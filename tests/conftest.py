@@ -1,0 +1,29 @@
+"""Test rig: 8 virtual CPU devices.
+
+SURVEY.md §5: the reference could only test multi-device behavior on a real
+cluster.  JAX removes that gap — ``--xla_force_host_platform_device_count``
+gives N fake CPU devices, so BSP/EASGD/GOSGD logic, mesh code, and
+collectives are all testable in CI with no TPU.  This file must run before
+anything imports jax.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# repo root on sys.path so `import theanompi_tpu` works without install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon environment pre-imports jax at interpreter startup (PYTHONPATH
+# sitecustomize), so the env vars above can be too late; force the platform
+# through the config API as well. Backends are created lazily, so this still
+# lands before any device is touched.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

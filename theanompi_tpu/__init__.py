@@ -1,0 +1,33 @@
+"""theanompi_tpu — a TPU-native distributed training framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of the
+reference Theano-MPI (upstream ``theanompi/__init__.py`` exports the three
+training rules BSP / EASGD / GOSGD; see SURVEY.md §3.1).  User-facing API
+mirrors the reference::
+
+    from theanompi_tpu import BSP
+    rule = BSP()
+    rule.init(devices=['tpu0', 'tpu1'],
+              modelfile='theanompi_tpu.models.cifar10',
+              modelclass='Cifar10_model')
+    rule.wait()
+
+Unlike the reference (one MPI process per GPU, mpirun launch), a rule here
+drives a single-controller SPMD program: one process per *host*, a
+``jax.sharding.Mesh`` over the devices, and XLA collectives (``lax.psum`` /
+``pmean``) over ICI instead of NCCL/MPI allreduce.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["BSP", "EASGD", "GOSGD", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy so that `import theanompi_tpu.runtime` doesn't pull in jax-heavy
+    # rule machinery (and so partial builds stay importable).
+    if name in ("BSP", "EASGD", "GOSGD"):
+        from theanompi_tpu.parallel import rules
+
+        return getattr(rules, name)
+    raise AttributeError(name)

@@ -1,0 +1,89 @@
+"""Config system.
+
+The reference configured models with plain dicts living inside each model
+file plus ``rule.init`` kwargs and THEANO_FLAGS env vars (SURVEY.md §3.7,
+"Config").  We keep the ergonomic part (per-model defaults in the model
+file, overridable at construction) and drop the env-var magic: everything
+is an explicit ``Config`` object.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, Mapping, Optional
+
+
+class Config:
+    """A small attribute-dict with defaults merging.
+
+    ``Config(defaults, **overrides)`` — overrides win.  Unknown-key access
+    raises ``AttributeError`` so typos fail loudly (the reference's raw
+    dicts failed silently with ``KeyError`` deep in the stack).
+    """
+
+    def __init__(self, defaults: Optional[Mapping[str, Any]] = None, **overrides: Any):
+        d: Dict[str, Any] = dict(defaults or {})
+        d.update(overrides)
+        object.__setattr__(self, "_d", d)
+
+    # -- mapping-ish interface -------------------------------------------
+    def __getattr__(self, k: str) -> Any:
+        # During unpickle/copy, __init__ is bypassed and "_d" is absent;
+        # guard it explicitly or the self._d lookup below recurses forever.
+        if k == "_d":
+            raise AttributeError("_d")
+        try:
+            return self._d[k]
+        except KeyError:
+            raise AttributeError(f"config has no key {k!r}") from None
+
+    def __getstate__(self) -> Dict[str, Any]:
+        return dict(self._d)
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        object.__setattr__(self, "_d", dict(state))
+
+    def __setattr__(self, k: str, v: Any) -> None:
+        self._d[k] = v
+
+    def __getitem__(self, k: str) -> Any:
+        return self._d[k]
+
+    def __setitem__(self, k: str, v: Any) -> None:
+        self._d[k] = v
+
+    def __contains__(self, k: str) -> bool:
+        return k in self._d
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._d)
+
+    def get(self, k: str, default: Any = None) -> Any:
+        return self._d.get(k, default)
+
+    def update(self, other: Optional[Mapping[str, Any]] = None, **kw: Any) -> "Config":
+        if other:
+            self._d.update(other)
+        self._d.update(kw)
+        return self
+
+    def asdict(self) -> Dict[str, Any]:
+        return dict(self._d)
+
+    def __repr__(self) -> str:
+        return f"Config({self._d!r})"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {k: v for k, v in self._d.items() if _jsonable(v)},
+            indent=2,
+            sort_keys=True,
+        )
+
+
+def _jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False

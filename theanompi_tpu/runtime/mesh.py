@@ -1,0 +1,169 @@
+"""Device mesh and distributed-runtime bootstrap.
+
+TPU-native replacement for the reference's process-per-GPU MPI runtime
+(upstream ``theanompi/lib/base.py``, class ``MPI_GPU_Process``: mpi4py
+``MPI.COMM_WORLD`` init + GPU binding via THEANO_FLAGS; SURVEY.md §3.2).
+
+Design differences, deliberately TPU-first:
+
+- One process per *host*, not per device.  ``jax.distributed.initialize()``
+  forms the multi-host process group (replaces MPI_COMM_WORLD); within a
+  process all local devices are driven by one Python thread.
+- The "communicator" is a ``jax.sharding.Mesh``.  Data parallelism is a mesh
+  axis (``dp``); collectives are XLA ops (``lax.psum`` etc.) compiled into
+  the step function, riding ICI within a slice and DCN across slices.
+- There is no GPU-binding step: device placement is expressed with
+  ``NamedSharding`` on arrays, never with env vars.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh-axis names used across the framework.
+DATA_AXIS = "dp"  # data parallelism (the only axis the reference had)
+MODEL_AXIS = "mp"  # reserved for tensor parallelism (not in reference scope)
+
+
+# Env markers that indicate a multi-process launch. Cloud TPU pods do NOT
+# set JAX_COORDINATOR_ADDRESS; their auto-config lives inside
+# jax.distributed.initialize() and is triggered by the TPU runtime env
+# (MEGASCALE_* / CLOUD_TPU_TASK_ID / TPU_WORKER_HOSTNAMES).
+_MULTIHOST_ENV_MARKERS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "CLOUD_TPU_TASK_ID",
+    "TPU_WORKER_HOSTNAMES",
+)
+
+_distributed_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host process group.
+
+    Replaces the reference's implicit ``MPI_Init`` (mpirun sets up
+    MPI_COMM_WORLD before ``MPI_GPU_Process.__init__`` runs).  On a
+    single-host run this is a no-op; on multi-host TPU pods the standard
+    JAX coordination service is used — no mpi4py anywhere.
+
+    Explicit arguments are authoritative: if any is given, initialization
+    failures propagate (a mistyped coordinator address must not silently
+    degrade to a single-host run).  With no arguments, we initialize only
+    when the environment indicates a multi-process launch, letting
+    ``jax.distributed.initialize()`` auto-configure from the TPU runtime.
+
+    Returns True if the process group is (now) initialized. Idempotent.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return True
+    explicit = any(
+        a is not None for a in (coordinator_address, num_processes, process_id)
+    )
+    if not explicit and not any(os.environ.get(k) for k in _MULTIHOST_ENV_MARKERS):
+        return False  # single-host: nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _distributed_initialized = True
+    return True
+
+
+def num_devices() -> int:
+    return jax.device_count()
+
+
+def local_devices() -> Sequence[jax.Device]:
+    return jax.local_devices()
+
+
+def process_index() -> int:
+    """Analog of the reference's MPI rank — but per *host*, not per device."""
+    return jax.process_index()
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Tuple[str, ...] = (DATA_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the device mesh the training rules run over.
+
+    This is the TPU analog of the reference's communicator construction
+    (``MPI.COMM_WORLD`` + NCCL clique bootstrap in
+    ``theanompi/lib/exchanger.py``; SURVEY.md §4.1).  There is no clique-id
+    broadcast: XLA's runtime owns the ICI topology, we only name the axes.
+
+    Args:
+      shape: mesh shape, e.g. ``(8,)`` or ``(4, 2)``. Defaults to all
+        devices on one data-parallel axis.
+      axis_names: one name per mesh dimension. ``('dp',)`` by default.
+      devices: explicit device list (tests use a subset of fake CPU
+        devices). Defaults to all global devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if shape is None:
+        shape = (len(devices),)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} does not cover {len(devices)} devices"
+        )
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} vs axis_names {axis_names} mismatch")
+    if len(shape) > 1 and len(devices) == jax.device_count():
+        # ICI-topology-aware ordering for real multi-dim meshes.
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+            return Mesh(dev_array, axis_names)
+        except Exception:
+            pass
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for parameters: fully replicated across the mesh.
+
+    Matches the reference's model: every worker holds a full copy of the
+    parameters (pure data parallelism; SURVEY.md §3.4).
+    """
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis.
+
+    Replaces the reference's per-rank batch-file sharding
+    (``theanompi/lib/helper_funcs.py`` divides batch counts among MPI
+    ranks): here the *global* batch is one array whose leading dimension is
+    sharded over ``dp``.
+    """
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
+    """Place a host batch (pytree of np arrays) onto the mesh, sharded."""
+    sh = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def replicate(mesh: Mesh, tree):
+    """Place a host pytree onto the mesh fully replicated."""
+    sh = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

@@ -1,0 +1,155 @@
+"""Recorder — per-iteration timing and metric bookkeeping.
+
+Re-creation of the reference's homegrown profiler
+(upstream ``theanompi/lib/recorder.py``, class ``Recorder``; SURVEY.md
+§3.7 / §6 "Tracing"): wall-clock split per iteration into calc / comm /
+wait / load segments, running train loss+error, per-epoch val error, a
+print every K iterations, and a record dumped to disk for offline plots.
+
+TPU-honesty note: JAX dispatch is async, so a naive ``time.time()`` around
+a jitted call measures dispatch, not compute.  Callers that want honest
+segment times must fence with ``jax.block_until_ready`` before ``end()``;
+the workers in ``theanompi_tpu.parallel`` do exactly that.  For op-level
+depth the recorder can also drive ``jax.profiler`` traces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+PHASES = ("calc", "comm", "wait", "load")
+
+
+class Recorder:
+    def __init__(
+        self,
+        print_freq: int = 40,
+        rank: int = 0,
+        verbose: bool = True,
+        save_dir: Optional[str] = None,
+    ):
+        self.print_freq = int(print_freq)
+        self.rank = rank
+        self.verbose = verbose
+        self.save_dir = save_dir
+
+        self._t0: Dict[str, float] = {}
+        # accumulated seconds per phase since last print
+        self._acc: Dict[str, float] = {p: 0.0 for p in PHASES}
+        # full history rows for offline plotting (reference dumps a record
+        # file loadable by a show_record.py-style script)
+        self.history: List[dict] = []
+
+        self._train_cost = 0.0
+        self._train_err = 0.0
+        self._train_n = 0
+        self.epoch_start: Optional[float] = None
+        self.val_history: List[dict] = []
+
+    # ---- timing segments ------------------------------------------------
+    def start(self, what: str = "calc") -> None:
+        self._t0[what] = time.perf_counter()
+
+    def end(self, what: str = "calc") -> float:
+        t0 = self._t0.pop(what, None)
+        if t0 is None:
+            return 0.0
+        dt = time.perf_counter() - t0
+        self._acc[what] = self._acc.get(what, 0.0) + dt
+        return dt
+
+    # ---- epoch ----------------------------------------------------------
+    def start_epoch(self) -> None:
+        self.epoch_start = time.perf_counter()
+
+    def end_epoch(self, count: int, epoch: int) -> float:
+        dt = (
+            time.perf_counter() - self.epoch_start
+            if self.epoch_start is not None
+            else 0.0
+        )
+        if self.verbose and self.rank == 0:
+            print(f"epoch {epoch} took {dt:.2f}s", flush=True)
+        self.epoch_start = None
+        return dt
+
+    # ---- train metrics --------------------------------------------------
+    def train_error(self, count: int, cost: float, error: float) -> None:
+        self._train_cost += float(cost)
+        self._train_err += float(error)
+        self._train_n += 1
+
+    def print_train_info(self, count: int, force: bool = False) -> None:
+        if (count % self.print_freq != 0 and not force) or self._train_n == 0:
+            return
+        n = self._train_n
+        row = {
+            "iter": count,
+            "cost": self._train_cost / n,
+            "error": self._train_err / n,
+            **{p: self._acc.get(p, 0.0) for p in PHASES},
+        }
+        self.history.append(row)
+        if self.verbose and self.rank == 0:
+            t = {p: row[p] for p in PHASES}
+            print(
+                f"iter {count}: cost {row['cost']:.4f} err {row['error']:.4f} "
+                f"| calc {t['calc']:.3f}s comm {t['comm']:.3f}s "
+                f"wait {t['wait']:.3f}s load {t['load']:.3f}s",
+                flush=True,
+            )
+        self._train_cost = self._train_err = 0.0
+        self._train_n = 0
+        for p in PHASES:
+            self._acc[p] = 0.0
+
+    # ---- val metrics ----------------------------------------------------
+    def val_error(
+        self, count: int, cost: float, error: float, error_top5: float = 0.0
+    ) -> None:
+        self.val_history.append(
+            {
+                "iter": count,
+                "cost": float(cost),
+                "error": float(error),
+                "error_top5": float(error_top5),
+            }
+        )
+
+    def print_val_info(self, count: int) -> None:
+        if not self.val_history:
+            return
+        row = self.val_history[-1]
+        if self.verbose and self.rank == 0:
+            print(
+                f"val @ iter {count}: cost {row['cost']:.4f} "
+                f"err {row['error']:.4f} err5 {row['error_top5']:.4f}",
+                flush=True,
+            )
+
+    # ---- persistence ----------------------------------------------------
+    def save(self, path: Optional[str] = None) -> str:
+        """Dump the record as JSONL (reference pickles a list; we keep the
+        same offline-plotting contract with a friendlier format)."""
+        if self._train_n:
+            # flush the partial window so short runs / run tails aren't lost
+            last_iter = self.history[-1]["iter"] + self._train_n if self.history else self._train_n
+            self.print_train_info(last_iter, force=True)
+        if path is None:
+            d = self.save_dir or "."
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"record_rank{self.rank}.jsonl")
+        with open(path, "w") as f:
+            for row in self.history:
+                f.write(json.dumps({"kind": "train", **row}) + "\n")
+            for row in self.val_history:
+                f.write(json.dumps({"kind": "val", **row}) + "\n")
+        return path
+
+    @staticmethod
+    def load(path: str) -> List[dict]:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
