@@ -1,0 +1,109 @@
+"""BSP end-to-end: the SURVEY.md §8.2 step-4 acceptance tests.
+
+Key invariant (reference validated this manually on a cluster; SURVEY.md
+§5): an N-device cdd run must match a 1-device run with the same global
+batch, because mean-of-shard-mean gradients == global-batch mean gradient.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import theanompi_tpu
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.runtime.mesh import make_mesh
+from theanompi_tpu.runtime.recorder import Recorder
+
+
+TINY = dict(
+    n_synth_train=512,
+    n_synth_val=64,
+    n_epochs=1,
+    dropout_rate=0.0,  # per-shard rng would break exact 1-vs-N equivalence
+    print_freq=1000,
+)
+
+
+def _run_steps(mesh, per_shard_bs, n_steps, **cfg):
+    model = Cifar10_model(
+        config=dict(TINY, batch_size=per_shard_bs, **cfg), mesh=mesh
+    )
+    model.compile_train()
+    rec = Recorder(verbose=False)
+    model.reset_train_iter(0)
+    return [model.train_iter(i, rec)[0] for i in range(1, n_steps + 1)], model
+
+
+def test_cdd_n_device_matches_single_device():
+    losses8, _ = _run_steps(make_mesh(), per_shard_bs=8, n_steps=4)
+    losses1, _ = _run_steps(
+        make_mesh(devices=jax.devices()[:1]), per_shard_bs=64, n_steps=4
+    )
+    np.testing.assert_allclose(losses8, losses1, rtol=2e-4)
+
+
+def test_cdd_loss_decreases():
+    losses, _ = _run_steps(make_mesh(), per_shard_bs=8, n_steps=8)
+    assert losses[-1] < losses[0]
+
+
+def test_avg_mode_runs_and_learns():
+    losses, model = _run_steps(make_mesh(), per_shard_bs=8, n_steps=8, sync_mode="avg")
+    assert losses[-1] < losses[0]
+    # params stay replicated-identical after averaging
+    leaf = jax.tree.leaves(model.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    np.testing.assert_array_equal(shards[0], shards[-1])
+
+
+@pytest.mark.parametrize("strategy", ["bf16", "fp16", "pallas_bf16"])
+def test_compressed_strategies_track_fp32(strategy):
+    losses_ar, _ = _run_steps(make_mesh(), per_shard_bs=8, n_steps=4)
+    losses_c, _ = _run_steps(
+        make_mesh(), per_shard_bs=8, n_steps=4, exch_strategy=strategy
+    )
+    # compressed wire loses precision but must track closely
+    np.testing.assert_allclose(losses_c, losses_ar, rtol=2e-2)
+
+
+def test_unknown_strategy_rejected():
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+    with pytest.raises(ValueError):
+        BSP_Exchanger(strategy="nccl99")
+
+
+def test_rule_api_end_to_end(tmp_path):
+    rule = theanompi_tpu.BSP()
+    rule.init(
+        devices=8,
+        modelfile="theanompi_tpu.models.cifar10",
+        modelclass="Cifar10_model",
+        model_config=dict(TINY, batch_size=4),
+        checkpoint_dir=str(tmp_path),
+        val_freq=1,
+    )
+    model = rule.wait()
+    assert model.current_epoch == 1
+    # checkpoint written + recorder record saved
+    files = list(tmp_path.iterdir())
+    assert any(f.name.startswith("ckpt_") for f in files)
+    assert any(f.name.startswith("record_") for f in files)
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    _, model = _run_steps(make_mesh(), per_shard_bs=8, n_steps=2)
+    path = model.save_model(str(tmp_path / "ckpt_0001.npz"))
+    model2 = Cifar10_model(config=dict(TINY, batch_size=8), mesh=make_mesh())
+    model2.load_model(path)
+    for a, b in zip(jax.tree.leaves(model.params), jax.tree.leaves(model2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(model2.opt_state["lr"]) == float(model.opt_state["lr"])
+
+
+def test_scale_lr_and_adjust_hyperp():
+    model = Cifar10_model(config=dict(TINY, batch_size=8), mesh=make_mesh())
+    model.adjust_hyperp(0)
+    base = float(model.opt_state["lr"])
+    model.scale_lr(8.0)
+    assert float(model.opt_state["lr"]) == pytest.approx(8 * base)

@@ -1,0 +1,212 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import losses, optim
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_conv2d_shapes_and_fp32_accum():
+    layer = L.Conv2d(8, 3, stride=2, padding="SAME", compute_dtype=jnp.bfloat16)
+    p, s, out = layer.init(KEY, (16, 16, 3))
+    assert out == (8, 8, 8)
+    x = jnp.ones((2, 16, 16, 3))
+    y, _ = layer.apply(p, s, x)
+    assert y.shape == (2, 8, 8, 8)
+    assert y.dtype == jnp.float32  # MXU accumulation stays fp32
+
+
+def test_conv2d_valid_padding_shape():
+    layer = L.Conv2d(4, 5, stride=1, padding="VALID")
+    p, s, out = layer.init(KEY, (12, 12, 3))
+    assert out == (8, 8, 4)
+    y, _ = layer.apply(p, s, jnp.zeros((1, 12, 12, 3)))
+    assert y.shape[1:] == out
+
+
+def test_dense():
+    layer = L.Dense(10)
+    p, s, out = layer.init(KEY, (32,))
+    assert out == (10,)
+    y, _ = layer.apply(p, s, jnp.ones((4, 32)))
+    assert y.shape == (4, 10)
+
+
+def test_pools():
+    mp = L.MaxPool(2)
+    p, s, out = mp.init(KEY, (8, 8, 3))
+    assert out == (4, 4, 3)
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    y, _ = mp.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+    ap = L.AvgPool(2)
+    y2, _ = ap.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y2)[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_global_avg_pool():
+    g = L.GlobalAvgPool()
+    _, _, out = g.init(KEY, (7, 7, 64))
+    assert out == (64,)
+    y, _ = g.apply({}, {}, jnp.ones((2, 7, 7, 64)) * 3.0)
+    np.testing.assert_allclose(np.asarray(y), 3.0)
+
+
+def test_lrn_matches_manual():
+    lrn = L.LRN(size=3, alpha=1e-4, beta=0.75, k=2.0)
+    x = jax.random.normal(KEY, (2, 4, 4, 6))
+    y, _ = lrn.apply({}, {}, x)
+    xn = np.asarray(x)
+    # manual cross-channel window sum
+    sq = xn**2
+    out = np.zeros_like(xn)
+    C = xn.shape[-1]
+    for c in range(C):
+        lo, hi = max(0, c - 1), min(C, c + 2)
+        denom = (2.0 + 1e-4 * sq[..., lo:hi].sum(-1)) ** 0.75
+        out[..., c] = xn[..., c] / denom
+    np.testing.assert_allclose(np.asarray(y), out, rtol=1e-5)
+
+
+def test_batchnorm_train_and_eval():
+    bn = L.BatchNorm(momentum=0.5)
+    p, s, _ = bn.init(KEY, (4,))
+    x = jax.random.normal(KEY, (64, 4)) * 3.0 + 1.0
+    y, s1 = bn.apply(p, s, x, train=True)
+    # normalized output: ~zero mean, unit var
+    np.testing.assert_allclose(np.asarray(y.mean(0)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y.var(0)), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(s1["mean"]), 0.0)
+    # eval mode uses running stats and does not change state
+    y2, s2 = bn.apply(p, s1, x, train=False)
+    assert s2 is s1
+
+
+def test_dropout():
+    d = L.Dropout(0.5)
+    x = jnp.ones((1000,))
+    y, _ = d.apply({}, {}, x, train=True, rng=KEY)
+    kept = float((np.asarray(y) > 0).mean())
+    assert 0.4 < kept < 0.6
+    np.testing.assert_allclose(np.asarray(y).max(), 2.0)  # inverted scaling
+    y_eval, _ = d.apply({}, {}, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.asarray(x))
+    with pytest.raises(ValueError):
+        d.apply({}, {}, x, train=True, rng=None)
+
+
+def test_sequential_and_flatten():
+    net = L.Sequential(
+        [
+            L.Conv2d(4, 3),
+            L.Relu(),
+            L.MaxPool(2),
+            L.Flatten(),
+            L.Dense(10),
+        ]
+    )
+    p, s, out = net.init(KEY, (8, 8, 3))
+    assert out == (10,)
+    y, s1 = net.apply(p, s, jnp.ones((2, 8, 8, 3)), train=True, rng=KEY)
+    assert y.shape == (2, 10)
+    assert len(s1) == len(net.layers)
+
+
+def test_parallel_concat():
+    block = L.Parallel(
+        [
+            L.Conv2d(4, 1),
+            L.Sequential([L.Conv2d(2, 1), L.Relu(), L.Conv2d(6, 3)]),
+        ]
+    )
+    p, s, out = block.init(KEY, (8, 8, 3))
+    assert out == (8, 8, 10)
+    y, _ = block.apply(p, s, jnp.ones((2, 8, 8, 3)))
+    assert y.shape == (2, 8, 8, 10)
+
+
+def test_parallel_shape_mismatch():
+    block = L.Parallel([L.Conv2d(4, 1), L.MaxPool(2)])
+    with pytest.raises(ValueError):
+        block.init(KEY, (8, 8, 3))
+
+
+def test_losses_match_manual():
+    logits = jnp.array([[2.0, 0.0, -1.0], [0.0, 3.0, 0.0]])
+    labels = jnp.array([0, 2])
+    ce = losses.softmax_cross_entropy(logits, labels)
+    lp = np.log(np.exp(np.asarray(logits)) / np.exp(np.asarray(logits)).sum(-1, keepdims=True))
+    np.testing.assert_allclose(float(ce), -(lp[0, 0] + lp[1, 2]) / 2, rtol=1e-6)
+    err = losses.classification_error(logits, labels)
+    assert float(err) == 0.5
+    err5 = losses.topk_error(logits, labels, k=2)
+    assert float(err5) == 0.5  # label 2 not in top-2 of row 1
+
+
+def test_sgd_momentum_matches_numpy():
+    opt = optim.sgd(lr=0.1, momentum=0.9, weight_decay=0.01)
+    params = {"w": jnp.ones((3,))}
+    state = opt.init(params)
+    grads = {"w": jnp.full((3,), 0.5)}
+    # numpy reference
+    w, v = np.ones(3), np.zeros(3)
+    for _ in range(3):
+        g = 0.5 + 0.01 * w
+        v = 0.9 * v - 0.1 * g
+        w = w + v
+    p = params
+    for _ in range(3):
+        g = {"w": jnp.full((3,), 0.5)}
+        p, state = opt.update(p, g, state)
+    np.testing.assert_allclose(np.asarray(p["w"]), w, rtol=1e-5)
+    assert int(state["step"]) == 3
+
+
+def test_sgd_nesterov_runs_and_lr_set():
+    opt = optim.sgd(lr=0.1, momentum=0.9, nesterov=True)
+    params = {"w": jnp.ones((2,))}
+    state = opt.init(params)
+    p, state = opt.update(params, {"w": jnp.ones((2,))}, state)
+    assert not np.allclose(np.asarray(p["w"]), 1.0)
+    state = optim.set_lr(state, 0.001)
+    assert optim.get_lr(state) == pytest.approx(0.001)
+
+
+def test_sgd_update_is_jittable():
+    opt = optim.sgd(lr=0.05, momentum=0.9)
+    params = {"w": jnp.ones((4, 4))}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, g, s):
+        return opt.update(p, g, s)
+
+    p1, s1 = step(params, {"w": jnp.ones((4, 4))}, state)
+    # lr change must NOT retrigger compile-sensitive behavior (it's a leaf)
+    s1 = optim.set_lr(s1, 0.01)
+    p2, s2 = step(p1, {"w": jnp.ones((4, 4))}, s1)
+    assert float(s2["lr"]) == pytest.approx(0.01)
+
+
+def test_schedules():
+    sch = optim.step_decay(0.1, [2, 4], 0.1)
+    assert sch(0) == pytest.approx(0.1)
+    assert sch(2) == pytest.approx(0.01)
+    assert sch(4) == pytest.approx(0.001)
+    w = optim.linear_warmup_step(0.8, 4, [10])
+    assert w(0) == pytest.approx(0.2)
+    assert w(3) == pytest.approx(0.8)
+    assert w(10) == pytest.approx(0.08)
+    assert optim.exp_decay(1.0, 0.5)(2) == pytest.approx(0.25)
+    assert optim.constant(0.3)(99) == pytest.approx(0.3)
+
+
+def test_count_params():
+    net = L.Sequential([L.Dense(4), L.Dense(2)])
+    p, _, _ = net.init(KEY, (3,))
+    assert L.count_params(p) == (3 * 4 + 4) + (4 * 2 + 2)

@@ -112,6 +112,17 @@ def test_recorder_save_flushes_partial_window(tmp_path):
 def test_init_distributed_single_host_noop(monkeypatch):
     from theanompi_tpu.runtime import mesh as mesh_mod
 
-    for k in mesh_mod._MULTIHOST_ENV_MARKERS:
+    for k in (*mesh_mod._MULTIHOST_ENV_MARKERS, "TPU_WORKER_HOSTNAMES"):
         monkeypatch.delenv(k, raising=False)
     assert mesh_mod.init_distributed() is False
+
+
+def test_single_entry_hostnames_is_single_host(monkeypatch):
+    from theanompi_tpu.runtime import mesh as mesh_mod
+
+    for k in mesh_mod._MULTIHOST_ENV_MARKERS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert mesh_mod._env_says_multihost() is False
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h1,h2")
+    assert mesh_mod._env_says_multihost() is True

@@ -1,0 +1,2 @@
+from theanompi_tpu.data.providers import ArrayDataset, Cifar10Data, ImageNetData  # noqa: F401
+from theanompi_tpu.data.loader import PrefetchLoader  # noqa: F401

@@ -1,0 +1,266 @@
+"""Data providers.
+
+Re-creation of the reference's data layer (upstream
+``theanompi/models/data/{cifar10,imagenet}.py``; SURVEY.md §3.6): batch
+lists, per-epoch shuffling, per-rank sharding, mean subtraction and
+crop/mirror augmentation.
+
+TPU-first differences:
+
+- Providers yield **global** batches (``per_replica_batch × n_dp``); the
+  worker shards the leading dim over the mesh with one ``device_put``.
+  There is no per-rank file bookkeeping — the mesh owns placement.
+- The reference stored pre-processed ImageNet as hickle/HDF5 ``.hkl``
+  files; we use ``.npz`` shard files (same idea, no HDF5 C dependency).
+- No network in this environment, so every provider has a deterministic
+  synthetic fallback (class-conditional Gaussian images) — learnable, so
+  convergence tests mean something.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class ArrayDataset:
+    """In-RAM (x, y) with per-epoch shuffle and global-batch iteration."""
+
+    def __init__(
+        self,
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        x_val: np.ndarray,
+        y_val: np.ndarray,
+        batch_size: int,
+        seed: int = 0,
+    ):
+        self.x_train, self.y_train = x_train, y_train
+        self.x_val, self.y_val = x_val, y_val
+        self.batch_size = int(batch_size)  # GLOBAL batch size
+        self._rng = np.random.RandomState(seed)
+        self.n_batch_train = len(x_train) // self.batch_size
+        self.n_batch_val = max(1, len(x_val) // self.batch_size)
+        self._order = np.arange(len(x_train))
+
+    def shuffle(self, epoch: Optional[int] = None) -> None:
+        """Per-epoch reshuffle. Pass ``epoch`` for resumable determinism
+        (resume = re-seed and fast-forward; SURVEY.md §6 checkpoint row)."""
+        if epoch is not None:
+            rng = np.random.RandomState(hash(("epoch", epoch)) % (2**31))
+            self._order = rng.permutation(len(self.x_train))
+        else:
+            self._order = self._rng.permutation(len(self.x_train))
+
+    def train_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        bs = self.batch_size
+        for i in range(self.n_batch_train):
+            idx = self._order[i * bs : (i + 1) * bs]
+            yield self.x_train[idx], self.y_train[idx]
+
+    def val_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        bs = self.batch_size
+        for i in range(self.n_batch_val):
+            yield self.x_val[i * bs : (i + 1) * bs], self.y_val[i * bs : (i + 1) * bs]
+
+
+def _synthetic_classification(
+    n: int, shape: Tuple[int, ...], n_classes: int, seed: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional Gaussians: mean pattern per class + noise.
+
+    Learnable by a linear model, so loss curves in tests/benches move."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(n_classes, *shape).astype(np.float32) * 0.5
+    y = rng.randint(0, n_classes, size=n).astype(np.int32)
+    x = protos[y] + rng.randn(n, *shape).astype(np.float32) * 0.3
+    return x, y
+
+
+class Cifar10Data:
+    """CIFAR-10 provider (reference: models/data/cifar10.py).
+
+    Loads the standard python pickle batches from ``data_dir`` when
+    present; otherwise generates a synthetic stand-in with identical
+    shapes (no network in this environment to download the real set).
+    """
+
+    shape = (32, 32, 3)  # NHWC
+    n_classes = 10
+
+    def __init__(
+        self,
+        batch_size: int,
+        data_dir: Optional[str] = None,
+        n_synth_train: int = 8192,
+        n_synth_val: int = 1024,
+        seed: int = 0,
+    ):
+        data_dir = data_dir or os.environ.get("CIFAR10_DIR", "")
+        loaded = self._try_load_real(data_dir) if data_dir else None
+        if loaded is not None:
+            xtr, ytr, xva, yva = loaded
+            self.synthetic = False
+        else:
+            xtr, ytr = _synthetic_classification(
+                n_synth_train, self.shape, self.n_classes, seed
+            )
+            xva, yva = _synthetic_classification(
+                n_synth_val, self.shape, self.n_classes, seed + 1
+            )
+            self.synthetic = True
+        # mean subtraction, as the reference does with the stored img_mean
+        self.mean = xtr.mean(axis=0, keepdims=True)
+        xtr = xtr - self.mean
+        xva = xva - self.mean
+        self.dataset = ArrayDataset(xtr, ytr, xva, yva, batch_size, seed)
+
+    @staticmethod
+    def _try_load_real(data_dir: str):
+        try:
+            xs, ys = [], []
+            for i in range(1, 6):
+                with open(os.path.join(data_dir, f"data_batch_{i}"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"])
+                ys.append(d[b"labels"])
+            with open(os.path.join(data_dir, "test_batch"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xtr = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            ytr = np.concatenate(ys).astype(np.int32)
+            xva = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            yva = np.asarray(d[b"labels"], np.int32)
+            return (
+                xtr.astype(np.float32) / 255.0,
+                ytr,
+                xva.astype(np.float32) / 255.0,
+                yva,
+            )
+        except (OSError, KeyError, pickle.UnpicklingError):
+            return None
+
+    # provider facade used by workers
+    def shuffle(self, epoch=None):
+        self.dataset.shuffle(epoch)
+
+    def train_batches(self):
+        return self.dataset.train_batches()
+
+    def val_batches(self):
+        return self.dataset.val_batches()
+
+    @property
+    def n_batch_train(self):
+        return self.dataset.n_batch_train
+
+    @property
+    def n_batch_val(self):
+        return self.dataset.n_batch_val
+
+
+class ImageNetData:
+    """ImageNet-style provider over pre-processed ``.npz`` shard files.
+
+    Reference analog: hickle ``.hkl`` batch files listed and sharded per
+    rank (models/data/imagenet.py). Each ``.npz`` holds ``x`` (N,H,W,C
+    float32 or uint8) and ``y`` (N,) int labels. When ``data_dir`` is
+    absent, synthesizes batches on the fly at the configured image size
+    (128px default — the AlexNet-128 benchmark of BASELINE.json).
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        data_dir: Optional[str] = None,
+        image_size: int = 128,
+        n_classes: int = 1000,
+        n_synth_batches: int = 64,
+        n_synth_val_batches: int = 4,
+        seed: int = 0,
+        crop_size: Optional[int] = None,
+        mirror: bool = True,
+    ):
+        self.batch_size = int(batch_size)
+        self.image_size = image_size
+        self.n_classes = n_classes
+        self.crop_size = crop_size
+        self.mirror = mirror
+        self._rng = np.random.RandomState(seed)
+        data_dir = data_dir or os.environ.get("IMAGENET_NPZ_DIR", "")
+        if data_dir and os.path.isdir(data_dir):
+            self.train_files = sorted(
+                os.path.join(data_dir, "train", f)
+                for f in os.listdir(os.path.join(data_dir, "train"))
+                if f.endswith(".npz")
+            )
+            self.val_files = sorted(
+                os.path.join(data_dir, "val", f)
+                for f in os.listdir(os.path.join(data_dir, "val"))
+                if f.endswith(".npz")
+            )
+            self.synthetic = False
+        else:
+            self.train_files = [f"synthetic://{i}" for i in range(n_synth_batches)]
+            self.val_files = [f"synthetic://{i}" for i in range(n_synth_val_batches)]
+            self.synthetic = True
+        self._order = np.arange(len(self.train_files))
+
+    @property
+    def n_batch_train(self):
+        return len(self.train_files)
+
+    @property
+    def n_batch_val(self):
+        return len(self.val_files)
+
+    def shuffle(self, epoch=None):
+        if epoch is not None:
+            rng = np.random.RandomState(hash(("epoch", epoch)) % (2**31))
+            self._order = rng.permutation(len(self.train_files))
+        else:
+            self._order = self._rng.permutation(len(self.train_files))
+
+    def _load(self, path: str, train: bool):
+        if path.startswith("synthetic://"):
+            i = int(path.split("//")[1])
+            shape = (self.image_size, self.image_size, 3)
+            x, y = _synthetic_classification(
+                self.batch_size, shape, self.n_classes, seed=i
+            )
+        else:
+            with np.load(path) as d:
+                x = d["x"].astype(np.float32)
+                if x.max() > 2.0:  # uint8-scaled
+                    x = x / 255.0
+                y = d["y"].astype(np.int32)
+            x, y = x[: self.batch_size], y[: self.batch_size]
+        if train:
+            x = self._augment(x)
+        elif self.crop_size:
+            c = self.crop_size
+            off = (x.shape[1] - c) // 2
+            x = x[:, off : off + c, off : off + c, :]
+        return x, y
+
+    def _augment(self, x: np.ndarray) -> np.ndarray:
+        """Random crop + mirror, the reference's ImageNet augmentation."""
+        if self.crop_size:
+            c = self.crop_size
+            max_off = x.shape[1] - c
+            oh = self._rng.randint(0, max_off + 1)
+            ow = self._rng.randint(0, max_off + 1)
+            x = x[:, oh : oh + c, ow : ow + c, :]
+        if self.mirror and self._rng.rand() < 0.5:
+            x = x[:, :, ::-1, :]
+        return x
+
+    def train_batches(self):
+        for i in self._order:
+            yield self._load(self.train_files[i], train=True)
+
+    def val_batches(self):
+        for f in self.val_files:
+            yield self._load(f, train=False)

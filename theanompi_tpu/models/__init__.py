@@ -1,0 +1,9 @@
+"""Model zoo.
+
+Reference analog: ``theanompi/models/`` (SURVEY.md §3.5). Every model
+implements the duck-typed contract the workers drive:
+``__init__(config)``, ``build_model()``, ``compile_train()``,
+``compile_val()``, ``train_iter()``, ``val_iter()``,
+``adjust_hyperp(epoch)``, attrs ``params``, ``data``, ``batch_size``,
+``n_epochs``.
+"""

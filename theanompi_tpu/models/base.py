@@ -1,0 +1,308 @@
+"""Model-contract base class.
+
+The reference enforced a duck-typed model API consumed by its workers
+(upstream README + worker code; SURVEY.md §3.5 "Model contract"):
+``__init__(config)``, ``build_model()``, ``compile_train()``,
+``compile_val()``, ``train_iter(count, recorder)``, ``val_iter(count,
+recorder)``, ``adjust_hyperp(epoch)``, ``scale_lr(factor)``,
+``cleanup()``, attrs ``params``, ``data``, ``batch_size``, ``n_epochs``.
+
+``TpuModel`` implements that contract once, TPU-first:
+
+- ``compile_train`` emits ONE jitted XLA program containing forward,
+  backward, the BSP exchange (``lax.psum`` via ``BSP_Exchanger``) and the
+  optimizer update, shard_mapped over the mesh's ``dp`` axis.  The
+  reference's separate "theano function + exchanger.exchange()" phases
+  fuse into a single compiled step (SURVEY.md §4.5 TPU mapping).
+- Parameters / optimizer state / BN state are replicated pytrees on the
+  mesh; batches are sharded on the leading dim.
+- Subclasses define ``build_data()`` (set ``self.data``) and
+  ``build_net()`` (return ``(net, input_shape)``), plus per-model config
+  defaults and lr schedule.  Models that are not plain classifiers (the
+  GAN) override ``compile_train``/``train_iter`` instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.data.loader import prefetch_to_mesh
+from theanompi_tpu.ops import losses
+from theanompi_tpu.ops import optim as optim_lib
+from theanompi_tpu.ops.layers import Layer, count_params
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+from theanompi_tpu.runtime.config import Config
+from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh, replicate
+
+COMMON_DEFAULTS = dict(
+    seed=0,
+    batch_size=128,  # per data-parallel shard, like the reference's per-GPU bs
+    n_epochs=10,
+    lr=0.01,
+    momentum=0.9,
+    nesterov=False,
+    weight_decay=1e-4,
+    sync_mode="cdd",  # 'cdd' = gradient reduce; 'avg' = param averaging
+    exch_strategy="ar",  # 'ar' | 'bf16' | 'fp16' | 'pallas_bf16'
+    prefetch_depth=2,
+    print_freq=40,
+    val_top5=True,
+    compute_dtype=None,  # e.g. 'bfloat16' for MXU-native compute
+)
+
+
+class TpuModel:
+    default_config: dict = {}
+
+    def __init__(self, config: Optional[dict] = None, mesh=None, **overrides):
+        self.config = Config(COMMON_DEFAULTS)
+        self.config.update(self.default_config)
+        if config:
+            self.config.update(dict(config))
+        self.config.update(overrides)
+        cfg = self.config
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_workers = int(self.mesh.shape[DATA_AXIS])
+        self.batch_size = int(cfg.batch_size)
+        self.global_batch = self.batch_size * self.n_workers
+        self.n_epochs = int(cfg.n_epochs)
+        self.rng = jax.random.PRNGKey(int(cfg.seed))
+
+        self.data = None
+        self.net: Optional[Layer] = None
+        self.input_shape: Optional[Tuple[int, ...]] = None
+        self.lr_schedule = optim_lib.constant(float(cfg.lr))
+        self._lr_scale = 1.0
+
+        self.build_data()
+        self.build_model()
+
+        self.train_fn = None
+        self.val_fn = None
+        self._train_it = None
+        self._val_it = None
+        self.current_epoch = 0
+
+    # ------------------------------------------------------------------
+    # subclass hooks
+    # ------------------------------------------------------------------
+    def build_data(self) -> None:
+        raise NotImplementedError
+
+    def build_net(self) -> Tuple[Layer, Tuple[int, ...]]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # contract: build_model
+    # ------------------------------------------------------------------
+    def build_model(self) -> None:
+        cfg = self.config
+        self.net, self.input_shape = self.build_net()
+        self.rng, init_key = jax.random.split(self.rng)
+        params, net_state, out_shape = self.net.init(init_key, self.input_shape)
+        self.out_shape = out_shape
+        self.optimizer = optim_lib.sgd(
+            lr=float(cfg.lr),
+            momentum=float(cfg.momentum),
+            nesterov=bool(cfg.nesterov),
+            weight_decay=float(cfg.weight_decay),
+        )
+        opt_state = self.optimizer.init(params)
+        # replicate across the mesh (reference: each rank holds a copy)
+        self.params = replicate(self.mesh, params)
+        self.net_state = replicate(self.mesh, net_state)
+        self.opt_state = replicate(self.mesh, opt_state)
+        self.n_params = count_params(params)
+
+    # ------------------------------------------------------------------
+    # loss — default classifier; GAN overrides
+    # ------------------------------------------------------------------
+    def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
+        dtype = self.config.compute_dtype
+        if dtype is not None:
+            x = x.astype(jnp.dtype(dtype))
+        logits, new_state = self.net.apply(params, net_state, x, train=train, rng=rng)
+        loss = losses.softmax_cross_entropy(logits, y)
+        err = losses.classification_error(logits, y)
+        if self.config.val_top5 and logits.shape[-1] > 5:
+            err5 = losses.topk_error(logits, y, k=5)
+        else:
+            err5 = err
+        return loss, (err, err5, new_state)
+
+    # ------------------------------------------------------------------
+    # contract: compile_train / compile_val  (reference names [DRIVER])
+    # ------------------------------------------------------------------
+    def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
+        cfg = self.config
+        exchanger = exchanger or BSP_Exchanger(strategy=cfg.exch_strategy)
+        axis = exchanger.axis
+        opt = self.optimizer
+        sync_mode = cfg.sync_mode
+        if sync_mode not in ("cdd", "avg"):
+            raise ValueError(f"sync_mode must be 'cdd' or 'avg', got {sync_mode!r}")
+
+        def shard_step(params, net_state, opt_state, x, y, rng):
+            rng = jax.random.fold_in(rng, lax.axis_index(axis))
+
+            def loss_fn(p):
+                return self.loss_and_metrics(p, net_state, x, y, True, rng)
+
+            (loss, (err, _, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            if sync_mode == "cdd":
+                grads = exchanger.reduce_grads(grads)
+                params, opt_state = opt.update(params, grads, opt_state)
+            else:  # avg: local step, then parameter averaging
+                params, opt_state = opt.update(params, grads, opt_state)
+                params = exchanger.average_params(params)
+                opt_state = dict(
+                    opt_state,
+                    velocity=jax.tree.map(
+                        lambda v: lax.pmean(v, axis), opt_state["velocity"]
+                    ),
+                )
+            # BN running stats: sync so the replicated out-spec holds
+            new_state = jax.tree.map(lambda s: lax.pmean(s, axis), new_state)
+            loss = lax.pmean(loss, axis)
+            err = lax.pmean(err, axis)
+            return params, new_state, opt_state, loss, err
+
+        mapped = jax.shard_map(
+            shard_step,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+            check_vma=False,
+        )
+        self.train_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+        self.exchanger = exchanger
+        return self.train_fn
+
+    def compile_val(self):
+        def shard_eval(params, net_state, x, y):
+            loss, (err, err5, _) = self.loss_and_metrics(
+                params, net_state, x, y, False, None
+            )
+            return (
+                lax.pmean(loss, DATA_AXIS),
+                lax.pmean(err, DATA_AXIS),
+                lax.pmean(err5, DATA_AXIS),
+            )
+
+        mapped = jax.shard_map(
+            shard_eval,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        self.val_fn = jax.jit(mapped)
+        return self.val_fn
+
+    # ------------------------------------------------------------------
+    # contract: train_iter / val_iter
+    # ------------------------------------------------------------------
+    def reset_train_iter(self, epoch: int) -> None:
+        self.data.shuffle(epoch)
+        self._train_it = prefetch_to_mesh(
+            self.data.train_batches(), self.mesh, depth=int(self.config.prefetch_depth)
+        )
+
+    def reset_val_iter(self) -> None:
+        self._val_it = prefetch_to_mesh(self.data.val_batches(), self.mesh, depth=1)
+
+    def train_iter(self, count: int, recorder) -> Tuple[float, float]:
+        if self.train_fn is None:
+            self.compile_train()
+        if self._train_it is None:
+            self.reset_train_iter(self.current_epoch)
+        recorder.start("wait")
+        x, y = next(self._train_it)
+        recorder.end("wait")
+        recorder.start("calc")
+        self.rng, step_key = jax.random.split(self.rng)
+        out = self.train_fn(
+            self.params, self.net_state, self.opt_state, x, y, step_key
+        )
+        self.params, self.net_state, self.opt_state = out[0], out[1], out[2]
+        # pulling the scalars fences the step (honest calc timing; the
+        # comm is fused in-graph so calc includes exchange — by design)
+        loss, err = float(out[3]), float(out[4])
+        recorder.end("calc")
+        recorder.train_error(count, loss, err)
+        return loss, err
+
+    def val_iter(self, count: int, recorder) -> Tuple[float, float, float]:
+        if self.val_fn is None:
+            self.compile_val()
+        x, y = next(self._val_it)
+        loss, err, err5 = self.val_fn(self.params, self.net_state, x, y)
+        return float(loss), float(err), float(err5)
+
+    def run_validation(self, count: int, recorder) -> Tuple[float, float, float]:
+        self.reset_val_iter()
+        tot = jnp.zeros((3,))
+        n = 0
+        for _ in range(self.data.n_batch_val):
+            loss, err, err5 = self.val_iter(count, recorder)
+            tot = tot + jnp.array([loss, err, err5])
+            n += 1
+        loss, err, err5 = (float(v) / n for v in tot)
+        recorder.val_error(count, loss, err, err5)
+        recorder.print_val_info(count)
+        return loss, err, err5
+
+    # ------------------------------------------------------------------
+    # contract: hyperparameter scheduling
+    # ------------------------------------------------------------------
+    def adjust_hyperp(self, epoch: int) -> None:
+        """Per-epoch lr schedule (reference: shared-var lr set)."""
+        self.current_epoch = epoch
+        lr = self.lr_schedule(epoch) * self._lr_scale
+        self.opt_state = optim_lib.set_lr(self.opt_state, lr)
+
+    def scale_lr(self, factor: float) -> None:
+        """Linear-scaling for N workers (reference: `scale_lr`)."""
+        self._lr_scale = float(factor)
+        self.opt_state = optim_lib.set_lr(
+            self.opt_state, self.lr_schedule(self.current_epoch) * self._lr_scale
+        )
+
+    # ------------------------------------------------------------------
+    # checkpoint + cleanup
+    # ------------------------------------------------------------------
+    def save_model(self, path: str) -> str:
+        from theanompi_tpu.utils import checkpoint
+
+        return checkpoint.save(
+            path,
+            {
+                "params": self.params,
+                "net_state": self.net_state,
+                "opt_state": self.opt_state,
+                "epoch": self.current_epoch,
+                "rng": self.rng,
+            },
+        )
+
+    def load_model(self, path: str) -> None:
+        from theanompi_tpu.utils import checkpoint
+
+        blob = checkpoint.restore(path)
+        self.params = replicate(self.mesh, blob["params"])
+        self.net_state = replicate(self.mesh, blob["net_state"])
+        self.opt_state = replicate(self.mesh, blob["opt_state"])
+        self.current_epoch = int(blob["epoch"])
+        self.rng = blob["rng"]
+
+    def cleanup(self) -> None:
+        self._train_it = None
+        self._val_it = None

@@ -1,0 +1,66 @@
+"""CIFAR-10 CNN — the smoke-test model.
+
+Reference analog: ``Cifar10_model`` in ``theanompi/models/cifar10.py``
+(SURVEY.md §3.5): a small conv net used to validate every training rule
+cheaply before the ImageNet models run.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from theanompi_tpu.data.providers import Cifar10Data
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import optim
+
+
+class Cifar10_model(TpuModel):
+    default_config = dict(
+        batch_size=128,
+        n_epochs=30,
+        lr=0.01,
+        momentum=0.9,
+        weight_decay=1e-4,
+        dropout_rate=0.5,
+        lr_boundaries=(20, 25),
+        data_dir=None,
+        n_synth_train=8192,
+        n_synth_val=1024,
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = Cifar10Data(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+        net = L.Sequential(
+            [
+                L.Conv2d(64, 5, padding="SAME", compute_dtype=dtype),
+                L.Relu(),
+                L.MaxPool(2),
+                L.Conv2d(128, 5, padding="SAME", compute_dtype=dtype),
+                L.Relu(),
+                L.MaxPool(2),
+                L.Conv2d(256, 3, padding="SAME", compute_dtype=dtype),
+                L.Relu(),
+                L.MaxPool(2),
+                L.Flatten(),
+                L.Dense(256, compute_dtype=dtype),
+                L.Relu(),
+                L.Dropout(float(cfg.dropout_rate)),
+                L.Dense(10, compute_dtype=dtype),
+            ]
+        )
+        self.lr_schedule = optim.step_decay(
+            float(cfg.lr), list(cfg.lr_boundaries), 0.1
+        )
+        return net, Cifar10Data.shape

@@ -1,0 +1,1 @@
+from theanompi_tpu.ops import layers, losses, optim  # noqa: F401

@@ -1,0 +1,507 @@
+"""Functional layer library.
+
+Re-creation of the reference's layer lib (upstream
+``theanompi/models/layers2.py``: ``Weight``, ``Conv``, ``Pool``, ``LRN``,
+``FC``, ``Dropout``, ``Softmax`` classes wrapping Theano ops; SURVEY.md
+§3.5) — redesigned for JAX:
+
+- Layers are **stateless descriptor objects** (hyperparameters only).
+  Trainable variables live in a separate ``params`` pytree, non-trainable
+  state (BatchNorm running stats) in a ``state`` pytree, so optimizers and
+  exchangers operate on pure pytrees — the TPU analog of the reference's
+  list of Theano shared variables (``model.params``).
+- Contract: ``init(key, in_shape) -> (params, state, out_shape)`` and
+  ``apply(params, state, x, train=False, rng=None) -> (y, new_state)``.
+  ``in_shape``/``out_shape`` exclude the batch dimension.
+- Layout is NHWC (TPU-native); convolutions accumulate in fp32 via
+  ``preferred_element_type`` so bf16 compute is safe on the MXU.
+- There is no ``Weight`` save/load here: checkpointing serializes whole
+  pytrees (``theanompi_tpu.utils.checkpoint``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any
+State = Any
+Shape = Tuple[int, ...]
+
+
+# ---------------------------------------------------------------------------
+# initializers (the reference's `Weight` init modes)
+# ---------------------------------------------------------------------------
+
+def he_normal(key, shape, fan_in, dtype=jnp.float32):
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def normal_init(std):
+    def f(key, shape, fan_in, dtype=jnp.float32):
+        return jax.random.normal(key, shape, dtype) * std
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# base
+# ---------------------------------------------------------------------------
+
+class Layer:
+    """Descriptor base. Subclasses override init/apply."""
+
+    def init(self, key, in_shape: Shape):
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, train: bool = False, rng=None):
+        return x, state
+
+    def __repr__(self):
+        fields = ", ".join(
+            f"{k}={v!r}" for k, v in vars(self).items() if not k.startswith("_")
+        )
+        return f"{type(self).__name__}({fields})"
+
+
+class Conv2d(Layer):
+    """2-D convolution, NHWC / HWIO, fp32 MXU accumulation.
+
+    Reference analog: ``Conv`` in layers2.py (cuDNN NCHW). NHWC is the
+    TPU-preferred layout; ``compute_dtype=bfloat16`` casts inputs/weights
+    for the MXU while keeping master params fp32.
+    """
+
+    def __init__(
+        self,
+        filters: int,
+        kernel: Tuple[int, int] | int,
+        stride: Tuple[int, int] | int = 1,
+        padding: str | Sequence[Tuple[int, int]] = "SAME",
+        use_bias: bool = True,
+        w_init: Optional[Callable] = None,
+        compute_dtype: Optional[jnp.dtype] = None,
+    ):
+        self.filters = filters
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+        self.use_bias = use_bias
+        self.w_init = w_init or he_normal
+        self.compute_dtype = compute_dtype
+
+    def init(self, key, in_shape):
+        h, w, cin = in_shape
+        kh, kw = self.kernel
+        fan_in = kh * kw * cin
+        wkey, _ = jax.random.split(key)
+        params = {"w": self.w_init(wkey, (kh, kw, cin, self.filters), fan_in)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), jnp.float32)
+        out_h, out_w = _conv_out_hw((h, w), self.kernel, self.stride, self.padding)
+        return params, {}, (out_h, out_w, self.filters)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        w = params["w"]
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            w = w.astype(self.compute_dtype)
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["b"]
+        return y, state
+
+
+class Dense(Layer):
+    """Fully-connected layer (reference ``FC``)."""
+
+    def __init__(
+        self,
+        features: int,
+        use_bias: bool = True,
+        w_init: Optional[Callable] = None,
+        compute_dtype: Optional[jnp.dtype] = None,
+    ):
+        self.features = features
+        self.use_bias = use_bias
+        self.w_init = w_init
+        self.compute_dtype = compute_dtype
+
+    def init(self, key, in_shape):
+        (d,) = in_shape
+        init = self.w_init or (
+            lambda k, s, fi, dtype=jnp.float32: xavier_uniform(
+                k, s, fi, self.features, dtype
+            )
+        )
+        params = {"w": init(key, (d, self.features), d)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.features,), jnp.float32)
+        return params, {}, (self.features,)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        w = params["w"]
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            w = w.astype(self.compute_dtype)
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        if self.use_bias:
+            y = y + params["b"]
+        return y, state
+
+
+class MaxPool(Layer):
+    def __init__(self, window=2, stride=None, padding="VALID"):
+        self.window = (window, window) if isinstance(window, int) else tuple(window)
+        stride = stride if stride is not None else self.window
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        oh, ow = _conv_out_hw((h, w), self.window, self.stride, self.padding)
+        return {}, {}, (oh, ow, c)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        y = lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            (1, *self.window, 1),
+            (1, *self.stride, 1),
+            self.padding,
+        )
+        return y, state
+
+
+class AvgPool(Layer):
+    def __init__(self, window=2, stride=None, padding="VALID"):
+        self.window = (window, window) if isinstance(window, int) else tuple(window)
+        stride = stride if stride is not None else self.window
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        oh, ow = _conv_out_hw((h, w), self.window, self.stride, self.padding)
+        return {}, {}, (oh, ow, c)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        ones = jnp.ones_like(x)
+        s = lax.reduce_window(
+            x, 0.0, lax.add, (1, *self.window, 1), (1, *self.stride, 1), self.padding
+        )
+        n = lax.reduce_window(
+            ones, 0.0, lax.add, (1, *self.window, 1), (1, *self.stride, 1), self.padding
+        )
+        return s / n, state
+
+
+class GlobalAvgPool(Layer):
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        return {}, {}, (c,)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+class LRN(Layer):
+    """Local response normalization (AlexNet/GoogLeNet-era; reference
+    ``LRN`` layer). Cross-channel normalization in NHWC."""
+
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0):
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, params, state, x, train=False, rng=None):
+        sq = jnp.square(x)
+        # sum over a window of `size` channels centered at each channel
+        pad = self.size // 2
+        sq = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (pad, self.size - 1 - pad)))
+        win = lax.reduce_window(
+            sq, 0.0, lax.add, (1, 1, 1, self.size), (1, 1, 1, 1), "VALID"
+        )
+        denom = jnp.power(self.k + self.alpha * win, self.beta)
+        return x / denom, state
+
+
+class BatchNorm(Layer):
+    """Batch normalization with running statistics in ``state``.
+
+    Per-shard statistics by default (matches per-GPU BN in reference-era
+    data parallelism). ``axis_name`` enables cross-replica sync-BN via
+    ``lax.pmean`` when applied inside ``shard_map``.
+    """
+
+    def __init__(self, momentum=0.9, eps=1e-5, axis_name: Optional[str] = None):
+        self.momentum = momentum
+        self.eps = eps
+        self.axis_name = axis_name
+
+    def init(self, key, in_shape):
+        c = in_shape[-1]
+        params = {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+        state = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+        return params, state, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=reduce_axes)
+            var = jnp.mean(jnp.square(x), axis=reduce_axes) - jnp.square(mean)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                var = lax.pmean(var, self.axis_name)
+            m = self.momentum
+            new_state = {
+                "mean": m * state["mean"] + (1 - m) * mean,
+                "var": m * state["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv * params["scale"] + params["bias"]
+        return y, new_state
+
+
+class Dropout(Layer):
+    """Inverted dropout (reference ``Dropout``). Needs an rng in train."""
+
+    def __init__(self, rate=0.5):
+        self.rate = rate
+
+    def apply(self, params, state, x, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout in train mode requires an rng key")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0), state
+
+
+class Activation(Layer):
+    def __init__(self, fn: Callable = jax.nn.relu):
+        self.fn = fn
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return self.fn(x), state
+
+
+def Relu():
+    return Activation(jax.nn.relu)
+
+
+class Flatten(Layer):
+    def init(self, key, in_shape):
+        return {}, {}, (int(jnp.prod(jnp.array(in_shape))),)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+# ---------------------------------------------------------------------------
+# combinators
+# ---------------------------------------------------------------------------
+
+class Sequential(Layer):
+    """Chain of layers; threads params/state lists and splits dropout rngs."""
+
+    def __init__(self, layers: Sequence[Layer]):
+        self.layers = list(layers)
+
+    def init(self, key, in_shape):
+        params, state = [], []
+        shape = in_shape
+        for layer in self.layers:
+            key, sub = jax.random.split(key)
+            p, s, shape = layer.init(sub, shape)
+            params.append(p)
+            state.append(s)
+        return params, state, shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        new_state = []
+        for i, layer in enumerate(self.layers):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            x, s = layer.apply(params[i], state[i], x, train=train, rng=sub)
+            new_state.append(s)
+        return x, new_state
+
+
+class Parallel(Layer):
+    """Apply branches to the same input, concat outputs on channels.
+
+    The inception-block combinator (GoogLeNet's reference implementation
+    builds these by hand in Theano; SURVEY.md §3.5).
+    """
+
+    def __init__(self, branches: Sequence[Layer]):
+        self.branches = list(branches)
+
+    def init(self, key, in_shape):
+        params, state, out_shapes = [], [], []
+        for br in self.branches:
+            key, sub = jax.random.split(key)
+            p, s, o = br.init(sub, in_shape)
+            params.append(p)
+            state.append(s)
+            out_shapes.append(o)
+        base = out_shapes[0][:-1]
+        for o in out_shapes:
+            if o[:-1] != base:
+                raise ValueError(f"branch spatial shapes differ: {out_shapes}")
+        c = sum(o[-1] for o in out_shapes)
+        return params, state, (*base, c)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        ys, new_state = [], []
+        for i, br in enumerate(self.branches):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            y, s = br.apply(params[i], state[i], x, train=train, rng=sub)
+            ys.append(y)
+            new_state.append(s)
+        return jnp.concatenate(ys, axis=-1), new_state
+
+
+class Residual(Layer):
+    """Residual connection: ``y = body(x) + shortcut(x)``.
+
+    The ResNet/Wide-ResNet combinator (the reference's Lasagne model zoo
+    builds these with Lasagne ElemwiseSumLayer; SURVEY.md §3.5).
+    ``shortcut=None`` is identity; pass a projection (1×1 conv, possibly
+    strided) when shapes change.
+    """
+
+    def __init__(self, body: Layer, shortcut: Optional[Layer] = None):
+        self.body = body
+        self.shortcut = shortcut
+
+    def init(self, key, in_shape):
+        k1, k2 = jax.random.split(key)
+        bp, bs, out_shape = self.body.init(k1, in_shape)
+        if self.shortcut is not None:
+            sp, ss, s_out = self.shortcut.init(k2, in_shape)
+            if s_out != out_shape:
+                raise ValueError(
+                    f"shortcut out {s_out} != body out {out_shape}"
+                )
+        else:
+            if out_shape != in_shape:
+                raise ValueError(
+                    f"identity shortcut needs body out {out_shape} == in {in_shape}"
+                )
+            sp, ss = {}, {}
+        return {"body": bp, "shortcut": sp}, {"body": bs, "shortcut": ss}, out_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        r1 = r2 = None
+        if rng is not None:
+            rng, r1 = jax.random.split(rng)
+            rng, r2 = jax.random.split(rng)
+        y, new_bs = self.body.apply(
+            params["body"], state["body"], x, train=train, rng=r1
+        )
+        if self.shortcut is not None:
+            sc, new_ss = self.shortcut.apply(
+                params["shortcut"], state["shortcut"], x, train=train, rng=r2
+            )
+        else:
+            sc, new_ss = x, state["shortcut"]
+        return y + sc, {"body": new_bs, "shortcut": new_ss}
+
+
+class ConvTranspose2d(Layer):
+    """Transposed convolution (the LS-GAN generator's upsampling op)."""
+
+    def __init__(
+        self,
+        filters: int,
+        kernel: Tuple[int, int] | int,
+        stride: Tuple[int, int] | int = 2,
+        padding: str = "SAME",
+        use_bias: bool = True,
+        w_init: Optional[Callable] = None,
+        compute_dtype: Optional[jnp.dtype] = None,
+    ):
+        self.filters = filters
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+        self.use_bias = use_bias
+        self.w_init = w_init or he_normal
+        self.compute_dtype = compute_dtype
+
+    def init(self, key, in_shape):
+        h, w, cin = in_shape
+        kh, kw = self.kernel
+        params = {"w": self.w_init(key, (kh, kw, cin, self.filters), kh * kw * cin)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), jnp.float32)
+        if self.padding.upper() == "SAME":
+            oh, ow = h * self.stride[0], w * self.stride[1]
+        else:
+            oh = (h - 1) * self.stride[0] + kh
+            ow = (w - 1) * self.stride[1] + kw
+        return params, {}, (oh, ow, self.filters)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        w = params["w"]
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            w = w.astype(self.compute_dtype)
+        y = lax.conv_transpose(
+            x,
+            w,
+            strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["b"]
+        return y, state
+
+
+# ---------------------------------------------------------------------------
+
+def _conv_out_hw(hw, window, stride, padding):
+    h, w = hw
+    if isinstance(padding, str):
+        if padding.upper() == "SAME":
+            return math.ceil(h / stride[0]), math.ceil(w / stride[1])
+        pads = ((0, 0), (0, 0))
+    else:
+        pads = padding
+    oh = (h + pads[0][0] + pads[0][1] - window[0]) // stride[0] + 1
+    ow = (w + pads[1][0] + pads[1][1] - window[1]) // stride[1] + 1
+    return oh, ow
+
+
+def count_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))

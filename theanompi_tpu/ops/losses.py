@@ -1,0 +1,31 @@
+"""Losses and classification metrics.
+
+Reference analog: the ``Softmax`` layer's negative-log-likelihood plus the
+error / top-5-error outputs each model's Theano graph computed (upstream
+``theanompi/models/layers2.py`` + per-model cost definitions; SURVEY.md
+§3.5)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean NLL over the batch. ``labels`` are int class ids."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def classification_error(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Top-1 error rate in [0, 1]."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.mean((pred != labels).astype(jnp.float32))
+
+
+def topk_error(logits: jnp.ndarray, labels: jnp.ndarray, k: int = 5) -> jnp.ndarray:
+    """Top-k error rate (the reference reports top-5 for ImageNet)."""
+    _, topk = jax.lax.top_k(logits, k)
+    hit = jnp.any(topk == labels[:, None], axis=-1)
+    return jnp.mean((~hit).astype(jnp.float32))

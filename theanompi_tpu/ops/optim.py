@@ -1,0 +1,138 @@
+"""Native SGD-family optimizers and learning-rate schedules.
+
+Re-creation of the reference's update-rule builders (upstream
+``theanompi/lib/opt.py``: vanilla / momentum / Nesterov SGD with weight
+decay, building Theano update pairs over shared variables; SURVEY.md
+§3.5) — redesigned as pure ``init``/``update`` functions over pytrees.
+
+The learning rate is a **leaf of the optimizer state** (a jnp scalar), not
+a Python constant baked into the jit: the reference kept lr in a Theano
+shared variable so ``adjust_hyperp(epoch)`` could change it without
+recompiling, and storing it in opt state gives the same property under
+``jax.jit`` (it is an array argument, not a static).  Host code mutates it
+via ``set_lr``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+Grads = Any
+OptState = Any
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Params], OptState]
+    update: Callable[[Params, Grads, OptState], Tuple[Params, OptState]]
+
+
+def sgd(
+    lr: float,
+    momentum: float = 0.0,
+    nesterov: bool = False,
+    weight_decay: float = 0.0,
+) -> Optimizer:
+    """SGD with optional (Nesterov) momentum and decoupled-from-loss L2.
+
+    Weight decay is applied as ``g += wd * p`` (classic L2, as the
+    reference's update builders did), not AdamW-style decoupled decay.
+    """
+
+    def init(params: Params) -> OptState:
+        return {
+            "velocity": jax.tree.map(jnp.zeros_like, params),
+            "lr": jnp.asarray(lr, jnp.float32),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(params: Params, grads: Grads, state: OptState):
+        lr_t = state["lr"]
+
+        def upd(p, g, v):
+            g = g.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p
+            if momentum:
+                v_new = momentum * v - lr_t * g
+                if nesterov:
+                    step = momentum * v_new - lr_t * g
+                else:
+                    step = v_new
+            else:
+                v_new = v
+                step = -lr_t * g
+            return p + step, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_v = treedef.flatten_up_to(state["velocity"])
+        out = [upd(p, g, v) for p, g, v in zip(flat_p, flat_g, flat_v)]
+        new_params = treedef.unflatten([o[0] for o in out])
+        new_vel = treedef.unflatten([o[1] for o in out])
+        return new_params, {
+            "velocity": new_vel,
+            "lr": lr_t,
+            "step": state["step"] + 1,
+        }
+
+    return Optimizer(init, update)
+
+
+def set_lr(state: OptState, lr: float) -> OptState:
+    """Host-side lr mutation between steps (reference: shared-var set)."""
+    new = dict(state)
+    new["lr"] = jnp.asarray(lr, jnp.float32)
+    return new
+
+
+def get_lr(state: OptState) -> float:
+    return float(state["lr"])
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules — host-side functions epoch -> lr, driven by
+# model.adjust_hyperp(epoch) exactly like the reference's per-model
+# schedules (e.g. AlexNet: /10 at fixed epochs).
+# ---------------------------------------------------------------------------
+
+def step_decay(base_lr: float, boundaries, factor: float = 0.1):
+    """lr = base * factor^(number of boundaries passed)."""
+
+    boundaries = sorted(boundaries)
+
+    def schedule(epoch: int) -> float:
+        n = sum(1 for b in boundaries if epoch >= b)
+        return base_lr * (factor**n)
+
+    return schedule
+
+
+def exp_decay(base_lr: float, rate: float):
+    def schedule(epoch: int) -> float:
+        return base_lr * (rate**epoch)
+
+    return schedule
+
+
+def constant(base_lr: float):
+    def schedule(epoch: int) -> float:
+        return base_lr
+
+    return schedule
+
+
+def linear_warmup_step(base_lr: float, warmup_epochs: int, boundaries, factor=0.1):
+    """Warmup then step decay — used when scaling batch size with workers
+    (the reference's `scale_lr` heritage: lr scaled by N workers)."""
+    step = step_decay(base_lr, boundaries, factor)
+
+    def schedule(epoch: int) -> float:
+        if warmup_epochs and epoch < warmup_epochs:
+            return base_lr * float(epoch + 1) / warmup_epochs
+        return step(epoch)
+
+    return schedule

@@ -1,0 +1,1 @@
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger  # noqa: F401

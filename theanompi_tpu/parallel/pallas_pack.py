@@ -1,0 +1,62 @@
+"""Pallas TPU kernels for wire-format pack/unpack.
+
+Native-tier parity item: the reference ships in-repo CUDA C kernels
+(pycuda-JIT'd) that cast fp32 gradient blocks to fp16 before the MPI
+alltoall and back after (upstream ``theanompi/lib/exchanger_strategy.py``,
+``Exch_asa16``; SURVEY.md §3.3 native list #1).  Here the same role is
+played by explicit Pallas kernels: fp32 → bf16 before ``lax.psum`` and
+bf16 → fp32 after.
+
+XLA would fuse a plain ``astype`` just as well — these kernels exist to
+(a) honor the reference's native-kernel component with a real TPU-kernel
+implementation and (b) serve as the seam where smarter wire formats
+(int8 + per-block scale, stochastic rounding) land without touching the
+exchanger. On CPU (tests) the kernels run in interpreter mode.
+
+Tiling: arrays are flattened and padded to (8, 1024) fp32 tiles — sublane
+multiple 8, lane multiple 128 — per the TPU tiling rules in
+/opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 1024  # 8 * 128: one fp32 tile row
+_SUB = 8
+
+
+def _cast_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...].astype(o_ref.dtype)
+
+
+def _cast_via_pallas(x: jnp.ndarray, out_dtype) -> jnp.ndarray:
+    n = x.size
+    flat = x.reshape(-1)
+    block = _SUB * _LANES
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    x2 = flat.reshape(-1, _LANES)
+    grid = x2.shape[0] // _SUB
+    y2 = pl.pallas_call(
+        _cast_kernel,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, out_dtype),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_SUB, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_SUB, _LANES), lambda i: (i, 0)),
+        interpret=(jax.default_backend() == "cpu"),
+    )(x2)
+    return y2.reshape(-1)[:n].reshape(x.shape)
+
+
+def pack_bf16(x: jnp.ndarray, wire_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """fp32 → bf16 wire format (reference: fp32→fp16 CUDA pack kernel)."""
+    return _cast_via_pallas(x, wire_dtype)
+
+
+def unpack_fp32(x: jnp.ndarray, out_dtype=jnp.float32) -> jnp.ndarray:
+    """bf16 wire → fp32 (reference: fp16→fp32 CUDA unpack kernel)."""
+    return _cast_via_pallas(x, jnp.float32).astype(out_dtype)

@@ -1,0 +1,106 @@
+"""Training rules — the user-facing launcher API.
+
+Reference analog: ``theanompi/__init__.py`` + ``sync_rule.py`` /
+``async_rule.py`` (SURVEY.md §3.1): ``BSP()/EASGD()/GOSGD()`` with
+``.init(devices, modelfile, modelclass)`` spawning one MPI process per
+GPU via mpirun, and ``.wait()`` joining them.
+
+TPU-native redesign: no process spawning.  ``init`` builds the device
+mesh (joining the multi-host group when launched on a pod — the analog of
+the mpirun rank setup), imports the model class by string, and constructs
+the worker; ``wait`` runs the training loop to completion on the calling
+thread.  The reference's API shape is preserved so user scripts port
+verbatim.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Optional, Sequence
+
+import jax
+
+from theanompi_tpu.runtime.mesh import init_distributed, make_mesh
+
+
+def _resolve_devices(devices) -> Optional[Sequence[jax.Device]]:
+    """Accept None (all), an int count, or an explicit device list.
+
+    The reference took strings like ``['cuda0', 'cuda1']``; the TPU analog
+    of "which chips" is just "how many" — placement is the mesh's job.
+    """
+    if devices is None:
+        return None
+    if isinstance(devices, int):
+        all_devs = jax.devices()
+        if devices > len(all_devs):
+            raise ValueError(
+                f"requested {devices} devices, only {len(all_devs)} present"
+            )
+        return all_devs[:devices]
+    devs = list(devices)
+    if devs and isinstance(devs[0], str):
+        # 'cuda0'-style strings: keep the count, ignore the names
+        return jax.devices()[: len(devs)]
+    return devs
+
+
+class Rule:
+    """Common init/wait machinery; subclasses pick the worker."""
+
+    def __init__(self):
+        self.model = None
+        self.worker = None
+
+    def _make_worker(self, model, **worker_kwargs):
+        raise NotImplementedError
+
+    def init(
+        self,
+        devices=None,
+        modelfile: str = "theanompi_tpu.models.cifar10",
+        modelclass: str = "Cifar10_model",
+        model_config: Optional[dict] = None,
+        **worker_kwargs: Any,
+    ) -> "Rule":
+        init_distributed()
+        mesh = make_mesh(devices=_resolve_devices(devices))
+        module = importlib.import_module(modelfile)
+        cls = getattr(module, modelclass)
+        self.model = cls(config=model_config, mesh=mesh)
+        self.worker = self._make_worker(self.model, **worker_kwargs)
+        return self
+
+    def wait(self):
+        """Run training to completion (reference: block on worker procs)."""
+        if self.worker is None:
+            raise RuntimeError("call rule.init(...) before rule.wait()")
+        self.worker.run()
+        return self.model
+
+
+class BSP(Rule):
+    """Bulk-synchronous parallel (reference ``sync_rule.BSP``)."""
+
+    def _make_worker(self, model, **kw):
+        from theanompi_tpu.parallel.workers import BSP_Worker
+
+        return BSP_Worker(model, **kw)
+
+
+class EASGD(Rule):
+    """Elastic-averaging SGD (reference ``async_rule.EASGD``)."""
+
+    def _make_worker(self, model, **kw):
+        from theanompi_tpu.parallel.async_workers import EASGD_Driver
+
+        return EASGD_Driver(model, **kw)
+
+
+class GOSGD(Rule):
+    """Gossip SGD (reference ``async_rule.GOSGD``)."""
+
+    def _make_worker(self, model, **kw):
+        from theanompi_tpu.parallel.async_workers import GOSGD_Driver
+
+        return GOSGD_Driver(model, **kw)

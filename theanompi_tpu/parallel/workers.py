@@ -1,0 +1,79 @@
+"""Workers — the per-rule training loops.
+
+Reference analog: ``bsp_worker.py`` / ``easgd_worker.py`` /
+``easgd_server.py`` / ``gosgd_worker.py`` (SURVEY.md §3.2), each an MPI
+``__main__`` driving epoch/iteration loops on one GPU.
+
+TPU-native redesign: a worker is an **object driving the whole mesh** from
+the single controller, not a per-device process.  The BSP loop is the
+reference's (SURVEY.md §4.2) minus the separate exchange phase — exchange
+is fused into the jitted step — so the loop body is: next batch →
+train_iter → periodic print → epoch-end validation / lr adjust /
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from theanompi_tpu.runtime.recorder import Recorder
+
+
+class BSP_Worker:
+    """Bulk-synchronous data-parallel training loop (reference
+    ``BSP_Worker``; SURVEY.md §4.2)."""
+
+    def __init__(
+        self,
+        model,
+        recorder: Optional[Recorder] = None,
+        val_freq: int = 1,  # epochs between validations (0 = never)
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_freq: int = 1,  # epochs between snapshots (0 = never)
+        resume: bool = False,
+    ):
+        self.model = model
+        self.recorder = recorder or Recorder(
+            print_freq=int(model.config.get("print_freq", 40)),
+            save_dir=checkpoint_dir,
+        )
+        self.val_freq = val_freq
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_freq = checkpoint_freq
+        self.resume = resume
+
+    def run(self) -> None:
+        model, rec = self.model, self.recorder
+        if self.resume and self.checkpoint_dir:
+            from theanompi_tpu.utils import checkpoint as ckpt
+
+            path = ckpt.latest(self.checkpoint_dir)
+            if path:
+                model.load_model(path)
+                print(f"resumed from {path} at epoch {model.current_epoch}")
+        model.compile_train()
+        model.compile_val()
+        count = model.current_epoch * model.data.n_batch_train
+        for epoch in range(model.current_epoch, model.n_epochs):
+            model.adjust_hyperp(epoch)
+            rec.start_epoch()
+            model.reset_train_iter(epoch)
+            for _ in range(model.data.n_batch_train):
+                count += 1
+                model.train_iter(count, rec)
+                rec.print_train_info(count)
+            if self.val_freq and (epoch + 1) % self.val_freq == 0:
+                model.run_validation(count, rec)
+            rec.end_epoch(count, epoch)
+            model.current_epoch = epoch + 1
+            if self.checkpoint_dir and self.checkpoint_freq and (
+                (epoch + 1) % self.checkpoint_freq == 0
+            ):
+                path = os.path.join(
+                    self.checkpoint_dir, f"ckpt_{epoch + 1:04d}.npz"
+                )
+                model.save_model(path)
+        if self.checkpoint_dir:
+            rec.save()
+        model.cleanup()

@@ -38,10 +38,19 @@ _MULTIHOST_ENV_MARKERS = (
     "JAX_COORDINATOR_ADDRESS",
     "MEGASCALE_COORDINATOR_ADDRESS",
     "CLOUD_TPU_TASK_ID",
-    "TPU_WORKER_HOSTNAMES",
 )
 
 _distributed_initialized = False
+_distributed_gave_up = False
+
+
+def _env_says_multihost() -> bool:
+    if any(os.environ.get(k) for k in _MULTIHOST_ENV_MARKERS):
+        return True
+    # TPU_WORKER_HOSTNAMES is also set on single-host setups (one entry);
+    # only a multi-entry list means a pod of hosts.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hostnames.split(",") if h.strip()]) > 1
 
 
 def init_distributed(
@@ -64,19 +73,40 @@ def init_distributed(
 
     Returns True if the process group is (now) initialized. Idempotent.
     """
-    global _distributed_initialized
+    global _distributed_initialized, _distributed_gave_up
     if _distributed_initialized:
         return True
     explicit = any(
         a is not None for a in (coordinator_address, num_processes, process_id)
     )
-    if not explicit and not any(os.environ.get(k) for k in _MULTIHOST_ENV_MARKERS):
-        return False  # single-host: nothing to join
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    if not explicit:
+        if _distributed_gave_up:
+            return False
+        if not _env_says_multihost():
+            return False  # single-host: nothing to join
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except ValueError as e:
+        if explicit:
+            raise  # a mistyped explicit config must not silently degrade
+        # env looked multi-host but auto-detection found no coordinator.
+        # Warn loudly: if this really is a pod, proceeding means N
+        # independent single-host runs with unsynced gradients.
+        import warnings
+
+        warnings.warn(
+            "environment looks multi-host but jax.distributed auto-detection "
+            f"failed ({e}); proceeding SINGLE-HOST. If this is a pod, pass "
+            "coordinator_address/num_processes/process_id explicitly.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        _distributed_gave_up = True  # don't re-run costly auto-detect
+        return False
     _distributed_initialized = True
     return True
 

@@ -1,0 +1,1 @@
+from theanompi_tpu.utils import checkpoint  # noqa: F401
