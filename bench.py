@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric (BASELINE.md): ImageNet images/sec/chip on the flagship AlexNet
+ImageNet-128px BSP configuration. Protocol per BASELINE.md: warmup steps
+excluded, compile excluded, `block_until_ready` fenced, per-chip img/s =
+global_throughput / chips.
+
+``vs_baseline`` is 1.0: the reference's published numbers are not
+recoverable in this environment (BASELINE.json `published: {}` — see
+BASELINE.md), so there is no external denominator; cross-round progress
+is tracked by the driver's BENCH_r{N}.json history.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from theanompi_tpu.models.alex_net import AlexNet
+    from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
+
+    n_chips = jax.device_count()
+    mesh = make_mesh()
+    per_chip_bs = 128
+    model = AlexNet(
+        config=dict(
+            batch_size=per_chip_bs,
+            compute_dtype="bfloat16",
+            lr=1e-3,  # throughput bench: avoid divergence on synthetic data
+            n_synth_batches=8,
+            print_freq=10_000,
+        ),
+        mesh=mesh,
+    )
+    train_fn = model.compile_train()
+
+    # device-resident batches, cycled: measure compute+exchange, not host
+    # IO (the reference hid loading behind compute, so steady-state step
+    # time is the honest comparison)
+    batches = [shard_batch(mesh, b) for b in model.data.train_batches()]
+
+    params, net_state, opt_state = model.params, model.net_state, model.opt_state
+    rng = jax.random.PRNGKey(0)
+
+    def step(p, s, o, i):
+        x, y = batches[i % len(batches)]
+        return train_fn(p, s, o, x, y, rng)
+
+    # warmup (compile + 3 steps)
+    for i in range(3):
+        params, net_state, opt_state, loss, err = step(params, net_state, opt_state, i)
+    jax.block_until_ready(loss)
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        params, net_state, opt_state, loss, err = step(params, net_state, opt_state, i)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    assert jnp.isfinite(loss), f"bench diverged: loss={loss}"
+
+    global_bs = per_chip_bs * n_chips
+    imgs_per_sec = n_steps * global_bs / dt
+    per_chip = imgs_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet128_bsp_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": 1.0,
+                "detail": {
+                    "chips": n_chips,
+                    "per_chip_batch": per_chip_bs,
+                    "steps": n_steps,
+                    "total_s": round(dt, 3),
+                    "loss_final": float(loss),
+                    "compute_dtype": "bfloat16",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

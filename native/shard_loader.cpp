@@ -1,0 +1,155 @@
+// Native shard loader — C++ runtime component of the data layer.
+//
+// Reference analog: Theano-MPI's "parallel loading" subsystem (upstream
+// proc_load_mpi.py + hickle/HDF5 C stack; SURVEY.md §3.6): a separate
+// loader hiding disk→host time behind device compute. Here that role is
+// a C++ reader thread pool with a ring of pre-allocated buffers, bound
+// via ctypes (no pybind11 in this environment). NumPy loading in Python
+// threads already releases the GIL, but the C++ ring removes the Python
+// dispatch from the hot path entirely and is the seam where direct-IO /
+// decompression lands later.
+//
+// Shard file format ("raw" shards, written by theanompi_tpu.data.shards):
+//   [x: n*h*w*c float32][y: n int32]  — sizes fixed per dataset config.
+//
+// C ABI (ctypes):
+//   void* tnp_loader_open(const char* const* paths, int n_files,
+//                         long x_bytes, long y_bytes, int depth);
+//   int   tnp_loader_next(void* h, void* x_out, void* y_out);
+//         // 1 = batch copied, 0 = end of files, <0 = error
+//   const char* tnp_loader_error(void* h);
+//   void  tnp_loader_close(void* h);
+//   int   tnp_version();
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<char> data;  // x_bytes + y_bytes
+};
+
+struct Loader {
+  std::vector<std::string> paths;
+  size_t x_bytes = 0, y_bytes = 0;
+  int depth = 2;
+
+  std::vector<Slot> slots;
+  std::deque<int> free_q;   // slot indices available to the reader
+  std::deque<int> ready_q;  // slot indices filled, in file order
+  bool done = false;        // reader finished (EOF or error)
+  std::string error;
+
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  std::thread reader;
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+      free_q.clear();
+    }
+    cv_free.notify_all();
+    if (reader.joinable()) reader.join();
+  }
+};
+
+void reader_main(Loader* L) {
+  const size_t total = L->x_bytes + L->y_bytes;
+  for (size_t i = 0; i < L->paths.size(); ++i) {
+    int slot_idx;
+    {
+      std::unique_lock<std::mutex> lk(L->mu);
+      L->cv_free.wait(lk, [L] { return !L->free_q.empty() || L->done; });
+      if (L->done) return;
+      slot_idx = L->free_q.front();
+      L->free_q.pop_front();
+    }
+    Slot& slot = L->slots[slot_idx];
+    FILE* f = std::fopen(L->paths[i].c_str(), "rb");
+    bool ok = f != nullptr;
+    if (ok) {
+      ok = std::fread(slot.data.data(), 1, total, f) == total;
+      std::fclose(f);
+    }
+    {
+      std::lock_guard<std::mutex> lk(L->mu);
+      if (!ok) {
+        L->error = "failed to read shard: " + L->paths[i];
+        L->done = true;
+      } else {
+        L->ready_q.push_back(slot_idx);
+      }
+    }
+    L->cv_ready.notify_all();
+    if (!ok) return;
+  }
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->done = true;
+  }
+  L->cv_ready.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+int tnp_version() { return 1; }
+
+void* tnp_loader_open(const char* const* paths, int n_files, long x_bytes,
+                      long y_bytes, int depth) {
+  if (n_files < 0 || x_bytes < 0 || y_bytes < 0 || depth < 1) return nullptr;
+  Loader* L = new Loader();
+  L->paths.assign(paths, paths + n_files);
+  L->x_bytes = static_cast<size_t>(x_bytes);
+  L->y_bytes = static_cast<size_t>(y_bytes);
+  L->depth = depth;
+  L->slots.resize(depth);
+  for (int i = 0; i < depth; ++i) {
+    L->slots[i].data.resize(L->x_bytes + L->y_bytes);
+    L->free_q.push_back(i);
+  }
+  L->reader = std::thread(reader_main, L);
+  return L;
+}
+
+int tnp_loader_next(void* h, void* x_out, void* y_out) {
+  Loader* L = static_cast<Loader*>(h);
+  int slot_idx;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_ready.wait(lk, [L] { return !L->ready_q.empty() || L->done; });
+    if (!L->error.empty()) return -1;
+    if (L->ready_q.empty()) return 0;  // clean EOF
+    slot_idx = L->ready_q.front();
+    L->ready_q.pop_front();
+  }
+  Slot& slot = L->slots[slot_idx];
+  std::memcpy(x_out, slot.data.data(), L->x_bytes);
+  std::memcpy(y_out, slot.data.data() + L->x_bytes, L->y_bytes);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_q.push_back(slot_idx);
+  }
+  L->cv_free.notify_all();
+  return 1;
+}
+
+const char* tnp_loader_error(void* h) {
+  Loader* L = static_cast<Loader*>(h);
+  std::lock_guard<std::mutex> lk(L->mu);
+  return L->error.empty() ? "" : L->error.c_str();
+}
+
+void tnp_loader_close(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Inspect a saved training record.
+
+Reference analog: the ``show_record.py``-style plot script (SURVEY.md
+§3.7) that loaded the recorder's dump and plotted curves.  Reads the
+JSONL records written by ``Recorder.save`` and renders matplotlib PNGs
+when matplotlib is available, else an ASCII summary.
+
+Usage: python scripts/show_record.py <record.jsonl> [out.png]
+"""
+
+import json
+import sys
+
+
+def load(path):
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    train = [r for r in rows if r.get("kind") == "train"]
+    val = [r for r in rows if r.get("kind") == "val"]
+    return train, val
+
+
+def ascii_curve(xs, ys, label, width=60, height=10):
+    if not ys:
+        return f"(no {label} data)"
+    lo, hi = min(ys), max(ys)
+    span = (hi - lo) or 1.0
+    grid = [[" "] * width for _ in range(height)]
+    n = len(ys)
+    for i, y in enumerate(ys):
+        col = int(i / max(1, n - 1) * (width - 1))
+        row = int((1 - (y - lo) / span) * (height - 1))
+        grid[row][col] = "*"
+    lines = ["".join(r) for r in grid]
+    return (
+        f"{label}  max={hi:.4f} min={lo:.4f}\n" + "\n".join(lines)
+    )
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    path = sys.argv[1]
+    train, val = load(path)
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+        if train:
+            axes[0].plot([r["iter"] for r in train], [r["cost"] for r in train])
+            axes[0].set_title("train cost")
+            axes[1].plot([r["iter"] for r in train], [r["error"] for r in train])
+        if val:
+            axes[1].plot(
+                [r["iter"] for r in val], [r["error"] for r in val], "o-"
+            )
+        axes[1].set_title("error (train line, val dots)")
+        if train:
+            for phase in ("calc", "comm", "wait", "load"):
+                axes[2].plot(
+                    [r["iter"] for r in train],
+                    [r.get(phase, 0.0) for r in train],
+                    label=phase,
+                )
+            axes[2].legend()
+            axes[2].set_title("time per print-window (s)")
+        out = sys.argv[2] if len(sys.argv) > 2 else path.replace(".jsonl", ".png")
+        fig.tight_layout()
+        fig.savefig(out, dpi=120)
+        print(f"wrote {out}")
+    except ImportError:
+        print(ascii_curve(None, [r["cost"] for r in train], "train cost"))
+        if val:
+            print(ascii_curve(None, [r["error"] for r in val], "val error"))
+        for r in val[-3:]:
+            print(r)
+
+
+if __name__ == "__main__":
+    main()

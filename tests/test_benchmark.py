@@ -1,0 +1,42 @@
+"""Benchmark harness sanity on the fake-device mesh."""
+
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.runtime.mesh import make_mesh
+from theanompi_tpu.utils import benchmark as B
+
+
+CFG = dict(
+    batch_size=8,
+    n_synth_train=256,
+    n_synth_val=64,
+    dropout_rate=0.0,
+    print_freq=1000,
+)
+
+
+def test_measure_step_time_and_images_per_sec():
+    model = Cifar10_model(config=CFG, mesh=make_mesh())
+    t = B.measure_step_time(model, n_steps=3, warmup=1)
+    assert t > 0
+    ips = model.global_batch / t
+    assert ips > 0
+
+
+def test_comm_fraction_reports_fields():
+    out = B.comm_fraction(Cifar10_model, CFG, mesh=make_mesh(), n_steps=3)
+    assert set(out) == {
+        "step_with_exchange_s",
+        "step_without_exchange_s",
+        "comm_s",
+        "comm_fraction",
+    }
+    assert 0.0 <= out["comm_fraction"] < 1.0
+
+
+def test_scaling_efficiency_rows():
+    rows = B.scaling_efficiency(
+        Cifar10_model, CFG, device_counts=[1, 2], n_steps=2
+    )
+    assert [r["devices"] for r in rows] == [1, 2]
+    assert rows[0]["efficiency"] == 1.0
+    assert rows[1]["images_per_sec"] > 0

@@ -1,0 +1,94 @@
+"""Fault handling: restart loop, fault injection, and the chaos test for
+the checkpoint-resume path (SURVEY.md §6: reference had NONE of this)."""
+
+import pytest
+
+from theanompi_tpu.runtime.fault import FaultInjector, TrainingFault, run_with_restart
+
+
+def test_fault_injector_fires_once():
+    fi = FaultInjector([(0, 3)])
+    fi.maybe_fail(0, 1)
+    fi.maybe_fail(1, 3)  # other rank unaffected
+    with pytest.raises(TrainingFault):
+        fi.maybe_fail(0, 3)
+    fi.maybe_fail(0, 3)  # fired once, now clear
+
+
+def test_run_with_restart_recovers():
+    calls = []
+
+    def flaky(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise TrainingFault("boom")
+
+    n = run_with_restart(flaky, max_restarts=3)
+    assert n == 2
+    assert calls == [0, 1, 2]
+
+
+def test_run_with_restart_exhausts_budget():
+    def always_fails(attempt):
+        raise TrainingFault("boom")
+
+    with pytest.raises(TrainingFault):
+        run_with_restart(always_fails, max_restarts=2)
+
+
+def test_restart_resumes_training_from_checkpoint(tmp_path):
+    """Chaos test: kill BSP mid-run, restart, confirm it resumes from the
+    snapshot rather than epoch 0 (the reference's only recovery story)."""
+    import theanompi_tpu
+
+    cfg = dict(
+        batch_size=8,
+        n_epochs=3,
+        n_synth_train=128,
+        n_synth_val=64,
+        dropout_rate=0.0,
+        print_freq=1000,
+    )
+    epochs_seen = []
+
+    def attempt(i):
+        rule = theanompi_tpu.BSP()
+        rule.init(
+            devices=4,
+            model_config=cfg,
+            checkpoint_dir=str(tmp_path),
+            resume=i > 0,
+            val_freq=0,
+        )
+        model = rule.model
+        if i == 0:
+            # sabotage: crash after epoch 1's checkpoint is written
+            orig = model.adjust_hyperp
+
+            def bomb(epoch):
+                if epoch == 2:
+                    raise TrainingFault("injected mid-training crash")
+                orig(epoch)
+
+            model.adjust_hyperp = bomb
+        epochs_seen.append(("start", i, model.current_epoch))
+        rule.wait()
+        epochs_seen.append(("done", i, model.current_epoch))
+
+    restarts = run_with_restart(attempt, max_restarts=1)
+    assert restarts == 1
+    # attempt 1 must resume at epoch 2 (post-crash snapshot), not 0
+    starts = [e for e in epochs_seen if e[0] == "start"]
+    assert starts[0] == ("start", 0, 0)
+    dones = [e for e in epochs_seen if e[0] == "done"]
+    assert dones == [("done", 1, 3)]
+
+
+def test_launch_cli_parser():
+    from theanompi_tpu.launch import build_parser
+
+    args = build_parser().parse_args(
+        ["--rule", "EASGD", "--n-workers", "2", "--tau", "5", "--config", '{"lr": 0.1}']
+    )
+    assert args.rule == "EASGD"
+    assert args.tau == 5

@@ -210,3 +210,36 @@ def test_count_params():
     net = L.Sequential([L.Dense(4), L.Dense(2)])
     p, _, _ = net.init(KEY, (3,))
     assert L.count_params(p) == (3 * 4 + 4) + (4 * 2 + 2)
+
+
+def test_bf16_compute_backward_is_well_typed():
+    net = L.Sequential(
+        [
+            L.Conv2d(4, 3, compute_dtype=jnp.bfloat16),
+            L.Relu(),
+            L.Flatten(),
+            L.Dense(2, compute_dtype=jnp.bfloat16),
+        ]
+    )
+    p, s, _ = net.init(KEY, (8, 8, 3))
+
+    def loss(p):
+        y, _ = net.apply(p, s, jnp.ones((2, 8, 8, 3)))
+        return jnp.sum(y**2)
+
+    g = jax.grad(loss)(p)
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.asarray(leaf, dtype=np.float32)).all()
+
+
+def test_convtranspose_bf16_backward():
+    net = L.ConvTranspose2d(3, 4, stride=2, compute_dtype=jnp.bfloat16)
+    p, s, out = net.init(KEY, (4, 4, 8))
+    assert out == (8, 8, 3)
+
+    def loss(p):
+        y, _ = net.apply(p, s, jnp.ones((2, 4, 4, 8)))
+        return jnp.mean(y**2)
+
+    g = jax.grad(loss)(p)
+    assert np.isfinite(np.asarray(jax.tree.leaves(g)[0], np.float32)).all()

@@ -1,0 +1,92 @@
+"""Raw shard format + native C++ ring loader."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.data import shards
+from theanompi_tpu.data.providers import ImageNetData
+
+
+def _make_batches(n=4, bs=8, hw=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            rng.rand(bs, hw, hw, 3).astype(np.float32),
+            rng.randint(0, 10, bs).astype(np.int32),
+        )
+        for _ in range(n)
+    ]
+
+
+def test_native_lib_builds():
+    # g++ is baked into this environment; the build must succeed
+    assert shards.native_available()
+
+
+def test_roundtrip_native(tmp_path):
+    batches = _make_batches()
+    paths = shards.write_shard_dir(str(tmp_path), batches)
+    meta = shards.read_meta(str(tmp_path))
+    reader = shards.RawShardReader(paths, meta["x_shape"], meta["y_shape"])
+    out = list(reader)
+    assert len(out) == len(batches)
+    for (x0, y0), (x1, y1) in zip(batches, out):
+        np.testing.assert_array_equal(x0, x1)
+        np.testing.assert_array_equal(y0, y1)
+
+
+def test_roundtrip_python_fallback(tmp_path, monkeypatch):
+    batches = _make_batches(n=2)
+    paths = shards.write_shard_dir(str(tmp_path), batches)
+    meta = shards.read_meta(str(tmp_path))
+    monkeypatch.setattr(shards, "_load_lib", lambda: None)
+    reader = shards.RawShardReader(paths, meta["x_shape"], meta["y_shape"])
+    assert reader._h is None  # really on the fallback path
+    out = list(reader)
+    np.testing.assert_array_equal(out[1][0], batches[1][0])
+
+
+def test_native_reports_missing_file(tmp_path):
+    if not shards.native_available():
+        pytest.skip("no native toolchain")
+    reader = shards.RawShardReader(
+        [str(tmp_path / "nope.raw")], (2, 4, 4, 3), (2,)
+    )
+    with pytest.raises(IOError):
+        next(reader)
+
+
+def test_truncated_shard_rejected(tmp_path, monkeypatch):
+    p = str(tmp_path / "bad.raw")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 10)
+    monkeypatch.setattr(shards, "_load_lib", lambda: None)
+    reader = shards.RawShardReader([p], (2, 4, 4, 3), (2,))
+    with pytest.raises(IOError):
+        next(reader)
+
+
+def test_imagenet_provider_raw_mode(tmp_path):
+    bs, hw = 8, 16
+    shards.write_shard_dir(str(tmp_path / "train"), _make_batches(3, bs, hw, 1))
+    shards.write_shard_dir(str(tmp_path / "val"), _make_batches(1, bs, hw, 2))
+    data = ImageNetData(batch_size=bs, data_dir=str(tmp_path), image_size=hw)
+    assert not data.synthetic
+    assert data.raw_meta is not None
+    assert data.n_batch_train == 3
+    data.shuffle(epoch=0)
+    xs = list(data.train_batches())
+    assert len(xs) == 3
+    assert xs[0][0].shape == (bs, hw, hw, 3)
+    vs = list(data.val_batches())
+    assert len(vs) == 1
+
+
+def test_imagenet_provider_train_only_raw_dir(tmp_path):
+    bs, hw = 8, 16
+    shards.write_shard_dir(str(tmp_path / "train"), _make_batches(2, bs, hw, 1))
+    data = ImageNetData(batch_size=bs, data_dir=str(tmp_path), image_size=hw)
+    assert data.n_batch_train == 2
+    assert data.n_batch_val == 0
+    assert list(data.val_batches()) == []
+    assert len(list(data.train_batches())) == 2

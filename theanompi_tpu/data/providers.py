@@ -190,7 +190,27 @@ class ImageNetData:
         self.mirror = mirror
         self._rng = np.random.RandomState(seed)
         data_dir = data_dir or os.environ.get("IMAGENET_NPZ_DIR", "")
-        if data_dir and os.path.isdir(data_dir):
+        self.raw_meta = None
+        if data_dir and os.path.isfile(os.path.join(data_dir, "train", "meta.json")):
+            # raw-shard layout (written by data.shards.write_shard_dir;
+            # read through the native C++ ring loader when built). A
+            # train-only directory is valid: val_files just stays empty.
+            from theanompi_tpu.data.shards import read_meta
+
+            def _split(name):
+                d = os.path.join(data_dir, name)
+                if not os.path.isfile(os.path.join(d, "meta.json")):
+                    return None, []
+                files = sorted(
+                    os.path.join(d, f) for f in os.listdir(d) if f.endswith(".raw")
+                )
+                return read_meta(d), files
+
+            train_meta, self.train_files = _split("train")
+            val_meta, self.val_files = _split("val")
+            self.raw_meta = {"train": train_meta, "val": val_meta}
+            self.synthetic = False
+        elif data_dir and os.path.isdir(data_dir):
             self.train_files = sorted(
                 os.path.join(data_dir, "train", f)
                 for f in os.listdir(os.path.join(data_dir, "train"))
@@ -237,13 +257,17 @@ class ImageNetData:
                     x = x / 255.0
                 y = d["y"].astype(np.int32)
             x, y = x[: self.batch_size], y[: self.batch_size]
+        return self._postprocess(x, train), y
+
+    def _postprocess(self, x: np.ndarray, train: bool) -> np.ndarray:
+        """Shared aug/center-crop tail for the npz and raw-shard paths."""
         if train:
-            x = self._augment(x)
-        elif self.crop_size:
+            return self._augment(x)
+        if self.crop_size:
             c = self.crop_size
             off = (x.shape[1] - c) // 2
             x = x[:, off : off + c, off : off + c, :]
-        return x, y
+        return x
 
     def _augment(self, x: np.ndarray) -> np.ndarray:
         """Random crop + mirror, the reference's ImageNet augmentation."""
@@ -257,10 +281,24 @@ class ImageNetData:
             x = x[:, :, ::-1, :]
         return x
 
+    def _raw_batches(self, split: str, paths, train: bool):
+        from theanompi_tpu.data.shards import RawShardReader
+
+        meta = self.raw_meta[split]
+        if meta is None or not paths:
+            return
+        reader = RawShardReader(paths, meta["x_shape"], meta["y_shape"])
+        for x, y in reader:
+            x, y = x[: self.batch_size], y[: self.batch_size]
+            yield self._postprocess(x, train), y
+
     def train_batches(self):
-        for i in self._order:
-            yield self._load(self.train_files[i], train=True)
+        if self.raw_meta is not None:
+            order = [self.train_files[i] for i in self._order]
+            return self._raw_batches("train", order, train=True)
+        return (self._load(self.train_files[i], train=True) for i in self._order)
 
     def val_batches(self):
-        for f in self.val_files:
-            yield self._load(f, train=False)
+        if self.raw_meta is not None:
+            return self._raw_batches("val", self.val_files, train=False)
+        return (self._load(f, train=False) for f in self.val_files)

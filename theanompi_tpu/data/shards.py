@@ -1,0 +1,185 @@
+"""Raw shard files + the native C++ ring loader binding.
+
+The reference stored pre-processed ImageNet as hickle/HDF5 ``.hkl`` batch
+files read by a spawned loader process (SURVEY.md §3.6).  Our equivalents:
+
+- **raw shards**: ``[x float32 | y int32]`` flat binary per batch —
+  written by :func:`write_raw_shard`, shapes carried in a ``meta.json``
+  sidecar per directory (no HDF5 C dependency).
+- **native ring loader**: ``native/shard_loader.cpp`` (C++ reader thread
+  + pre-allocated ring, ctypes ABI). Auto-built with ``make`` on first
+  use; :class:`RawShardReader` falls back to NumPy reads when no
+  toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtnploader.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _load_lib():
+    """Load (building if needed) the native loader; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.tnp_loader_open.restype = ctypes.c_void_p
+    lib.tnp_loader_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_int,
+    ]
+    lib.tnp_loader_next.restype = ctypes.c_int
+    lib.tnp_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.tnp_loader_error.restype = ctypes.c_char_p
+    lib.tnp_loader_error.argtypes = [ctypes.c_void_p]
+    lib.tnp_loader_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+def write_raw_shard(path: str, x: np.ndarray, y: np.ndarray) -> None:
+    x = np.ascontiguousarray(x, np.float32)
+    y = np.ascontiguousarray(y, np.int32)
+    with open(path, "wb") as f:
+        f.write(x.tobytes())
+        f.write(y.tobytes())
+
+
+def write_shard_dir(
+    dir_path: str, batches: Sequence[Tuple[np.ndarray, np.ndarray]]
+) -> List[str]:
+    """Write batches as raw shards + meta.json (shapes/dtypes)."""
+    os.makedirs(dir_path, exist_ok=True)
+    first_x, first_y = batches[0]
+    meta = {
+        "x_shape": list(first_x.shape),
+        "y_shape": list(first_y.shape),
+        "x_dtype": "float32",
+        "y_dtype": "int32",
+        "n_shards": len(batches),
+    }
+    with open(os.path.join(dir_path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    paths = []
+    for i, (x, y) in enumerate(batches):
+        if x.shape != first_x.shape or y.shape != first_y.shape:
+            raise ValueError("all shards must share one batch shape")
+        p = os.path.join(dir_path, f"shard_{i:05d}.raw")
+        write_raw_shard(p, x, y)
+        paths.append(p)
+    return paths
+
+
+def read_meta(dir_path: str) -> dict:
+    with open(os.path.join(dir_path, "meta.json")) as f:
+        return json.load(f)
+
+
+class RawShardReader:
+    """Iterate (x, y) batches from raw shard files in a given order.
+
+    Uses the C++ ring loader when available (reads run in a native thread
+    ahead of consumption), NumPy otherwise. One pass per instance — make
+    a new reader per epoch with the shuffled file order, exactly like the
+    reference re-listed ``.hkl`` files each epoch.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        x_shape: Tuple[int, ...],
+        y_shape: Tuple[int, ...],
+        depth: int = 3,
+    ):
+        self.paths = list(paths)
+        self.x_shape = tuple(x_shape)
+        self.y_shape = tuple(y_shape)
+        self.x_bytes = int(np.prod(self.x_shape)) * 4
+        self.y_bytes = int(np.prod(self.y_shape)) * 4
+        self._lib = _load_lib()
+        self._h = None
+        if self._lib is not None and self.paths:
+            arr = (ctypes.c_char_p * len(self.paths))(
+                *[p.encode() for p in self.paths]
+            )
+            self._h = self._lib.tnp_loader_open(
+                arr, len(self.paths), self.x_bytes, self.y_bytes, depth
+            )
+        self._i = 0
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        return self
+
+    def __next__(self):
+        if self._h:
+            x = np.empty(self.x_shape, np.float32)
+            y = np.empty(self.y_shape, np.int32)
+            rc = self._lib.tnp_loader_next(
+                self._h,
+                x.ctypes.data_as(ctypes.c_void_p),
+                y.ctypes.data_as(ctypes.c_void_p),
+            )
+            if rc == 1:
+                return x, y
+            err = self._lib.tnp_loader_error(self._h).decode()
+            self.close()
+            if rc < 0:
+                raise IOError(err or "native shard loader failed")
+            raise StopIteration
+        # NumPy fallback
+        if self._i >= len(self.paths):
+            raise StopIteration
+        p = self.paths[self._i]
+        self._i += 1
+        buf = np.fromfile(p, dtype=np.uint8)
+        if buf.nbytes != self.x_bytes + self.y_bytes:
+            raise IOError(f"shard {p} has {buf.nbytes} bytes, "
+                          f"expected {self.x_bytes + self.y_bytes}")
+        x = buf[: self.x_bytes].view(np.float32).reshape(self.x_shape)
+        y = buf[self.x_bytes :].view(np.int32).reshape(self.y_shape)
+        return x, y
+
+    def close(self):
+        if self._h:
+            self._lib.tnp_loader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

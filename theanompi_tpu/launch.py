@@ -1,0 +1,83 @@
+"""CLI launcher — ``python -m theanompi_tpu.launch``.
+
+Reference analog: the mpirun command lines the rules shelled out to
+(``mpirun -np N python bsp_worker.py <device> <modelfile> <modelclass>``;
+SURVEY.md §3.1).  On TPU there is nothing to spawn per device — this CLI
+is the per-host entry point: run the same command on every host of a pod
+(with standard TPU env) and the mesh spans all chips.
+
+Examples::
+
+    python -m theanompi_tpu.launch --rule BSP \
+        --modelfile theanompi_tpu.models.alex_net --modelclass AlexNet \
+        --config '{"batch_size": 128, "n_epochs": 60}' \
+        --checkpoint-dir ./run0 --restarts 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="theanompi_tpu.launch", description=__doc__)
+    p.add_argument("--rule", choices=["BSP", "EASGD", "GOSGD"], default="BSP")
+    p.add_argument("--modelfile", default="theanompi_tpu.models.cifar10")
+    p.add_argument("--modelclass", default="Cifar10_model")
+    p.add_argument("--devices", type=int, default=None, help="device count (default: all)")
+    p.add_argument("--config", default="{}", help="model config JSON")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument(
+        "--restarts", type=int, default=0,
+        help="restart-from-checkpoint budget on crash (0 = fail fast)",
+    )
+    # async-rule knobs (ignored by BSP)
+    p.add_argument("--n-workers", type=int, default=None)
+    p.add_argument("--tau", type=int, default=10, help="EASGD exchange period")
+    p.add_argument("--alpha", type=float, default=0.5, help="EASGD elastic coef")
+    p.add_argument("--p-push", type=float, default=0.25, help="GOSGD push prob")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import theanompi_tpu
+    from theanompi_tpu.runtime.fault import run_with_restart
+
+    model_config = json.loads(args.config)
+    rule_cls = getattr(theanompi_tpu, args.rule)
+
+    def make_kwargs(resume: bool):
+        kw = {}
+        if args.rule == "BSP":
+            kw.update(checkpoint_dir=args.checkpoint_dir, resume=resume)
+        else:
+            kw.update(checkpoint_dir=args.checkpoint_dir)
+            if args.n_workers:
+                kw["n_workers"] = args.n_workers
+            if args.rule == "EASGD":
+                kw.update(tau=args.tau, alpha=args.alpha)
+            else:
+                kw.update(p_push=args.p_push)
+        return kw
+
+    def attempt(i: int) -> None:
+        rule = rule_cls()
+        rule.init(
+            devices=args.devices,
+            modelfile=args.modelfile,
+            modelclass=args.modelclass,
+            model_config=dict(model_config),
+            **make_kwargs(resume=args.resume or i > 0),
+        )
+        rule.wait()
+
+    run_with_restart(attempt, max_restarts=args.restarts)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

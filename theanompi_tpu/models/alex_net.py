@@ -1,0 +1,81 @@
+"""AlexNet — the ImageNet benchmark model.
+
+Reference analog: ``AlexNet`` in ``theanompi/models/alex_net.py``
+(SURVEY.md §3.5), the model behind the paper's headline BSP scaling
+numbers, run at 128px ("AlexNet ImageNet-128px" in BASELINE.json).
+Single-tower (the reference dropped the original's 2-GPU grouping), with
+the classic LRN + overlapping-pool arrangement.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from theanompi_tpu.data.providers import ImageNetData
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import optim
+
+
+class AlexNet(TpuModel):
+    default_config = dict(
+        batch_size=128,
+        n_epochs=60,
+        lr=0.01,
+        momentum=0.9,
+        weight_decay=5e-4,
+        dropout_rate=0.5,
+        lr_boundaries=(20, 40, 50),
+        image_size=128,
+        n_classes=1000,
+        data_dir=None,
+        n_synth_batches=64,
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = ImageNetData(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            image_size=int(cfg.image_size),
+            n_classes=int(cfg.n_classes),
+            n_synth_batches=int(cfg.n_synth_batches),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+        drop = float(cfg.dropout_rate)
+        net = L.Sequential(
+            [
+                L.Conv2d(96, 11, stride=4, padding="SAME", compute_dtype=dt),
+                L.Relu(),
+                L.LRN(),
+                L.MaxPool(3, stride=2),
+                L.Conv2d(256, 5, padding="SAME", compute_dtype=dt),
+                L.Relu(),
+                L.LRN(),
+                L.MaxPool(3, stride=2),
+                L.Conv2d(384, 3, padding="SAME", compute_dtype=dt),
+                L.Relu(),
+                L.Conv2d(384, 3, padding="SAME", compute_dtype=dt),
+                L.Relu(),
+                L.Conv2d(256, 3, padding="SAME", compute_dtype=dt),
+                L.Relu(),
+                L.MaxPool(3, stride=2),
+                L.Flatten(),
+                L.Dense(4096, compute_dtype=dt),
+                L.Relu(),
+                L.Dropout(drop),
+                L.Dense(4096, compute_dtype=dt),
+                L.Relu(),
+                L.Dropout(drop),
+                L.Dense(int(cfg.n_classes), compute_dtype=dt),
+            ]
+        )
+        self.lr_schedule = optim.step_decay(
+            float(cfg.lr), list(cfg.lr_boundaries), 0.1
+        )
+        size = int(cfg.image_size)
+        return net, (size, size, 3)

@@ -50,6 +50,7 @@ COMMON_DEFAULTS = dict(
     sync_mode="cdd",  # 'cdd' = gradient reduce; 'avg' = param averaging
     exch_strategy="ar",  # 'ar' | 'bf16' | 'fp16' | 'pallas_bf16'
     prefetch_depth=2,
+    grad_clip_norm=None,  # global-norm clip after exchange (None = off)
     print_freq=40,
     val_top5=True,
     compute_dtype=None,  # e.g. 'bfloat16' for MXU-native compute
@@ -147,6 +148,16 @@ class TpuModel:
         sync_mode = cfg.sync_mode
         if sync_mode not in ("cdd", "avg"):
             raise ValueError(f"sync_mode must be 'cdd' or 'avg', got {sync_mode!r}")
+        clip = cfg.grad_clip_norm
+
+        def maybe_clip(grads):
+            if clip is None:
+                return grads
+            gnorm = jnp.sqrt(
+                sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+            )
+            scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            return jax.tree.map(lambda g: g * scale, grads)
 
         def shard_step(params, net_state, opt_state, x, y, rng):
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
@@ -158,10 +169,10 @@ class TpuModel:
                 loss_fn, has_aux=True
             )(params)
             if sync_mode == "cdd":
-                grads = exchanger.reduce_grads(grads)
+                grads = maybe_clip(exchanger.reduce_grads(grads))
                 params, opt_state = opt.update(params, grads, opt_state)
             else:  # avg: local step, then parameter averaging
-                params, opt_state = opt.update(params, grads, opt_state)
+                params, opt_state = opt.update(params, maybe_clip(grads), opt_state)
                 params = exchanger.average_params(params)
                 opt_state = dict(
                     opt_state,
@@ -248,6 +259,8 @@ class TpuModel:
         return float(loss), float(err), float(err5)
 
     def run_validation(self, count: int, recorder) -> Tuple[float, float, float]:
+        if not self.data.n_batch_val:
+            return float("nan"), float("nan"), float("nan")
         self.reset_val_iter()
         tot = jnp.zeros((3,))
         n = 0

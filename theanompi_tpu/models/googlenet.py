@@ -1,0 +1,101 @@
+"""GoogLeNet (Inception v1).
+
+Reference analog: ``GoogLeNet`` in ``theanompi/models/googlenet.py``
+(SURVEY.md §3.5, ~1000 LoC of hand-built Theano inception blocks).  Here
+each inception block is one ``Parallel`` combinator over four branches.
+The reference-era auxiliary classifiers are omitted: they existed to
+mitigate vanishing gradients in 2014-era plain SGD and complicate the
+single-output model contract; modern init + BN-free LRN training of this
+depth converges without them (documented deviation).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from theanompi_tpu.data.providers import ImageNetData
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import optim
+
+
+def _conv(filters, kernel, dt, stride=1):
+    return L.Sequential(
+        [
+            L.Conv2d(filters, kernel, stride=stride, padding="SAME", compute_dtype=dt),
+            L.Relu(),
+        ]
+    )
+
+
+def _inception(c1, c3r, c3, c5r, c5, pp, dt):
+    return L.Parallel(
+        [
+            _conv(c1, 1, dt),
+            L.Sequential([_conv(c3r, 1, dt), _conv(c3, 3, dt)]),
+            L.Sequential([_conv(c5r, 1, dt), _conv(c5, 5, dt)]),
+            L.Sequential([L.MaxPool(3, stride=1, padding="SAME"), _conv(pp, 1, dt)]),
+        ]
+    )
+
+
+class GoogLeNet(TpuModel):
+    default_config = dict(
+        batch_size=64,
+        n_epochs=60,
+        lr=0.01,
+        momentum=0.9,
+        weight_decay=2e-4,
+        dropout_rate=0.4,
+        lr_boundaries=(30, 50),
+        image_size=224,
+        n_classes=1000,
+        data_dir=None,
+        n_synth_batches=32,
+        exch_strategy="bf16",  # BASELINE.json config #3 exchanger path
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = ImageNetData(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            image_size=int(cfg.image_size),
+            n_classes=int(cfg.n_classes),
+            n_synth_batches=int(cfg.n_synth_batches),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+        net = L.Sequential(
+            [
+                _conv(64, 7, dt, stride=2),
+                L.MaxPool(3, stride=2, padding="SAME"),
+                L.LRN(),
+                _conv(64, 1, dt),
+                _conv(192, 3, dt),
+                L.LRN(),
+                L.MaxPool(3, stride=2, padding="SAME"),
+                _inception(64, 96, 128, 16, 32, 32, dt),  # 3a -> 256
+                _inception(128, 128, 192, 32, 96, 64, dt),  # 3b -> 480
+                L.MaxPool(3, stride=2, padding="SAME"),
+                _inception(192, 96, 208, 16, 48, 64, dt),  # 4a -> 512
+                _inception(160, 112, 224, 24, 64, 64, dt),  # 4b
+                _inception(128, 128, 256, 24, 64, 64, dt),  # 4c
+                _inception(112, 144, 288, 32, 64, 64, dt),  # 4d -> 528
+                _inception(256, 160, 320, 32, 128, 128, dt),  # 4e -> 832
+                L.MaxPool(3, stride=2, padding="SAME"),
+                _inception(256, 160, 320, 32, 128, 128, dt),  # 5a
+                _inception(384, 192, 384, 48, 128, 128, dt),  # 5b -> 1024
+                L.GlobalAvgPool(),
+                L.Dropout(float(cfg.dropout_rate)),
+                L.Dense(int(cfg.n_classes), compute_dtype=dt),
+            ]
+        )
+        self.lr_schedule = optim.step_decay(
+            float(cfg.lr), list(cfg.lr_boundaries), 0.1
+        )
+        size = int(cfg.image_size)
+        return net, (size, size, 3)

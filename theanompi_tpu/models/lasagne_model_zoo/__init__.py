@@ -1,0 +1,15 @@
+"""Compat namespace mirroring the reference's model zoo layout.
+
+The reference keeps ResNet-50, Wide-ResNet, LS-GAN and VGG under
+``theanompi/models/lasagne_model_zoo/`` (SURVEY.md §3.5).  There is no
+Lasagne here — these are the same TPU-native models — but user scripts
+that import by the reference's paths keep working::
+
+    rule.init(modelfile='theanompi_tpu.models.lasagne_model_zoo',
+              modelclass='ResNet50')
+"""
+
+from theanompi_tpu.models.lsgan import LSGAN  # noqa: F401
+from theanompi_tpu.models.resnet50 import ResNet50  # noqa: F401
+from theanompi_tpu.models.vgg16 import VGG16  # noqa: F401
+from theanompi_tpu.models.wresnet import WResNet  # noqa: F401

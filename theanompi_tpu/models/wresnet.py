@@ -1,0 +1,95 @@
+"""Wide-ResNet (CIFAR-10).
+
+Reference analog: ``WResNet`` in
+``theanompi/models/lasagne_model_zoo/wresnet.py`` (SURVEY.md §3.5) —
+BASELINE.json config #1 is "Cifar-10 Wide-ResNet single-worker BSP, CPU
+smoke".  Pre-activation WRN-d-k (Zagoruyko & Komodakis 2016): depth
+``d = 6n+4``, widen factor ``k``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from theanompi_tpu.data.providers import Cifar10Data
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import optim
+from theanompi_tpu.runtime.mesh import DATA_AXIS
+
+
+def _wide_block(cin, cout, stride, drop, bn_axis, dt):
+    body = L.Sequential(
+        [
+            L.BatchNorm(axis_name=bn_axis),
+            L.Relu(),
+            L.Conv2d(cout, 3, stride=stride, padding="SAME", use_bias=False, compute_dtype=dt),
+            L.BatchNorm(axis_name=bn_axis),
+            L.Relu(),
+            *([L.Dropout(drop)] if drop else []),
+            L.Conv2d(cout, 3, padding="SAME", use_bias=False, compute_dtype=dt),
+        ]
+    )
+    shortcut = (
+        L.Conv2d(cout, 1, stride=stride, use_bias=False, compute_dtype=dt)
+        if (stride != 1 or cin != cout)
+        else None
+    )
+    return L.Residual(body, shortcut)
+
+
+class WResNet(TpuModel):
+    default_config = dict(
+        batch_size=128,
+        n_epochs=200,
+        lr=0.1,
+        momentum=0.9,
+        nesterov=True,
+        weight_decay=5e-4,
+        lr_boundaries=(60, 120, 160),
+        depth=28,
+        widen_factor=4,
+        dropout_rate=0.0,
+        sync_bn=False,
+        data_dir=None,
+        n_synth_train=8192,
+        n_synth_val=1024,
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = Cifar10Data(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+        bn_axis = DATA_AXIS if cfg.sync_bn else None
+        depth, k = int(cfg.depth), int(cfg.widen_factor)
+        if (depth - 4) % 6 != 0:
+            raise ValueError("WRN depth must be 6n+4")
+        n = (depth - 4) // 6
+        drop = float(cfg.dropout_rate)
+        widths = [16 * k, 32 * k, 64 * k]
+        seq = [L.Conv2d(16, 3, padding="SAME", use_bias=False, compute_dtype=dt)]
+        cin = 16
+        for gi, w in enumerate(widths):
+            for b in range(n):
+                stride = 2 if (gi > 0 and b == 0) else 1
+                seq.append(_wide_block(cin, w, stride, drop, bn_axis, dt))
+                cin = w
+        seq += [
+            L.BatchNorm(axis_name=bn_axis),
+            L.Relu(),
+            L.GlobalAvgPool(),
+            L.Dense(10, compute_dtype=dt),
+        ]
+        self.lr_schedule = optim.step_decay(
+            float(cfg.lr), list(cfg.lr_boundaries), 0.2
+        )
+        return L.Sequential(seq), Cifar10Data.shape

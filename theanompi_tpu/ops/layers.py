@@ -114,6 +114,9 @@ class Conv2d(Layer):
     def apply(self, params, state, x, train=False, rng=None):
         w = params["w"]
         if self.compute_dtype is not None:
+            # cast inputs AND output boundary: the MXU accumulates bf16
+            # matmuls in fp32 internally, and the up-cast on y keeps the
+            # VJP well-typed (fp32 cotangents never meet bf16 operands)
             x = x.astype(self.compute_dtype)
             w = w.astype(self.compute_dtype)
         y = lax.conv_general_dilated(
@@ -122,8 +125,9 @@ class Conv2d(Layer):
             window_strides=self.stride,
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
         )
+        if self.compute_dtype is not None:
+            y = y.astype(jnp.float32)
         if self.use_bias:
             y = y + params["b"]
         return y, state
@@ -161,6 +165,8 @@ class Dense(Layer):
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
             w = w.astype(self.compute_dtype)
+        # unlike conv, dot's VJP handles mixed dtypes, so bf16 operands can
+        # keep a true fp32 accumulator output with no precision round-trip
         y = jnp.dot(x, w, preferred_element_type=jnp.float32)
         if self.use_bias:
             y = y + params["b"]
@@ -253,14 +259,27 @@ class BatchNorm(Layer):
     ``lax.pmean`` when applied inside ``shard_map``.
     """
 
-    def __init__(self, momentum=0.9, eps=1e-5, axis_name: Optional[str] = None):
+    def __init__(
+        self,
+        momentum=0.9,
+        eps=1e-5,
+        axis_name: Optional[str] = None,
+        scale_init: float = 1.0,
+    ):
         self.momentum = momentum
         self.eps = eps
         self.axis_name = axis_name
+        # scale_init=0 is the "zero-gamma" residual trick: a freshly-init
+        # deep ResNet starts as (near-)identity, keeping early gradients
+        # bounded through dozens of stacked blocks
+        self.scale_init = scale_init
 
     def init(self, key, in_shape):
         c = in_shape[-1]
-        params = {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+        params = {
+            "scale": jnp.full((c,), self.scale_init, jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32),
+        }
         state = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
         return params, state, in_shape
 
@@ -311,6 +330,23 @@ class Activation(Layer):
 
 def Relu():
     return Activation(jax.nn.relu)
+
+
+class Reshape(Layer):
+    """Reshape the per-example feature shape (batch dim untouched)."""
+
+    def __init__(self, shape: Shape):
+        self.shape = tuple(shape)
+
+    def init(self, key, in_shape):
+        import numpy as _np
+
+        if int(_np.prod(in_shape)) != int(_np.prod(self.shape)):
+            raise ValueError(f"cannot reshape {in_shape} -> {self.shape}")
+        return {}, {}, self.shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return x.reshape(x.shape[0], *self.shape), state
 
 
 class Flatten(Layer):
@@ -481,8 +517,9 @@ class ConvTranspose2d(Layer):
             strides=self.stride,
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
         )
+        if self.compute_dtype is not None:
+            y = y.astype(jnp.float32)
         if self.use_bias:
             y = y + params["b"]
         return y, state

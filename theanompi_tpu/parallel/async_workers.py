@@ -1,0 +1,362 @@
+"""EASGD and GOSGD — the asynchronous training rules.
+
+Reference analogs (SURVEY.md §3.2, §4.3, §4.4):
+
+- ``EASGD_Worker`` / ``EASGD_Server`` (upstream ``easgd_worker.py`` /
+  ``easgd_server.py``): a dedicated server rank holds center variables;
+  each worker trains τ local iterations then does a serialized pairwise
+  elastic exchange — worker ``x_i ← x_i − α(x_i − x̃)``, center
+  ``x̃ ← x̃ + α(x_i − x̃)`` (Zhang, Choromanska & LeCun 2015).
+- ``GOSGD_Worker`` (upstream ``gosgd_worker.py``): no server; after each
+  local step, with probability p a worker pushes ``(params, weight/2)``
+  to a random peer and halves its own weight; receivers merge by weight
+  (Blot et al. 2016).
+
+TPU-native redesign (SURVEY.md §8.1): each async worker is an
+**independent jitted program on its own disjoint device subset** (a
+per-worker ``Mesh``), driven by a thread of the single controller; the
+server is a host object; exchanges move host pytrees through
+``transport.Mailbox``.  Asynchrony semantics (staleness, elastic math,
+gossip weights) are preserved exactly at the host level — XLA has no
+dynamic p2p, and τ hides host-transfer latency just as it hid MPI latency
+in the reference.  Device subsets of size >1 run BSP *within* a worker
+(hierarchical: in-graph psum inside, elastic averaging outside).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from theanompi_tpu.parallel.transport import Mailbox
+from theanompi_tpu.runtime.mesh import make_mesh, replicate
+from theanompi_tpu.runtime.recorder import Recorder
+
+Pytree = Any
+
+
+def _to_host(tree: Pytree) -> Pytree:
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _split_devices(devices, n_workers: int):
+    per = len(devices) // n_workers
+    if per < 1:
+        raise ValueError(
+            f"{n_workers} workers need ≥{n_workers} devices, have {len(devices)}"
+        )
+    return [devices[i * per : (i + 1) * per] for i in range(n_workers)]
+
+
+class EASGD_Server:
+    """Center-variable holder (reference ``EASGD_Server``).
+
+    The reference dedicates an MPI rank + GPU to this; here it is a host
+    object whose ``exchange`` serializes workers with a lock exactly as
+    the MPI recv-loop serialized them (SURVEY.md §4.3 'serialization
+    bottleneck by design').
+    """
+
+    def __init__(self, center: Pytree, alpha: float):
+        self.center = center
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self.n_exchanges = 0
+
+    def exchange(self, worker_params: Pytree) -> Pytree:
+        a = self.alpha
+        with self._lock:
+            diff = jax.tree.map(lambda w, c: w - c, worker_params, self.center)
+            self.center = jax.tree.map(
+                lambda c, d: c + a * d, self.center, diff
+            )
+            self.n_exchanges += 1
+            return jax.tree.map(lambda w, d: w - a * d, worker_params, diff)
+
+
+class _AsyncWorkerBase:
+    """Common thread body: local model + train loop + exchange hook."""
+
+    def __init__(self, rank, devices, modelfile, modelclass, model_config, n_epochs,
+                 recorder: Recorder):
+        self.rank = rank
+        self.devices = devices
+        self.recorder = recorder
+        cfg = dict(model_config or {})
+        # different data order per worker (reference: per-rank shard)
+        cfg["seed"] = int(cfg.get("seed", 0)) + rank
+        cls = getattr(importlib.import_module(modelfile), modelclass)
+        self.model = cls(config=cfg, mesh=make_mesh(devices=devices))
+        if n_epochs is not None:
+            self.model.n_epochs = n_epochs
+        self.error: Optional[BaseException] = None
+
+    def set_params(self, host_params: Pytree) -> None:
+        self.model.params = replicate(self.model.mesh, host_params)
+
+    def get_params(self) -> Pytree:
+        return _to_host(self.model.params)
+
+    def run(self):
+        try:
+            self._run()
+        except BaseException as e:  # joined + re-raised by the driver
+            self.error = e
+
+    def _run(self):
+        raise NotImplementedError
+
+
+class EASGD_Worker(_AsyncWorkerBase):
+    def __init__(self, *args, server: EASGD_Server, tau: int, **kw):
+        super().__init__(*args, **kw)
+        self.server = server
+        self.tau = tau
+
+    def _run(self):
+        model, rec = self.model, self.recorder
+        model.compile_train()
+        count = 0
+        since_exchange = 0
+        for epoch in range(model.n_epochs):
+            model.adjust_hyperp(epoch)
+            model.reset_train_iter(epoch)
+            for _ in range(model.data.n_batch_train):
+                count += 1
+                model.train_iter(count, rec)
+                rec.print_train_info(count)
+                since_exchange += 1
+                if since_exchange >= self.tau:
+                    since_exchange = 0
+                    rec.start("comm")
+                    new_w = self.server.exchange(self.get_params())
+                    self.set_params(new_w)
+                    rec.end("comm")
+
+
+class GOSGD_Worker(_AsyncWorkerBase):
+    def __init__(self, *args, mailbox: Mailbox, p_push: float, rng: np.random.RandomState, **kw):
+        super().__init__(*args, **kw)
+        self.mailbox = mailbox
+        self.p_push = p_push
+        self.weight = 1.0 / mailbox.n_ranks  # gossip consensus weights
+        self._np_rng = rng
+
+    def _merge_inbox(self):
+        msgs = self.mailbox.drain(self.rank)
+        if not msgs:
+            return
+        self.recorder.start("comm")
+        w_i = self.get_params()
+        a_i = self.weight
+        for (w_j, a_j) in msgs:
+            tot = a_i + a_j
+            w_i = jax.tree.map(
+                lambda wi, wj: (a_i * wi + a_j * wj) / tot, w_i, w_j
+            )
+            a_i = tot
+        self.weight = a_i
+        self.set_params(w_i)
+        self.recorder.end("comm")
+
+    def _maybe_push(self):
+        if self._np_rng.rand() >= self.p_push or self.mailbox.n_ranks < 2:
+            return
+        peers = [r for r in range(self.mailbox.n_ranks) if r != self.rank]
+        dst = int(self._np_rng.choice(peers))
+        self.recorder.start("comm")
+        self.weight /= 2.0
+        self.mailbox.send(dst, (self.get_params(), self.weight))
+        self.recorder.end("comm")
+
+    def _run(self):
+        model, rec = self.model, self.recorder
+        model.compile_train()
+        count = 0
+        for epoch in range(model.n_epochs):
+            model.adjust_hyperp(epoch)
+            model.reset_train_iter(epoch)
+            for _ in range(model.data.n_batch_train):
+                count += 1
+                model.train_iter(count, rec)
+                rec.print_train_info(count)
+                self._merge_inbox()
+                self._maybe_push()
+        # final drain so in-flight pushes aren't lost at shutdown
+        self._merge_inbox()
+
+
+class _AsyncDriverBase:
+    """Spawns worker threads over disjoint device subsets and joins them."""
+
+    def __init__(
+        self,
+        modelfile: str,
+        modelclass: str,
+        model_config: Optional[dict],
+        devices,
+        n_workers: Optional[int] = None,
+        n_epochs: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        verbose: bool = True,
+        val_freq: int = 1,  # 0 = skip final validation of the result model
+    ):
+        self.modelfile = modelfile
+        self.modelclass = modelclass
+        self.model_config = model_config
+        self.devices = list(devices)
+        self.n_workers = n_workers or len(self.devices)
+        self.n_epochs = n_epochs
+        self.checkpoint_dir = checkpoint_dir
+        self.verbose = verbose
+        self.val_freq = val_freq
+        self.workers: List[_AsyncWorkerBase] = []
+        self.result_model = None
+
+    def _make_recorder(self, rank):
+        pf = int((self.model_config or {}).get("print_freq", 40))
+        return Recorder(
+            print_freq=pf,
+            rank=rank,
+            verbose=self.verbose and rank == 0,
+            save_dir=self.checkpoint_dir,
+        )
+
+    def _build_workers(self):
+        raise NotImplementedError
+
+    def _finalize(self):
+        raise NotImplementedError
+
+    def run(self):
+        self._build_workers()
+        threads = [
+            threading.Thread(target=w.run, name=f"{type(w).__name__}-{w.rank}")
+            for w in self.workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errs = [w.error for w in self.workers if w.error is not None]
+        if errs:
+            raise errs[0]
+        self._finalize()
+        if self.val_freq and self.result_model is not None:
+            # validate the consensus/center model (reference: the EASGD
+            # server owns validation of the center params; SURVEY.md §4.3)
+            rec = self.workers[0].recorder
+            self.result_model.run_validation(0, rec)
+        if self.checkpoint_dir:
+            for w in self.workers:
+                w.recorder.save()
+
+
+class EASGD_Driver(_AsyncDriverBase):
+    """Server + N elastic-averaging workers (reference ``async_rule.EASGD``
+    spawning N workers + 1 server rank; SURVEY.md §3.1)."""
+
+    def __init__(self, *args, tau: int = 10, alpha: float = 0.5, **kw):
+        super().__init__(*args, **kw)
+        self.tau = tau
+        self.alpha = alpha
+        self.server: Optional[EASGD_Server] = None
+
+    def _build_workers(self):
+        groups = _split_devices(self.devices, self.n_workers)
+        self.workers = [
+            EASGD_Worker(
+                rank,
+                groups[rank],
+                self.modelfile,
+                self.modelclass,
+                self.model_config,
+                self.n_epochs,
+                self._make_recorder(rank),
+                server=None,  # set below once center exists
+                tau=self.tau,
+            )
+            for rank in range(self.n_workers)
+        ]
+        # center = worker 0's init (reference: server rank initializes and
+        # broadcasts); all workers start at the center
+        center = self.workers[0].get_params()
+        self.server = EASGD_Server(center, self.alpha)
+        for w in self.workers:
+            w.server = self.server
+            w.set_params(center)
+
+    def _finalize(self):
+        # the server owns the final model (reference: server saves center)
+        self.result_model = self.workers[0].model
+        self.result_model.params = replicate(
+            self.result_model.mesh, self.server.center
+        )
+        if self.checkpoint_dir:
+            path = os.path.join(self.checkpoint_dir, "ckpt_center.npz")
+            self.result_model.save_model(path)
+
+
+class GOSGD_Driver(_AsyncDriverBase):
+    """N gossip workers over a shared mailbox (reference
+    ``async_rule.GOSGD``)."""
+
+    def __init__(self, *args, p_push: float = 0.25, **kw):
+        super().__init__(*args, **kw)
+        self.p_push = p_push
+
+    def _build_workers(self):
+        groups = _split_devices(self.devices, self.n_workers)
+        mailbox = self.mailbox = Mailbox(self.n_workers)
+        seed0 = int((self.model_config or {}).get("seed", 0))
+        self.workers = [
+            GOSGD_Worker(
+                rank,
+                groups[rank],
+                self.modelfile,
+                self.modelclass,
+                self.model_config,
+                self.n_epochs,
+                self._make_recorder(rank),
+                mailbox=mailbox,
+                p_push=self.p_push,
+                rng=np.random.RandomState(10_000 + seed0 + rank),
+            )
+            for rank in range(self.n_workers)
+        ]
+        # common init point (reference workers all load the same init)
+        w0 = self.workers[0].get_params()
+        for w in self.workers[1:]:
+            w.set_params(w0)
+
+    def _finalize(self):
+        # drain pushes still in flight when their target exited (a worker's
+        # final drain races with peers' last sends) — without this, their
+        # weight mass is lost and the consensus denominator drifts from 1
+        for w in self.workers:
+            for (w_j, a_j) in self.mailbox.drain(w.rank):
+                w_i, a_i = w.get_params(), w.weight
+                tot = a_i + a_j
+                merged = jax.tree.map(
+                    lambda wi, wj: (a_i * wi + a_j * wj) / tot, w_i, w_j
+                )
+                w.weight = tot
+                w.set_params(merged)
+        # gossip consensus: weighted average of worker params
+        tot = sum(w.weight for w in self.workers)
+        acc = None
+        for w in self.workers:
+            part = jax.tree.map(
+                lambda x: np.asarray(x) * (w.weight / tot), w.model.params
+            )
+            acc = part if acc is None else jax.tree.map(np.add, acc, part)
+        self.result_model = self.workers[0].model
+        self.result_model.params = replicate(self.result_model.mesh, acc)
+        if self.checkpoint_dir:
+            path = os.path.join(self.checkpoint_dir, "ckpt_consensus.npz")
+            self.result_model.save_model(path)

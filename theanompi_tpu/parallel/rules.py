@@ -46,13 +46,13 @@ def _resolve_devices(devices) -> Optional[Sequence[jax.Device]]:
 
 
 class Rule:
-    """Common init/wait machinery; subclasses pick the worker."""
+    """Common init/wait machinery; subclasses wire up their worker(s)."""
 
     def __init__(self):
         self.model = None
         self.worker = None
 
-    def _make_worker(self, model, **worker_kwargs):
+    def _setup(self, devices, modelfile, modelclass, model_config, **worker_kwargs):
         raise NotImplementedError
 
     def init(
@@ -64,11 +64,10 @@ class Rule:
         **worker_kwargs: Any,
     ) -> "Rule":
         init_distributed()
-        mesh = make_mesh(devices=_resolve_devices(devices))
-        module = importlib.import_module(modelfile)
-        cls = getattr(module, modelclass)
-        self.model = cls(config=model_config, mesh=mesh)
-        self.worker = self._make_worker(self.model, **worker_kwargs)
+        devs = _resolve_devices(devices)
+        if devs is None:
+            devs = jax.devices()
+        self._setup(list(devs), modelfile, modelclass, model_config, **worker_kwargs)
         return self
 
     def wait(self):
@@ -80,27 +79,52 @@ class Rule:
 
 
 class BSP(Rule):
-    """Bulk-synchronous parallel (reference ``sync_rule.BSP``)."""
+    """Bulk-synchronous parallel (reference ``sync_rule.BSP``).
 
-    def _make_worker(self, model, **kw):
+    One model over one mesh; exchange is in-graph psum."""
+
+    def _setup(self, devices, modelfile, modelclass, model_config, **kw):
         from theanompi_tpu.parallel.workers import BSP_Worker
 
-        return BSP_Worker(model, **kw)
+        mesh = make_mesh(devices=devices)
+        cls = getattr(importlib.import_module(modelfile), modelclass)
+        self.model = cls(config=model_config, mesh=mesh)
+        self.worker = BSP_Worker(self.model, **kw)
 
 
-class EASGD(Rule):
-    """Elastic-averaging SGD (reference ``async_rule.EASGD``)."""
+class _AsyncRule(Rule):
+    driver_cls = None
 
-    def _make_worker(self, model, **kw):
+    def _setup(self, devices, modelfile, modelclass, model_config, **kw):
+        self.worker = self.driver_cls(
+            modelfile, modelclass, model_config, devices, **kw
+        )
+
+    def wait(self):
+        if self.worker is None:
+            raise RuntimeError("call rule.init(...) before rule.wait()")
+        self.worker.run()
+        self.model = self.worker.result_model
+        return self.model
+
+
+class EASGD(_AsyncRule):
+    """Elastic-averaging SGD (reference ``async_rule.EASGD``): N workers
+    on disjoint device subsets + a host-level center-variable server."""
+
+    @property
+    def driver_cls(self):
         from theanompi_tpu.parallel.async_workers import EASGD_Driver
 
-        return EASGD_Driver(model, **kw)
+        return EASGD_Driver
 
 
-class GOSGD(Rule):
-    """Gossip SGD (reference ``async_rule.GOSGD``)."""
+class GOSGD(_AsyncRule):
+    """Gossip SGD (reference ``async_rule.GOSGD``): N peer workers with
+    randomized host-level pushes, no server."""
 
-    def _make_worker(self, model, **kw):
+    @property
+    def driver_cls(self):
         from theanompi_tpu.parallel.async_workers import GOSGD_Driver
 
-        return GOSGD_Driver(model, **kw)
+        return GOSGD_Driver

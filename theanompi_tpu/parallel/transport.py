@@ -1,0 +1,65 @@
+"""Host-level async transport for EASGD/GOSGD.
+
+The reference's async rules ride MPI point-to-point (worker↔server sends
+in ``easgd_worker/server.py``, randomized peer pushes in
+``gosgd_worker.py``; SURVEY.md §4.3/§4.4).  XLA has no dynamic p2p inside
+a compiled program (SURVEY.md §6 "Distributed communication backend"), so
+asynchrony lives at the host layer by design: device compute stays in
+jitted programs per worker, while parameter pytrees hop between workers
+through this transport.
+
+``Mailbox`` is the in-process implementation (threads driving disjoint
+device subsets under one controller — the single-host analog of the
+reference's one-process-per-GPU).  The interface is deliberately tiny so
+a cross-host implementation (TCP/grpc between ``jax.distributed``
+processes) can slot in without touching the workers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Mailbox:
+    """N addressable inboxes with nonblocking drain (MPI iprobe analog)."""
+
+    def __init__(self, n_ranks: int):
+        self.n_ranks = n_ranks
+        self._queues: List[queue.Queue] = [queue.Queue() for _ in range(n_ranks)]
+
+    def send(self, dst: int, msg: Any) -> None:
+        self._queues[dst].put(msg)
+
+    def drain(self, rank: int) -> List[Any]:
+        """All currently-queued messages for ``rank`` (nonblocking)."""
+        out = []
+        q = self._queues[rank]
+        while True:
+            try:
+                out.append(q.get_nowait())
+            except queue.Empty:
+                return out
+
+    def recv(self, rank: int, timeout: Optional[float] = None) -> Any:
+        """Blocking receive (MPI recv analog). Raises queue.Empty on timeout."""
+        return self._queues[rank].get(timeout=timeout)
+
+
+class SharedCounter:
+    """Thread-safe counter (e.g. total iterations across async workers)."""
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def add(self, k: int = 1) -> int:
+        with self._lock:
+            self._v += k
+            return self._v
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._v

@@ -1,0 +1,67 @@
+"""Failure handling: restart-from-checkpoint + fault injection.
+
+The reference has NO failure handling — any MPI rank dying kills the job
+(SURVEY.md §6 "Failure detection": ABSENT).  Matching the reference means
+restart-from-checkpoint; this module provides that plus the fault-injection
+hook the reference lacked, used by the chaos tests for the host-side async
+(EASGD/GOSGD) paths.
+
+- ``run_with_restart``: drive a training callable; on crash, re-invoke it
+  (the callable resumes from its latest checkpoint — ``BSP_Worker``'s
+  ``resume=True`` path).  This is the single-controller analog of a
+  cluster manager rescheduling the job.
+- ``FaultInjector``: deterministic fault plan (raise at iteration K on
+  worker R) threaded into workers for tests.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Callable, Optional
+
+
+class TrainingFault(RuntimeError):
+    """Injected fault (distinguishable from real bugs in tests)."""
+
+
+class FaultInjector:
+    """Raise ``TrainingFault`` at configured (rank, iteration) points."""
+
+    def __init__(self, plan):
+        # plan: iterable of (rank, iteration) pairs, each fires once
+        self._plan = set(tuple(p) for p in plan)
+
+    def maybe_fail(self, rank: int, iteration: int) -> None:
+        key = (rank, iteration)
+        if key in self._plan:
+            self._plan.discard(key)
+            raise TrainingFault(f"injected fault at rank={rank} iter={iteration}")
+
+
+def run_with_restart(
+    run_fn: Callable[[int], None],
+    max_restarts: int = 3,
+    backoff_s: float = 0.0,
+    on_failure: Optional[Callable[[int, BaseException], None]] = None,
+) -> int:
+    """Call ``run_fn(attempt)`` until it completes; restart on exceptions.
+
+    Returns the number of restarts consumed. Re-raises once the budget is
+    exhausted.  ``run_fn`` must be restartable (resume from checkpoints).
+    """
+    attempt = 0
+    while True:
+        try:
+            run_fn(attempt)
+            return attempt
+        except BaseException as e:  # noqa: BLE001 — restart loop is the point
+            attempt += 1
+            if on_failure is not None:
+                on_failure(attempt, e)
+            if attempt > max_restarts:
+                raise
+            traceback.print_exc()
+            print(f"restart {attempt}/{max_restarts} after: {e!r}", flush=True)
+            if backoff_s:
+                time.sleep(backoff_s)

@@ -130,6 +130,25 @@ class Recorder:
                 flush=True,
             )
 
+    # ---- deep profiling -------------------------------------------------
+    def profile(self, logdir: str):
+        """Context manager: capture a ``jax.profiler`` trace (Perfetto/
+        XProf) around a training window — the op-level complement to the
+        calc/comm/wait wall-clock splits (reference used Theano's
+        ``profile=True`` for this; SURVEY.md §6 Tracing row)."""
+        import jax
+
+        class _Trace:
+            def __enter__(self_inner):
+                jax.profiler.start_trace(logdir)
+                return self_inner
+
+            def __exit__(self_inner, *exc):
+                jax.profiler.stop_trace()
+                return False
+
+        return _Trace()
+
     # ---- persistence ----------------------------------------------------
     def save(self, path: Optional[str] = None) -> str:
         """Dump the record as JSONL (reference pickles a list; we keep the

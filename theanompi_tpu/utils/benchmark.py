@@ -1,0 +1,107 @@
+"""Benchmark harness — step timing, comm fraction, scaling efficiency.
+
+Reference analog: the recorder's calc/comm/wait split plus the paper's
+scaling-efficiency methodology (images/sec at N workers ÷ N × images/sec
+at 1; SURVEY.md §7).  Because our exchange is fused into the XLA step,
+comm time can't be host-timed the way the reference timed
+``exchanger.exchange()`` — instead ``comm_fraction`` compiles the step
+twice (with and without the exchange term) and differences steady-state
+step times, which is the honest fused-graph equivalent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
+
+
+def measure_step_time(
+    model, n_steps: int = 20, warmup: int = 3, train_fn=None
+) -> float:
+    """Steady-state seconds per training step (compile + warmup excluded)."""
+    fn = train_fn or model.train_fn or model.compile_train()
+    batches = [shard_batch(model.mesh, b) for b in model.data.train_batches()]
+    p, s, o = model.params, model.net_state, model.opt_state
+    rng = jax.random.PRNGKey(0)
+    loss = None
+    for i in range(warmup):
+        x, y = batches[i % len(batches)]
+        p, s, o, loss, _ = fn(p, s, o, x, y, rng)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        x, y = batches[i % len(batches)]
+        p, s, o, loss, _ = fn(p, s, o, x, y, rng)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / n_steps
+
+
+def images_per_sec(model, n_steps: int = 20) -> float:
+    step_s = measure_step_time(model, n_steps=n_steps)
+    return model.global_batch / step_s
+
+
+def comm_fraction(model_cls, config: dict, mesh=None, n_steps: int = 20) -> Dict:
+    """Estimate exchange cost: step time with psum vs a no-exchange step.
+
+    The no-exchange variant applies local gradients only (what a single
+    worker would do) — the delta is the in-graph collective's cost, the
+    fused-XLA analog of the reference recorder's 'comm' column.
+    """
+    mesh = mesh or make_mesh()
+    with_x = model_cls(config=dict(config), mesh=mesh)
+    t_with = measure_step_time(with_x, n_steps=n_steps)
+
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+    class _NoExchange(BSP_Exchanger):
+        def reduce_grads(self, grads):
+            return grads
+
+        def average_params(self, params):
+            return params
+
+    without = model_cls(config=dict(config), mesh=mesh)
+    without.compile_train(exchanger=_NoExchange(strategy="ar"))
+    t_without = measure_step_time(without, n_steps=n_steps)
+    return {
+        "step_with_exchange_s": t_with,
+        "step_without_exchange_s": t_without,
+        "comm_s": max(0.0, t_with - t_without),
+        "comm_fraction": max(0.0, 1.0 - t_without / t_with),
+    }
+
+
+def scaling_efficiency(
+    model_cls,
+    config: dict,
+    device_counts: Optional[Sequence[int]] = None,
+    n_steps: int = 10,
+) -> List[Dict]:
+    """images/sec and efficiency across device counts (BASELINE.md metric:
+    efficiency(N) = imgs/s at N ÷ (N × imgs/s at 1))."""
+    all_devs = jax.devices()
+    if device_counts is None:
+        device_counts = [n for n in (1, 2, 4, 8, 16, 32) if n <= len(all_devs)]
+    rows: List[Dict] = []
+    base_per_chip = None
+    for n in device_counts:
+        mesh = make_mesh(devices=all_devs[:n])
+        model = model_cls(config=dict(config), mesh=mesh)
+        ips = images_per_sec(model, n_steps=n_steps)
+        per_chip = ips / n
+        if base_per_chip is None:
+            base_per_chip = per_chip
+        rows.append(
+            {
+                "devices": n,
+                "images_per_sec": ips,
+                "per_chip": per_chip,
+                "efficiency": per_chip / base_per_chip,
+            }
+        )
+    return rows
