@@ -157,6 +157,7 @@ class RawShardReader:
                 return x, y
             err = self._lib.tnp_loader_error(self._h).decode()
             self.close()
+            self._i = len(self.paths)  # stay exhausted (no fallback re-read)
             if rc < 0:
                 raise IOError(err or "native shard loader failed")
             raise StopIteration

@@ -45,12 +45,18 @@ def _to_host(tree: Pytree) -> Pytree:
 
 
 def _split_devices(devices, n_workers: int):
-    per = len(devices) // n_workers
+    per, rem = divmod(len(devices), n_workers)
     if per < 1:
         raise ValueError(
             f"{n_workers} workers need ≥{n_workers} devices, have {len(devices)}"
         )
-    return [devices[i * per : (i + 1) * per] for i in range(n_workers)]
+    # spread the remainder so no chip idles (first `rem` workers get +1)
+    out, i = [], 0
+    for w in range(n_workers):
+        n = per + (1 if w < rem else 0)
+        out.append(devices[i : i + n])
+        i += n
+    return out
 
 
 class EASGD_Server:

@@ -55,7 +55,9 @@ def run_with_restart(
         try:
             run_fn(attempt)
             return attempt
-        except BaseException as e:  # noqa: BLE001 — restart loop is the point
+        except (KeyboardInterrupt, SystemExit):
+            raise  # operator abort is not a fault — never restart on it
+        except Exception as e:
             attempt += 1
             if on_failure is not None:
                 on_failure(attempt, e)

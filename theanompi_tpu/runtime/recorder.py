@@ -138,16 +138,7 @@ class Recorder:
         ``profile=True`` for this; SURVEY.md §6 Tracing row)."""
         import jax
 
-        class _Trace:
-            def __enter__(self_inner):
-                jax.profiler.start_trace(logdir)
-                return self_inner
-
-            def __exit__(self_inner, *exc):
-                jax.profiler.stop_trace()
-                return False
-
-        return _Trace()
+        return jax.profiler.trace(logdir)
 
     # ---- persistence ----------------------------------------------------
     def save(self, path: Optional[str] = None) -> str:
