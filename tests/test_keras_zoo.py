@@ -1,0 +1,41 @@
+"""Keras model-zoo frontend tests (reference: keras_model_zoo wrapping,
+SURVEY.md §3.5)."""
+
+import jax
+import numpy as np
+
+from theanompi_tpu.models.keras_model_zoo import MnistCnn, klayers as K
+from theanompi_tpu.runtime.mesh import make_mesh
+from theanompi_tpu.runtime.recorder import Recorder
+
+
+def test_klayers_shapes():
+    model = K.Sequential()
+    model.add(K.Conv2D(8, 3, activation="relu", padding="same"))
+    model.add(K.MaxPooling2D(2))
+    model.add(K.BatchNormalization())
+    model.add(K.Flatten())
+    model.add(K.Dense(16, activation="relu"))
+    model.add(K.Dense(10))
+    params, state, out = model.init(jax.random.PRNGKey(0), (28, 28, 1))
+    assert out == (10,)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 28, 28, 1))
+    y, _ = model.apply(params, state, x, train=False)
+    assert y.shape == (4, 10)
+
+
+def test_mnist_cnn_trains():
+    mesh = make_mesh(devices=jax.devices()[:2])
+    model = MnistCnn(
+        config=dict(batch_size=16, n_synth_train=128, n_synth_val=32,
+                    print_freq=10_000),
+        mesh=mesh,
+    )
+    model.compile_train()
+    rec = Recorder(verbose=False)
+    model.reset_train_iter(0)
+    losses = [model.train_iter(i, rec)[0] for i in range(1, 5)]
+    assert np.isfinite(losses).all()
+    # dropout needs rng: implicitly checked (train=True path)
+    loss, err, err5 = model.run_validation(1, rec)
+    assert np.isfinite([loss, err, err5]).all()

@@ -1,0 +1,80 @@
+"""Preprocessing-pipeline tests (reference: ImageNet preprocessing
+scripts, SURVEY.md §3.6): image folder → raw shards → ImageNetData →
+training step."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.datasets.preprocess import (
+    decode_image,
+    preprocess_image_folder,
+    resize_bilinear,
+)
+
+
+def _write_ppm(path, arr):
+    h, w, _ = arr.shape
+    with open(path, "wb") as f:
+        f.write(f"P6\n{w} {h}\n255\n".encode())
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def _make_image_folder(root, n_per_class=24, classes=("ant", "bee")):
+    rng = np.random.RandomState(0)
+    for ci, c in enumerate(classes):
+        d = os.path.join(root, c)
+        os.makedirs(d)
+        for i in range(n_per_class):
+            img = rng.randint(0, 255, size=(40 + ci * 8, 36, 3), dtype=np.uint8)
+            if i % 2:
+                _write_ppm(os.path.join(d, f"im{i:03d}.ppm"), img)
+            else:
+                np.save(os.path.join(d, f"im{i:03d}.npy"), img)
+
+
+def test_decode_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 255, size=(8, 6, 3), dtype=np.uint8)
+    p = str(tmp_path / "x.ppm")
+    _write_ppm(p, img)
+    np.testing.assert_array_equal(decode_image(p), img)
+
+
+def test_resize_shapes_and_range():
+    img = np.full((50, 30, 3), 128, np.uint8)
+    out = resize_bilinear(img, 16)
+    assert out.shape == (16, 16, 3)
+    np.testing.assert_allclose(out, 128.0, atol=0.5)
+
+
+def test_pipeline_end_to_end(tmp_path):
+    src = str(tmp_path / "raw")
+    out = str(tmp_path / "shards")
+    os.makedirs(src)
+    _make_image_folder(src)
+    summary = preprocess_image_folder(
+        src, out, size=16, batch_size=8, val_frac=0.2, seed=0
+    )
+    assert summary["n_classes"] == 2
+    assert summary["n_batch_train"] >= 2
+    assert summary["n_batch_val"] >= 1
+    assert os.path.isfile(os.path.join(out, "img_mean.npy"))
+    mean = np.load(os.path.join(out, "img_mean.npy"))
+    assert mean.shape == (16, 16, 3)
+    with open(os.path.join(out, "labels.json")) as f:
+        assert json.load(f) == {"ant": 0, "bee": 1}
+
+    # the provider consumes the shard dir (native loader or numpy path)
+    from theanompi_tpu.data.providers import ImageNetData
+
+    data = ImageNetData(batch_size=8, data_dir=out, image_size=16, n_classes=2)
+    assert not data.synthetic
+    x, y = next(iter(data.train_batches()))
+    assert x.shape == (8, 16, 16, 3)
+    assert x.dtype == np.float32
+    assert y.shape == (8,)
+    assert set(np.unique(y)) <= {0, 1}
+    assert 0.0 <= x.min() and x.max() <= 1.0

@@ -66,8 +66,15 @@ class PrefetchLoader:
         return item
 
 
-def prefetch_to_mesh(batches, mesh, depth: int = 2):
-    """Convenience: shard each (x, y) host batch over the mesh's dp axis."""
+def prefetch_to_mesh(batches, mesh, depth: int = 2, spec=None):
+    """Convenience: shard each (x, y) host batch over the mesh.
+
+    Default places the leading dim over ``dp``; pass an explicit
+    ``PartitionSpec`` (e.g. ``P('dp','sp')``) for other layouts such as
+    the sequence-parallel transformer's token batches.
+    """
     from theanompi_tpu.runtime.mesh import shard_batch
 
-    return PrefetchLoader(batches, lambda b: shard_batch(mesh, b), depth=depth)
+    return PrefetchLoader(
+        batches, lambda b: shard_batch(mesh, b, spec=spec), depth=depth
+    )

@@ -26,6 +26,16 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 
+def _epoch_seed(epoch: int) -> int:
+    """Process-independent epoch→seed map.
+
+    ``hash()`` is randomized per interpreter (PYTHONHASHSEED), which
+    would give each host of a pod — and each resumed run — a different
+    shuffle for the same epoch; every process must derive the same
+    batch order for the global batch to be consistent."""
+    return (int(epoch) * 1_000_003 + 12345) % (2**31)
+
+
 class ArrayDataset:
     """In-RAM (x, y) with per-epoch shuffle and global-batch iteration."""
 
@@ -50,7 +60,7 @@ class ArrayDataset:
         """Per-epoch reshuffle. Pass ``epoch`` for resumable determinism
         (resume = re-seed and fast-forward; SURVEY.md §6 checkpoint row)."""
         if epoch is not None:
-            rng = np.random.RandomState(hash(("epoch", epoch)) % (2**31))
+            rng = np.random.RandomState(_epoch_seed(epoch))
             self._order = rng.permutation(len(self.x_train))
         else:
             self._order = self._rng.permutation(len(self.x_train))
@@ -161,6 +171,185 @@ class Cifar10Data:
         return self.dataset.n_batch_val
 
 
+class MnistData:
+    """MNIST provider (for the Keras model-zoo models).
+
+    Reads the standard idx files from ``data_dir`` (or ``MNIST_DIR``)
+    when present; synthetic class-conditional fallback otherwise, same
+    policy as the other providers.
+    """
+
+    shape = (28, 28, 1)
+    n_classes = 10
+
+    def __init__(
+        self,
+        batch_size: int,
+        data_dir: Optional[str] = None,
+        n_synth_train: int = 4096,
+        n_synth_val: int = 512,
+        seed: int = 0,
+    ):
+        data_dir = data_dir or os.environ.get("MNIST_DIR", "")
+        loaded = self._try_load_idx(data_dir) if data_dir else None
+        if loaded is not None:
+            xtr, ytr, xva, yva = loaded
+            self.synthetic = False
+        else:
+            xtr, ytr = _synthetic_classification(
+                n_synth_train, self.shape, self.n_classes, seed
+            )
+            xva, yva = _synthetic_classification(
+                n_synth_val, self.shape, self.n_classes, seed + 1
+            )
+            self.synthetic = True
+        self.dataset = ArrayDataset(xtr, ytr, xva, yva, batch_size, seed)
+
+    @staticmethod
+    def _try_load_idx(data_dir: str):
+        def read_images(path):
+            with open(path, "rb") as f:
+                buf = f.read()
+            n = int.from_bytes(buf[4:8], "big")
+            x = np.frombuffer(buf, np.uint8, offset=16).reshape(n, 28, 28, 1)
+            return x.astype(np.float32) / 255.0
+
+        def read_labels(path):
+            with open(path, "rb") as f:
+                buf = f.read()
+            return np.frombuffer(buf, np.uint8, offset=8).astype(np.int32)
+
+        try:
+            return (
+                read_images(os.path.join(data_dir, "train-images-idx3-ubyte")),
+                read_labels(os.path.join(data_dir, "train-labels-idx1-ubyte")),
+                read_images(os.path.join(data_dir, "t10k-images-idx3-ubyte")),
+                read_labels(os.path.join(data_dir, "t10k-labels-idx1-ubyte")),
+            )
+        except (OSError, ValueError):  # missing OR truncated/malformed files
+            return None
+
+    def shuffle(self, epoch=None):
+        self.dataset.shuffle(epoch)
+
+    def train_batches(self):
+        return self.dataset.train_batches()
+
+    def val_batches(self):
+        return self.dataset.val_batches()
+
+    @property
+    def n_batch_train(self):
+        return self.dataset.n_batch_train
+
+    @property
+    def n_batch_val(self):
+        return self.dataset.n_batch_val
+
+
+class LMTextData:
+    """Language-modeling token provider for the long-context transformer.
+
+    No reference analog (the reference is a 2016 CNN framework —
+    SURVEY.md §3.4); the contract matches the other providers (shuffle /
+    train_batches / val_batches / n_batch_*) so the BSP worker drives it
+    unchanged. Yields ``(tokens, next_tokens)`` int32 pairs of shape
+    ``(batch, seq_len)``.
+
+    Real data: a ``tokens.npy`` (or raw ``.bin`` uint16/int32) corpus in
+    ``data_dir``, consumed as contiguous windows. Fallback: a synthetic
+    order-2 Markov byte stream — learnable structure, so convergence
+    tests and benches are meaningful.
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        seq_len: int,
+        vocab_size: int = 256,
+        data_dir: Optional[str] = None,
+        n_synth_train: int = 64,
+        n_synth_val: int = 4,
+        seed: int = 0,
+    ):
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.vocab_size = int(vocab_size)
+        self._rng = np.random.RandomState(seed)
+        tokens = self._try_load(data_dir) if data_dir else None
+        if tokens is None:
+            tokens = self._synth_markov(
+                (n_synth_train + n_synth_val) * self.batch_size * (self.seq_len + 1),
+                seed,
+            )
+            self.synthetic = True
+        else:
+            self.synthetic = False
+        win = self.seq_len + 1  # +1: targets are inputs shifted by one
+        n_windows = len(tokens) // win
+        self._windows = tokens[: n_windows * win].reshape(n_windows, win)
+        # val split in whole global batches (a ragged batch would not
+        # shard over the mesh), leaving at least one train batch
+        n_val = max(1, min(n_windows // 16, n_synth_val)) * self.batch_size
+        if n_val + self.batch_size > n_windows:
+            n_val = max(0, n_windows - self.batch_size)
+        n_val -= n_val % self.batch_size
+        self._val = self._windows[:n_val]
+        self._train = self._windows[n_val:]
+        self.n_batch_train = len(self._train) // self.batch_size
+        self.n_batch_val = len(self._val) // self.batch_size
+        if self.n_batch_train == 0:
+            raise ValueError(
+                f"corpus too small: need ≥ {self.batch_size * win} tokens "
+                f"for one global batch (batch {self.batch_size} × window "
+                f"{win}), have {n_windows * win}"
+            )
+        self._order = np.arange(len(self._train))
+
+    def _try_load(self, data_dir: str):
+        for name, dtype in (("tokens.npy", None), ("tokens.bin", np.uint16)):
+            p = os.path.join(data_dir, name)
+            if os.path.isfile(p):
+                t = np.load(p) if dtype is None else np.fromfile(p, dtype=dtype)
+                return t.astype(np.int32) % self.vocab_size
+        return None
+
+    def _synth_markov(self, n: int, seed: int) -> np.ndarray:
+        """Learnable synthetic stream, fully vectorized.
+
+        A deterministic affine walk ``clean[i] = (start + i·a) mod v``
+        (so next-token is the learnable map ``t → (t+a) mod v``) with
+        10% uniform replacement noise. Vectorized because the advertised
+        long-context sizes make a per-token Python loop (an earlier
+        order-2 Markov sampler) take minutes inside model __init__."""
+        rng = np.random.RandomState(seed)
+        v = self.vocab_size
+        a = int(rng.randint(1, v))
+        clean = (int(rng.randint(0, v)) + np.arange(n, dtype=np.int64) * a) % v
+        noise = rng.rand(n) < 0.1
+        out = np.where(noise, rng.randint(0, v, size=n), clean)
+        return out.astype(np.int32)
+
+    def shuffle(self, epoch=None):
+        if epoch is not None:
+            rng = np.random.RandomState(_epoch_seed(epoch))
+            self._order = rng.permutation(len(self._train))
+        else:
+            self._order = self._rng.permutation(len(self._train))
+
+    def train_batches(self):
+        bs = self.batch_size
+        for i in range(self.n_batch_train):
+            w = self._train[self._order[i * bs : (i + 1) * bs]]
+            yield w[:, :-1].copy(), w[:, 1:].copy()
+
+    def val_batches(self):
+        bs = self.batch_size
+        for i in range(self.n_batch_val):
+            w = self._val[i * bs : (i + 1) * bs]
+            yield w[:, :-1].copy(), w[:, 1:].copy()
+
+
 class ImageNetData:
     """ImageNet-style provider over pre-processed ``.npz`` shard files.
 
@@ -238,7 +427,7 @@ class ImageNetData:
 
     def shuffle(self, epoch=None):
         if epoch is not None:
-            rng = np.random.RandomState(hash(("epoch", epoch)) % (2**31))
+            rng = np.random.RandomState(_epoch_seed(epoch))
             self._order = rng.permutation(len(self.train_files))
         else:
             self._order = self._rng.permutation(len(self.train_files))

@@ -1,0 +1,3 @@
+"""Dataset preparation tools (reference: the preprocessing scripts that
+turned raw ImageNet JPEGs into pre-processed ``.hkl`` batch files, label
+arrays and the image mean; SURVEY.md §3.6)."""

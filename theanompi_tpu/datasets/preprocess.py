@@ -1,0 +1,213 @@
+"""Raw image folders → pre-processed training shards.
+
+Reference analog (SURVEY.md §3.6 "Preprocessing scripts"): Theano-MPI's
+pipeline turned raw ImageNet JPEGs into fixed-size pre-processed batch
+files (``.hkl``), a label array, and the training-set image mean, which
+the data layer then streamed per rank. This module is the same stage for
+the TPU framework, targeting the **raw shard** format the native C++
+ring loader reads (``data.shards``: flat ``[x f32 | y i32]`` files +
+``meta.json``), plus ``img_mean.npy`` and ``labels.json``.
+
+Layout expected at ``src``: one subdirectory per class (the torchvision
+``ImageFolder`` convention, equivalent to ImageNet's synset dirs)::
+
+    src/cat/xxx.jpg
+    src/dog/yyy.png
+
+Output::
+
+    out/train/shard_*.raw + meta.json
+    out/val/shard_*.raw   + meta.json      (val_frac split)
+    out/img_mean.npy                        (H,W,C float32, train mean)
+    out/labels.json                         (class name -> int id)
+
+Decoding uses Pillow when present; ``.npy`` per-image arrays and binary
+``.ppm`` (P6) are decoded with pure NumPy so the pipeline (and its test)
+has no hard image-library dependency. Batches whose final slice would be
+ragged are dropped (the reference likewise wrote fixed-size batches).
+
+CLI::
+
+    python -m theanompi_tpu.datasets.preprocess \
+        --src /data/imagenet_raw --out /data/imagenet_shards \
+        --size 128 --batch-size 256 --val-frac 0.02
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".npy")
+
+
+def _decode_ppm(path: str) -> np.ndarray:
+    """Binary PPM (P6), pure NumPy."""
+    with open(path, "rb") as f:
+        data = f.read()
+    # header: magic, width, height, maxval — whitespace/comment separated
+    tokens: List[bytes] = []
+    i = 0
+    while len(tokens) < 4:
+        while i < len(data) and data[i : i + 1].isspace():
+            i += 1
+        if data[i : i + 1] == b"#":
+            while i < len(data) and data[i : i + 1] != b"\n":
+                i += 1
+            continue
+        j = i
+        while j < len(data) and not data[j : j + 1].isspace():
+            j += 1
+        tokens.append(data[i:j])
+        i = j
+    if tokens[0] != b"P6":
+        raise ValueError(f"{path}: not a binary PPM")
+    w, h = int(tokens[1]), int(tokens[2])
+    px = np.frombuffer(data, np.uint8, count=w * h * 3, offset=i + 1)
+    return px.reshape(h, w, 3)
+
+
+def decode_image(path: str) -> np.ndarray:
+    """→ (H, W, 3) uint8."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npy":
+        arr = np.load(path)
+        if arr.ndim == 2:
+            arr = np.stack([arr] * 3, axis=-1)
+        return arr.astype(np.uint8)
+    if ext == ".ppm":
+        return _decode_ppm(path)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            f"decoding {ext} needs Pillow; convert to .npy/.ppm instead"
+        ) from e
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"), np.uint8)
+
+
+def resize_bilinear(img: np.ndarray, size: int) -> np.ndarray:
+    """Aspect-preserving shorter-side resize + center crop to (size, size).
+
+    The reference pipeline resized then center-cropped its ImageNet
+    images the same way. Pure NumPy bilinear so no image library is
+    load-bearing.
+    """
+    h, w, c = img.shape
+    scale = size / min(h, w)
+    nh, nw = max(size, int(round(h * scale))), max(size, int(round(w * scale)))
+    # bilinear sample grid
+    ys = (np.arange(nh) + 0.5) * h / nh - 0.5
+    xs = (np.arange(nw) + 0.5) * w / nw - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    img_f = img.astype(np.float32)
+    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    # center crop
+    oy, ox = (nh - size) // 2, (nw - size) // 2
+    return out[oy : oy + size, ox : ox + size]
+
+
+def list_image_folder(src: str) -> Tuple[List[Tuple[str, int]], dict]:
+    """(path, label) pairs + class-name → id map, classes sorted."""
+    classes = sorted(
+        d for d in os.listdir(src) if os.path.isdir(os.path.join(src, d))
+    )
+    if not classes:
+        raise ValueError(f"{src}: no class subdirectories")
+    label_map = {c: i for i, c in enumerate(classes)}
+    samples = []
+    for c in classes:
+        cdir = os.path.join(src, c)
+        for f in sorted(os.listdir(cdir)):
+            if f.lower().endswith(IMG_EXTS):
+                samples.append((os.path.join(cdir, f), label_map[c]))
+    if not samples:
+        raise ValueError(f"{src}: no images with extensions {IMG_EXTS}")
+    return samples, label_map
+
+
+def preprocess_image_folder(
+    src: str,
+    out: str,
+    size: int = 128,
+    batch_size: int = 256,
+    val_frac: float = 0.02,
+    seed: int = 0,
+    scale_to_unit: bool = True,
+) -> dict:
+    """Run the full pipeline; returns a summary dict (also written as
+    ``out/prep_summary.json``)."""
+    from theanompi_tpu.data.shards import write_shard_dir
+
+    samples, label_map = list_image_folder(src)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(samples))
+    n_val = int(len(samples) * val_frac)
+    splits = {"val": order[:n_val], "train": order[n_val:]}
+
+    os.makedirs(out, exist_ok=True)
+    summary = {"size": size, "batch_size": batch_size, "n_classes": len(label_map)}
+    mean_acc: Optional[np.ndarray] = None
+    n_mean = 0
+    for split, idxs in splits.items():
+        batches = []
+        for start in range(0, len(idxs) - batch_size + 1, batch_size):
+            xs, ys = [], []
+            for i in idxs[start : start + batch_size]:
+                path, label = samples[i]
+                img = resize_bilinear(decode_image(path), size)
+                if scale_to_unit:
+                    img = img / 255.0
+                xs.append(img.astype(np.float32))
+                ys.append(label)
+            x = np.stack(xs)
+            y = np.asarray(ys, np.int32)
+            if split == "train":
+                s = x.sum(axis=0)
+                mean_acc = s if mean_acc is None else mean_acc + s
+                n_mean += len(x)
+            batches.append((x, y))
+        if batches:
+            write_shard_dir(os.path.join(out, split), batches)
+        summary[f"n_batch_{split}"] = len(batches)
+        summary[f"n_dropped_{split}"] = len(idxs) - len(batches) * batch_size
+    if mean_acc is not None and n_mean:
+        np.save(os.path.join(out, "img_mean.npy"), (mean_acc / n_mean).astype(np.float32))
+    with open(os.path.join(out, "labels.json"), "w") as f:
+        json.dump(label_map, f, indent=0, sort_keys=True)
+    with open(os.path.join(out, "prep_summary.json"), "w") as f:
+        json.dump(summary, f, indent=0, sort_keys=True)
+    return summary
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--src", required=True, help="class-per-subdir image root")
+    ap.add_argument("--out", required=True, help="output shard root")
+    ap.add_argument("--size", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--val-frac", type=float, default=0.02)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    summary = preprocess_image_folder(
+        args.src, args.out,
+        size=args.size, batch_size=args.batch_size,
+        val_frac=args.val_frac, seed=args.seed,
+    )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

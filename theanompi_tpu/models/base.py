@@ -59,6 +59,12 @@ COMMON_DEFAULTS = dict(
 
 class TpuModel:
     default_config: dict = {}
+    # Sharding surface of the step function. Plain data-parallel models
+    # keep the defaults (batch over 'dp', exchange over 'dp'); the
+    # sequence-parallel transformer overrides both (batch over 'dp',
+    # sequence over 'sp', exchange over ('dp','sp')).
+    batch_spec = P(DATA_AXIS)
+    exchange_axes = DATA_AXIS
 
     def __init__(self, config: Optional[dict] = None, mesh=None, **overrides):
         self.config = Config(COMMON_DEFAULTS)
@@ -93,6 +99,16 @@ class TpuModel:
     # ------------------------------------------------------------------
     # subclass hooks
     # ------------------------------------------------------------------
+    @classmethod
+    def build_mesh(cls, devices=None, config: Optional[dict] = None):
+        """Mesh the rules should build for this model class.
+
+        Plain data-parallel models use one ``dp`` axis; models with
+        extra mesh axes (the sequence-parallel transformer) override so
+        ``rule.init(...)`` engages them without the caller hand-building
+        a mesh."""
+        return make_mesh(devices=devices)
+
     def build_data(self) -> None:
         raise NotImplementedError
 
@@ -142,7 +158,9 @@ class TpuModel:
     # ------------------------------------------------------------------
     def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
         cfg = self.config
-        exchanger = exchanger or BSP_Exchanger(strategy=cfg.exch_strategy)
+        exchanger = exchanger or BSP_Exchanger(
+            strategy=cfg.exch_strategy, axis=self.exchange_axes
+        )
         axis = exchanger.axis
         opt = self.optimizer
         sync_mode = cfg.sync_mode
@@ -189,7 +207,7 @@ class TpuModel:
         mapped = jax.shard_map(
             shard_step,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            in_specs=(P(), P(), P(), self.batch_spec, self.batch_spec, P()),
             out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
         )
@@ -198,20 +216,22 @@ class TpuModel:
         return self.train_fn
 
     def compile_val(self):
+        axes = self.exchange_axes
+
         def shard_eval(params, net_state, x, y):
             loss, (err, err5, _) = self.loss_and_metrics(
                 params, net_state, x, y, False, None
             )
             return (
-                lax.pmean(loss, DATA_AXIS),
-                lax.pmean(err, DATA_AXIS),
-                lax.pmean(err5, DATA_AXIS),
+                lax.pmean(loss, axes),
+                lax.pmean(err, axes),
+                lax.pmean(err5, axes),
             )
 
         mapped = jax.shard_map(
             shard_eval,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(P(), P(), self.batch_spec, self.batch_spec),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
@@ -224,11 +244,16 @@ class TpuModel:
     def reset_train_iter(self, epoch: int) -> None:
         self.data.shuffle(epoch)
         self._train_it = prefetch_to_mesh(
-            self.data.train_batches(), self.mesh, depth=int(self.config.prefetch_depth)
+            self.data.train_batches(),
+            self.mesh,
+            depth=int(self.config.prefetch_depth),
+            spec=self.batch_spec,
         )
 
     def reset_val_iter(self) -> None:
-        self._val_it = prefetch_to_mesh(self.data.val_batches(), self.mesh, depth=1)
+        self._val_it = prefetch_to_mesh(
+            self.data.val_batches(), self.mesh, depth=1, spec=self.batch_spec
+        )
 
     def train_iter(self, count: int, recorder) -> Tuple[float, float]:
         if self.train_fn is None:

@@ -1,0 +1,13 @@
+"""Keras model zoo — path-compat namespace + Keras-spelled frontend.
+
+Reference analog: upstream ``theanompi/models/keras_model_zoo/`` (models
+written against Keras, wrapped into the model contract; SURVEY.md §3.5).
+``klayers`` is the Keras-spelled layer frontend; models import by the
+reference-style path::
+
+    rule.init(modelfile='theanompi_tpu.models.keras_model_zoo',
+              modelclass='MnistCnn')
+"""
+
+from theanompi_tpu.models.keras_model_zoo import klayers  # noqa: F401
+from theanompi_tpu.models.keras_model_zoo.mnist_cnn import MnistCnn  # noqa: F401

@@ -1,0 +1,101 @@
+"""Keras-spelled layer constructors over the native layer library.
+
+The reference ships a small Keras model zoo — models written against the
+Keras(Theano-backend) layer API, wrapped into the framework's model
+contract (upstream ``theanompi/models/keras_model_zoo/``; SURVEY.md
+§3.5). There is no Keras here; this module reproduces the *frontend*:
+Keras-spelled constructors (``Conv2D``, ``MaxPooling2D``, ``Dense(...,
+activation=...)``) that build the same ``ops.layers`` descriptors every
+other model uses, so Keras-era model definitions port line-for-line.
+
+Only the spelling is Keras; init semantics, NHWC layout, bf16 handling
+and the params/state pytree contract are the native library's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+
+from theanompi_tpu.ops import layers as L
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "tanh": jax.numpy.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": None,  # final-layer softmax lives in the loss (from_logits)
+    "linear": None,
+    None: None,
+}
+
+
+def _activation_layers(activation):
+    if activation not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation {activation!r}")
+    fn = _ACTIVATIONS[activation]
+    return [L.Activation(fn)] if fn is not None else []
+
+
+def _maybe_seq(layers: list):
+    return layers[0] if len(layers) == 1 else L.Sequential(layers)
+
+
+def Dense(units: int, activation: Optional[str] = None, use_bias: bool = True):
+    return _maybe_seq([L.Dense(units, use_bias=use_bias), *_activation_layers(activation)])
+
+
+def Conv2D(
+    filters: int,
+    kernel_size: Union[int, Tuple[int, int]],
+    strides: Union[int, Tuple[int, int]] = 1,
+    padding: str = "same",
+    activation: Optional[str] = None,
+    use_bias: bool = True,
+):
+    conv = L.Conv2d(
+        filters, kernel_size, stride=strides, padding=padding.upper(), use_bias=use_bias
+    )
+    return _maybe_seq([conv, *_activation_layers(activation)])
+
+
+def MaxPooling2D(pool_size=2, strides=None, padding: str = "valid"):
+    return L.MaxPool(pool_size, stride=strides, padding=padding.upper())
+
+
+def AveragePooling2D(pool_size=2, strides=None, padding: str = "valid"):
+    return L.AvgPool(pool_size, stride=strides, padding=padding.upper())
+
+
+def GlobalAveragePooling2D():
+    return L.GlobalAvgPool()
+
+
+def BatchNormalization(momentum: float = 0.99, epsilon: float = 1e-3):
+    return L.BatchNorm(momentum=momentum, eps=epsilon)
+
+
+def Dropout(rate: float):
+    return L.Dropout(rate)
+
+
+def Flatten():
+    return L.Flatten()
+
+
+def Activation(name: str):
+    fn = _ACTIVATIONS[name]
+    if fn is None:
+        raise ValueError(f"activation {name!r} has no standalone layer form")
+    return L.Activation(fn)
+
+
+class Sequential(L.Sequential):
+    """Keras-style incremental container: ``model.add(layer)``."""
+
+    def __init__(self, layers: Optional[Sequence] = None):
+        super().__init__(list(layers or []))
+
+    def add(self, layer):
+        self.layers.append(layer)

@@ -1,0 +1,55 @@
+"""Keras-zoo MNIST CNN.
+
+Reference analog: the small Keras(Theano-backend) models in upstream
+``theanompi/models/keras_model_zoo/`` wrapped into the model contract
+(SURVEY.md §3.5, LOW-confidence layout). This is the classic Keras
+``mnist_cnn`` topology written against the Keras-spelled frontend
+(``klayers``) — the definition reads like the Keras original while
+compiling to the same jitted BSP step as every native model.
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu.data.providers import MnistData
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.models.keras_model_zoo import klayers as K
+from theanompi_tpu.ops import optim
+
+
+class MnistCnn(TpuModel):
+    default_config = dict(
+        batch_size=128,
+        n_epochs=12,
+        lr=0.05,
+        momentum=0.9,
+        weight_decay=0.0,
+        dropout1=0.25,
+        dropout2=0.5,
+        data_dir=None,
+        n_synth_train=4096,
+        n_synth_val=512,
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = MnistData(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        model = K.Sequential()
+        model.add(K.Conv2D(32, 3, activation="relu", padding="valid"))
+        model.add(K.Conv2D(64, 3, activation="relu", padding="valid"))
+        model.add(K.MaxPooling2D(pool_size=2))
+        model.add(K.Dropout(float(cfg.dropout1)))
+        model.add(K.Flatten())
+        model.add(K.Dense(128, activation="relu"))
+        model.add(K.Dropout(float(cfg.dropout2)))
+        model.add(K.Dense(10))
+        self.lr_schedule = optim.constant(float(cfg.lr))
+        return model, MnistData.shape

@@ -1,0 +1,168 @@
+"""Long-context decoder-only transformer LM with ring sequence parallelism.
+
+No reference analog — Theano-MPI's zoo is 2016 CNNs/GAN (SURVEY.md §3.4,
+§6: long-context "ABSENT") — but long-context training is first-class in
+this framework, so the model demonstrates the full sharding surface:
+
+- batch over the ``dp`` mesh axis (the reference's data parallelism),
+- sequence over the ``sp`` mesh axis with exact **ring attention**
+  (``parallel.ring_attention``: K/V blocks rotate over ICI neighbor
+  links via ``ppermute`` while each device keeps its query shard),
+- gradients reduced over *both* axes in-graph through the standard
+  ``BSP_Exchanger`` (every device holds a partial batch × sequence
+  gradient contribution).
+
+It implements the unchanged model contract, so ``BSP`` drives it like
+any CNN::
+
+    from theanompi_tpu import BSP
+    rule = BSP()
+    rule.init(devices=8,
+              modelfile='theanompi_tpu.models.transformer',
+              modelclass='TransformerLM',
+              model_config=dict(sp=4, seq_len=8192))
+    rule.wait()
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.data.providers import LMTextData
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.ops import attention as A
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import losses, optim
+from theanompi_tpu.parallel.ring_attention import SEQ_AXIS
+from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh
+
+
+class TransformerLM(TpuModel):
+    default_config = dict(
+        batch_size=8,  # per dp shard
+        seq_len=512,  # GLOBAL sequence length (sharded over sp)
+        vocab_size=256,
+        d_model=256,
+        n_heads=8,
+        n_layers=4,
+        mlp_ratio=4,
+        sp=1,  # sequence-parallel degree (mesh sp-axis size)
+        lr=0.1,
+        momentum=0.9,
+        weight_decay=0.0,
+        n_epochs=5,
+        lr_boundaries=(3,),
+        data_dir=None,
+        n_synth_train=32,
+        n_synth_val=2,
+        val_top5=True,
+        exch_strategy="bf16",
+    )
+
+    @classmethod
+    def build_mesh(cls, devices=None, config=None):
+        cfg = dict(cls.default_config)
+        cfg.update(dict(config or {}))
+        sp = int(cfg.get("sp", 1))
+        devices = list(devices) if devices is not None else jax.devices()
+        if len(devices) % sp:
+            raise ValueError(f"sp={sp} does not divide {len(devices)} devices")
+        return make_mesh(
+            shape=(len(devices) // sp, sp),
+            axis_names=(DATA_AXIS, SEQ_AXIS),
+            devices=devices,
+        )
+
+    def __init__(self, config=None, mesh=None, **overrides):
+        cfg = dict(self.default_config)
+        cfg.update(dict(config or {}))
+        cfg.update(overrides)
+        sp = int(cfg.get("sp", 1))
+        if mesh is None:
+            mesh = self.build_mesh(config=cfg)
+        elif SEQ_AXIS not in mesh.axis_names:
+            if sp > 1:
+                # an explicit dp-only mesh must not silently discard the
+                # requested sequence parallelism (dense attention at long
+                # seq_len would OOM where the user asked for ring)
+                raise ValueError(
+                    f"config sp={sp} but the given mesh has no "
+                    f"'{SEQ_AXIS}' axis ({mesh.axis_names}); build it with "
+                    f"{type(self).__name__}.build_mesh(...)"
+                )
+        elif sp > 1 and int(mesh.shape[SEQ_AXIS]) != sp:
+            raise ValueError(
+                f"config sp={sp} != mesh {SEQ_AXIS} size {mesh.shape[SEQ_AXIS]}"
+            )
+        if SEQ_AXIS in mesh.axis_names:
+            self.sp_size = int(mesh.shape[SEQ_AXIS])
+            # tokens: (batch over dp, sequence over sp); grads contribute
+            # from every (dp, sp) shard, so the exchange reduces over both
+            self.batch_spec = P(DATA_AXIS, SEQ_AXIS)
+            self.exchange_axes = (DATA_AXIS, SEQ_AXIS)
+        else:
+            self.sp_size = 1
+        super().__init__(cfg, mesh=mesh)  # cfg = defaults + config + overrides
+
+    def build_data(self):
+        cfg = self.config
+        if int(cfg.seq_len) % self.sp_size:
+            raise ValueError(
+                f"seq_len {cfg.seq_len} not divisible by sp={self.sp_size}"
+            )
+        self.data = LMTextData(
+            batch_size=self.global_batch,
+            seq_len=int(cfg.seq_len),
+            vocab_size=int(cfg.vocab_size),
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+        sp_axis = SEQ_AXIS if self.sp_size > 1 else None
+        t_local = int(cfg.seq_len) // self.sp_size
+        d = int(cfg.d_model)
+        net = L.Sequential(
+            [
+                A.Embedding(int(cfg.vocab_size), d),
+                A.PositionalEmbedding(int(cfg.seq_len), sp_axis=sp_axis),
+                *[
+                    A.TransformerBlock(
+                        int(cfg.n_heads),
+                        mlp_ratio=int(cfg.mlp_ratio),
+                        causal=True,
+                        sp_axis=sp_axis,
+                        sp_size=self.sp_size,
+                        compute_dtype=dt,
+                    )
+                    for _ in range(int(cfg.n_layers))
+                ],
+                A.LayerNorm(),
+                L.Dense(int(cfg.vocab_size), compute_dtype=dt),
+            ]
+        )
+        self.lr_schedule = optim.step_decay(
+            float(cfg.lr), list(cfg.lr_boundaries), 0.1
+        )
+        return net, (t_local,)
+
+    def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
+        # x, y: int32 (B, T_local) token shards; flatten tokens so the
+        # shared classification losses apply per-token
+        logits, new_state = self.net.apply(params, net_state, x, train=train, rng=rng)
+        v = logits.shape[-1]
+        flat_logits = logits.reshape(-1, v)
+        flat_y = y.reshape(-1)
+        loss = losses.softmax_cross_entropy(flat_logits, flat_y)
+        err = losses.classification_error(flat_logits, flat_y)
+        if self.config.val_top5 and v > 5:
+            err5 = losses.topk_error(flat_logits, flat_y, k=5)
+        else:
+            err5 = err
+        return loss, (err, err5, new_state)

@@ -1,0 +1,229 @@
+"""Attention / transformer layers for the functional layer library.
+
+These extend ``ops.layers`` with the building blocks of a long-context
+transformer. The reference has no attention (SURVEY.md §3.4), so there
+is no reference analog to cite — the contract and style follow
+``layers2``-derived ``ops.layers``, and the sequence-parallel path runs
+``parallel.ring_attention`` over the ``sp`` mesh axis when the layer is
+applied inside ``shard_map``.
+
+Per the library convention, ``in_shape``/``out_shape`` exclude the batch
+dimension: token inputs are ``(T,)`` int32, activations ``(T, D)``.
+When sequence parallelism is active, ``T`` here is the *local* shard
+length and position-dependent layers recover global positions from
+``lax.axis_index(sp_axis)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.ops.layers import Layer, normal_init
+from theanompi_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+class LayerNorm(Layer):
+    """Layer normalization over the feature (last) dimension, fp32 stats."""
+
+    def __init__(self, eps: float = 1e-5):
+        self.eps = eps
+
+    def init(self, key, in_shape):
+        d = in_shape[-1]
+        params = {
+            "scale": jnp.ones((d,), jnp.float32),
+            "bias": jnp.zeros((d,), jnp.float32),
+        }
+        return params, {}, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), state
+
+
+class Embedding(Layer):
+    """Token embedding: int32 ``(T,)`` → ``(T, D)``."""
+
+    def __init__(self, vocab_size: int, features: int, w_init=None):
+        self.vocab_size = vocab_size
+        self.features = features
+        self.w_init = w_init or normal_init(0.02)
+
+    def init(self, key, in_shape):
+        params = {
+            "table": self.w_init(
+                key, (self.vocab_size, self.features), self.features
+            )
+        }
+        return params, {}, (*in_shape, self.features)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return jnp.take(params["table"], x, axis=0), state
+
+
+class PositionalEmbedding(Layer):
+    """Learned absolute positions, sequence-parallel aware.
+
+    ``max_len`` is the *global* maximum sequence length. Under sequence
+    parallelism (``sp_axis`` given and in scope), the local shard of
+    length T covers global rows ``[idx·T, (idx+1)·T)`` of the table.
+    """
+
+    def __init__(self, max_len: int, sp_axis: Optional[str] = None):
+        self.max_len = max_len
+        self.sp_axis = sp_axis
+
+    def init(self, key, in_shape):
+        t, d = in_shape
+        params = {"pos": normal_init(0.02)(key, (self.max_len, d), d)}
+        return params, {}, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        t = x.shape[1]
+        offset = 0
+        if self.sp_axis is not None:
+            offset = lax.axis_index(self.sp_axis) * t
+        pos = lax.dynamic_slice_in_dim(params["pos"], offset, t, axis=0)
+        return x + pos, state
+
+
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention with optional ring sequence parallelism.
+
+    ``sp_axis``/``sp_size`` select the path statically at trace time:
+    ``sp_size == 1`` (or ``sp_axis=None``) runs dense single-shard
+    attention; otherwise K/V circulate the ring
+    (``parallel.ring_attention``) and the layer must be applied inside a
+    ``shard_map`` that has ``sp_axis`` in scope with the sequence dim
+    sharded over it.
+    """
+
+    def __init__(
+        self,
+        n_heads: int,
+        causal: bool = True,
+        sp_axis: Optional[str] = None,
+        sp_size: int = 1,
+        compute_dtype: Optional[jnp.dtype] = None,
+    ):
+        self.n_heads = n_heads
+        self.causal = causal
+        self.sp_axis = sp_axis
+        self.sp_size = sp_size
+        self.compute_dtype = compute_dtype
+
+    def init(self, key, in_shape):
+        t, d = in_shape
+        if d % self.n_heads:
+            raise ValueError(f"d_model {d} not divisible by n_heads {self.n_heads}")
+        keys = jax.random.split(key, 4)
+        std = 1.0 / math.sqrt(d)
+        init = normal_init(std)
+        params = {
+            "wq": init(keys[0], (d, d), d),
+            "wk": init(keys[1], (d, d), d),
+            "wv": init(keys[2], (d, d), d),
+            "wo": init(keys[3], (d, d), d),
+        }
+        return params, {}, in_shape
+
+    def _proj(self, x, w):
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            w = w.astype(self.compute_dtype)
+        return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        b, t, d = x.shape
+        h = self.n_heads
+        hd = d // h
+        q = self._proj(x, params["wq"]).reshape(b, t, h, hd)
+        k = self._proj(x, params["wk"]).reshape(b, t, h, hd)
+        v = self._proj(x, params["wv"]).reshape(b, t, h, hd)
+        if self.compute_dtype is not None:
+            q, k, v = (a.astype(self.compute_dtype) for a in (q, k, v))
+        if self.sp_axis is not None and self.sp_size > 1:
+            o = ring_attention(
+                q, k, v,
+                axis_name=self.sp_axis,
+                axis_size=self.sp_size,
+                causal=self.causal,
+            )
+        else:
+            o = full_attention(q, k, v, causal=self.causal)
+        y = self._proj(o.reshape(b, t, d), params["wo"])
+        return y.astype(jnp.float32), state
+
+
+class TransformerBlock(Layer):
+    """Pre-LN decoder block: LN→MHA→residual, LN→MLP(GELU)→residual."""
+
+    def __init__(
+        self,
+        n_heads: int,
+        mlp_ratio: int = 4,
+        causal: bool = True,
+        sp_axis: Optional[str] = None,
+        sp_size: int = 1,
+        compute_dtype: Optional[jnp.dtype] = None,
+    ):
+        self.ln1 = LayerNorm()
+        self.ln2 = LayerNorm()
+        self.attn = MultiHeadAttention(
+            n_heads, causal=causal, sp_axis=sp_axis, sp_size=sp_size,
+            compute_dtype=compute_dtype,
+        )
+        self.mlp_ratio = mlp_ratio
+        self.compute_dtype = compute_dtype
+
+    def init(self, key, in_shape):
+        t, d = in_shape
+        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+        p1, _, _ = self.ln1.init(k1, in_shape)
+        pa, _, _ = self.attn.init(k2, in_shape)
+        p2, _, _ = self.ln2.init(k3, in_shape)
+        dm = d * self.mlp_ratio
+        params = {
+            "ln1": p1,
+            "attn": pa,
+            "ln2": p2,
+            "mlp_in": {
+                "w": normal_init(1.0 / math.sqrt(d))(k4, (d, dm), d),
+                "b": jnp.zeros((dm,), jnp.float32),
+            },
+            "mlp_out": {
+                "w": normal_init(1.0 / math.sqrt(dm))(k5, (dm, d), dm),
+                "b": jnp.zeros((d,), jnp.float32),
+            },
+        }
+        return params, {}, in_shape
+
+    def _mlp(self, params, x):
+        w1, w2 = params["mlp_in"]["w"], params["mlp_out"]["w"]
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            w1 = w1.astype(self.compute_dtype)
+            w2 = w2.astype(self.compute_dtype)
+        hmid = jnp.dot(x, w1, preferred_element_type=jnp.float32)
+        hmid = jax.nn.gelu(hmid + params["mlp_in"]["b"])
+        if self.compute_dtype is not None:
+            hmid = hmid.astype(self.compute_dtype)
+        y = jnp.dot(hmid, w2, preferred_element_type=jnp.float32)
+        return y + params["mlp_out"]["b"]
+
+    def apply(self, params, state, x, train=False, rng=None):
+        h1, _ = self.ln1.apply(params["ln1"], {}, x)
+        a, _ = self.attn.apply(params["attn"], {}, h1, train=train, rng=rng)
+        x = x + a
+        h2, _ = self.ln2.apply(params["ln2"], {}, x)
+        x = x + self._mlp(params, h2)
+        return x, state

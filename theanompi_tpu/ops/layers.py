@@ -149,7 +149,9 @@ class Dense(Layer):
         self.compute_dtype = compute_dtype
 
     def init(self, key, in_shape):
-        (d,) = in_shape
+        # acts on the last dim; leading per-example dims (e.g. the
+        # transformer's sequence axis) pass through untouched
+        d = in_shape[-1]
         init = self.w_init or (
             lambda k, s, fi, dtype=jnp.float32: xavier_uniform(
                 k, s, fi, self.features, dtype
@@ -158,7 +160,7 @@ class Dense(Layer):
         params = {"w": init(key, (d, self.features), d)}
         if self.use_bias:
             params["b"] = jnp.zeros((self.features,), jnp.float32)
-        return params, {}, (self.features,)
+        return params, {}, (*in_shape[:-1], self.features)
 
     def apply(self, params, state, x, train=False, rng=None):
         w = params["w"]

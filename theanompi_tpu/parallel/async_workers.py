@@ -97,7 +97,9 @@ class _AsyncWorkerBase:
         # different data order per worker (reference: per-rank shard)
         cfg["seed"] = int(cfg.get("seed", 0)) + rank
         cls = getattr(importlib.import_module(modelfile), modelclass)
-        self.model = cls(config=cfg, mesh=make_mesh(devices=devices))
+        self.model = cls(
+            config=cfg, mesh=cls.build_mesh(devices=devices, config=cfg)
+        )
         if n_epochs is not None:
             self.model.n_epochs = n_epochs
         self.error: Optional[BaseException] = None

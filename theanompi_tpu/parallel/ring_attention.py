@@ -1,0 +1,184 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference framework has no attention anywhere (2016 CNN/GAN zoo;
+SURVEY.md §3.4 / §6 "long-context: ABSENT"), but long-context sequence
+parallelism is a first-class requirement of this framework, so it is
+built into the parallel layer rather than bolted onto a model.
+
+Design (TPU-first, after Liu et al., "Ring Attention with Blockwise
+Transformers", and the blockwise-parallel-transformer lineage in
+PAPERS.md):
+
+- The sequence dimension is sharded over a named mesh axis (``sp``).
+  Each device holds a query block Q_i and starts with its own K_i/V_i.
+- ``n_sp`` ring steps: compute blockwise attention of Q_i against the
+  resident K/V block, then rotate K/V one hop around the ring with
+  ``lax.ppermute`` — on TPU this rides ICI neighbor links, overlapping
+  the transfer with the next block's compute under XLA's scheduler.
+- Numerically exact (not approximate): blocks combine with the online
+  softmax recurrence (running max ``m``, normalizer ``den``, numerator
+  ``num``), so the result is bit-comparable to full attention up to
+  float association.
+- Causal masking uses global positions reconstructed from
+  ``lax.axis_index``: query block ``i`` holds rows ``[i·T, (i+1)·T)``,
+  and after ``s`` rotations the resident K/V block originated on device
+  ``(i − s) mod n``.
+
+Everything here runs *inside* ``shard_map`` (the functions take the
+local shards). ``ring_self_attention`` is a convenience wrapper that
+builds the shard_map for standalone use and tests; models embed
+``ring_attention`` directly in their own step functions via
+``ops.attention.MultiHeadAttention(sp_axis=...)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "sp"  # canonical sequence-parallel mesh axis name
+
+_NEG_INF = -1e30  # finite mask value: keeps exp() NaN-free on all-masked rows
+
+
+def full_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain softmax attention; the single-device reference semantics.
+
+    Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D).
+    Softmax statistics are computed in fp32 regardless of input dtype.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
+
+
+def _block_update(q, k_blk, v_blk, m, den, num, scale, mask):
+    """One online-softmax accumulation step against a K/V block.
+
+    q (B,Tq,H,D); k_blk/v_blk (B,Tk,H,D); m/den (B,H,Tq); num (B,H,Tq,D).
+    ``mask`` is (Tq, Tk) boolean or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        # zero masked probabilities explicitly: on a fully-masked row
+        # m_new stays at _NEG_INF and exp(s - m_new) = 1, which must not
+        # count toward the normalizer
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    den = den * corr + jnp.sum(p, axis=-1)
+    num = num * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, den, num
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    axis_size: Optional[int] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact blockwise attention over sequence shards on a ring.
+
+    Call inside ``shard_map`` with the sequence dim sharded over
+    ``axis_name``. Local shapes: q/k/v (B, T_local, H, D); returns the
+    local output shard (B, T_local, H, D) in q's dtype.
+
+    ``axis_size`` is the static size of the ring (``mesh.shape[axis]``);
+    it must be supplied because the loop bound has to be a Python int
+    for XLA unrolling/scan. With ``axis_size=1`` this degrades to
+    ``full_attention`` (no collectives traced — the single-shard path
+    costs nothing extra).
+    """
+    if axis_size is None:
+        raise ValueError("ring_attention needs static axis_size (mesh.shape[axis])")
+    if axis_size == 1:
+        return full_attention(q, k, v, causal=causal, scale=scale)
+
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    den0 = jnp.zeros((b, h, t), jnp.float32)
+    num0 = jnp.zeros((b, h, t, d), jnp.float32)
+
+    def step(carry, s):
+        k_blk, v_blk, m, den, num = carry
+        if causal:
+            src = (my - s) % axis_size  # origin device of the resident block
+            qpos = my * t + jnp.arange(t)
+            kpos = src * t + jnp.arange(t)
+            mask = qpos[:, None] >= kpos[None, :]
+        else:
+            mask = None
+        m, den, num = _block_update(q, k_blk, v_blk, m, den, num, scale, mask)
+        # rotate K/V one hop; neighbor transfer over ICI. The final
+        # rotation returns the block home — keeping it unconditional
+        # trades one redundant hop for a branch-free scan body.
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, den, num), None
+
+    (k, v, m, den, num), _ = lax.scan(
+        step, (k, v, m0, den0, num0), jnp.arange(axis_size)
+    )
+    out = num / den[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_self_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+):
+    """Standalone sharded entry point (tests / direct use).
+
+    Takes *global* (B, T, H, D) arrays, shard_maps the ring over
+    ``mesh`` axis ``axis`` (T must divide by its size), returns the
+    global result.
+    """
+    n = int(mesh.shape[axis])
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis, axis_size=n, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)(q, k, v)

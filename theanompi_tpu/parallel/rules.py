@@ -20,7 +20,7 @@ from typing import Any, Optional, Sequence
 
 import jax
 
-from theanompi_tpu.runtime.mesh import init_distributed, make_mesh
+from theanompi_tpu.runtime.mesh import init_distributed
 
 
 def _resolve_devices(devices) -> Optional[Sequence[jax.Device]]:
@@ -86,8 +86,10 @@ class BSP(Rule):
     def _setup(self, devices, modelfile, modelclass, model_config, **kw):
         from theanompi_tpu.parallel.workers import BSP_Worker
 
-        mesh = make_mesh(devices=devices)
         cls = getattr(importlib.import_module(modelfile), modelclass)
+        # the model class owns mesh topology (a sequence-parallel model
+        # needs a dp×sp mesh; plain DP models return the flat dp mesh)
+        mesh = cls.build_mesh(devices=devices, config=model_config)
         self.model = cls(config=model_config, mesh=mesh)
         self.worker = BSP_Worker(self.model, **kw)
 

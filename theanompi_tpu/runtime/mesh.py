@@ -187,9 +187,12 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
-def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
-    """Place a host batch (pytree of np arrays) onto the mesh, sharded."""
-    sh = batch_sharding(mesh, axis)
+def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS, spec: Optional[P] = None):
+    """Place a host batch (pytree of np arrays) onto the mesh, sharded.
+
+    Default: leading dim over ``axis``. An explicit ``spec`` overrides
+    (e.g. ``P('dp', 'sp')`` for sequence-parallel token batches)."""
+    sh = NamedSharding(mesh, spec) if spec is not None else batch_sharding(mesh, axis)
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
 
