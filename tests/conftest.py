@@ -18,7 +18,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 # repo root on sys.path so `import theanompi_tpu` works without install
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo_root)
 
 # The axon environment pre-imports jax at interpreter startup (PYTHONPATH
 # sitecustomize), so the env vars above can be too late; force the platform
@@ -27,3 +28,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the zoo smoke tests compile full
+# ResNet50/GoogLeNet/VGG16 graphs on one CPU core (~6 min cold); cached
+# re-runs of the suite drop to seconds of compile time.
+_cache_dir = os.path.join(_repo_root, ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
