@@ -10,14 +10,22 @@ from theanompi_tpu.ops import losses, optim
 KEY = jax.random.PRNGKey(0)
 
 
-def test_conv2d_shapes_and_fp32_accum():
+def test_conv2d_shapes_and_mixed_precision_flow():
     layer = L.Conv2d(8, 3, stride=2, padding="SAME", compute_dtype=jnp.bfloat16)
     p, s, out = layer.init(KEY, (16, 16, 3))
     assert out == (8, 8, 8)
     x = jnp.ones((2, 16, 16, 3))
     y, _ = layer.apply(p, s, x)
     assert y.shape == (2, 8, 8, 8)
-    assert y.dtype == jnp.float32  # MXU accumulation stays fp32
+    # activations FLOW in compute_dtype (half the HBM bytes downstream);
+    # master params stay fp32
+    assert y.dtype == jnp.bfloat16
+    assert p["w"].dtype == jnp.float32
+    # a logits head opts back into fp32
+    head = L.Conv2d(8, 3, compute_dtype=jnp.bfloat16, output_dtype=jnp.float32)
+    hp, hs, _ = head.init(KEY, (16, 16, 3))
+    hy, _ = head.apply(hp, hs, x)
+    assert hy.dtype == jnp.float32
 
 
 def test_conv2d_valid_padding_shape():

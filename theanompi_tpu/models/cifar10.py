@@ -57,7 +57,7 @@ class Cifar10_model(TpuModel):
                 L.Dense(256, compute_dtype=dtype),
                 L.Relu(),
                 L.Dropout(float(cfg.dropout_rate)),
-                L.Dense(10, compute_dtype=dtype),
+                L.Dense(10, compute_dtype=dtype, output_dtype=jnp.float32),
             ]
         )
         self.lr_schedule = optim.step_decay(

@@ -91,7 +91,7 @@ class GoogLeNet(TpuModel):
                 _inception(384, 192, 384, 48, 128, 128, dt),  # 5b -> 1024
                 L.GlobalAvgPool(),
                 L.Dropout(float(cfg.dropout_rate)),
-                L.Dense(int(cfg.n_classes), compute_dtype=dt),
+                L.Dense(int(cfg.n_classes), compute_dtype=dt, output_dtype=jnp.float32),
             ]
         )
         self.lr_schedule = optim.step_decay(

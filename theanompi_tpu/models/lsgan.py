@@ -96,7 +96,7 @@ class LSGAN(TpuModel):
                 L.BatchNorm(),
                 _leaky(),
                 L.Flatten(),
-                L.Dense(1, compute_dtype=dt),
+                L.Dense(1, compute_dtype=dt, output_dtype=jnp.float32),
             ]
         )
         self.rng, gk, dk = jax.random.split(self.rng, 3)
@@ -218,7 +218,9 @@ class LSGAN(TpuModel):
         self.rng, step_key = jax.random.split(self.rng)
         out = self.train_fn(self.params, self.net_state, self.opt_state, x, step_key)
         self.params, self.net_state, self.opt_state = out[0], out[1], out[2]
-        d_loss, g_loss = float(out[3]), float(out[4])
+        d_loss, g_loss = out[3], out[4]
+        if self.config.sync_each_iter:
+            d_loss, g_loss = float(d_loss), float(g_loss)
         recorder.end("calc")
         # recorder's (cost, error) slots carry (d_loss, g_loss)
         recorder.train_error(count, d_loss, g_loss)

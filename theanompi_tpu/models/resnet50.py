@@ -93,7 +93,7 @@ class ResNet50(TpuModel):
                     _bottleneck(cin, cmid, cout, stride if b == 0 else 1, bn_axis, dt)
                 )
                 cin = cout
-        seq += [L.GlobalAvgPool(), L.Dense(int(cfg.n_classes), compute_dtype=dt)]
+        seq += [L.GlobalAvgPool(), L.Dense(int(cfg.n_classes), compute_dtype=dt, output_dtype=jnp.float32)]
         self.lr_schedule = optim.step_decay(
             float(cfg.lr), list(cfg.lr_boundaries), 0.1
         )

@@ -49,6 +49,7 @@ class TransformerLM(TpuModel):
         n_layers=4,
         mlp_ratio=4,
         sp=1,  # sequence-parallel degree (mesh sp-axis size)
+        sp_mode="ring",  # 'ring' (ppermute K/V ring) | 'alltoall' (Ulysses)
         lr=0.1,
         momentum=0.9,
         weight_decay=0.0,
@@ -130,7 +131,7 @@ class TransformerLM(TpuModel):
         d = int(cfg.d_model)
         net = L.Sequential(
             [
-                A.Embedding(int(cfg.vocab_size), d),
+                A.Embedding(int(cfg.vocab_size), d, compute_dtype=dt),
                 A.PositionalEmbedding(int(cfg.seq_len), sp_axis=sp_axis),
                 *[
                     A.TransformerBlock(
@@ -139,12 +140,13 @@ class TransformerLM(TpuModel):
                         causal=True,
                         sp_axis=sp_axis,
                         sp_size=self.sp_size,
+                        sp_mode=str(cfg.sp_mode),
                         compute_dtype=dt,
                     )
                     for _ in range(int(cfg.n_layers))
                 ],
                 A.LayerNorm(),
-                L.Dense(int(cfg.vocab_size), compute_dtype=dt),
+                L.Dense(int(cfg.vocab_size), compute_dtype=dt, output_dtype=jnp.float32),
             ]
         )
         self.lr_schedule = optim.step_decay(

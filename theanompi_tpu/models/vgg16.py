@@ -69,7 +69,7 @@ class VGG16(TpuModel):
                 L.Dense(4096, compute_dtype=dt),
                 L.Relu(),
                 L.Dropout(drop),
-                L.Dense(int(cfg.n_classes), compute_dtype=dt),
+                L.Dense(int(cfg.n_classes), compute_dtype=dt, output_dtype=jnp.float32),
             ]
         )
         self.lr_schedule = optim.step_decay(

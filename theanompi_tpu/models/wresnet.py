@@ -87,7 +87,7 @@ class WResNet(TpuModel):
             L.BatchNorm(axis_name=bn_axis),
             L.Relu(),
             L.GlobalAvgPool(),
-            L.Dense(10, compute_dtype=dt),
+            L.Dense(10, compute_dtype=dt, output_dtype=jnp.float32),
         ]
         self.lr_schedule = optim.step_decay(
             float(cfg.lr), list(cfg.lr_boundaries), 0.2

@@ -51,12 +51,24 @@ class LayerNorm(Layer):
 
 
 class Embedding(Layer):
-    """Token embedding: int32 ``(T,)`` → ``(T, D)``."""
+    """Token embedding: int32 ``(T,)`` → ``(T, D)``.
 
-    def __init__(self, vocab_size: int, features: int, w_init=None):
+    With ``compute_dtype`` set, the looked-up activations enter the
+    residual stream in that dtype (master table stays fp32), so the whole
+    transformer stack flows in bf16 on TPU.
+    """
+
+    def __init__(
+        self,
+        vocab_size: int,
+        features: int,
+        w_init=None,
+        compute_dtype: Optional[jnp.dtype] = None,
+    ):
         self.vocab_size = vocab_size
         self.features = features
         self.w_init = w_init or normal_init(0.02)
+        self.compute_dtype = compute_dtype
 
     def init(self, key, in_shape):
         params = {
@@ -67,7 +79,10 @@ class Embedding(Layer):
         return params, {}, (*in_shape, self.features)
 
     def apply(self, params, state, x, train=False, rng=None):
-        return jnp.take(params["table"], x, axis=0), state
+        y = jnp.take(params["table"], x, axis=0)
+        if self.compute_dtype is not None:
+            y = y.astype(self.compute_dtype)
+        return y, state
 
 
 class PositionalEmbedding(Layer):
@@ -93,18 +108,21 @@ class PositionalEmbedding(Layer):
         if self.sp_axis is not None:
             offset = lax.axis_index(self.sp_axis) * t
         pos = lax.dynamic_slice_in_dim(params["pos"], offset, t, axis=0)
-        return x + pos, state
+        return x + pos.astype(x.dtype), state
 
 
 class MultiHeadAttention(Layer):
-    """Multi-head self-attention with optional ring sequence parallelism.
+    """Multi-head self-attention with optional sequence parallelism.
 
     ``sp_axis``/``sp_size`` select the path statically at trace time:
     ``sp_size == 1`` (or ``sp_axis=None``) runs dense single-shard
-    attention; otherwise K/V circulate the ring
-    (``parallel.ring_attention``) and the layer must be applied inside a
-    ``shard_map`` that has ``sp_axis`` in scope with the sequence dim
-    sharded over it.
+    attention; otherwise the layer must be applied inside a ``shard_map``
+    that has ``sp_axis`` in scope with the sequence dim sharded over it,
+    and ``sp_mode`` picks the exact-attention layout:
+
+    - ``'ring'`` — K/V circulate the ring (``parallel.ring_attention``).
+    - ``'alltoall'`` — head⇄sequence reshuffle (``parallel.ulysses``),
+      needs ``n_heads % sp_size == 0``.
     """
 
     def __init__(
@@ -113,12 +131,16 @@ class MultiHeadAttention(Layer):
         causal: bool = True,
         sp_axis: Optional[str] = None,
         sp_size: int = 1,
+        sp_mode: str = "ring",
         compute_dtype: Optional[jnp.dtype] = None,
     ):
+        if sp_mode not in ("ring", "alltoall"):
+            raise ValueError(f"sp_mode must be 'ring' or 'alltoall', got {sp_mode!r}")
         self.n_heads = n_heads
         self.causal = causal
         self.sp_axis = sp_axis
         self.sp_size = sp_size
+        self.sp_mode = sp_mode
         self.compute_dtype = compute_dtype
 
     def init(self, key, in_shape):
@@ -140,7 +162,11 @@ class MultiHeadAttention(Layer):
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
             w = w.astype(self.compute_dtype)
-        return jnp.dot(x, w, preferred_element_type=jnp.float32)
+        # fp32 MXU accumulation, narrowed back to the flowing dtype
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        if self.compute_dtype is not None:
+            y = y.astype(self.compute_dtype)
+        return y
 
     def apply(self, params, state, x, train=False, rng=None):
         b, t, d = x.shape
@@ -149,10 +175,14 @@ class MultiHeadAttention(Layer):
         q = self._proj(x, params["wq"]).reshape(b, t, h, hd)
         k = self._proj(x, params["wk"]).reshape(b, t, h, hd)
         v = self._proj(x, params["wv"]).reshape(b, t, h, hd)
-        if self.compute_dtype is not None:
-            q, k, v = (a.astype(self.compute_dtype) for a in (q, k, v))
         if self.sp_axis is not None and self.sp_size > 1:
-            o = ring_attention(
+            if self.sp_mode == "alltoall":
+                from theanompi_tpu.parallel.ulysses import ulysses_attention
+
+                sp_fn = ulysses_attention
+            else:
+                sp_fn = ring_attention
+            o = sp_fn(
                 q, k, v,
                 axis_name=self.sp_axis,
                 axis_size=self.sp_size,
@@ -160,8 +190,10 @@ class MultiHeadAttention(Layer):
             )
         else:
             o = full_attention(q, k, v, causal=self.causal)
+        # output keeps the flowing activation dtype (softmax statistics
+        # inside ring/ulysses/full attention are fp32 regardless)
         y = self._proj(o.reshape(b, t, d), params["wo"])
-        return y.astype(jnp.float32), state
+        return y, state
 
 
 class TransformerBlock(Layer):
@@ -174,13 +206,14 @@ class TransformerBlock(Layer):
         causal: bool = True,
         sp_axis: Optional[str] = None,
         sp_size: int = 1,
+        sp_mode: str = "ring",
         compute_dtype: Optional[jnp.dtype] = None,
     ):
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
         self.attn = MultiHeadAttention(
             n_heads, causal=causal, sp_axis=sp_axis, sp_size=sp_size,
-            compute_dtype=compute_dtype,
+            sp_mode=sp_mode, compute_dtype=compute_dtype,
         )
         self.mlp_ratio = mlp_ratio
         self.compute_dtype = compute_dtype
@@ -218,7 +251,9 @@ class TransformerBlock(Layer):
         if self.compute_dtype is not None:
             hmid = hmid.astype(self.compute_dtype)
         y = jnp.dot(hmid, w2, preferred_element_type=jnp.float32)
-        return y + params["mlp_out"]["b"]
+        if self.compute_dtype is not None:
+            y = y.astype(self.compute_dtype)
+        return y + params["mlp_out"]["b"].astype(y.dtype)
 
     def apply(self, params, state, x, train=False, rng=None):
         h1, _ = self.ln1.apply(params["ln1"], {}, x)

@@ -13,8 +13,16 @@ Re-creation of the reference's layer lib (upstream
 - Contract: ``init(key, in_shape) -> (params, state, out_shape)`` and
   ``apply(params, state, x, train=False, rng=None) -> (y, new_state)``.
   ``in_shape``/``out_shape`` exclude the batch dimension.
-- Layout is NHWC (TPU-native); convolutions accumulate in fp32 via
-  ``preferred_element_type`` so bf16 compute is safe on the MXU.
+- Layout is NHWC (TPU-native).
+- Mixed precision: with ``compute_dtype=bfloat16`` activations FLOW in
+  bf16 between layers (halves HBM traffic — the usual TPU bottleneck);
+  master params stay fp32 and statistics (BatchNorm moments, global
+  pooling) are computed in fp32 inside the fused op. Dense matmuls
+  request fp32 accumulation explicitly (``preferred_element_type``);
+  convs rely on the TPU MXU's native fp32 accumulation of bf16 inputs
+  (the conv VJP rejects a widened output dtype, see ``Conv2d.apply``).
+  Pass ``output_dtype=float32`` on a final logits layer to leave mixed
+  precision at the head.
 - There is no ``Weight`` save/load here: checkpointing serializes whole
   pytrees (``theanompi_tpu.utils.checkpoint``).
 """
@@ -91,6 +99,7 @@ class Conv2d(Layer):
         use_bias: bool = True,
         w_init: Optional[Callable] = None,
         compute_dtype: Optional[jnp.dtype] = None,
+        output_dtype: Optional[jnp.dtype] = None,
     ):
         self.filters = filters
         self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
@@ -99,6 +108,7 @@ class Conv2d(Layer):
         self.use_bias = use_bias
         self.w_init = w_init or he_normal
         self.compute_dtype = compute_dtype
+        self.output_dtype = output_dtype
 
     def init(self, key, in_shape):
         h, w, cin = in_shape
@@ -114,11 +124,16 @@ class Conv2d(Layer):
     def apply(self, params, state, x, train=False, rng=None):
         w = params["w"]
         if self.compute_dtype is not None:
-            # cast inputs AND output boundary: the MXU accumulates bf16
-            # matmuls in fp32 internally, and the up-cast on y keeps the
-            # VJP well-typed (fp32 cotangents never meet bf16 operands)
+            # the MXU accumulates bf16 convs in fp32 internally; the
+            # activation stays in compute_dtype so downstream layers read
+            # half the HBM bytes
             x = x.astype(self.compute_dtype)
             w = w.astype(self.compute_dtype)
+        # no preferred_element_type here: a widened (fp32) conv output makes
+        # the VJP's cotangent dtype mismatch its bf16 operands, which
+        # lax.conv rejects. On the TPU MXU bf16 convs accumulate in fp32 in
+        # hardware anyway; on other backends bf16 conv accumulation follows
+        # the operand dtype (acceptable for the CPU test rig's tolerances).
         y = lax.conv_general_dilated(
             x,
             w,
@@ -126,10 +141,10 @@ class Conv2d(Layer):
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        if self.compute_dtype is not None:
-            y = y.astype(jnp.float32)
+        if self.output_dtype is not None:
+            y = y.astype(self.output_dtype)
         if self.use_bias:
-            y = y + params["b"]
+            y = y + params["b"].astype(y.dtype)
         return y, state
 
 
@@ -142,11 +157,13 @@ class Dense(Layer):
         use_bias: bool = True,
         w_init: Optional[Callable] = None,
         compute_dtype: Optional[jnp.dtype] = None,
+        output_dtype: Optional[jnp.dtype] = None,
     ):
         self.features = features
         self.use_bias = use_bias
         self.w_init = w_init
         self.compute_dtype = compute_dtype
+        self.output_dtype = output_dtype
 
     def init(self, key, in_shape):
         # acts on the last dim; leading per-example dims (e.g. the
@@ -164,14 +181,20 @@ class Dense(Layer):
 
     def apply(self, params, state, x, train=False, rng=None):
         w = params["w"]
+        out_dtype = self.output_dtype
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
             w = w.astype(self.compute_dtype)
-        # unlike conv, dot's VJP handles mixed dtypes, so bf16 operands can
-        # keep a true fp32 accumulator output with no precision round-trip
+            if out_dtype is None:
+                out_dtype = self.compute_dtype
+        # fp32 MXU accumulation regardless of operand dtype; the result is
+        # then narrowed to the flowing activation dtype (or kept fp32 for
+        # a logits head via output_dtype=float32)
         y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        if out_dtype is not None:
+            y = y.astype(out_dtype)
         if self.use_bias:
-            y = y + params["b"]
+            y = y + params["b"].astype(y.dtype)
         return y, state
 
 
@@ -228,7 +251,8 @@ class GlobalAvgPool(Layer):
         return {}, {}, (c,)
 
     def apply(self, params, state, x, train=False, rng=None):
-        return jnp.mean(x, axis=(1, 2)), state
+        # fp32 accumulation for the spatial mean (49+ bf16 adds would drift)
+        return jnp.mean(x.astype(jnp.float32), axis=(1, 2)).astype(x.dtype), state
 
 
 class LRN(Layer):
@@ -242,6 +266,10 @@ class LRN(Layer):
         self.k = k
 
     def apply(self, params, state, x, train=False, rng=None):
+        # runs in the flowing dtype: bf16 shares fp32's exponent range so
+        # the squares can't overflow, and a 5-channel window sum loses
+        # <0.5% relative precision on a normalization heuristic — while
+        # fp32 here would double HBM traffic on the largest activations
         sq = jnp.square(x)
         # sum over a window of `size` channels centered at each channel
         pad = self.size // 2
@@ -250,7 +278,7 @@ class LRN(Layer):
             sq, 0.0, lax.add, (1, 1, 1, self.size), (1, 1, 1, 1), "VALID"
         )
         denom = jnp.power(self.k + self.alpha * win, self.beta)
-        return x / denom, state
+        return (x / denom).astype(x.dtype), state
 
 
 class BatchNorm(Layer):
@@ -287,9 +315,10 @@ class BatchNorm(Layer):
 
     def apply(self, params, state, x, train=False, rng=None):
         reduce_axes = tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)  # fp32 moments even for bf16 activations
         if train:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.mean(jnp.square(x), axis=reduce_axes) - jnp.square(mean)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
             if self.axis_name is not None:
                 mean = lax.pmean(mean, self.axis_name)
                 var = lax.pmean(var, self.axis_name)
@@ -302,8 +331,8 @@ class BatchNorm(Layer):
             mean, var = state["mean"], state["var"]
             new_state = state
         inv = lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv * params["scale"] + params["bias"]
-        return y, new_state
+        y = (xf - mean) * inv * params["scale"] + params["bias"]
+        return y.astype(x.dtype), new_state
 
 
 class Dropout(Layer):
@@ -520,10 +549,8 @@ class ConvTranspose2d(Layer):
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        if self.compute_dtype is not None:
-            y = y.astype(jnp.float32)
         if self.use_bias:
-            y = y + params["b"]
+            y = y + params["b"].astype(y.dtype)
         return y, state
 
 

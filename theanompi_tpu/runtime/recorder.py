@@ -7,10 +7,14 @@ wait / load segments, running train loss+error, per-epoch val error, a
 print every K iterations, and a record dumped to disk for offline plots.
 
 TPU-honesty note: JAX dispatch is async, so a naive ``time.time()`` around
-a jitted call measures dispatch, not compute.  Callers that want honest
-segment times must fence with ``jax.block_until_ready`` before ``end()``;
-the workers in ``theanompi_tpu.parallel`` do exactly that.  For op-level
-depth the recorder can also drive ``jax.profiler`` traces.
+a jitted call measures dispatch, not compute.  With the default
+``sync_each_iter=False`` the models deliberately do NOT fence each step
+(a host↔device fence costs ~60ms on tunneled rigs, a ~20% throughput
+tax), so ``calc`` rows record dispatch time only; true throughput is
+what ``end_epoch`` wall-time and ``bench.py`` report.  Set
+``sync_each_iter=True`` in the model config for reference-style honest
+per-step calc/comm/wait splits, or drive ``jax.profiler`` traces for
+op-level depth.
 """
 
 from __future__ import annotations
@@ -77,9 +81,19 @@ class Recorder:
         return dt
 
     # ---- train metrics --------------------------------------------------
-    def train_error(self, count: int, cost: float, error: float) -> None:
-        self._train_cost += float(cost)
-        self._train_err += float(error)
+    def train_error(self, count: int, cost, error) -> None:
+        # cost/error may be device scalars: accumulate lazily (tiny on-device
+        # adds) and only materialize at the print boundary, so metric
+        # bookkeeping never forces a per-step host↔device sync
+        try:
+            self._train_cost = self._train_cost + cost
+            self._train_err = self._train_err + error
+        except ValueError:
+            # one recorder fed by models on different device meshes (two
+            # committed scalars can't add): materialize the old accumulator
+            # once and continue lazily on the new mesh
+            self._train_cost = float(self._train_cost) + cost
+            self._train_err = float(self._train_err) + error
         self._train_n += 1
 
     def print_train_info(self, count: int, force: bool = False) -> None:
@@ -88,8 +102,8 @@ class Recorder:
         n = self._train_n
         row = {
             "iter": count,
-            "cost": self._train_cost / n,
-            "error": self._train_err / n,
+            "cost": float(self._train_cost) / n,  # the one sync per window
+            "error": float(self._train_err) / n,
             **{p: self._acc.get(p, 0.0) for p in PHASES},
         }
         self.history.append(row)
