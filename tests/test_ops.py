@@ -80,6 +80,29 @@ def test_lrn_matches_manual():
     np.testing.assert_allclose(np.asarray(y), out, rtol=1e-5)
 
 
+def test_lrn_pallas_matches_xla():
+    """The fused Pallas kernel (interpret mode on CPU) must reproduce the
+    XLA path — forward and gradients. M = B·H·W = 32 rows here, so the
+    kernel's pad-to-512-rows-and-slice path is exercised."""
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 4, 4, 6), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(6), x.shape)
+    lp = L.LRN(size=3, k=2.0, impl="pallas")
+    lx = L.LRN(size=3, k=2.0, impl="xla")
+    yp, _ = lp.apply({}, {}, x)
+    yx, _ = lx.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(yp), np.asarray(yx), atol=5e-5, rtol=5e-5)
+    gp = jax.grad(lambda a: jnp.sum(lp.apply({}, {}, a)[0] * w))(x)
+    gx = jax.grad(lambda a: jnp.sum(lx.apply({}, {}, a)[0] * w))(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx), atol=5e-5, rtol=5e-5)
+
+
+def test_lrn_bad_impl_raises():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="impl"):
+        L.LRN(impl="cuda")
+
+
 def test_batchnorm_train_and_eval():
     bn = L.BatchNorm(momentum=0.5)
     p, s, _ = bn.init(KEY, (4,))
