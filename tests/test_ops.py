@@ -80,26 +80,27 @@ def test_lrn_matches_manual():
     np.testing.assert_allclose(np.asarray(y), out, rtol=1e-5)
 
 
-def test_lrn_pallas_matches_xla():
-    """The fused Pallas kernel (interpret mode on CPU) must reproduce the
-    XLA path — forward and gradients. M = B·H·W = 32 rows here, so the
-    kernel's pad-to-512-rows-and-slice path is exercised."""
+@pytest.mark.parametrize("size", [3, 4])  # even size: asymmetric window
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_lrn_impls_match_window_baseline(impl, size):
+    """Every LRN implementation must reproduce the literal
+    pad+reduce_window baseline — forward and gradients, odd AND even
+    window sizes. M = B·H·W = 32 rows exercises the Pallas kernel's
+    pad-to-512-rows-and-slice path."""
     x = jax.random.normal(jax.random.PRNGKey(5), (2, 4, 4, 6), jnp.float32)
     w = jax.random.normal(jax.random.PRNGKey(6), x.shape)
-    lp = L.LRN(size=3, k=2.0, impl="pallas")
-    lx = L.LRN(size=3, k=2.0, impl="xla")
-    yp, _ = lp.apply({}, {}, x)
-    yx, _ = lx.apply({}, {}, x)
-    np.testing.assert_allclose(np.asarray(yp), np.asarray(yx), atol=5e-5, rtol=5e-5)
-    gp = jax.grad(lambda a: jnp.sum(lp.apply({}, {}, a)[0] * w))(x)
-    gx = jax.grad(lambda a: jnp.sum(lx.apply({}, {}, a)[0] * w))(x)
-    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx), atol=5e-5, rtol=5e-5)
+    li = L.LRN(size=size, k=2.0, impl=impl)
+    lw = L.LRN(size=size, k=2.0, impl="window")
+    yi, _ = li.apply({}, {}, x)
+    yw, _ = lw.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(yi), np.asarray(yw), atol=5e-5, rtol=5e-5)
+    gi = jax.grad(lambda a: jnp.sum(li.apply({}, {}, a)[0] * w))(x)
+    gw = jax.grad(lambda a: jnp.sum(lw.apply({}, {}, a)[0] * w))(x)
+    np.testing.assert_allclose(np.asarray(gi), np.asarray(gw), atol=5e-5, rtol=5e-5)
 
 
 def test_lrn_bad_impl_raises():
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="impl"):
+    with pytest.raises(ValueError, match="impl"):
         L.LRN(impl="cuda")
 
 

@@ -23,7 +23,10 @@ Math (cross-channel window W(c) of ``size`` channels centered at c):
 
 Backward, with u_c = dy_c · x_c · D_c^{-β-1}:
 
-    dx_i = dy_i · D_i^{-β} − 2αβ · x_i · Σ_{c∈W(i)} u_c
+    dx_i = dy_i · D_i^{-β} − 2αβ · x_i · Σ_{c : i∈W(c)} u_c
+
+(the reverse-window sum = matmul with the transposed band; B ≠ Bᵀ for
+even window sizes).
 
 D is recomputed in the backward kernel instead of saved: one extra
 in-register window pass is far cheaper than an activation-sized HBM
@@ -47,19 +50,23 @@ from jax.experimental import pallas as pl
 _ROWS = 512  # rows (= B·H·W elements) per grid step; VMEM ~ ROWS·C·4B·few
 
 
-def _win_sum(a: jnp.ndarray, size: int) -> jnp.ndarray:
-    """Sum over a centered window of ``size`` along the last (lane) axis.
+def _win_sum(a: jnp.ndarray, size: int, transpose: bool = False) -> jnp.ndarray:
+    """Sum over the LRN channel window along the last (lane) axis.
 
     Implemented as a matmul with a banded 0/1 matrix: cross-lane shifts
     are slow on the VPU's register layout, while a (rows,C)×(C,C) matmul
     rides the MXU at full rate (the band matrix is built by iota in
-    registers, never touching HBM).
+    registers, never touching HBM). The band is shared with the XLA
+    banded-matmul path (``layers.lrn_band_matrix``) so impls can't
+    diverge. ``transpose=True`` sums over the REVERSE relation
+    ``{c : i ∈ W(c)}`` — needed by the backward pass; for even window
+    sizes the band is asymmetric, so B and Bᵀ differ.
     """
-    c = a.shape[-1]
-    pad = size // 2
-    row = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
-    band = (jnp.abs(row - col) <= pad).astype(a.dtype)
+    from theanompi_tpu.ops.layers import lrn_band_matrix
+
+    band = lrn_band_matrix(a.shape[-1], size, a.dtype)
+    if transpose:
+        band = band.T
     return jnp.dot(a, band, preferred_element_type=jnp.float32)
 
 
@@ -75,7 +82,7 @@ def _bwd_kernel(x_ref, dy_ref, dx_ref, *, size, alpha, beta, k):
     d = k + alpha * _win_sum(x * x, size)  # recomputed, stays in VMEM
     d_mb = jnp.exp(-beta * jnp.log(d))  # D^-β
     u = dy * x * d_mb / d  # dy·x·D^(-β-1)
-    dx = dy * d_mb - (2.0 * alpha * beta) * x * _win_sum(u, size)
+    dx = dy * d_mb - (2.0 * alpha * beta) * x * _win_sum(u, size, transpose=True)
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
