@@ -1,0 +1,156 @@
+"""Tensor-parallelism tests (Megatron-style column/row sharding).
+
+Beyond-reference (Theano-MPI is DP-only, SURVEY.md §3.4): exact-math
+checks on the fake 8-device CPU mesh that TP training steps equal the
+dense single-shard math, including combined dp×sp×tp meshes and the
+per-leaf gradient exchange (tp-sharded leaves skip the tp axis).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.transformer import TransformerLM
+from theanompi_tpu.parallel.ring_attention import SEQ_AXIS
+from theanompi_tpu.runtime.mesh import DATA_AXIS, TP_AXIS, make_mesh
+from theanompi_tpu.runtime.recorder import Recorder
+
+BASE = dict(
+    batch_size=2,
+    seq_len=32,
+    vocab_size=64,
+    d_model=32,
+    n_heads=4,
+    n_layers=2,
+    n_synth_train=4,
+    n_synth_val=1,
+    n_epochs=1,
+    print_freq=10_000,
+    seed=7,
+    exch_strategy="ar",
+)
+
+
+def _dense_ref(dp=2):
+    mesh = make_mesh(
+        shape=(dp, 1), axis_names=(DATA_AXIS, SEQ_AXIS), devices=jax.devices()[:dp]
+    )
+    return TransformerLM(config=dict(BASE), mesh=mesh)
+
+
+def _step(model, rec):
+    model.compile_train()
+    model.reset_train_iter(0)
+    return model.train_iter(1, rec)
+
+
+def _assert_params_match(m, ref):
+    for a, b in zip(jax.tree.leaves(m.params), jax.tree.leaves(ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_step_matches_dense(tp):
+    """One tp-sharded training step == the dense run (same dp, so same
+    data): forward psums, Megatron f/g backward, per-leaf exchange and
+    sharded optimizer update all have to line up for this to hold."""
+    rec = Recorder(verbose=False)
+    # build the mesh explicitly so dp matches the reference (same global batch)
+    mesh = make_mesh(
+        shape=(2, 1, tp),
+        axis_names=(DATA_AXIS, SEQ_AXIS, TP_AXIS),
+        devices=jax.devices()[: 2 * tp],
+    )
+    m_tp = TransformerLM(config=dict(BASE, tp=tp), mesh=mesh)
+    ref = _dense_ref(dp=2)
+    l_tp, _ = _step(m_tp, rec)
+    l_ref, _ = _step(ref, rec)
+    assert abs(float(l_tp) - float(l_ref)) < 2e-4
+    _assert_params_match(m_tp, ref)
+
+
+def test_dp_sp_tp_combined_matches_dense():
+    """The full parallelism surface on one mesh: dp2 × sp2 × tp2."""
+    rec = Recorder(verbose=False)
+    m = TransformerLM(config=dict(BASE, tp=2, sp=2))
+    ref = _dense_ref(dp=2)
+    l_m, _ = _step(m, rec)
+    l_ref, _ = _step(ref, rec)
+    assert abs(float(l_m) - float(l_ref)) < 2e-4
+    _assert_params_match(m, ref)
+
+
+def test_tp_params_are_actually_sharded():
+    m = TransformerLM(config=dict(BASE, tp=4))
+    m.compile_train()
+    wq = m.params[2]["attn"]["wq"]  # first block
+    shardings = {tuple(s.spec) for s in [wq.sharding]}
+    assert (None, TP_AXIS) in shardings
+    # a replicated leaf stays replicated
+    emb = m.params[0]["table"]
+    assert not any(TP_AXIS in str(p) for p in tuple(emb.sharding.spec))
+
+
+def test_tp_learns():
+    rec = Recorder(verbose=False)
+    m = TransformerLM(config=dict(BASE, tp=2, sp=2))
+    m.compile_train()
+    m.reset_train_iter(0)
+    losses = []
+    for i in range(1, 9):
+        if (i - 1) % m.data.n_batch_train == 0:
+            m.reset_train_iter(0)
+        losses.append(float(m.train_iter(i, rec)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_tp_val_runs():
+    m = TransformerLM(config=dict(BASE, tp=2, sp=2))
+    m.compile_val()
+    m.reset_val_iter()
+    loss, err, err5 = m.val_iter(1, Recorder(verbose=False))
+    assert np.isfinite([float(loss), float(err), float(err5)]).all()
+
+
+def test_tp_checkpoint_roundtrip(tmp_path):
+    rec = Recorder(verbose=False)
+    m = TransformerLM(config=dict(BASE, tp=2))
+    _step(m, rec)
+    path = m.save_model(str(tmp_path / "ckpt.npz"))
+    m2 = TransformerLM(config=dict(BASE, tp=2))
+    m2.load_model(path)
+    for a, b in zip(jax.tree.leaves(m.params), jax.tree.leaves(m2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+def test_tp_head_divisibility_error():
+    with pytest.raises(ValueError, match="n_heads"):
+        TransformerLM(config=dict(BASE, n_heads=3, tp=2))
+
+
+def test_tp_avg_mode_rejected():
+    m = TransformerLM(config=dict(BASE, tp=2, sync_mode="avg"))
+    with pytest.raises(ValueError, match="data-parallel only"):
+        m.compile_train()
+
+
+def test_tp_grad_clip_matches_dense():
+    """Global-norm clipping must see the FULL norm (sharded leaves'
+    sum-of-squares psum'd over tp), not the per-rank partial norm."""
+    rec = Recorder(verbose=False)
+    cfg = dict(BASE, grad_clip_norm=0.5)
+    mesh = make_mesh(
+        shape=(2, 1, 2),
+        axis_names=(DATA_AXIS, SEQ_AXIS, TP_AXIS),
+        devices=jax.devices()[:4],
+    )
+    m_tp = TransformerLM(config=dict(cfg, tp=2), mesh=mesh)
+    ref_mesh = make_mesh(
+        shape=(2, 1), axis_names=(DATA_AXIS, SEQ_AXIS), devices=jax.devices()[:2]
+    )
+    ref = TransformerLM(config=dict(cfg), mesh=ref_mesh)
+    l_tp, _ = _step(m_tp, rec)
+    l_ref, _ = _step(ref, rec)
+    assert abs(float(l_tp) - float(l_ref)) < 2e-4
+    _assert_params_match(m_tp, ref)

@@ -90,6 +90,9 @@ class TpuModel:
         self.input_shape: Optional[Tuple[int, ...]] = None
         self.lr_schedule = optim_lib.constant(float(cfg.lr))
         self._lr_scale = 1.0
+        # pytree of PartitionSpec matching ``params`` for tensor-parallel
+        # models (None = fully replicated, the plain data-parallel case)
+        self.param_specs = None
 
         self.build_data()
         self.build_model()
@@ -160,8 +163,50 @@ class TpuModel:
     # ------------------------------------------------------------------
     # contract: compile_train / compile_val  (reference names [DRIVER])
     # ------------------------------------------------------------------
+    def _opt_state_specs(self):
+        """PartitionSpec tree for the optimizer state, derived from its
+        actual structure: any top-level entry shaped like ``params``
+        (velocity, Adam moments, …) mirrors ``param_specs``; everything
+        else (lr, step counters) is replicated. Keeps the base class
+        optimizer-agnostic."""
+        if self.param_specs is None:
+            return P()
+        ptree = jax.tree.structure(self.params)
+        return {
+            k: (
+                self.param_specs
+                if jax.tree.structure(v) == ptree
+                else jax.tree.map(lambda _: P(), v)
+            )
+            for k, v in self.opt_state.items()
+        }
+
+    def _place_sharded_state(self) -> None:
+        """Lay params / params-shaped optimizer entries out per
+        ``param_specs`` (tensor-parallel leaves land sharded, not
+        replicated). Idempotent; no-op for plain DP models."""
+        if self.param_specs is None:
+            return
+        from jax.sharding import NamedSharding
+
+        def put(tree, specs):
+            return jax.tree.map(
+                lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+                tree,
+                specs,
+            )
+
+        self.params = put(self.params, self.param_specs)
+        self.opt_state = {
+            k: put(v, s)
+            for (k, v), s in zip(
+                self.opt_state.items(), self._opt_state_specs().values()
+            )
+        }
+
     def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
         cfg = self.config
+        self._place_sharded_state()
         exchanger = exchanger or BSP_Exchanger(
             strategy=cfg.exch_strategy, axis=self.exchange_axes
         )
@@ -170,14 +215,35 @@ class TpuModel:
         sync_mode = cfg.sync_mode
         if sync_mode not in ("cdd", "avg"):
             raise ValueError(f"sync_mode must be 'cdd' or 'avg', got {sync_mode!r}")
+        if sync_mode == "avg" and self.param_specs is not None:
+            raise ValueError(
+                "sync_mode='avg' (parameter averaging) is data-parallel "
+                "only; tensor-parallel models must use 'cdd'"
+            )
         clip = cfg.grad_clip_norm
+
+        param_specs = self.param_specs
 
         def maybe_clip(grads):
             if clip is None:
                 return grads
-            gnorm = jnp.sqrt(
-                sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
-            )
+            if param_specs is None:
+                sumsq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+            else:
+                # tensor-parallel leaves hold disjoint shards: their local
+                # sum-of-squares must be summed over the axes they shard
+                # on to contribute the full-leaf norm
+                from theanompi_tpu.parallel.exchanger import spec_axis_names
+
+                def leaf_sq(g, s):
+                    v = jnp.sum(jnp.square(g))
+                    ax = spec_axis_names(s) if s is not None else ()
+                    return lax.psum(v, ax) if ax else v
+
+                sumsq = sum(
+                    jax.tree.leaves(jax.tree.map(leaf_sq, grads, param_specs))
+                )
+            gnorm = jnp.sqrt(sumsq)
             scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
             return jax.tree.map(lambda g: g * scale, grads)
 
@@ -191,9 +257,10 @@ class TpuModel:
                 loss_fn, has_aux=True
             )(params)
             if sync_mode == "cdd":
-                grads = maybe_clip(exchanger.reduce_grads(grads))
+                grads = maybe_clip(exchanger.reduce_grads(grads, param_specs))
                 params, opt_state = opt.update(params, grads, opt_state)
-            else:  # avg: local step, then parameter averaging
+            else:  # avg: local step, then parameter averaging (DP-only;
+                # TP models are rejected above, so no per-leaf specs here)
                 params, opt_state = opt.update(params, maybe_clip(grads), opt_state)
                 params = exchanger.average_params(params)
                 opt_state = dict(
@@ -208,11 +275,13 @@ class TpuModel:
             err = lax.pmean(err, axis)
             return params, new_state, opt_state, loss, err
 
+        pspec = P() if param_specs is None else param_specs
+        opt_spec = self._opt_state_specs()
         mapped = jax.shard_map(
             shard_step,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), self.batch_spec, self.batch_spec, P()),
-            out_specs=(P(), P(), P(), P(), P()),
+            in_specs=(pspec, P(), opt_spec, self.batch_spec, self.batch_spec, P()),
+            out_specs=(pspec, P(), opt_spec, P(), P()),
             check_vma=False,
         )
         self.train_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
@@ -221,6 +290,7 @@ class TpuModel:
 
     def compile_val(self):
         axes = self.exchange_axes
+        self._place_sharded_state()
 
         def shard_eval(params, net_state, x, y):
             loss, (err, err5, _) = self.loss_and_metrics(
@@ -232,10 +302,11 @@ class TpuModel:
                 lax.pmean(err5, axes),
             )
 
+        pspec = P() if self.param_specs is None else self.param_specs
         mapped = jax.shard_map(
             shard_eval,
             mesh=self.mesh,
-            in_specs=(P(), P(), self.batch_spec, self.batch_spec),
+            in_specs=(pspec, P(), self.batch_spec, self.batch_spec),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
@@ -346,6 +417,9 @@ class TpuModel:
         self.opt_state = replicate(self.mesh, blob["opt_state"])
         self.current_epoch = int(blob["epoch"])
         self.rng = blob["rng"]
+        # tensor-parallel leaves go back to their sharded layout
+        # (checkpoints store full global arrays either way)
+        self._place_sharded_state()
 
     def cleanup(self) -> None:
         self._train_it = None

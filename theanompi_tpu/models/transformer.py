@@ -36,7 +36,7 @@ from theanompi_tpu.ops import attention as A
 from theanompi_tpu.ops import layers as L
 from theanompi_tpu.ops import losses, optim
 from theanompi_tpu.parallel.ring_attention import SEQ_AXIS
-from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh
+from theanompi_tpu.runtime.mesh import DATA_AXIS, TP_AXIS, make_mesh
 
 
 class TransformerLM(TpuModel):
@@ -50,6 +50,7 @@ class TransformerLM(TpuModel):
         mlp_ratio=4,
         sp=1,  # sequence-parallel degree (mesh sp-axis size)
         sp_mode="ring",  # 'ring' (ppermute K/V ring) | 'alltoall' (Ulysses)
+        tp=1,  # tensor-parallel degree (Megatron-style column/row sharding)
         lr=0.1,
         momentum=0.9,
         weight_decay=0.0,
@@ -67,9 +68,19 @@ class TransformerLM(TpuModel):
         cfg = dict(cls.default_config)
         cfg.update(dict(config or {}))
         sp = int(cfg.get("sp", 1))
+        tp = int(cfg.get("tp", 1))
         devices = list(devices) if devices is not None else jax.devices()
-        if len(devices) % sp:
-            raise ValueError(f"sp={sp} does not divide {len(devices)} devices")
+        if len(devices) % (sp * tp):
+            raise ValueError(
+                f"sp={sp}·tp={tp} does not divide {len(devices)} devices"
+            )
+        if tp > 1:
+            # innermost axis = tp so its psums ride nearest-neighbor ICI
+            return make_mesh(
+                shape=(len(devices) // (sp * tp), sp, tp),
+                axis_names=(DATA_AXIS, SEQ_AXIS, TP_AXIS),
+                devices=devices,
+            )
         return make_mesh(
             shape=(len(devices) // sp, sp),
             axis_names=(DATA_AXIS, SEQ_AXIS),
@@ -81,6 +92,7 @@ class TransformerLM(TpuModel):
         cfg.update(dict(config or {}))
         cfg.update(overrides)
         sp = int(cfg.get("sp", 1))
+        tp = int(cfg.get("tp", 1))
         if mesh is None:
             mesh = self.build_mesh(config=cfg)
         elif SEQ_AXIS not in mesh.axis_names:
@@ -97,15 +109,38 @@ class TransformerLM(TpuModel):
             raise ValueError(
                 f"config sp={sp} != mesh {SEQ_AXIS} size {mesh.shape[SEQ_AXIS]}"
             )
+        if tp > 1 and TP_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"config tp={tp} but the given mesh has no '{TP_AXIS}' axis "
+                f"({mesh.axis_names}); build it with "
+                f"{type(self).__name__}.build_mesh(...)"
+            )
+        if TP_AXIS in mesh.axis_names and tp > 1 and int(mesh.shape[TP_AXIS]) != tp:
+            raise ValueError(
+                f"config tp={tp} != mesh {TP_AXIS} size {mesh.shape[TP_AXIS]}"
+            )
+        self.tp_size = int(mesh.shape[TP_AXIS]) if TP_AXIS in mesh.axis_names else 1
         if SEQ_AXIS in mesh.axis_names:
             self.sp_size = int(mesh.shape[SEQ_AXIS])
-            # tokens: (batch over dp, sequence over sp); grads contribute
-            # from every (dp, sp) shard, so the exchange reduces over both
+            # tokens: (batch over dp, sequence over sp, replicated over
+            # tp); grads contribute from every (dp, sp) shard, so the
+            # exchange reduces over both
             self.batch_spec = P(DATA_AXIS, SEQ_AXIS)
             self.exchange_axes = (DATA_AXIS, SEQ_AXIS)
         else:
             self.sp_size = 1
+        if self.tp_size > 1:
+            # replicated leaves carry identical full gradients across tp
+            # (the Megatron f/g pair completes cotangents in-block), so tp
+            # joins the mean axes harmlessly; tp-SHARDED leaves skip it
+            # via param_specs in the per-leaf exchange
+            ex = self.exchange_axes
+            self.exchange_axes = (
+                ex + (TP_AXIS,) if isinstance(ex, tuple) else (ex, TP_AXIS)
+            )
         super().__init__(cfg, mesh=mesh)  # cfg = defaults + config + overrides
+        if self.tp_size > 1:
+            self.param_specs = self._build_param_specs()
 
     def build_data(self):
         cfg = self.config
@@ -127,20 +162,31 @@ class TransformerLM(TpuModel):
         cfg = self.config
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
         sp_axis = SEQ_AXIS if self.sp_size > 1 else None
+        tp_axis = TP_AXIS if self.tp_size > 1 else None
         t_local = int(cfg.seq_len) // self.sp_size
         d = int(cfg.d_model)
+        n_heads = int(cfg.n_heads)
+        if self.tp_size > 1 and str(cfg.sp_mode) == "alltoall":
+            if (n_heads // self.tp_size) % self.sp_size:
+                raise ValueError(
+                    f"alltoall SP over tp-local heads needs "
+                    f"(n_heads/tp) % sp == 0, got n_heads={n_heads}, "
+                    f"tp={self.tp_size}, sp={self.sp_size}"
+                )
         net = L.Sequential(
             [
                 A.Embedding(int(cfg.vocab_size), d, compute_dtype=dt),
                 A.PositionalEmbedding(int(cfg.seq_len), sp_axis=sp_axis),
                 *[
                     A.TransformerBlock(
-                        int(cfg.n_heads),
+                        n_heads,
                         mlp_ratio=int(cfg.mlp_ratio),
                         causal=True,
                         sp_axis=sp_axis,
                         sp_size=self.sp_size,
                         sp_mode=str(cfg.sp_mode),
+                        tp_axis=tp_axis,
+                        tp_size=self.tp_size,
                         compute_dtype=dt,
                     )
                     for _ in range(int(cfg.n_layers))
@@ -153,6 +199,29 @@ class TransformerLM(TpuModel):
             float(cfg.lr), list(cfg.lr_boundaries), 0.1
         )
         return net, (t_local,)
+
+    def _build_param_specs(self):
+        """PartitionSpec tree mirroring ``self.params`` (a Sequential's
+        per-layer list): Megatron column/row sharding for every
+        TransformerBlock, everything else replicated."""
+        col = P(None, TP_AXIS)  # output-dim sharded: wq/wk/wv, mlp_in.w
+        row = P(TP_AXIS, None)  # input-dim sharded: wo, mlp_out.w
+        rep = P()
+        specs = []
+        for layer, layer_params in zip(self.net.layers, self.params):
+            if isinstance(layer, A.TransformerBlock):
+                specs.append(
+                    {
+                        "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
+                        "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+                        "ln2": jax.tree.map(lambda _: rep, layer_params["ln2"]),
+                        "mlp_in": {"w": col, "b": P(TP_AXIS)},
+                        "mlp_out": {"w": row, "b": rep},
+                    }
+                )
+            else:
+                specs.append(jax.tree.map(lambda _: rep, layer_params))
+        return specs
 
     def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
         # x, y: int32 (B, T_local) token shards; flatten tokens so the

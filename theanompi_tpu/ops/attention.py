@@ -123,6 +123,13 @@ class MultiHeadAttention(Layer):
     - ``'ring'`` — K/V circulate the ring (``parallel.ring_attention``).
     - ``'alltoall'`` — head⇄sequence reshuffle (``parallel.ulysses``),
       needs ``n_heads % sp_size == 0``.
+
+    ``tp_axis``/``tp_size`` add Megatron-style tensor parallelism:
+    wq/wk/wv are column-parallel (each tp rank owns ``n_heads/tp_size``
+    whole heads), wo is row-parallel with a ``psum`` over ``tp_axis``
+    restoring the replicated residual stream. The owning model supplies
+    the matching ``PartitionSpec`` tree (``TransformerLM.param_specs``)
+    so ``shard_map`` hands each rank its weight shards.
     """
 
     def __init__(
@@ -132,15 +139,24 @@ class MultiHeadAttention(Layer):
         sp_axis: Optional[str] = None,
         sp_size: int = 1,
         sp_mode: str = "ring",
+        tp_axis: Optional[str] = None,
+        tp_size: int = 1,
         compute_dtype: Optional[jnp.dtype] = None,
     ):
         if sp_mode not in ("ring", "alltoall"):
             raise ValueError(f"sp_mode must be 'ring' or 'alltoall', got {sp_mode!r}")
+        if tp_size > 1 and n_heads % tp_size:
+            raise ValueError(
+                f"tensor parallelism needs n_heads % tp == 0, "
+                f"got n_heads={n_heads}, tp={tp_size}"
+            )
         self.n_heads = n_heads
         self.causal = causal
         self.sp_axis = sp_axis
         self.sp_size = sp_size
         self.sp_mode = sp_mode
+        self.tp_axis = tp_axis
+        self.tp_size = tp_size
         self.compute_dtype = compute_dtype
 
     def init(self, key, in_shape):
@@ -169,9 +185,15 @@ class MultiHeadAttention(Layer):
         return y
 
     def apply(self, params, state, x, train=False, rng=None):
-        b, t, d = x.shape
-        h = self.n_heads
-        hd = d // h
+        b, t, d = x.shape  # d = full model dim (residual stream replicated)
+        tp = self.tp_axis is not None and self.tp_size > 1
+        h = self.n_heads // (self.tp_size if tp else 1)
+        hd = d // self.n_heads
+        if tp:
+            from theanompi_tpu.parallel.tensor import copy_to_tp
+
+            x = copy_to_tp(x, self.tp_axis)  # Megatron f: bwd psums cotangents
+        # column-parallel projections: local wq is (d, d/tp) → local heads
         q = self._proj(x, params["wq"]).reshape(b, t, h, hd)
         k = self._proj(x, params["wk"]).reshape(b, t, h, hd)
         v = self._proj(x, params["wv"]).reshape(b, t, h, hd)
@@ -191,8 +213,14 @@ class MultiHeadAttention(Layer):
         else:
             o = full_attention(q, k, v, causal=self.causal)
         # output keeps the flowing activation dtype (softmax statistics
-        # inside ring/ulysses/full attention are fp32 regardless)
-        y = self._proj(o.reshape(b, t, d), params["wo"])
+        # inside ring/ulysses/full attention are fp32 regardless).
+        # Row-parallel wo: local (d/tp, d) partial products summed over tp
+        # restore the replicated residual stream (Megatron g: bwd identity).
+        y = self._proj(o.reshape(b, t, h * hd), params["wo"])
+        if tp:
+            from theanompi_tpu.parallel.tensor import reduce_from_tp
+
+            y = reduce_from_tp(y, self.tp_axis)
         return y, state
 
 
@@ -207,15 +235,20 @@ class TransformerBlock(Layer):
         sp_axis: Optional[str] = None,
         sp_size: int = 1,
         sp_mode: str = "ring",
+        tp_axis: Optional[str] = None,
+        tp_size: int = 1,
         compute_dtype: Optional[jnp.dtype] = None,
     ):
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
         self.attn = MultiHeadAttention(
             n_heads, causal=causal, sp_axis=sp_axis, sp_size=sp_size,
-            sp_mode=sp_mode, compute_dtype=compute_dtype,
+            sp_mode=sp_mode, tp_axis=tp_axis, tp_size=tp_size,
+            compute_dtype=compute_dtype,
         )
         self.mlp_ratio = mlp_ratio
+        self.tp_axis = tp_axis
+        self.tp_size = tp_size
         self.compute_dtype = compute_dtype
 
     def init(self, key, in_shape):
@@ -241,6 +274,15 @@ class TransformerBlock(Layer):
         return params, {}, in_shape
 
     def _mlp(self, params, x):
+        # tp: w1/b1 column-parallel (local (d, dm/tp) / (dm/tp,)), the
+        # gelu runs on the local slice, w2 row-parallel with the Megatron
+        # f/g pair restoring the replicated stream; b2 is added AFTER the
+        # reduce so it isn't counted tp times
+        tp = self.tp_axis is not None and self.tp_size > 1
+        if tp:
+            from theanompi_tpu.parallel.tensor import copy_to_tp
+
+            x = copy_to_tp(x, self.tp_axis)
         w1, w2 = params["mlp_in"]["w"], params["mlp_out"]["w"]
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
@@ -253,6 +295,10 @@ class TransformerBlock(Layer):
         y = jnp.dot(hmid, w2, preferred_element_type=jnp.float32)
         if self.compute_dtype is not None:
             y = y.astype(self.compute_dtype)
+        if tp:
+            from theanompi_tpu.parallel.tensor import reduce_from_tp
+
+            y = reduce_from_tp(y, self.tp_axis)
         return y + params["mlp_out"]["b"].astype(y.dtype)
 
     def apply(self, params, state, x, train=False, rng=None):

@@ -49,6 +49,19 @@ Pytree = Any
 STRATEGIES = ("ar", "bf16", "fp16", "pallas_bf16")
 
 
+def spec_axis_names(spec) -> tuple:
+    """Mesh-axis names a PartitionSpec shards over (flattening sub-tuples)."""
+    names = []
+    for part in tuple(spec):
+        if part is None:
+            continue
+        if isinstance(part, (tuple, list)):
+            names.extend(part)
+        else:
+            names.append(part)
+    return tuple(names)
+
+
 def _compress_leaf_psum(g, axis: str, wire_dtype, pack, unpack):
     """cast → (optional pallas pack) → psum → unpack → fp32."""
     orig_dtype = g.dtype
@@ -78,47 +91,65 @@ class BSP_Exchanger:
         self.strategy = strategy
         self.axis = axis
 
-    # -- in-graph collectives (call inside shard_map) ---------------------
-    def reduce_grads(self, grads: Pytree) -> Pytree:
-        """Mean-reduce gradients across the dp axis (cdd mode)."""
-        axis = self.axis
+    # -- per-leaf reduction recipes ---------------------------------------
+    def _axes_tuple(self) -> tuple:
+        a = self.axis
+        return tuple(a) if isinstance(a, (tuple, list)) else (a,)
+
+    def _leaf_axes(self, spec) -> tuple:
+        """Reduction axes for one leaf: the exchange axes MINUS any axis the
+        leaf's PartitionSpec shards over.
+
+        Tensor-parallel leaves (e.g. a column-parallel ``wq`` sharded over
+        ``tp``) hold disjoint parameter shards whose gradients are already
+        complete on each tp rank — summing them over tp would be wrong.
+        Replicated leaves' gradients are *partial* over tp (the deferred
+        psum of the TP backward) and must reduce over every axis."""
+        if spec is None:
+            return self._axes_tuple()
+        sharded = set(spec_axis_names(spec))
+        return tuple(a for a in self._axes_tuple() if a not in sharded)
+
+    def _reduce_leaf_mean(self, g, axes: tuple):
+        if not axes:
+            return g
         if self.strategy == "ar":
-            return jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+            return lax.pmean(g, axes).astype(g.dtype)
         if self.strategy in ("bf16", "fp16"):
             wire = jnp.bfloat16 if self.strategy == "bf16" else jnp.float16
-            n = lax.psum(1, axis)
-
-            def red(g):
-                r = _compress_leaf_psum(
-                    g,
-                    axis,
-                    wire,
-                    pack=lambda x, d: x.astype(d),
-                    unpack=lambda x, d: x.astype(jnp.float32),
-                )
-                return (r / n).astype(g.dtype)
-
-            return jax.tree.map(red, grads)
-        if self.strategy == "pallas_bf16":
+            pack = lambda x, d: x.astype(d)  # noqa: E731
+            unpack = lambda x, d: x.astype(jnp.float32)  # noqa: E731
+        else:  # pallas_bf16
             from theanompi_tpu.parallel.pallas_pack import pack_bf16, unpack_fp32
 
-            n = lax.psum(1, axis)
+            wire, pack, unpack = jnp.bfloat16, pack_bf16, unpack_fp32
+        r = _compress_leaf_psum(g, axes, wire, pack=pack, unpack=unpack)
+        return (r / lax.psum(1, axes)).astype(g.dtype)
 
-            def red(g):
-                r = _compress_leaf_psum(
-                    g, axis, jnp.bfloat16, pack=pack_bf16, unpack=unpack_fp32
-                )
-                return (r / n).astype(g.dtype)
+    # -- in-graph collectives (call inside shard_map) ---------------------
+    def reduce_grads(self, grads: Pytree, specs: Optional[Pytree] = None) -> Pytree:
+        """Mean-reduce gradients across the exchange axes (cdd mode).
 
-            return jax.tree.map(red, grads)
-        raise AssertionError(self.strategy)
+        ``specs`` (optional): pytree of ``PartitionSpec`` matching
+        ``grads`` — per-leaf parameter shardings for tensor-parallel
+        models; ``None`` means fully replicated params (plain DP)."""
+        if specs is None:
+            return jax.tree.map(
+                lambda g: self._reduce_leaf_mean(g, self._axes_tuple()), grads
+            )
+        return jax.tree.map(
+            lambda g, s: self._reduce_leaf_mean(g, self._leaf_axes(s)),
+            grads,
+            specs,
+        )
 
     def sum_grads(self, grads: Pytree) -> Pytree:
         """Sum-reduce (the reference's cdd summed; workers then scaled lr)."""
         return jax.tree.map(lambda g: lax.psum(g, self.axis), grads)
 
     def average_params(self, params: Pytree) -> Pytree:
-        """Parameter averaging after local steps (avg mode)."""
+        """Parameter averaging after local steps (avg mode; DP-only —
+        tensor-parallel models are rejected at compile_train)."""
         return jax.tree.map(lambda p: lax.pmean(p, self.axis), params)
 
     def __repr__(self):

@@ -1,0 +1,67 @@
+"""Tensor-parallel collective pair (Megatron's f/g functions, TPU-style).
+
+Beyond-reference (Theano-MPI is data-parallel only, SURVEY.md §3.4):
+building blocks for column/row-parallel matmuls inside ``shard_map``
+over a ``tp`` mesh axis.
+
+Why custom VJPs instead of raw ``lax.psum``: the step functions run
+under ``shard_map(..., check_vma=False)``, where autodiff cannot know a
+cotangent is replicated across ``tp`` — transposing a bare forward psum
+would over-count by the axis size. The canonical solution (Megatron's
+``f``/``g``) makes the conjugate pair explicit:
+
+- ``copy_to_tp``   — forward identity (activations are replicated into
+  each rank's column-parallel matmul), backward ``psum`` (the partial
+  cotangents from each rank's weight shard sum to the true cotangent).
+- ``reduce_from_tp`` — forward ``psum`` (row-parallel partial products
+  sum to the replicated output), backward identity (the replicated
+  cotangent is already what each rank needs).
+
+With the pair in place every parameter gradient is complete on its own
+rank: replicated leaves hold identical full gradients across ``tp``
+(the dp-mean exchange is a no-op over tp), and tp-sharded leaves hold
+their shard's gradient (the exchange skips tp via ``param_specs``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from theanompi_tpu.runtime.mesh import TP_AXIS
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp(x, axis=TP_AXIS):
+    """Identity forward; psum over ``axis`` backward (Megatron's f)."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+copy_to_tp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp(x, axis=TP_AXIS):
+    """psum over ``axis`` forward; identity backward (Megatron's g)."""
+    return lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, ct):
+    return (ct,)
+
+
+reduce_from_tp.defvjp(_reduce_fwd, _reduce_bwd)

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical mesh-axis names used across the framework.
 DATA_AXIS = "dp"  # data parallelism (the only axis the reference had)
-MODEL_AXIS = "mp"  # reserved for tensor parallelism (not in reference scope)
+TP_AXIS = "tp"  # tensor parallelism (beyond-reference; Megatron-style)
 
 
 # Env markers that indicate a multi-process launch. Cloud TPU pods do NOT

@@ -59,10 +59,10 @@ def comm_fraction(model_cls, config: dict, mesh=None, n_steps: int = 20) -> Dict
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
 
     class _NoExchange(BSP_Exchanger):
-        def reduce_grads(self, grads):
+        def reduce_grads(self, grads, specs=None):
             return grads
 
-        def average_params(self, params):
+        def average_params(self, params, specs=None):
             return params
 
     without = model_cls(config=dict(config), mesh=mesh)
