@@ -1,0 +1,115 @@
+"""Checkpoint format v2: JSON structure, no pickle (VERDICT round-1 #9).
+
+Reference analog: `load_model/save_model` in upstream
+``theanompi/lib/helper_funcs.py`` saved per-param ``.npy`` / pickled
+lists (SURVEY.md §3.7); the v2 format here keeps one-file atomic
+snapshots but removes executable deserialization entirely.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.utils import checkpoint as ckpt
+
+
+def _sample_tree():
+    return {
+        "params": {
+            "conv1": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                      "b": np.zeros(4, np.float32)},
+            "blocks": [
+                {"scale": np.float32(1.5)},
+                {"scale": np.float32(2.5)},
+            ],
+        },
+        "opt_state": {"lr": np.float32(0.01),
+                      "momentum": (np.ones(3), np.zeros(3))},
+        "epoch": 7,
+        "tag": "wrn-28-10",
+        "done": False,
+        "aux": None,
+        "ratio": 0.25,
+    }
+
+
+def test_roundtrip_types_exact(tmp_path):
+    tree = _sample_tree()
+    path = ckpt.save(str(tmp_path / "c.npz"), tree)
+    back = ckpt.restore(path)
+    assert back["epoch"] == 7 and isinstance(back["epoch"], int)
+    assert back["tag"] == "wrn-28-10" and isinstance(back["tag"], str)
+    assert back["done"] is False
+    assert back["aux"] is None
+    assert isinstance(back["ratio"], float) and back["ratio"] == 0.25
+    assert isinstance(back["opt_state"]["momentum"], tuple)
+    assert isinstance(back["params"]["blocks"], list)
+    np.testing.assert_array_equal(
+        back["params"]["conv1"]["w"], tree["params"]["conv1"]["w"]
+    )
+    np.testing.assert_array_equal(
+        back["opt_state"]["momentum"][0], np.ones(3)
+    )
+
+
+def test_restore_never_touches_pickle(tmp_path, monkeypatch):
+    """The v2 path must not deserialize executable state."""
+    path = ckpt.save(str(tmp_path / "c.npz"), _sample_tree())
+
+    def _bomb(*a, **k):  # any pickle.loads call is a security regression
+        raise AssertionError("pickle.loads called on v2 checkpoint path")
+
+    monkeypatch.setattr(pickle, "loads", _bomb)
+    monkeypatch.setattr(pickle, "load", _bomb)
+    back = ckpt.restore(path)
+    assert back["epoch"] == 7
+
+
+def test_legacy_v1_file_still_restores(tmp_path):
+    """Round-1 checkpoints embedded a pickled treedef; keep reading them."""
+    import jax
+
+    tree = {"w": np.ones((2, 2), np.float32), "epoch": np.asarray(3)}
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["__meta__"] = np.frombuffer(
+        pickle.dumps({"treedef": treedef, "meta": {"n_leaves": len(leaves)}}),
+        dtype=np.uint8,
+    )
+    p = tmp_path / "old.npz"
+    np.savez(p, **arrays)
+    back = ckpt.restore(str(p))
+    np.testing.assert_array_equal(back["w"], tree["w"])
+
+
+def test_namedtuple_structure_preserved(tmp_path):
+    """namedtuple containers (optax-style opt states) must round-trip as
+    namedtuples, not collapse to plain tuples (v1 pickle preserved them)."""
+    from collections import namedtuple
+
+    Point = namedtuple("Point", ["x", "y"])
+    tree = {"state": Point(np.ones(2), np.zeros(3)), "epoch": 1}
+    path = ckpt.save(str(tmp_path / "c.npz"), tree)
+    back = ckpt.restore(path)
+    st = back["state"]
+    assert isinstance(st, tuple) and st._fields == ("x", "y")
+    np.testing.assert_array_equal(st.x, np.ones(2))
+    np.testing.assert_array_equal(st.y, np.zeros(3))
+
+
+def test_unsupported_leaf_raises(tmp_path):
+    with pytest.raises(TypeError, match="cannot serialize"):
+        ckpt.save(str(tmp_path / "c.npz"), {"fn": lambda x: x})
+
+
+def test_non_checkpoint_file_rejected(tmp_path):
+    p = tmp_path / "junk.npz"
+    np.savez(p, a=np.zeros(3))
+    with pytest.raises(ValueError, match="not a theanompi_tpu checkpoint"):
+        ckpt.restore(str(p))
+
+
+def test_atomic_save_leaves_no_tmp(tmp_path):
+    ckpt.save(str(tmp_path / "c.npz"), {"x": np.zeros(2)})
+    assert [f.name for f in tmp_path.iterdir()] == ["c.npz"]

@@ -6,8 +6,13 @@ save (one ``.npy`` per param / pickled lists) plus ``load_model`` /
 §3.7 / §6).  Here a whole training-state pytree (params, optimizer state,
 BN state, epoch, rng) is serialized in one shot:
 
-- arrays → ``.npz`` (one entry per flattened-pytree leaf, keyed by path)
-- structure + scalars → a small JSON sidecar inside the same file
+- arrays → ``.npz`` (one entry per leaf, ``leaf_{i}``)
+- tree structure → a JSON document stored as a uint8 npz entry
+  (``__structure__``): containers are encoded recursively
+  (dict/list/tuple/None), leaves by index + python-kind, so restore
+  never deserializes executable state.  ``pickle`` is not imported on
+  the v2 path at all — v1 files (which embedded a pickled treedef) are
+  still readable through a lazy legacy branch.
 
 Orbax is available in the environment for users who want async /
 multi-host checkpointing; this module stays dependency-free so restart
@@ -21,37 +26,115 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Any, Dict
+from typing import Any, List, Tuple
 
-import jax
 import numpy as np
 
-_META_KEY = "__meta__"
+_META_KEY = "__meta__"  # v1 (pickled treedef) marker
+_STRUCT_KEY = "__structure__"  # v2 JSON structure
+FORMAT_VERSION = 2
 
 
-def _flatten_with_paths(tree: Any) -> Dict[str, Any]:
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = leaf
-    return flat
+def _encode(node: Any, leaves: List[np.ndarray]) -> Any:
+    """Recursively replace container nodes with JSON-able descriptors and
+    leaves with ``{"leaf": i, "kind": ...}`` index records."""
+    if isinstance(node, dict):
+        # sort_keys=False: preserve insertion order (models rely on it)
+        return {"t": "dict", "k": list(node.keys()),
+                "v": [_encode(node[k], leaves) for k in node.keys()]}
+    if isinstance(node, tuple) and hasattr(node, "_fields"):
+        # namedtuple (e.g. an optax-style opt_state): record the field
+        # names and class identity so restore can rebuild the same
+        # pytree structure, not a plain tuple
+        return {
+            "t": "ntuple",
+            "cls": f"{type(node).__module__}:{type(node).__qualname__}",
+            "f": list(node._fields),
+            "v": [_encode(x, leaves) for x in node],
+        }
+    if isinstance(node, tuple):
+        return {"t": "tuple", "v": [_encode(x, leaves) for x in node]}
+    if isinstance(node, list):
+        return {"t": "list", "v": [_encode(x, leaves) for x in node]}
+    if node is None:
+        return {"t": "none"}
+    # leaf: device array / np array / python scalar
+    if isinstance(node, (bool, int, float, str)):
+        kind = type(node).__name__
+    elif hasattr(node, "shape"):  # jax.Array / np.ndarray / np scalar
+        kind = "array"
+    else:
+        raise TypeError(
+            f"checkpoint cannot serialize leaf of type {type(node).__name__}; "
+            "supported: arrays, bool/int/float/str, dict/list/tuple/None"
+        )
+    idx = len(leaves)
+    leaves.append(np.asarray(node))
+    return {"t": "leaf", "i": idx, "kind": kind}
+
+
+def _decode(desc: Any, leaves: List[np.ndarray]) -> Any:
+    t = desc["t"]
+    if t == "dict":
+        return {k: _decode(v, leaves) for k, v in zip(desc["k"], desc["v"])}
+    if t == "tuple":
+        return tuple(_decode(v, leaves) for v in desc["v"])
+    if t == "ntuple":
+        vals = [_decode(v, leaves) for v in desc["v"]]
+        cls = _resolve_namedtuple(desc.get("cls", ""), desc["f"])
+        return cls(*vals)
+    if t == "list":
+        return [_decode(v, leaves) for v in desc["v"]]
+    if t == "none":
+        return None
+    if t == "leaf":
+        a = leaves[desc["i"]]
+        kind = desc.get("kind", "array")
+        if kind == "array":
+            return a
+        # python scalar round-trip (epoch counters, flags, tags)
+        return {"bool": bool, "int": int, "float": float, "str": str}[kind](
+            a.item() if a.shape == () else a
+        )
+    raise ValueError(f"unknown checkpoint node type {t!r} (corrupt file?)")
+
+
+def _resolve_namedtuple(qualified: str, fields: List[str]):
+    """Recover the namedtuple class for restore.
+
+    Tries the recorded ``module:qualname`` (an attribute lookup on an
+    importable module — far weaker than pickle, which executes arbitrary
+    reduce callables), accepting it only if it really is a namedtuple
+    class with the same fields; otherwise builds an anonymous namedtuple
+    with the right field names, which keeps attribute access and pytree
+    arity working."""
+    import collections
+    import importlib
+
+    mod_name, _, qual = qualified.partition(":")
+    if mod_name and qual and "." not in qual:  # no nested-class traversal
+        try:
+            cls = getattr(importlib.import_module(mod_name), qual, None)
+            if (
+                isinstance(cls, type)
+                and issubclass(cls, tuple)
+                and getattr(cls, "_fields", None) == tuple(fields)
+            ):
+                return cls
+        except ImportError:
+            pass
+    return collections.namedtuple(qual or "Restored", fields)
 
 
 def save(path: str, tree: Any) -> str:
     """Serialize a pytree of arrays/scalars to ``path`` (.npz), atomically."""
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    host_leaves = [np.asarray(leaf) for leaf in leaves]
-    arrays = {f"leaf_{i}": a for i, a in enumerate(host_leaves)}
-    meta = {
-        "treedef": str(treedef),  # human-readable; structure restored below
-        "n_leaves": len(leaves),
-    }
-    # store the treedef via pickle-free round trip: we re-flatten on restore
-    # using a structure file produced by jax.tree_util serialization
-    import pickle
-
-    arrays[_META_KEY] = np.frombuffer(
-        pickle.dumps({"treedef": treedef, "meta": meta}), dtype=np.uint8
+    leaves: List[np.ndarray] = []
+    structure = _encode(tree, leaves)
+    arrays = {f"leaf_{i}": a for i, a in enumerate(leaves)}
+    doc = {"format": FORMAT_VERSION, "n_leaves": len(leaves),
+           "structure": structure}
+    arrays[_STRUCT_KEY] = np.frombuffer(
+        json.dumps(doc).encode("utf-8"), dtype=np.uint8
     )
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
@@ -67,15 +150,26 @@ def save(path: str, tree: Any) -> str:
 
 
 def restore(path: str) -> Any:
-    """Inverse of :func:`save`. Returns host numpy leaves."""
-    import pickle
+    """Inverse of :func:`save`. Returns host numpy leaves.
 
+    Reads the v2 JSON-structure format natively (``pickle`` never
+    imported); v1 files written by round-1 builds fall through to a
+    legacy branch that lazily imports pickle — only ever taken when the
+    v1 marker entry is present."""
     with np.load(path, allow_pickle=False) as d:
-        blob = pickle.loads(d[_META_KEY].tobytes())
-        treedef = blob["treedef"]
-        n = blob["meta"]["n_leaves"]
-        leaves = [d[f"leaf_{i}"] for i in range(n)]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+        if _STRUCT_KEY in d.files:
+            doc = json.loads(d[_STRUCT_KEY].tobytes().decode("utf-8"))
+            leaves = [d[f"leaf_{i}"] for i in range(doc["n_leaves"])]
+            return _decode(doc["structure"], leaves)
+        if _META_KEY in d.files:  # v1 backward compat
+            import pickle  # noqa: lazy — only for legacy files
+
+            blob = pickle.loads(d[_META_KEY].tobytes())
+            import jax
+
+            leaves = [d[f"leaf_{i}"] for i in range(blob["meta"]["n_leaves"])]
+            return jax.tree_util.tree_unflatten(blob["treedef"], leaves)
+    raise ValueError(f"{path}: not a theanompi_tpu checkpoint (no structure entry)")
 
 
 def latest(dir_path: str, prefix: str = "ckpt_") -> str | None:
