@@ -91,6 +91,54 @@ def test_gosgd_end_to_end():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_easgd_server_duties_and_resume(tmp_path):
+    """Reference ``easgd_server.py`` duties (SURVEY.md §4.3): the center
+    is validated and checkpointed DURING training, per epoch — and a new
+    run can resume from the latest center snapshot (VERDICT round-1 #4)."""
+    rule = theanompi_tpu.EASGD()
+    rule.init(
+        devices=4,
+        model_config=TINY,
+        n_workers=2,
+        tau=3,
+        checkpoint_dir=str(tmp_path),
+        verbose=False,
+    )
+    rule.wait()
+    # per-epoch center checkpoints exist (n_epochs=2)
+    names = sorted(f.name for f in tmp_path.iterdir())
+    assert "ckpt_center_0001.npz" in names
+    assert "ckpt_center_0002.npz" in names
+    # mid-run validation happened: one entry per epoch, recorded by the
+    # server (not the end-of-run result validation, which lands in the
+    # worker-0 recorder)
+    assert len(rule.worker.server_recorder.val_history) == 2
+    assert "record_server.jsonl" in names
+
+    # resume: a fresh driver starts at epoch 2 with the saved center
+    rule2 = theanompi_tpu.EASGD()
+    rule2.init(
+        devices=4,
+        model_config=dict(TINY, n_epochs=3),
+        n_workers=2,
+        tau=3,
+        checkpoint_dir=str(tmp_path),
+        resume=True,
+        verbose=False,
+    )
+    rule2.worker._build_workers()
+    assert rule2.worker.start_epoch == 2
+    from theanompi_tpu.utils import checkpoint as ckpt
+
+    saved = ckpt.restore(str(tmp_path / "ckpt_center_0002.npz"))
+    w0 = rule2.worker.workers[0]
+    assert w0.model.current_epoch == 2
+    got = jax.tree.leaves(w0.get_params())
+    want = jax.tree.leaves(saved["params"])
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_easgd_worker_error_propagates():
     rule = theanompi_tpu.EASGD()
     with pytest.raises(ValueError):

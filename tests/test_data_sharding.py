@@ -1,0 +1,127 @@
+"""Per-worker data sharding for the async rules (VERDICT round-1 #3).
+
+The round-1 bug: async workers only got a shifted *seed*, and the
+epoch-seeded shuffle is deliberately rank-independent — so on a real
+dataset every EASGD/GOSGD worker trained on the identical batch stream.
+These tests pin the fix with a real on-disk dataset (tmp CIFAR pickles),
+not the synthetic path that masked the bug.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.providers import (
+    ArrayDataset,
+    Cifar10Data,
+    ImageNetData,
+    LMTextData,
+)
+
+
+def _write_fake_cifar(tmp_path, n_per_batch=64):
+    """Standard CIFAR-10 python-pickle layout, tiny."""
+    rng = np.random.RandomState(0)
+    for i in range(1, 6):
+        d = {
+            b"data": rng.randint(0, 255, (n_per_batch, 3072), dtype=np.uint8),
+            b"labels": rng.randint(0, 10, n_per_batch).tolist(),
+        }
+        with open(tmp_path / f"data_batch_{i}", "wb") as f:
+            pickle.dump(d, f)
+    d = {
+        b"data": rng.randint(0, 255, (n_per_batch, 3072), dtype=np.uint8),
+        b"labels": rng.randint(0, 10, n_per_batch).tolist(),
+    }
+    with open(tmp_path / "test_batch", "wb") as f:
+        pickle.dump(d, f)
+
+
+def test_real_dataset_workers_get_different_streams(tmp_path):
+    """Two workers over the SAME on-disk dataset must see different,
+    disjoint batch streams (reference: per-rank batch division)."""
+    _write_fake_cifar(tmp_path)
+    streams = []
+    for rank in range(2):
+        data = Cifar10Data(batch_size=32, data_dir=str(tmp_path), seed=0)
+        assert not data.synthetic
+        data.shard_for_worker(rank, 2)
+        data.shuffle(epoch=0)
+        streams.append(list(data.train_batches()))
+    x0, x1 = streams[0][0][0], streams[1][0][0]
+    assert x0.shape == x1.shape == (32, 32, 32, 3)
+    assert not np.array_equal(x0, x1)  # round-1 bug: these were identical
+    # disjoint: no example of worker 0's epoch appears in worker 1's
+    flat0 = {b.tobytes() for (xb, _) in streams[0] for b in xb}
+    flat1 = {b.tobytes() for (xb, _) in streams[1] for b in xb}
+    assert not (flat0 & flat1)
+
+
+def test_shards_cover_the_whole_epoch():
+    x = np.arange(128, dtype=np.float32).reshape(128, 1)
+    y = np.zeros(128, np.int32)
+    seen = set()
+    for rank in range(4):
+        ds = ArrayDataset(x, y, x[:8], y[:8], batch_size=8)
+        ds.shard_for_worker(rank, 4)
+        ds.shuffle(epoch=3)
+        assert ds.n_batch_train == 4
+        for xb, _ in ds.train_batches():
+            seen.update(float(v) for v in xb.ravel())
+    assert seen == set(range(128))  # disjoint AND complete
+
+
+def test_shard_too_small_raises():
+    x = np.zeros((64, 1), np.float32)
+    ds = ArrayDataset(x, np.zeros(64, np.int32), x[:8], np.zeros(8, np.int32),
+                      batch_size=48)
+    with pytest.raises(ValueError, match="worker shard too small"):
+        ds.shard_for_worker(0, 2)
+    with pytest.raises(ValueError, match="outside"):
+        ds.shard_for_worker(2, 2)
+
+
+def test_imagenet_files_sharded():
+    datas = []
+    for rank in range(2):
+        d = ImageNetData(batch_size=4, image_size=8, n_synth_batches=8)
+        d.shard_for_worker(rank, 2)
+        d.shuffle(epoch=0)
+        datas.append(d)
+    assert datas[0].n_batch_train == datas[1].n_batch_train == 4
+    f0 = [datas[0].train_files[i] for i in datas[0]._my_order()]
+    f1 = [datas[1].train_files[i] for i in datas[1]._my_order()]
+    assert not (set(f0) & set(f1))
+    assert len(set(f0) | set(f1)) == 8
+
+
+def test_lmtext_sharded():
+    streams = []
+    for rank in range(2):
+        d = LMTextData(batch_size=2, seq_len=16, n_synth_train=8, seed=0)
+        d.shard_for_worker(rank, 2)
+        d.shuffle(epoch=0)
+        streams.append([x.tobytes() for x, _ in d.train_batches()])
+    assert streams[0] and streams[0] != streams[1]
+
+
+def test_async_workers_are_sharded():
+    """End-to-end: EASGD workers must come up with sharded providers."""
+    import theanompi_tpu
+
+    rule = theanompi_tpu.EASGD()
+    rule.init(
+        devices=4,
+        model_config=dict(
+            batch_size=8, n_epochs=1, n_synth_train=128, n_synth_val=64,
+            dropout_rate=0.0, print_freq=1000,
+        ),
+        n_workers=2,
+        tau=2,
+        verbose=False,
+    )
+    for w in rule.worker.workers:
+        ds = w.model.data.dataset
+        assert (ds._worker_rank, ds._n_workers) == (w.rank, 2)
+    rule.wait()

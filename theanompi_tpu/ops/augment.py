@@ -1,0 +1,81 @@
+"""Data augmentation ops — per-image random crop + horizontal mirror.
+
+Reference analog: the ImageNet pipeline's crop/mirror augmentation
+(upstream ``theanompi/models/data/imagenet.py``; SURVEY.md §3.6), which
+drew offsets PER IMAGE.  Round 1 approximated this with one offset per
+global batch — at bs512 that is a measurable augmentation-entropy loss
+(VERDICT round-1 #7).
+
+Two implementations with identical semantics:
+
+- :func:`random_crop_mirror` — the TPU-first path: pure jax, runs INSIDE
+  the jitted train step (``device_aug=True`` in the model config).  The
+  crop is a vmapped ``dynamic_slice`` (per-image offsets, static crop
+  size, so XLA sees static shapes) and the mirror a masked reverse —
+  both fuse into the step's prologue, costing ~0 extra HBM round-trips.
+- :func:`np_crop_mirror` — vectorized numpy for the host providers
+  (real-data pipelines that pre-augment on CPU, like the reference did).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def random_crop_mirror(
+    key,
+    x,
+    crop_size: Optional[int] = None,
+    mirror: bool = True,
+):
+    """Per-image random crop + horizontal mirror, jit-safe.
+
+    Args:
+      key: PRNG key (fold in the step/shard before calling).
+      x: (N, H, W, C) batch.
+      crop_size: output side length (static); None/>=H = no crop.
+      mirror: flip each image left-right with probability 1/2.
+    """
+    n = x.shape[0]
+    kh, kw, km = jax.random.split(key, 3)
+    if crop_size and crop_size < x.shape[1]:
+        c = int(crop_size)
+        ch = x.shape[-1]
+        max_off = x.shape[1] - c
+        oh = jax.random.randint(kh, (n,), 0, max_off + 1)
+        ow = jax.random.randint(kw, (n,), 0, max_off + 1)
+        x = jax.vmap(
+            lambda img, i, j: lax.dynamic_slice(img, (i, j, 0), (c, c, ch))
+        )(x, oh, ow)
+    if mirror:
+        flip = jax.random.bernoulli(km, 0.5, (n,))
+        x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+    return x
+
+
+def np_crop_mirror(
+    rng: np.random.RandomState,
+    x: np.ndarray,
+    crop_size: Optional[int] = None,
+    mirror: bool = True,
+) -> np.ndarray:
+    """Host (numpy) twin of :func:`random_crop_mirror` — one gather for
+    the whole batch, no per-image python loop."""
+    n = x.shape[0]
+    if crop_size and crop_size < x.shape[1]:
+        c = int(crop_size)
+        max_off = x.shape[1] - c
+        oh = rng.randint(0, max_off + 1, size=n)
+        ow = rng.randint(0, max_off + 1, size=n)
+        rows = oh[:, None, None] + np.arange(c)[None, :, None]
+        cols = ow[:, None, None] + np.arange(c)[None, None, :]
+        x = x[np.arange(n)[:, None, None], rows, cols]
+    if mirror:
+        flip = rng.rand(n) < 0.5
+        x = np.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+    return np.ascontiguousarray(x)

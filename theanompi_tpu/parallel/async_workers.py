@@ -89,20 +89,61 @@ class _AsyncWorkerBase:
     """Common thread body: local model + train loop + exchange hook."""
 
     def __init__(self, rank, devices, modelfile, modelclass, model_config, n_epochs,
-                 recorder: Recorder):
+                 recorder: Recorder, n_workers: Optional[int] = None):
         self.rank = rank
         self.devices = devices
         self.recorder = recorder
         cfg = dict(model_config or {})
-        # different data order per worker (reference: per-rank shard)
-        cfg["seed"] = int(cfg.get("seed", 0)) + rank
         cls = getattr(importlib.import_module(modelfile), modelclass)
         self.model = cls(
             config=cfg, mesh=cls.build_mesh(devices=devices, config=cfg)
         )
+        # Disjoint per-worker example streams (reference: per-rank batch
+        # division, SURVEY.md §3.6). All workers share the dataset and the
+        # epoch-seeded permutation; each takes its rank::n slice — real
+        # data diversity, not just a shifted seed (round-1 VERDICT bug:
+        # identical streams across async workers on real datasets).
+        # Custom duck-typed providers without shard_for_worker keep
+        # working via the old behavior — rebuild the model with a
+        # per-rank seed shift — loudly, since on a real dataset a seed
+        # shift alone does NOT diversify the stream.
+        if n_workers and n_workers > 1:
+            shard = getattr(self.model.data, "shard_for_worker", None)
+            if shard is not None:
+                shard(rank, n_workers)
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"{type(self.model.data).__name__} lacks shard_for_worker; "
+                    f"falling back to a per-rank seed shift. If the provider "
+                    f"ignores its seed (real on-disk data), all async workers "
+                    f"will train on the SAME batch stream — implement "
+                    f"shard_for_worker(rank, n_workers) to fix this",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                cfg["seed"] = int(cfg.get("seed", 0)) + rank
+                self.model = cls(
+                    config=cfg, mesh=cls.build_mesh(devices=devices, config=cfg)
+                )
+        # per-worker rng stream (dropout masks, device aug) — data order
+        # is handled by sharding above, but the in-step rng must differ
+        # per worker too or single-device workers draw identical masks
+        self.model.rng = jax.random.fold_in(self.model.rng, rank)
         if n_epochs is not None:
             self.model.n_epochs = n_epochs
         self.error: Optional[BaseException] = None
+        # host-side snapshot of BN/running state taken by the worker
+        # thread at each epoch boundary: the server's center validation
+        # reads THIS, never the live training state (whose buffers the
+        # donating jitted step invalidates concurrently)
+        self.host_net_state: Optional[Pytree] = None
+        # driver-installed hooks (epoch-completion protocol: the EASGD
+        # server thread validates/saves the center once all live workers
+        # pass an epoch boundary — reference server duties, SURVEY.md §4.3)
+        self.on_epoch_end = None  # fn(rank, epoch)
+        self.on_exit = None  # fn(rank)
 
     def set_params(self, host_params: Pytree) -> None:
         self.model.params = replicate(self.model.mesh, host_params)
@@ -115,6 +156,17 @@ class _AsyncWorkerBase:
             self._run()
         except BaseException as e:  # joined + re-raised by the driver
             self.error = e
+        finally:
+            if self.on_exit is not None:
+                self.on_exit(self.rank)
+
+    def _epoch_end(self, epoch: int) -> None:
+        self.model.current_epoch = epoch + 1
+        if self.on_epoch_end is not None:
+            # worker thread owns the state between steps — snapshot here,
+            # so the server thread never touches donated buffers
+            self.host_net_state = _to_host(self.model.net_state)
+            self.on_epoch_end(self.rank, epoch)
 
     def _run(self):
         raise NotImplementedError
@@ -129,9 +181,9 @@ class EASGD_Worker(_AsyncWorkerBase):
     def _run(self):
         model, rec = self.model, self.recorder
         model.compile_train()
-        count = 0
+        count = model.current_epoch * model.data.n_batch_train
         since_exchange = 0
-        for epoch in range(model.n_epochs):
+        for epoch in range(model.current_epoch, model.n_epochs):
             model.adjust_hyperp(epoch)
             model.reset_train_iter(epoch)
             for _ in range(model.data.n_batch_train):
@@ -145,6 +197,7 @@ class EASGD_Worker(_AsyncWorkerBase):
                     new_w = self.server.exchange(self.get_params())
                     self.set_params(new_w)
                     rec.end("comm")
+            self._epoch_end(epoch)
 
 
 class GOSGD_Worker(_AsyncWorkerBase):
@@ -185,8 +238,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
     def _run(self):
         model, rec = self.model, self.recorder
         model.compile_train()
-        count = 0
-        for epoch in range(model.n_epochs):
+        count = model.current_epoch * model.data.n_batch_train
+        for epoch in range(model.current_epoch, model.n_epochs):
             model.adjust_hyperp(epoch)
             model.reset_train_iter(epoch)
             for _ in range(model.data.n_batch_train):
@@ -195,6 +248,7 @@ class GOSGD_Worker(_AsyncWorkerBase):
                 rec.print_train_info(count)
                 self._merge_inbox()
                 self._maybe_push()
+            self._epoch_end(epoch)
         # final drain so in-flight pushes aren't lost at shutdown
         self._merge_inbox()
 
@@ -241,16 +295,24 @@ class _AsyncDriverBase:
     def _finalize(self):
         raise NotImplementedError
 
+    def _start_aux(self):
+        """Hook: driver-side background duties (EASGD server thread)."""
+
+    def _stop_aux(self):
+        """Hook: join background duties after workers exit."""
+
     def run(self):
         self._build_workers()
         threads = [
             threading.Thread(target=w.run, name=f"{type(w).__name__}-{w.rank}")
             for w in self.workers
         ]
+        self._start_aux()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        self._stop_aux()
         errs = [w.error for w in self.workers if w.error is not None]
         if errs:
             raise errs[0]
@@ -267,13 +329,36 @@ class _AsyncDriverBase:
 
 class EASGD_Driver(_AsyncDriverBase):
     """Server + N elastic-averaging workers (reference ``async_rule.EASGD``
-    spawning N workers + 1 server rank; SURVEY.md §3.1)."""
+    spawning N workers + 1 server rank; SURVEY.md §3.1).
 
-    def __init__(self, *args, tau: int = 10, alpha: float = 0.5, **kw):
+    The server's *in-training* duties match the reference
+    ``easgd_server.py`` loop (SURVEY.md §4.3): when every live worker
+    passes an epoch boundary, the server validates the CENTER params,
+    checkpoints them (``ckpt_center_{epoch:04d}.npz``), and records the
+    result — so a long run produces mid-run signal and mid-run restart
+    points of the model that matters.  ``resume=True`` restarts from the
+    latest center checkpoint.  (lr scheduling stays in the workers'
+    ``adjust_hyperp`` — our schedule is epoch-deterministic, so the
+    reference's server-pushed lr adjustments need no central authority.)
+    """
+
+    def __init__(self, *args, tau: int = 10, alpha: float = 0.5,
+                 resume: bool = False, **kw):
         super().__init__(*args, **kw)
         self.tau = tau
         self.alpha = alpha
+        self.resume = resume
         self.server: Optional[EASGD_Server] = None
+        self.server_recorder: Optional[Recorder] = None
+        self.start_epoch = 0
+        self._cv = threading.Condition()
+        self._epoch_counts: dict = {}
+        self._n_running = 0
+        self._n_failed = 0  # workers that exited WITH an error: they will
+        # never report further epoch boundaries, so the duties predicate
+        # must stop expecting them — but a worker that finished normally
+        # already reported every epoch and keeps counting toward it
+        self._duties_thread: Optional[threading.Thread] = None
 
     def _build_workers(self):
         groups = _split_devices(self.devices, self.n_workers)
@@ -286,6 +371,7 @@ class EASGD_Driver(_AsyncDriverBase):
                 self.model_config,
                 self.n_epochs,
                 self._make_recorder(rank),
+                n_workers=self.n_workers,
                 server=None,  # set below once center exists
                 tau=self.tau,
             )
@@ -294,10 +380,113 @@ class EASGD_Driver(_AsyncDriverBase):
         # center = worker 0's init (reference: server rank initializes and
         # broadcasts); all workers start at the center
         center = self.workers[0].get_params()
+        if self.resume and self.checkpoint_dir:
+            from theanompi_tpu.utils import checkpoint as ckpt
+
+            path = ckpt.latest(self.checkpoint_dir, prefix="ckpt_center_")
+            if path:
+                blob = ckpt.restore(path)
+                center = blob["params"]
+                self.start_epoch = int(blob["epoch"])
+                print(f"EASGD: resumed center from {path} "
+                      f"at epoch {self.start_epoch}", flush=True)
         self.server = EASGD_Server(center, self.alpha)
+        self.server_recorder = Recorder(
+            print_freq=1, rank=0, verbose=self.verbose,
+            save_dir=self.checkpoint_dir,
+        )
         for w in self.workers:
             w.server = self.server
             w.set_params(center)
+            w.model.current_epoch = self.start_epoch
+            w.on_epoch_end = self._epoch_done
+            w.on_exit = self._worker_exit
+        if self.val_freq:
+            # compile the center-validation fn BEFORE training starts:
+            # compile_val's state placement must not run concurrently
+            # with the donating train step
+            self.workers[0].model.compile_val()
+
+    # --- epoch-completion protocol (worker threads → server thread) ----
+    def _epoch_done(self, rank: int, epoch: int) -> None:
+        with self._cv:
+            self._epoch_counts[epoch] = self._epoch_counts.get(epoch, 0) + 1
+            self._cv.notify_all()
+
+    def _worker_exit(self, rank: int) -> None:
+        with self._cv:
+            self._n_running -= 1
+            if self.workers[rank].error is not None:
+                self._n_failed += 1
+            self._cv.notify_all()
+
+    def _start_aux(self):
+        self._n_running = len(self.workers)
+        self._duties_thread = threading.Thread(
+            target=self._server_duties, name="EASGD-server", daemon=True
+        )
+        self._duties_thread.start()
+
+    def _stop_aux(self):
+        if self._duties_thread is not None:
+            self._duties_thread.join(timeout=600)
+
+    def _server_duties(self):
+        """Reference ``EASGD_Server.run()`` periodic branch: validate +
+        checkpoint the center at every epoch boundary."""
+        n_epochs = self.workers[0].model.n_epochs
+        for epoch in range(self.start_epoch, n_epochs):
+            with self._cv:
+                # every worker that has not FAILED must report epoch
+                # `epoch` before center duties run — a fast worker that
+                # exited normally already reported all its epochs, so it
+                # keeps counting toward the expectation (a predicate on
+                # `_n_running` alone would fire epochs early once any
+                # worker finishes, checkpointing centers the slow
+                # workers never trained toward)
+                self._cv.wait_for(
+                    lambda: self._epoch_counts.get(epoch, 0)
+                    >= len(self.workers) - self._n_failed
+                )
+                if self._epoch_counts.get(epoch, 0) == 0:
+                    return  # every worker failed before this boundary
+            try:
+                self._center_duties(epoch)
+            except Exception as e:  # duties must never kill training
+                print(f"EASGD server duties failed at epoch {epoch}: "
+                      f"{type(e).__name__}: {e}", flush=True)
+
+    def _center_duties(self, epoch: int) -> None:
+        m = self.workers[0].model
+        with self.server._lock:
+            center = jax.tree.map(np.copy, self.server.center)
+        if self.checkpoint_dir:
+            from theanompi_tpu.utils import checkpoint as ckpt
+
+            ckpt.save(
+                os.path.join(
+                    self.checkpoint_dir, f"ckpt_center_{epoch + 1:04d}.npz"
+                ),
+                {"params": center, "epoch": epoch + 1, "alpha": self.alpha,
+                 "tau": self.tau},
+            )
+        if self.val_freq and (epoch + 1) % self.val_freq == 0:
+            w0 = self.workers[0]
+            loss, err, _ = m.run_validation(
+                (epoch + 1) * m.data.n_batch_train,
+                self.server_recorder,
+                params=replicate(m.mesh, center),
+                # epoch-boundary snapshot taken by the worker thread —
+                # never the live (donation-churned) training state
+                net_state=w0.host_net_state
+                if w0.host_net_state is not None
+                else _to_host(m.net_state),
+            )
+            if self.verbose:
+                print(
+                    f"[EASGD center] epoch {epoch}: val cost {loss:.4f} "
+                    f"err {err:.4f}", flush=True,
+                )
 
     def _finalize(self):
         # the server owns the final model (reference: server saves center)
@@ -308,6 +497,10 @@ class EASGD_Driver(_AsyncDriverBase):
         if self.checkpoint_dir:
             path = os.path.join(self.checkpoint_dir, "ckpt_center.npz")
             self.result_model.save_model(path)
+            if self.server_recorder is not None:
+                self.server_recorder.save(
+                    os.path.join(self.checkpoint_dir, "record_server.jsonl")
+                )
 
 
 class GOSGD_Driver(_AsyncDriverBase):
@@ -331,6 +524,7 @@ class GOSGD_Driver(_AsyncDriverBase):
                 self.model_config,
                 self.n_epochs,
                 self._make_recorder(rank),
+                n_workers=self.n_workers,
                 mailbox=mailbox,
                 p_push=self.p_push,
                 rng=np.random.RandomState(10_000 + seed0 + rank),
