@@ -27,6 +27,8 @@ class AlexNet(TpuModel):
         dropout_rate=0.5,
         lr_boundaries=(20, 40, 50),
         image_size=128,
+        crop_size=None,  # e.g. 112 for crop aug; None trains full-size
+        mirror=True,
         n_classes=1000,
         data_dir=None,
         n_synth_batches=64,
@@ -41,6 +43,10 @@ class AlexNet(TpuModel):
             n_classes=int(cfg.n_classes),
             n_synth_batches=int(cfg.n_synth_batches),
             seed=int(cfg.seed),
+            crop_size=cfg.crop_size,
+            mirror=bool(cfg.mirror),
+            # device_aug: the jitted step augments; host ships raw images
+            train_aug=not bool(cfg.get("device_aug", False)),
         )
 
     def build_net(self):
@@ -77,5 +83,5 @@ class AlexNet(TpuModel):
         self.lr_schedule = optim.step_decay(
             float(cfg.lr), list(cfg.lr_boundaries), 0.1
         )
-        size = int(cfg.image_size)
+        size = int(cfg.crop_size or cfg.image_size)
         return net, (size, size, 3)
