@@ -33,6 +33,34 @@ def test_comm_fraction_reports_fields():
     assert 0.0 <= out["comm_fraction"] < 1.0
 
 
+def test_bsp_worker_logs_comm_fraction(tmp_path):
+    """VERDICT round-1 #10: a BSP run's record must carry the one-shot
+    comm-fraction probe (calc-vs-exchange, the reference recorder's comm
+    column made honest for a fused step)."""
+    import json
+
+    import theanompi_tpu
+
+    rule = theanompi_tpu.BSP()
+    rule.init(
+        devices=4,
+        model_config=dict(CFG, n_epochs=1, comm_probe=True),
+        checkpoint_dir=str(tmp_path),
+        val_freq=0,
+    )
+    model = rule.wait()
+    assert model.current_epoch == 1  # probe restored state; training ran
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "record_rank0.jsonl").read_text().splitlines()
+    ]
+    probe = [r for r in rows if r["kind"] == "comm_fraction"]
+    assert len(probe) == 1
+    assert probe[0]["n_dp"] == 4
+    assert 0.0 <= probe[0]["comm_fraction"] < 1.0
+    assert probe[0]["step_with_exchange_s"] > 0
+
+
 def test_scaling_efficiency_rows():
     rows = B.scaling_efficiency(
         Cifar10_model, CFG, device_counts=[1, 2], n_steps=2

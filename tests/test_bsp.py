@@ -21,6 +21,7 @@ TINY = dict(
     n_epochs=1,
     dropout_rate=0.0,  # per-shard rng would break exact 1-vs-N equivalence
     print_freq=1000,
+    comm_probe=False,  # probed once in its own test, not in every run
 )
 
 

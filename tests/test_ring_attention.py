@@ -154,7 +154,7 @@ class TestTransformerLM:
             model_config=dict(
                 sp=2, batch_size=1, seq_len=16, vocab_size=32, d_model=16,
                 n_heads=2, n_layers=1, n_synth_train=2, n_synth_val=1,
-                print_freq=10_000,
+                print_freq=10_000, comm_probe=False,
             ),
         )
         assert rule.model.sp_size == 2

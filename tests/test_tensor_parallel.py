@@ -80,6 +80,30 @@ def test_dp_sp_tp_combined_matches_dense():
     _assert_params_match(m, ref)
 
 
+def test_dp_sp_tp_alltoall_matches_dense():
+    """Ulysses (all-to-all) SP composed with TP: heads are first sharded
+    over tp, then all-to-all'd over sp within each tp group — the
+    tp-local-head kernel path, covered here directly (ADVICE round-1)."""
+    rec = Recorder(verbose=False)
+    m = TransformerLM(config=dict(BASE, tp=2, sp=2, sp_mode="alltoall"))
+    ref = _dense_ref(dp=2)
+    l_m, _ = _step(m, rec)
+    l_ref, _ = _step(ref, rec)
+    assert abs(float(l_m) - float(l_ref)) < 2e-4
+    _assert_params_match(m, ref)
+
+
+def test_alltoall_tp_head_divisibility_error():
+    """(n_heads/tp) % sp != 0 must fail loudly at build time."""
+    with pytest.raises(ValueError, match="alltoall SP over tp-local heads"):
+        TransformerLM(
+            config=dict(BASE, n_heads=4, tp=2, sp=4, sp_mode="alltoall"),
+            mesh=make_mesh(
+                shape=(1, 4, 2), axis_names=(DATA_AXIS, SEQ_AXIS, TP_AXIS)
+            ),
+        )
+
+
 def test_tp_params_are_actually_sharded():
     m = TransformerLM(config=dict(BASE, tp=4))
     m.compile_train()

@@ -37,7 +37,7 @@ from theanompi_tpu.ops import optim as optim_lib
 from theanompi_tpu.ops.layers import Layer, count_params
 from theanompi_tpu.parallel.exchanger import BSP_Exchanger
 from theanompi_tpu.runtime.config import Config
-from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh, replicate
+from theanompi_tpu.runtime.mesh import DATA_AXIS, DCN_AXIS, make_mesh, replicate
 
 COMMON_DEFAULTS = dict(
     seed=0,
@@ -54,6 +54,13 @@ COMMON_DEFAULTS = dict(
     print_freq=40,
     val_top5=True,
     compute_dtype=None,  # e.g. 'bfloat16' for MXU-native compute
+    device_aug=False,  # True = per-image random crop/mirror INSIDE the
+    # jitted step (ops.augment.random_crop_mirror) instead of on the
+    # host; the provider then ships raw full-size train images. Uses
+    # model config keys crop_size / mirror when the model defines them.
+    comm_probe=True,  # one-shot comm-fraction measurement at BSP train
+    # start (logged as a record event; the fused-step analog of the
+    # reference's per-window comm column). Costs two extra compiles.
     sync_each_iter=False,  # True = fence every step (honest per-step calc
     # split, reference-style); False = let steps pipeline and only sync at
     # print/validation boundaries (a host↔device fence costs ~60ms on
@@ -79,7 +86,10 @@ class TpuModel:
         cfg = self.config
 
         self.mesh = mesh if mesh is not None else make_mesh()
+        self._engage_dcn_axis()
         self.n_workers = int(self.mesh.shape[DATA_AXIS])
+        if DCN_AXIS in self.mesh.shape:
+            self.n_workers *= int(self.mesh.shape[DCN_AXIS])
         self.batch_size = int(cfg.batch_size)
         self.global_batch = self.batch_size * self.n_workers
         self.n_epochs = int(cfg.n_epochs)
@@ -102,6 +112,25 @@ class TpuModel:
         self._train_it = None
         self._val_it = None
         self.current_epoch = 0
+
+    def _engage_dcn_axis(self) -> None:
+        """On a two-level ICI×DCN mesh, widen the batch spec and exchange
+        axes to cover the outer ``dp_dcn`` axis: the batch shards over
+        (dcn, dp) jointly and the gradient reduction runs over both — XLA
+        lowers it hierarchically (reduce over ICI within a slice, then
+        once across DCN per slice-pair), which is exactly the reference's
+        intra-node NCCL + inter-node MPI split (SURVEY.md §6 backend row,
+        §8.2 step 8)."""
+        if DCN_AXIS not in self.mesh.shape:
+            return
+        ax = self.exchange_axes
+        ax_t = (ax,) if isinstance(ax, str) else tuple(ax)
+        if DCN_AXIS not in ax_t:
+            self.exchange_axes = (DCN_AXIS,) + ax_t
+        lead = self.batch_spec[0]
+        lead_t = (lead,) if isinstance(lead, str) else tuple(lead)
+        if DCN_AXIS not in lead_t:
+            self.batch_spec = P((DCN_AXIS,) + lead_t, *self.batch_spec[1:])
 
     # ------------------------------------------------------------------
     # subclass hooks
@@ -197,11 +226,9 @@ class TpuModel:
             )
 
         self.params = put(self.params, self.param_specs)
+        specs = self._opt_state_specs()  # keyed lookup, not positional zip
         self.opt_state = {
-            k: put(v, s)
-            for (k, v), s in zip(
-                self.opt_state.items(), self._opt_state_specs().values()
-            )
+            k: put(v, specs[k]) for k, v in self.opt_state.items()
         }
 
     def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
@@ -247,8 +274,19 @@ class TpuModel:
             scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
             return jax.tree.map(lambda g: g * scale, grads)
 
+        device_aug = bool(cfg.get("device_aug", False))
+        aug_crop = cfg.get("crop_size", None)
+        aug_mirror = bool(cfg.get("mirror", True))
+
         def shard_step(params, net_state, opt_state, x, y, rng):
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
+            if device_aug:
+                from theanompi_tpu.ops.augment import random_crop_mirror
+
+                rng, aug_key = jax.random.split(rng)
+                x = random_crop_mirror(
+                    aug_key, x, crop_size=aug_crop, mirror=aug_mirror
+                )
 
             def loss_fn(p):
                 return self.loss_and_metrics(p, net_state, x, y, True, rng)
@@ -360,14 +398,28 @@ class TpuModel:
         # device scalars; run_validation accumulates on device and syncs once
         return self.val_fn(self.params, self.net_state, x, y)
 
-    def run_validation(self, count: int, recorder) -> Tuple[float, float, float]:
+    def run_validation(
+        self, count: int, recorder, params=None, net_state=None
+    ) -> Tuple[float, float, float]:
+        """Full-set validation.
+
+        ``params``/``net_state`` override the model's own state for
+        validating FOREIGN weights (the EASGD server validates the center
+        params mid-training this way — reference ``easgd_server.py``
+        duties, SURVEY.md §4.3 — without touching the live training
+        state, whose buffers the jitted step donates)."""
         if not self.data.n_batch_val:
             return float("nan"), float("nan"), float("nan")
+        if self.val_fn is None:
+            self.compile_val()
+        p = self.params if params is None else params
+        s = self.net_state if net_state is None else net_state
         self.reset_val_iter()
         tot = jnp.zeros((3,))
         n = 0
         for _ in range(self.data.n_batch_val):
-            loss, err, err5 = self.val_iter(count, recorder)
+            x, y = next(self._val_it)
+            loss, err, err5 = self.val_fn(p, s, x, y)
             tot = tot + jnp.array([loss, err, err5])
             n += 1
         loss, err, err5 = (float(v) / n for v in tot)

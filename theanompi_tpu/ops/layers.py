@@ -122,18 +122,9 @@ class Conv2d(Layer):
         return params, {}, (out_h, out_w, self.filters)
 
     def apply(self, params, state, x, train=False, rng=None):
-        w = params["w"]
-        if self.compute_dtype is not None:
-            # the MXU accumulates bf16 convs in fp32 internally; the
-            # activation stays in compute_dtype so downstream layers read
-            # half the HBM bytes
-            x = x.astype(self.compute_dtype)
-            w = w.astype(self.compute_dtype)
-        # no preferred_element_type here: a widened (fp32) conv output makes
-        # the VJP's cotangent dtype mismatch its bf16 operands, which
-        # lax.conv rejects. On the TPU MXU bf16 convs accumulate in fp32 in
-        # hardware anyway; on other backends bf16 conv accumulation follows
-        # the operand dtype (acceptable for the CPU test rig's tolerances).
+        x, w, narrow_to = _conv_operand_dtypes(
+            x, params["w"], self.compute_dtype
+        )
         y = lax.conv_general_dilated(
             x,
             w,
@@ -141,11 +132,35 @@ class Conv2d(Layer):
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
+        if narrow_to is not None:
+            y = y.astype(narrow_to)
         if self.output_dtype is not None:
             y = y.astype(self.output_dtype)
         if self.use_bias:
             y = y + params["b"].astype(y.dtype)
         return y, state
+
+
+def _conv_operand_dtypes(x, w, compute_dtype):
+    """Pick conv operand dtypes for the current backend.
+
+    On TPU, narrow (bf16) operands are the right call: the MXU
+    accumulates in fp32 in hardware and the narrow activation halves HBM
+    traffic.  (``preferred_element_type=fp32`` is not used because a
+    widened conv output makes the VJP's cotangent dtype mismatch its
+    bf16 operands, which ``lax.conv`` rejects.)  On other backends a
+    narrow conv accumulates in the operand dtype — silently degrading
+    deep nets like VGG16/ResNet50 — so there we keep fp32 operands and
+    narrow the *output* instead: same activation dtype flows downstream,
+    accumulation stays fp32.
+
+    Returns ``(x, w, narrow_to)`` where ``narrow_to`` is a dtype to cast
+    the conv result to, or None."""
+    if compute_dtype is None:
+        return x, w, None
+    if jax.default_backend() == "tpu":
+        return x.astype(compute_dtype), w.astype(compute_dtype), None
+    return x.astype(jnp.float32), w.astype(jnp.float32), compute_dtype
 
 
 class Dense(Layer):
@@ -585,10 +600,9 @@ class ConvTranspose2d(Layer):
         return params, {}, (oh, ow, self.filters)
 
     def apply(self, params, state, x, train=False, rng=None):
-        w = params["w"]
-        if self.compute_dtype is not None:
-            x = x.astype(self.compute_dtype)
-            w = w.astype(self.compute_dtype)
+        x, w, narrow_to = _conv_operand_dtypes(
+            x, params["w"], self.compute_dtype
+        )
         y = lax.conv_transpose(
             x,
             w,
@@ -596,6 +610,8 @@ class ConvTranspose2d(Layer):
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
+        if narrow_to is not None:
+            y = y.astype(narrow_to)
         if self.output_dtype is not None:
             y = y.astype(self.output_dtype)
         if self.use_bias:

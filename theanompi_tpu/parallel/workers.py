@@ -43,6 +43,28 @@ class BSP_Worker:
         self.checkpoint_freq = checkpoint_freq
         self.resume = resume
 
+    def _probe_comm(self, model, rec: Recorder) -> None:
+        """One-shot comm-fraction measurement at train start.
+
+        The reference printed calc vs comm every window (upstream
+        ``lib/recorder.py``; SURVEY.md §3.7); our exchange is fused into
+        the XLA step, so the honest equivalent is a one-time differenced
+        measurement (step-with vs step-without exchange) logged as a
+        record event. Gated by config ``comm_probe`` (default on; no-op
+        on a 1-device data axis). Diagnostics only — a probe failure
+        (e.g. a model whose compile_train takes no exchanger) warns and
+        training proceeds."""
+        if not bool(model.config.get("comm_probe", True)):
+            return
+        try:
+            from theanompi_tpu.utils.benchmark import comm_fraction_probe
+
+            stats = comm_fraction_probe(model)
+            if stats.get("n_dp", 1) > 1:
+                rec.log_event("comm_fraction", **stats)
+        except Exception as e:  # never let diagnostics kill training
+            print(f"comm probe skipped: {type(e).__name__}: {e}", flush=True)
+
     def run(self) -> None:
         model, rec = self.model, self.recorder
         if self.resume and self.checkpoint_dir:
@@ -54,6 +76,10 @@ class BSP_Worker:
                 print(f"resumed from {path} at epoch {model.current_epoch}")
         model.compile_train()
         model.compile_val()
+        if model.current_epoch == 0:
+            # fresh runs only: a crash-restart loop must not re-pay the
+            # probe's two extra compiles on every recovery attempt
+            self._probe_comm(model, rec)
         count = model.current_epoch * model.data.n_batch_train
         for epoch in range(model.current_epoch, model.n_epochs):
             model.adjust_hyperp(epoch)

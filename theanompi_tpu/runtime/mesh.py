@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Canonical mesh-axis names used across the framework.
 DATA_AXIS = "dp"  # data parallelism (the only axis the reference had)
 TP_AXIS = "tp"  # tensor parallelism (beyond-reference; Megatron-style)
+DCN_AXIS = "dp_dcn"  # cross-slice data parallelism riding DCN, not ICI
 
 
 # Env markers that indicate a multi-process launch. Cloud TPU pods do NOT
@@ -128,6 +129,7 @@ def make_mesh(
     shape: Optional[Tuple[int, ...]] = None,
     axis_names: Tuple[str, ...] = (DATA_AXIS,),
     devices: Optional[Sequence[jax.Device]] = None,
+    dcn_shape: Optional[int] = None,
 ) -> Mesh:
     """Build the device mesh the training rules run over.
 
@@ -138,14 +140,41 @@ def make_mesh(
 
     Args:
       shape: mesh shape, e.g. ``(8,)`` or ``(4, 2)``. Defaults to all
-        devices on one data-parallel axis.
+        devices on one data-parallel axis (after dividing out
+        ``dcn_shape`` when given).
       axis_names: one name per mesh dimension. ``('dp',)`` by default.
       devices: explicit device list (tests use a subset of fake CPU
         devices). Defaults to all global devices.
+      dcn_shape: number of slices for a two-level ICI×DCN layout
+        (SURVEY.md §6 backend row / §8.2 step 8).  Prepends a
+        ``'dp_dcn'`` axis of that size: devices are grouped by slice
+        (``slice_index`` on real multi-slice pods, contiguous blocks on
+        single-slice / CPU rigs) so intra-slice collectives ride ICI and
+        only the outer reduction crosses DCN.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    if dcn_shape:
+        n_dcn = int(dcn_shape)
+        if len(devices) % n_dcn:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {n_dcn} slices"
+            )
+        per_slice = len(devices) // n_dcn
+        if shape is None:
+            shape = (per_slice,)
+        if int(np.prod(shape)) != per_slice:
+            raise ValueError(
+                f"ICI shape {shape} must cover {per_slice} devices/slice"
+            )
+        # group by slice: real multi-slice devices carry slice_index;
+        # otherwise contiguous id-order blocks stand in (CPU test rig)
+        devices = sorted(
+            devices, key=lambda d: (getattr(d, "slice_index", 0) or 0, d.id)
+        )
+        dev_array = np.asarray(devices).reshape((n_dcn,) + tuple(shape))
+        return Mesh(dev_array, (DCN_AXIS,) + tuple(axis_names))
     if shape is None:
         shape = (len(devices),)
     if int(np.prod(shape)) != len(devices):

@@ -52,6 +52,9 @@ class Recorder:
         self._train_n = 0
         self.epoch_start: Optional[float] = None
         self.val_history: List[dict] = []
+        # one-off structured events (comm-fraction probe, restarts, …);
+        # saved to the record file with their own `kind`
+        self.events: List[dict] = []
 
     # ---- timing segments ------------------------------------------------
     def start(self, what: str = "calc") -> None:
@@ -84,16 +87,25 @@ class Recorder:
     def train_error(self, count: int, cost, error) -> None:
         # cost/error may be device scalars: accumulate lazily (tiny on-device
         # adds) and only materialize at the print boundary, so metric
-        # bookkeeping never forces a per-step host↔device sync
-        try:
-            self._train_cost = self._train_cost + cost
-            self._train_err = self._train_err + error
-        except ValueError:
-            # one recorder fed by models on different device meshes (two
-            # committed scalars can't add): materialize the old accumulator
-            # once and continue lazily on the new mesh
-            self._train_cost = float(self._train_cost) + cost
-            self._train_err = float(self._train_err) + error
+        # bookkeeping never forces a per-step host↔device sync.
+        # One recorder can be fed by models on different device meshes
+        # (two committed scalars can't add): on an actual device-set
+        # mismatch, materialize the old accumulator once and continue
+        # lazily on the new mesh. Checked explicitly rather than with a
+        # bare `except ValueError`, which would swallow unrelated errors
+        # (e.g. a model returning a non-scalar).
+        import jax
+
+        acc, new = self._train_cost, cost
+        if (
+            isinstance(acc, jax.Array)
+            and isinstance(new, jax.Array)
+            and acc.devices() != new.devices()
+        ):
+            self._train_cost = float(self._train_cost)
+            self._train_err = float(self._train_err)
+        self._train_cost = self._train_cost + cost
+        self._train_err = self._train_err + error
         self._train_n += 1
 
     def print_train_info(self, count: int, force: bool = False) -> None:
@@ -119,6 +131,20 @@ class Recorder:
         self._train_n = 0
         for p in PHASES:
             self._acc[p] = 0.0
+
+    # ---- one-off events -------------------------------------------------
+    def log_event(self, kind: str, **fields) -> None:
+        """Record a structured one-off row (e.g. the train-start
+        comm-fraction probe — the reference printed calc/comm per window;
+        SURVEY.md §3.7)."""
+        row = {"kind": kind, **fields}
+        self.events.append(row)
+        if self.verbose and self.rank == 0:
+            body = " ".join(
+                f"{k} {v:.4g}" if isinstance(v, float) else f"{k} {v}"
+                for k, v in fields.items()
+            )
+            print(f"[{kind}] {body}", flush=True)
 
     # ---- val metrics ----------------------------------------------------
     def val_error(
@@ -167,6 +193,8 @@ class Recorder:
             os.makedirs(d, exist_ok=True)
             path = os.path.join(d, f"record_rank{self.rank}.jsonl")
         with open(path, "w") as f:
+            for row in self.events:
+                f.write(json.dumps(row) + "\n")
             for row in self.history:
                 f.write(json.dumps({"kind": "train", **row}) + "\n")
             for row in self.val_history:

@@ -20,11 +20,19 @@ from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
 
 
 def measure_step_time(
-    model, n_steps: int = 20, warmup: int = 3, train_fn=None
+    model, n_steps: int = 20, warmup: int = 3, train_fn=None, max_batches: int = 8
 ) -> float:
     """Steady-state seconds per training step (compile + warmup excluded)."""
+    import itertools
+
     fn = train_fn or model.train_fn or model.compile_train()
-    batches = [shard_batch(model.mesh, b) for b in model.data.train_batches()]
+    # cap the materialized batch pool: timing cycles over a few distinct
+    # batches; loading a whole epoch (e.g. 64×bs512 ImageNet ≈ GBs) would
+    # swamp the probe itself
+    batches = [
+        shard_batch(model.mesh, b)
+        for b in itertools.islice(model.data.train_batches(), max_batches)
+    ]
     p, s, o = model.params, model.net_state, model.opt_state
     rng = jax.random.PRNGKey(0)
     loss = None
@@ -45,6 +53,32 @@ def images_per_sec(model, n_steps: int = 20) -> float:
     return model.global_batch / step_s
 
 
+def _no_exchange_cls():
+    """A BSP_Exchanger stub whose exchange is the identity — the
+    'single-worker step' both comm measurements difference against."""
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+    class _NoExchange(BSP_Exchanger):
+        def reduce_grads(self, grads, specs=None):
+            return grads
+
+        def average_params(self, params, specs=None):
+            return params
+
+    return _NoExchange
+
+
+def _exchange_world_size(model) -> int:
+    """Devices the model's gradient exchange spans: the product of every
+    mesh axis in ``exchange_axes`` (dp, and dp_dcn on two-level meshes)."""
+    ax = model.exchange_axes
+    axes = (ax,) if isinstance(ax, str) else tuple(ax)
+    n = 1
+    for a in axes:
+        n *= int(model.mesh.shape.get(a, 1))
+    return n
+
+
 def comm_fraction(model_cls, config: dict, mesh=None, n_steps: int = 20) -> Dict:
     """Estimate exchange cost: step time with psum vs a no-exchange step.
 
@@ -56,19 +90,64 @@ def comm_fraction(model_cls, config: dict, mesh=None, n_steps: int = 20) -> Dict
     with_x = model_cls(config=dict(config), mesh=mesh)
     t_with = measure_step_time(with_x, n_steps=n_steps)
 
-    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
-
-    class _NoExchange(BSP_Exchanger):
-        def reduce_grads(self, grads, specs=None):
-            return grads
-
-        def average_params(self, params, specs=None):
-            return params
-
     without = model_cls(config=dict(config), mesh=mesh)
-    without.compile_train(exchanger=_NoExchange(strategy="ar"))
+    without.compile_train(
+        exchanger=_no_exchange_cls()(strategy="ar", axis=without.exchange_axes)
+    )
     t_without = measure_step_time(without, n_steps=n_steps)
     return {
+        "step_with_exchange_s": t_with,
+        "step_without_exchange_s": t_without,
+        "comm_s": max(0.0, t_with - t_without),
+        "comm_fraction": max(0.0, 1.0 - t_without / t_with),
+    }
+
+
+def comm_fraction_probe(model, n_steps: int = 6, warmup: int = 2) -> Dict:
+    """One-shot exchange-cost measurement on an already-built model.
+
+    The BSP worker runs this at train start so every BSP record carries a
+    calc-vs-exchange split, matching the reference recorder's per-window
+    ``comm`` column (upstream ``lib/recorder.py``; SURVEY.md §3.7) — which
+    a fused-XLA step otherwise hides.  The model's state is snapshotted to
+    host and restored afterwards because the timed step function donates
+    its state buffers.
+    """
+    import numpy as np
+
+    from theanompi_tpu.runtime.mesh import replicate
+
+    n_dp = _exchange_world_size(model)
+    if n_dp <= 1:
+        return {"comm_fraction": 0.0, "comm_s": 0.0, "n_dp": 1}
+
+    snap = jax.tree.map(
+        np.asarray, (model.params, model.net_state, model.opt_state)
+    )
+
+    def _restore():
+        model.params = replicate(model.mesh, snap[0])
+        model.net_state = replicate(model.mesh, snap[1])
+        model.opt_state = replicate(model.mesh, snap[2])
+        model._place_sharded_state()
+
+    try:
+        t_with = measure_step_time(model, n_steps=n_steps, warmup=warmup)
+        _restore()
+        no_exch_fn = model.compile_train(
+            exchanger=_no_exchange_cls()(strategy="ar", axis=model.exchange_axes)
+        )
+        t_without = measure_step_time(
+            model, n_steps=n_steps, warmup=warmup, train_fn=no_exch_fn
+        )
+    finally:
+        # even on a failed probe the model must leave with live (not
+        # donated-away) state and the REAL exchanging step compiled —
+        # callers treat probe errors as non-fatal and keep training
+        _restore()
+        model.compile_train()
+    return {
+        "n_dp": n_dp,
         "step_with_exchange_s": t_with,
         "step_without_exchange_s": t_without,
         "comm_s": max(0.0, t_with - t_without),
