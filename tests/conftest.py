@@ -36,3 +36,11 @@ _cache_dir = os.path.join(_repo_root, ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "distributed: spawns real OS processes joined by jax.distributed "
+        "(deselect with -m 'not distributed' where spawning is unavailable)",
+    )

@@ -1,0 +1,89 @@
+"""Multi-PROCESS execution (VERDICT round-1 #1; SURVEY.md §3.1/§5).
+
+The reference's identity is N MPI processes training in lockstep; until
+round 2 this framework had only ever executed in one process.  These
+tests spawn real OS processes joined by ``jax.distributed`` on the CPU
+backend (the reference needed a physical cluster for this — SURVEY.md §5
+calls out the gap) and assert the 2-process run is gradient-synchronized:
+loss-identical to a single-process run at the same global batch.
+
+Marked ``distributed``: deselect with ``-m 'not distributed'`` when
+process spawning is unavailable.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+CFG = (
+    '{"batch_size": 8, "n_epochs": 1, "n_synth_train": 128, '
+    '"n_synth_val": 64, "dropout_rate": 0.0, "print_freq": 1, '
+    '"comm_probe": false, "seed": 3}'
+)
+
+
+def _train_rows(path):
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    return [r for r in rows if r["kind"] == "train"]
+
+
+@pytest.mark.distributed
+def test_two_process_bsp_matches_single_process(tmp_path):
+    """2 processes × 2 fake devices (dp=4 global mesh) must produce the
+    SAME loss curve as 1 process × 4 devices: the cross-process psum is
+    doing exactly what the in-process one does."""
+    from theanompi_tpu.runtime.multiprocess import spawn_local
+
+    d2 = tmp_path / "two_proc"
+    d1 = tmp_path / "one_proc"
+    base = [
+        "--rule", "BSP", "--config", CFG,
+    ]
+    env_cache = {
+        "JAX_COMPILATION_CACHE_DIR": str(tmp_path.parent / "jax_cache_dist"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
+    }
+    spawn_local(
+        2,
+        base + ["--checkpoint-dir", str(d2)],
+        local_device_count=2,
+        env_extra=env_cache,
+        timeout=600,
+        stream_output=False,
+    )
+    # single-process reference at the same global batch, as a spawned
+    # 1-process "group" (identical code path, no coordinator semantics)
+    spawn_local(
+        1,
+        base + ["--checkpoint-dir", str(d1)],
+        local_device_count=4,
+        env_extra=env_cache,
+        timeout=600,
+        stream_output=False,
+    )
+
+    rows2 = _train_rows(d2 / "record_rank0.jsonl")
+    rows1 = _train_rows(d1 / "record_rank0.jsonl")
+    assert len(rows2) == len(rows1) == 4  # 128 / (8*4) = 4 iters
+    for a, b in zip(rows2, rows1):
+        assert a["cost"] == pytest.approx(b["cost"], rel=2e-5), (rows2, rows1)
+        assert a["error"] == pytest.approx(b["error"], abs=1e-6)
+
+    # each process logged its own record; only rank 0 wrote checkpoints
+    assert (d2 / "record_rank1.jsonl").exists()
+    assert (d2 / "ckpt_0001.npz").exists()
+
+
+@pytest.mark.distributed
+def test_spawn_local_surfaces_child_failure(tmp_path):
+    from theanompi_tpu.runtime.multiprocess import spawn_local
+
+    with pytest.raises(RuntimeError, match="exit codes"):
+        spawn_local(
+            2,
+            ["--rule", "BSP", "--modelclass", "NoSuchModel"],
+            timeout=120,
+            stream_output=False,
+        )

@@ -12,6 +12,19 @@ Examples::
         --modelfile theanompi_tpu.models.alex_net --modelclass AlexNet \
         --config '{"batch_size": 128, "n_epochs": 60}' \
         --checkpoint-dir ./run0 --restarts 2
+
+Multi-process (the reference's ``mpirun -np N``; SURVEY.md §3.1).  On a
+TPU pod, run the same command on every host — ``jax.distributed``
+auto-configures from the TPU runtime.  Elsewhere (CI, single machine),
+either spawn N local CPU-backend processes::
+
+    python -m theanompi_tpu.launch --rule BSP --spawn-procs 2 \
+        --config '{"batch_size": 8, "n_epochs": 1}'
+
+or address the process group explicitly, one command per process::
+
+    python -m theanompi_tpu.launch --rule BSP \
+        --dist-coordinator host0:1234 --dist-nprocs 2 --dist-rank 0
 """
 
 from __future__ import annotations
@@ -39,11 +52,124 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tau", type=int, default=10, help="EASGD exchange period")
     p.add_argument("--alpha", type=float, default=0.5, help="EASGD elastic coef")
     p.add_argument("--p-push", type=float, default=0.25, help="GOSGD push prob")
+    # multi-process launch (the mpirun analog; SURVEY.md §3.1)
+    p.add_argument(
+        "--spawn-procs", type=int, default=None,
+        help="spawn N local CPU-backend processes joined by jax.distributed "
+        "(single-machine multi-process; on a real pod run this command "
+        "per host instead)",
+    )
+    p.add_argument(
+        "--spawn-local-devices", type=int, default=1,
+        help="fake devices per spawned process (CPU backend)",
+    )
+    p.add_argument("--dist-coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address (worker mode)")
+    p.add_argument("--dist-nprocs", type=int, default=None)
+    p.add_argument("--dist-rank", type=int, default=None)
+    p.add_argument(
+        "--async-port-base", type=int, default=29750,
+        help="EASGD/GOSGD TCP transport: rank r listens on port base+r",
+    )
+    p.add_argument(
+        "--async-hosts", default=None,
+        help="comma-separated host per rank for the async transport "
+        "(default: all localhost)",
+    )
     return p
+
+
+def _async_distributed_main(args) -> int:
+    """Cross-process EASGD/GOSGD (reference: N workers + server over MPI
+    p2p; SURVEY.md §4.3/§4.4)."""
+    import json as _json
+
+    from theanompi_tpu.parallel import distributed_async as da
+
+    rank, size = args.dist_rank, args.dist_nprocs
+    if rank is None or size is None:
+        raise SystemExit("--dist-rank and --dist-nprocs are required")
+    hosts = args.async_hosts.split(",") if args.async_hosts else None
+    addresses = da.default_addresses(size, hosts, args.async_port_base)
+    model_config = _json.loads(args.config)
+    common = dict(
+        modelfile=args.modelfile,
+        modelclass=args.modelclass,
+        model_config=model_config,
+        n_epochs=None,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    if args.rule == "EASGD":
+        if size < 2:
+            raise SystemExit("EASGD needs ≥2 processes (1 server + workers)")
+        if rank == 0:
+            da.run_easgd_server(
+                size, addresses[0], alpha=args.alpha, resume=args.resume,
+                **common,
+            )
+        else:
+            da.run_easgd_worker(
+                rank, size, addresses[0], tau=args.tau, **common
+            )
+    else:  # GOSGD
+        da.run_gosgd_peer(
+            rank, size, addresses, p_push=args.p_push, **common
+        )
+    return 0
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.spawn_procs:
+        # driver mode: re-exec ourselves N times as a local process group
+        from theanompi_tpu.runtime.multiprocess import spawn_local
+
+        # strip both '--flag value' and '--flag=value' spellings — a
+        # surviving --spawn-procs in child argv would fork recursively
+        child_argv = []
+        skip = False
+        for a in (argv if argv is not None else sys.argv[1:]):
+            if skip:
+                skip = False
+                continue
+            if a in ("--spawn-procs", "--spawn-local-devices"):
+                skip = True
+                continue
+            if a.startswith(("--spawn-procs=", "--spawn-local-devices=")):
+                continue
+            child_argv.append(a)
+        spawn_local(
+            args.spawn_procs,
+            child_argv,
+            local_device_count=args.spawn_local_devices,
+        )
+        return 0
+
+    if args.dist_coordinator is not None:
+        # worker mode: configure the backend BEFORE any device use.
+        # The axon sitecustomize pre-imports jax, so honor a JAX_PLATFORMS
+        # env through the config API too (see tests/conftest.py).
+        import os
+
+        import jax
+
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        if args.rule == "BSP":
+            # one SPMD program over the global mesh: join the group
+            from theanompi_tpu.runtime.mesh import init_distributed
+
+            init_distributed(
+                coordinator_address=args.dist_coordinator,
+                num_processes=args.dist_nprocs,
+                process_id=args.dist_rank,
+            )
+        else:
+            # async rules: independent processes + TCP transport — no
+            # collectives cross the process boundary (SURVEY.md §8.1)
+            return _async_distributed_main(args)
+
     import theanompi_tpu
     from theanompi_tpu.runtime.fault import run_with_restart
 

@@ -22,7 +22,12 @@ from theanompi_tpu.runtime.recorder import Recorder
 
 class BSP_Worker:
     """Bulk-synchronous data-parallel training loop (reference
-    ``BSP_Worker``; SURVEY.md §4.2)."""
+    ``BSP_Worker``; SURVEY.md §4.2).
+
+    Multi-process aware: under a ``jax.distributed`` group every process
+    runs this same loop SPMD (the reference's N MPI ranks), each logging
+    to ``record_rank{process}.jsonl``; only process 0 prints and writes
+    checkpoints (the reference also checkpointed on rank 0)."""
 
     def __init__(
         self,
@@ -33,9 +38,14 @@ class BSP_Worker:
         checkpoint_freq: int = 1,  # epochs between snapshots (0 = never)
         resume: bool = False,
     ):
+        import jax
+
+        self.process_index = jax.process_index()
         self.model = model
         self.recorder = recorder or Recorder(
             print_freq=int(model.config.get("print_freq", 40)),
+            rank=self.process_index,
+            verbose=self.process_index == 0,
             save_dir=checkpoint_dir,
         )
         self.val_freq = val_freq
@@ -95,7 +105,7 @@ class BSP_Worker:
             model.current_epoch = epoch + 1
             if self.checkpoint_dir and self.checkpoint_freq and (
                 (epoch + 1) % self.checkpoint_freq == 0
-            ):
+            ) and self.process_index == 0:  # rank-0 writes, like the reference
                 path = os.path.join(
                     self.checkpoint_dir, f"ckpt_{epoch + 1:04d}.npz"
                 )

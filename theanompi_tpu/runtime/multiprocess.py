@@ -1,0 +1,125 @@
+"""Multi-process launch — the mpirun analog.
+
+Reference analog: the rules shelled out to ``mpirun -np N python
+bsp_worker.py <device> <modelfile> <modelclass>`` (upstream
+``sync_rule.py``/``async_rule.py``; SURVEY.md §3.1 / §4.1) — N OS
+processes, one per GPU, joined into MPI_COMM_WORLD.
+
+TPU-native redesign: one process per HOST (not per chip), joined into a
+global device mesh by ``jax.distributed.initialize`` — the coordination
+service replaces MPI_COMM_WORLD, XLA collectives replace the exchanger's
+MPI calls, and the SPMD step is identical in every process.  On a real
+pod each host runs the same ``theanompi_tpu.launch`` command (the TPU
+runtime auto-configures coordinator/rank); for single-machine testing and
+CI, :func:`spawn_local` spawns N local processes over the CPU backend —
+the moral equivalent of the reference's single-node ``mpirun -np N``.
+
+Every process executes the whole training script (SPMD): same model,
+same epoch-seeded shuffle, same global batches.  Each ``device_put`` of
+a global batch materializes only the process's addressable shards, so
+data loading parallelizes across hosts exactly like the reference's
+per-rank batch files.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_local(
+    n_procs: int,
+    argv: Sequence[str],
+    local_device_count: int = 1,
+    env_extra: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = 900.0,
+    stream_output: bool = True,
+) -> List[int]:
+    """Run ``python -m theanompi_tpu.launch <argv> --dist-*`` × N locally.
+
+    Each child joins a ``jax.distributed`` process group on the CPU
+    backend with ``local_device_count`` fake devices, so N×K chips'
+    worth of SPMD training runs on one machine — the reference could
+    only test its multi-process path on a real cluster (SURVEY.md §5).
+
+    Returns the list of exit codes; raises RuntimeError if any child
+    failed (after terminating the rest).
+    """
+    port = find_free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    # children control their own fake-device count (strip any inherited
+    # setting, e.g. the 8-device test-rig flag)
+    flags = " ".join(
+        f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={local_device_count}"
+    ).strip()
+    env.update(env_extra or {})
+
+    procs = []
+    for rank in range(n_procs):
+        cmd = [
+            sys.executable,
+            "-m",
+            "theanompi_tpu.launch",
+            *argv,
+            "--dist-coordinator",
+            f"localhost:{port}",
+            "--dist-nprocs",
+            str(n_procs),
+            "--dist-rank",
+            str(rank),
+        ]
+        procs.append(
+            subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=None if stream_output else subprocess.DEVNULL,
+                stderr=subprocess.STDOUT if not stream_output else None,
+            )
+        )
+    deadline = time.monotonic() + timeout if timeout else None
+    codes: List[Optional[int]] = [None] * n_procs
+    try:
+        while any(c is None for c in codes):
+            for i, p in enumerate(procs):
+                if codes[i] is None:
+                    codes[i] = p.poll()
+            if any(c not in (None, 0) for c in codes):
+                # fail fast: surviving BSP ranks would otherwise block at
+                # the jax.distributed barrier until the full timeout,
+                # turning an instantly-diagnosable crash into a hang
+                break
+            if deadline and time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"distributed launch timed out after {timeout}s "
+                    f"(exit codes so far: {codes})"
+                )
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for i, p in enumerate(procs):
+            if codes[i] is None:
+                try:
+                    codes[i] = p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    codes[i] = p.wait()
+    if any(c != 0 for c in codes):
+        raise RuntimeError(f"distributed launch failed: exit codes {codes}")
+    return [int(c) for c in codes]
