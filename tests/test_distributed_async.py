@@ -1,0 +1,107 @@
+"""Cross-PROCESS EASGD / GOSGD over the TCP transport (VERDICT round-1
+#2; SURVEY.md §4.3/§4.4, §8.1).
+
+The reference ran its async rules as MPI processes; round 1 only ever
+exchanged through an in-process queue.  These tests spawn real OS
+processes: EASGD's server rank serves elastic exchanges over TCP and
+checkpoints/validates the center per epoch; GOSGD peers gossip over
+their TCP mailboxes and rank 0 writes the consensus.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.runtime.multiprocess import find_free_port, spawn_local
+
+CFG = (
+    '{"batch_size": 16, "n_epochs": 2, "n_synth_train": 128, '
+    '"n_synth_val": 64, "dropout_rate": 0.0, "print_freq": 1000, '
+    '"comm_probe": false, "seed": 5}'
+)
+
+ENV_CACHE = {
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
+}
+
+
+def _cache_env(tmp_path):
+    return dict(ENV_CACHE, JAX_COMPILATION_CACHE_DIR=str(
+        tmp_path.parent / "jax_cache_dist"
+    ))
+
+
+@pytest.mark.distributed
+def test_easgd_across_processes(tmp_path):
+    """1 server + 2 worker processes: exchanges cross the process
+    boundary, the center is checkpointed + validated per epoch, and the
+    final center model is saved by the server."""
+    port = find_free_port()
+    spawn_local(
+        3,
+        [
+            "--rule", "EASGD", "--config", CFG,
+            "--checkpoint-dir", str(tmp_path),
+            "--tau", "2",
+            "--async-port-base", str(port),
+        ],
+        local_device_count=1,
+        env_extra=_cache_env(tmp_path),
+        timeout=600,
+        stream_output=False,
+    )
+    names = sorted(f.name for f in tmp_path.iterdir())
+    assert "ckpt_center_0001.npz" in names
+    assert "ckpt_center_0002.npz" in names
+    assert "ckpt_center.npz" in names
+    # the server validated the center DURING training
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "record_server.jsonl").read_text().splitlines()
+    ]
+    assert len([r for r in rows if r["kind"] == "val"]) == 2
+    # the two epoch snapshots differ: exchanges actually moved the center
+    from theanompi_tpu.utils import checkpoint as ckpt
+
+    c1 = ckpt.restore(str(tmp_path / "ckpt_center_0001.npz"))["params"]
+    c2 = ckpt.restore(str(tmp_path / "ckpt_center_0002.npz"))["params"]
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(
+            [x for x in _leaves(c1)], [x for x in _leaves(c2)]
+        )
+    ]
+    assert max(diffs) > 0
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+@pytest.mark.distributed
+def test_gosgd_across_processes(tmp_path):
+    """2 peer processes gossiping over TCP; rank 0 writes the consensus
+    checkpoint after collecting every peer's final (params, weight)."""
+    port = find_free_port()
+    spawn_local(
+        2,
+        [
+            "--rule", "GOSGD", "--config", CFG,
+            "--checkpoint-dir", str(tmp_path),
+            "--p-push", "0.5",
+            "--async-port-base", str(port),
+        ],
+        local_device_count=1,
+        env_extra=_cache_env(tmp_path),
+        timeout=600,
+        stream_output=False,
+    )
+    assert (tmp_path / "ckpt_consensus.npz").exists()
+    from theanompi_tpu.utils import checkpoint as ckpt
+
+    blob = ckpt.restore(str(tmp_path / "ckpt_consensus.npz"))
+    for leaf in _leaves(blob["params"]):
+        assert np.isfinite(np.asarray(leaf)).all()

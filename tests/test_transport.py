@@ -1,0 +1,136 @@
+"""Wire codec + TCP transport unit tests (in-process, localhost).
+
+Reference analog being re-created: MPI p2p of parameter lists in the
+async rules (SURVEY.md §4.3/§4.4) — here a pickle-free framed codec over
+stdlib sockets (SURVEY.md §8.1's "host RPC + device_put" mapping).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.transport import (
+    TcpMailbox,
+    TcpServerChannel,
+    request,
+)
+from theanompi_tpu.runtime.multiprocess import find_free_port
+
+
+def test_wire_roundtrip_types():
+    tree = {
+        "params": {"w": np.random.randn(3, 4).astype(np.float32),
+                   "b": np.zeros(4, np.float16)},
+        "meta": ("push", 1.25, 7, "tag", None, True),
+        "empty": np.zeros((0, 5), np.int32),
+        "scalar": np.float64(2.5),
+    }
+    back = wire.decode(wire.encode(tree))
+    np.testing.assert_array_equal(back["params"]["w"], tree["params"]["w"])
+    assert back["params"]["b"].dtype == np.float16
+    assert back["meta"] == ("push", 1.25, 7, "tag", None, True)
+    assert back["empty"].shape == (0, 5)
+    assert float(back["scalar"]) == 2.5
+
+
+def test_wire_is_pickle_free(monkeypatch):
+    import pickle
+
+    def _bomb(*a, **k):
+        raise AssertionError("pickle used on the wire path")
+
+    monkeypatch.setattr(pickle, "loads", _bomb)
+    monkeypatch.setattr(pickle, "dumps", _bomb)
+    blob = wire.encode({"x": np.ones(3)})
+    assert wire.decode(blob)["x"].sum() == 3.0
+
+
+def test_tcp_mailbox_send_drain():
+    p0, p1 = find_free_port(), find_free_port()
+    addrs = [("127.0.0.1", p0), ("127.0.0.1", p1)]
+    m0 = TcpMailbox(0, addrs)
+    m1 = TcpMailbox(1, addrs)
+    try:
+        m0.send(1, ("push", {"w": np.arange(4.0)}, 0.5))
+        m0.send(1, ("push", {"w": np.ones(4)}, 0.25))
+        got = []
+        deadline = 50
+        while len(got) < 2 and deadline:
+            got.extend(m1.drain())
+            deadline -= 1
+            if len(got) < 2:
+                import time
+
+                time.sleep(0.05)
+        assert len(got) == 2
+        kinds = {g[0] for g in got}
+        assert kinds == {"push"}
+        assert m0.drain() == []
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_tcp_server_channel_request_reply():
+    port = find_free_port()
+    calls = []
+
+    def handler(msg):
+        calls.append(msg["kind"])
+        return {"params": {"w": msg["params"]["w"] * 2}}
+
+    ch = TcpServerChannel(port, handler)
+    try:
+        results = []
+
+        def client():
+            r = request(("127.0.0.1", port),
+                        {"kind": "exchange", "params": {"w": np.ones(3)}})
+            results.append(r)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 4
+        for r in results:
+            np.testing.assert_array_equal(r["params"]["w"], 2 * np.ones(3))
+        assert calls == ["exchange"] * 4  # serialized, one at a time
+    finally:
+        ch.close()
+
+
+def test_remote_server_matches_in_process_elastic_math():
+    """The TCP-served elastic update must equal EASGD_Server.exchange."""
+    from theanompi_tpu.parallel.async_workers import EASGD_Server
+    from theanompi_tpu.parallel.distributed_async import _RemoteServer
+
+    alpha = 0.5
+    local = EASGD_Server({"w": np.zeros(3, np.float32)}, alpha)
+
+    state = {"center": {"w": np.zeros(3, np.float32)}}
+
+    def handler(msg):
+        import jax
+
+        w = msg["params"]
+        diff = jax.tree.map(lambda a, b: a - b, w, state["center"])
+        state["center"] = jax.tree.map(
+            lambda b, d: b + alpha * d, state["center"], diff
+        )
+        return {"params": jax.tree.map(lambda a, d: a - alpha * d, w, diff)}
+
+    port = find_free_port()
+    ch = TcpServerChannel(port, handler)
+    try:
+        remote = _RemoteServer(("127.0.0.1", port))
+        w = {"w": np.ones(3, np.float32)}
+        np.testing.assert_allclose(
+            remote.exchange(w)["w"], local.exchange(w)["w"]
+        )
+        np.testing.assert_allclose(state["center"]["w"], local.center["w"])
+    finally:
+        ch.close()

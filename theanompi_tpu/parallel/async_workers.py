@@ -232,8 +232,17 @@ class GOSGD_Worker(_AsyncWorkerBase):
         dst = int(self._np_rng.choice(peers))
         self.recorder.start("comm")
         self.weight /= 2.0
-        self.mailbox.send(dst, (self.get_params(), self.weight))
-        self.recorder.end("comm")
+        try:
+            self.mailbox.send(dst, (self.get_params(), self.weight))
+        except (ConnectionError, OSError):
+            # peer unreachable (cross-process: exited/crashed) — undo
+            # the halving so the consensus weight mass isn't lost, and
+            # keep training: gossip tolerates dead peers by design
+            self.weight *= 2.0
+            print(f"GOSGD worker {self.rank}: push to {dst} failed "
+                  f"(peer gone); weight restored", flush=True)
+        finally:
+            self.recorder.end("comm")
 
     def _run(self):
         model, rec = self.model, self.recorder

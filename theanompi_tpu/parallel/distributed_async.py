@@ -1,0 +1,383 @@
+"""Cross-process EASGD / GOSGD — async rules over the TCP transport.
+
+Reference analog (SURVEY.md §4.3/§4.4, §8.1): upstream
+``easgd_server.py`` is a dedicated MPI rank serving elastic exchanges
+one worker at a time, and ``gosgd_worker.py`` pushes (params, weight) to
+random peers over MPI p2p.  Here each rank is an OS process driving its
+own local devices; exchanges ride ``transport.TcpMailbox`` /
+``TcpServerChannel`` (host RPC + device_put — XLA has no dynamic p2p).
+The in-process worker classes are reused verbatim: a worker cannot tell
+whether ``server.exchange`` crosses a thread or a datacenter.
+
+Topology (matches the reference):
+
+- EASGD: rank 0 = server process (owns the center, validates and
+  checkpoints it per epoch, serves ``join``/``exchange``/``epoch``/
+  ``done`` requests serialized); ranks 1..N-1 = workers.
+- GOSGD: every rank is a peer worker; rank 0 additionally collects the
+  final (params, weight) pairs and writes the consensus checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from theanompi_tpu.parallel.async_workers import (
+    EASGD_Worker,
+    GOSGD_Worker,
+    _to_host,
+)
+from theanompi_tpu.parallel.transport import (
+    TcpMailbox,
+    TcpServerChannel,
+    request,
+)
+from theanompi_tpu.runtime.mesh import replicate
+from theanompi_tpu.runtime.recorder import Recorder
+
+Address = Tuple[str, int]
+
+
+def default_addresses(n: int, hosts: Optional[Sequence[str]], port_base: int) -> List[Address]:
+    """Rank r listens on (hosts[r], port_base + r); single-host default."""
+    if hosts is None or len(hosts) == 0:
+        hosts = ["127.0.0.1"]
+    if len(hosts) == 1:
+        hosts = [hosts[0]] * n
+    if len(hosts) != n:
+        raise ValueError(f"{len(hosts)} hosts for {n} ranks")
+    return [(hosts[r], port_base + r) for r in range(n)]
+
+
+class _RemoteServer:
+    """Client proxy with the in-process EASGD_Server's exchange surface."""
+
+    def __init__(self, address: Address):
+        self.address = address
+
+    def exchange(self, worker_params):
+        reply = request(
+            self.address, {"kind": "exchange", "params": worker_params}
+        )
+        return reply["params"]
+
+
+# ---------------------------------------------------------------------------
+# EASGD
+# ---------------------------------------------------------------------------
+
+def run_easgd_server(
+    size: int,
+    address: Address,
+    modelfile: str,
+    modelclass: str,
+    model_config: Optional[dict],
+    n_epochs: Optional[int],
+    alpha: float,
+    checkpoint_dir: Optional[str],
+    val_freq: int = 1,
+    resume: bool = False,
+    verbose: bool = True,
+    timeout: float = 3600.0,
+):
+    """Rank 0: the reference ``EASGD_Server.run()`` loop, TCP-served.
+
+    Builds its own model instance on this process's devices (the
+    reference dedicated a rank + GPU to the server) purely for center
+    init + validation; it never trains."""
+    import importlib
+
+    cfg = dict(model_config or {})
+    cls = getattr(importlib.import_module(modelfile), modelclass)
+    model = cls(config=cfg, mesh=cls.build_mesh(devices=jax.local_devices(), config=cfg))
+    if n_epochs is not None:
+        model.n_epochs = n_epochs
+    n_workers = size - 1
+    start_epoch = 0
+    center = _to_host(model.params)
+    if resume and checkpoint_dir:
+        from theanompi_tpu.utils import checkpoint as ckpt
+
+        path = ckpt.latest(checkpoint_dir, prefix="ckpt_center_")
+        if path:
+            blob = ckpt.restore(path)
+            center = blob["params"]
+            start_epoch = int(blob["epoch"])
+            print(f"EASGD server: resumed center from {path} at epoch "
+                  f"{start_epoch}", flush=True)
+
+    state = {
+        "center": center,
+        "n_exchanges": 0,
+        "epoch_counts": {},
+        "done": 0,
+        "failed": 0,
+        "net_state": None,  # latest worker BN-state snapshot
+    }
+    cv = threading.Condition()
+    rec = Recorder(print_freq=1, rank=0, verbose=verbose,
+                   save_dir=checkpoint_dir)
+
+    def handler(msg: Any) -> Any:
+        kind = msg["kind"]
+        with cv:
+            if kind == "join":
+                return {"params": state["center"], "epoch": start_epoch}
+            if kind == "exchange":
+                w = msg["params"]
+                c = state["center"]
+                diff = jax.tree.map(lambda a, b: a - b, w, c)
+                state["center"] = jax.tree.map(
+                    lambda b, d: b + alpha * d, c, diff
+                )
+                state["n_exchanges"] += 1
+                return {
+                    "params": jax.tree.map(lambda a, d: a - alpha * d, w, diff)
+                }
+            if kind == "epoch":
+                e = int(msg["epoch"])
+                state["epoch_counts"][e] = state["epoch_counts"].get(e, 0) + 1
+                if msg.get("net_state") is not None:
+                    state["net_state"] = msg["net_state"]
+                cv.notify_all()
+                return {"ok": True}
+            if kind == "done":
+                state["done"] += 1
+                if bool(msg.get("failed", False)):
+                    state["failed"] += 1
+                cv.notify_all()
+                return {"ok": True}
+        raise ValueError(f"unknown request kind {kind!r}")
+
+    channel = TcpServerChannel(address[1], handler)
+    deadline = time.monotonic() + timeout
+    try:
+        for epoch in range(start_epoch, model.n_epochs):
+            with cv:
+                ok = cv.wait_for(
+                    lambda: state["epoch_counts"].get(epoch, 0)
+                    >= n_workers - state["failed"]
+                    or state["done"] >= n_workers,
+                    timeout=max(1.0, deadline - time.monotonic()),
+                )
+                if not ok:
+                    raise TimeoutError(
+                        f"EASGD server: no epoch-{epoch} boundary within "
+                        f"{timeout}s"
+                    )
+                if state["epoch_counts"].get(epoch, 0) == 0:
+                    break  # all workers gone before this boundary
+                center = jax.tree.map(np.copy, state["center"])
+                net_state = state["net_state"]
+            if checkpoint_dir:
+                from theanompi_tpu.utils import checkpoint as ckpt
+
+                ckpt.save(
+                    os.path.join(checkpoint_dir, f"ckpt_center_{epoch + 1:04d}.npz"),
+                    {"params": center, "epoch": epoch + 1, "alpha": alpha},
+                )
+            if val_freq and (epoch + 1) % val_freq == 0:
+                loss, err, _ = model.run_validation(
+                    (epoch + 1) * model.data.n_batch_train,
+                    rec,
+                    params=replicate(model.mesh, center),
+                    net_state=net_state,  # workers' trained BN stats
+                )
+                if verbose:
+                    print(f"[EASGD center] epoch {epoch}: val cost "
+                          f"{loss:.4f} err {err:.4f}", flush=True)
+        with cv:
+            cv.wait_for(
+                lambda: state["done"] >= n_workers,
+                timeout=max(1.0, deadline - time.monotonic()),
+            )
+            center = jax.tree.map(np.copy, state["center"])
+    finally:
+        channel.close()
+    model.params = replicate(model.mesh, center)
+    if checkpoint_dir:
+        model.save_model(os.path.join(checkpoint_dir, "ckpt_center.npz"))
+        rec.save(os.path.join(checkpoint_dir, "record_server.jsonl"))
+    return model
+
+
+def run_easgd_worker(
+    rank: int,
+    size: int,
+    server_address: Address,
+    modelfile: str,
+    modelclass: str,
+    model_config: Optional[dict],
+    n_epochs: Optional[int],
+    tau: int,
+    checkpoint_dir: Optional[str] = None,
+    verbose: bool = False,
+):
+    """Ranks 1..N-1: the reference ``EASGD_Worker`` loop, one process."""
+    widx = rank - 1  # data-shard index among the N-1 workers
+    rec = Recorder(
+        print_freq=int((model_config or {}).get("print_freq", 40)),
+        rank=rank,
+        verbose=verbose,
+        save_dir=checkpoint_dir,
+    )
+    worker = EASGD_Worker(
+        widx,
+        jax.local_devices(),
+        modelfile,
+        modelclass,
+        model_config,
+        n_epochs,
+        rec,
+        n_workers=size - 1,
+        server=_RemoteServer(server_address),
+        tau=tau,
+    )
+    joined = request(server_address, {"kind": "join", "rank": rank})
+    worker.set_params(joined["params"])
+    worker.model.current_epoch = int(joined["epoch"])
+    # the epoch report carries this worker's host BN-state snapshot
+    # (taken at the boundary by _epoch_end): the server's own model
+    # never trains, so validating the center with ITS init running
+    # stats would make every mid-run val row garbage on BN models
+    worker.on_epoch_end = lambda r, e: request(
+        server_address,
+        {"kind": "epoch", "rank": rank, "epoch": e,
+         "net_state": worker.host_net_state},
+    )
+    failed = True
+    try:
+        worker._run()
+        failed = False
+    finally:
+        try:
+            request(
+                server_address, {"kind": "done", "rank": rank, "failed": failed}
+            )
+        except OSError:
+            pass  # server already gone; never mask the original error
+        if checkpoint_dir:
+            rec.save()
+    return worker.model
+
+
+# ---------------------------------------------------------------------------
+# GOSGD
+# ---------------------------------------------------------------------------
+
+class _GossipAdapter:
+    """Rank-0 view of the TcpMailbox that sets gossip 2-tuples apart
+    from ('final', params, weight) control messages, which must survive
+    until the consensus phase."""
+
+    def __init__(self, mailbox: TcpMailbox):
+        self.mailbox = mailbox
+        self.n_ranks = mailbox.n_ranks
+        self.finals: List[Tuple[Any, float]] = []
+
+    def send(self, dst: int, msg: Any) -> None:
+        self.mailbox.send(dst, msg)
+
+    def drain(self, rank: Optional[int] = None) -> List[Any]:
+        gossip = []
+        for m in self.mailbox.drain():
+            if isinstance(m, tuple) and len(m) == 3 and m[0] == "final":
+                self.finals.append((m[1], float(np.asarray(m[2]))))
+            else:
+                gossip.append(m)
+        return gossip
+
+
+def run_gosgd_peer(
+    rank: int,
+    size: int,
+    addresses: Sequence[Address],
+    modelfile: str,
+    modelclass: str,
+    model_config: Optional[dict],
+    n_epochs: Optional[int],
+    p_push: float,
+    checkpoint_dir: Optional[str] = None,
+    val_freq: int = 1,
+    verbose: bool = False,
+    timeout: float = 3600.0,
+):
+    """One GOSGD peer process; rank 0 also aggregates the consensus."""
+    mailbox = TcpMailbox(rank, addresses)
+    adapter = _GossipAdapter(mailbox)
+    seed0 = int((model_config or {}).get("seed", 0))
+    rec = Recorder(
+        print_freq=int((model_config or {}).get("print_freq", 40)),
+        rank=rank,
+        verbose=verbose and rank == 0,
+        save_dir=checkpoint_dir,
+    )
+    worker = GOSGD_Worker(
+        rank,
+        jax.local_devices(),
+        modelfile,
+        modelclass,
+        model_config,
+        n_epochs,
+        rec,
+        n_workers=size,
+        mailbox=adapter,
+        p_push=p_push,
+        rng=np.random.RandomState(10_000 + seed0 + rank),
+    )
+    try:
+        worker._run()  # ends with a final inbox drain
+        if rank != 0:
+            mailbox.send(0, ("final", worker.get_params(), worker.weight))
+            # keep the listener open until rank 0 finishes the consensus:
+            # slower peers may still push gossip at this port, and a dead
+            # port would crash their training (their push rolls back on
+            # failure, but staying reachable avoids the churn entirely)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    m = mailbox.recv(timeout=1.0)
+                except Exception:
+                    continue
+                if isinstance(m, tuple) and len(m) == 1 and m[0] == "stop":
+                    break
+                # post-final gossip: its mass is normalized away by rank 0
+            return worker.model
+        # rank 0: gather everyone's final (params, weight), weight-average
+        deadline = time.monotonic() + timeout
+        while len(adapter.finals) < size - 1:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"GOSGD consensus: only {len(adapter.finals)}/{size - 1} "
+                    f"finals within {timeout}s"
+                )
+            worker._merge_inbox()  # late gossip folds into rank 0's mass
+            time.sleep(0.05)
+        entries = [(worker.get_params(), worker.weight)] + adapter.finals
+        tot = sum(w for _, w in entries)
+        acc = None
+        for p, w in entries:
+            part = jax.tree.map(lambda x: np.asarray(x) * (w / tot), p)
+            acc = part if acc is None else jax.tree.map(np.add, acc, part)
+        model = worker.model
+        model.params = replicate(model.mesh, acc)
+        if val_freq:
+            model.run_validation(0, rec)
+        if checkpoint_dir:
+            model.save_model(os.path.join(checkpoint_dir, "ckpt_consensus.npz"))
+            rec.save()
+        # release the peers lingering for shutdown
+        for r in range(1, size):
+            try:
+                mailbox.send(r, ("stop",))
+            except (ConnectionError, OSError):
+                pass  # peer already gone
+        return model
+    finally:
+        mailbox.close()

@@ -8,18 +8,28 @@ asynchrony lives at the host layer by design: device compute stays in
 jitted programs per worker, while parameter pytrees hop between workers
 through this transport.
 
-``Mailbox`` is the in-process implementation (threads driving disjoint
-device subsets under one controller — the single-host analog of the
-reference's one-process-per-GPU).  The interface is deliberately tiny so
-a cross-host implementation (TCP/grpc between ``jax.distributed``
-processes) can slot in without touching the workers.
+Two implementations of the same tiny interface:
+
+- ``Mailbox`` — in-process (threads driving disjoint device subsets
+  under one controller; the single-host analog of the reference's
+  one-process-per-GPU).
+- ``TcpMailbox`` — cross-PROCESS/cross-host: each rank runs a listener
+  socket; ``send`` opens a connection to the peer and writes one framed
+  ``wire``-encoded pytree (SURVEY.md §8.1 maps the reference's MPI
+  send/recv to exactly this: host RPC + device_put).  stdlib-only — no
+  grpc dependency.
+
+``TcpServerChannel``/``request`` add the request-reply shape the EASGD
+worker↔server exchange needs (the reference's paired MPI send+recv).
 """
 
 from __future__ import annotations
 
 import queue
+import socket
+import struct
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
 class Mailbox:
@@ -45,6 +55,157 @@ class Mailbox:
     def recv(self, rank: int, timeout: Optional[float] = None) -> Any:
         """Blocking receive (MPI recv analog). Raises queue.Empty on timeout."""
         return self._queues[rank].get(timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# TCP framing: one 8-byte LE length prefix + wire-encoded pytree per message
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    hdr = _recv_exact(sock, 8)
+    (n,) = struct.unpack("<Q", hdr)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+class TcpMailbox:
+    """Cross-process Mailbox: same send/drain/recv surface, TCP inside.
+
+    ``addresses[r]`` is rank r's ``(host, port)`` listener address; this
+    rank binds and serves ``addresses[rank]``. One connection per
+    message — exchanges happen every τ iterations, so connection setup
+    is noise next to the parameter payload (reference: one MPI message
+    pair per exchange)."""
+
+    def __init__(self, rank: int, addresses: Sequence[Tuple[str, int]]):
+        from theanompi_tpu.parallel import wire
+
+        self._wire = wire
+        self.rank = int(rank)
+        self.addresses = [tuple(a) for a in addresses]
+        self.n_ranks = len(self.addresses)
+        self._q: queue.Queue = queue.Queue()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", self.addresses[self.rank][1]))
+        self._listener.listen(64)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._serve, name=f"TcpMailbox-{rank}", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                with conn:
+                    self._q.put(self._wire.decode(recv_frame(conn)))
+            except (ConnectionError, OSError):
+                continue  # truncated frame: drop, sender will see the reset
+
+    def send(self, dst: int, msg: Any) -> None:
+        host, port = self.addresses[dst]
+        with socket.create_connection((host, port), timeout=60) as s:
+            send_frame(s, self._wire.encode(msg))
+
+    def drain(self, rank: Optional[int] = None) -> List[Any]:
+        """All queued messages (``rank`` accepted for Mailbox interface
+        compatibility; a TcpMailbox only holds its own rank's inbox)."""
+        out = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+    def recv(self, rank: Optional[int] = None, timeout: Optional[float] = None) -> Any:
+        return self._q.get(timeout=timeout)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class TcpServerChannel:
+    """Request-reply server: the EASGD server's MPI recv-loop analog.
+
+    ``handler(msg) -> reply`` runs serialized (one connection at a time —
+    the reference server served workers one at a time by design;
+    SURVEY.md §4.3)."""
+
+    def __init__(self, port: int, handler: Callable[[Any], Any]):
+        from theanompi_tpu.parallel import wire
+
+        self._wire = wire
+        self._handler = handler
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", port))
+        self._listener.listen(64)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._serve, name="TcpServerChannel", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                with conn:
+                    msg = self._wire.decode(recv_frame(conn))
+                    send_frame(conn, self._wire.encode(self._handler(msg)))
+            except (ConnectionError, OSError):
+                continue
+            except Exception:
+                # a handler bug must not kill the serve thread (the
+                # server would silently stop answering and every worker
+                # would die on a request timeout) — log and keep serving;
+                # the unreplied client sees a fast connection error
+                import traceback
+
+                traceback.print_exc()
+                continue
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def request(address: Tuple[str, int], msg: Any, timeout: float = 600.0) -> Any:
+    """Client half of TcpServerChannel: one framed request, one reply."""
+    from theanompi_tpu.parallel import wire
+
+    with socket.create_connection(tuple(address), timeout=timeout) as s:
+        send_frame(s, wire.encode(msg))
+        return wire.decode(recv_frame(s))
 
 
 class SharedCounter:

@@ -1,0 +1,58 @@
+"""Binary pytree wire codec for the cross-process async transport.
+
+Reference analog: the MPI point-to-point sends of whole parameter lists
+in upstream ``easgd_worker/server.py`` and ``gosgd_worker.py`` (SURVEY.md
+§4.3/§4.4) — mpi4py pickled Python objects over the wire.  This codec is
+deliberately pickle-free (same policy as ``utils/checkpoint``): a JSON
+header describes the pytree structure and per-array dtype/shape, followed
+by the raw array bytes.  Deserializing a hostile frame can therefore
+yield only numpy arrays and plain containers, never code execution.
+
+Frame layout::
+
+    [4-byte LE header length][header JSON][array 0 bytes][array 1 bytes]…
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, List
+
+import numpy as np
+
+from theanompi_tpu.utils.checkpoint import _decode, _encode
+
+
+def encode(tree: Any) -> bytes:
+    """Pytree of arrays/scalars/containers → one framed bytes blob."""
+    leaves: List[np.ndarray] = []
+    structure = _encode(tree, leaves)
+    leaves = [np.ascontiguousarray(a) for a in leaves]
+    header = json.dumps(
+        {
+            "structure": structure,
+            "arrays": [
+                {"dtype": a.dtype.str, "shape": list(a.shape)} for a in leaves
+            ],
+        }
+    ).encode("utf-8")
+    parts = [struct.pack("<I", len(header)), header]
+    parts.extend(a.tobytes() for a in leaves)
+    return b"".join(parts)
+
+
+def decode(buf: bytes) -> Any:
+    """Inverse of :func:`encode`."""
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    header = json.loads(buf[4 : 4 + hlen].decode("utf-8"))
+    off = 4 + hlen
+    leaves = []
+    for spec in header["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        a = np.frombuffer(buf, dtype=dt, count=count, offset=off).reshape(shape)
+        leaves.append(a.copy())
+        off += a.nbytes
+    return _decode(header["structure"], leaves)

@@ -58,11 +58,13 @@ def _encode(node: Any, leaves: List[np.ndarray]) -> Any:
         return {"t": "list", "v": [_encode(x, leaves) for x in node]}
     if node is None:
         return {"t": "none"}
-    # leaf: device array / np array / python scalar
-    if isinstance(node, (bool, int, float, str)):
-        kind = type(node).__name__
-    elif hasattr(node, "shape"):  # jax.Array / np.ndarray / np scalar
+    # leaf: device array / np array / python scalar.  The shape check
+    # comes FIRST: numpy scalars subclass python float/int, and must
+    # round-trip as 0-d arrays (dtype preserved), not python kinds.
+    if hasattr(node, "shape"):  # jax.Array / np.ndarray / np scalar
         kind = "array"
+    elif isinstance(node, (bool, int, float, str)):
+        kind = type(node).__name__
     else:
         raise TypeError(
             f"checkpoint cannot serialize leaf of type {type(node).__name__}; "
@@ -92,9 +94,10 @@ def _decode(desc: Any, leaves: List[np.ndarray]) -> Any:
         kind = desc.get("kind", "array")
         if kind == "array":
             return a
-        # python scalar round-trip (epoch counters, flags, tags)
+        # python scalar round-trip (epoch counters, flags, tags); scalar
+        # kinds are always stored as 0-d arrays
         return {"bool": bool, "int": int, "float": float, "str": str}[kind](
-            a.item() if a.shape == () else a
+            a.item()
         )
     raise ValueError(f"unknown checkpoint node type {t!r} (corrupt file?)")
 
