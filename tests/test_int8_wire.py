@@ -1,0 +1,162 @@
+"""int8 + per-block-scale wire strategy (VERDICT round-1 #5).
+
+The reference's native capability was fp16 pack/unpack CUDA kernels
+halving exchange bytes (SURVEY.md §3.3 native #1); the ``int8`` strategy
+quarters them.  These tests pin (a) quantizer math, (b) XLA-vs-Pallas
+kernel equivalence, (c) training equivalence vs the fp32 ``ar`` path,
+and (d) — the honesty check — that the lowered HLO's collectives really
+move s8, not f32.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.parallel import quantize as Q
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh
+from theanompi_tpu.runtime.recorder import Recorder
+
+TINY = dict(
+    n_synth_train=512,
+    n_synth_val=64,
+    n_epochs=1,
+    dropout_rate=0.0,
+    print_freq=1000,
+    comm_probe=False,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, Q.BLOCK).astype(np.float32) * 3.0
+    q, s = Q.quantize_blocks(x)
+    assert q.dtype == jnp.int8
+    back = np.asarray(Q.dequantize_blocks(q, s))
+    # per-block max-abs scaling bounds the error at scale/2 per element
+    bound = (np.abs(x).max(axis=1, keepdims=True) / 127.0) * 0.5 + 1e-7
+    assert (np.abs(back - x) <= bound).all()
+
+
+def test_quantize_zero_block_safe():
+    x = np.zeros((4, Q.BLOCK), np.float32)
+    q, s = Q.quantize_blocks(x)
+    assert np.asarray(q).max() == 0
+    np.testing.assert_array_equal(np.asarray(Q.dequantize_blocks(q, s)), x)
+
+
+def test_pallas_kernels_match_xla():
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, Q.BLOCK).astype(np.float32)  # 64 rows: 2 pallas tiles
+    q_x, s_x = Q.quantize_blocks(x)
+    q_p, s_p = Q.pallas_quantize_blocks(x)
+    np.testing.assert_array_equal(np.asarray(q_x), np.asarray(q_p))
+    np.testing.assert_allclose(np.asarray(s_x), np.asarray(s_p), rtol=1e-6)
+    d_x = Q.dequantize_blocks(q_x, s_x)
+    d_p = Q.pallas_dequantize_blocks(q_p, s_p)
+    np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_p), rtol=1e-6)
+
+
+def _int8_mean(mesh, g_global, strategy="int8"):
+    """Run the exchanger's int8 reduce inside shard_map; every shard gets
+    the (approximate) mean of the per-shard values."""
+    ex = BSP_Exchanger(strategy=strategy, axis=DATA_AXIS, mesh=mesh)
+
+    def step(g):
+        return ex.reduce_grads({"g": g})["g"]
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+    )
+    return np.asarray(fn(g_global))
+
+
+@pytest.mark.parametrize("strategy", ["int8", "pallas_int8"])
+def test_int8_reduce_matches_true_mean(strategy):
+    mesh = make_mesh()
+    n_dev = 8
+    rng = np.random.RandomState(2)
+    g = rng.randn(n_dev, 1000).astype(np.float32)  # shard i = row i
+    out = _int8_mean(mesh, g, strategy)
+    true_mean = g.mean(axis=0)
+    # every shard holds the same reduced values
+    for i in range(n_dev):
+        np.testing.assert_allclose(out[i], true_mean, atol=2e-2)
+
+
+def test_int8_requires_mesh():
+    with pytest.raises(ValueError, match="needs the mesh"):
+        BSP_Exchanger(strategy="int8")
+
+
+@pytest.mark.parametrize("strategy", ["int8", "pallas_int8"])
+def test_int8_training_tracks_ar(strategy):
+    def run(strat):
+        model = Cifar10_model(
+            config=dict(TINY, batch_size=8, exch_strategy=strat),
+            mesh=make_mesh(),
+        )
+        model.compile_train()
+        model.reset_train_iter(0)
+        rec = Recorder(verbose=False)
+        return [float(model.train_iter(i, rec)[0]) for i in range(1, 5)]
+
+    np.testing.assert_allclose(run(strategy), run("ar"), rtol=5e-2)
+
+
+def test_int8_wire_bytes_actually_shrink():
+    """HLO honesty check: the exchange collectives must carry s8 — and
+    the full-size f32 all-reduce of the ``ar`` path must be gone."""
+    mesh = make_mesh()
+    n = 8 * Q.BLOCK * 32 * 2  # two full chunks, no padding noise
+
+    def lower(strategy):
+        ex = BSP_Exchanger(strategy=strategy, axis=DATA_AXIS, mesh=mesh)
+
+        def step(g):
+            return ex.reduce_grads({"g": g})["g"]
+
+        return (
+            jax.jit(
+                jax.shard_map(
+                    step, mesh=mesh, in_specs=P(DATA_AXIS),
+                    out_specs=P(DATA_AXIS), check_vma=False,
+                )
+            )
+            .lower(jax.ShapeDtypeStruct((8, n), jnp.float32))
+            .compile()  # post-optimization HLO shows the real wire types
+            .as_text()
+        )
+
+    def _f32_elems(line):
+        return [
+            int(np.prod([int(d) for d in dims.split(",") if d]))
+            for dims in re.findall(r"f32\[([\d,]*)\]", line)
+        ]
+
+    hlo8 = lower("int8")
+    lines = [
+        l for l in hlo8.splitlines() if re.search(r"all-to-all|all-gather", l)
+    ]
+    assert lines, "int8 path lost its collectives"
+    assert any("s8[" in l and "all-to-all" in l for l in lines), hlo8[:2000]
+    assert any("s8[" in l and "all-gather" in l or "all_gather" in l and "s8[" in l for l in lines)
+    # fp32 may only ride the wire as per-block scales (n/BLOCK elements
+    # total) — never as a payload-sized tensor (n/8 per shard and up)
+    for l in lines:
+        for sz in _f32_elems(l):
+            assert sz <= n // Q.BLOCK, f"fp32 payload on the wire: {l}"
+
+    hlo_ar = lower("ar")
+    ar_lines = [l for l in hlo_ar.splitlines() if "all-reduce" in l]
+    assert any(
+        sz >= n // 8 for l in ar_lines for sz in _f32_elems(l)
+    )  # the baseline really does move fp32 payloads

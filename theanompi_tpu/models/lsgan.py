@@ -121,7 +121,9 @@ class LSGAN(TpuModel):
     # -- fused adversarial step -----------------------------------------
     def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
         cfg = self.config
-        exchanger = exchanger or BSP_Exchanger(strategy=cfg.exch_strategy)
+        exchanger = exchanger or BSP_Exchanger(
+            strategy=cfg.exch_strategy, mesh=self.mesh
+        )
         axis = exchanger.axis
         G, D = self.generator, self.discriminator
         g_opt, d_opt = self.g_opt, self.d_opt

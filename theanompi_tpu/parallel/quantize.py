@@ -1,0 +1,119 @@
+"""Block-quantized int8 wire format for gradient exchange.
+
+The reference's native-kernel capability was fp16 pack/unpack CUDA
+kernels that halved exchange bytes (upstream ``Exch_asa16``; SURVEY.md
+§3.3 native #1).  This module goes past parity: **int8 + per-block fp32
+scale**, quartering the wire vs fp32 — the modern gradient-compression
+recipe (per-block max-abs scaling keeps the quantization error bounded
+per 256-element block instead of per whole tensor).
+
+Two equivalent implementations:
+
+- :func:`quantize_blocks` / :func:`dequantize_blocks` — XLA ops; these
+  fuse into the surrounding step (measured on this rig: ``pallas_call``
+  is a fusion barrier, so the XLA path is the perf default).
+- :func:`pallas_quantize_blocks` / :func:`pallas_dequantize_blocks` —
+  explicit Pallas TPU kernels (interpret-mode on CPU), the native-tier
+  seam.  Tiles are (32, lanes) so the int8 operand respects the TPU's
+  (32, 128) int8 tiling (pallas_guide.md).
+
+The exchange algebra lives in ``exchanger.BSP_Exchanger`` (strategies
+``int8`` / ``pallas_int8``): quantize → all_to_all (int8 shards + fp32
+scales) → dequantize → fp32 shard-sum → requantize → all_gather →
+dequantize.  Summation always happens in fp32 — int8 is a WIRE format
+only, never an accumulator (a sum of int8 values overflows at world
+size 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK = 256  # elements per quantization block (fp32 scale each)
+
+
+# ---------------------------------------------------------------------------
+# XLA path
+# ---------------------------------------------------------------------------
+
+def quantize_blocks(x: jnp.ndarray):
+    """(…, BLOCK) fp32 → ((…, BLOCK) int8, (…,) fp32 scales)."""
+    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.round(x / safe[..., None]).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Pallas path (native-tier kernels)
+# ---------------------------------------------------------------------------
+
+_ROWS = 32  # int8 TPU tile: (32, 128); 32 is also a legal f32 sublane count
+_LANES = 256  # = BLOCK: one quant block per row segment
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...]  # (_ROWS, _LANES) fp32 — one quant block per row
+    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0  # (_ROWS, 1)
+    safe = jnp.where(s > 0, s, 1.0)
+    q_ref[...] = jnp.round(x / safe).astype(jnp.int8)
+    s_ref[...] = s.astype(jnp.float32)
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref):
+    o_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[...]
+
+
+def pallas_quantize_blocks(x: jnp.ndarray):
+    """Same contract as :func:`quantize_blocks`, for (…, BLOCK) inputs
+    whose leading dims multiply to a multiple of 32 (the exchanger pads
+    to this)."""
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    x2 = x.reshape(rows, BLOCK)
+    grid = rows // _ROWS
+    q2, s2 = pl.pallas_call(
+        _quant_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0))],
+        out_specs=(
+            pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
+        ),
+        interpret=(jax.default_backend() == "cpu"),
+    )(x2)
+    return q2.reshape(*lead, BLOCK), s2.reshape(lead)
+
+
+def pallas_dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    lead = q.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    q2 = q.reshape(rows, BLOCK)
+    s2 = scale.reshape(rows, 1)
+    grid = rows // _ROWS
+    o2 = pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, BLOCK), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
+        interpret=(jax.default_backend() == "cpu"),
+    )(q2, s2)
+    return o2.reshape(*lead, BLOCK)
