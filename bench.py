@@ -44,11 +44,13 @@ def main():
     batches = [shard_batch(mesh, b) for b in model.data.train_batches()]
 
     params, net_state, opt_state = model.params, model.net_state, model.opt_state
-    rng = jax.random.PRNGKey(0)
+    # pre-split per-step keys (round-1 wart: one key reused every step
+    # made every iteration draw identical dropout masks)
+    keys = list(jax.random.split(jax.random.PRNGKey(0), 2100))
 
     def step(p, s, o, i):
         x, y = batches[i % len(batches)]
-        return train_fn(p, s, o, x, y, rng)
+        return train_fn(p, s, o, x, y, keys[i % len(keys)])
 
     # warmup (compile + 5 steps)
     for i in range(5):

@@ -32,6 +32,8 @@ class AlexNet(TpuModel):
         n_classes=1000,
         data_dir=None,
         n_synth_batches=64,
+        lrn_impl="auto",  # see ops.layers.LRN: auto|xla|shift|window|pallas
+        lrn_remat=False,  # recompute LRN internals in bwd (saves HBM)
     )
 
     def build_data(self):
@@ -53,15 +55,16 @@ class AlexNet(TpuModel):
         cfg = self.config
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
         drop = float(cfg.dropout_rate)
+        lrn = dict(impl=str(cfg.lrn_impl), remat=bool(cfg.lrn_remat))
         net = L.Sequential(
             [
                 L.Conv2d(96, 11, stride=4, padding="SAME", compute_dtype=dt),
                 L.Relu(),
-                L.LRN(),
+                L.LRN(**lrn),
                 L.MaxPool(3, stride=2),
                 L.Conv2d(256, 5, padding="SAME", compute_dtype=dt),
                 L.Relu(),
-                L.LRN(),
+                L.LRN(**lrn),
                 L.MaxPool(3, stride=2),
                 L.Conv2d(384, 3, padding="SAME", compute_dtype=dt),
                 L.Relu(),

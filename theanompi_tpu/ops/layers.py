@@ -302,14 +302,21 @@ class LRN(Layer):
       op-for-op shape, kept as the numeric baseline).
     """
 
-    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0, impl="auto"):
-        if impl not in ("auto", "xla", "pallas", "window"):
-            raise ValueError(f"impl must be auto|xla|pallas|window, got {impl!r}")
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0, impl="auto",
+                 remat=False):
+        if impl not in ("auto", "xla", "pallas", "window", "shift"):
+            raise ValueError(
+                f"impl must be auto|xla|pallas|window|shift, got {impl!r}"
+            )
         self.size = size
         self.alpha = alpha
         self.beta = beta
         self.k = k
         self.impl = impl
+        # remat: recompute the window sum in the backward pass instead of
+        # saving the fp32 denominator activation — trades a second cheap
+        # window sum for a [N,H,W,C] fp32 HBM round-trip
+        self.remat = remat
 
     def apply(self, params, state, x, train=False, rng=None):
         if self.impl == "pallas":
@@ -320,6 +327,12 @@ class LRN(Layer):
                            float(self.k)),
                 state,
             )
+        fn = self._normalize
+        if self.remat:
+            fn = jax.checkpoint(fn)
+        return fn(x), state
+
+    def _normalize(self, x):
         pad = self.size // 2
         if self.impl == "window":
             # literal pad + reduce_window chain (numeric baseline)
@@ -328,6 +341,17 @@ class LRN(Layer):
             win = lax.reduce_window(
                 sq, 0.0, lax.add, (1, 1, 1, self.size), (1, 1, 1, 1), "VALID"
             )
+        elif self.impl == "shift":
+            # explicit shifted adds along the lane (channel) axis: O(size)
+            # elementwise work instead of the O(C) MXU contraction — the
+            # window sum becomes size slices + adds that XLA fuses into
+            # the surrounding square/power/divide chain
+            sq = jnp.square(x.astype(jnp.float32))
+            sq = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (pad, self.size - 1 - pad)))
+            c = x.shape[-1]
+            win = sq[..., :c]
+            for i in range(1, self.size):
+                win = win + sq[..., i : i + c]
         else:
             # banded-matmul window sum: rides the MXU with fp32
             # accumulation, and XLA fuses the square into the contraction
@@ -338,7 +362,7 @@ class LRN(Layer):
                 preferred_element_type=jnp.float32,
             )
         denom = jnp.power(self.k + self.alpha * win, self.beta)
-        return (x.astype(jnp.float32) / denom).astype(x.dtype), state
+        return (x.astype(jnp.float32) / denom).astype(x.dtype)
 
 
 class BatchNorm(Layer):
