@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Sweep AlexNet step-time knobs on the real chip (perf exploration;
+bench.py stays the canonical single-number harness)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.alex_net import AlexNet
+from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
+
+
+def measure(cfg_overrides, steps=120):
+    mesh = make_mesh()
+    model = AlexNet(
+        config=dict(
+            batch_size=512,
+            compute_dtype="bfloat16",
+            lr=1e-3,
+            n_synth_batches=8,
+            print_freq=10_000,
+            **cfg_overrides,
+        ),
+        mesh=mesh,
+    )
+    train_fn = model.compile_train()
+    batches = [shard_batch(mesh, b) for b in model.data.train_batches()]
+    p, s, o = model.params, model.net_state, model.opt_state
+    keys = list(jax.random.split(jax.random.PRNGKey(0), 256))
+
+    def step(p, s, o, i):
+        x, y = batches[i % len(batches)]
+        return train_fn(p, s, o, x, y, keys[i % len(keys)])
+
+    for i in range(8):
+        p, s, o, loss, err = step(p, s, o, i)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        p, s, o, loss, err = step(p, s, o, i)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * 512 / dt
+
+
+if __name__ == "__main__":
+    configs = [
+        ("xla", dict(lrn_impl="xla")),
+        ("xla+remat", dict(lrn_impl="xla", lrn_remat=True)),
+        ("shift", dict(lrn_impl="shift")),
+        ("shift+remat", dict(lrn_impl="shift", lrn_remat=True)),
+        ("window", dict(lrn_impl="window")),
+    ]
+    for name, cfg in configs:
+        ips = measure(cfg)
+        print(f"{name:16s} {ips:10.0f} img/s", flush=True)

@@ -108,3 +108,26 @@ def test_scale_lr_and_adjust_hyperp():
     base = float(model.opt_state["lr"])
     model.scale_lr(8.0)
     assert float(model.opt_state["lr"]) == pytest.approx(8 * base)
+
+
+def test_worker_engages_linear_lr_scaling():
+    """The BSP worker linearly scales lr by n_workers (the reference's
+    scale_lr heritage), unless lr_linear_scaling=False."""
+    from theanompi_tpu.parallel.workers import BSP_Worker
+
+    base_lr = float(
+        Cifar10_model(config=dict(TINY, batch_size=4), mesh=make_mesh())
+        .opt_state["lr"]
+    )
+    model = Cifar10_model(config=dict(TINY, batch_size=4), mesh=make_mesh())
+    BSP_Worker(model, val_freq=0).run()
+    assert float(model.opt_state["lr"]) == pytest.approx(
+        base_lr * model.n_workers
+    )
+
+    off = Cifar10_model(
+        config=dict(TINY, batch_size=4, lr_linear_scaling=False),
+        mesh=make_mesh(),
+    )
+    BSP_Worker(off, val_freq=0).run()
+    assert float(off.opt_state["lr"]) == pytest.approx(base_lr)

@@ -39,3 +39,44 @@ def test_mnist_cnn_trains():
     # dropout needs rng: implicitly checked (train=True path)
     loss, err, err5 = model.run_validation(1, rec)
     assert np.isfinite([loss, err, err5]).all()
+
+
+def test_cifar10_cnn_trains():
+    from theanompi_tpu.models.keras_model_zoo import Cifar10Cnn
+
+    model = Cifar10Cnn(
+        config=dict(batch_size=8, n_synth_train=256, n_synth_val=64,
+                    print_freq=10_000),
+        mesh=make_mesh(),
+    )
+    model.compile_train()
+    rec = Recorder(verbose=False)
+    model.reset_train_iter(0)
+    losses = [model.train_iter(i, rec)[0] for i in range(1, 5)]
+    assert np.isfinite(losses).all()
+    assert np.isfinite(model.run_validation(1, rec)).all()
+
+
+def test_mnist_mlp_learns():
+    from theanompi_tpu.models.keras_model_zoo import MnistMlp
+
+    model = MnistMlp(
+        config=dict(batch_size=32, n_synth_train=2048, n_synth_val=64,
+                    print_freq=10_000, dropout_rate=0.0),
+        mesh=make_mesh(),
+    )
+    model.compile_train()
+    rec = Recorder(verbose=False)
+    model.reset_train_iter(0)
+    losses = [model.train_iter(i, rec)[0] for i in range(1, 9)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_zoo_rule_import_path():
+    """Models import by reference-style (modelfile, modelclass) strings."""
+    import importlib
+
+    mod = importlib.import_module("theanompi_tpu.models.keras_model_zoo")
+    for name in ("MnistCnn", "MnistMlp", "Cifar10Cnn"):
+        assert hasattr(mod, name)

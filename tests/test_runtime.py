@@ -126,3 +126,25 @@ def test_single_entry_hostnames_is_single_host(monkeypatch):
     assert mesh_mod._env_says_multihost() is False
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h1,h2")
     assert mesh_mod._env_says_multihost() is True
+
+
+def test_multihost_env_with_failed_autodetect_hard_fails(monkeypatch):
+    """Pod-looking env + no coordinator must raise, not silently train N
+    unsynced replicas (the override env var restores the old degrade)."""
+    from theanompi_tpu.runtime import mesh as mesh_mod
+
+    monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
+    monkeypatch.setattr(mesh_mod, "_distributed_gave_up", False)
+    monkeypatch.setenv("CLOUD_TPU_TASK_ID", "0")
+    monkeypatch.delenv("THEANOMPI_TPU_ALLOW_DEGRADED", raising=False)
+
+    def boom(**kw):
+        raise ValueError("no coordinator found")
+
+    monkeypatch.setattr(mesh_mod.jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="UNSYNCED"):
+        mesh_mod.init_distributed()
+
+    monkeypatch.setenv("THEANOMPI_TPU_ALLOW_DEGRADED", "1")
+    with pytest.warns(RuntimeWarning, match="SINGLE-HOST"):
+        assert mesh_mod.init_distributed() is False

@@ -78,6 +78,11 @@ class TpuModel:
     # sequence over 'sp', exchange over ('dp','sp')).
     batch_spec = P(DATA_AXIS)
     exchange_axes = DATA_AXIS
+    # mesh axes the LEADING (batch) dim of batch_spec shards over — the
+    # per-shard batch_size multiplies over these to give global_batch.
+    # The MoE model adds 'ep' (tokens shard over dp×ep); the transformer
+    # does NOT add 'sp' (sp shards the sequence dim, not the batch dim).
+    batch_axes = (DATA_AXIS,)
 
     def __init__(self, config: Optional[dict] = None, mesh=None, **overrides):
         self.config = Config(COMMON_DEFAULTS)
@@ -89,7 +94,10 @@ class TpuModel:
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self._engage_dcn_axis()
-        self.n_workers = int(self.mesh.shape[DATA_AXIS])
+        self.n_workers = 1
+        for ax in self.batch_axes:
+            if ax in self.mesh.shape:
+                self.n_workers *= int(self.mesh.shape[ax])
         if DCN_AXIS in self.mesh.shape:
             self.n_workers *= int(self.mesh.shape[DCN_AXIS])
         self.batch_size = int(cfg.batch_size)
@@ -133,6 +141,21 @@ class TpuModel:
         lead_t = (lead,) if isinstance(lead, str) else tuple(lead)
         if DCN_AXIS not in lead_t:
             self.batch_spec = P((DCN_AXIS,) + lead_t, *self.batch_spec[1:])
+
+    @classmethod
+    def _require_mesh_axis(cls, mesh, axis: str, size: int):
+        """Validate that ``mesh`` carries model-parallel ``axis`` at
+        ``size`` (shared by the pp/ep/tp models' __init__)."""
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"config {axis}={size} but mesh has no '{axis}' axis "
+                f"({mesh.axis_names}); build it with "
+                f"{cls.__name__}.build_mesh(...)"
+            )
+        if int(mesh.shape[axis]) != size:
+            raise ValueError(
+                f"config {axis}={size} != mesh {axis} size {mesh.shape[axis]}"
+            )
 
     # ------------------------------------------------------------------
     # subclass hooks
@@ -466,6 +489,14 @@ class TpuModel:
         from theanompi_tpu.utils import checkpoint
 
         blob = checkpoint.restore(path)
+        if jax.tree.structure(blob["params"]) != jax.tree.structure(self.params):
+            raise ValueError(
+                f"checkpoint {path!r} has a different params structure than "
+                f"this model — an architecture config changed between save "
+                "and load (e.g. GoogLeNet aux_heads, WResNet depth). "
+                "Rebuild the model with the config the checkpoint was "
+                "trained with."
+            )
         self.params = replicate(self.mesh, blob["params"])
         self.net_state = replicate(self.mesh, blob["net_state"])
         self.opt_state = replicate(self.mesh, blob["opt_state"])

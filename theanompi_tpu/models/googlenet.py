@@ -2,11 +2,12 @@
 
 Reference analog: ``GoogLeNet`` in ``theanompi/models/googlenet.py``
 (SURVEY.md §3.5, ~1000 LoC of hand-built Theano inception blocks).  Here
-each inception block is one ``Parallel`` combinator over four branches.
-The reference-era auxiliary classifiers are omitted: they existed to
-mitigate vanishing gradients in 2014-era plain SGD and complicate the
-single-output model contract; modern init + BN-free LRN training of this
-depth converges without them (documented deviation).
+each inception block is one ``Parallel`` combinator over four branches,
+and the two reference-era **auxiliary classifiers** (tapped off
+inception 4a and 4d, loss-weighted 0.3, train-only) hang off an
+``AuxTapped`` trunk — inference never pays for them.  Set
+``aux_heads=False`` to drop them (modern init converges without them,
+but the default matches the reference architecture).
 """
 
 from __future__ import annotations
@@ -16,6 +17,7 @@ import jax.numpy as jnp
 from theanompi_tpu.data.providers import ImageNetData
 from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import losses
 from theanompi_tpu.ops import optim
 
 
@@ -39,6 +41,23 @@ def _inception(c1, c3r, c3, c5r, c5, pp, dt):
     )
 
 
+def _aux_head(n_classes, dt):
+    """Szegedy-2014 auxiliary classifier: avgpool 5/3 → 1×1×128 conv →
+    FC-1024 → dropout 0.7 → FC-n_classes. SAME pooling so the head also
+    wires up at the small image sizes the smoke tests use."""
+    return L.Sequential(
+        [
+            L.AvgPool(5, stride=3, padding="SAME"),
+            _conv(128, 1, dt),
+            L.Flatten(),
+            L.Dense(1024, compute_dtype=dt),
+            L.Relu(),
+            L.Dropout(0.7),
+            L.Dense(n_classes, compute_dtype=dt, output_dtype=jnp.float32),
+        ]
+    )
+
+
 class GoogLeNet(TpuModel):
     default_config = dict(
         batch_size=64,
@@ -53,6 +72,8 @@ class GoogLeNet(TpuModel):
         data_dir=None,
         n_synth_batches=32,
         exch_strategy="bf16",  # BASELINE.json config #3 exchanger path
+        aux_heads=True,  # reference-parity train-only aux classifiers
+        aux_weight=0.3,  # classic 0.3 weighting of each aux loss
     )
 
     def build_data(self):
@@ -69,7 +90,8 @@ class GoogLeNet(TpuModel):
     def build_net(self):
         cfg = self.config
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
-        net = L.Sequential(
+        nc = int(cfg.n_classes)
+        stem_to_4a = L.Sequential(
             [
                 _conv(64, 7, dt, stride=2),
                 L.MaxPool(3, stride=2, padding="SAME"),
@@ -82,20 +104,55 @@ class GoogLeNet(TpuModel):
                 _inception(128, 128, 192, 32, 96, 64, dt),  # 3b -> 480
                 L.MaxPool(3, stride=2, padding="SAME"),
                 _inception(192, 96, 208, 16, 48, 64, dt),  # 4a -> 512
+            ]
+        )
+        mid_to_4d = L.Sequential(
+            [
                 _inception(160, 112, 224, 24, 64, 64, dt),  # 4b
                 _inception(128, 128, 256, 24, 64, 64, dt),  # 4c
                 _inception(112, 144, 288, 32, 64, 64, dt),  # 4d -> 528
+            ]
+        )
+        tail = L.Sequential(
+            [
                 _inception(256, 160, 320, 32, 128, 128, dt),  # 4e -> 832
                 L.MaxPool(3, stride=2, padding="SAME"),
                 _inception(256, 160, 320, 32, 128, 128, dt),  # 5a
                 _inception(384, 192, 384, 48, 128, 128, dt),  # 5b -> 1024
                 L.GlobalAvgPool(),
                 L.Dropout(float(cfg.dropout_rate)),
-                L.Dense(int(cfg.n_classes), compute_dtype=dt, output_dtype=jnp.float32),
+                L.Dense(nc, compute_dtype=dt, output_dtype=jnp.float32),
             ]
         )
+        if bool(cfg.aux_heads):
+            net = L.AuxTapped(
+                [stem_to_4a, mid_to_4d, tail],
+                [_aux_head(nc, dt), _aux_head(nc, dt), None],
+            )
+        else:
+            net = L.Sequential([stem_to_4a, mid_to_4d, tail])
         self.lr_schedule = optim.step_decay(
             float(cfg.lr), list(cfg.lr_boundaries), 0.1
         )
         size = int(cfg.image_size)
         return net, (size, size, 3)
+
+    def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
+        if not (train and bool(self.config.aux_heads)):
+            return super().loss_and_metrics(params, net_state, x, y, train, rng)
+        dtype = self.config.compute_dtype
+        if dtype is not None:
+            x = x.astype(jnp.dtype(dtype))
+        (logits, aux_logits), new_state = self.net.apply(
+            params, net_state, x, train=True, rng=rng
+        )
+        loss = losses.softmax_cross_entropy(logits, y)
+        w = float(self.config.aux_weight)
+        for al in aux_logits:
+            loss = loss + w * losses.softmax_cross_entropy(al, y)
+        err = losses.classification_error(logits, y)
+        if self.config.val_top5 and logits.shape[-1] > 5:
+            err5 = losses.topk_error(logits, y, k=5)
+        else:
+            err5 = err
+        return loss, (err, err5, new_state)

@@ -10,4 +10,6 @@ reference-style path::
 """
 
 from theanompi_tpu.models.keras_model_zoo import klayers  # noqa: F401
+from theanompi_tpu.models.keras_model_zoo.cifar10_cnn import Cifar10Cnn  # noqa: F401
 from theanompi_tpu.models.keras_model_zoo.mnist_cnn import MnistCnn  # noqa: F401
+from theanompi_tpu.models.keras_model_zoo.mnist_mlp import MnistMlp  # noqa: F401

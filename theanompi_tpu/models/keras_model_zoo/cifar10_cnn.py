@@ -1,0 +1,59 @@
+"""Keras-zoo CIFAR-10 CNN.
+
+Reference analog: the Keras(Theano-backend) zoo in upstream
+``theanompi/models/keras_model_zoo/`` (SURVEY.md §3.5, LOW-confidence
+layout). This is the classic Keras ``cifar10_cnn`` example topology —
+two conv blocks + FC-512 head — written against the Keras-spelled
+frontend (``klayers``) and compiled to the same jitted BSP step as the
+native models.
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu.data.providers import Cifar10Data
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.models.keras_model_zoo import klayers as K
+from theanompi_tpu.ops import optim
+
+
+class Cifar10Cnn(TpuModel):
+    default_config = dict(
+        batch_size=32,
+        n_epochs=100,
+        lr=0.01,
+        momentum=0.9,
+        weight_decay=1e-6,
+        dropout1=0.25,
+        dropout2=0.5,
+        data_dir=None,
+        n_synth_train=8192,
+        n_synth_val=1024,
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = Cifar10Data(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        model = K.Sequential()
+        model.add(K.Conv2D(32, 3, activation="relu", padding="same"))
+        model.add(K.Conv2D(32, 3, activation="relu", padding="valid"))
+        model.add(K.MaxPooling2D(pool_size=2))
+        model.add(K.Dropout(float(cfg.dropout1)))
+        model.add(K.Conv2D(64, 3, activation="relu", padding="same"))
+        model.add(K.Conv2D(64, 3, activation="relu", padding="valid"))
+        model.add(K.MaxPooling2D(pool_size=2))
+        model.add(K.Dropout(float(cfg.dropout1)))
+        model.add(K.Flatten())
+        model.add(K.Dense(512, activation="relu"))
+        model.add(K.Dropout(float(cfg.dropout2)))
+        model.add(K.Dense(Cifar10Data.n_classes))
+        self.lr_schedule = optim.constant(float(cfg.lr))
+        return model, Cifar10Data.shape

@@ -1,0 +1,52 @@
+"""Keras-zoo MNIST MLP.
+
+Reference analog: upstream ``theanompi/models/keras_model_zoo/``
+(SURVEY.md §3.5). The classic Keras ``mnist_mlp`` example — two
+dropout-regularized 512-unit layers — in ``klayers`` spelling; the
+smallest member of the zoo, useful as the fastest-compiling sanity
+model.
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu.data.providers import MnistData
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.models.keras_model_zoo import klayers as K
+from theanompi_tpu.ops import optim
+
+
+class MnistMlp(TpuModel):
+    default_config = dict(
+        batch_size=128,
+        n_epochs=20,
+        lr=0.05,
+        momentum=0.9,
+        weight_decay=0.0,
+        dropout_rate=0.2,
+        data_dir=None,
+        n_synth_train=4096,
+        n_synth_val=512,
+    )
+
+    def build_data(self):
+        cfg = self.config
+        self.data = MnistData(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        drop = float(cfg.dropout_rate)
+        model = K.Sequential()
+        model.add(K.Flatten())
+        model.add(K.Dense(512, activation="relu"))
+        model.add(K.Dropout(drop))
+        model.add(K.Dense(512, activation="relu"))
+        model.add(K.Dropout(drop))
+        model.add(K.Dense(10))
+        self.lr_schedule = optim.constant(float(cfg.lr))
+        return model, MnistData.shape

@@ -1,0 +1,122 @@
+"""Pipeline-parallel residual MLP classifier.
+
+No reference analog (Theano-MPI is data-parallel only; SURVEY.md §3.4)
+— this is the demonstrator for the beyond-reference ``pp`` mesh axis:
+an input projection and classifier head run replicated on every device,
+while S residual MLP blocks execute as a GPipe pipeline
+(``parallel.pipeline.PipelineStages``) with stage weights sharded over
+``pp`` and activations streaming between ICI neighbors. Composes with
+data parallelism on a (dp, pp) mesh: batch shards over ``dp``,
+gradients reduce over (dp, pp) with stage leaves skipping ``pp`` via
+``param_specs`` (same mechanism as tensor parallelism).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.data.providers import Cifar10Data
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import optim
+from theanompi_tpu.parallel.pipeline import PipelineStages
+from theanompi_tpu.runtime.mesh import DATA_AXIS, PP_AXIS, make_dp_axis_mesh
+
+
+def _stage_builder(d_model: int):
+    def build(_i: int):
+        return L.Residual(
+            L.Sequential(
+                [
+                    L.Dense(d_model),
+                    L.Relu(),
+                    L.Dense(d_model),
+                ]
+            )
+        )
+
+    return build
+
+
+class PipelinedMLP(TpuModel):
+    default_config = dict(
+        batch_size=32,  # per dp shard (global over pp: replicated)
+        d_model=128,
+        pp=2,  # pipeline depth = mesh pp-axis size
+        n_micro=4,  # microbatches per step (bubble = (pp-1)/(n_micro+pp-1))
+        n_classes=10,
+        lr=0.05,
+        momentum=0.9,
+        weight_decay=0.0,
+        n_epochs=5,
+        data_dir=None,
+        n_synth_train=2048,
+        n_synth_val=256,
+    )
+
+    @classmethod
+    def build_mesh(cls, devices=None, config=None):
+        cfg = dict(cls.default_config)
+        cfg.update(dict(config or {}))
+        return make_dp_axis_mesh(PP_AXIS, int(cfg.get("pp", 1)), devices)
+
+    def __init__(self, config=None, mesh=None, **overrides):
+        cfg = dict(self.default_config)
+        cfg.update(dict(config or {}))
+        cfg.update(overrides)
+        pp = int(cfg.get("pp", 1))
+        if mesh is None:
+            mesh = self.build_mesh(config=cfg)
+        self._require_mesh_axis(mesh, PP_AXIS, pp)
+        self.pp_size = pp
+        # batch shards over dp, replicated over pp (every stage device
+        # sees the full dp-shard; stage masking selects what it uses);
+        # replicated-leaf grads are identical across pp after the f/g
+        # pair, so pp joins the mean axes; stage leaves skip pp via
+        # param_specs.
+        self.batch_spec = P(DATA_AXIS)
+        self.exchange_axes = (DATA_AXIS, PP_AXIS)
+        super().__init__(cfg, mesh=mesh)
+        self.param_specs = self._build_param_specs()
+
+    def build_data(self):
+        cfg = self.config
+        self.data = Cifar10Data(
+            batch_size=self.global_batch,
+            data_dir=cfg.data_dir,
+            n_synth_train=int(cfg.n_synth_train),
+            n_synth_val=int(cfg.n_synth_val),
+            seed=int(cfg.seed),
+        )
+
+    def build_net(self):
+        cfg = self.config
+        d = int(cfg.d_model)
+        net = L.Sequential(
+            [
+                L.Flatten(),
+                L.Dense(d),
+                L.Relu(),
+                PipelineStages(
+                    _stage_builder(d),
+                    n_stages=self.pp_size,
+                    n_micro=int(cfg.n_micro),
+                ),
+                L.Dense(int(cfg.n_classes)),
+            ]
+        )
+        self.lr_schedule = optim.constant(float(cfg.lr))
+        return net, Cifar10Data.shape
+
+    def _build_param_specs(self):
+        """Stage-stacked leaves shard over pp on their leading (stage)
+        dim; everything else replicated."""
+        specs = []
+        for layer, layer_params in zip(self.net.layers, self.params):
+            if isinstance(layer, PipelineStages):
+                specs.append(jax.tree.map(lambda _: P(PP_AXIS), layer_params))
+            else:
+                specs.append(jax.tree.map(lambda _: P(), layer_params))
+        return specs

@@ -540,6 +540,76 @@ class Parallel(Layer):
         return jnp.concatenate(ys, axis=-1), new_state
 
 
+class AuxTapped(Layer):
+    """Sequential trunk with auxiliary classifier heads tapped off
+    intermediate outputs (GoogLeNet's aux classifiers — the reference
+    builds the two heads by hand off inception 4a/4d; SURVEY.md §3.5).
+
+    ``segments`` run in sequence; ``aux_heads[i]`` (if not None) is
+    applied to segment i's output. In train mode ``apply`` returns
+    ``(main_out, [aux_out, ...])``; in eval mode just ``main_out`` —
+    the heads exist only to inject gradient mid-trunk, so inference
+    never pays for them. Models using this override ``loss_and_metrics``
+    to weight the aux losses (classically 0.3×).
+    """
+
+    def __init__(self, segments: Sequence[Layer], aux_heads: Sequence[Optional[Layer]]):
+        if len(aux_heads) != len(segments):
+            raise ValueError(
+                f"aux_heads must align with segments: "
+                f"{len(aux_heads)} vs {len(segments)}"
+            )
+        self.segments = list(segments)
+        self.aux_heads = list(aux_heads)
+
+    def init(self, key, in_shape):
+        seg_params, seg_state, aux_params, aux_state = [], [], [], []
+        shape = in_shape
+        for seg, aux in zip(self.segments, self.aux_heads):
+            key, sub = jax.random.split(key)
+            p, s, shape = seg.init(sub, shape)
+            seg_params.append(p)
+            seg_state.append(s)
+            if aux is None:
+                aux_params.append({})
+                aux_state.append({})
+            else:
+                key, sub = jax.random.split(key)
+                ap, as_, _ = aux.init(sub, shape)
+                aux_params.append(ap)
+                aux_state.append(as_)
+        params = {"trunk": seg_params, "aux": aux_params}
+        state = {"trunk": seg_state, "aux": aux_state}
+        return params, state, shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        new_trunk, new_aux, aux_outs = [], [], []
+        for i, (seg, aux) in enumerate(zip(self.segments, self.aux_heads)):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            x, s = seg.apply(
+                params["trunk"][i], state["trunk"][i], x, train=train, rng=sub
+            )
+            new_trunk.append(s)
+            if aux is not None and train:
+                asub = None
+                if rng is not None:
+                    rng, asub = jax.random.split(rng)
+                y, as_ = aux.apply(
+                    params["aux"][i], state["aux"][i], x, train=train, rng=asub
+                )
+                aux_outs.append(y)
+                new_aux.append(as_)
+            else:
+                # eval: heads untouched; their state passes through
+                new_aux.append(state["aux"][i])
+        new_state = {"trunk": new_trunk, "aux": new_aux}
+        if train:
+            return (x, aux_outs), new_state
+        return x, new_state
+
+
 class Residual(Layer):
     """Residual connection: ``y = body(x) + shortcut(x)``.
 

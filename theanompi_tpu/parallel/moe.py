@@ -1,0 +1,188 @@
+"""Expert parallelism — Mixture-of-Experts FFN over an ``ep`` mesh axis.
+
+Beyond-reference (Theano-MPI is data-parallel only; SURVEY.md §3.4).
+TPU-first design, Switch/GShard-style:
+
+- Tokens are sharded over ``ep`` (it acts as an extra data axis);
+  expert weights are sharded over ``ep`` on their leading expert dim
+  (``PartitionSpec('ep', ...)`` via the model's ``param_specs``).
+- Routing is dense one-hot linear algebra (top-1 or top-2 gating with
+  per-expert capacity, overflow dropped) — matmul-shaped on purpose so
+  it rides the MXU instead of scatter/gather.
+- Dispatch and return are each ONE ``lax.all_to_all`` over ``ep``
+  (XLA lowers to ICI all-to-all). The pair is its own inverse, and
+  autodiff transposes each to the reverse all-to-all — no custom VJPs
+  needed: every device's tokens contribute to every grad, so the
+  standard (dp, ep) gradient mean plus ep-skipping expert leaves is
+  exact.
+- ``ep_axis=None`` runs the identical math unsharded (no collectives):
+  that is the equivalence oracle the sharded path must match exactly
+  when capacity is ample.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from functools import partial
+
+from theanompi_tpu.ops.layers import Layer, he_normal
+from theanompi_tpu.runtime.mesh import EP_AXIS
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_scale(w, c):
+    """Identity forward; cotangent × c backward.
+
+    Why: ``ep`` shards the BATCH (unlike ``tp``, where every rank sees
+    the same loss), so the backward all-to-all hands an expert shard the
+    summed cotangents of all ep peers' local losses — ep× the per-shard
+    mean the exchanger contract expects. Scaling the WEIGHT cotangent by
+    1/ep (activations untouched: upstream replicated layers still need
+    unscaled cotangents) makes `pmean over dp, skip ep` exact for
+    expert-sharded leaves.
+    """
+    return w
+
+
+_grad_scale.defvjp(lambda w, c: (w, None), lambda c, _, ct: (ct * c,))
+
+
+class MoeMlp(Layer):
+    """Mixture-of-experts FFN: ``y[token] = Σ_k gate_k · FFN_{e_k}(x)``.
+
+    Capacity per expert is ``ceil(capacity_factor · n_local_tokens ·
+    top_k / n_experts)`` per source device; tokens routed beyond an
+    expert's capacity are dropped (output 0 — wrap in a Residual).
+    """
+
+    def __init__(
+        self,
+        n_experts: int,
+        d_hidden: int,
+        top_k: int = 1,
+        capacity_factor: float = 1.25,
+        ep_axis: Optional[str] = EP_AXIS,
+        ep_size: int = 1,
+    ):
+        if top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+        if n_experts % max(ep_size, 1):
+            raise ValueError(
+                f"n_experts={n_experts} not divisible by ep={ep_size}"
+            )
+        self.n_experts = n_experts
+        self.d_hidden = d_hidden
+        self.top_k = top_k
+        self.capacity_factor = float(capacity_factor)
+        self.ep_axis = ep_axis if ep_size > 1 else None
+        self.ep_size = ep_size if ep_size > 1 else 1
+
+    def init(self, key, in_shape):
+        (d,) = in_shape
+        E, h = self.n_experts, self.d_hidden
+        kg, ki, ko = jax.random.split(key, 3)
+        params = {
+            "wg": he_normal(kg, (d, E), d),
+            "w_in": he_normal(ki, (E, d, h), d),
+            "b_in": jnp.zeros((E, h), jnp.float32),
+            "w_out": he_normal(ko, (E, h, d), h),
+            "b_out": jnp.zeros((E, d), jnp.float32),
+        }
+        return params, {}, in_shape
+
+    def _capacity(self, n_tokens: int) -> int:
+        import math
+
+        return max(
+            1,
+            math.ceil(
+                self.capacity_factor * n_tokens * self.top_k / self.n_experts
+            ),
+        )
+
+    def apply(self, params, state, x, train=False, rng=None):
+        n, d = x.shape
+        E = self.n_experts
+        C = self._capacity(n)
+        # ---- routing (fp32: softmax over experts must not run bf16) ----
+        logits = jnp.dot(
+            x.astype(jnp.float32),
+            params["wg"].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # (n, E)
+        a1 = jnp.argmax(probs, axis=-1)
+        g1 = jnp.take_along_axis(probs, a1[:, None], axis=-1)[:, 0]
+        hot1 = jax.nn.one_hot(a1, E, dtype=jnp.float32)
+        assigns = [(hot1, g1)]
+        if self.top_k == 2:
+            probs2 = probs * (1.0 - hot1)
+            a2 = jnp.argmax(probs2, axis=-1)
+            g2 = jnp.take_along_axis(probs, a2[:, None], axis=-1)[:, 0]
+            hot2 = jax.nn.one_hot(a2, E, dtype=jnp.float32)
+            denom = g1 + g2 + 1e-9  # renormalize the pair (GShard)
+            assigns = [(hot1, g1 / denom), (hot2, g2 / denom)]
+        # positions within each expert's capacity, first-choice priority:
+        # second choices queue behind ALL first choices (GShard ordering)
+        disp = jnp.zeros((n, E, C), jnp.float32)  # 0/1 dispatch
+        comb = jnp.zeros((n, E, C), jnp.float32)  # gate-weighted combine
+        offset = jnp.zeros((E,), jnp.float32)
+        for hot, g in assigns:
+            pos = jnp.cumsum(hot, axis=0) - 1.0 + offset[None, :]
+            offset = offset + jnp.sum(hot, axis=0)
+            keep = hot * (pos < C)
+            pos_idx = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+            onehot_pos = jax.nn.one_hot(pos_idx, C, dtype=jnp.float32)
+            d_k = keep[:, :, None] * onehot_pos  # (n, E, C)
+            disp = disp + d_k
+            comb = comb + d_k * g[:, None, None]
+        # ---- dispatch: (n,d) -> (E, C, d), then all-to-all over ep ----
+        xe = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
+        if self.ep_axis is not None:
+            ep = self.ep_size
+            e_local = E // ep
+            xe = xe.reshape(ep, e_local, C, d)
+            # device j receives every source's chunk for ITS experts
+            xe = lax.all_to_all(xe, self.ep_axis, 0, 0)  # (src, e_local, C, d)
+            s = 1.0 / ep  # see _grad_scale: batch shards over ep
+            w_in = _grad_scale(params["w_in"], s)  # local (e_local, d, h)
+            b_in = _grad_scale(params["b_in"], s)
+            w_out = _grad_scale(params["w_out"], s)
+            b_out = _grad_scale(params["b_out"], s)
+            hmid = jax.nn.relu(
+                jnp.einsum("secd,edh->sech", xe, w_in) + b_in[None, :, None, :]
+            )
+            ye = (
+                jnp.einsum("sech,ehd->secd", hmid, w_out)
+                + b_out[None, :, None, :]
+            )
+            ye = lax.all_to_all(ye, self.ep_axis, 0, 0)  # back to sources
+            ye = ye.reshape(E, C, d)
+        else:
+            hmid = jax.nn.relu(
+                jnp.einsum("ecd,edh->ech", xe, params["w_in"])
+                + params["b_in"][:, None, :]
+            )
+            ye = (
+                jnp.einsum("ech,ehd->ecd", hmid, params["w_out"])
+                + params["b_out"][:, None, :]
+            )
+        # ---- combine: gate-weighted gather back to token order ----
+        y = jnp.einsum("nec,ecd->nd", comb, ye)
+        return y.astype(x.dtype), state
+
+    def aux_load_balance_loss(self, params, x):
+        """Switch load-balancing auxiliary: E · Σ_e fraction_e · prob_e.
+        Minimized (=1) at uniform routing; add ``coef·aux`` to the task
+        loss when training real MoE models."""
+        logits = jnp.dot(x.astype(jnp.float32), params["wg"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        hot = jax.nn.one_hot(jnp.argmax(probs, -1), self.n_experts)
+        frac = jnp.mean(hot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        return self.n_experts * jnp.sum(frac * mean_prob)

@@ -1,0 +1,147 @@
+"""Pipeline parallelism (GPipe-style) over a ``pp`` mesh axis.
+
+Beyond-reference (Theano-MPI is data-parallel only; SURVEY.md §3.4) but
+first-class here: stage weights live on different devices and
+microbatched activations stream between ICI neighbors.
+
+TPU-first design — the whole pipeline is ONE jitted SPMD program:
+
+- The S stages are homogeneous (same in/out shape). Their parameters
+  are stacked on a leading stage dimension sharded over ``pp``
+  (``PartitionSpec('pp', ...)``), so each device holds exactly its
+  stage's weights — no per-stage processes, no host scheduling.
+- The GPipe schedule is a ``lax.scan`` over ``n_micro + S - 1`` ticks.
+  Each tick every device runs its stage on its current microbatch and
+  hands the activation to the next stage via ``lax.ppermute`` (one ICI
+  neighbor hop). Bubble fraction is the classic (S-1)/(M+S-1).
+- The BACKWARD pipeline is not hand-written: jax autodiff transposes
+  the scan+ppermute forward into the reverse-order activation/cotangent
+  schedule automatically.
+- Gradient completeness across the masked schedule uses the same
+  custom-VJP pair as tensor parallelism (``parallel.tensor``):
+  ``copy_to_tp`` on pipeline entry (identity fwd / psum bwd: only stage
+  0 consumes the input, but upstream replicated layers need the full
+  cotangent everywhere) and ``reduce_from_tp`` on exit (psum fwd of the
+  last stage's masked output / identity bwd).
+
+Stages must be stateless pure layers (no BatchNorm running stats, no
+dropout rng) — the scan carries activations only. That covers the
+LayerNorm/Dense/Relu blocks pipelines are built from in practice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.ops.layers import Layer
+from theanompi_tpu.parallel.tensor import copy_to_tp, reduce_from_tp
+from theanompi_tpu.runtime.mesh import PP_AXIS
+
+
+class PipelineStages(Layer):
+    """S homogeneous stages executed as a GPipe pipeline over ``axis``.
+
+    ``stage_builder(i)`` returns stage i's layer; all stages must map
+    shape d -> d (checked at init). ``init`` returns the STACKED global
+    params (leading dim S); ``apply`` must run inside ``shard_map`` over
+    a mesh whose ``axis`` has size S, with this layer's params sharded
+    ``P(axis)`` on the stage dimension (each device then sees a local
+    leading dim of 1).
+    """
+
+    def __init__(self, stage_builder, n_stages: int, n_micro: int, axis: str = PP_AXIS):
+        if n_stages < 1:
+            raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+        if n_micro < 1:
+            raise ValueError(f"n_micro must be >= 1, got {n_micro}")
+        self.stages = [stage_builder(i) for i in range(n_stages)]
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.axis = axis
+
+    def init(self, key, in_shape):
+        params_list = []
+        shape = in_shape
+        stage_state = None
+        for stage in self.stages:
+            key, sub = jax.random.split(key)
+            p, s, out_shape = stage.init(sub, shape)
+            if out_shape != shape:
+                raise ValueError(
+                    f"pipeline stages must be homogeneous (d->d): "
+                    f"stage maps {shape} -> {out_shape}"
+                )
+            if jax.tree.leaves(s):
+                raise ValueError(
+                    "pipeline stages must be stateless (no BatchNorm "
+                    "running stats inside a scanned schedule)"
+                )
+            stage_state = s  # leaf-free structure, identical across stages
+            params_list.append(p)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+        return stacked, stage_state, shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        # local shard of the stacked params: leading dim 1 under shard_map
+        local = jax.tree.map(lambda a: a[0], params)
+        S, M = self.n_stages, self.n_micro
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by n_micro {M}")
+        mb = B // M
+        idx = lax.axis_index(self.axis)
+        # entry: identity fwd, psum bwd — completes upstream cotangents
+        # (only stage 0 reads x, but upstream layers are replicated)
+        x = copy_to_tp(x, self.axis)
+        xs = x.reshape(M, mb, *x.shape[1:])
+        # every device runs the SAME stage layer graph; stage identity
+        # comes from the params shard. Use stage 0's layer as the
+        # template (all stages are structurally identical).
+        template = self.stages[0]
+
+        buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        outs0 = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            buf, outs = carry
+            t0 = jnp.clip(t, 0, M - 1)
+            inp0 = lax.dynamic_index_in_dim(xs, t0, 0, keepdims=False)
+            inp = jnp.where(idx == 0, inp0, buf)
+            y, _ = template.apply(local, state, inp, train=train, rng=None)
+            k = t - (S - 1)
+            valid = (k >= 0) & (idx == S - 1)
+            kc = jnp.clip(k, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(outs, kc, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, cur), kc, 0
+            )
+            if S > 1:
+                buf = lax.ppermute(
+                    y, self.axis, [(i, i + 1) for i in range(S - 1)]
+                )
+            return (buf, outs), None
+
+        (_, outs), _ = lax.scan(
+            tick, (buf0, outs0), jnp.arange(M + S - 1), unroll=False
+        )
+        # exit: only the last stage holds real outputs; psum fwd makes
+        # them replicated, identity bwd starts the cotangent at stage S-1
+        out = reduce_from_tp(
+            jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)), self.axis
+        )
+        return out.reshape(B, *out.shape[2:]), state
+
+    def apply_dense(self, params, x, train=False, state=None):
+        """Reference semantics OUTSIDE shard_map: run the S stages
+        sequentially on the global stacked params (the equivalence
+        oracle the pipeline must match exactly)."""
+        if state is None:
+            _, state, _ = self.stages[0].init(
+                jax.random.PRNGKey(0), x.shape[1:]
+            )
+        for s in range(self.n_stages):
+            p = jax.tree.map(lambda a: a[s], params)
+            x, _ = self.stages[s].apply(p, state, x, train=train, rng=None)
+        return x

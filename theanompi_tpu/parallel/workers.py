@@ -84,6 +84,19 @@ class BSP_Worker:
             if path:
                 model.load_model(path)
                 print(f"resumed from {path} at epoch {model.current_epoch}")
+        if bool(model.config.get("lr_linear_scaling", True)) and model.n_workers > 1:
+            # linear lr scaling for N-worker data parallelism — the
+            # engaged path for the contract's scale_lr (the reference's
+            # BSP worker scaled the model lr by the rank count; SURVEY.md
+            # §3.5 contract). Set lr_linear_scaling=False to opt out.
+            model.scale_lr(float(model.n_workers))
+            if self.process_index == 0:
+                print(
+                    f"lr linearly scaled x{model.n_workers} for "
+                    f"{model.n_workers}-worker data parallelism "
+                    "(lr_linear_scaling=False to disable)",
+                    flush=True,
+                )
         model.compile_train()
         model.compile_val()
         if model.current_epoch == 0:

@@ -28,6 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Canonical mesh-axis names used across the framework.
 DATA_AXIS = "dp"  # data parallelism (the only axis the reference had)
 TP_AXIS = "tp"  # tensor parallelism (beyond-reference; Megatron-style)
+PP_AXIS = "pp"  # pipeline parallelism (beyond-reference; GPipe-style)
+EP_AXIS = "ep"  # expert parallelism (beyond-reference; MoE all-to-all)
 DCN_AXIS = "dp_dcn"  # cross-slice data parallelism riding DCN, not ICI
 
 
@@ -95,14 +97,26 @@ def init_distributed(
         if explicit:
             raise  # a mistyped explicit config must not silently degrade
         # env looked multi-host but auto-detection found no coordinator.
-        # Warn loudly: if this really is a pod, proceeding means N
-        # independent single-host runs with unsynced gradients.
+        # Degrading silently would mean N independent single-host runs
+        # with unsynced gradients — a correctness failure that looks like
+        # training. Hard-fail unless the operator explicitly opts into
+        # degraded mode (THEANOMPI_TPU_ALLOW_DEGRADED=1).
+        if os.environ.get("THEANOMPI_TPU_ALLOW_DEGRADED", "") not in ("1", "true"):
+            raise RuntimeError(
+                "environment looks multi-host (one of "
+                f"{_MULTIHOST_ENV_MARKERS} is set, or TPU_WORKER_HOSTNAMES "
+                "lists multiple hosts) but jax.distributed auto-detection "
+                f"failed: {e}. Proceeding would train N UNSYNCED "
+                "single-host replicas. Pass coordinator_address/"
+                "num_processes/process_id explicitly, or set "
+                "THEANOMPI_TPU_ALLOW_DEGRADED=1 to accept a single-host run."
+            ) from e
         import warnings
 
         warnings.warn(
             "environment looks multi-host but jax.distributed auto-detection "
-            f"failed ({e}); proceeding SINGLE-HOST. If this is a pod, pass "
-            "coordinator_address/num_processes/process_id explicitly.",
+            f"failed ({e}); proceeding SINGLE-HOST per "
+            "THEANOMPI_TPU_ALLOW_DEGRADED.",
             RuntimeWarning,
             stacklevel=2,
         )
@@ -194,6 +208,25 @@ def make_mesh(
             pass
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names)
+
+
+def make_dp_axis_mesh(axis_name: str, size: int, devices=None) -> Mesh:
+    """(dp, <axis>) mesh with the model-parallel axis INNERMOST so its
+    collectives (ppermute hops, all-to-alls, psums) ride nearest-neighbor
+    ICI links. Shared by the pp/ep/tp demonstrator models' ``build_mesh``."""
+    devices = list(devices) if devices is not None else jax.devices()
+    size = int(size)
+    if size < 1:
+        raise ValueError(f"{axis_name}={size} must be >= 1")
+    if len(devices) % size:
+        raise ValueError(
+            f"{axis_name}={size} does not divide {len(devices)} devices"
+        )
+    return make_mesh(
+        shape=(len(devices) // size, size),
+        axis_names=(DATA_AXIS, axis_name),
+        devices=devices,
+    )
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
