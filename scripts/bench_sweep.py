@@ -45,7 +45,7 @@ def measure(cfg_overrides, steps=120):
         p, s, o, loss, err = step(p, s, o, i)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    return steps * 512 / dt
+    return steps * model.global_batch / dt
 
 
 if __name__ == "__main__":

@@ -21,8 +21,7 @@ from theanompi_tpu.runtime.recorder import Recorder
 
 
 def _expert_specs():
-    return {"wg": P(), "w_in": P(EP_AXIS), "b_in": P(EP_AXIS),
-            "w_out": P(EP_AXIS), "b_out": P(EP_AXIS)}
+    return MoeMlp.param_specs(EP_AXIS)
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
@@ -65,6 +64,7 @@ CFG = dict(
     print_freq=10_000,
     weight_decay=0.0,
     comm_probe=False,
+    moe_aux_coef=0.0,  # the dense oracle models the task loss only
 )
 
 
@@ -137,6 +137,31 @@ def test_capacity_overflow_drops_tokens():
     y, _ = moe.apply(params, {}, x)
     zero_rows = np.sum(~np.any(np.asarray(y) != 0.0, axis=-1))
     assert zero_rows >= n - 2 * E  # at most C=1 token kept per expert
+
+
+def test_aux_loss_engaged_in_training():
+    """With moe_aux_coef > 0 the train loss includes the load-balance
+    term (≥1 by Cauchy-Schwarz), and it rides the state tree."""
+    one = jax.devices()[:1]  # outside shard_map -> unsharded (ep=1) path
+    m0 = MoeMlpModel(
+        config=dict(CFG, seed=11, ep=1),
+        mesh=MoeMlpModel.build_mesh(devices=one, config=dict(ep=1)),
+    )
+    m1 = MoeMlpModel(
+        config=dict(CFG, seed=11, ep=1, moe_aux_coef=0.5),
+        mesh=MoeMlpModel.build_mesh(devices=one, config=dict(ep=1)),
+    )
+    x, y = next(iter(m0.data.train_batches()))
+    import jax.numpy as jnp
+
+    args = (jnp.asarray(x)[:8], jnp.asarray(y)[:8], True, jax.random.PRNGKey(0))
+    l0, (_, _, st) = m0.loss_and_metrics(m0.params, m0.net_state, *args)
+    l1, _ = m1.loss_and_metrics(m1.params, m1.net_state, *args)
+    aux = MoeMlp.collect_aux_losses(st)
+    assert len(aux) == 1 and float(aux[0]) >= 0.99
+    np.testing.assert_allclose(
+        float(l1), float(l0) + 0.5 * float(aux[0]), rtol=1e-5
+    )
 
 
 def test_aux_load_balance_loss():

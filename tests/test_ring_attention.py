@@ -141,6 +141,26 @@ class TestTransformerLM:
                 np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3
             )
 
+    def test_remat_matches_no_remat(self):
+        """Gradient checkpointing changes memory, not math: losses and
+        updated params must match the un-remat run exactly."""
+        from theanompi_tpu.runtime.recorder import Recorder
+
+        cfg = dict(seed=5, exch_strategy="ar")
+        m_remat = self._model(sp=2, dp=4, remat=True, **cfg)
+        m_plain = self._model(sp=2, dp=4, **cfg)
+        rec = Recorder(verbose=False)
+        for m in (m_remat, m_plain):
+            m.compile_train()
+            m.reset_train_iter(0)
+        l_r = float(m_remat.train_iter(1, rec)[0])
+        l_p = float(m_plain.train_iter(1, rec)[0])
+        assert abs(l_r - l_p) < 1e-5
+        for a, b in zip(
+            jax.tree.leaves(m_remat.params), jax.tree.leaves(m_plain.params)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
     def test_bsp_rule_engages_sp(self):
         """rule.init must build the dp×sp mesh from model_config['sp']
         (regression: a dp-only mesh silently discarded sp)."""

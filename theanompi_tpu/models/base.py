@@ -201,17 +201,27 @@ class TpuModel:
     # ------------------------------------------------------------------
     # loss — default classifier; GAN overrides
     # ------------------------------------------------------------------
-    def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
+    def _cast_input(self, x):
         dtype = self.config.compute_dtype
-        if dtype is not None:
-            x = x.astype(jnp.dtype(dtype))
-        logits, new_state = self.net.apply(params, net_state, x, train=train, rng=rng)
-        loss = losses.softmax_cross_entropy(logits, y)
+        return x.astype(jnp.dtype(dtype)) if dtype is not None else x
+
+    def _metrics(self, logits, y):
+        """(err, err5) for classifier logits — shared by the base loss
+        and model overrides (GoogLeNet aux, the LM) so metric logic has
+        one home."""
         err = losses.classification_error(logits, y)
         if self.config.val_top5 and logits.shape[-1] > 5:
             err5 = losses.topk_error(logits, y, k=5)
         else:
             err5 = err
+        return err, err5
+
+    def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
+        logits, new_state = self.net.apply(
+            params, net_state, self._cast_input(x), train=train, rng=rng
+        )
+        loss = losses.softmax_cross_entropy(logits, y)
+        err, err5 = self._metrics(logits, y)
         return loss, (err, err5, new_state)
 
     # ------------------------------------------------------------------

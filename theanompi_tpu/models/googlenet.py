@@ -140,19 +140,12 @@ class GoogLeNet(TpuModel):
     def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
         if not (train and bool(self.config.aux_heads)):
             return super().loss_and_metrics(params, net_state, x, y, train, rng)
-        dtype = self.config.compute_dtype
-        if dtype is not None:
-            x = x.astype(jnp.dtype(dtype))
         (logits, aux_logits), new_state = self.net.apply(
-            params, net_state, x, train=True, rng=rng
+            params, net_state, self._cast_input(x), train=True, rng=rng
         )
         loss = losses.softmax_cross_entropy(logits, y)
         w = float(self.config.aux_weight)
         for al in aux_logits:
             loss = loss + w * losses.softmax_cross_entropy(al, y)
-        err = losses.classification_error(logits, y)
-        if self.config.val_top5 and logits.shape[-1] > 5:
-            err5 = losses.topk_error(logits, y, k=5)
-        else:
-            err5 = err
+        err, err5 = self._metrics(logits, y)
         return loss, (err, err5, new_state)

@@ -31,6 +31,7 @@ class MoeMlpModel(TpuModel):
         n_experts=8,
         top_k=1,
         capacity_factor=1.5,
+        moe_aux_coef=0.01,  # weight of the Switch load-balance aux loss
         ep=2,  # expert-parallel degree = mesh ep-axis size
         n_classes=10,
         lr=0.05,
@@ -90,6 +91,9 @@ class MoeMlpModel(TpuModel):
             capacity_factor=float(cfg.capacity_factor),
             ep_axis=EP_AXIS if self.ep_size > 1 else None,
             ep_size=self.ep_size,
+            compute_dtype=(
+                jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+            ),
         )
         net = L.Sequential(
             [
@@ -103,9 +107,17 @@ class MoeMlpModel(TpuModel):
         self.lr_schedule = optim.constant(float(cfg.lr))
         return net, Cifar10Data.shape
 
+    def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
+        loss, (err, err5, new_state) = super().loss_and_metrics(
+            params, net_state, x, y, train, rng
+        )
+        coef = float(self.config.moe_aux_coef)
+        if train and coef:
+            loss = loss + coef * sum(MoeMlp.collect_aux_losses(new_state))
+        return loss, (err, err5, new_state)
+
     def _build_param_specs(self):
-        expert = {"wg": P(), "w_in": P(EP_AXIS), "b_in": P(EP_AXIS),
-                  "w_out": P(EP_AXIS), "b_out": P(EP_AXIS)}
+        expert = MoeMlp.param_specs(EP_AXIS)
         specs = []
         for layer, layer_params in zip(self.net.layers, self.params):
             if isinstance(layer, L.Residual):

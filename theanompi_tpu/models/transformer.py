@@ -61,6 +61,15 @@ class TransformerLM(TpuModel):
         n_synth_val=2,
         val_top5=True,
         exch_strategy="bf16",
+        moe_experts=0,  # >0 = MoE FFN blocks (GShard-style: experts
+        # shard over the existing dp axis — parallel.moe.MoeMlp)
+        moe_top_k=1,
+        moe_capacity_factor=1.5,
+        moe_hidden=None,  # None = d_model * mlp_ratio
+        moe_aux_coef=0.01,  # weight of the Switch load-balance aux loss
+        remat=False,  # gradient-checkpoint each block (ops.layers.Remat):
+        # backward recomputes the block instead of saving activations —
+        # the long-context HBM lever alongside sp
     )
 
     @classmethod
@@ -139,7 +148,11 @@ class TransformerLM(TpuModel):
                 ex + (TP_AXIS,) if isinstance(ex, tuple) else (ex, TP_AXIS)
             )
         super().__init__(cfg, mesh=mesh)  # cfg = defaults + config + overrides
-        if self.tp_size > 1:
+        moe_sharded = (
+            int(self.config.moe_experts) > 0
+            and int(self.mesh.shape[DATA_AXIS]) > 1
+        )
+        if self.tp_size > 1 or moe_sharded:
             self.param_specs = self._build_param_specs()
 
     def build_data(self):
@@ -173,12 +186,41 @@ class TransformerLM(TpuModel):
                     f"(n_heads/tp) % sp == 0, got n_heads={n_heads}, "
                     f"tp={self.tp_size}, sp={self.sp_size}"
                 )
+        n_experts = int(cfg.moe_experts)
+        if n_experts and self.tp_size > 1:
+            raise ValueError(
+                "moe_experts does not compose with tp>1 "
+                "(2-D expert sharding unsupported)"
+            )
+        dp = int(self.mesh.shape[DATA_AXIS])
+        if n_experts and n_experts % max(dp, 1):
+            raise ValueError(
+                f"moe_experts={n_experts} must divide by the dp axis "
+                f"size {dp} (experts shard over dp, GShard-style)"
+            )
+
+        def make_moe():
+            if not n_experts:
+                return None
+            from theanompi_tpu.parallel.moe import MoeMlp
+
+            return MoeMlp(
+                n_experts,
+                int(cfg.moe_hidden or d * int(cfg.mlp_ratio)),
+                top_k=int(cfg.moe_top_k),
+                capacity_factor=float(cfg.moe_capacity_factor),
+                ep_axis=DATA_AXIS if dp > 1 else None,
+                ep_size=dp,
+                compute_dtype=dt,
+            )
+
+        wrap = L.Remat if bool(cfg.remat) else (lambda b: b)
         net = L.Sequential(
             [
                 A.Embedding(int(cfg.vocab_size), d, compute_dtype=dt),
                 A.PositionalEmbedding(int(cfg.seq_len), sp_axis=sp_axis),
                 *[
-                    A.TransformerBlock(
+                    wrap(A.TransformerBlock(
                         n_heads,
                         mlp_ratio=int(cfg.mlp_ratio),
                         causal=True,
@@ -188,7 +230,8 @@ class TransformerLM(TpuModel):
                         tp_axis=tp_axis,
                         tp_size=self.tp_size,
                         compute_dtype=dt,
-                    )
+                        moe=make_moe(),
+                    ))
                     for _ in range(int(cfg.n_layers))
                 ],
                 A.LayerNorm(),
@@ -202,14 +245,30 @@ class TransformerLM(TpuModel):
 
     def _build_param_specs(self):
         """PartitionSpec tree mirroring ``self.params`` (a Sequential's
-        per-layer list): Megatron column/row sharding for every
-        TransformerBlock, everything else replicated."""
+        per-layer list): Megatron column/row sharding for every dense
+        TransformerBlock (tp), expert-dim sharding over dp for MoE
+        blocks (GShard-style ep≡dp), everything else replicated."""
         col = P(None, TP_AXIS)  # output-dim sharded: wq/wk/wv, mlp_in.w
         row = P(TP_AXIS, None)  # input-dim sharded: wo, mlp_out.w
         rep = P()
         specs = []
         for layer, layer_params in zip(self.net.layers, self.params):
-            if isinstance(layer, A.TransformerBlock):
+            if isinstance(layer, L.Remat):
+                layer = layer.inner  # spec by the wrapped block
+            if not isinstance(layer, A.TransformerBlock):
+                specs.append(jax.tree.map(lambda _: rep, layer_params))
+            elif layer.moe is not None:
+                from theanompi_tpu.parallel.moe import MoeMlp
+
+                specs.append(
+                    {
+                        "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
+                        "attn": jax.tree.map(lambda _: rep, layer_params["attn"]),
+                        "ln2": jax.tree.map(lambda _: rep, layer_params["ln2"]),
+                        "moe": MoeMlp.param_specs(DATA_AXIS),
+                    }
+                )
+            else:
                 specs.append(
                     {
                         "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
@@ -219,8 +278,6 @@ class TransformerLM(TpuModel):
                         "mlp_out": {"w": row, "b": rep},
                     }
                 )
-            else:
-                specs.append(jax.tree.map(lambda _: rep, layer_params))
         return specs
 
     def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
@@ -231,9 +288,13 @@ class TransformerLM(TpuModel):
         flat_logits = logits.reshape(-1, v)
         flat_y = y.reshape(-1)
         loss = losses.softmax_cross_entropy(flat_logits, flat_y)
-        err = losses.classification_error(flat_logits, flat_y)
-        if self.config.val_top5 and v > 5:
-            err5 = losses.topk_error(flat_logits, flat_y, k=5)
-        else:
-            err5 = err
+        if train and int(self.config.moe_experts):
+            # Switch load-balance aux: MoE blocks emit it through the
+            # state tree (differentiable — same apply call)
+            coef = float(self.config.moe_aux_coef)
+            if coef:
+                from theanompi_tpu.parallel.moe import MoeMlp
+
+                loss = loss + coef * sum(MoeMlp.collect_aux_losses(new_state))
+        err, err5 = self._metrics(flat_logits, flat_y)
         return loss, (err, err5, new_state)

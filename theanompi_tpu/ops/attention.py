@@ -225,7 +225,16 @@ class MultiHeadAttention(Layer):
 
 
 class TransformerBlock(Layer):
-    """Pre-LN decoder block: LN→MHA→residual, LN→MLP(GELU)→residual."""
+    """Pre-LN decoder block: LN→MHA→residual, LN→FFN→residual.
+
+    The FFN is a dense GELU MLP by default; pass ``moe`` (a
+    ``parallel.moe.MoeMlp``) to make this a mixture-of-experts block —
+    tokens flatten to ``(b·t, d)`` for routing and the expert weights
+    shard over the MoE layer's ``ep_axis`` (GShard-style, the model
+    reuses its data axis). MoE composes with sequence parallelism but
+    not (yet) with tensor parallelism — 2-D expert sharding is out of
+    scope, callers must reject the combination.
+    """
 
     def __init__(
         self,
@@ -238,7 +247,13 @@ class TransformerBlock(Layer):
         tp_axis: Optional[str] = None,
         tp_size: int = 1,
         compute_dtype: Optional[jnp.dtype] = None,
+        moe=None,
     ):
+        if moe is not None and tp_size > 1:
+            raise ValueError(
+                "MoE blocks do not compose with tensor parallelism "
+                "(2-D expert sharding unsupported)"
+            )
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
         self.attn = MultiHeadAttention(
@@ -250,6 +265,7 @@ class TransformerBlock(Layer):
         self.tp_axis = tp_axis
         self.tp_size = tp_size
         self.compute_dtype = compute_dtype
+        self.moe = moe
 
     def init(self, key, in_shape):
         t, d = in_shape
@@ -257,19 +273,19 @@ class TransformerBlock(Layer):
         p1, _, _ = self.ln1.init(k1, in_shape)
         pa, _, _ = self.attn.init(k2, in_shape)
         p2, _, _ = self.ln2.init(k3, in_shape)
+        params = {"ln1": p1, "attn": pa, "ln2": p2}
+        if self.moe is not None:
+            pm, ms, _ = self.moe.init(k4, (d,))
+            params["moe"] = pm
+            return params, {"moe": ms}, in_shape
         dm = d * self.mlp_ratio
-        params = {
-            "ln1": p1,
-            "attn": pa,
-            "ln2": p2,
-            "mlp_in": {
-                "w": normal_init(1.0 / math.sqrt(d))(k4, (d, dm), d),
-                "b": jnp.zeros((dm,), jnp.float32),
-            },
-            "mlp_out": {
-                "w": normal_init(1.0 / math.sqrt(dm))(k5, (dm, d), dm),
-                "b": jnp.zeros((d,), jnp.float32),
-            },
+        params["mlp_in"] = {
+            "w": normal_init(1.0 / math.sqrt(d))(k4, (d, dm), d),
+            "b": jnp.zeros((dm,), jnp.float32),
+        }
+        params["mlp_out"] = {
+            "w": normal_init(1.0 / math.sqrt(dm))(k5, (dm, d), dm),
+            "b": jnp.zeros((d,), jnp.float32),
         }
         return params, {}, in_shape
 
@@ -306,5 +322,10 @@ class TransformerBlock(Layer):
         a, _ = self.attn.apply(params["attn"], {}, h1, train=train, rng=rng)
         x = x + a
         h2, _ = self.ln2.apply(params["ln2"], {}, x)
+        if self.moe is not None:
+            b, t, d = h2.shape
+            y, ms = self.moe.apply(params["moe"], state["moe"], h2.reshape(b * t, d))
+            x = x + y.reshape(b, t, d)
+            return x, {"moe": ms}
         x = x + self._mlp(params, h2)
         return x, state

@@ -540,6 +540,30 @@ class Parallel(Layer):
         return jnp.concatenate(ys, axis=-1), new_state
 
 
+class Remat(Layer):
+    """Gradient checkpointing (rematerialization) around ``inner``.
+
+    The backward pass recomputes ``inner``'s forward instead of saving
+    its internal activations — the standard HBM-for-FLOPs trade that
+    makes long-context transformer training fit (activation memory per
+    block drops from O(layers) tensors to the block boundary only).
+    Thin wrapper over ``jax.checkpoint``; composes with the sp/tp
+    collectives inside the block (they replay in the recompute).
+    """
+
+    def __init__(self, inner: Layer):
+        self.inner = inner
+
+    def init(self, key, in_shape):
+        return self.inner.init(key, in_shape)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        def fn(p, xx):
+            return self.inner.apply(p, state, xx, train=train, rng=rng)
+
+        return jax.checkpoint(fn)(params, x)
+
+
 class AuxTapped(Layer):
     """Sequential trunk with auxiliary classifier heads tapped off
     intermediate outputs (GoogLeNet's aux classifiers — the reference

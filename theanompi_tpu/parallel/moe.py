@@ -68,6 +68,7 @@ class MoeMlp(Layer):
         capacity_factor: float = 1.25,
         ep_axis: Optional[str] = EP_AXIS,
         ep_size: int = 1,
+        compute_dtype=None,
     ):
         if top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {top_k}")
@@ -81,6 +82,9 @@ class MoeMlp(Layer):
         self.capacity_factor = float(capacity_factor)
         self.ep_axis = ep_axis if ep_size > 1 else None
         self.ep_size = ep_size if ep_size > 1 else 1
+        # expert matmul dtype (routing softmax stays fp32 regardless):
+        # bf16 here matches the dense-MLP path's MXU behavior
+        self.compute_dtype = compute_dtype
 
     def init(self, key, in_shape):
         (d,) = in_shape
@@ -93,7 +97,11 @@ class MoeMlp(Layer):
             "w_out": he_normal(ko, (E, h, d), h),
             "b_out": jnp.zeros((E, d), jnp.float32),
         }
-        return params, {}, in_shape
+        # aux_loss rides the STATE tree: apply emits the differentiable
+        # Switch load-balance scalar there, and the owning model adds
+        # coef·aux to its task loss (gradients flow — state is a live
+        # output of the same apply call)
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}, in_shape
 
     def _capacity(self, n_tokens: int) -> int:
         import math
@@ -119,6 +127,9 @@ class MoeMlp(Layer):
         a1 = jnp.argmax(probs, axis=-1)
         g1 = jnp.take_along_axis(probs, a1[:, None], axis=-1)[:, 0]
         hot1 = jax.nn.one_hot(a1, E, dtype=jnp.float32)
+        # Switch load-balance aux (E·Σ frac_e·prob̄_e, =1 at uniform):
+        # differentiable through prob̄ only, exactly as in the paper
+        aux = E * jnp.sum(jnp.mean(hot1, axis=0) * jnp.mean(probs, axis=0))
         assigns = [(hot1, g1)]
         if self.top_k == 2:
             probs2 = probs * (1.0 - hot1)
@@ -142,7 +153,18 @@ class MoeMlp(Layer):
             disp = disp + d_k
             comb = comb + d_k * g[:, None, None]
         # ---- dispatch: (n,d) -> (E, C, d), then all-to-all over ep ----
-        xe = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
+        # expert compute dtype: bf16 operands with fp32 MXU accumulation
+        # when compute_dtype is set, fp32 end-to-end otherwise
+        cd = jnp.dtype(self.compute_dtype) if self.compute_dtype else jnp.float32
+
+        def mm(sub, a, b):
+            out = jnp.einsum(
+                sub, a.astype(cd), b.astype(cd),
+                preferred_element_type=jnp.float32,
+            )
+            return out.astype(cd)
+
+        xe = mm("nec,nd->ecd", disp, x)
         if self.ep_axis is not None:
             ep = self.ep_size
             e_local = E // ep
@@ -155,26 +177,62 @@ class MoeMlp(Layer):
             w_out = _grad_scale(params["w_out"], s)
             b_out = _grad_scale(params["b_out"], s)
             hmid = jax.nn.relu(
-                jnp.einsum("secd,edh->sech", xe, w_in) + b_in[None, :, None, :]
-            )
+                mm("secd,edh->sech", xe, w_in)
+                + b_in[None, :, None, :].astype(cd)
+            ).astype(cd)
             ye = (
-                jnp.einsum("sech,ehd->secd", hmid, w_out)
-                + b_out[None, :, None, :]
+                mm("sech,ehd->secd", hmid, w_out)
+                + b_out[None, :, None, :].astype(cd)
             )
             ye = lax.all_to_all(ye, self.ep_axis, 0, 0)  # back to sources
             ye = ye.reshape(E, C, d)
         else:
             hmid = jax.nn.relu(
-                jnp.einsum("ecd,edh->ech", xe, params["w_in"])
-                + params["b_in"][:, None, :]
-            )
+                mm("ecd,edh->ech", xe, params["w_in"])
+                + params["b_in"][:, None, :].astype(cd)
+            ).astype(cd)
             ye = (
-                jnp.einsum("ech,ehd->ecd", hmid, params["w_out"])
-                + params["b_out"][:, None, :]
+                mm("ech,ehd->ecd", hmid, params["w_out"])
+                + params["b_out"][:, None, :].astype(cd)
             )
         # ---- combine: gate-weighted gather back to token order ----
-        y = jnp.einsum("nec,ecd->nd", comb, ye)
-        return y.astype(x.dtype), state
+        # fp32 accumulation: a token's output is a 1-of-C·E selection
+        y = jnp.einsum(
+            "nec,ecd->nd", comb, ye.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return y.astype(x.dtype), {"aux_loss": aux}
+
+    @staticmethod
+    def param_specs(axis):
+        """PartitionSpec dict matching ``init``'s param keys: expert
+        leaves shard their leading expert dim over ``axis``, the gate is
+        replicated. The ONE place the key set lives — models and tests
+        build their spec trees from this."""
+        from jax.sharding import PartitionSpec as P
+
+        e = P(axis)
+        return {"wg": P(), "w_in": e, "b_in": e, "w_out": e, "b_out": e}
+
+    @staticmethod
+    def collect_aux_losses(state_tree):
+        """Every ``aux_loss`` leaf in a (nested) state tree — the model
+        adds ``coef · sum(...)`` to its task loss."""
+        out = []
+
+        def walk(node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "aux_loss":
+                        out.append(v)
+                    else:
+                        walk(v)
+            elif isinstance(node, (list, tuple)):
+                for v in node:
+                    walk(v)
+
+        walk(state_tree)
+        return out
 
     def aux_load_balance_loss(self, params, x):
         """Switch load-balancing auxiliary: E · Σ_e fraction_e · prob_e.
