@@ -68,7 +68,8 @@ def _int8_mean(mesh, g_global, strategy="int8"):
     ex = BSP_Exchanger(strategy=strategy, axis=DATA_AXIS, mesh=mesh)
 
     def step(g):
-        return ex.reduce_grads({"g": g})["g"]
+        rng = jax.random.PRNGKey(0)  # used by int8_sr only
+        return ex.reduce_grads({"g": g}, rng=rng)["g"]
 
     fn = jax.jit(
         jax.shard_map(
@@ -79,7 +80,7 @@ def _int8_mean(mesh, g_global, strategy="int8"):
     return np.asarray(fn(g_global))
 
 
-@pytest.mark.parametrize("strategy", ["int8", "pallas_int8"])
+@pytest.mark.parametrize("strategy", ["int8", "pallas_int8", "int8_sr"])
 def test_int8_reduce_matches_true_mean(strategy):
     mesh = make_mesh()
     n_dev = 8
@@ -97,7 +98,39 @@ def test_int8_requires_mesh():
         BSP_Exchanger(strategy="int8")
 
 
-@pytest.mark.parametrize("strategy", ["int8", "pallas_int8"])
+def test_stochastic_rounding_is_unbiased():
+    """E[dequant(quant_sr(x))] = x: the mean over many keys converges to
+    the input where round-to-nearest stays stuck at its bias."""
+    x = np.full((1, Q.BLOCK), 0.30, np.float32)
+    x[0, 0] = 127.0  # pins scale=1.0 -> values at .30 between int steps
+    acc = np.zeros_like(x)
+    n = 400
+    for i in range(n):
+        q, s = Q.quantize_blocks(x, jax.random.PRNGKey(i))
+        acc += np.asarray(Q.dequantize_blocks(q, s))
+    sr_err = abs(acc[0, 1] / n - 0.30)
+    q_det, s_det = Q.quantize_blocks(x)
+    det_err = abs(float(np.asarray(Q.dequantize_blocks(q_det, s_det))[0, 1]) - 0.30)
+    assert det_err > 0.25  # nearest rounds 0.30 -> 0: bias ~0.30
+    assert sr_err < 0.05  # SR average converges to the true value
+
+
+def test_int8_sr_requires_rng():
+    mesh = make_mesh()
+    ex = BSP_Exchanger(strategy="int8_sr", axis=DATA_AXIS, mesh=mesh)
+
+    def step(g):
+        return ex.reduce_grads({"g": g})["g"]
+
+    fn = jax.shard_map(
+        step, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="needs per-step randomness"):
+        jax.jit(fn)(jnp.ones((8, 8 * Q.BLOCK), jnp.float32))
+
+
+@pytest.mark.parametrize("strategy", ["int8", "pallas_int8", "int8_sr"])
 def test_int8_training_tracks_ar(strategy):
     def run(strat):
         model = Cifar10_model(
@@ -110,6 +143,24 @@ def test_int8_training_tracks_ar(strategy):
         return [float(model.train_iter(i, rec)[0]) for i in range(1, 5)]
 
     np.testing.assert_allclose(run(strategy), run("ar"), rtol=5e-2)
+
+
+def test_lsgan_int8_sr_compiles_and_steps():
+    """Regression: the GAN's two reduce_grads calls must thread rng so
+    exch_strategy='int8_sr' works for every model, not just TpuModel."""
+    from theanompi_tpu.models.lsgan import LSGAN
+
+    model = LSGAN(
+        config=dict(
+            batch_size=4, base_width=8, latent_dim=16, exch_strategy="int8_sr",
+            n_synth_train=64, n_synth_val=32, print_freq=10_000,
+        ),
+        mesh=make_mesh(),
+    )
+    model.compile_train()
+    model.reset_train_iter(0)
+    d, g = model.train_iter(1, Recorder(verbose=False))
+    assert np.isfinite([d, g]).all()
 
 
 def test_int8_wire_bytes_actually_shrink():

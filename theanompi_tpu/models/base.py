@@ -330,7 +330,10 @@ class TpuModel:
                 loss_fn, has_aux=True
             )(params)
             if sync_mode == "cdd":
-                grads = maybe_clip(exchanger.reduce_grads(grads, param_specs))
+                rng, ex_key = jax.random.split(rng)  # int8_sr rounding noise
+                grads = maybe_clip(
+                    exchanger.reduce_grads(grads, param_specs, rng=ex_key)
+                )
                 params, opt_state = opt.update(params, grads, opt_state)
             else:  # avg: local step, then parameter averaging (DP-only;
                 # TP models are rejected above, so no per-leaf specs here)

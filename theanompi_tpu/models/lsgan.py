@@ -131,7 +131,7 @@ class LSGAN(TpuModel):
 
         def shard_step(params, net_state, opt_state, x, rng):
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
-            rz, rg, rd = jax.random.split(rng, 3)
+            rz, rg, rd, rex_d, rex_g = jax.random.split(rng, 5)
             z = jax.random.normal(rz, (x.shape[0], zdim))
 
             def d_loss_fn(d_params):
@@ -151,7 +151,7 @@ class LSGAN(TpuModel):
             (d_loss, (g_state, d_state)), d_grads = jax.value_and_grad(
                 d_loss_fn, has_aux=True
             )(params["d"])
-            d_grads = exchanger.reduce_grads(d_grads)
+            d_grads = exchanger.reduce_grads(d_grads, rng=rex_d)
             new_d, new_d_opt = d_opt.update(params["d"], d_grads, opt_state["d"])
 
             def g_loss_fn(g_params):
@@ -162,7 +162,7 @@ class LSGAN(TpuModel):
             (g_loss, g_state2), g_grads = jax.value_and_grad(
                 g_loss_fn, has_aux=True
             )(params["g"])
-            g_grads = exchanger.reduce_grads(g_grads)
+            g_grads = exchanger.reduce_grads(g_grads, rng=rex_g)
             new_g, new_g_opt = g_opt.update(params["g"], g_grads, opt_state["g"])
 
             new_params = {"g": new_g, "d": new_d}

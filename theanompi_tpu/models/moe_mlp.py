@@ -111,9 +111,9 @@ class MoeMlpModel(TpuModel):
         loss, (err, err5, new_state) = super().loss_and_metrics(
             params, net_state, x, y, train, rng
         )
-        coef = float(self.config.moe_aux_coef)
-        if train and coef:
-            loss = loss + coef * sum(MoeMlp.collect_aux_losses(new_state))
+        loss = MoeMlp.add_aux_loss(
+            loss, new_state, self.config.moe_aux_coef, train
+        )
         return loss, (err, err5, new_state)
 
     def _build_param_specs(self):

@@ -288,13 +288,13 @@ class TransformerLM(TpuModel):
         flat_logits = logits.reshape(-1, v)
         flat_y = y.reshape(-1)
         loss = losses.softmax_cross_entropy(flat_logits, flat_y)
-        if train and int(self.config.moe_experts):
+        if int(self.config.moe_experts):
             # Switch load-balance aux: MoE blocks emit it through the
             # state tree (differentiable — same apply call)
-            coef = float(self.config.moe_aux_coef)
-            if coef:
-                from theanompi_tpu.parallel.moe import MoeMlp
+            from theanompi_tpu.parallel.moe import MoeMlp
 
-                loss = loss + coef * sum(MoeMlp.collect_aux_losses(new_state))
+            loss = MoeMlp.add_aux_loss(
+                loss, new_state, self.config.moe_aux_coef, train
+            )
         err, err5 = self._metrics(flat_logits, flat_y)
         return loss, (err, err5, new_state)

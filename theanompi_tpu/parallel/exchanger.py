@@ -26,6 +26,11 @@ applied inside the jitted, shard_mapped train step:
                 fp32 shard summation — ~4× fewer wire bytes than ``ar``
                 (the reference's fp16 kernels managed 2×). The pallas
                 variant runs the pack/unpack as TPU kernels.
+- ``int8_sr`` — the int8 wire with **stochastic rounding** on both
+                quantization legs (unbiased: rounding error averages out
+                across steps instead of accumulating). Needs the
+                per-step rng that compile_train threads through
+                ``reduce_grads(..., rng=...)``.
 
 Because the exchange executes inside the step function, XLA overlaps it
 with backprop where the schedule allows — the fusion the reference could
@@ -51,7 +56,9 @@ from theanompi_tpu.runtime.mesh import DATA_AXIS
 
 Pytree = Any
 
-STRATEGIES = ("ar", "bf16", "fp16", "pallas_bf16", "int8", "pallas_int8")
+STRATEGIES = ("ar", "bf16", "fp16", "pallas_bf16", "int8", "pallas_int8",
+              "int8_sr")
+_INT8_STRATEGIES = ("int8", "pallas_int8", "int8_sr")
 
 
 def spec_axis_names(spec) -> tuple:
@@ -99,7 +106,7 @@ class BSP_Exchanger:
         # axis sizes must be STATIC for the int8 reduce-scatter reshape;
         # compile_train passes its mesh, direct users of int8 must too
         self._axis_sizes = dict(mesh.shape) if mesh is not None else None
-        if strategy in ("int8", "pallas_int8") and self._axis_sizes is None:
+        if strategy in _INT8_STRATEGIES and self._axis_sizes is None:
             raise ValueError(
                 f"strategy {strategy!r} needs the mesh: "
                 "BSP_Exchanger(strategy=..., axis=..., mesh=mesh)"
@@ -125,7 +132,7 @@ class BSP_Exchanger:
         return tuple(a for a in self._axes_tuple() if a not in sharded)
 
     # -- int8 reduce-scatter + all-gather over a quantized wire -----------
-    def _int8_sum_one_axis(self, g, axis: str):
+    def _int8_sum_one_axis(self, g, axis: str, rng=None):
         """Sum ``g`` over one mesh axis moving ONLY int8 + per-block fp32
         scales on the wire (wire bytes ≈ N/4 + N/64 each way vs 4N for a
         fp32 ring — the reference's fp16 kernels halved bytes, this
@@ -135,6 +142,10 @@ class BSP_Exchanger:
         dequantizes and sums ITS shard in fp32 (quantized values are
         never added in the int domain — that overflows immediately).
         all-gather leg: requantize the reduced shard, all_gather, dequant.
+
+        ``int8_sr`` (``rng`` required) uses stochastic rounding on both
+        quantization legs — unbiased, so the rounding error averages out
+        across steps instead of accumulating (see quantize_blocks).
         """
         from theanompi_tpu.parallel import quantize as Q
 
@@ -142,7 +153,18 @@ class BSP_Exchanger:
         if world == 1:
             return g
         pallas = self.strategy == "pallas_int8"
-        quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
+        k1 = k2 = None
+        if self.strategy == "int8_sr":
+            if rng is None:
+                raise ValueError(
+                    "strategy 'int8_sr' needs per-step randomness: call "
+                    "reduce_grads(grads, specs, rng=key)"
+                )
+            k1, k2 = jax.random.split(rng)  # one per quantization leg
+        if pallas:
+            quant = lambda x, key=None: Q.pallas_quantize_blocks(x)  # noqa: E731
+        else:
+            quant = Q.quantize_blocks
         dequant = Q.pallas_dequantize_blocks if pallas else Q.dequantize_blocks
 
         orig_dtype = g.dtype
@@ -163,32 +185,33 @@ class BSP_Exchanger:
         nb = flat.size // (world * Q.BLOCK)  # blocks per device shard
         x = flat.reshape(world, nb, Q.BLOCK)
 
-        q, s = quant(x)  # (world, nb, BLOCK) int8, (world, nb) f32
+        q, s = quant(x, k1)  # (world, nb, BLOCK) int8, (world, nb) f32
         # all_to_all: row p of the result is peer p's shard-for-me
         q_t = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
         s_t = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
         mine = jnp.sum(dequant(q_t, s_t), axis=0)  # fp32 (nb, BLOCK)
 
-        q2, s2 = quant(mine)
+        q2, s2 = quant(mine, k2)
         q_all = lax.all_gather(q2, axis, axis=0)  # (world, nb, BLOCK)
         s_all = lax.all_gather(s2, axis, axis=0)
         out = dequant(q_all, s_all).reshape(-1)[:n]
         return out.reshape(g.shape).astype(orig_dtype)
 
-    def _int8_reduce_mean(self, g, axes: tuple):
+    def _int8_reduce_mean(self, g, axes: tuple, rng=None):
         total = 1
-        for a in axes:
-            g = self._int8_sum_one_axis(g, a)  # hierarchical: ICI then DCN
+        for i, a in enumerate(axes):
+            sub = jax.random.fold_in(rng, i) if rng is not None else None
+            g = self._int8_sum_one_axis(g, a, sub)  # hierarchical: ICI, DCN
             total *= int(self._axis_sizes[a])
         return (g / total).astype(g.dtype)
 
-    def _reduce_leaf_mean(self, g, axes: tuple):
+    def _reduce_leaf_mean(self, g, axes: tuple, rng=None):
         if not axes:
             return g
         if self.strategy == "ar":
             return lax.pmean(g, axes).astype(g.dtype)
-        if self.strategy in ("int8", "pallas_int8"):
-            return self._int8_reduce_mean(g, axes)
+        if self.strategy in _INT8_STRATEGIES:
+            return self._int8_reduce_mean(g, axes, rng)
         if self.strategy in ("bf16", "fp16"):
             wire = jnp.bfloat16 if self.strategy == "bf16" else jnp.float16
             pack = lambda x, d: x.astype(d)  # noqa: E731
@@ -201,18 +224,35 @@ class BSP_Exchanger:
         return (r / lax.psum(1, axes)).astype(g.dtype)
 
     # -- in-graph collectives (call inside shard_map) ---------------------
-    def reduce_grads(self, grads: Pytree, specs: Optional[Pytree] = None) -> Pytree:
+    def reduce_grads(
+        self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
+    ) -> Pytree:
         """Mean-reduce gradients across the exchange axes (cdd mode).
 
         ``specs`` (optional): pytree of ``PartitionSpec`` matching
         ``grads`` — per-leaf parameter shardings for tensor-parallel
-        models; ``None`` means fully replicated params (plain DP)."""
+        models; ``None`` means fully replicated params (plain DP).
+        ``rng``: per-step key, required by (and only used for) the
+        ``int8_sr`` stochastic-rounding wire; each leaf folds in its own
+        index so no two leaves share rounding noise."""
+        leaves_seen = [0]
+
+        def leaf_rng():
+            if rng is None:
+                return None
+            k = jax.random.fold_in(rng, leaves_seen[0])
+            leaves_seen[0] += 1
+            return k
+
         if specs is None:
             return jax.tree.map(
-                lambda g: self._reduce_leaf_mean(g, self._axes_tuple()), grads
+                lambda g: self._reduce_leaf_mean(
+                    g, self._axes_tuple(), leaf_rng()
+                ),
+                grads,
             )
         return jax.tree.map(
-            lambda g, s: self._reduce_leaf_mean(g, self._leaf_axes(s)),
+            lambda g, s: self._reduce_leaf_mean(g, self._leaf_axes(s), leaf_rng()),
             grads,
             specs,
         )

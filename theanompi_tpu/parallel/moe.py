@@ -158,13 +158,15 @@ class MoeMlp(Layer):
         cd = jnp.dtype(self.compute_dtype) if self.compute_dtype else jnp.float32
 
         def mm(sub, a, b):
-            out = jnp.einsum(
+            # bf16 operands, fp32 accumulation — the RESULT stays fp32 so
+            # bias-add and the activation run at full precision before any
+            # narrowing (matches the dense _mlp path in ops.attention)
+            return jnp.einsum(
                 sub, a.astype(cd), b.astype(cd),
                 preferred_element_type=jnp.float32,
             )
-            return out.astype(cd)
 
-        xe = mm("nec,nd->ecd", disp, x)
+        xe = mm("nec,nd->ecd", disp, x).astype(cd)
         if self.ep_axis is not None:
             ep = self.ep_size
             e_local = E // ep
@@ -177,23 +179,23 @@ class MoeMlp(Layer):
             w_out = _grad_scale(params["w_out"], s)
             b_out = _grad_scale(params["b_out"], s)
             hmid = jax.nn.relu(
-                mm("secd,edh->sech", xe, w_in)
-                + b_in[None, :, None, :].astype(cd)
+                mm("secd,edh->sech", xe, w_in) + b_in[None, :, None, :]
             ).astype(cd)
+            # narrow AFTER the fp32 bias-add — the return all-to-all then
+            # moves cd-width activations, same bytes as the dispatch leg
             ye = (
-                mm("sech,ehd->secd", hmid, w_out)
-                + b_out[None, :, None, :].astype(cd)
-            )
+                mm("sech,ehd->secd", hmid, w_out) + b_out[None, :, None, :]
+            ).astype(cd)
             ye = lax.all_to_all(ye, self.ep_axis, 0, 0)  # back to sources
             ye = ye.reshape(E, C, d)
         else:
             hmid = jax.nn.relu(
                 mm("ecd,edh->ech", xe, params["w_in"])
-                + params["b_in"][:, None, :].astype(cd)
+                + params["b_in"][:, None, :]
             ).astype(cd)
             ye = (
                 mm("ech,ehd->ecd", hmid, params["w_out"])
-                + params["b_out"][:, None, :].astype(cd)
+                + params["b_out"][:, None, :]
             )
         # ---- combine: gate-weighted gather back to token order ----
         # fp32 accumulation: a token's output is a 1-of-C·E selection
@@ -213,6 +215,15 @@ class MoeMlp(Layer):
 
         e = P(axis)
         return {"wg": P(), "w_in": e, "b_in": e, "w_out": e, "b_out": e}
+
+    @staticmethod
+    def add_aux_loss(loss, state_tree, coef, train: bool):
+        """``loss + coef·Σ aux`` during training — THE way models engage
+        the load-balance aux (both MoE models call this; keep the logic
+        in one place)."""
+        if not (train and coef):
+            return loss
+        return loss + float(coef) * sum(MoeMlp.collect_aux_losses(state_tree))
 
     @staticmethod
     def collect_aux_losses(state_tree):
