@@ -67,6 +67,9 @@ COMMON_DEFAULTS = dict(
     # split, reference-style); False = let steps pipeline and only sync at
     # print/validation boundaries (a host↔device fence costs ~60ms on
     # tunneled rigs — per-step syncing was a 20% throughput tax)
+    zero1=False,  # shard optimizer state over dp (parallel.zero.Zero1):
+    # reduce-scatter grads -> update own shard -> all-gather params.
+    # Same wire bytes as the allreduce it replaces, moments HBM / N.
 )
 
 
@@ -185,13 +188,15 @@ class TpuModel:
         self.rng, init_key = jax.random.split(self.rng)
         params, net_state, out_shape = self.net.init(init_key, self.input_shape)
         self.out_shape = out_shape
-        self.optimizer = optim_lib.sgd(
-            lr=float(cfg.lr),
-            momentum=float(cfg.momentum),
-            nesterov=bool(cfg.nesterov),
-            weight_decay=float(cfg.weight_decay),
-        )
-        opt_state = self.optimizer.init(params)
+        self.optimizer = optim_lib.from_config(cfg)  # sgd | adam | adamw
+        self._zero = None
+        if bool(cfg.zero1) and self.n_workers > 1:
+            from theanompi_tpu.parallel.zero import Zero1
+
+            self._zero = Zero1(self.optimizer, world=self.n_workers)
+            opt_state = self._zero.init(params)
+        else:
+            opt_state = self.optimizer.init(params)
         # replicate across the mesh (reference: each rank holds a copy)
         self.params = replicate(self.mesh, params)
         self.net_state = replicate(self.mesh, net_state)
@@ -235,11 +240,13 @@ class TpuModel:
         optimizer-agnostic."""
         if self.param_specs is None:
             return P()
-        ptree = jax.tree.structure(self.params)
+        shard_keys = optim_lib.param_shaped_entries(
+            self.opt_state, jax.tree.structure(self.params)
+        )
         return {
             k: (
                 self.param_specs
-                if jax.tree.structure(v) == ptree
+                if k in shard_keys
                 else jax.tree.map(lambda _: P(), v)
             )
             for k, v in self.opt_state.items()
@@ -282,6 +289,20 @@ class TpuModel:
                 "sync_mode='avg' (parameter averaging) is data-parallel "
                 "only; tensor-parallel models must use 'cdd'"
             )
+        zero = self._zero
+        if zero is not None:
+            # ZeRO-1 fuses the gradient reduction into the sharded update;
+            # scope: plain single-level dp with the fp32 wire
+            unsupported = {
+                "sync_mode != 'cdd'": sync_mode != "cdd",
+                "sharded params (tp/pp/ep)": self.param_specs is not None,
+                "exchange axes beyond dp": self.exchange_axes != DATA_AXIS,
+                "compressed exch_strategy": cfg.exch_strategy != "ar",
+                "grad_clip_norm": cfg.grad_clip_norm is not None,
+            }
+            bad = [k for k, v in unsupported.items() if v]
+            if bad:
+                raise ValueError(f"zero1 does not support: {', '.join(bad)}")
         clip = cfg.grad_clip_norm
 
         param_specs = self.param_specs
@@ -329,7 +350,11 @@ class TpuModel:
             (loss, (err, _, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            if sync_mode == "cdd":
+            if zero is not None:
+                # reduce-scatter + shard update + params all-gather; the
+                # exchanger is bypassed (the reduction IS the scatter)
+                params, opt_state = zero.update_shard(params, grads, opt_state)
+            elif sync_mode == "cdd":
                 rng, ex_key = jax.random.split(rng)  # int8_sr rounding noise
                 grads = maybe_clip(
                     exchanger.reduce_grads(grads, param_specs, rng=ex_key)
@@ -339,12 +364,19 @@ class TpuModel:
                 # TP models are rejected above, so no per-leaf specs here)
                 params, opt_state = opt.update(params, maybe_clip(grads), opt_state)
                 params = exchanger.average_params(params)
-                opt_state = dict(
-                    opt_state,
-                    velocity=jax.tree.map(
-                        lambda v: lax.pmean(v, axis), opt_state["velocity"]
-                    ),
+                # moments drift per-replica under avg: sync every
+                # param-shaped entry (SGD velocity, Adam mu/nu, ...)
+                sync_keys = optim_lib.param_shaped_entries(
+                    opt_state, jax.tree.structure(self.params)
                 )
+                opt_state = {
+                    k: (
+                        jax.tree.map(lambda v: lax.pmean(v, axis), v)
+                        if k in sync_keys
+                        else v
+                    )
+                    for k, v in opt_state.items()
+                }
             # BN running stats: sync so the replicated out-spec holds
             new_state = jax.tree.map(lambda s: lax.pmean(s, axis), new_state)
             loss = lax.pmean(loss, axis)
@@ -352,7 +384,11 @@ class TpuModel:
             return params, new_state, opt_state, loss, err
 
         pspec = P() if param_specs is None else param_specs
-        opt_spec = self._opt_state_specs()
+        opt_spec = (
+            zero.state_specs(self.opt_state)
+            if zero is not None
+            else self._opt_state_specs()
+        )
         mapped = jax.shard_map(
             shard_step,
             mesh=self.mesh,
@@ -509,6 +545,15 @@ class TpuModel:
                 "and load (e.g. GoogLeNet aux_heads, WResNet depth). "
                 "Rebuild the model with the config the checkpoint was "
                 "trained with."
+            )
+        ck_shapes = [jnp.shape(l) for l in jax.tree.leaves(blob["opt_state"])]
+        my_shapes = [jnp.shape(l) for l in jax.tree.leaves(self.opt_state)]
+        if ck_shapes != my_shapes:
+            raise ValueError(
+                f"checkpoint {path!r} has a different optimizer-state "
+                "layout than this model — the optimizer or zero1 config "
+                "changed between save and load (zero1 stores flat "
+                "dp-sharded moments). Rebuild with the saving config."
             )
         self.params = replicate(self.mesh, blob["params"])
         self.net_state = replicate(self.mesh, blob["net_state"])

@@ -82,6 +82,99 @@ def sgd(
     return Optimizer(init, update)
 
 
+def adam(
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    decoupled: bool = True,
+) -> Optimizer:
+    """Adam / AdamW (beyond-reference: the 2016 upstream had only the
+    SGD family, but the transformer/MoE models this framework adds are
+    conventionally trained with it).  Same design rules as :func:`sgd`:
+    lr lives in the state, moments are param-shaped top-level entries so
+    ``TpuModel._opt_state_specs`` shards them automatically for tp/ep/pp
+    models.  ``decoupled=True`` = AdamW (decay applied to params, not
+    grads); ``False`` = classic L2-in-gradient.
+    """
+
+    def init(params: Params) -> OptState:
+        return {
+            "mu": jax.tree.map(jnp.zeros_like, params),
+            "nu": jax.tree.map(jnp.zeros_like, params),
+            "lr": jnp.asarray(lr, jnp.float32),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(params: Params, grads: Grads, state: OptState):
+        lr_t = state["lr"]
+        t = state["step"] + 1
+        # bias correction folded into a step-dependent scale (fp32)
+        c1 = 1.0 - jnp.power(b1, t.astype(jnp.float32))
+        c2 = 1.0 - jnp.power(b2, t.astype(jnp.float32))
+        scale = lr_t * jnp.sqrt(c2) / c1
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            if weight_decay and not decoupled:
+                g = g + weight_decay * p
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * jnp.square(g)
+            step = -scale * m_new / (jnp.sqrt(v_new) + eps)
+            if weight_decay and decoupled:
+                step = step - lr_t * weight_decay * p
+            return p + step, m_new, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["mu"])
+        flat_v = treedef.flatten_up_to(state["nu"])
+        out = [upd(*a) for a in zip(flat_p, flat_g, flat_m, flat_v)]
+        return treedef.unflatten([o[0] for o in out]), {
+            "mu": treedef.unflatten([o[1] for o in out]),
+            "nu": treedef.unflatten([o[2] for o in out]),
+            "lr": lr_t,
+            "step": t,
+        }
+
+    return Optimizer(init, update)
+
+
+def from_config(cfg) -> Optimizer:
+    """Build the optimizer a model config names (``optimizer`` key:
+    'sgd' default, 'adam', 'adamw')."""
+    name = str(cfg.get("optimizer", "sgd")).lower()
+    if name == "sgd":
+        return sgd(
+            lr=float(cfg.lr),
+            momentum=float(cfg.momentum),
+            nesterov=bool(cfg.nesterov),
+            weight_decay=float(cfg.weight_decay),
+        )
+    if name in ("adam", "adamw"):
+        return adam(
+            lr=float(cfg.lr),
+            b1=float(cfg.get("adam_b1", 0.9)),
+            b2=float(cfg.get("adam_b2", 0.999)),
+            eps=float(cfg.get("adam_eps", 1e-8)),
+            weight_decay=float(cfg.weight_decay),
+            decoupled=(name == "adamw"),
+        )
+    raise ValueError(f"unknown optimizer {name!r} (sgd|adam|adamw)")
+
+
+def param_shaped_entries(state: OptState, params_treedef) -> tuple:
+    """Top-level state keys whose value mirrors the params pytree
+    (velocity, Adam moments, …) — THE discriminator for 'shard/sync this
+    entry like a parameter' used by opt-state placement, avg-mode moment
+    sync, and ZeRO; keep the rule in one place."""
+    return tuple(
+        k for k, v in state.items()
+        if jax.tree.structure(v) == params_treedef
+    )
+
+
 def set_lr(state: OptState, lr: float) -> OptState:
     """Host-side lr mutation between steps (reference: shared-var set)."""
     new = dict(state)

@@ -59,7 +59,7 @@ def _no_exchange_cls():
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
 
     class _NoExchange(BSP_Exchanger):
-        def reduce_grads(self, grads, specs=None):
+        def reduce_grads(self, grads, specs=None, rng=None):
             return grads
 
         def average_params(self, params, specs=None):
