@@ -13,13 +13,48 @@ is tracked by the driver's BENCH_r{N}.json history.
 """
 
 import json
+import sys
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 
 
+def _require_devices(timeout_s: float = 120.0):
+    """Fail FAST if the accelerator backend is unreachable — a wedged
+    tunnel makes jax.devices() hang, not error, and a hung bench tells
+    the driver nothing."""
+    out = {}
+
+    def probe():
+        try:
+            out["devs"] = jax.devices()
+        except Exception as e:  # pragma: no cover
+            out["err"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if "devs" not in out:
+        print(
+            json.dumps(
+                {
+                    "metric": "alexnet128_bsp_images_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "images/sec/chip",
+                    "vs_baseline": 0.0,
+                    "detail": {"error": f"no accelerator within {timeout_s}s: "
+                               f"{out.get('err', 'device probe hung')}"},
+                }
+            )
+        )
+        sys.exit(1)
+    return out["devs"]
+
+
 def main():
+    _require_devices()
     from theanompi_tpu.models.alex_net import AlexNet
     from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
 

@@ -110,6 +110,27 @@ def test_scale_lr_and_adjust_hyperp():
     assert float(model.opt_state["lr"]) == pytest.approx(8 * base)
 
 
+def test_grad_accum_matches_single_pass():
+    """grad_accum=K must reproduce the K=1 step exactly: equal-size
+    microbatch mean-of-means == full-batch mean (no BN in the way when
+    dropout=0 and stats sync at the end either way)."""
+    losses1, m1 = _run_steps(make_mesh(), per_shard_bs=16, n_steps=3)
+    losses4, m4 = _run_steps(
+        make_mesh(), per_shard_bs=16, n_steps=3, grad_accum=4
+    )
+    np.testing.assert_allclose(losses4, losses1, rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(m4.params), jax.tree.leaves(m1.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5,
+            err_msg="accumulated grads diverged from the single pass",
+        )
+
+
+def test_grad_accum_bad_divisibility():
+    with pytest.raises(ValueError, match="not divisible"):
+        _run_steps(make_mesh(), per_shard_bs=8, n_steps=1, grad_accum=3)
+
+
 def test_worker_engages_linear_lr_scaling():
     """The BSP worker linearly scales lr by n_workers (the reference's
     scale_lr heritage), unless lr_linear_scaling=False."""

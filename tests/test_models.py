@@ -187,6 +187,18 @@ def test_lsgan_adversarial_step():
     assert np.isfinite(np.asarray(imgs)).all()
 
 
+def test_lsgan_rejects_unsupported_base_features():
+    from theanompi_tpu.models.lsgan import LSGAN
+
+    model = LSGAN(
+        config=dict(batch_size=4, base_width=8, latent_dim=16,
+                    n_synth_train=64, n_synth_val=32, zero1=True),
+        mesh=make_mesh(),
+    )
+    with pytest.raises(ValueError, match="LSGAN does not support"):
+        model.compile_train()
+
+
 def test_lasagne_zoo_namespace():
     from theanompi_tpu.models import lasagne_model_zoo as zoo
 

@@ -70,6 +70,9 @@ COMMON_DEFAULTS = dict(
     zero1=False,  # shard optimizer state over dp (parallel.zero.Zero1):
     # reduce-scatter grads -> update own shard -> all-gather params.
     # Same wire bytes as the allreduce it replaces, moments HBM / N.
+    grad_accum=1,  # microbatches per step (lax.scan): grads accumulate
+    # across K sequential fwd+bwd passes before ONE exchange+update —
+    # K× the effective batch at 1/K the activation HBM
 )
 
 
@@ -333,9 +336,11 @@ class TpuModel:
         device_aug = bool(cfg.get("device_aug", False))
         aug_crop = cfg.get("crop_size", None)
         aug_mirror = bool(cfg.get("mirror", True))
+        accum = int(cfg.get("grad_accum", 1) or 1)
 
-        def shard_step(params, net_state, opt_state, x, y, rng):
-            rng = jax.random.fold_in(rng, lax.axis_index(axis))
+        def micro_grads(params, net_state, x, y, rng):
+            """fwd+bwd on one microbatch (augment inside, so each
+            microbatch draws fresh crops)."""
             if device_aug:
                 from theanompi_tpu.ops.augment import random_crop_mirror
 
@@ -347,15 +352,52 @@ class TpuModel:
             def loss_fn(p):
                 return self.loss_and_metrics(p, net_state, x, y, True, rng)
 
-            (loss, (err, _, new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def shard_step(params, net_state, opt_state, x, y, rng):
+            rng = jax.random.fold_in(rng, lax.axis_index(axis))
+            # ALL keys this step uses come from one split so none can
+            # collide: accum microbatch keys + the exchange (int8_sr) key
+            if accum == 1:
+                k_micro, ex_key = jax.random.split(rng)
+                (loss, (err, _, new_state)), grads = micro_grads(
+                    params, net_state, x, y, k_micro
+                )
+            else:
+                # gradient accumulation: scan over K microbatches, only
+                # 1/K of the activations live at once — big effective
+                # batches without the HBM. Equal microbatch sizes, so
+                # mean-of-means == the full local-batch mean; BN stats
+                # thread sequentially (per-microbatch stats, as K
+                # smaller steps would see).
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"per-shard batch {x.shape[0]} not divisible by "
+                        f"grad_accum={accum}"
+                    )
+                xs = x.reshape(accum, -1, *x.shape[1:])
+                ys = y.reshape(accum, -1, *y.shape[1:])
+                all_keys = jax.random.split(rng, accum + 1)
+                keys, ex_key = all_keys[:accum], all_keys[accum]
+
+                def micro(carry, inp):
+                    g_acc, l_acc, e_acc, st = carry
+                    xm, ym, k = inp
+                    (l, (e, _, st2)), g = micro_grads(params, st, xm, ym, k)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + l, e_acc + e, st2), None
+
+                g0 = jax.tree.map(jnp.zeros_like, params)
+                (grads, loss, err, new_state), _ = lax.scan(
+                    micro, (g0, 0.0, 0.0, net_state), (xs, ys, keys)
+                )
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss, err = loss / accum, err / accum
             if zero is not None:
                 # reduce-scatter + shard update + params all-gather; the
                 # exchanger is bypassed (the reduction IS the scatter)
                 params, opt_state = zero.update_shard(params, grads, opt_state)
             elif sync_mode == "cdd":
-                rng, ex_key = jax.random.split(rng)  # int8_sr rounding noise
                 grads = maybe_clip(
                     exchanger.reduce_grads(grads, param_specs, rng=ex_key)
                 )

@@ -121,6 +121,16 @@ class LSGAN(TpuModel):
     # -- fused adversarial step -----------------------------------------
     def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
         cfg = self.config
+        # COMMON_DEFAULTS features the GAN's bespoke two-player step does
+        # not implement — reject loudly rather than silently ignore
+        unsupported = {
+            "zero1": bool(cfg.get("zero1", False)),
+            "grad_accum": int(cfg.get("grad_accum", 1) or 1) != 1,
+            "device_aug": bool(cfg.get("device_aug", False)),
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            raise ValueError(f"LSGAN does not support: {', '.join(bad)}")
         exchanger = exchanger or BSP_Exchanger(
             strategy=cfg.exch_strategy, mesh=self.mesh
         )
