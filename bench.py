@@ -21,6 +21,22 @@ import jax
 import jax.numpy as jnp
 
 
+def emit(value: float, vs_baseline: float, detail: dict) -> None:
+    """THE one JSON line the driver parses — success and failure paths
+    both come through here so the schema cannot diverge."""
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet128_bsp_images_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": vs_baseline,
+                "detail": detail,
+            }
+        )
+    )
+
+
 def _require_devices(timeout_s: float = 120.0):
     """Fail FAST if the accelerator backend is unreachable — a wedged
     tunnel makes jax.devices() hang, not error, and a hung bench tells
@@ -37,17 +53,10 @@ def _require_devices(timeout_s: float = 120.0):
     t.start()
     t.join(timeout=timeout_s)
     if "devs" not in out:
-        print(
-            json.dumps(
-                {
-                    "metric": "alexnet128_bsp_images_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "images/sec/chip",
-                    "vs_baseline": 0.0,
-                    "detail": {"error": f"no accelerator within {timeout_s}s: "
-                               f"{out.get('err', 'device probe hung')}"},
-                }
-            )
+        emit(
+            0.0, 0.0,
+            {"error": f"no accelerator within {timeout_s}s: "
+             f"{out.get('err', 'device probe hung')}"},
         )
         sys.exit(1)
     return out["devs"]
@@ -111,24 +120,17 @@ def main():
 
     global_bs = per_chip_bs * n_chips
     imgs_per_sec = n_steps * global_bs / dt
-    per_chip = imgs_per_sec / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "alexnet128_bsp_images_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": 1.0,
-                "detail": {
-                    "chips": n_chips,
-                    "per_chip_batch": per_chip_bs,
-                    "steps": n_steps,
-                    "total_s": round(dt, 3),
-                    "loss_final": float(loss),
-                    "compute_dtype": "bfloat16",
-                },
-            }
-        )
+    emit(
+        imgs_per_sec / n_chips,
+        1.0,
+        {
+            "chips": n_chips,
+            "per_chip_batch": per_chip_bs,
+            "steps": n_steps,
+            "total_s": round(dt, 3),
+            "loss_final": float(loss),
+            "compute_dtype": "bfloat16",
+        },
     )
 
 

@@ -50,6 +50,8 @@ class TransformerLM(TpuModel):
         mlp_ratio=4,
         sp=1,  # sequence-parallel degree (mesh sp-axis size)
         sp_mode="ring",  # 'ring' (ppermute K/V ring) | 'alltoall' (Ulysses)
+        attn_impl="xla",  # 'xla' (fused dense) | 'flash' (Pallas kernel;
+        # local dense path + alltoall SP — not the ring body)
         tp=1,  # tensor-parallel degree (Megatron-style column/row sharding)
         lr=0.1,
         momentum=0.9,
@@ -231,6 +233,7 @@ class TransformerLM(TpuModel):
                         tp_size=self.tp_size,
                         compute_dtype=dt,
                         moe=make_moe(),
+                        attn_impl=str(cfg.attn_impl),
                     ))
                     for _ in range(int(cfg.n_layers))
                 ],

@@ -142,9 +142,19 @@ class MultiHeadAttention(Layer):
         tp_axis: Optional[str] = None,
         tp_size: int = 1,
         compute_dtype: Optional[jnp.dtype] = None,
+        attn_impl: str = "xla",
     ):
         if sp_mode not in ("ring", "alltoall"):
             raise ValueError(f"sp_mode must be 'ring' or 'alltoall', got {sp_mode!r}")
+        if attn_impl not in ("xla", "flash"):
+            raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
+        if attn_impl == "flash" and sp_axis is not None and sp_size > 1 and sp_mode == "ring":
+            raise ValueError(
+                "attn_impl='flash' fuses the local dense attention; the "
+                "ring path does its own blockwise accumulation — use "
+                "sp_mode='alltoall' (local dense after the reshuffle) or "
+                "attn_impl='xla' with ring"
+            )
         if tp_size > 1 and n_heads % tp_size:
             raise ValueError(
                 f"tensor parallelism needs n_heads % tp == 0, "
@@ -158,6 +168,7 @@ class MultiHeadAttention(Layer):
         self.tp_axis = tp_axis
         self.tp_size = tp_size
         self.compute_dtype = compute_dtype
+        self.attn_impl = attn_impl
 
     def init(self, key, in_shape):
         t, d = in_shape
@@ -209,7 +220,12 @@ class MultiHeadAttention(Layer):
                 axis_name=self.sp_axis,
                 axis_size=self.sp_size,
                 causal=self.causal,
+                attn_impl=self.attn_impl,
             )
+        elif self.attn_impl == "flash":
+            from theanompi_tpu.ops.pallas_flash import flash_attention
+
+            o = flash_attention(q, k, v, self.causal)
         else:
             o = full_attention(q, k, v, causal=self.causal)
         # output keeps the flowing activation dtype (softmax statistics
@@ -248,6 +264,7 @@ class TransformerBlock(Layer):
         tp_size: int = 1,
         compute_dtype: Optional[jnp.dtype] = None,
         moe=None,
+        attn_impl: str = "xla",
     ):
         if moe is not None and tp_size > 1:
             raise ValueError(
@@ -259,7 +276,7 @@ class TransformerBlock(Layer):
         self.attn = MultiHeadAttention(
             n_heads, causal=causal, sp_axis=sp_axis, sp_size=sp_size,
             sp_mode=sp_mode, tp_axis=tp_axis, tp_size=tp_size,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, attn_impl=attn_impl,
         )
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis

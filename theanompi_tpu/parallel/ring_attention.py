@@ -107,6 +107,7 @@ def ring_attention(
     axis_size: Optional[int] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    attn_impl: str = "xla",
 ) -> jax.Array:
     """Exact blockwise attention over sequence shards on a ring.
 
@@ -123,7 +124,15 @@ def ring_attention(
     if axis_size is None:
         raise ValueError("ring_attention needs static axis_size (mesh.shape[axis])")
     if axis_size == 1:
+        if attn_impl == "flash":
+            from theanompi_tpu.ops.pallas_flash import flash_attention
+
+            return flash_attention(q, k, v, causal, scale)
         return full_attention(q, k, v, causal=causal, scale=scale)
+    if attn_impl == "flash":
+        # the ring body IS a blockwise accumulation; a fused per-block
+        # kernel is future work (needs carry-in/out of m/den/num)
+        raise ValueError("ring attention does not take attn_impl='flash'")
 
     b, t, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5

@@ -44,6 +44,7 @@ def ulysses_attention(
     axis_size: Optional[int] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    attn_impl: str = "xla",
 ) -> jax.Array:
     """Exact attention over sequence shards via head⇄sequence all-to-all.
 
@@ -52,12 +53,22 @@ def ulysses_attention(
     local output shard (B, T_local, H, D) in q's dtype. Requires
     ``H % axis_size == 0`` (each device owns H/n whole heads in the
     middle phase). ``axis_size=1`` degrades to dense attention with no
-    collectives traced.
+    collectives traced. ``attn_impl='flash'`` runs the local dense
+    attention (full sequence × local heads) through the fused Pallas
+    kernel — the combination that makes the memory story work at long T.
     """
+
+    def dense(qq, kk, vv):
+        if attn_impl == "flash":
+            from theanompi_tpu.ops.pallas_flash import flash_attention
+
+            return flash_attention(qq, kk, vv, causal, scale)
+        return full_attention(qq, kk, vv, causal=causal, scale=scale)
+
     if axis_size is None:
         raise ValueError("ulysses_attention needs static axis_size (mesh.shape[axis])")
     if axis_size == 1:
-        return full_attention(q, k, v, causal=causal, scale=scale)
+        return dense(q, k, v)
     h = q.shape[2]
     if h % axis_size:
         raise ValueError(
@@ -74,9 +85,9 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # full sequence resident: plain causal masking is exact, XLA fuses the
-    # whole softmax-attention per local head group
-    out = full_attention(qg, kg, vg, causal=causal, scale=scale)
+    # full sequence resident: plain causal masking is exact; the local
+    # dense attention is XLA-fused or the Pallas flash kernel
+    out = dense(qg, kg, vg)
     return heads_to_seq(out).astype(q.dtype)
 
 
