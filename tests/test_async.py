@@ -91,6 +91,32 @@ def test_gosgd_end_to_end():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_easgd_drives_transformer():
+    """Async rules compose with the beyond-reference models: two EASGD
+    workers on disjoint 2-device sub-meshes elastic-average a
+    TransformerLM (the async path is model-agnostic by contract)."""
+    rule = theanompi_tpu.EASGD()
+    rule.init(
+        devices=4,
+        modelfile="theanompi_tpu.models.transformer",
+        modelclass="TransformerLM",
+        model_config=dict(
+            batch_size=4, seq_len=16, vocab_size=32, d_model=32,
+            n_heads=4, n_layers=1, n_epochs=2, n_synth_train=16,
+            n_synth_val=2, print_freq=1000, exch_strategy="ar",
+            comm_probe=False,
+        ),
+        n_workers=2,
+        tau=2,
+        alpha=0.5,
+        verbose=False,
+    )
+    model = rule.wait()
+    assert rule.worker.server.n_exchanges > 0
+    for leaf in jax.tree.leaves(model.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
 def test_easgd_server_duties_and_resume(tmp_path):
     """Reference ``easgd_server.py`` duties (SURVEY.md §4.3): the center
     is validated and checkpointed DURING training, per epoch — and a new

@@ -222,12 +222,12 @@ class MultiHeadAttention(Layer):
                 causal=self.causal,
                 attn_impl=self.attn_impl,
             )
-        elif self.attn_impl == "flash":
-            from theanompi_tpu.ops.pallas_flash import flash_attention
-
-            o = flash_attention(q, k, v, self.causal)
         else:
-            o = full_attention(q, k, v, causal=self.causal)
+            from theanompi_tpu.parallel.ring_attention import local_attention
+
+            o = local_attention(
+                q, k, v, causal=self.causal, attn_impl=self.attn_impl
+            )
         # output keeps the flowing activation dtype (softmax statistics
         # inside ring/ulysses/full attention are fp32 regardless).
         # Row-parallel wo: local (d/tp, d) partial products summed over tp

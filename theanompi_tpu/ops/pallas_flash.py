@@ -43,6 +43,23 @@ BLOCK_Q = 128  # MXU/VPU-friendly tile; shapes must divide (or T < block)
 BLOCK_K = 128
 
 
+def _on_tpu() -> bool:
+    """True when the default backend drives real TPU hardware.
+
+    NOT a string-equality check on the backend name: this rig's
+    tunneled TPU registers as platform 'axon' (device_kind 'TPU v5
+    lite'), and ``jax.default_backend() == 'tpu'`` would silently fall
+    into interpret mode there — an orders-of-magnitude perf cliff with
+    no error.
+    """
+    try:
+        d = jax.devices()[0]
+    except RuntimeError:
+        return False
+    text = f"{d.platform} {getattr(d, 'device_kind', '')}".lower()
+    return "tpu" in text
+
+
 def _pick_block(t: int, pref: int) -> int:
     if t <= pref:
         return t
@@ -119,7 +136,7 @@ def _flash_forward(q, k, v, causal, scale):
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-        interpret=(jax.default_backend() != "tpu"),
+        interpret=not _on_tpu(),
     )(qr, kr, vr)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 

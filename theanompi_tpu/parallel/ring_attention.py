@@ -73,6 +73,17 @@ def full_attention(
     return out.astype(q.dtype)
 
 
+def local_attention(q, k, v, causal=False, scale=None, attn_impl="xla"):
+    """THE local dense-attention dispatch (XLA fused vs Pallas flash) —
+    shared by the non-SP path, the Ulysses local phase, and the sp=1
+    degenerations, so impl/scale policy lives in one place."""
+    if attn_impl == "flash":
+        from theanompi_tpu.ops.pallas_flash import flash_attention
+
+        return flash_attention(q, k, v, causal, scale)
+    return full_attention(q, k, v, causal=causal, scale=scale)
+
+
 def _block_update(q, k_blk, v_blk, m, den, num, scale, mask):
     """One online-softmax accumulation step against a K/V block.
 
@@ -124,11 +135,7 @@ def ring_attention(
     if axis_size is None:
         raise ValueError("ring_attention needs static axis_size (mesh.shape[axis])")
     if axis_size == 1:
-        if attn_impl == "flash":
-            from theanompi_tpu.ops.pallas_flash import flash_attention
-
-            return flash_attention(q, k, v, causal, scale)
-        return full_attention(q, k, v, causal=causal, scale=scale)
+        return local_attention(q, k, v, causal, scale, attn_impl)
     if attn_impl == "flash":
         # the ring body IS a blockwise accumulation; a fused per-block
         # kernel is future work (needs carry-in/out of m/den/num)

@@ -59,11 +59,9 @@ def ulysses_attention(
     """
 
     def dense(qq, kk, vv):
-        if attn_impl == "flash":
-            from theanompi_tpu.ops.pallas_flash import flash_attention
+        from theanompi_tpu.parallel.ring_attention import local_attention
 
-            return flash_attention(qq, kk, vv, causal, scale)
-        return full_attention(qq, kk, vv, causal=causal, scale=scale)
+        return local_attention(qq, kk, vv, causal, scale, attn_impl)
 
     if axis_size is None:
         raise ValueError("ulysses_attention needs static axis_size (mesh.shape[axis])")
