@@ -1,25 +1,33 @@
-"""Fused flash-attention forward kernel (Pallas TPU).
+"""Fused flash-attention (Pallas TPU) — forward AND backward kernels.
 
 The dense attention path (``parallel.ring_attention.full_attention``)
 materializes the (B, H, Tq, Tk) score matrix in HBM — the classic
-O(T²) memory wall. This kernel computes the same softmax(QKᵀ)V with the
-online-softmax recurrence entirely in VMEM: one grid step owns one
-(batch·head, q-block) tile, streams K/V blocks through registers, and
-writes only the (BLOCK_Q, D) output tile. HBM traffic drops from
-O(T² + T·D) to O(T·D).
+O(T²) memory wall. These kernels compute the same softmax(QKᵀ)V with
+the online-softmax recurrence entirely in VMEM:
 
-Scope (v1, deliberate):
+- **forward**: one grid step owns one (batch·head, q-block) tile,
+  streams K/V blocks through registers, writes the (BLOCK_Q, D) output
+  tile plus the per-row log-sum-exp (the only residual the backward
+  needs beyond q/k/v/out).
+- **backward** (FlashAttention-2 schedule): probabilities are
+  *recomputed* blockwise from q/k/lse — never stored — in two kernels
+  with no cross-tile accumulation hazards: a dq pass gridded over
+  q-blocks and a dk/dv pass gridded over k-blocks, each streaming the
+  opposite operand. ``Δ = rowsum(dout·out)`` is precomputed in XLA
+  (cheap elementwise) and prefetched per tile.
 
-- **Forward only.** The backward runs through a ``jax.custom_vjp``
-  whose bwd re-derives gradients from the XLA reference implementation
-  (numerically the same function, so the VJP is exact). A fused flash
-  backward kernel is the natural next step; the fwd already removes the
-  score matrix from inference/validation and from the residual forward
-  pass.
-- Head dim and sequence enter VMEM whole per (b, h): fine through
-  T ≈ 8k at D=64/128 on v5e-class VMEM; beyond that, shard sequence
-  over ``sp`` first (ring attention) — the layers compose.
-- ``interpret=True`` off-TPU so CPU CI exercises the same kernel code.
+Causal masking skips fully-masked blocks in all three kernels (the
+forward bounds its K loop at the diagonal; dq starts its K loop at 0
+and ends at the diagonal; dk/dv starts its Q loop at the diagonal).
+
+HBM traffic: O(T·D) per pass instead of O(T²). Head dim and sequence
+enter VMEM whole per (b, h): fine through T ≈ 8k at D=64/128 on
+v5e-class VMEM; beyond that, shard sequence over ``sp`` first — ring
+attention composes (``attn_impl`` applies to the local dense paths).
+
+``interpret=True`` off-TPU so CPU CI exercises the same kernel code;
+the gate checks the DEVICE (platform + device_kind), not the backend
+name — tunneled TPUs register under non-'tpu' platform names.
 
 Reference lineage: the reference framework has no attention at all
 (SURVEY.md §3.4); its only native-kernel component was the fp16
@@ -69,7 +77,16 @@ def _pick_block(t: int, pref: int) -> int:
     return t  # fall back to one block (still correct, more VMEM)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, t):
+def _dot(a, b, dims):
+    return lax.dot_general(a, b, (dims, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, t):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     d = q.shape[-1]
@@ -85,10 +102,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, t):
         m, den, acc = carry
         k_blk = k_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
         v_blk = v_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk)
+        s = _dot(q, k_blk, ((1,), (1,))) * scale  # (bq, bk)
         if causal:
             k_pos = kc * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
@@ -98,21 +112,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, t):
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         corr = jnp.exp(m - m_new)
         den = den * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        acc = acc * corr[:, None] + _dot(p, v_blk, ((1,), (0,)))
         return m_new, den, acc
 
     if causal:
         # skip K blocks entirely above the diagonal: q-block qi covers
-        # rows < (qi+1)·bq, so blocks with kc·bk >= (qi+1)·bq are fully
-        # masked — without this the causal forward does ~2× the FLOPs
+        # rows < (qi+1)·bq — without this the causal forward does ~2×
+        # the necessary block matmuls
         nk_eff = jnp.minimum(nk, ((qi + 1) * bq + bk - 1) // bk)
     else:
         nk_eff = nk
-    _, den, acc = lax.fori_loop(0, nk_eff, body, (m0, den0, acc0))
+    m, den, acc = lax.fori_loop(0, nk_eff, body, (m0, den0, acc0))
     o_ref[0] = (acc / den[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(den)
 
 
 def _flash_forward(q, k, v, causal, scale):
@@ -124,21 +136,174 @@ def _flash_forward(q, k, v, causal, scale):
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ),
         grid=(b * h, t // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+        ),
         interpret=not _on_tpu(),
     )(qr, kr, vr)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out, lse  # both in (B*H, ...) layout
+
+
+# ---------------------------------------------------------------------------
+# backward — FlashAttention-2 two-pass schedule
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+               *, scale, causal, bq, bk, t):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # (bq,)
+    dlt = dlt_ref[0]  # (bq,)
+    d = q.shape[-1]
+    nk = t // bk
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(kc, dq):
+        k_blk = k_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
+        s = _dot(q, k_blk, ((1,), (1,))) * scale
+        if causal:
+            k_pos = kc * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # normalized probabilities
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = _dot(do, v_blk, ((1,), (1,)))  # (bq, bk)
+        ds = p * (dp - dlt[:, None]) * scale
+        return dq + _dot(ds, k_blk, ((1,), (0,)))
+
+    nk_eff = jnp.minimum(nk, ((qi + 1) * bq + bk - 1) // bk) if causal else nk
+    dq = lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref,
+                *, scale, causal, bq, bk, t):
+    kc = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)  # (bk, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    nq = t // bq
+    k_pos = kc * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.dslice(qi * bq, bq)].astype(jnp.float32)
+        do_blk = do_ref[0, pl.dslice(qi * bq, bq)].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(qi * bq, bq)]
+        dlt = dlt_ref[0, pl.dslice(qi * bq, bq)]
+        s = _dot(q_blk, k_blk, ((1,), (1,))) * scale  # (bq, bk)
+        if causal:
+            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dv = dv + _dot(p, do_blk, ((0,), (0,)))  # (bk, d)
+        dp = _dot(do_blk, v_blk, ((1,), (1,)))  # (bq, bk)
+        ds = p * (dp - dlt[:, None]) * scale
+        dk = dk + _dot(ds, q_blk, ((0,), (0,)))  # (bk, d)
+        return dk, dv
+
+    # causal: q-blocks strictly above the diagonal see only masked rows
+    qi_min = (kc * bk) // bq if causal else 0
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(qi_min, nq, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(causal, scale, res, ct):
+    qr, kr, vr, out, lse = res  # all (B*H, T, D) / (B*H, T)
+    bh, t, d = qr.shape
+    bq = _pick_block(t, BLOCK_Q)
+    bk = _pick_block(t, BLOCK_K)
+    do = ct  # (B*H, T, D) fp32-or-input-dtype cotangent
+    # Δ_i = Σ_d dout·out — XLA elementwise, prefetched per tile
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B*H, T)
+
+    row = lambda bhi, i: (bhi, 0, 0)  # noqa: E731 — whole-row spec
+    rowv = lambda bhi, i: (bhi, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qr.dtype),
+        grid=(bh, t // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bhi, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, t, d), row),
+            pl.BlockSpec((1, t, d), row),
+            pl.BlockSpec((1, bq, d), lambda bhi, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bhi, qi: (bhi, qi)),
+            pl.BlockSpec((1, bq), lambda bhi, qi: (bhi, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bhi, qi: (bhi, qi, 0)),
+        interpret=not _on_tpu(),
+    )(qr, kr, vr, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), kr.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), vr.dtype),
+        ),
+        grid=(bh, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, t, d), row),
+            pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
+            pl.BlockSpec((1, t, d), row),
+            pl.BlockSpec((1, t), rowv),
+            pl.BlockSpec((1, t), rowv),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
+        ),
+        interpret=not _on_tpu(),
+    )(qr, kr, vr, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom VJP over the kernels)
+# ---------------------------------------------------------------------------
+
+def _to_rows(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_rows(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _resolve_scale(scale, d: int) -> float:
+    """THE default-scale policy, resolved once — fwd and bwd must agree."""
+    return float(scale) if scale is not None else d ** -0.5
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -149,28 +314,24 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
 ):
-    """softmax(QKᵀ·scale)V, fused. Shapes (B, T, H, D) like
+    """softmax(QKᵀ·scale)V, fused fwd+bwd. Shapes (B, T, H, D) like
     ``full_attention``; same numerics (fp32 statistics) by test."""
-    s = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, causal, s)
+    out, _ = _flash_forward(q, k, v, causal, _resolve_scale(scale, q.shape[-1]))
+    return _from_rows(out, q.shape[0], q.shape[2])
 
 
-def _ref(q, k, v, causal, scale):
-    from theanompi_tpu.parallel.ring_attention import full_attention
-
-    return full_attention(q, k, v, causal=causal, scale=scale)
-
-
-def _fwd(q, k, v, causal, scale):
-    return flash_attention(q, k, v, causal, scale), (q, k, v)
+def _vjp_fwd(q, k, v, causal, scale):
+    s = _resolve_scale(scale, q.shape[-1])
+    out, lse = _flash_forward(q, k, v, causal, s)
+    b, h = q.shape[0], q.shape[2]
+    res = (_to_rows(q), _to_rows(k), _to_rows(v), out, lse, b, h, s)
+    return _from_rows(out, b, h), res
 
 
-def _bwd(causal, scale, res, ct):
-    # exact VJP via the XLA reference (same mathematical function);
-    # rematerializes the score matrix for the bwd only
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _ref(a, b, c, causal, scale), q, k, v)
-    return vjp(ct)
+def _vjp_bwd(causal, scale, res, ct):
+    qr, kr, vr, out, lse, b, h, s = res  # s: the scale the fwd ran with
+    dq, dk, dv = _flash_backward(causal, s, (qr, kr, vr, out, lse), _to_rows(ct))
+    return _from_rows(dq, b, h), _from_rows(dk, b, h), _from_rows(dv, b, h)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
