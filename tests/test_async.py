@@ -117,6 +117,34 @@ def test_easgd_drives_transformer():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_gosgd_drives_transformer():
+    """Gossip SGD over two transformer workers: pushes exchange, the
+    consensus-weight invariant holds, params stay finite."""
+    rule = theanompi_tpu.GOSGD()
+    rule.init(
+        devices=4,
+        modelfile="theanompi_tpu.models.transformer",
+        modelclass="TransformerLM",
+        model_config=dict(
+            batch_size=4, seq_len=16, vocab_size=32, d_model=32,
+            n_heads=4, n_layers=1, n_epochs=2, n_synth_train=16,
+            n_synth_val=2, print_freq=1000, exch_strategy="ar",
+            comm_probe=False,
+        ),
+        n_workers=2,
+        p_push=0.5,
+        verbose=False,
+    )
+    model = rule.wait()
+    tot = sum(w.weight for w in rule.worker.workers)
+    assert tot == pytest.approx(1.0)
+    # gossip actually happened (not just two isolated trainers)
+    assert sum(w.n_pushes for w in rule.worker.workers) > 0
+    assert sum(w.n_merges for w in rule.worker.workers) > 0
+    for leaf in jax.tree.leaves(model.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
 def test_easgd_server_duties_and_resume(tmp_path):
     """Reference ``easgd_server.py`` duties (SURVEY.md §4.3): the center
     is validated and checkpointed DURING training, per epoch — and a new

@@ -94,6 +94,42 @@ def test_pipeline_learns():
     assert float(ls[-1]) < float(ls[0])
 
 
+def test_bsp_rule_drives_pipeline_model():
+    """The reference rule API drives the pp model family end-to-end
+    (build_mesh supplies the dp×pp mesh)."""
+    import theanompi_tpu
+
+    rule = theanompi_tpu.BSP()
+    rule.init(
+        devices=8,
+        modelfile="theanompi_tpu.models.pipeline_mlp",
+        modelclass="PipelinedMLP",
+        model_config=dict(CFG, n_epochs=1),
+        val_freq=1,
+    )
+    model = rule.wait()
+    assert model.current_epoch == 1
+
+
+def test_bsp_rule_drives_moe_model():
+    import theanompi_tpu
+
+    rule = theanompi_tpu.BSP()
+    rule.init(
+        devices=8,
+        modelfile="theanompi_tpu.models.moe_mlp",
+        modelclass="MoeMlpModel",
+        model_config=dict(
+            batch_size=4, d_model=16, d_hidden=32, n_experts=4, ep=4,
+            n_epochs=1, n_synth_train=64, n_synth_val=32,
+            print_freq=10_000, comm_probe=False,
+        ),
+        val_freq=1,
+    )
+    model = rule.wait()
+    assert model.current_epoch == 1 and model.ep_size == 4
+
+
 def test_stage_shape_mismatch_rejected():
     from theanompi_tpu.ops import layers as L
 

@@ -207,6 +207,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
         self.p_push = p_push
         self.weight = 1.0 / mailbox.n_ranks  # gossip consensus weights
         self._np_rng = rng
+        self.n_pushes = 0  # observability: tests/operators can assert
+        self.n_merges = 0  # gossip actually happened
 
     def _merge_inbox(self):
         msgs = self.mailbox.drain(self.rank)
@@ -223,6 +225,7 @@ class GOSGD_Worker(_AsyncWorkerBase):
             a_i = tot
         self.weight = a_i
         self.set_params(w_i)
+        self.n_merges += len(msgs)
         self.recorder.end("comm")
 
     def _maybe_push(self):
@@ -234,6 +237,7 @@ class GOSGD_Worker(_AsyncWorkerBase):
         self.weight /= 2.0
         try:
             self.mailbox.send(dst, (self.get_params(), self.weight))
+            self.n_pushes += 1
         except (ConnectionError, OSError):
             # peer unreachable (cross-process: exited/crashed) — undo
             # the halving so the consensus weight mass isn't lost, and
