@@ -55,7 +55,18 @@ if __name__ == "__main__":
         ("shift", dict(lrn_impl="shift")),
         ("shift+remat", dict(lrn_impl="shift", lrn_remat=True)),
         ("window", dict(lrn_impl="window")),
+        ("maskpool", dict(pool_grad="mask")),
+        ("shift+maskpool", dict(lrn_impl="shift", pool_grad="mask")),
     ]
+    only = sys.argv[1:] or None  # run one config per process: safer on
+    # the single-client axon tunnel (see .claude/skills/verify/SKILL.md)
+    if only:
+        known = {name for name, _ in configs}
+        bad = [a for a in only if a not in known]
+        if bad:
+            sys.exit(f"unknown config(s) {bad}; choose from {sorted(known)}")
     for name, cfg in configs:
+        if only and name not in only:
+            continue
         ips = measure(cfg)
         print(f"{name:16s} {ips:10.0f} img/s", flush=True)

@@ -225,6 +225,48 @@ def test_sgd_update_is_jittable():
     assert float(s2["lr"]) == pytest.approx(0.01)
 
 
+@pytest.mark.parametrize("window,stride", [(2, 2), (3, 2), (3, 1)])
+def test_maxpool_mask_grad_matches_native(window, stride):
+    """Mask-based maxpool backward == select-and-scatter backward on
+    tie-free inputs (random floats; ties measure-zero)."""
+    from theanompi_tpu.ops.layers import MaxPool
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 9, 9, 3))
+
+    def loss(x, impl):
+        pool = MaxPool(window, stride=stride, grad_impl=impl)
+        y, _ = pool.apply({}, {}, x)
+        return jnp.sum(jnp.square(y)), y
+
+    (l_m, y_m), g_m = jax.value_and_grad(loss, has_aux=True)(x, "mask")
+    (l_n, y_n), g_n = jax.value_and_grad(loss, has_aux=True)(x, "native")
+    np.testing.assert_array_equal(np.asarray(y_m), np.asarray(y_n))
+    np.testing.assert_allclose(np.asarray(g_m), np.asarray(g_n), atol=1e-6)
+
+
+def test_maxpool_mask_tie_conserves_cotangent():
+    """On ties the mask impl splits the cotangent across tied maxima —
+    a valid subgradient; per-window cotangent mass is conserved."""
+    from theanompi_tpu.ops.layers import MaxPool
+
+    x = jnp.zeros((1, 4, 4, 1))  # all tied
+
+    def loss(x):
+        y, _ = MaxPool(2, stride=2, grad_impl="mask").apply({}, {}, x)
+        return jnp.sum(y)
+
+    g = jax.grad(loss)(x)
+    # 4 windows, each distributing cotangent 1 over its 4 tied entries
+    np.testing.assert_allclose(float(jnp.sum(g)), 4.0)
+
+
+def test_maxpool_mask_rejects_same_padding():
+    from theanompi_tpu.ops.layers import MaxPool
+
+    with pytest.raises(ValueError, match="VALID"):
+        MaxPool(3, stride=2, padding="SAME", grad_impl="mask")
+
+
 def test_adam_matches_numpy():
     opt = optim.adam(lr=0.01, b1=0.9, b2=0.999, eps=1e-8)
     params = {"w": jnp.ones((3,))}

@@ -34,6 +34,8 @@ class AlexNet(TpuModel):
         n_synth_batches=64,
         lrn_impl="auto",  # see ops.layers.LRN: auto|xla|shift|window|pallas
         lrn_remat=False,  # recompute LRN internals in bwd (saves HBM)
+        pool_grad="native",  # 'mask' = fused maxpool bwd (no
+        # select-and-scatter; see ops.layers.MaxPool)
     )
 
     def build_data(self):
@@ -56,23 +58,24 @@ class AlexNet(TpuModel):
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
         drop = float(cfg.dropout_rate)
         lrn = dict(impl=str(cfg.lrn_impl), remat=bool(cfg.lrn_remat))
+        pg = str(cfg.pool_grad)
         net = L.Sequential(
             [
                 L.Conv2d(96, 11, stride=4, padding="SAME", compute_dtype=dt),
                 L.Relu(),
                 L.LRN(**lrn),
-                L.MaxPool(3, stride=2),
+                L.MaxPool(3, stride=2, grad_impl=pg),
                 L.Conv2d(256, 5, padding="SAME", compute_dtype=dt),
                 L.Relu(),
                 L.LRN(**lrn),
-                L.MaxPool(3, stride=2),
+                L.MaxPool(3, stride=2, grad_impl=pg),
                 L.Conv2d(384, 3, padding="SAME", compute_dtype=dt),
                 L.Relu(),
                 L.Conv2d(384, 3, padding="SAME", compute_dtype=dt),
                 L.Relu(),
                 L.Conv2d(256, 3, padding="SAME", compute_dtype=dt),
                 L.Relu(),
-                L.MaxPool(3, stride=2),
+                L.MaxPool(3, stride=2, grad_impl=pg),
                 L.Flatten(),
                 L.Dense(4096, compute_dtype=dt),
                 L.Relu(),
