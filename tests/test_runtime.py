@@ -128,6 +128,21 @@ def test_single_entry_hostnames_is_single_host(monkeypatch):
     assert mesh_mod._env_says_multihost() is True
 
 
+def test_model_describe():
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.runtime.mesh import make_mesh as mk
+
+    m = Cifar10_model(
+        config=dict(batch_size=4, n_synth_train=64, n_synth_val=32,
+                    grad_accum=2, zero1=True),
+        mesh=mk(),
+    )
+    text = m.describe()
+    assert "Cifar10_model" in text and "dp=8" in text
+    assert "zero1" in text and "grad_accum=2" in text
+    assert f"{m.n_params:,}" in text
+
+
 def test_multihost_env_with_failed_autodetect_hard_fails(monkeypatch):
     """Pod-looking env + no coordinator must raise, not silently train N
     unsynced replicas (the override env var restores the old degrade)."""

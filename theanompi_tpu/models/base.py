@@ -606,6 +606,31 @@ class TpuModel:
         # (checkpoints store full global arrays either way)
         self._place_sharded_state()
 
+    def describe(self) -> str:
+        """One-paragraph model summary (the reference printed per-rank
+        model info at startup; workers print this on rank 0)."""
+        cfg = self.config
+        mesh_desc = ", ".join(
+            f"{a}={int(s)}" for a, s in zip(self.mesh.axis_names, self.mesh.devices.shape)
+        )
+        # effective lr (post schedule + linear scaling), not the raw
+        # config value — this line is what operators copy into reports
+        eff_lr = self.lr_schedule(self.current_epoch) * self._lr_scale
+        zero_on = getattr(self, "_zero", None) is not None  # GAN models
+        # override build_model and never set _zero
+        lines = [
+            f"{type(self).__name__}: {self.n_params:,} params, "
+            f"mesh({mesh_desc}), global_batch={self.global_batch} "
+            f"({self.batch_size}/shard x {self.n_workers})",
+            f"  optimizer={cfg.get('optimizer', 'sgd')} lr={eff_lr:g} "
+            f"exch={cfg.exch_strategy} sync={cfg.sync_mode}"
+            + (" zero1" if zero_on else "")
+            + (f" grad_accum={cfg.grad_accum}" if int(cfg.get('grad_accum', 1) or 1) > 1 else ""),
+        ]
+        if cfg.compute_dtype:
+            lines.append(f"  compute_dtype={cfg.compute_dtype}")
+        return "\n".join(lines)
+
     def cleanup(self) -> None:
         self._train_it = None
         self._val_it = None

@@ -53,6 +53,26 @@ class BSP_Worker:
         self.checkpoint_freq = checkpoint_freq
         self.resume = resume
 
+    def _log_memory(self, rec: Recorder, tag: str) -> None:
+        """Device-memory snapshot as a record event (bytes in use /
+        peak). TPU backends expose ``memory_stats``; CPU/fake-device
+        rigs return None — skip silently, this is observability only."""
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return
+        rec.log_event(
+            "memory",
+            tag=tag,
+            bytes_in_use=int(stats.get("bytes_in_use", 0)),
+            peak_bytes_in_use=int(stats.get("peak_bytes_in_use", 0)),
+            bytes_limit=int(stats.get("bytes_limit", 0)),
+        )
+
     def _probe_comm(self, model, rec: Recorder) -> None:
         """One-shot comm-fraction measurement at train start.
 
@@ -103,6 +123,9 @@ class BSP_Worker:
             # fresh runs only: a crash-restart loop must not re-pay the
             # probe's two extra compiles on every recovery attempt
             self._probe_comm(model, rec)
+        self._log_memory(rec, "train_start")
+        if self.process_index == 0 and hasattr(model, "describe"):
+            print(model.describe(), flush=True)
         count = model.current_epoch * model.data.n_batch_train
         for epoch in range(model.current_epoch, model.n_epochs):
             model.adjust_hyperp(epoch)
@@ -115,6 +138,7 @@ class BSP_Worker:
             if self.val_freq and (epoch + 1) % self.val_freq == 0:
                 model.run_validation(count, rec)
             rec.end_epoch(count, epoch)
+            self._log_memory(rec, f"epoch_{epoch + 1}")
             model.current_epoch = epoch + 1
             if self.checkpoint_dir and self.checkpoint_freq and (
                 (epoch + 1) % self.checkpoint_freq == 0
