@@ -1,0 +1,56 @@
+"""BASELINE.json target-config presets (presets.py + launch --preset)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from theanompi_tpu import presets
+
+
+def test_all_baseline_configs_have_presets():
+    """Every BASELINE.json config row maps to at least one preset."""
+    with open("BASELINE.json") as f:
+        base = json.load(f)
+    assert len(base["configs"]) == 5
+    # 5 rows -> 6 presets (config #3 names two models)
+    assert len(presets.PRESETS) == 6
+    rules = {p["rule"] for p in presets.PRESETS.values()}
+    assert rules == {"BSP", "EASGD", "GOSGD"}
+
+
+def test_unknown_preset_rejected():
+    with pytest.raises(KeyError, match="unknown preset"):
+        presets.get_preset("alexnet-bspp")
+
+
+def test_run_preset_wresnet_smoke():
+    """BASELINE config #1 end-to-end (tiny shapes)."""
+    model = presets.run_preset(
+        "wresnet-smoke",
+        config_overrides=dict(
+            batch_size=8, depth=10, widen_factor=1, n_epochs=1,
+            n_synth_train=64, n_synth_val=32, print_freq=10_000,
+            comm_probe=False,
+        ),
+    )
+    assert model.current_epoch == 1
+    for leaf in __import__("jax").tree.leaves(model.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_launch_preset_flag(tmp_path):
+    """--preset fills rule/model defaults; explicit flags still win."""
+    from theanompi_tpu import launch
+
+    parser_args = [
+        "--preset", "wresnet-smoke",
+        "--config", json.dumps(dict(
+            batch_size=8, depth=10, widen_factor=1, n_epochs=1,
+            n_synth_train=64, n_synth_val=32, print_freq=10_000,
+            comm_probe=False,
+        )),
+        "--checkpoint-dir", str(tmp_path),
+    ]
+    assert launch.main(parser_args) == 0
+    assert any(f.name.startswith("ckpt_") for f in tmp_path.iterdir())

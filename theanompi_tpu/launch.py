@@ -35,10 +35,20 @@ import sys
 
 
 def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="theanompi_tpu.launch", description=__doc__)
+    # allow_abbrev=False: preset resolution compares raw argv flag names
+    # to decide what the user explicitly set — abbreviations would dodge
+    # that comparison and get silently overridden by the preset
+    p = argparse.ArgumentParser(
+        prog="theanompi_tpu.launch", description=__doc__, allow_abbrev=False
+    )
     p.add_argument("--rule", choices=["BSP", "EASGD", "GOSGD"], default="BSP")
     p.add_argument("--modelfile", default="theanompi_tpu.models.cifar10")
     p.add_argument("--modelclass", default="Cifar10_model")
+    p.add_argument(
+        "--preset", default=None,
+        help="a BASELINE.json target config by name (see presets.PRESETS); "
+        "sets rule/model/config defaults, explicit flags still override",
+    )
     p.add_argument("--devices", type=int, default=None, help="device count (default: all)")
     p.add_argument("--config", default="{}", help="model config JSON")
     p.add_argument("--checkpoint-dir", default=None)
@@ -119,7 +129,27 @@ def _async_distributed_main(args) -> int:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    argv_list = list(argv if argv is not None else sys.argv[1:])
+    args = build_parser().parse_args(argv_list)
+
+    if args.preset:
+        from theanompi_tpu.presets import get_preset
+
+        spec = get_preset(args.preset)
+        given = {a.split("=", 1)[0] for a in argv_list if a.startswith("--")}
+        if "--rule" not in given:
+            args.rule = spec["rule"]
+        if "--modelfile" not in given:
+            args.modelfile = spec["modelfile"]
+        if "--modelclass" not in given:
+            args.modelclass = spec["modelclass"]
+        cfg = dict(spec["model_config"])
+        cfg.update(json.loads(args.config))  # explicit JSON wins
+        args.config = json.dumps(cfg)
+        for k, v in spec["rule_kwargs"].items():
+            flag = "--" + k.replace("_", "-")
+            if flag not in given:  # user didn't pass it -> preset wins
+                setattr(args, k, v)
 
     if args.spawn_procs:
         # driver mode: re-exec ourselves N times as a local process group

@@ -1,0 +1,106 @@
+"""Ready-to-run presets for the five BASELINE.json target configs.
+
+The driver's north star names five reference run configurations
+(BASELINE.json ``configs``; BASELINE.md). Each preset is the full
+(rule, modelfile, modelclass, model_config, rule_kwargs) tuple that
+reproduces it through the unchanged rule API or the CLI::
+
+    python -m theanompi_tpu.launch --preset alexnet-bsp
+    # == --rule BSP --modelfile theanompi_tpu.models.alex_net ...
+
+    from theanompi_tpu.presets import run_preset
+    model = run_preset("wresnet-smoke")
+
+Hyperparameters follow the models' per-model defaults (which encode the
+reference lineage — AlexNet/GoogLeNet-era schedules; any deviation is
+documented in the model file). Presets only pin what the BASELINE
+config names: model, rule, exchanger path, worker count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    # BASELINE config #1: "Cifar-10 Wide-ResNet (lasagne_model_zoo),
+    # single-worker BSP — CPU smoke"
+    "wresnet-smoke": dict(
+        rule="BSP",
+        modelfile="theanompi_tpu.models.wresnet",
+        modelclass="WResNet",
+        model_config=dict(n_epochs=2),
+        rule_kwargs=dict(devices=1),
+    ),
+    # BASELINE config #2: "AlexNet ImageNet-128px, 8-worker BSP sync
+    # allreduce" — the benchmark model (bench.py measures this config)
+    "alexnet-bsp": dict(
+        rule="BSP",
+        modelfile="theanompi_tpu.models.alex_net",
+        modelclass="AlexNet",
+        model_config=dict(compute_dtype="bfloat16"),
+        rule_kwargs=dict(devices=8),
+    ),
+    # BASELINE config #3: "GoogLeNet + VGG16 ImageNet, BSP with NCCL32
+    # exchanger path" — the NCCL path maps to in-graph ICI collectives;
+    # both models default to the compressed bf16 wire (see model files)
+    "googlenet-bsp": dict(
+        rule="BSP",
+        modelfile="theanompi_tpu.models.googlenet",
+        modelclass="GoogLeNet",
+        model_config=dict(compute_dtype="bfloat16"),
+        rule_kwargs=dict(devices=8),
+    ),
+    "vgg16-bsp": dict(
+        rule="BSP",
+        modelfile="theanompi_tpu.models.vgg16",
+        modelclass="VGG16",
+        model_config=dict(compute_dtype="bfloat16"),
+        rule_kwargs=dict(devices=8),
+    ),
+    # BASELINE config #4: "ResNet-50 ImageNet, EASGD elastic-averaging
+    # (async param server)"
+    "resnet50-easgd": dict(
+        rule="EASGD",
+        modelfile="theanompi_tpu.models.resnet50",
+        modelclass="ResNet50",
+        model_config=dict(compute_dtype="bfloat16"),
+        rule_kwargs=dict(devices=8, n_workers=2, tau=10, alpha=0.5),
+    ),
+    # BASELINE config #5: "LS-GAN + GOSGD gossip peer-to-peer exchange"
+    "lsgan-gosgd": dict(
+        rule="GOSGD",
+        modelfile="theanompi_tpu.models.lsgan",
+        modelclass="LSGAN",
+        model_config=dict(),
+        rule_kwargs=dict(devices=8, n_workers=2, p_push=0.25),
+    ),
+}
+
+
+def get_preset(name: str) -> Dict[str, Any]:
+    if name not in PRESETS:
+        raise KeyError(
+            f"unknown preset {name!r}; available: {sorted(PRESETS)}"
+        )
+    import copy
+
+    return copy.deepcopy(PRESETS[name])
+
+
+def run_preset(name: str, config_overrides: dict | None = None, **rule_overrides):
+    """Build the rule, run it to completion, return the trained model."""
+    import theanompi_tpu
+
+    spec = get_preset(name)
+    rule = getattr(theanompi_tpu, spec["rule"])()
+    cfg = dict(spec["model_config"])
+    cfg.update(config_overrides or {})
+    kw = dict(spec["rule_kwargs"])
+    kw.update(rule_overrides)
+    rule.init(
+        modelfile=spec["modelfile"],
+        modelclass=spec["modelclass"],
+        model_config=cfg,
+        **kw,
+    )
+    return rule.wait()
