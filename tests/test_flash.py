@@ -138,14 +138,97 @@ def test_flash_with_alltoall_sp():
     np.testing.assert_allclose(run("flash"), run("xla"), rtol=1e-4)
 
 
-def test_flash_ring_combination_rejected():
-    from theanompi_tpu.models.transformer import TransformerLM
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_ring_xla(causal):
+    """Per-ring-step flash blocks + lse merge == the XLA ring, fwd and
+    bwd (bwd routes through the exact XLA ring via custom VJP)."""
+    from functools import partial
 
-    with pytest.raises(ValueError, match="flash"):
-        TransformerLM(
-            config=dict(
-                batch_size=1, seq_len=32, vocab_size=32, d_model=32,
-                n_heads=4, n_layers=1, sp=2, sp_mode="ring",
-                attn_impl="flash",
-            )
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.ring_attention import (
+        SEQ_AXIS, ring_attention,
+    )
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(
+        shape=(4,), axis_names=(SEQ_AXIS,), devices=jax.devices()[:4]
+    )
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), b=2, t=32, h=2, d=8)
+    spec = P(None, SEQ_AXIS, None, None)
+
+    def run(impl, with_grad=False):
+        fn = jax.shard_map(
+            partial(
+                ring_attention, axis_name=SEQ_AXIS, axis_size=4,
+                causal=causal, attn_impl=impl,
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
         )
+        if not with_grad:
+            return jax.jit(fn)(q, k, v)
+        return jax.grad(
+            lambda a, b, c: jnp.sum(jnp.square(fn(a, b, c))), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(run("flash")), np.asarray(run("xla")), atol=2e-5
+    )
+    for a, b in zip(run("flash", True), run("xla", True)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ring_flash_bf16():
+    """bf16 inputs through ring-flash: the merge carry runs fp32 (a
+    bf16 carry broke the scan/cond dtype contract at trace time)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.ring_attention import SEQ_AXIS, ring_attention
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(shape=(2,), axis_names=(SEQ_AXIS,), devices=jax.devices()[:2])
+    q, k, v = _rand_qkv(jax.random.PRNGKey(10), b=1, t=16, h=2, d=8,
+                        dtype=jnp.bfloat16)
+    spec = P(None, SEQ_AXIS, None, None)
+
+    def run(impl, causal):
+        fn = jax.shard_map(
+            partial(ring_attention, axis_name=SEQ_AXIS, axis_size=2,
+                    causal=causal, attn_impl=impl),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(fn)(q, k, v)
+
+    for causal in (False, True):
+        out = run("flash", causal)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(run("xla", causal), np.float32), atol=3e-2,
+        )
+
+
+def test_flash_lm_with_ring_sp():
+    """TransformerLM: ring SP + flash blocks trains identically to
+    ring SP + XLA blocks."""
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.runtime.recorder import Recorder
+
+    cfg = dict(
+        batch_size=1, seq_len=32, vocab_size=32, d_model=32, n_heads=4,
+        n_layers=1, sp=2, sp_mode="ring", n_synth_train=4, n_synth_val=1,
+        print_freq=10_000, weight_decay=0.0, exch_strategy="ar",
+        comm_probe=False, seed=9,
+    )
+
+    def run(impl):
+        m = TransformerLM(config=dict(cfg, attn_impl=impl))
+        m.compile_train()
+        m.reset_train_iter(0)
+        return float(m.train_iter(1, Recorder(verbose=False))[0])
+
+    np.testing.assert_allclose(run("flash"), run("xla"), rtol=1e-4)

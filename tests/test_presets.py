@@ -10,7 +10,10 @@ from theanompi_tpu import presets
 
 def test_all_baseline_configs_have_presets():
     """Every BASELINE.json config row maps to at least one preset."""
-    with open("BASELINE.json") as f:
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "BASELINE.json")
+    with open(path) as f:
         base = json.load(f)
     assert len(base["configs"]) == 5
     # 5 rows -> 6 presets (config #3 names two models)

@@ -50,8 +50,8 @@ class TransformerLM(TpuModel):
         mlp_ratio=4,
         sp=1,  # sequence-parallel degree (mesh sp-axis size)
         sp_mode="ring",  # 'ring' (ppermute K/V ring) | 'alltoall' (Ulysses)
-        attn_impl="xla",  # 'xla' (fused dense) | 'flash' (Pallas kernel;
-        # local dense path + alltoall SP — not the ring body)
+        attn_impl="xla",  # 'xla' (fused dense) | 'flash' (Pallas kernels:
+        # dense path, alltoall local attention, and per-ring-step blocks)
         tp=1,  # tensor-parallel degree (Megatron-style column/row sharding)
         lr=0.1,
         momentum=0.9,

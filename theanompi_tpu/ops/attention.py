@@ -148,13 +148,6 @@ class MultiHeadAttention(Layer):
             raise ValueError(f"sp_mode must be 'ring' or 'alltoall', got {sp_mode!r}")
         if attn_impl not in ("xla", "flash"):
             raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
-        if attn_impl == "flash" and sp_axis is not None and sp_size > 1 and sp_mode == "ring":
-            raise ValueError(
-                "attn_impl='flash' fuses the local dense attention; the "
-                "ring path does its own blockwise accumulation — use "
-                "sp_mode='alltoall' (local dense after the reshuffle) or "
-                "attn_impl='xla' with ring"
-            )
         if tp_size > 1 and n_heads % tp_size:
             raise ValueError(
                 f"tensor parallelism needs n_heads % tp == 0, "

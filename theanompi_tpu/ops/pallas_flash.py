@@ -301,6 +301,18 @@ def _from_rows(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
+def flash_forward_with_lse(q, k, v, causal=False, scale=None):
+    """Forward-only kernel entry returning ``(out, lse)`` with
+    lse shaped (B, H, T). NO AD rule — callers (the ring-flash path)
+    wrap it in their own custom_vjp; differentiating this directly
+    raises at trace time (pallas_call has no autodiff registration).
+    """
+    s = _resolve_scale(scale, q.shape[-1])
+    out, lse = _flash_forward(q, k, v, causal, s)
+    b, h = q.shape[0], q.shape[2]
+    return _from_rows(out, b, h), lse.reshape(b, h, -1)
+
+
 def _resolve_scale(scale, d: int) -> float:
     """THE default-scale policy, resolved once — fwd and bwd must agree."""
     return float(scale) if scale is not None else d ** -0.5

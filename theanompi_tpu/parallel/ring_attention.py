@@ -137,9 +137,9 @@ def ring_attention(
     if axis_size == 1:
         return local_attention(q, k, v, causal, scale, attn_impl)
     if attn_impl == "flash":
-        # the ring body IS a blockwise accumulation; a fused per-block
-        # kernel is future work (needs carry-in/out of m/den/num)
-        raise ValueError("ring attention does not take attn_impl='flash'")
+        return ring_attention_flash(
+            q, k, v, axis_name, axis_size, causal, scale
+        )
 
     b, t, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
@@ -172,6 +172,89 @@ def ring_attention(
     )
     out = num / den[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _merge_blocks(o1, lse1, o2, lse2):
+    """Online-softmax combination of two attention partials.
+
+    o: (B, T, H, D); lse: (B, H, T). Numerically safe for one side
+    being all-masked (lse = -inf ⇒ weight 0)."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    den = w1 + w2
+    c1 = jnp.transpose(w1 / den, (0, 2, 1))[..., None]  # (B, T, H, 1)
+    c2 = jnp.transpose(w2 / den, (0, 2, 1))[..., None]
+    return o1 * c1 + o2 * c2, m + jnp.log(den)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_flash(q, k, v, axis_name, axis_size, causal, scale):
+    """Ring attention whose per-step block attention runs the fused
+    Pallas flash kernel, partials merged by log-sum-exp.
+
+    Causal structure on the ring is block-triangular: the resident
+    (s=0) block is the diagonal (standard causal flash); a rotated-in
+    block from source device ``src`` is either fully visible
+    (``src < my`` — dense flash) or fully masked (skip, no kernel
+    launch). Backward: custom VJP through the exact XLA ring
+    (``attn_impl='xla'`` — same function), recomputing blockwise; the
+    kernels themselves need no AD rule.
+    """
+    from theanompi_tpu.ops.pallas_flash import flash_forward_with_lse
+
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    # s = 0: the diagonal block (own K/V). The merge carry runs fp32
+    # (partials are re-weighted each step; bf16 inputs would also
+    # break the scan/cond carry dtype contract) — cast back at the end.
+    o, lse = flash_forward_with_lse(q, k, v, causal=causal, scale=scale)
+    o = o.astype(jnp.float32)
+
+    def step(carry, s):
+        k_blk, v_blk, o, lse = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (my - s) % axis_size
+
+        def visible(args):
+            o, lse = args
+            o_s, lse_s = flash_forward_with_lse(
+                q, k_blk, v_blk, causal=False, scale=scale
+            )
+            return _merge_blocks(o, lse, o_s.astype(jnp.float32), lse_s)
+
+        if causal:
+            o, lse = lax.cond(src < my, visible, lambda a: a, (o, lse))
+        else:
+            o, lse = visible((o, lse))
+        return (k_blk, v_blk, o, lse), None
+
+    (_, _, o, _), _ = lax.scan(
+        step, (k, v, o, lse), jnp.arange(1, axis_size)
+    )
+    return o.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, scale):
+    out = ring_attention_flash(q, k, v, axis_name, axis_size, causal, scale)
+    return out, (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, axis_size, causal, scale, res, ct):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: ring_attention(
+            a, b, c, axis_name=axis_name, axis_size=axis_size,
+            causal=causal, scale=scale, attn_impl="xla",
+        ),
+        q, k, v,
+    )
+    return vjp(ct)
+
+
+ring_attention_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_self_attention(
