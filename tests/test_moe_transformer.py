@@ -85,10 +85,27 @@ def test_moe_lm_expert_sharding_engaged():
     assert shard.data.shape[0] == w_in.shape[0] // 8
 
 
-def test_moe_lm_rejects_tp_combo():
-    mesh = TransformerLM.build_mesh(config=dict(BASE, tp=2))
-    with pytest.raises(ValueError, match="not compose|2-D expert"):
-        TransformerLM(config=dict(BASE, batch_size=1, tp=2), mesh=mesh)
+def test_moe_lm_2d_expert_sharding_matches_single_device():
+    """MoE × tp: experts shard over dp(=ep) AND each expert's hidden
+    dim Megatron-splits over tp — must track the single-device run."""
+    cfg = dict(BASE, moe_experts=4, tp=2)
+    mesh = TransformerLM.build_mesh(config=cfg)  # (dp=4, sp=1, tp=2)
+    losses_2d = _run(mesh, bs=2, n_steps=3, moe_experts=4, tp=2)
+    losses_1 = _run(
+        make_mesh(devices=jax.devices()[:1]), bs=8, n_steps=3, moe_experts=4
+    )
+    np.testing.assert_allclose(losses_2d, losses_1, rtol=2e-4)
+
+
+def test_moe_lm_2d_expert_leaves_are_sharded_both_ways():
+    cfg = dict(BASE, moe_experts=4, tp=2, batch_size=2)
+    mesh = TransformerLM.build_mesh(config=cfg)
+    model = TransformerLM(config=cfg, mesh=mesh)
+    model.compile_train()
+    w_in = model.params[2]["moe"]["w_in"]  # (E, d, h)
+    shard = next(iter(w_in.addressable_shards))
+    assert shard.data.shape[0] == w_in.shape[0] // 4  # experts / dp
+    assert shard.data.shape[2] == w_in.shape[2] // 2  # hidden / tp
 
 
 def test_moe_lm_rejects_indivisible_experts():

@@ -189,11 +189,6 @@ class TransformerLM(TpuModel):
                     f"tp={self.tp_size}, sp={self.sp_size}"
                 )
         n_experts = int(cfg.moe_experts)
-        if n_experts and self.tp_size > 1:
-            raise ValueError(
-                "moe_experts does not compose with tp>1 "
-                "(2-D expert sharding unsupported)"
-            )
         dp = int(self.mesh.shape[DATA_AXIS])
         if n_experts and n_experts % max(dp, 1):
             raise ValueError(
@@ -214,6 +209,8 @@ class TransformerLM(TpuModel):
                 ep_axis=DATA_AXIS if dp > 1 else None,
                 ep_size=dp,
                 compute_dtype=dt,
+                tp_axis=tp_axis,  # 2-D expert sharding when tp > 1
+                tp_size=self.tp_size,
             )
 
         wrap = L.Remat if bool(cfg.remat) else (lambda b: b)
@@ -254,33 +251,35 @@ class TransformerLM(TpuModel):
         col = P(None, TP_AXIS)  # output-dim sharded: wq/wk/wv, mlp_in.w
         row = P(TP_AXIS, None)  # input-dim sharded: wo, mlp_out.w
         rep = P()
+        tp_on = self.tp_size > 1
+        dp = int(self.mesh.shape[DATA_AXIS])
         specs = []
         for layer, layer_params in zip(self.net.layers, self.params):
             if isinstance(layer, L.Remat):
                 layer = layer.inner  # spec by the wrapped block
             if not isinstance(layer, A.TransformerBlock):
                 specs.append(jax.tree.map(lambda _: rep, layer_params))
-            elif layer.moe is not None:
+                continue
+            block = {
+                "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
+                "attn": (
+                    {"wq": col, "wk": col, "wv": col, "wo": row}
+                    if tp_on
+                    else jax.tree.map(lambda _: rep, layer_params["attn"])
+                ),
+                "ln2": jax.tree.map(lambda _: rep, layer_params["ln2"]),
+            }
+            if layer.moe is not None:
                 from theanompi_tpu.parallel.moe import MoeMlp
 
-                specs.append(
-                    {
-                        "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
-                        "attn": jax.tree.map(lambda _: rep, layer_params["attn"]),
-                        "ln2": jax.tree.map(lambda _: rep, layer_params["ln2"]),
-                        "moe": MoeMlp.param_specs(DATA_AXIS),
-                    }
+                block["moe"] = MoeMlp.param_specs(
+                    DATA_AXIS if dp > 1 else None,
+                    TP_AXIS if tp_on else None,
                 )
             else:
-                specs.append(
-                    {
-                        "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
-                        "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
-                        "ln2": jax.tree.map(lambda _: rep, layer_params["ln2"]),
-                        "mlp_in": {"w": col, "b": P(TP_AXIS)},
-                        "mlp_out": {"w": row, "b": rep},
-                    }
-                )
+                block["mlp_in"] = {"w": col, "b": P(TP_AXIS)}
+                block["mlp_out"] = {"w": row, "b": rep}
+            specs.append(block)
         return specs
 
     def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):

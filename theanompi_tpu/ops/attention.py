@@ -240,9 +240,9 @@ class TransformerBlock(Layer):
     ``parallel.moe.MoeMlp``) to make this a mixture-of-experts block —
     tokens flatten to ``(b·t, d)`` for routing and the expert weights
     shard over the MoE layer's ``ep_axis`` (GShard-style, the model
-    reuses its data axis). MoE composes with sequence parallelism but
-    not (yet) with tensor parallelism — 2-D expert sharding is out of
-    scope, callers must reject the combination.
+    reuses its data axis). Composes with sequence parallelism and,
+    via 2-D expert sharding (the MoE's ``tp_axis``: every expert's
+    hidden dim Megatron-split), with tensor parallelism.
     """
 
     def __init__(
@@ -259,11 +259,6 @@ class TransformerBlock(Layer):
         moe=None,
         attn_impl: str = "xla",
     ):
-        if moe is not None and tp_size > 1:
-            raise ValueError(
-                "MoE blocks do not compose with tensor parallelism "
-                "(2-D expert sharding unsupported)"
-            )
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
         self.attn = MultiHeadAttention(

@@ -69,12 +69,18 @@ class MoeMlp(Layer):
         ep_axis: Optional[str] = EP_AXIS,
         ep_size: int = 1,
         compute_dtype=None,
+        tp_axis: Optional[str] = None,
+        tp_size: int = 1,
     ):
         if top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {top_k}")
         if n_experts % max(ep_size, 1):
             raise ValueError(
                 f"n_experts={n_experts} not divisible by ep={ep_size}"
+            )
+        if tp_size > 1 and d_hidden % tp_size:
+            raise ValueError(
+                f"d_hidden={d_hidden} not divisible by tp={tp_size}"
             )
         self.n_experts = n_experts
         self.d_hidden = d_hidden
@@ -85,6 +91,10 @@ class MoeMlp(Layer):
         # expert matmul dtype (routing softmax stays fp32 regardless):
         # bf16 here matches the dense-MLP path's MXU behavior
         self.compute_dtype = compute_dtype
+        # 2-D expert sharding: hidden dim of every expert Megatron-split
+        # over tp (w_in column-parallel, w_out row-parallel, f/g pair)
+        self.tp_axis = tp_axis if tp_size > 1 else None
+        self.tp_size = tp_size if tp_size > 1 else 1
 
     def init(self, key, in_shape):
         (d,) = in_shape
@@ -166,6 +176,37 @@ class MoeMlp(Layer):
                 preferred_element_type=jnp.float32,
             )
 
+        def expert_ffn(xe, sub_in, sub_out):
+            """Per-expert FFN on dispatched tokens ``xe`` (…, e, C, d).
+
+            tp (2-D expert sharding): w_in column-parallel over the
+            hidden dim, w_out row-parallel, the Megatron f/g pair
+            completing cotangents/partials — each (ep, tp) device holds
+            an (E/ep, d, h/tp) slice of every weight."""
+            gs = 1.0 / self.ep_size  # see _grad_scale: batch shards on ep
+            scale_w = (
+                (lambda w: _grad_scale(w, gs)) if self.ep_axis else (lambda w: w)
+            )
+            w_in = scale_w(params["w_in"])
+            b_in = scale_w(params["b_in"])
+            w_out = scale_w(params["w_out"])
+            b_out = scale_w(params["b_out"])
+            if self.tp_axis is not None:
+                from theanompi_tpu.parallel.tensor import copy_to_tp
+
+                xe = copy_to_tp(xe, self.tp_axis)  # f: bwd psums over tp
+            hmid = jax.nn.relu(
+                mm(sub_in, xe, w_in) + jnp.expand_dims(b_in, -2)
+            ).astype(cd)
+            ye = mm(sub_out, hmid, w_out)
+            if self.tp_axis is not None:
+                from theanompi_tpu.parallel.tensor import reduce_from_tp
+
+                ye = reduce_from_tp(ye, self.tp_axis)  # g: fwd psum
+            # narrow AFTER the fp32 bias-add — any return all-to-all then
+            # moves cd-width activations, same bytes as the dispatch leg
+            return (ye + jnp.expand_dims(b_out, -2)).astype(cd)
+
         xe = mm("nec,nd->ecd", disp, x).astype(cd)
         if self.ep_axis is not None:
             ep = self.ep_size
@@ -173,30 +214,11 @@ class MoeMlp(Layer):
             xe = xe.reshape(ep, e_local, C, d)
             # device j receives every source's chunk for ITS experts
             xe = lax.all_to_all(xe, self.ep_axis, 0, 0)  # (src, e_local, C, d)
-            s = 1.0 / ep  # see _grad_scale: batch shards over ep
-            w_in = _grad_scale(params["w_in"], s)  # local (e_local, d, h)
-            b_in = _grad_scale(params["b_in"], s)
-            w_out = _grad_scale(params["w_out"], s)
-            b_out = _grad_scale(params["b_out"], s)
-            hmid = jax.nn.relu(
-                mm("secd,edh->sech", xe, w_in) + b_in[None, :, None, :]
-            ).astype(cd)
-            # narrow AFTER the fp32 bias-add — the return all-to-all then
-            # moves cd-width activations, same bytes as the dispatch leg
-            ye = (
-                mm("sech,ehd->secd", hmid, w_out) + b_out[None, :, None, :]
-            ).astype(cd)
+            ye = expert_ffn(xe, "secd,edh->sech", "sech,ehd->secd")
             ye = lax.all_to_all(ye, self.ep_axis, 0, 0)  # back to sources
             ye = ye.reshape(E, C, d)
         else:
-            hmid = jax.nn.relu(
-                mm("ecd,edh->ech", xe, params["w_in"])
-                + params["b_in"][:, None, :]
-            ).astype(cd)
-            ye = (
-                mm("ech,ehd->ecd", hmid, params["w_out"])
-                + params["b_out"][:, None, :]
-            )
+            ye = expert_ffn(xe, "ecd,edh->ech", "ech,ehd->ecd")
         # ---- combine: gate-weighted gather back to token order ----
         # fp32 accumulation: a token's output is a 1-of-C·E selection
         y = jnp.einsum(
@@ -206,15 +228,26 @@ class MoeMlp(Layer):
         return y.astype(x.dtype), {"aux_loss": aux}
 
     @staticmethod
-    def param_specs(axis):
+    def param_specs(axis, tp_axis=None):
         """PartitionSpec dict matching ``init``'s param keys: expert
-        leaves shard their leading expert dim over ``axis``, the gate is
-        replicated. The ONE place the key set lives — models and tests
-        build their spec trees from this."""
+        leaves shard their leading expert dim over ``axis``; with
+        ``tp_axis``, each expert's hidden dim additionally shards
+        Megatron-style (w_in column, w_out row; b_out replicated over
+        tp — it is added after the tp reduce). The gate is replicated.
+        The ONE place the key set lives — models and tests build their
+        spec trees from this."""
         from jax.sharding import PartitionSpec as P
 
-        e = P(axis)
-        return {"wg": P(), "w_in": e, "b_in": e, "w_out": e, "b_out": e}
+        if tp_axis is None:
+            e = P(axis)
+            return {"wg": P(), "w_in": e, "b_in": e, "w_out": e, "b_out": e}
+        return {
+            "wg": P(),
+            "w_in": P(axis, None, tp_axis),  # (E, d, h): column-parallel
+            "b_in": P(axis, tp_axis),  # (E, h)
+            "w_out": P(axis, tp_axis, None),  # (E, h, d): row-parallel
+            "b_out": P(axis),  # (E, d): added post-reduce, tp-replicated
+        }
 
     @staticmethod
     def add_aux_loss(loss, state_tree, coef, train: bool):
