@@ -231,14 +231,35 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref,
 
 def _flash_backward(causal, scale, res, ct):
     qr, kr, vr, out, lse = res  # all (B*H, T, D) / (B*H, T)
-    bh, t, d = qr.shape
-    bq = _pick_block(t, BLOCK_Q)
-    bk = _pick_block(t, BLOCK_K)
     do = ct  # (B*H, T, D) fp32-or-input-dtype cotangent
     # Δ_i = Σ_d dout·out — XLA elementwise, prefetched per tile
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # (B*H, T)
+    return flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale)
+
+
+def flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale):
+    """FA-2 backward kernels on row-layout operands with a precomputed
+    Δ — the entry the ring backward drives per block, so that the
+    loop-invariant pieces (Q/dO transposes, lse reshape, Δ) are
+    computed ONCE outside the ring scan instead of per hop.
+
+    The enabler for the ring backward: because the FA-2 recomputation
+    normalizes probabilities by ``p = exp(s − lse)``, feeding the
+    *global* (all-ring-steps) lse makes each (Q-shard, KV-block) pair's
+    ``dq += ds·K``, ``dk += dsᵀ·Q``, ``dv += pᵀ·dO`` exact additive
+    partials of the full-sequence gradient — no re-weighting or second
+    online pass needed. ``delta`` must come from the global output
+    (Δ = rowsum(dO·O) is only meaningful globally).
+
+    qr/kr/vr/do (B·H, T, D) with equal Tq == Tk; lse/delta (B·H, T);
+    ``scale`` must already be resolved (a float). Returns (dq, dk, dv)
+    in rows layout.
+    """
+    bh, t, d = qr.shape
+    bq = _pick_block(t, BLOCK_Q)
+    bk = _pick_block(t, BLOCK_K)
 
     row = lambda bhi, i: (bhi, 0, 0)  # noqa: E731 — whole-row spec
     rowv = lambda bhi, i: (bhi, 0)  # noqa: E731
@@ -291,12 +312,12 @@ def _flash_backward(causal, scale, res, ct):
 # public entry (custom VJP over the kernels)
 # ---------------------------------------------------------------------------
 
-def _to_rows(x):
+def to_rows(x):
     b, t, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
 
-def _from_rows(x, b, h):
+def from_rows(x, b, h):
     bh, t, d = x.shape
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
@@ -307,13 +328,13 @@ def flash_forward_with_lse(q, k, v, causal=False, scale=None):
     wrap it in their own custom_vjp; differentiating this directly
     raises at trace time (pallas_call has no autodiff registration).
     """
-    s = _resolve_scale(scale, q.shape[-1])
+    s = resolve_scale(scale, q.shape[-1])
     out, lse = _flash_forward(q, k, v, causal, s)
     b, h = q.shape[0], q.shape[2]
-    return _from_rows(out, b, h), lse.reshape(b, h, -1)
+    return from_rows(out, b, h), lse.reshape(b, h, -1)
 
 
-def _resolve_scale(scale, d: int) -> float:
+def resolve_scale(scale, d: int) -> float:
     """THE default-scale policy, resolved once — fwd and bwd must agree."""
     return float(scale) if scale is not None else d ** -0.5
 
@@ -328,22 +349,22 @@ def flash_attention(
 ):
     """softmax(QKᵀ·scale)V, fused fwd+bwd. Shapes (B, T, H, D) like
     ``full_attention``; same numerics (fp32 statistics) by test."""
-    out, _ = _flash_forward(q, k, v, causal, _resolve_scale(scale, q.shape[-1]))
-    return _from_rows(out, q.shape[0], q.shape[2])
+    out, _ = _flash_forward(q, k, v, causal, resolve_scale(scale, q.shape[-1]))
+    return from_rows(out, q.shape[0], q.shape[2])
 
 
 def _vjp_fwd(q, k, v, causal, scale):
-    s = _resolve_scale(scale, q.shape[-1])
+    s = resolve_scale(scale, q.shape[-1])
     out, lse = _flash_forward(q, k, v, causal, s)
     b, h = q.shape[0], q.shape[2]
-    res = (_to_rows(q), _to_rows(k), _to_rows(v), out, lse, b, h, s)
-    return _from_rows(out, b, h), res
+    res = (to_rows(q), to_rows(k), to_rows(v), out, lse, b, h, s)
+    return from_rows(out, b, h), res
 
 
 def _vjp_bwd(causal, scale, res, ct):
     qr, kr, vr, out, lse, b, h, s = res  # s: the scale the fwd ran with
-    dq, dk, dv = _flash_backward(causal, s, (qr, kr, vr, out, lse), _to_rows(ct))
-    return _from_rows(dq, b, h), _from_rows(dk, b, h), _from_rows(dv, b, h)
+    dq, dk, dv = _flash_backward(causal, s, (qr, kr, vr, out, lse), to_rows(ct))
+    return from_rows(dq, b, h), from_rows(dk, b, h), from_rows(dv, b, h)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -188,19 +188,10 @@ def _merge_blocks(o1, lse1, o2, lse2):
     return o1 * c1 + o2 * c2, m + jnp.log(den)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def ring_attention_flash(q, k, v, axis_name, axis_size, causal, scale):
-    """Ring attention whose per-step block attention runs the fused
-    Pallas flash kernel, partials merged by log-sum-exp.
-
-    Causal structure on the ring is block-triangular: the resident
-    (s=0) block is the diagonal (standard causal flash); a rotated-in
-    block from source device ``src`` is either fully visible
-    (``src < my`` — dense flash) or fully masked (skip, no kernel
-    launch). Backward: custom VJP through the exact XLA ring
-    (``attn_impl='xla'`` — same function), recomputing blockwise; the
-    kernels themselves need no AD rule.
-    """
+def _ring_flash_forward_impl(q, k, v, axis_name, axis_size, causal, scale):
+    """The flash ring forward, returning ``(out, lse)`` — lse is the
+    GLOBAL log-sum-exp over every ring step, the residual that makes
+    the blockwise FA-2 backward exact (see ``_ring_flash_bwd``)."""
     from theanompi_tpu.ops.pallas_flash import flash_forward_with_lse
 
     my = lax.axis_index(axis_name)
@@ -231,27 +222,132 @@ def ring_attention_flash(q, k, v, axis_name, axis_size, causal, scale):
             o, lse = visible((o, lse))
         return (k_blk, v_blk, o, lse), None
 
-    (_, _, o, _), _ = lax.scan(
+    (_, _, o, lse), _ = lax.scan(
         step, (k, v, o, lse), jnp.arange(1, axis_size)
     )
-    return o.astype(q.dtype)
+    return o.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_flash(q, k, v, axis_name, axis_size, causal, scale):
+    """Ring attention whose per-step block attention runs the fused
+    Pallas flash kernel, partials merged by log-sum-exp.
+
+    Causal structure on the ring is block-triangular: the resident
+    (s=0) block is the diagonal (standard causal flash); a rotated-in
+    block from source device ``src`` is either fully visible
+    (``src < my`` — dense flash) or fully masked (skip, no kernel
+    launch). Backward: blockwise FA-2 ring (same block-triangular
+    skips) — the global lse saved from the forward makes every
+    per-block kernel contribution an exact additive partial, and dk/dv
+    accumulators travel the ring *with* their K/V block, arriving home
+    after the final hop.
+    """
+    return _ring_flash_forward_impl(
+        q, k, v, axis_name, axis_size, causal, scale
+    )[0]
 
 
 def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, scale):
-    out = ring_attention_flash(q, k, v, axis_name, axis_size, causal, scale)
-    return out, (q, k, v)
+    out, lse = _ring_flash_forward_impl(
+        q, k, v, axis_name, axis_size, causal, scale
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(axis_name, axis_size, causal, scale, res, ct):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: ring_attention(
-            a, b, c, axis_name=axis_name, axis_size=axis_size,
-            causal=causal, scale=scale, attn_impl="xla",
-        ),
-        q, k, v,
+    """FA-2 backward on the ring — no O(T²) rematerialization, no
+    second forward. Each ring step feeds the resident K/V block plus
+    the global lse to the blockwise flash backward kernels:
+
+    - dq accumulates locally on the query owner (every visible block
+      contributes ``ds·K``).
+    - dk/dv partials are accumulated into carries that ``ppermute``
+      around the ring in lockstep with their K/V block; after the ring
+      closes (axis_size hops total) each block's gradient lands back
+      on the device that owns it.
+
+    Causality mirrors the forward exactly: the s=0 diagonal block runs
+    the causal kernels; rotated-in blocks run dense kernels when
+    ``src < my`` and are skipped (carry passthrough, no kernel launch)
+    when fully masked.
+
+    The whole ring runs in the kernels' row layout (B·H, T, D): the
+    loop-invariant operands (Q, dO, lse, Δ) are converted/computed once
+    up front, the traveling K/V blocks and their accumulators rotate in
+    row layout, and only the three outputs convert back at the end.
+    """
+    from theanompi_tpu.ops.pallas_flash import (
+        flash_backward_rows, from_rows, resolve_scale, to_rows,
     )
-    return vjp(ct)
+
+    q, k, v, o, lse = res
+    b, h = q.shape[0], q.shape[2]
+    s_resolved = resolve_scale(scale, q.shape[-1])
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    qr = to_rows(q)
+    kr = to_rows(k)
+    vr = to_rows(v)
+    dor = to_rows(ct)
+    lser = lse.reshape(b * h, -1)
+    # Δ = rowsum(dO·O) over the GLOBAL output — loop-invariant
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * to_rows(o).astype(jnp.float32), axis=-1
+    )
+
+    def block_bwd(k_rows, v_rows, blk_causal):
+        return flash_backward_rows(
+            qr, k_rows, v_rows, dor, lser, delta, blk_causal, s_resolved
+        )
+
+    # s = 0: the diagonal block. Accumulators run fp32 — dk/dv partials
+    # are summed across up to axis_size devices' contributions.
+    dq0, dk0, dv0 = block_bwd(kr, vr, causal)
+    dq0 = dq0.astype(jnp.float32)
+    dk0 = dk0.astype(jnp.float32)
+    dv0 = dv0.astype(jnp.float32)
+
+    def step(carry, s):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        # rotate the K/V block and ITS gradient accumulators together —
+        # the pairing is what routes each block's dk/dv home
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+        src = (my - s) % axis_size
+
+        def visible(args):
+            dk_blk, dv_blk, dq = args
+            dq_c, dk_c, dv_c = block_bwd(k_blk, v_blk, False)
+            return (
+                dk_blk + dk_c.astype(jnp.float32),
+                dv_blk + dv_c.astype(jnp.float32),
+                dq + dq_c.astype(jnp.float32),
+            )
+
+        if causal:
+            dk_blk, dv_blk, dq = lax.cond(
+                src < my, visible, lambda a: a, (dk_blk, dv_blk, dq)
+            )
+        else:
+            dk_blk, dv_blk, dq = visible((dk_blk, dv_blk, dq))
+        return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+
+    (_, _, dk_blk, dv_blk, dq), _ = lax.scan(
+        step, (kr, vr, dk0, dv0, dq0), jnp.arange(1, axis_size)
+    )
+    # the scan made axis_size−1 hops; one more closes the ring and
+    # returns each block's accumulated gradient to its owner
+    dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+    dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+    return (
+        from_rows(dq, b, h).astype(q.dtype),
+        from_rows(dk_blk, b, h).astype(k.dtype),
+        from_rows(dv_blk, b, h).astype(v.dtype),
+    )
 
 
 ring_attention_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
