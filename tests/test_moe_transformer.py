@@ -108,6 +108,22 @@ def test_moe_lm_2d_expert_leaves_are_sharded_both_ways():
     assert shard.data.shape[2] == w_in.shape[2] // 2  # hidden / tp
 
 
+@pytest.mark.parametrize("sp_mode", ["ring", "alltoall"])
+def test_moe_lm_triple_dp_sp_tp(sp_mode):
+    """The full triple: experts over ep(≡dp) × hidden over tp × sequence
+    over sp — the composition README advertises. Exactness vs a
+    single-device run pins the interaction of sp-sharded token counts
+    with per-tp-rank routing/capacity and the ep all_to_all subgroups."""
+    cfg = dict(BASE, moe_experts=2, tp=2, sp=2, sp_mode=sp_mode)
+    mesh = TransformerLM.build_mesh(config=cfg)  # (dp=2, sp=2, tp=2)
+    losses_3d = _run(mesh, bs=4, n_steps=3, moe_experts=2, tp=2, sp=2,
+                     sp_mode=sp_mode)
+    losses_1 = _run(
+        make_mesh(devices=jax.devices()[:1]), bs=8, n_steps=3, moe_experts=2
+    )
+    np.testing.assert_allclose(losses_3d, losses_1, rtol=2e-4)
+
+
 def test_moe_lm_rejects_indivisible_experts():
     with pytest.raises(ValueError, match="must divide"):
         TransformerLM(
