@@ -80,7 +80,9 @@ def _int8_mean(mesh, g_global, strategy="int8"):
     return np.asarray(fn(g_global))
 
 
-@pytest.mark.parametrize("strategy", ["int8", "pallas_int8", "int8_sr"])
+@pytest.mark.parametrize(
+    "strategy", ["int8", "pallas_int8", "int8_sr", "pallas_int8_sr"]
+)
 def test_int8_reduce_matches_true_mean(strategy):
     mesh = make_mesh()
     n_dev = 8
@@ -115,6 +117,49 @@ def test_stochastic_rounding_is_unbiased():
     assert sr_err < 0.05  # SR average converges to the true value
 
 
+def test_pallas_sr_kernel_rounds_within_one_quantum():
+    """Every SR output must be floor(y) or ceil(y) of the scaled value —
+    dequantization error strictly under one quantum per element."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, Q.BLOCK).astype(np.float32) * 2.0
+    q, s = Q.pallas_quantize_blocks(x, jax.random.PRNGKey(0))
+    assert np.asarray(q).dtype == np.int8
+    back = np.asarray(Q.pallas_dequantize_blocks(q, s))
+    quantum = np.asarray(s)[:, None] + 1e-7
+    assert (np.abs(back - x) < quantum).all()
+
+
+def test_pallas_sr_kernel_deterministic_per_key():
+    rng = np.random.RandomState(4)
+    x = rng.randn(32, Q.BLOCK).astype(np.float32)
+    q0a, _ = Q.pallas_quantize_blocks(x, jax.random.PRNGKey(0))
+    q0b, _ = Q.pallas_quantize_blocks(x, jax.random.PRNGKey(0))
+    q1, _ = Q.pallas_quantize_blocks(x, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(q0a), np.asarray(q0b))
+    assert (np.asarray(q0a) != np.asarray(q1)).any()
+
+
+def test_pallas_sr_kernel_is_unbiased():
+    """Mean over many keys converges to the input where round-to-nearest
+    is stuck at its bias — same acceptance as the XLA SR path."""
+    x = np.full((32, Q.BLOCK), 0.30, np.float32)
+    x[:, 0] = 127.0  # pins scale=1.0 -> .30 sits between int steps
+    acc = np.zeros_like(x)
+    n = 400
+    fn = jax.jit(Q.pallas_quantize_blocks)
+    for i in range(n):
+        q, s = fn(x, jax.random.PRNGKey(i))
+        acc += np.asarray(Q.pallas_dequantize_blocks(q, s))
+    sr_err = abs(acc[:, 1:].mean() / n - 0.30)
+    q_det, s_det = Q.pallas_quantize_blocks(x)
+    det_err = abs(
+        float(np.asarray(Q.pallas_dequantize_blocks(q_det, s_det))[:, 1:].mean())
+        - 0.30
+    )
+    assert det_err > 0.25  # nearest rounds 0.30 -> 0: bias ~0.30
+    assert sr_err < 0.02  # SR average converges to the true value
+
+
 def test_int8_sr_requires_rng():
     mesh = make_mesh()
     ex = BSP_Exchanger(strategy="int8_sr", axis=DATA_AXIS, mesh=mesh)
@@ -130,7 +175,9 @@ def test_int8_sr_requires_rng():
         jax.jit(fn)(jnp.ones((8, 8 * Q.BLOCK), jnp.float32))
 
 
-@pytest.mark.parametrize("strategy", ["int8", "pallas_int8", "int8_sr"])
+@pytest.mark.parametrize(
+    "strategy", ["int8", "pallas_int8", "int8_sr", "pallas_int8_sr"]
+)
 def test_int8_training_tracks_ar(strategy):
     def run(strat):
         model = Cifar10_model(

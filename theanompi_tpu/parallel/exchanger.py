@@ -26,11 +26,13 @@ applied inside the jitted, shard_mapped train step:
                 fp32 shard summation — ~4× fewer wire bytes than ``ar``
                 (the reference's fp16 kernels managed 2×). The pallas
                 variant runs the pack/unpack as TPU kernels.
-- ``int8_sr`` — the int8 wire with **stochastic rounding** on both
-                quantization legs (unbiased: rounding error averages out
-                across steps instead of accumulating). Needs the
-                per-step rng that compile_train threads through
-                ``reduce_grads(..., rng=...)``.
+- ``int8_sr`` / ``pallas_int8_sr`` — the int8 wire with **stochastic
+                rounding** on both quantization legs (unbiased: rounding
+                error averages out across steps instead of
+                accumulating). Needs the per-step rng that compile_train
+                threads through ``reduce_grads(..., rng=...)``. The
+                pallas variant derives its dither from an in-kernel
+                counter hash, so no U[0,1) tensor ever crosses HBM.
 
 Because the exchange executes inside the step function, XLA overlaps it
 with backprop where the schedule allows — the fusion the reference could
@@ -57,8 +59,9 @@ from theanompi_tpu.runtime.mesh import DATA_AXIS
 Pytree = Any
 
 STRATEGIES = ("ar", "bf16", "fp16", "pallas_bf16", "int8", "pallas_int8",
-              "int8_sr")
-_INT8_STRATEGIES = ("int8", "pallas_int8", "int8_sr")
+              "int8_sr", "pallas_int8_sr")
+_INT8_STRATEGIES = ("int8", "pallas_int8", "int8_sr", "pallas_int8_sr")
+_SR_STRATEGIES = ("int8_sr", "pallas_int8_sr")
 
 
 def spec_axis_names(spec) -> tuple:
@@ -152,19 +155,16 @@ class BSP_Exchanger:
         world = int(self._axis_sizes[axis])
         if world == 1:
             return g
-        pallas = self.strategy == "pallas_int8"
+        pallas = self.strategy in ("pallas_int8", "pallas_int8_sr")
         k1 = k2 = None
-        if self.strategy == "int8_sr":
+        if self.strategy in _SR_STRATEGIES:
             if rng is None:
                 raise ValueError(
-                    "strategy 'int8_sr' needs per-step randomness: call "
-                    "reduce_grads(grads, specs, rng=key)"
+                    f"strategy '{self.strategy}' needs per-step randomness: "
+                    "call reduce_grads(grads, specs, rng=key)"
                 )
             k1, k2 = jax.random.split(rng)  # one per quantization leg
-        if pallas:
-            quant = lambda x, key=None: Q.pallas_quantize_blocks(x)  # noqa: E731
-        else:
-            quant = Q.quantize_blocks
+        quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
         dequant = Q.pallas_dequantize_blocks if pallas else Q.dequantize_blocks
 
         orig_dtype = g.dtype

@@ -15,7 +15,10 @@ Two equivalent implementations:
 - :func:`pallas_quantize_blocks` / :func:`pallas_dequantize_blocks` —
   explicit Pallas TPU kernels (interpret-mode on CPU), the native-tier
   seam.  Tiles are (32, lanes) so the int8 operand respects the TPU's
-  (32, 128) int8 tiling (pallas_guide.md).
+  (32, 128) int8 tiling (pallas_guide.md).  Passing a ``key`` selects
+  the stochastic-rounding kernel, whose U[0,1) dither is a counter hash
+  computed in VMEM — the XLA SR path materializes a payload-sized
+  random tensor as a fusion input; the kernel never touches HBM for it.
 
 The exchange algebra lives in ``exchanger.BSP_Exchanger`` (strategies
 ``int8`` / ``pallas_int8``): quantize → all_to_all (int8 shards + fp32
@@ -77,34 +80,92 @@ def _quant_kernel(x_ref, q_ref, s_ref):
     s_ref[...] = s.astype(jnp.float32)
 
 
+def _hash_uniform(counter: jnp.ndarray) -> jnp.ndarray:
+    """Counter-based U[0,1) from a uint32 lattice — lowmc-style integer
+    avalanche (xor-shift/multiply mix), all VPU 32-bit int ops so it
+    runs identically under Mosaic and interpret mode. Statistical grade
+    is plenty for rounding dither; this is NOT a crypto or jax.random
+    replacement."""
+    x = counter
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    # top 24 bits → exactly representable fp32 in [0, 1)
+    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _quant_sr_kernel(x_ref, seed_ref, q_ref, s_ref):
+    """Stochastic-rounding variant: ``floor(y + u)`` with per-element
+    dither derived in-kernel from (seed, global element index) — no
+    random tensor ever crosses HBM, unlike the XLA path where the
+    U[0,1) array is a full payload-sized input to the fusion."""
+    i = pl.program_id(0)
+    x = x_ref[...]
+    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    safe = jnp.where(s > 0, s, 1.0)
+    y = x / safe
+    row = jax.lax.broadcasted_iota(jnp.uint32, (_ROWS, _LANES), 0)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (_ROWS, _LANES), 1)
+    idx = (jnp.uint32(i * _ROWS) + row) * jnp.uint32(_LANES) + lane
+    # Weyl step decorrelates the seed from the lattice before the mix
+    u = _hash_uniform(idx * jnp.uint32(0x9E3779B9) + seed_ref[0, 0])
+    q = jnp.floor(y + u)
+    q_ref[...] = jnp.clip(q, -127, 127).astype(jnp.int8)
+    s_ref[...] = s.astype(jnp.float32)
+
+
 def _dequant_kernel(q_ref, s_ref, o_ref):
     o_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[...]
 
 
-def pallas_quantize_blocks(x: jnp.ndarray):
-    """Same contract as :func:`quantize_blocks`, for (…, BLOCK) inputs
-    whose leading dims multiply to a multiple of 32 (the exchanger pads
-    to this)."""
+def pallas_quantize_blocks(x: jnp.ndarray, key=None):
+    """Same contract as :func:`quantize_blocks` (``key`` selects the
+    stochastic-rounding kernel), for (…, BLOCK) inputs whose leading
+    dims multiply to a multiple of 32 (the exchanger pads to this).
+
+    SR dither comes from an in-kernel counter hash seeded by ``key``
+    (not the jax.random bit stream), so outputs are deterministic per
+    key but NOT bit-identical to ``quantize_blocks(x, key)`` — both are
+    valid unbiased rounding dither."""
     lead = x.shape[:-1]
     rows = 1
     for d in lead:
         rows *= d
     x2 = x.reshape(rows, BLOCK)
     grid = rows // _ROWS
-    q2, s2 = pl.pallas_call(
-        _quant_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((rows, BLOCK), jnp.int8),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-        ),
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0))],
-        out_specs=(
-            pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
-            pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
-        ),
-        interpret=(jax.default_backend() == "cpu"),
-    )(x2)
+    out_shape = (
+        jax.ShapeDtypeStruct((rows, BLOCK), jnp.int8),
+        jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+    )
+    out_specs = (
+        pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
+        pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
+    )
+    interpret = jax.default_backend() == "cpu"
+    if key is None:
+        q2, s2 = pl.pallas_call(
+            _quant_kernel,
+            out_shape=out_shape,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0))],
+            out_specs=out_specs,
+            interpret=interpret,
+        )(x2)
+    else:
+        seed = jax.random.bits(key, (1, 1), jnp.uint32)
+        q2, s2 = pl.pallas_call(
+            _quant_sr_kernel,
+            out_shape=out_shape,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
+                pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            ],
+            out_specs=out_specs,
+            interpret=interpret,
+        )(x2, seed)
     return q2.reshape(*lead, BLOCK), s2.reshape(lead)
 
 
