@@ -2,26 +2,41 @@
 //
 // Reference analog: Theano-MPI's "parallel loading" subsystem (upstream
 // proc_load_mpi.py + hickle/HDF5 C stack; SURVEY.md §3.6): a separate
-// loader hiding disk→host time behind device compute. Here that role is
-// a C++ reader thread pool with a ring of pre-allocated buffers, bound
-// via ctypes (no pybind11 in this environment). NumPy loading in Python
-// threads already releases the GIL, but the C++ ring removes the Python
-// dispatch from the hot path entirely and is the seam where direct-IO /
-// decompression lands later.
+// loader hiding disk→host time behind device compute — and it did more
+// than read: the spawned process also CROPPED and MIRRORED each image
+// before handing the buffer over. Here that role is a C++ reader thread
+// with a ring of pre-allocated buffers, bound via ctypes (no pybind11
+// in this environment), and the v2 "aug" mode reproduces the
+// augment-in-the-loader design: per-image random crop + horizontal
+// mirror fused into the slot fill, so the Python consumer receives
+// train-ready crops and the aug cost rides the reader thread, hidden
+// behind device compute.
 //
 // Shard file format ("raw" shards, written by theanompi_tpu.data.shards):
 //   [x: n*h*w*c float32][y: n int32]  — sizes fixed per dataset config.
 //
+// Aug RNG: splitmix64 keyed on (seed, file index, image index) — the
+// exact same scheme is implemented in numpy by data/shards.py so the
+// no-toolchain fallback produces BIT-IDENTICAL augmented batches (and
+// the tests assert that equality).
+//
 // C ABI (ctypes):
 //   void* tnp_loader_open(const char* const* paths, int n_files,
 //                         long x_bytes, long y_bytes, int depth);
+//   void* tnp_loader_open_aug(const char* const* paths, int n_files,
+//                             int n, int h, int w, int c, long y_bytes,
+//                             int crop, int mirror,
+//                             unsigned long long seed, int depth);
 //   int   tnp_loader_next(void* h, void* x_out, void* y_out);
 //         // 1 = batch copied, 0 = end of files, <0 = error
+//   int   tnp_loader_next_aug(void* h, void* x_out, void* y_out,
+//                             int* meta_out /* n*3 (oh,ow,flip) or null */);
 //   const char* tnp_loader_error(void* h);
 //   void  tnp_loader_close(void* h);
 //   int   tnp_version();
 
 #include <condition_variable>
+#include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <deque>
@@ -33,13 +48,34 @@
 namespace {
 
 struct Slot {
-  std::vector<char> data;  // x_bytes + y_bytes
+  std::vector<char> data;    // x_bytes + y_bytes (post-aug sizes)
+  std::vector<int32_t> meta; // n*3 (oh, ow, flip) when aug enabled
 };
+
+constexpr uint64_t kPhiFile = 0x9E3779B97F4A7C15ull;  // file-index stride
+constexpr uint64_t kPhiImg = 0xBF58476D1CE4E5B9ull;   // image-index stride
+constexpr uint64_t kPhiDraw = 0x94D049BB133111EBull;  // per-draw stride
+
+uint64_t mix64(uint64_t z) {  // splitmix64 finalizer
+  z ^= z >> 30;
+  z *= 0xBF58476D1CE4E5B9ull;
+  z ^= z >> 27;
+  z *= 0x94D049BB133111EBull;
+  z ^= z >> 31;
+  return z;
+}
 
 struct Loader {
   std::vector<std::string> paths;
-  size_t x_bytes = 0, y_bytes = 0;
+  size_t x_bytes = 0, y_bytes = 0;  // slot (output) sizes
   int depth = 2;
+
+  // aug mode (v2): crop/mirror applied by the reader thread
+  bool aug = false;
+  int n = 0, img_h = 0, img_w = 0, img_c = 0, crop_h = 0, crop_w = 0;
+  bool mirror = false;
+  uint64_t seed = 0;
+  size_t raw_x_bytes = 0;  // on-disk x size (pre-crop)
 
   std::vector<Slot> slots;
   std::deque<int> free_q;   // slot indices available to the reader
@@ -62,8 +98,47 @@ struct Loader {
   }
 };
 
+// Crop+mirror one file's images from `raw` into the slot, drawing
+// (oh, ow, flip) per image from the keyed splitmix64 stream.
+void augment_into_slot(Loader* L, size_t file_idx, const float* raw,
+                       Slot& slot) {
+  float* dst_x = reinterpret_cast<float*>(slot.data.data());
+  const int ch = L->crop_h, cw = L->crop_w, c = L->img_c;
+  const int max_oh = L->img_h - ch, max_ow = L->img_w - cw;
+  for (int img = 0; img < L->n; ++img) {
+    const uint64_t base =
+        L->seed + file_idx * kPhiFile + static_cast<uint64_t>(img) * kPhiImg;
+    const int oh = max_oh ? static_cast<int>(
+        mix64(base) % static_cast<uint64_t>(max_oh + 1)) : 0;
+    const int ow = max_ow ? static_cast<int>(
+        mix64(base + kPhiDraw) % static_cast<uint64_t>(max_ow + 1)) : 0;
+    const int flip =
+        L->mirror ? static_cast<int>(mix64(base + 2 * kPhiDraw) & 1) : 0;
+    slot.meta[img * 3 + 0] = oh;
+    slot.meta[img * 3 + 1] = ow;
+    slot.meta[img * 3 + 2] = flip;
+    const float* src =
+        raw + static_cast<size_t>(img) * L->img_h * L->img_w * c;
+    float* dst = dst_x + static_cast<size_t>(img) * ch * cw * c;
+    for (int r = 0; r < ch; ++r) {
+      const float* srow = src + (static_cast<size_t>(oh + r) * L->img_w + ow) * c;
+      float* drow = dst + static_cast<size_t>(r) * cw * c;
+      if (!flip) {
+        std::memcpy(drow, srow, static_cast<size_t>(cw) * c * sizeof(float));
+      } else {
+        for (int j = 0; j < cw; ++j)
+          std::memcpy(drow + static_cast<size_t>(j) * c,
+                      srow + static_cast<size_t>(cw - 1 - j) * c,
+                      static_cast<size_t>(c) * sizeof(float));
+      }
+    }
+  }
+}
+
 void reader_main(Loader* L) {
-  const size_t total = L->x_bytes + L->y_bytes;
+  const size_t raw_total = L->raw_x_bytes + L->y_bytes;
+  std::vector<char> scratch;  // raw file image payload (aug mode only)
+  if (L->aug) scratch.resize(raw_total);
   for (size_t i = 0; i < L->paths.size(); ++i) {
     int slot_idx;
     {
@@ -76,10 +151,18 @@ void reader_main(Loader* L) {
     Slot& slot = L->slots[slot_idx];
     FILE* f = std::fopen(L->paths[i].c_str(), "rb");
     bool ok = f != nullptr;
-    if (ok) {
-      ok = std::fread(slot.data.data(), 1, total, f) == total;
-      std::fclose(f);
+    if (ok && !L->aug) {
+      ok = std::fread(slot.data.data(), 1, raw_total, f) == raw_total;
+    } else if (ok) {
+      ok = std::fread(scratch.data(), 1, raw_total, f) == raw_total;
+      if (ok) {
+        augment_into_slot(L, i, reinterpret_cast<float*>(scratch.data()),
+                          slot);
+        std::memcpy(slot.data.data() + L->x_bytes,
+                    scratch.data() + L->raw_x_bytes, L->y_bytes);
+      }
     }
+    if (f) std::fclose(f);
     {
       std::lock_guard<std::mutex> lk(L->mu);
       if (!ok) {
@@ -103,7 +186,7 @@ void reader_main(Loader* L) {
 
 extern "C" {
 
-int tnp_version() { return 1; }
+int tnp_version() { return 2; }
 
 void* tnp_loader_open(const char* const* paths, int n_files, long x_bytes,
                       long y_bytes, int depth) {
@@ -111,6 +194,7 @@ void* tnp_loader_open(const char* const* paths, int n_files, long x_bytes,
   Loader* L = new Loader();
   L->paths.assign(paths, paths + n_files);
   L->x_bytes = static_cast<size_t>(x_bytes);
+  L->raw_x_bytes = L->x_bytes;
   L->y_bytes = static_cast<size_t>(y_bytes);
   L->depth = depth;
   L->slots.resize(depth);
@@ -122,8 +206,42 @@ void* tnp_loader_open(const char* const* paths, int n_files, long x_bytes,
   return L;
 }
 
-int tnp_loader_next(void* h, void* x_out, void* y_out) {
-  Loader* L = static_cast<Loader*>(h);
+void* tnp_loader_open_aug(const char* const* paths, int n_files, int n,
+                          int h, int w, int c, long y_bytes, int crop,
+                          int mirror, unsigned long long seed, int depth) {
+  if (n_files < 0 || n < 1 || h < 1 || w < 1 || c < 1 || y_bytes < 0 ||
+      depth < 1)
+    return nullptr;
+  // crop <= 0 or >= the dimension means "no crop on that axis" (full
+  // frame, offset 0) — mirroring the Python-side contract
+  const int ch = (crop > 0 && crop < h) ? crop : h;
+  const int cw = (crop > 0 && crop < w) ? crop : w;
+  Loader* L = new Loader();
+  L->paths.assign(paths, paths + n_files);
+  L->aug = true;
+  L->n = n;
+  L->img_h = h;
+  L->img_w = w;
+  L->img_c = c;
+  L->crop_h = ch;
+  L->crop_w = cw;
+  L->mirror = mirror != 0;
+  L->seed = seed;
+  L->raw_x_bytes = static_cast<size_t>(n) * h * w * c * sizeof(float);
+  L->x_bytes = static_cast<size_t>(n) * ch * cw * c * sizeof(float);
+  L->y_bytes = static_cast<size_t>(y_bytes);
+  L->depth = depth;
+  L->slots.resize(depth);
+  for (int i = 0; i < depth; ++i) {
+    L->slots[i].data.resize(L->x_bytes + L->y_bytes);
+    L->slots[i].meta.resize(static_cast<size_t>(n) * 3);
+    L->free_q.push_back(i);
+  }
+  L->reader = std::thread(reader_main, L);
+  return L;
+}
+
+static int next_impl(Loader* L, void* x_out, void* y_out, int* meta_out) {
   int slot_idx;
   {
     std::unique_lock<std::mutex> lk(L->mu);
@@ -136,12 +254,23 @@ int tnp_loader_next(void* h, void* x_out, void* y_out) {
   Slot& slot = L->slots[slot_idx];
   std::memcpy(x_out, slot.data.data(), L->x_bytes);
   std::memcpy(y_out, slot.data.data() + L->x_bytes, L->y_bytes);
+  if (meta_out && L->aug)
+    std::memcpy(meta_out, slot.meta.data(),
+                slot.meta.size() * sizeof(int32_t));
   {
     std::lock_guard<std::mutex> lk(L->mu);
     L->free_q.push_back(slot_idx);
   }
   L->cv_free.notify_all();
   return 1;
+}
+
+int tnp_loader_next(void* h, void* x_out, void* y_out) {
+  return next_impl(static_cast<Loader*>(h), x_out, y_out, nullptr);
+}
+
+int tnp_loader_next_aug(void* h, void* x_out, void* y_out, int* meta_out) {
+  return next_impl(static_cast<Loader*>(h), x_out, y_out, meta_out);
 }
 
 const char* tnp_loader_error(void* h) {

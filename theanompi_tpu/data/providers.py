@@ -544,6 +544,19 @@ class ImageNetData:
         meta = self.raw_meta[split]
         if meta is None or not paths:
             return
+        if train and self.train_aug and (self.crop_size or self.mirror):
+            # crop/mirror INSIDE the loader (C++ reader thread when
+            # built, identical-stream numpy otherwise) — the reference's
+            # augment-in-the-loader design (SURVEY.md §3.6). Fresh seed
+            # per epoch pass so augmentation varies across epochs.
+            reader = RawShardReader(
+                paths, meta["x_shape"], meta["y_shape"],
+                crop_size=self.crop_size, mirror=self.mirror,
+                aug_seed=int(self._rng.randint(0, 2**31 - 1)),
+            )
+            for x, y in reader:
+                yield x[: self.batch_size], y[: self.batch_size]
+            return
         reader = RawShardReader(paths, meta["x_shape"], meta["y_shape"])
         for x, y in reader:
             x, y = x[: self.batch_size], y[: self.batch_size]

@@ -31,25 +31,37 @@ _lib_tried = False
 
 
 def _load_lib():
-    """Load (building if needed) the native loader; None if unavailable."""
+    """Load (building/rebuilding if needed) the native loader; None if
+    unavailable. ``make`` is invoked unconditionally so a stale ``.so``
+    gets rebuilt whenever ``shard_loader.cpp`` is newer (it is a no-op
+    when up to date)."""
     global _lib, _lib_tried
     if _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH):
-        try:
+    try:
+        # Serialize the (re)build across processes: N worker ranks start
+        # together, and an unlocked `make` race could dlopen a partially
+        # written .so. Every process takes the lock before its make; any
+        # process that reaches CDLL has therefore waited out all writers.
+        import fcntl
+
+        with open(_LIB_PATH + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR, "-s"],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
-        except (OSError, subprocess.SubprocessError):
-            return None
+    except (OSError, subprocess.SubprocessError):
+        if not os.path.exists(_LIB_PATH):
+            return None  # no toolchain AND no prebuilt library
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
+    lib.tnp_version.restype = ctypes.c_int
     lib.tnp_loader_open.restype = ctypes.c_void_p
     lib.tnp_loader_open.argtypes = [
         ctypes.POINTER(ctypes.c_char_p),
@@ -63,12 +75,80 @@ def _load_lib():
     lib.tnp_loader_error.restype = ctypes.c_char_p
     lib.tnp_loader_error.argtypes = [ctypes.c_void_p]
     lib.tnp_loader_close.argtypes = [ctypes.c_void_p]
+    if lib.tnp_version() >= 2:
+        lib.tnp_loader_open_aug.restype = ctypes.c_void_p
+        lib.tnp_loader_open_aug.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_long,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_ulonglong,
+            ctypes.c_int,
+        ]
+        lib.tnp_loader_next_aug.restype = ctypes.c_int
+        lib.tnp_loader_next_aug.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
     _lib = lib
     return _lib
 
 
 def native_available() -> bool:
     return _load_lib() is not None
+
+
+def native_aug_available() -> bool:
+    lib = _load_lib()
+    return lib is not None and lib.tnp_version() >= 2
+
+
+# -- splitmix64 twin of the C++ aug RNG (shard_loader.cpp) -------------------
+# Keyed on (seed, file index, image index); the numpy fallback draws the
+# SAME (oh, ow, flip) stream, so native and fallback batches are
+# bit-identical — the property the tests pin.
+
+_PHI_FILE = np.uint64(0x9E3779B97F4A7C15)
+_PHI_IMG = np.uint64(0xBF58476D1CE4E5B9)
+_PHI_DRAW = np.uint64(0x94D049BB133111EB)
+
+
+def _mix64(z: np.ndarray) -> np.ndarray:
+    z = z.astype(np.uint64, copy=True)
+    z ^= z >> np.uint64(30)
+    z *= np.uint64(0xBF58476D1CE4E5B9)
+    z ^= z >> np.uint64(27)
+    z *= np.uint64(0x94D049BB133111EB)
+    z ^= z >> np.uint64(31)
+    return z
+
+
+def aug_draws(
+    seed: int, file_idx: int, n: int, max_oh: int, max_ow: int, mirror: bool
+):
+    """(oh, ow, flip) int32 arrays of length n — the keyed splitmix64
+    stream both the C++ reader and the numpy fallback use."""
+    with np.errstate(over="ignore"):
+        base = (
+            np.uint64(seed)
+            + np.uint64(file_idx) * _PHI_FILE
+            + np.arange(n, dtype=np.uint64) * _PHI_IMG
+        )
+        oh = (_mix64(base) % np.uint64(max_oh + 1)).astype(np.int32)
+        ow = (_mix64(base + _PHI_DRAW) % np.uint64(max_ow + 1)).astype(np.int32)
+        if mirror:
+            flip = (_mix64(base + np.uint64(2) * _PHI_DRAW)
+                    & np.uint64(1)).astype(np.int32)
+        else:
+            flip = np.zeros(n, np.int32)
+    return oh, ow, flip
 
 
 def write_raw_shard(path: str, x: np.ndarray, y: np.ndarray) -> None:
@@ -116,6 +196,15 @@ class RawShardReader:
     ahead of consumption), NumPy otherwise. One pass per instance — make
     a new reader per epoch with the shuffled file order, exactly like the
     reference re-listed ``.hkl`` files each epoch.
+
+    **Aug mode** (``crop_size``/``mirror`` with an ``aug_seed``): the
+    reference's loader process cropped and mirrored while the GPU
+    computed (SURVEY.md §3.6 parallel loading); here the C++ reader
+    thread does the same — per-image random crop + horizontal mirror
+    fused into the slot fill, so the consumer receives train-ready
+    crops. The numpy fallback draws the identical splitmix64
+    (oh, ow, flip) stream, so both paths yield bit-identical batches.
+    x_shape must be (N, H, W, C) in aug mode.
     """
 
     def __init__(
@@ -124,37 +213,78 @@ class RawShardReader:
         x_shape: Tuple[int, ...],
         y_shape: Tuple[int, ...],
         depth: int = 3,
+        crop_size: Optional[int] = None,
+        mirror: bool = False,
+        aug_seed: Optional[int] = None,
+        return_meta: bool = False,
     ):
         self.paths = list(paths)
         self.x_shape = tuple(x_shape)
         self.y_shape = tuple(y_shape)
         self.x_bytes = int(np.prod(self.x_shape)) * 4
         self.y_bytes = int(np.prod(self.y_shape)) * 4
+        self.aug = aug_seed is not None and (bool(crop_size) or mirror)
+        self.return_meta = return_meta
+        if self.aug:
+            if len(self.x_shape) != 4:
+                raise ValueError("aug mode needs (N, H, W, C) shards")
+            n, h, w, _c = self.x_shape
+            ch = int(crop_size) if crop_size and crop_size < h else h
+            cw = int(crop_size) if crop_size and crop_size < w else w
+            self.out_shape = (n, ch, cw, _c)
+            self.crop_h, self.crop_w = ch, cw
+            self.mirror = bool(mirror)
+            self.aug_seed = int(aug_seed) & 0xFFFFFFFFFFFFFFFF
+        else:
+            self.out_shape = self.x_shape
         self._lib = _load_lib()
+        if self.aug and self._lib is not None and self._lib.tnp_version() < 2:
+            self._lib = None  # stale prebuilt lib: numpy fallback
         self._h = None
         if self._lib is not None and self.paths:
             arr = (ctypes.c_char_p * len(self.paths))(
                 *[p.encode() for p in self.paths]
             )
-            self._h = self._lib.tnp_loader_open(
-                arr, len(self.paths), self.x_bytes, self.y_bytes, depth
-            )
+            if self.aug:
+                n, h, w, _c = self.x_shape
+                self._h = self._lib.tnp_loader_open_aug(
+                    arr, len(self.paths), n, h, w, _c, self.y_bytes,
+                    int(crop_size or 0), int(self.mirror), self.aug_seed,
+                    depth,
+                )
+            else:
+                self._h = self._lib.tnp_loader_open(
+                    arr, len(self.paths), self.x_bytes, self.y_bytes, depth
+                )
         self._i = 0
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         return self
 
+    def _result(self, x, y, meta):
+        return (x, y, meta) if self.return_meta else (x, y)
+
     def __next__(self):
         if self._h:
-            x = np.empty(self.x_shape, np.float32)
+            x = np.empty(self.out_shape, np.float32)
             y = np.empty(self.y_shape, np.int32)
-            rc = self._lib.tnp_loader_next(
-                self._h,
-                x.ctypes.data_as(ctypes.c_void_p),
-                y.ctypes.data_as(ctypes.c_void_p),
-            )
+            if self.aug:
+                meta = np.empty((self.x_shape[0], 3), np.int32)
+                rc = self._lib.tnp_loader_next_aug(
+                    self._h,
+                    x.ctypes.data_as(ctypes.c_void_p),
+                    y.ctypes.data_as(ctypes.c_void_p),
+                    meta.ctypes.data_as(ctypes.c_void_p),
+                )
+            else:
+                meta = None
+                rc = self._lib.tnp_loader_next(
+                    self._h,
+                    x.ctypes.data_as(ctypes.c_void_p),
+                    y.ctypes.data_as(ctypes.c_void_p),
+                )
             if rc == 1:
-                return x, y
+                return self._result(x, y, meta)
             err = self._lib.tnp_loader_error(self._h).decode()
             self.close()
             self._i = len(self.paths)  # stay exhausted (no fallback re-read)
@@ -164,7 +294,8 @@ class RawShardReader:
         # NumPy fallback
         if self._i >= len(self.paths):
             raise StopIteration
-        p = self.paths[self._i]
+        file_idx = self._i
+        p = self.paths[file_idx]
         self._i += 1
         buf = np.fromfile(p, dtype=np.uint8)
         if buf.nbytes != self.x_bytes + self.y_bytes:
@@ -172,7 +303,20 @@ class RawShardReader:
                           f"expected {self.x_bytes + self.y_bytes}")
         x = buf[: self.x_bytes].view(np.float32).reshape(self.x_shape)
         y = buf[self.x_bytes :].view(np.int32).reshape(self.y_shape)
-        return x, y
+        meta = None
+        if self.aug:
+            from theanompi_tpu.ops.augment import apply_crop_mirror
+
+            n, h, w, _c = self.x_shape
+            oh, ow, flip = aug_draws(
+                self.aug_seed, file_idx, n, h - self.crop_h, w - self.crop_w,
+                self.mirror,
+            )
+            x = np.ascontiguousarray(
+                apply_crop_mirror(x, oh, ow, flip, self.crop_h, self.crop_w)
+            )
+            meta = np.stack([oh, ow, flip], axis=1)
+        return self._result(x, y, meta)
 
     def close(self):
         if self._h:

@@ -58,6 +58,24 @@ def random_crop_mirror(
     return x
 
 
+def apply_crop_mirror(x: np.ndarray, oh, ow, flip, crop_h: int, crop_w: int):
+    """Apply given per-image (oh, ow) crop windows + mirror flags — ONE
+    vectorized gather, shared by :func:`np_crop_mirror` and the native
+    shard loader's numpy fallback (its C++ twin is
+    ``augment_into_slot`` in ``native/shard_loader.cpp``)."""
+    n = x.shape[0]
+    oh = np.asarray(oh)
+    ow = np.asarray(ow)
+    rows = oh[:, None, None] + np.arange(crop_h)[None, :, None]
+    cols = ow[:, None, None] + np.arange(crop_w)[None, None, :]
+    out = x[np.arange(n)[:, None, None], rows, cols]
+    return np.where(
+        np.asarray(flip).astype(bool)[:, None, None, None],
+        out[:, :, ::-1, :],
+        out,
+    )
+
+
 def np_crop_mirror(
     rng: np.random.RandomState,
     x: np.ndarray,
@@ -66,16 +84,9 @@ def np_crop_mirror(
 ) -> np.ndarray:
     """Host (numpy) twin of :func:`random_crop_mirror` — one gather for
     the whole batch, no per-image python loop."""
-    n = x.shape[0]
-    if crop_size and crop_size < x.shape[1]:
-        c = int(crop_size)
-        max_off = x.shape[1] - c
-        oh = rng.randint(0, max_off + 1, size=n)
-        ow = rng.randint(0, max_off + 1, size=n)
-        rows = oh[:, None, None] + np.arange(c)[None, :, None]
-        cols = ow[:, None, None] + np.arange(c)[None, None, :]
-        x = x[np.arange(n)[:, None, None], rows, cols]
-    if mirror:
-        flip = rng.rand(n) < 0.5
-        x = np.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
-    return np.ascontiguousarray(x)
+    n, h, w = x.shape[:3]
+    c = int(crop_size) if crop_size and crop_size < h else h
+    oh = rng.randint(0, h - c + 1, size=n) if c < h else np.zeros(n, np.int64)
+    ow = rng.randint(0, w - c + 1, size=n) if c < w else np.zeros(n, np.int64)
+    flip = (rng.rand(n) < 0.5) if mirror else np.zeros(n, bool)
+    return np.ascontiguousarray(apply_crop_mirror(x, oh, ow, flip, c, c))
