@@ -158,3 +158,87 @@ def test_bad_microbatch_divisibility():
 def test_pp_mesh_validation():
     with pytest.raises(ValueError, match="pp="):
         PipelinedMLP(config=dict(CFG), mesh=make_mesh())  # dp-only mesh
+
+
+# -- pipelined TransformerLM -------------------------------------------------
+
+LM_CFG = dict(
+    batch_size=8,  # per dp shard; dp=4 with pp=2 -> global 32
+    seq_len=16,
+    vocab_size=32,
+    d_model=32,
+    n_heads=4,
+    n_layers=4,
+    n_synth_train=32,
+    n_synth_val=2,
+    print_freq=10_000,
+    weight_decay=0.0,
+    exch_strategy="ar",
+    comm_probe=False,
+)
+
+
+def test_pipelined_lm_matches_single_device():
+    """GPipe over the transformer block stack (2 blocks per stage on a
+    dp=4×pp=2 mesh) must track a single-device run exactly, from the
+    SAME initial weights (the stacked-stage init draws a different rng
+    tree, so the pp model's params are unstacked into the dense one)."""
+    from theanompi_tpu.models.transformer import TransformerLM
+
+    cfg_pp = dict(LM_CFG, batch_size=8, pp=2, pp_micro=2)
+    mesh_pp = TransformerLM.build_mesh(config=cfg_pp)
+    m_pp = TransformerLM(config=cfg_pp, mesh=mesh_pp)
+    m_pp.compile_train()
+
+    m_1 = TransformerLM(
+        config=dict(LM_CFG, batch_size=32),
+        mesh=make_mesh(devices=jax.devices()[:1]),
+    )
+    m_1.compile_train()
+
+    # [emb, pos, PipelineStages, ln, head] -> [emb, pos, b0..b3, ln, head]
+    pp_params = jax.tree.map(np.asarray, m_pp.params)
+    stage_list = pp_params[2]  # list over per-stage blocks, leaves (S, ...)
+    per_stage = len(stage_list)
+    dense = [pp_params[0], pp_params[1]]
+    for s in range(2):  # stage index
+        for j in range(per_stage):
+            dense.append(jax.tree.map(lambda a: a[s], stage_list[j]))
+    dense += [pp_params[3], pp_params[4]]
+    from theanompi_tpu.runtime.mesh import replicate
+
+    assert jax.tree.structure(dense) == jax.tree.structure(m_1.params)
+    m_1.params = replicate(m_1.mesh, dense)
+
+    def run(m, n_steps=3):
+        m.reset_train_iter(0)
+        rec = Recorder(verbose=False)
+        return [float(m.train_iter(i, rec)[0]) for i in range(1, n_steps + 1)]
+
+    np.testing.assert_allclose(run(m_pp), run(m_1), rtol=2e-4)
+
+
+def test_pipelined_lm_stage_leaves_sharded_over_pp():
+    from theanompi_tpu.models.transformer import TransformerLM
+
+    cfg = dict(LM_CFG, pp=2, pp_micro=2)
+    m = TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))
+    m.compile_train()
+    stages_params = m.params[2]  # [emb, posemb, PipelineStages, ln, head]
+    leaf = jax.tree.leaves(stages_params)[0]
+    assert leaf.shape[0] == 2  # stacked stage dim
+    shard = next(iter(leaf.addressable_shards))
+    assert shard.data.shape[0] == 1  # one stage per pp rank
+
+
+def test_pipelined_lm_rejections():
+    from theanompi_tpu.models.transformer import TransformerLM
+
+    with pytest.raises(ValueError, match="composes with dp only"):
+        TransformerLM.build_mesh(config=dict(LM_CFG, pp=2, sp=2))
+    with pytest.raises(ValueError, match="must divide by pp"):
+        cfg = dict(LM_CFG, pp=2, n_layers=3)
+        TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))
+    with pytest.raises(ValueError, match="MoE"):
+        cfg = dict(LM_CFG, pp=2, moe_experts=4)
+        TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))

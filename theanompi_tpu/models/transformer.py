@@ -53,6 +53,9 @@ class TransformerLM(TpuModel):
         attn_impl="xla",  # 'xla' (fused dense) | 'flash' (Pallas kernels:
         # dense path, alltoall local attention, and per-ring-step blocks)
         tp=1,  # tensor-parallel degree (Megatron-style column/row sharding)
+        pp=1,  # pipeline-parallel depth: n_layers/pp TransformerBlocks per
+        # GPipe stage (parallel.pipeline), activations hopping over ICI
+        pp_micro=4,  # microbatches per step (bubble = (pp-1)/(m+pp-1))
         lr=0.1,
         momentum=0.9,
         weight_decay=0.0,
@@ -80,7 +83,25 @@ class TransformerLM(TpuModel):
         cfg.update(dict(config or {}))
         sp = int(cfg.get("sp", 1))
         tp = int(cfg.get("tp", 1))
+        pp = int(cfg.get("pp", 1))
         devices = list(devices) if devices is not None else jax.devices()
+        if pp > 1:
+            if sp > 1 or tp > 1:
+                raise ValueError(
+                    f"pp={pp} composes with dp only (got sp={sp}, tp={tp})"
+                )
+            if len(devices) % pp:
+                raise ValueError(
+                    f"pp={pp} does not divide {len(devices)} devices"
+                )
+            from theanompi_tpu.runtime.mesh import PP_AXIS
+
+            # innermost axis = pp so stage→stage hops ride neighbor ICI
+            return make_mesh(
+                shape=(len(devices) // pp, pp),
+                axis_names=(DATA_AXIS, PP_AXIS),
+                devices=devices,
+            )
         if len(devices) % (sp * tp):
             raise ValueError(
                 f"sp={sp}·tp={tp} does not divide {len(devices)} devices"
@@ -104,9 +125,43 @@ class TransformerLM(TpuModel):
         cfg.update(overrides)
         sp = int(cfg.get("sp", 1))
         tp = int(cfg.get("tp", 1))
+        pp = int(cfg.get("pp", 1))
         if mesh is None:
             mesh = self.build_mesh(config=cfg)
-        elif SEQ_AXIS not in mesh.axis_names:
+        if pp > 1:
+            from theanompi_tpu.runtime.mesh import PP_AXIS
+
+            if sp > 1 or tp > 1:
+                raise ValueError(
+                    f"pp={pp} composes with dp only (got sp={sp}, tp={tp})"
+                )
+            if int(cfg.get("moe_experts", 0)):
+                raise ValueError(
+                    "pp does not compose with MoE blocks (the GPipe scan "
+                    "carries activations only; MoE aux flows through state)"
+                )
+            n_layers = int(cfg.get("n_layers", self.default_config["n_layers"]))
+            if n_layers % pp:
+                raise ValueError(
+                    f"n_layers={n_layers} must divide by pp={pp} "
+                    f"(homogeneous stages of n_layers/pp blocks)"
+                )
+            self._require_mesh_axis(mesh, PP_AXIS, pp)
+            self.pp_size = pp
+            self.sp_size = 1
+            self.tp_size = 1
+            # batch shards over dp, replicated over pp (stage masking in
+            # the GPipe scan selects what each stage consumes); stage-
+            # stacked leaves skip pp via param_specs, replicated leaves
+            # carry identical grads across pp after the entry/exit
+            # custom-VJP pair, so pp joins the mean axes harmlessly
+            self.batch_spec = P(DATA_AXIS)
+            self.exchange_axes = (DATA_AXIS, PP_AXIS)
+            super().__init__(cfg, mesh=mesh)
+            self.param_specs = self._build_param_specs()
+            return
+        self.pp_size = 1
+        if SEQ_AXIS not in mesh.axis_names:
             if sp > 1:
                 # an explicit dp-only mesh must not silently discard the
                 # requested sequence parallelism (dense attention at long
@@ -214,26 +269,41 @@ class TransformerLM(TpuModel):
             )
 
         wrap = L.Remat if bool(cfg.remat) else (lambda b: b)
+
+        def make_block():
+            return wrap(A.TransformerBlock(
+                n_heads,
+                mlp_ratio=int(cfg.mlp_ratio),
+                causal=True,
+                sp_axis=sp_axis,
+                sp_size=self.sp_size,
+                sp_mode=str(cfg.sp_mode),
+                tp_axis=tp_axis,
+                tp_size=self.tp_size,
+                compute_dtype=dt,
+                moe=make_moe(),
+                attn_impl=str(cfg.attn_impl),
+            ))
+
+        if self.pp_size > 1:
+            # GPipe over the block stack: n_layers/pp blocks per stage,
+            # stage weights sharded over pp, embeddings and the head
+            # replicated on every stage device (parallel.pipeline)
+            from theanompi_tpu.parallel.pipeline import PipelineStages
+
+            per_stage = int(cfg.n_layers) // self.pp_size
+            body = [PipelineStages(
+                lambda _i: L.Sequential([make_block() for _ in range(per_stage)]),
+                n_stages=self.pp_size,
+                n_micro=int(cfg.pp_micro),
+            )]
+        else:
+            body = [make_block() for _ in range(int(cfg.n_layers))]
         net = L.Sequential(
             [
                 A.Embedding(int(cfg.vocab_size), d, compute_dtype=dt),
                 A.PositionalEmbedding(int(cfg.seq_len), sp_axis=sp_axis),
-                *[
-                    wrap(A.TransformerBlock(
-                        n_heads,
-                        mlp_ratio=int(cfg.mlp_ratio),
-                        causal=True,
-                        sp_axis=sp_axis,
-                        sp_size=self.sp_size,
-                        sp_mode=str(cfg.sp_mode),
-                        tp_axis=tp_axis,
-                        tp_size=self.tp_size,
-                        compute_dtype=dt,
-                        moe=make_moe(),
-                        attn_impl=str(cfg.attn_impl),
-                    ))
-                    for _ in range(int(cfg.n_layers))
-                ],
+                *body,
                 A.LayerNorm(),
                 L.Dense(int(cfg.vocab_size), compute_dtype=dt, output_dtype=jnp.float32),
             ]
@@ -248,6 +318,9 @@ class TransformerLM(TpuModel):
         per-layer list): Megatron column/row sharding for every dense
         TransformerBlock (tp), expert-dim sharding over dp for MoE
         blocks (GShard-style ep≡dp), everything else replicated."""
+        from theanompi_tpu.parallel.pipeline import PipelineStages
+        from theanompi_tpu.runtime.mesh import PP_AXIS
+
         col = P(None, TP_AXIS)  # output-dim sharded: wq/wk/wv, mlp_in.w
         row = P(TP_AXIS, None)  # input-dim sharded: wo, mlp_out.w
         rep = P()
@@ -257,6 +330,11 @@ class TransformerLM(TpuModel):
         for layer, layer_params in zip(self.net.layers, self.params):
             if isinstance(layer, L.Remat):
                 layer = layer.inner  # spec by the wrapped block
+            if isinstance(layer, PipelineStages):
+                # stage-stacked leaves shard over pp on the leading
+                # (stage) dim; the exchanger then skips pp for them
+                specs.append(jax.tree.map(lambda _: P(PP_AXIS), layer_params))
+                continue
             if not isinstance(layer, A.TransformerBlock):
                 specs.append(jax.tree.map(lambda _: rep, layer_params))
                 continue
