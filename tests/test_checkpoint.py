@@ -113,3 +113,66 @@ def test_non_checkpoint_file_rejected(tmp_path):
 def test_atomic_save_leaves_no_tmp(tmp_path):
     ckpt.save(str(tmp_path / "c.npz"), {"x": np.zeros(2)})
     assert [f.name for f in tmp_path.iterdir()] == ["c.npz"]
+
+
+# -- async checkpointing ------------------------------------------------------
+
+def test_async_checkpointer_matches_sync(tmp_path):
+    """Background write produces the identical restorable file."""
+    tree = _sample_tree()
+    sync_path = str(tmp_path / "sync.npz")
+    async_path = str(tmp_path / "async.npz")
+    ckpt.save(sync_path, tree)
+    with ckpt.AsyncCheckpointer() as ac:
+        ac.save(async_path, tree)
+        ac.wait()
+        a = ckpt.restore(async_path)
+    s = ckpt.restore(sync_path)
+    assert a["epoch"] == s["epoch"] == 7 and a["tag"] == s["tag"]
+    for x, y in zip(
+        np.asarray(a["params"]["conv1"]["w"]).ravel(),
+        np.asarray(s["params"]["conv1"]["w"]).ravel(),
+    ):
+        assert x == y
+
+
+def test_async_checkpointer_snapshot_is_immediate(tmp_path):
+    """The host snapshot happens inside save(): mutating the caller's
+    tree afterwards must not affect the written file (the step donates
+    its device buffers — late reads would see reused memory)."""
+    tree = {"w": np.ones(4, np.float32)}
+    with ckpt.AsyncCheckpointer() as ac:
+        ac.save(str(tmp_path / "c.npz"), tree)
+        tree["w"][:] = -1.0  # mutate AFTER save returns, before wait
+        ac.wait()
+    out = ckpt.restore(str(tmp_path / "c.npz"))
+    np.testing.assert_array_equal(out["w"], np.ones(4, np.float32))
+
+
+def test_async_checkpointer_surfaces_write_errors(tmp_path):
+    # parent "directory" is a regular file: save()'s makedirs fails in
+    # the worker; the error must surface on wait(), not vanish
+    # (chmod-based denial doesn't work here — tests run as root)
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("")
+    ac = ckpt.AsyncCheckpointer()
+    try:
+        ac.save(str(blocker / "c.npz"), {"w": np.ones(2)})
+        with pytest.raises(OSError):
+            ac.wait()
+    finally:
+        ac.close()
+
+
+def test_async_checkpointer_closed_rejects_save(tmp_path):
+    ac = ckpt.AsyncCheckpointer()
+    ac.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ac.save(str(tmp_path / "c.npz"), {"w": np.ones(2)})
+
+
+def test_host_snapshot_passes_scalars_through():
+    snap = ckpt.host_snapshot({"epoch": 7, "tag": "x", "w": np.ones(2)})
+    assert snap["epoch"] == 7 and isinstance(snap["epoch"], int)
+    assert snap["tag"] == "x"
+    assert isinstance(snap["w"], np.ndarray)

@@ -562,19 +562,26 @@ class TpuModel:
     # ------------------------------------------------------------------
     # checkpoint + cleanup
     # ------------------------------------------------------------------
-    def save_model(self, path: str) -> str:
+    def checkpoint_state(self) -> dict:
+        """The full training-state pytree a checkpoint carries."""
+        return {
+            "params": self.params,
+            "net_state": self.net_state,
+            "opt_state": self.opt_state,
+            "epoch": self.current_epoch,
+            "rng": self.rng,
+        }
+
+    def save_model(self, path: str, checkpointer=None) -> str:
+        """Snapshot to ``path``. With a ``checkpointer``
+        (``utils.checkpoint.AsyncCheckpointer``) the device→host copy is
+        synchronous but the disk write happens on its worker thread."""
         from theanompi_tpu.utils import checkpoint
 
-        return checkpoint.save(
-            path,
-            {
-                "params": self.params,
-                "net_state": self.net_state,
-                "opt_state": self.opt_state,
-                "epoch": self.current_epoch,
-                "rng": self.rng,
-            },
-        )
+        if checkpointer is not None:
+            checkpointer.save(path, self.checkpoint_state())
+            return path
+        return checkpoint.save(path, self.checkpoint_state())
 
     def load_model(self, path: str) -> None:
         from theanompi_tpu.utils import checkpoint

@@ -37,6 +37,9 @@ class BSP_Worker:
         checkpoint_dir: Optional[str] = None,
         checkpoint_freq: int = 1,  # epochs between snapshots (0 = never)
         resume: bool = False,
+        async_checkpoint: bool = True,  # write snapshots on a background
+        # thread (device→host copy stays synchronous — the step donates
+        # its buffers); False = block the loop on the disk write
     ):
         import jax
 
@@ -52,6 +55,11 @@ class BSP_Worker:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_freq = checkpoint_freq
         self.resume = resume
+        self._ckpt = None
+        if async_checkpoint and checkpoint_dir and self.process_index == 0:
+            from theanompi_tpu.utils.checkpoint import AsyncCheckpointer
+
+            self._ckpt = AsyncCheckpointer()
 
     def _log_memory(self, rec: Recorder, tag: str) -> None:
         """Device-memory snapshot as a record event (bytes in use /
@@ -127,26 +135,46 @@ class BSP_Worker:
         if self.process_index == 0 and hasattr(model, "describe"):
             print(model.describe(), flush=True)
         count = model.current_epoch * model.data.n_batch_train
-        for epoch in range(model.current_epoch, model.n_epochs):
-            model.adjust_hyperp(epoch)
-            rec.start_epoch()
-            model.reset_train_iter(epoch)
-            for _ in range(model.data.n_batch_train):
-                count += 1
-                model.train_iter(count, rec)
-                rec.print_train_info(count)
-            if self.val_freq and (epoch + 1) % self.val_freq == 0:
-                model.run_validation(count, rec)
-            rec.end_epoch(count, epoch)
-            self._log_memory(rec, f"epoch_{epoch + 1}")
-            model.current_epoch = epoch + 1
-            if self.checkpoint_dir and self.checkpoint_freq and (
-                (epoch + 1) % self.checkpoint_freq == 0
-            ) and self.process_index == 0:  # rank-0 writes, like the reference
-                path = os.path.join(
-                    self.checkpoint_dir, f"ckpt_{epoch + 1:04d}.npz"
-                )
-                model.save_model(path)
+        try:
+            for epoch in range(model.current_epoch, model.n_epochs):
+                model.adjust_hyperp(epoch)
+                rec.start_epoch()
+                model.reset_train_iter(epoch)
+                for _ in range(model.data.n_batch_train):
+                    count += 1
+                    model.train_iter(count, rec)
+                    rec.print_train_info(count)
+                if self.val_freq and (epoch + 1) % self.val_freq == 0:
+                    model.run_validation(count, rec)
+                rec.end_epoch(count, epoch)
+                self._log_memory(rec, f"epoch_{epoch + 1}")
+                model.current_epoch = epoch + 1
+                if self.checkpoint_dir and self.checkpoint_freq and (
+                    (epoch + 1) % self.checkpoint_freq == 0
+                ) and self.process_index == 0:  # rank-0 writes, like the reference
+                    path = os.path.join(
+                        self.checkpoint_dir, f"ckpt_{epoch + 1:04d}.npz"
+                    )
+                    model.save_model(path, checkpointer=self._ckpt)
+        finally:
+            # drain the background writer EVEN when the loop raises — a
+            # crash mid-epoch must not kill the daemon thread before the
+            # last enqueued snapshot hits disk (restart-from-fault reads
+            # it immediately). On the success path writer errors
+            # propagate (a run whose checkpoints failed is a failed
+            # run); when the loop itself raised, don't mask that
+            # exception with a secondary writer error.
+            if self._ckpt is not None:
+                import sys
+
+                if sys.exc_info()[0] is None:
+                    self._ckpt.close()
+                else:
+                    try:
+                        self._ckpt.close()
+                    except Exception as ce:
+                        print(f"async checkpoint error during crash "
+                              f"drain: {type(ce).__name__}: {ce}", flush=True)
         if self.checkpoint_dir:
             rec.save()
         model.cleanup()

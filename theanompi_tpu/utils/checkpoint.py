@@ -175,6 +175,106 @@ def restore(path: str) -> Any:
     raise ValueError(f"{path}: not a theanompi_tpu checkpoint (no structure entry)")
 
 
+def host_snapshot(tree: Any) -> Any:
+    """Device→host copy of every array leaf, scalars passed through.
+
+    This is the synchronous half of an async save and it is NOT
+    optional: the jitted train step donates the params/opt-state
+    buffers (``donate_argnums``), so a background thread still holding
+    device references would read reused memory after the next step.
+    After this copy the tree is plain numpy — immutable history.
+    """
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            # np.array, not np.asarray: asarray on an already-host numpy
+            # array is a zero-copy VIEW, and a view of a buffer the
+            # caller keeps mutating is not a snapshot
+            return np.array(x)
+        return x
+
+    import jax
+
+    return jax.tree.map(leaf, tree)
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer — training never stalls on the disk.
+
+    ``save()`` copies the pytree to host memory synchronously (bounded
+    by device→host bandwidth, the part that MUST happen before the next
+    donated step), then hands serialization + atomic npz write to a
+    worker thread. The queue is bounded: if ``max_pending`` writes are
+    already in flight, ``save()`` blocks (backpressure beats unbounded
+    host-memory growth). Writer errors surface on the next ``save()``
+    or ``wait()`` — never silently dropped.
+
+    The reference saved synchronously in the epoch loop (SURVEY.md
+    §3.7); this is the same per-epoch snapshot with the write hidden
+    behind the next epoch's compute, Orbax-style but dependency-free.
+    """
+
+    _STOP = object()
+
+    def __init__(self, max_pending: int = 2):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        self._err: Exception | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="async-ckpt", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is self._STOP:
+                    return
+                path, tree = item
+                try:
+                    save(path, tree)
+                except Exception as e:  # surfaced on next save()/wait()
+                    self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def save(self, path: str, tree: Any) -> None:
+        """Snapshot now, write soon. Blocks only on device→host copy
+        (and on backpressure when ``max_pending`` writes are queued)."""
+        self._raise_pending()
+        if not self._thread.is_alive():
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._q.put((path, host_snapshot(tree)))
+
+    def wait(self) -> None:
+        """Block until every queued write has hit disk; re-raise any
+        writer error. Call before reading back a just-saved file and at
+        the end of training."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain, stop the worker, surface any trailing error."""
+        if self._thread.is_alive():
+            self._q.join()
+            self._q.put(self._STOP)
+            self._thread.join()
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def latest(dir_path: str, prefix: str = "ckpt_") -> str | None:
     """Most recent checkpoint in a directory (for restart-from-failure)."""
     if not os.path.isdir(dir_path):
