@@ -178,44 +178,59 @@ LM_CFG = dict(
 )
 
 
+def _unstack_pp_params(pp_model, pp):
+    """[emb, pos, PipelineStages, ln, head] → [emb, pos, blocks…, ln,
+    head]: stage s of the stacked stage params expands to blocks
+    s·per_stage … (s+1)·per_stage−1 of the unpipelined layout."""
+    pp_params = jax.tree.map(np.asarray, pp_model.params)
+    stage_list = pp_params[2]  # list over per-stage blocks, leaves (S, ...)
+    dense = [pp_params[0], pp_params[1]]
+    for s in range(pp):
+        for blk in stage_list:
+            dense.append(jax.tree.map(lambda a: a[s], blk))
+    return dense + [pp_params[3], pp_params[4]]
+
+
+def _lm_losses(m, n_steps=3):
+    m.reset_train_iter(0)
+    rec = Recorder(verbose=False)
+    return [float(m.train_iter(i, rec)[0]) for i in range(1, n_steps + 1)]
+
+
+def _assert_pp_lm_matches_single_device(cfg_pp, pp):
+    """Build the pipelined model, transplant its weights into an
+    unpipelined single-device model, pin identical trajectories."""
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.runtime.mesh import replicate
+
+    mesh_pp = TransformerLM.build_mesh(config=cfg_pp)
+    m_pp = TransformerLM(config=cfg_pp, mesh=mesh_pp)
+    m_pp.compile_train()
+    n_dev = 1
+    for v in mesh_pp.shape.values():
+        n_dev *= int(v)
+    global_bs = int(cfg_pp["batch_size"]) * (
+        n_dev // (pp * int(cfg_pp.get("tp", 1)))
+    )
+    m_1 = TransformerLM(
+        config=dict(LM_CFG, batch_size=global_bs),
+        mesh=make_mesh(devices=jax.devices()[:1]),
+    )
+    m_1.compile_train()
+    dense = _unstack_pp_params(m_pp, pp)
+    assert jax.tree.structure(dense) == jax.tree.structure(m_1.params)
+    m_1.params = replicate(m_1.mesh, dense)
+    np.testing.assert_allclose(_lm_losses(m_pp), _lm_losses(m_1), rtol=2e-4)
+
+
 def test_pipelined_lm_matches_single_device():
     """GPipe over the transformer block stack (2 blocks per stage on a
     dp=4×pp=2 mesh) must track a single-device run exactly, from the
     SAME initial weights (the stacked-stage init draws a different rng
     tree, so the pp model's params are unstacked into the dense one)."""
-    from theanompi_tpu.models.transformer import TransformerLM
-
-    cfg_pp = dict(LM_CFG, batch_size=8, pp=2, pp_micro=2)
-    mesh_pp = TransformerLM.build_mesh(config=cfg_pp)
-    m_pp = TransformerLM(config=cfg_pp, mesh=mesh_pp)
-    m_pp.compile_train()
-
-    m_1 = TransformerLM(
-        config=dict(LM_CFG, batch_size=32),
-        mesh=make_mesh(devices=jax.devices()[:1]),
+    _assert_pp_lm_matches_single_device(
+        dict(LM_CFG, batch_size=8, pp=2, pp_micro=2), pp=2
     )
-    m_1.compile_train()
-
-    # [emb, pos, PipelineStages, ln, head] -> [emb, pos, b0..b3, ln, head]
-    pp_params = jax.tree.map(np.asarray, m_pp.params)
-    stage_list = pp_params[2]  # list over per-stage blocks, leaves (S, ...)
-    per_stage = len(stage_list)
-    dense = [pp_params[0], pp_params[1]]
-    for s in range(2):  # stage index
-        for j in range(per_stage):
-            dense.append(jax.tree.map(lambda a: a[s], stage_list[j]))
-    dense += [pp_params[3], pp_params[4]]
-    from theanompi_tpu.runtime.mesh import replicate
-
-    assert jax.tree.structure(dense) == jax.tree.structure(m_1.params)
-    m_1.params = replicate(m_1.mesh, dense)
-
-    def run(m, n_steps=3):
-        m.reset_train_iter(0)
-        rec = Recorder(verbose=False)
-        return [float(m.train_iter(i, rec)[0]) for i in range(1, n_steps + 1)]
-
-    np.testing.assert_allclose(run(m_pp), run(m_1), rtol=2e-4)
 
 
 def test_pipelined_lm_stage_leaves_sharded_over_pp():
@@ -234,7 +249,7 @@ def test_pipelined_lm_stage_leaves_sharded_over_pp():
 def test_pipelined_lm_rejections():
     from theanompi_tpu.models.transformer import TransformerLM
 
-    with pytest.raises(ValueError, match="composes with dp only"):
+    with pytest.raises(ValueError, match="does not compose with sp"):
         TransformerLM.build_mesh(config=dict(LM_CFG, pp=2, sp=2))
     with pytest.raises(ValueError, match="must divide by pp"):
         cfg = dict(LM_CFG, pp=2, n_layers=3)
@@ -242,3 +257,24 @@ def test_pipelined_lm_rejections():
     with pytest.raises(ValueError, match="MoE"):
         cfg = dict(LM_CFG, pp=2, moe_experts=4)
         TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))
+
+
+def test_pipelined_lm_3d_dp_pp_tp_matches_single_device():
+    """The 3-D composition: batch over dp, stages over pp, Megatron
+    column/row splits over tp INSIDE each stage — must track the
+    unpipelined single-device model from the same (unstacked) weights."""
+    _assert_pp_lm_matches_single_device(
+        dict(LM_CFG, batch_size=4, pp=2, pp_micro=2, tp=2), pp=2
+    )
+
+
+def test_pipelined_lm_3d_leaves_sharded_both_ways():
+    cfg = dict(LM_CFG, batch_size=4, pp=2, pp_micro=2, tp=2)
+    from theanompi_tpu.models.transformer import TransformerLM
+
+    m = TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))
+    m.compile_train()
+    wq = m.params[2][0]["attn"]["wq"]  # stacked (S, d, d), tp on dim 2
+    shard = next(iter(wq.addressable_shards))
+    assert shard.data.shape[0] == wq.shape[0] // 2  # stage / pp
+    assert shard.data.shape[2] == wq.shape[2] // 2  # heads / tp

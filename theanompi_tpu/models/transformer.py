@@ -86,17 +86,25 @@ class TransformerLM(TpuModel):
         pp = int(cfg.get("pp", 1))
         devices = list(devices) if devices is not None else jax.devices()
         if pp > 1:
-            if sp > 1 or tp > 1:
+            if sp > 1:
                 raise ValueError(
-                    f"pp={pp} composes with dp only (got sp={sp}, tp={tp})"
+                    f"pp={pp} does not compose with sp={sp} (sequence "
+                    f"sharding inside pipeline stages is not supported)"
                 )
-            if len(devices) % pp:
+            if len(devices) % (pp * tp):
                 raise ValueError(
-                    f"pp={pp} does not divide {len(devices)} devices"
+                    f"pp={pp}·tp={tp} does not divide {len(devices)} devices"
                 )
             from theanompi_tpu.runtime.mesh import PP_AXIS
 
-            # innermost axis = pp so stage→stage hops ride neighbor ICI
+            if tp > 1:
+                # innermost = tp (its per-microbatch psums are the
+                # hottest collectives), pp next (neighbor hops)
+                return make_mesh(
+                    shape=(len(devices) // (pp * tp), pp, tp),
+                    axis_names=(DATA_AXIS, PP_AXIS, TP_AXIS),
+                    devices=devices,
+                )
             return make_mesh(
                 shape=(len(devices) // pp, pp),
                 axis_names=(DATA_AXIS, PP_AXIS),
@@ -131,9 +139,10 @@ class TransformerLM(TpuModel):
         if pp > 1:
             from theanompi_tpu.runtime.mesh import PP_AXIS
 
-            if sp > 1 or tp > 1:
+            if sp > 1:
                 raise ValueError(
-                    f"pp={pp} composes with dp only (got sp={sp}, tp={tp})"
+                    f"pp={pp} does not compose with sp={sp} (sequence "
+                    f"sharding inside pipeline stages is not supported)"
                 )
             if int(cfg.get("moe_experts", 0)):
                 raise ValueError(
@@ -147,16 +156,21 @@ class TransformerLM(TpuModel):
                     f"(homogeneous stages of n_layers/pp blocks)"
                 )
             self._require_mesh_axis(mesh, PP_AXIS, pp)
+            if tp > 1:
+                self._require_mesh_axis(mesh, TP_AXIS, tp)
             self.pp_size = pp
             self.sp_size = 1
-            self.tp_size = 1
-            # batch shards over dp, replicated over pp (stage masking in
-            # the GPipe scan selects what each stage consumes); stage-
-            # stacked leaves skip pp via param_specs, replicated leaves
-            # carry identical grads across pp after the entry/exit
-            # custom-VJP pair, so pp joins the mean axes harmlessly
+            self.tp_size = tp
+            # batch shards over dp, replicated over pp/tp (stage masking
+            # in the GPipe scan selects what each stage consumes); stage-
+            # stacked leaves skip pp — and their Megatron-split dims skip
+            # tp — via param_specs; replicated leaves carry identical
+            # grads across pp (entry/exit custom-VJP pair) and tp (the
+            # in-block f/g pair), so both join the mean axes harmlessly
             self.batch_spec = P(DATA_AXIS)
-            self.exchange_axes = (DATA_AXIS, PP_AXIS)
+            self.exchange_axes = (DATA_AXIS, PP_AXIS) + (
+                (TP_AXIS,) if tp > 1 else ()
+            )
             super().__init__(cfg, mesh=mesh)
             self.param_specs = self._build_param_specs()
             return
@@ -326,18 +340,8 @@ class TransformerLM(TpuModel):
         rep = P()
         tp_on = self.tp_size > 1
         dp = int(self.mesh.shape[DATA_AXIS])
-        specs = []
-        for layer, layer_params in zip(self.net.layers, self.params):
-            if isinstance(layer, L.Remat):
-                layer = layer.inner  # spec by the wrapped block
-            if isinstance(layer, PipelineStages):
-                # stage-stacked leaves shard over pp on the leading
-                # (stage) dim; the exchanger then skips pp for them
-                specs.append(jax.tree.map(lambda _: P(PP_AXIS), layer_params))
-                continue
-            if not isinstance(layer, A.TransformerBlock):
-                specs.append(jax.tree.map(lambda _: rep, layer_params))
-                continue
+
+        def block_spec(layer, layer_params):
             block = {
                 "ln1": jax.tree.map(lambda _: rep, layer_params["ln1"]),
                 "attn": (
@@ -354,10 +358,46 @@ class TransformerLM(TpuModel):
                     DATA_AXIS if dp > 1 else None,
                     TP_AXIS if tp_on else None,
                 )
-            else:
+            elif tp_on:
                 block["mlp_in"] = {"w": col, "b": P(TP_AXIS)}
                 block["mlp_out"] = {"w": row, "b": rep}
-            specs.append(block)
+            else:
+                block["mlp_in"] = jax.tree.map(
+                    lambda _: rep, layer_params["mlp_in"]
+                )
+                block["mlp_out"] = jax.tree.map(
+                    lambda _: rep, layer_params["mlp_out"]
+                )
+            return block
+
+        def unwrap(layer):
+            return layer.inner if isinstance(layer, L.Remat) else layer
+
+        specs = []
+        for layer, layer_params in zip(self.net.layers, self.params):
+            layer = unwrap(layer)
+            if isinstance(layer, PipelineStages):
+                # stage-stacked leaves: leading (stage) dim shards over
+                # pp, the block's own Megatron dims (if tp) shift right
+                # by one — every stacked leaf skips pp in the exchange;
+                # only the Megatron-split ones also skip tp (stacked
+                # LN/bias leaves still reduce over tp, required: their
+                # tp-rank grads are identical copies)
+                template = layer.stages[0]  # Sequential of blocks
+                stage = []
+                for blk, blk_params in zip(template.layers, layer_params):
+                    bs = block_spec(unwrap(blk), blk_params)
+                    stage.append(jax.tree.map(
+                        lambda s: P(PP_AXIS, *s),
+                        bs,
+                        is_leaf=lambda x: isinstance(x, P),
+                    ))
+                specs.append(stage)
+                continue
+            if not isinstance(layer, A.TransformerBlock):
+                specs.append(jax.tree.map(lambda _: rep, layer_params))
+                continue
+            specs.append(block_spec(layer, layer_params))
         return specs
 
     def loss_and_metrics(self, params, net_state, x, y, train: bool, rng):
