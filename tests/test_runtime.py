@@ -163,3 +163,38 @@ def test_multihost_env_with_failed_autodetect_hard_fails(monkeypatch):
     monkeypatch.setenv("THEANOMPI_TPU_ALLOW_DEGRADED", "1")
     with pytest.warns(RuntimeWarning, match="SINGLE-HOST"):
         assert mesh_mod.init_distributed() is False
+
+
+def test_recorder_tensorboard_mirror(tmp_path):
+    """tensorboard_dir mirrors the record to TB event files (SURVEY §6
+    metrics row: JSONL + optional TensorBoard writer)."""
+    pytest.importorskip("torch.utils.tensorboard")
+    from theanompi_tpu.runtime.recorder import Recorder
+
+    tb = tmp_path / "tb"
+    rec = Recorder(print_freq=2, verbose=False, save_dir=str(tmp_path),
+                   tensorboard_dir=str(tb))
+    for i in range(1, 5):
+        rec.train_error(i, 1.0, 0.5)
+        rec.print_train_info(i)
+    rec.val_error(4, 0.9, 0.4, 0.1)
+    rec.log_event("comm_fraction", frac=0.25)
+    rec.start_epoch()
+    rec.end_epoch(4, 0)
+    rec.save()
+    rec.close()
+    events = [f for f in tb.iterdir() if "tfevents" in f.name]
+    assert events and events[0].stat().st_size > 0
+    # JSONL record still written alongside
+    assert (tmp_path / "record_rank0.jsonl").exists()
+
+
+def test_recorder_without_tensorboard_unchanged(tmp_path):
+    from theanompi_tpu.runtime.recorder import Recorder
+
+    rec = Recorder(print_freq=1, verbose=False, save_dir=str(tmp_path))
+    rec.train_error(1, 2.0, 1.0)
+    rec.print_train_info(1)
+    rec.save()
+    rec.close()  # no-op without a writer
+    assert (tmp_path / "record_rank0.jsonl").exists()

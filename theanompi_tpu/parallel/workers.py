@@ -40,16 +40,27 @@ class BSP_Worker:
         async_checkpoint: bool = True,  # write snapshots on a background
         # thread (device→host copy stays synchronous — the step donates
         # its buffers); False = block the loop on the disk write
+        tensorboard_dir: Optional[str] = None,  # mirror the record to
+        # TensorBoard event files (rank 0 only)
     ):
         import jax
 
         self.process_index = jax.process_index()
         self.model = model
+        if recorder is not None and tensorboard_dir is not None:
+            raise ValueError(
+                "pass tensorboard_dir OR a pre-built recorder, not both — "
+                "an explicit recorder would silently drop the TB mirror "
+                "(build it with Recorder(tensorboard_dir=...) instead)"
+            )
         self.recorder = recorder or Recorder(
             print_freq=int(model.config.get("print_freq", 40)),
             rank=self.process_index,
             verbose=self.process_index == 0,
             save_dir=checkpoint_dir,
+            tensorboard_dir=(
+                tensorboard_dir if self.process_index == 0 else None
+            ),
         )
         self.val_freq = val_freq
         self.checkpoint_dir = checkpoint_dir
@@ -175,6 +186,9 @@ class BSP_Worker:
                     except Exception as ce:
                         print(f"async checkpoint error during crash "
                               f"drain: {type(ce).__name__}: {ce}", flush=True)
+            # flush+release the TB writer on BOTH paths — a crash must
+            # not lose the last flush_secs of buffered scalars
+            rec.close()
         if self.checkpoint_dir:
             rec.save()
         model.cleanup()

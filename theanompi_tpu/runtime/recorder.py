@@ -34,11 +34,28 @@ class Recorder:
         rank: int = 0,
         verbose: bool = True,
         save_dir: Optional[str] = None,
+        tensorboard_dir: Optional[str] = None,
     ):
         self.print_freq = int(print_freq)
         self.rank = rank
         self.verbose = verbose
         self.save_dir = save_dir
+        # Optional TensorBoard mirror of the JSONL record (SURVEY.md §6
+        # metrics row: "structured JSONL + optional TensorBoard
+        # writer"). torch's SummaryWriter is the only TB implementation
+        # in this environment; unavailable → warn once, JSONL only.
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tensorboard_dir)
+            except Exception as e:
+                print(
+                    f"tensorboard writer unavailable "
+                    f"({type(e).__name__}: {e}); recording JSONL only",
+                    flush=True,
+                )
 
         self._t0: Dict[str, float] = {}
         # accumulated seconds per phase since last print
@@ -80,6 +97,8 @@ class Recorder:
         )
         if self.verbose and self.rank == 0:
             print(f"epoch {epoch} took {dt:.2f}s", flush=True)
+        if self._tb is not None:
+            self._tb.add_scalar("epoch/seconds", dt, epoch)
         self.epoch_start = None
         return dt
 
@@ -119,6 +138,11 @@ class Recorder:
             **{p: self._acc.get(p, 0.0) for p in PHASES},
         }
         self.history.append(row)
+        if self._tb is not None:
+            self._tb.add_scalar("train/cost", row["cost"], count)
+            self._tb.add_scalar("train/error", row["error"], count)
+            for p in PHASES:
+                self._tb.add_scalar(f"time/{p}", row[p], count)
         if self.verbose and self.rank == 0:
             t = {p: row[p] for p in PHASES}
             print(
@@ -139,6 +163,8 @@ class Recorder:
         SURVEY.md §3.7)."""
         row = {"kind": kind, **fields}
         self.events.append(row)
+        if self._tb is not None:
+            self._tb.add_text(f"event/{kind}", json.dumps(fields))
         if self.verbose and self.rank == 0:
             body = " ".join(
                 f"{k} {v:.4g}" if isinstance(v, float) else f"{k} {v}"
@@ -158,6 +184,10 @@ class Recorder:
                 "error_top5": float(error_top5),
             }
         )
+        if self._tb is not None:
+            self._tb.add_scalar("val/cost", float(cost), count)
+            self._tb.add_scalar("val/error", float(error), count)
+            self._tb.add_scalar("val/error_top5", float(error_top5), count)
 
     def print_val_info(self, count: int) -> None:
         if not self.val_history:
@@ -199,7 +229,15 @@ class Recorder:
                 f.write(json.dumps({"kind": "train", **row}) + "\n")
             for row in self.val_history:
                 f.write(json.dumps({"kind": "val", **row}) + "\n")
+        if self._tb is not None:
+            self._tb.flush()
         return path
+
+    def close(self) -> None:
+        """Release the TensorBoard writer (no-op without one)."""
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     @staticmethod
     def load(path: str) -> List[dict]:
