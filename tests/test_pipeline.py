@@ -206,12 +206,7 @@ def _assert_pp_lm_matches_single_device(cfg_pp, pp):
     mesh_pp = TransformerLM.build_mesh(config=cfg_pp)
     m_pp = TransformerLM(config=cfg_pp, mesh=mesh_pp)
     m_pp.compile_train()
-    n_dev = 1
-    for v in mesh_pp.shape.values():
-        n_dev *= int(v)
-    global_bs = int(cfg_pp["batch_size"]) * (
-        n_dev // (pp * int(cfg_pp.get("tp", 1)))
-    )
+    global_bs = int(cfg_pp["batch_size"]) * int(mesh_pp.shape[DATA_AXIS])
     m_1 = TransformerLM(
         config=dict(LM_CFG, batch_size=global_bs),
         mesh=make_mesh(devices=jax.devices()[:1]),
@@ -249,8 +244,8 @@ def test_pipelined_lm_stage_leaves_sharded_over_pp():
 def test_pipelined_lm_rejections():
     from theanompi_tpu.models.transformer import TransformerLM
 
-    with pytest.raises(ValueError, match="does not compose with sp"):
-        TransformerLM.build_mesh(config=dict(LM_CFG, pp=2, sp=2))
+    with pytest.raises(ValueError, match="does not divide"):
+        TransformerLM.build_mesh(config=dict(LM_CFG, pp=3, sp=2))  # 6 ∤ 8
     with pytest.raises(ValueError, match="must divide by pp"):
         cfg = dict(LM_CFG, pp=2, n_layers=3)
         TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))
@@ -265,6 +260,30 @@ def test_pipelined_lm_3d_dp_pp_tp_matches_single_device():
     unpipelined single-device model from the same (unstacked) weights."""
     _assert_pp_lm_matches_single_device(
         dict(LM_CFG, batch_size=4, pp=2, pp_micro=2, tp=2), pp=2
+    )
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "alltoall"])
+def test_pipelined_lm_3d_dp_pp_sp_matches_single_device(sp_mode):
+    """pp × sp: sequence shards over sp INSIDE every pipeline tick (the
+    ring/alltoall collectives run uniformly across pp ranks) — exact vs
+    the unpipelined single-device model."""
+    _assert_pp_lm_matches_single_device(
+        dict(LM_CFG, batch_size=4, pp=2, pp_micro=2, sp=2, sp_mode=sp_mode),
+        pp=2,
+    )
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "alltoall"])
+def test_pipelined_lm_4d_dp_pp_sp_tp_matches_single_device(sp_mode):
+    """The full 4-D composition dp×pp×sp×tp on 8 devices (dp=1): stages
+    over pp, sequence over sp (both layouts — alltoall exercises the
+    tp-local-heads shuffle inside the GPipe scan), Megatron splits over
+    tp — exact vs the unpipelined single-device model, same weights."""
+    _assert_pp_lm_matches_single_device(
+        dict(LM_CFG, batch_size=8, pp=2, pp_micro=2, sp=2, tp=2,
+             sp_mode=sp_mode),
+        pp=2,
     )
 
 

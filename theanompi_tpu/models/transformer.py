@@ -86,29 +86,26 @@ class TransformerLM(TpuModel):
         pp = int(cfg.get("pp", 1))
         devices = list(devices) if devices is not None else jax.devices()
         if pp > 1:
-            if sp > 1:
+            if len(devices) % (pp * sp * tp):
                 raise ValueError(
-                    f"pp={pp} does not compose with sp={sp} (sequence "
-                    f"sharding inside pipeline stages is not supported)"
-                )
-            if len(devices) % (pp * tp):
-                raise ValueError(
-                    f"pp={pp}·tp={tp} does not divide {len(devices)} devices"
+                    f"pp={pp}·sp={sp}·tp={tp} does not divide "
+                    f"{len(devices)} devices"
                 )
             from theanompi_tpu.runtime.mesh import PP_AXIS
 
+            # innermost → outermost: tp (hottest per-microbatch psums),
+            # sp (ring/alltoall hops), pp (stage hops), dp. Axes of
+            # size 1 are omitted so the simple cases keep simple meshes.
+            shape = [len(devices) // (pp * sp * tp), pp]
+            names = [DATA_AXIS, PP_AXIS]
+            if sp > 1:
+                shape.append(sp)
+                names.append(SEQ_AXIS)
             if tp > 1:
-                # innermost = tp (its per-microbatch psums are the
-                # hottest collectives), pp next (neighbor hops)
-                return make_mesh(
-                    shape=(len(devices) // (pp * tp), pp, tp),
-                    axis_names=(DATA_AXIS, PP_AXIS, TP_AXIS),
-                    devices=devices,
-                )
+                shape.append(tp)
+                names.append(TP_AXIS)
             return make_mesh(
-                shape=(len(devices) // pp, pp),
-                axis_names=(DATA_AXIS, PP_AXIS),
-                devices=devices,
+                shape=tuple(shape), axis_names=tuple(names), devices=devices
             )
         if len(devices) % (sp * tp):
             raise ValueError(
@@ -139,11 +136,6 @@ class TransformerLM(TpuModel):
         if pp > 1:
             from theanompi_tpu.runtime.mesh import PP_AXIS
 
-            if sp > 1:
-                raise ValueError(
-                    f"pp={pp} does not compose with sp={sp} (sequence "
-                    f"sharding inside pipeline stages is not supported)"
-                )
             if int(cfg.get("moe_experts", 0)):
                 raise ValueError(
                     "pp does not compose with MoE blocks (the GPipe scan "
@@ -156,20 +148,38 @@ class TransformerLM(TpuModel):
                     f"(homogeneous stages of n_layers/pp blocks)"
                 )
             self._require_mesh_axis(mesh, PP_AXIS, pp)
+            # mirror the non-pipelined path: a hand-built mesh's sp/tp
+            # axes are ADOPTED when the config doesn't name them —
+            # otherwise half the devices would silently run duplicate
+            # replicated work over an unused axis
+            if sp == 1 and SEQ_AXIS in mesh.axis_names:
+                sp = int(mesh.shape[SEQ_AXIS])
+            if tp == 1 and TP_AXIS in mesh.axis_names:
+                tp = int(mesh.shape[TP_AXIS])
+            if sp > 1:
+                self._require_mesh_axis(mesh, SEQ_AXIS, sp)
             if tp > 1:
                 self._require_mesh_axis(mesh, TP_AXIS, tp)
             self.pp_size = pp
-            self.sp_size = 1
+            self.sp_size = sp
             self.tp_size = tp
-            # batch shards over dp, replicated over pp/tp (stage masking
-            # in the GPipe scan selects what each stage consumes); stage-
-            # stacked leaves skip pp — and their Megatron-split dims skip
-            # tp — via param_specs; replicated leaves carry identical
-            # grads across pp (entry/exit custom-VJP pair) and tp (the
-            # in-block f/g pair), so both join the mean axes harmlessly
-            self.batch_spec = P(DATA_AXIS)
-            self.exchange_axes = (DATA_AXIS, PP_AXIS) + (
-                (TP_AXIS,) if tp > 1 else ()
+            # batch shards over dp and (when sp) the sequence dim over
+            # sp; replicated over pp/tp (stage masking in the GPipe scan
+            # selects what each stage consumes). The ring/alltoall sp
+            # collectives run inside every pipeline tick, uniformly
+            # across pp ranks — SPMD-safe. Stage-stacked leaves skip pp
+            # — and their Megatron-split dims skip tp — via param_specs;
+            # replicated leaves carry identical grads across pp
+            # (entry/exit custom-VJP pair) and tp (the in-block f/g
+            # pair); sp shards hold partial token grads, so sp always
+            # joins the mean axes.
+            self.batch_spec = (
+                P(DATA_AXIS, SEQ_AXIS) if sp > 1 else P(DATA_AXIS)
+            )
+            self.exchange_axes = (
+                (DATA_AXIS, PP_AXIS)
+                + ((SEQ_AXIS,) if sp > 1 else ())
+                + ((TP_AXIS,) if tp > 1 else ())
             )
             super().__init__(cfg, mesh=mesh)
             self.param_specs = self._build_param_specs()
