@@ -359,6 +359,11 @@ def run_gosgd_peer(
                 )
             worker._merge_inbox()  # late gossip folds into rank 0's mass
             time.sleep(0.05)
+        # one defensive drain after the last final: per-sender FIFO on
+        # the persistent-connection transport already guarantees a
+        # peer's gossip precedes its final, but consensus mass must not
+        # depend on that subtlety — any straggler gossip folds in here
+        worker._merge_inbox()
         entries = [(worker.get_params(), worker.weight)] + adapter.finals
         tot = sum(w for _, w in entries)
         acc = None

@@ -86,10 +86,22 @@ class TcpMailbox:
     """Cross-process Mailbox: same send/drain/recv surface, TCP inside.
 
     ``addresses[r]`` is rank r's ``(host, port)`` listener address; this
-    rank binds and serves ``addresses[rank]``. One connection per
-    message — exchanges happen every τ iterations, so connection setup
-    is noise next to the parameter payload (reference: one MPI message
-    pair per exchange)."""
+    rank binds and serves ``addresses[rank]``.
+
+    Delivery model — both properties matter to the async rules:
+
+    - **per-sender FIFO**: ``send`` keeps ONE persistent connection per
+      destination, so a sender's frames ride a single TCP stream and
+      are decoded in order by that stream's receive thread. GOSGD's
+      shutdown depends on this: a peer's ``final`` must not overtake
+      its in-flight gossip pushes, or the consensus weight mass drifts
+      (the in-process path guards the same invariant in
+      ``async_workers._finalize``).
+    - **cross-sender concurrency**: each accepted connection gets its
+      own receive thread, so one slow or large sender never serializes
+      other peers' deliveries (MPI's progress engine overlaps receives
+      the same way).
+    """
 
     def __init__(self, rank: int, addresses: Sequence[Tuple[str, int]]):
         from theanompi_tpu.parallel import wire
@@ -104,6 +116,9 @@ class TcpMailbox:
         self._listener.bind(("0.0.0.0", self.addresses[self.rank][1]))
         self._listener.listen(64)
         self._closed = False
+        # persistent sender connections: dst -> (lock, socket|None)
+        self._out: Dict[int, Tuple[threading.Lock, Optional[socket.socket]]] = {}
+        self._out_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._serve, name=f"TcpMailbox-{rank}", daemon=True
         )
@@ -115,16 +130,48 @@ class TcpMailbox:
                 conn, _ = self._listener.accept()
             except OSError:
                 return  # listener closed
-            try:
-                with conn:
+            threading.Thread(
+                target=self._recv_stream, args=(conn,), daemon=True
+            ).start()
+
+    def _recv_stream(self, conn: socket.socket) -> None:
+        """Decode frames from one sender's stream, in order, until it
+        closes. A truncated tail frame is dropped (the sender sees the
+        reset and reconnects on its next send)."""
+        try:
+            with conn:
+                while True:
                     self._q.put(self._wire.decode(recv_frame(conn)))
-            except (ConnectionError, OSError):
-                continue  # truncated frame: drop, sender will see the reset
+        except (ConnectionError, OSError):
+            pass  # clean EOF between frames lands here too
 
     def send(self, dst: int, msg: Any) -> None:
-        host, port = self.addresses[dst]
-        with socket.create_connection((host, port), timeout=60) as s:
-            send_frame(s, self._wire.encode(msg))
+        with self._out_lock:
+            if dst not in self._out:
+                self._out[dst] = (threading.Lock(), None)
+            lock, _ = self._out[dst]
+        payload = self._wire.encode(msg)
+        with lock:
+            sock = self._out[dst][1]
+            for attempt in (0, 1):
+                if sock is None:
+                    host, port = self.addresses[dst]
+                    sock = socket.create_connection((host, port), timeout=60)
+                    self._out[dst] = (lock, sock)
+                try:
+                    send_frame(sock, payload)
+                    return
+                except OSError:
+                    # stale connection (receiver restarted): retry once
+                    # on a fresh socket, then propagate
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                    self._out[dst] = (lock, None)
+                    if attempt:
+                        raise
 
     def drain(self, rank: Optional[int] = None) -> List[Any]:
         """All queued messages (``rank`` accepted for Mailbox interface
@@ -145,6 +192,14 @@ class TcpMailbox:
             self._listener.close()
         except OSError:
             pass
+        with self._out_lock:
+            for lock, sock in self._out.values():
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._out.clear()
 
 
 class TcpServerChannel:
