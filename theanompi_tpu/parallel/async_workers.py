@@ -280,6 +280,7 @@ class _AsyncDriverBase:
         checkpoint_dir: Optional[str] = None,
         verbose: bool = True,
         val_freq: int = 1,  # 0 = skip final validation of the result model
+        tensorboard_dir: Optional[str] = None,  # rank-0 TB mirror
     ):
         self.modelfile = modelfile
         self.modelclass = modelclass
@@ -290,6 +291,7 @@ class _AsyncDriverBase:
         self.checkpoint_dir = checkpoint_dir
         self.verbose = verbose
         self.val_freq = val_freq
+        self.tensorboard_dir = tensorboard_dir
         self.workers: List[_AsyncWorkerBase] = []
         self.result_model = None
 
@@ -300,6 +302,7 @@ class _AsyncDriverBase:
             rank=rank,
             verbose=self.verbose and rank == 0,
             save_dir=self.checkpoint_dir,
+            tensorboard_dir=self.tensorboard_dir if rank == 0 else None,
         )
 
     def _build_workers(self):
@@ -326,18 +329,28 @@ class _AsyncDriverBase:
         for t in threads:
             t.join()
         self._stop_aux()
-        errs = [w.error for w in self.workers if w.error is not None]
-        if errs:
-            raise errs[0]
-        self._finalize()
-        if self.val_freq and self.result_model is not None:
-            # validate the consensus/center model (reference: the EASGD
-            # server owns validation of the center params; SURVEY.md §4.3)
-            rec = self.workers[0].recorder
-            self.result_model.run_validation(0, rec)
-        if self.checkpoint_dir:
+        try:
+            errs = [w.error for w in self.workers if w.error is not None]
+            if errs:
+                raise errs[0]
+            self._finalize()
+            if self.val_freq and self.result_model is not None:
+                # validate the consensus/center model (reference: the EASGD
+                # server owns validation of the center params; SURVEY.md §4.3)
+                rec = self.workers[0].recorder
+                self.result_model.run_validation(0, rec)
+            if self.checkpoint_dir:
+                for w in self.workers:
+                    w.recorder.save()
+        finally:
+            # release TB writers even when a worker raised — an unclosed
+            # SummaryWriter loses its last flush window and leaks its
+            # daemon thread in the still-running process
             for w in self.workers:
-                w.recorder.save()
+                w.recorder.close()
+            srv_rec = getattr(self, "server_recorder", None)
+            if srv_rec is not None:
+                srv_rec.close()
 
 
 class EASGD_Driver(_AsyncDriverBase):
@@ -407,6 +420,13 @@ class EASGD_Driver(_AsyncDriverBase):
         self.server_recorder = Recorder(
             print_freq=1, rank=0, verbose=self.verbose,
             save_dir=self.checkpoint_dir,
+            # the center's per-epoch validation curve is THE metric of
+            # an EASGD run — mirror it under its own TB run dir
+            tensorboard_dir=(
+                os.path.join(self.tensorboard_dir, "center")
+                if self.tensorboard_dir
+                else None
+            ),
         )
         for w in self.workers:
             w.server = self.server
