@@ -176,3 +176,127 @@ def test_host_snapshot_passes_scalars_through():
     assert snap["epoch"] == 7 and isinstance(snap["epoch"], int)
     assert snap["tag"] == "x"
     assert isinstance(snap["w"], np.ndarray)
+
+
+# -- retention ---------------------------------------------------------------
+
+def test_prune_keeps_newest(tmp_path):
+    import os
+    import time
+
+    for i in range(5):
+        ckpt.save(str(tmp_path / f"ckpt_{i:04d}.npz"), {"w": np.ones(2) * i})
+        os.utime(str(tmp_path / f"ckpt_{i:04d}.npz"), (i, i))  # force order
+    deleted = ckpt.prune(str(tmp_path), keep_last=2)
+    left = sorted(f.name for f in tmp_path.glob("ckpt_*.npz"))
+    assert left == ["ckpt_0003.npz", "ckpt_0004.npz"]
+    assert len(deleted) == 3
+    assert ckpt.latest(str(tmp_path)).endswith("ckpt_0004.npz")
+
+
+def test_prune_rejects_zero_keep(tmp_path):
+    with pytest.raises(ValueError, match="keep_last"):
+        ckpt.prune(str(tmp_path), keep_last=0)
+
+
+def test_worker_keep_last_prunes(tmp_path):
+    import jax
+
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.parallel.workers import BSP_Worker
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    m = Cifar10_model(
+        config=dict(batch_size=8, n_epochs=3, n_synth_train=32,
+                    n_synth_val=16, print_freq=1000, comm_probe=False),
+        mesh=make_mesh(devices=jax.devices()[:2]),
+    )
+    BSP_Worker(m, val_freq=0, checkpoint_dir=str(tmp_path), keep_last=1,
+               async_checkpoint=False).run()
+    ckpts = sorted(f.name for f in tmp_path.glob("ckpt_*.npz"))
+    assert ckpts == ["ckpt_0003.npz"]  # sync saves: exact retention
+
+
+def test_worker_keep_last_prunes_async(tmp_path):
+    """Async saves land during the final drain — the exit-time prune
+    must still leave exactly keep_last files."""
+    import jax
+
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.parallel.workers import BSP_Worker
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    m = Cifar10_model(
+        config=dict(batch_size=8, n_epochs=3, n_synth_train=32,
+                    n_synth_val=16, print_freq=1000, comm_probe=False),
+        mesh=make_mesh(devices=jax.devices()[:2]),
+    )
+    BSP_Worker(m, val_freq=0, checkpoint_dir=str(tmp_path), keep_last=1).run()
+    ckpts = sorted(f.name for f in tmp_path.glob("ckpt_*.npz"))
+    assert ckpts == ["ckpt_0003.npz"]
+
+
+# -- property-based round-trips (hypothesis) ---------------------------------
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_scalars = st.one_of(
+    st.booleans(),
+    st.integers(-2**31, 2**31 - 1),
+    st.floats(allow_nan=False, allow_infinity=False, width=32),
+    st.text(max_size=8),
+    st.none(),
+)
+_arrays = st.builds(
+    lambda shape, dt, seed: np.random.RandomState(seed)
+    .randint(-1000, 1000, size=shape)
+    .astype(dt),
+    st.lists(st.integers(0, 4), min_size=0, max_size=3).map(tuple),
+    st.sampled_from([np.float32, np.int32, np.float16, np.uint8]),
+    st.integers(0, 2**31 - 1),
+)
+_leaves = st.one_of(_scalars, _arrays)
+_trees = st.recursive(
+    _leaves,
+    lambda kids: st.one_of(
+        st.lists(kids, max_size=3),
+        st.dictionaries(st.text(min_size=1, max_size=6), kids, max_size=3),
+        st.tuples(kids, kids),
+    ),
+    max_leaves=12,
+)
+
+
+def _assert_tree_equal(a, b):
+    if isinstance(a, dict):
+        assert isinstance(b, dict) and list(a) == list(b)
+        for k in a:
+            _assert_tree_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert type(b) is type(a) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_tree_equal(x, y)
+    elif isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    else:
+        assert type(b) is type(a) and a == b
+
+
+@settings(max_examples=60, deadline=None)
+@given(_trees)
+def test_checkpoint_roundtrip_property(tmp_path_factory, tree):
+    """ANY supported pytree survives save→restore exactly — structure,
+    dtypes, python kinds, insertion order."""
+    p = tmp_path_factory.mktemp("prop") / "c.npz"
+    ckpt.save(str(p), tree)
+    _assert_tree_equal(tree, ckpt.restore(str(p)))
+
+
+@settings(max_examples=60, deadline=None)
+@given(_trees)
+def test_wire_roundtrip_property(tree):
+    """The transport codec holds the same round-trip contract."""
+    from theanompi_tpu.parallel import wire
+
+    _assert_tree_equal(tree, wire.decode(wire.encode(tree)))

@@ -28,7 +28,12 @@ def encode(tree: Any) -> bytes:
     """Pytree of arrays/scalars/containers → one framed bytes blob."""
     leaves: List[np.ndarray] = []
     structure = _encode(tree, leaves)
-    leaves = [np.ascontiguousarray(a) for a in leaves]
+    # NOT np.ascontiguousarray: it silently promotes 0-d arrays to
+    # shape (1,) (found by the hypothesis round-trip property) — a 0-d
+    # array is trivially contiguous, only reorder ndim >= 1
+    leaves = [
+        a if a.ndim == 0 else np.ascontiguousarray(a) for a in leaves
+    ]
     header = json.dumps(
         {
             "structure": structure,

@@ -42,6 +42,11 @@ class BSP_Worker:
         # its buffers); False = block the loop on the disk write
         tensorboard_dir: Optional[str] = None,  # mirror the record to
         # TensorBoard event files (rank 0 only)
+        keep_last: Optional[int] = None,  # prune to the newest N
+        # checkpoints after each save (None = keep all, the reference's
+        # behavior). With async saves the in-flight file lands after the
+        # prune, so N+1 can exist transiently mid-run; a final prune
+        # after the drain restores exactly N at exit.
     ):
         import jax
 
@@ -66,6 +71,7 @@ class BSP_Worker:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_freq = checkpoint_freq
         self.resume = resume
+        self.keep_last = keep_last
         self._ckpt = None
         if async_checkpoint and checkpoint_dir and self.process_index == 0:
             from theanompi_tpu.utils.checkpoint import AsyncCheckpointer
@@ -167,6 +173,10 @@ class BSP_Worker:
                         self.checkpoint_dir, f"ckpt_{epoch + 1:04d}.npz"
                     )
                     model.save_model(path, checkpointer=self._ckpt)
+                    if self.keep_last:
+                        from theanompi_tpu.utils import checkpoint as ckpt
+
+                        ckpt.prune(self.checkpoint_dir, self.keep_last)
         finally:
             # drain the background writer EVEN when the loop raises — a
             # crash mid-epoch must not kill the daemon thread before the
@@ -180,6 +190,13 @@ class BSP_Worker:
 
                 if sys.exc_info()[0] is None:
                     self._ckpt.close()
+                    if self.keep_last and self.process_index == 0:
+                        # the last async save only lands during close();
+                        # without this final prune the run would exit
+                        # with keep_last+in-flight files on disk
+                        from theanompi_tpu.utils import checkpoint as ckpt
+
+                        ckpt.prune(self.checkpoint_dir, self.keep_last)
                 else:
                     try:
                         self._ckpt.close()

@@ -275,16 +275,43 @@ class AsyncCheckpointer:
         self.close()
 
 
-def latest(dir_path: str, prefix: str = "ckpt_") -> str | None:
-    """Most recent checkpoint in a directory (for restart-from-failure)."""
+def _list_checkpoints(dir_path: str, prefix: str) -> List[str]:
+    """Checkpoint filenames oldest→newest. THE ordering both
+    :func:`latest` and :func:`prune` use — they must agree, or prune
+    could delete the file a restart would try to resume from. Ties on
+    mtime (coarse-granularity filesystems write two fast epochs in one
+    quantum) break on the name, whose zero-padded epoch number sorts
+    correctly."""
     if not os.path.isdir(dir_path):
-        return None
+        return []
     cands = [
         f
         for f in os.listdir(dir_path)
         if f.startswith(prefix) and f.endswith(".npz")
     ]
-    if not cands:
-        return None
-    cands.sort(key=lambda f: os.path.getmtime(os.path.join(dir_path, f)))
-    return os.path.join(dir_path, cands[-1])
+    cands.sort(key=lambda f: (os.path.getmtime(os.path.join(dir_path, f)), f))
+    return cands
+
+
+def latest(dir_path: str, prefix: str = "ckpt_") -> str | None:
+    """Most recent checkpoint in a directory (for restart-from-failure)."""
+    cands = _list_checkpoints(dir_path, prefix)
+    return os.path.join(dir_path, cands[-1]) if cands else None
+
+
+def prune(dir_path: str, keep_last: int, prefix: str = "ckpt_") -> List[str]:
+    """Delete all but the newest ``keep_last`` checkpoints matching
+    ``prefix`` (a 90-epoch run writes 90 full-state snapshots — disk is
+    finite; the reference kept everything and left cleanup to the
+    operator). Returns the deleted paths. ``keep_last`` must be >= 1:
+    the restart path must always find something."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    cands = _list_checkpoints(dir_path, prefix)
+    doomed = [os.path.join(dir_path, f) for f in cands[:-keep_last]]
+    for p in doomed:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass  # already gone (concurrent prune) — not an error
+    return doomed
