@@ -202,3 +202,21 @@ def test_easgd_worker_error_propagates():
             n_workers=4,  # more workers than devices
         )
         rule.wait()
+
+
+def test_easgd_keep_last_prunes_center(tmp_path):
+    import theanompi_tpu
+
+    rule = theanompi_tpu.EASGD()
+    rule.init(
+        devices=4,
+        model_config=dict(batch_size=4, n_epochs=3, n_synth_train=32,
+                          n_synth_val=16, print_freq=1000, comm_probe=False),
+        n_workers=2,
+        checkpoint_dir=str(tmp_path),
+        keep_last=1,
+        val_freq=0,
+    )
+    rule.wait()
+    centers = sorted(f.name for f in tmp_path.glob("ckpt_center_*.npz"))
+    assert centers == ["ckpt_center_0003.npz"]

@@ -24,8 +24,10 @@ TINY = dict(
 )
 
 
-def _losses(mesh, per_shard_bs, n_steps=4):
-    model = Cifar10_model(config=dict(TINY, batch_size=per_shard_bs), mesh=mesh)
+def _losses(mesh, per_shard_bs, n_steps=4, **cfg):
+    model = Cifar10_model(
+        config=dict(TINY, batch_size=per_shard_bs, **cfg), mesh=mesh
+    )
     model.compile_train()
     rec = Recorder(verbose=False)
     model.reset_train_iter(0)
@@ -80,3 +82,14 @@ def test_hybrid_avg_mode_matches_flat():
     leaf = jax.tree.leaves(m.params)[0]
     shards = [np.asarray(s.data) for s in leaf.addressable_shards]
     np.testing.assert_array_equal(shards[0], shards[-1])
+
+
+@pytest.mark.parametrize("strategy", ["bf16", "int8", "pallas_int8_sr"])
+def test_hybrid_compressed_strategies_track_flat_ar(strategy):
+    """Compressed wires compose with the two-level mesh: the reduce
+    runs hierarchically (quantize→sum per axis: ICI first, DCN second),
+    and training must track the flat fp32 baseline within the same
+    tolerance the single-level compressed paths hold."""
+    hybrid = _losses(make_mesh(dcn_shape=2), 8, exch_strategy=strategy)
+    flat_ar = _losses(make_mesh(), 8, exch_strategy="ar")
+    np.testing.assert_allclose(hybrid, flat_ar, rtol=5e-2)

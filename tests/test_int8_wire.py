@@ -258,3 +258,40 @@ def test_int8_wire_bytes_actually_shrink():
     assert any(
         sz >= n // 8 for l in ar_lines for sz in _f32_elems(l)
     )  # the baseline really does move fp32 payloads
+
+
+# -- property-based quantizer bounds (hypothesis) ----------------------------
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_blocks = st.builds(
+    lambda rows, scale, seed: (
+        np.random.RandomState(seed).randn(rows, Q.BLOCK) * scale
+    ).astype(np.float32),
+    st.integers(1, 4),
+    st.sampled_from([1e-6, 1e-2, 1.0, 1e4]),
+    st.integers(0, 2**31 - 1),
+)
+
+
+@settings(max_examples=40, deadline=None)
+@given(_blocks)
+def test_quantize_error_bound_property(x):
+    """Round-to-nearest: |dequant - x| <= quantum/2 per element, for any
+    block magnitude from 1e-6 to 1e4."""
+    q, s = Q.quantize_blocks(x)
+    back = np.asarray(Q.dequantize_blocks(q, s))
+    # epsilon RELATIVE to the quantum: an exact .5 tie plus one ulp of
+    # fp32 scale rounding lands a hair past s/2 (hypothesis found it)
+    bound = np.asarray(s)[:, None] * (0.5 + 1e-5)
+    assert (np.abs(back - x) <= bound).all()
+
+
+@settings(max_examples=20, deadline=None)
+@given(_blocks, st.integers(0, 2**31 - 1))
+def test_quantize_sr_error_bound_property(x, key):
+    """Stochastic rounding: |dequant - x| < one quantum per element."""
+    q, s = Q.quantize_blocks(x, jax.random.PRNGKey(key))
+    back = np.asarray(Q.dequantize_blocks(q, s))
+    bound = np.asarray(s)[:, None] * (1.0 + 1e-5)
+    assert (np.abs(back - x) < bound).all()

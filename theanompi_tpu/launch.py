@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument(
+        "--keep-last", type=int, default=None, metavar="N",
+        help="prune checkpoints to the newest N after each save "
+        "(BSP snapshots / EASGD center; default: keep all)",
+    )
+    p.add_argument(
         "--restarts", type=int, default=0,
         help="restart-from-checkpoint budget on crash (0 = fail fast)",
     )
@@ -115,6 +120,7 @@ def _async_distributed_main(args) -> int:
         if rank == 0:
             da.run_easgd_server(
                 size, addresses[0], alpha=args.alpha, resume=args.resume,
+                keep_last=args.keep_last,
                 **common,
             )
         else:
@@ -208,6 +214,8 @@ def main(argv=None) -> int:
 
     def make_kwargs(resume: bool):
         kw = {}
+        if args.keep_last:
+            kw["keep_last"] = args.keep_last
         if args.rule == "BSP":
             kw.update(checkpoint_dir=args.checkpoint_dir, resume=resume)
         else:

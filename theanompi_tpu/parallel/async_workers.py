@@ -281,6 +281,9 @@ class _AsyncDriverBase:
         verbose: bool = True,
         val_freq: int = 1,  # 0 = skip final validation of the result model
         tensorboard_dir: Optional[str] = None,  # rank-0 TB mirror
+        keep_last: Optional[int] = None,  # EASGD: prune per-epoch center
+        # snapshots to the newest N (None = keep all). No-op for GOSGD,
+        # which only writes one final consensus file.
     ):
         self.modelfile = modelfile
         self.modelclass = modelclass
@@ -292,6 +295,7 @@ class _AsyncDriverBase:
         self.verbose = verbose
         self.val_freq = val_freq
         self.tensorboard_dir = tensorboard_dir
+        self.keep_last = keep_last
         self.workers: List[_AsyncWorkerBase] = []
         self.result_model = None
 
@@ -503,6 +507,11 @@ class EASGD_Driver(_AsyncDriverBase):
                 {"params": center, "epoch": epoch + 1, "alpha": self.alpha,
                  "tau": self.tau},
             )
+            if self.keep_last:
+                ckpt.prune(
+                    self.checkpoint_dir, self.keep_last,
+                    prefix="ckpt_center_",
+                )
         if self.val_freq and (epoch + 1) % self.val_freq == 0:
             w0 = self.workers[0]
             loss, err, _ = m.run_validation(

@@ -85,6 +85,7 @@ def run_easgd_server(
     resume: bool = False,
     verbose: bool = True,
     timeout: float = 3600.0,
+    keep_last: Optional[int] = None,  # prune center snapshots to newest N
 ):
     """Rank 0: the reference ``EASGD_Server.run()`` loop, TCP-served.
 
@@ -182,6 +183,9 @@ def run_easgd_server(
                     os.path.join(checkpoint_dir, f"ckpt_center_{epoch + 1:04d}.npz"),
                     {"params": center, "epoch": epoch + 1, "alpha": alpha},
                 )
+                if keep_last:
+                    ckpt.prune(checkpoint_dir, keep_last,
+                               prefix="ckpt_center_")
             if val_freq and (epoch + 1) % val_freq == 0:
                 loss, err, _ = model.run_validation(
                     (epoch + 1) * model.data.n_batch_train,
