@@ -49,15 +49,9 @@ def measure(cfg_overrides, steps=120):
 
 
 if __name__ == "__main__":
-    configs = [
-        ("xla", dict(lrn_impl="xla")),
-        ("xla+remat", dict(lrn_impl="xla", lrn_remat=True)),
-        ("shift", dict(lrn_impl="shift")),
-        ("shift+remat", dict(lrn_impl="shift", lrn_remat=True)),
-        ("window", dict(lrn_impl="window")),
-        ("maskpool", dict(pool_grad="mask")),
-        ("shift+maskpool", dict(lrn_impl="shift", pool_grad="mask")),
-    ]
+    from theanompi_tpu.utils.benchmark import PERF_SWEEP_CONFIGS
+
+    configs = [(name, dict(cfg)) for name, cfg in PERF_SWEEP_CONFIGS]
     only = sys.argv[1:] or None  # run one config per process: safer on
     # the single-client axon tunnel (see .claude/skills/verify/SKILL.md)
     if only:

@@ -53,8 +53,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", default="{}", help="model config JSON")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
+    def _positive(v):
+        n = int(v)
+        if n < 1:  # fail at parse time, not hours in at the first prune
+            raise argparse.ArgumentTypeError("--keep-last must be >= 1")
+        return n
+
     p.add_argument(
-        "--keep-last", type=int, default=None, metavar="N",
+        "--keep-last", type=_positive, default=None, metavar="N",
         help="prune checkpoints to the newest N after each save "
         "(BSP snapshots / EASGD center; default: keep all)",
     )

@@ -19,6 +19,30 @@ import jax
 from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
 
 
+# THE perf-knob config registry (docs/perf/NOTES.md) — the single
+# source both `scripts/bench_sweep.py` (full sweep, one config per
+# process on the single-client tunnel) and `bench.py` (short
+# self-selection before the flagship measurement) draw from, so the
+# two can never drift.
+PERF_SWEEP_CONFIGS = (
+    ("xla", {"lrn_impl": "xla"}),
+    ("xla+remat", {"lrn_impl": "xla", "lrn_remat": True}),
+    ("shift", {"lrn_impl": "shift"}),
+    ("shift+remat", {"lrn_impl": "shift", "lrn_remat": True}),
+    ("window", {"lrn_impl": "window"}),
+    ("maskpool", {"pool_grad": "mask"}),
+    ("shift+maskpool", {"lrn_impl": "shift", "pool_grad": "mask"}),
+)
+
+# bench.py's candidate subset: the r1-measured default plus the
+# trace-driven contenders worth a compile each at bench time
+BENCH_CANDIDATES = (
+    ("r1-default", {}),
+    ("maskpool", {"pool_grad": "mask"}),
+    ("shift+maskpool", {"lrn_impl": "shift", "pool_grad": "mask"}),
+)
+
+
 def measure_step_time(
     model, n_steps: int = 20, warmup: int = 3, train_fn=None, max_batches: int = 8
 ) -> float:
