@@ -130,11 +130,13 @@ def main():
     best_est = short_est(first_model, first_fn)
     picks["r1-default"] = round(best_est * 1e3, 3)
     for name, extra in CANDIDATES[1:]:
+        m = fn = None
         try:
             m, fn = build(dict(extra))
             est = short_est(m, fn)
         except Exception as e:  # a candidate must never kill the bench
             picks[name] = f"failed: {type(e).__name__}"
+            del m, fn  # a failed candidate must not stay HBM-resident
             continue
         picks[name] = round(est * 1e3, 3)
         if est < best_est:

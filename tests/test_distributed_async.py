@@ -105,3 +105,43 @@ def test_gosgd_across_processes(tmp_path):
     blob = ckpt.restore(str(tmp_path / "ckpt_consensus.npz"))
     for leaf in _leaves(blob["params"]):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+@pytest.mark.distributed
+def test_easgd_fp16_wire_across_processes(tmp_path):
+    """--wire-dtype float16: exchanges carry fp16 payloads (reference's
+    fp16 exchange story on the async path) and the run still trains,
+    validates, and checkpoints the center."""
+    port = find_free_port()
+    spawn_local(
+        3,
+        [
+            "--rule", "EASGD", "--config", CFG,
+            "--checkpoint-dir", str(tmp_path),
+            "--tau", "2",
+            "--async-port-base", str(port),
+            "--wire-dtype", "float16",
+        ],
+        local_device_count=1,
+        env_extra=_cache_env(tmp_path),
+        timeout=600,
+        stream_output=False,
+    )
+    assert (tmp_path / "ckpt_center.npz").exists()
+    from theanompi_tpu.utils import checkpoint as ckpt
+
+    blob = ckpt.restore(str(tmp_path / "ckpt_center.npz"))
+    for leaf in _leaves(blob["params"]):
+        a = np.asarray(leaf)
+        assert np.isfinite(a).all()
+        if a.dtype.kind == "f":
+            assert a.dtype == np.float32  # wire dtype never leaks into state
+    # the server RECORDS what dtype actually rode the wire — a refactor
+    # that silently drops the compression turns this row float32
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "record_server.jsonl").read_text().splitlines()
+    ]
+    wire_rows = [r for r in rows if r["kind"] == "async_wire"]
+    assert wire_rows and wire_rows[0]["dtype"] == "float16"
+    assert wire_rows[0]["n_exchanges"] > 0

@@ -97,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated host per rank for the async transport "
         "(default: all localhost)",
     )
+    p.add_argument(
+        "--wire-dtype", choices=["float32", "float16"], default="float32",
+        help="async-exchange payload dtype: float16 halves EASGD/GOSGD "
+        "parameter bytes on the wire (the reference's fp16 exchange "
+        "story); math always runs fp32",
+    )
     return p
 
 
@@ -113,12 +119,15 @@ def _async_distributed_main(args) -> int:
     hosts = args.async_hosts.split(",") if args.async_hosts else None
     addresses = da.default_addresses(size, hosts, args.async_port_base)
     model_config = _json.loads(args.config)
+    import numpy as _np
+
     common = dict(
         modelfile=args.modelfile,
         modelclass=args.modelclass,
         model_config=model_config,
         n_epochs=None,
         checkpoint_dir=args.checkpoint_dir,
+        wire_dtype=_np.float16 if args.wire_dtype == "float16" else None,
     )
     if args.rule == "EASGD":
         if size < 2:
@@ -214,6 +223,16 @@ def main(argv=None) -> int:
 
     import theanompi_tpu
     from theanompi_tpu.runtime.fault import run_with_restart
+
+    if args.wire_dtype != "float32":
+        # only the cross-process async transport has a wire; accepting
+        # the flag for BSP would let a user benchmark believing
+        # compression is on (BSP's exchange compresses via the model's
+        # exch_strategy config instead)
+        raise SystemExit(
+            "--wire-dtype applies to the --dist-* EASGD/GOSGD paths; "
+            "for BSP use exch_strategy (bf16/int8/...) in --config"
+        )
 
     model_config = json.loads(args.config)
     rule_cls = getattr(theanompi_tpu, args.rule)

@@ -55,17 +55,72 @@ def default_addresses(n: int, hosts: Optional[Sequence[str]], port_base: int) ->
     return [(hosts[r], port_base + r) for r in range(n)]
 
 
-class _RemoteServer:
-    """Client proxy with the in-process EASGD_Server's exchange surface."""
+def _cast_wire(tree: Any, dtype) -> Any:
+    """Cast fp32 array leaves to ``dtype`` (everything else untouched) —
+    the compressed-wire half of the reference's fp16 exchange story
+    (SURVEY.md §3.3 ``Exch_asa16``) applied to the async TCP path: the
+    parameter payload is ~2× fewer bytes per exchange, and quantization
+    noise rides the same channel asynchrony already makes noisy."""
+    def leaf(a):
+        if isinstance(a, np.ndarray) and a.dtype == np.float32:
+            return a.astype(dtype)
+        return a
 
-    def __init__(self, address: Address):
+    return jax.tree.map(leaf, tree)
+
+
+def _uncast_wire(tree: Any) -> Any:
+    """fp16 leaves back to fp32 after decode (training math never runs
+    in the wire dtype)."""
+    def leaf(a):
+        if isinstance(a, np.ndarray) and a.dtype == np.float16:
+            return a.astype(np.float32)
+        return a
+
+    return jax.tree.map(leaf, tree)
+
+
+class _RemoteServer:
+    """Client proxy with the in-process EASGD_Server's exchange surface.
+
+    ``wire_dtype`` (e.g. ``np.float16``) compresses the parameter
+    payload both ways; elastic math always runs fp32 at the server."""
+
+    def __init__(self, address: Address, wire_dtype=None):
         self.address = address
+        self.wire_dtype = wire_dtype
 
     def exchange(self, worker_params):
-        reply = request(
-            self.address, {"kind": "exchange", "params": worker_params}
+        w = (
+            _cast_wire(worker_params, self.wire_dtype)
+            if self.wire_dtype
+            else worker_params
         )
-        return reply["params"]
+        reply = request(self.address, {"kind": "exchange", "params": w})
+        return _uncast_wire(reply["params"])
+
+
+class _CompressedMailbox:
+    """Mailbox decorator: fp32 leaves ride the TCP frames in
+    ``wire_dtype``; receives upcast back to fp32. The GOSGD analog of
+    the EASGD proxy's compressed exchange."""
+
+    def __init__(self, inner, wire_dtype):
+        self._inner = inner
+        self._dt = wire_dtype
+        self.n_ranks = inner.n_ranks
+
+    def send(self, dst: int, msg: Any) -> None:
+        self._inner.send(dst, _cast_wire(msg, self._dt))
+
+    def drain(self, rank=None):
+        return [_uncast_wire(m) for m in self._inner.drain(rank)]
+
+    def recv(self, rank=None, timeout=None):
+        return _uncast_wire(self._inner.recv(rank, timeout))
+
+    def close(self) -> None:
+        self._inner.close()
 
 
 # ---------------------------------------------------------------------------
@@ -86,6 +141,7 @@ def run_easgd_server(
     verbose: bool = True,
     timeout: float = 3600.0,
     keep_last: Optional[int] = None,  # prune center snapshots to newest N
+    wire_dtype=None,  # e.g. np.float16: compressed exchange replies
 ):
     """Rank 0: the reference ``EASGD_Server.run()`` loop, TCP-served.
 
@@ -131,16 +187,23 @@ def run_easgd_server(
             if kind == "join":
                 return {"params": state["center"], "epoch": start_epoch}
             if kind == "exchange":
-                w = msg["params"]
+                if "wire_seen" not in state:
+                    # observability: what dtype ACTUALLY rode the wire —
+                    # the e2e fp16 test asserts this, so a refactor that
+                    # silently drops the compression cannot stay green
+                    leaves = jax.tree.leaves(msg["params"])
+                    state["wire_seen"] = str(leaves[0].dtype) if leaves else "?"
+                w = _uncast_wire(msg["params"])  # math always fp32
                 c = state["center"]
                 diff = jax.tree.map(lambda a, b: a - b, w, c)
                 state["center"] = jax.tree.map(
                     lambda b, d: b + alpha * d, c, diff
                 )
                 state["n_exchanges"] += 1
-                return {
-                    "params": jax.tree.map(lambda a, d: a - alpha * d, w, diff)
-                }
+                out = jax.tree.map(lambda a, d: a - alpha * d, w, diff)
+                if wire_dtype:
+                    out = _cast_wire(out, wire_dtype)
+                return {"params": out}
             if kind == "epoch":
                 e = int(msg["epoch"])
                 state["epoch_counts"][e] = state["epoch_counts"].get(e, 0) + 1
@@ -205,6 +268,11 @@ def run_easgd_server(
     finally:
         channel.close()
     model.params = replicate(model.mesh, center)
+    rec.log_event(
+        "async_wire",
+        dtype=state.get("wire_seen", "none"),
+        n_exchanges=state["n_exchanges"],
+    )
     if checkpoint_dir:
         model.save_model(os.path.join(checkpoint_dir, "ckpt_center.npz"))
         rec.save(os.path.join(checkpoint_dir, "record_server.jsonl"))
@@ -222,6 +290,7 @@ def run_easgd_worker(
     tau: int,
     checkpoint_dir: Optional[str] = None,
     verbose: bool = False,
+    wire_dtype=None,  # e.g. np.float16: compressed exchange payloads
 ):
     """Ranks 1..N-1: the reference ``EASGD_Worker`` loop, one process."""
     widx = rank - 1  # data-shard index among the N-1 workers
@@ -240,7 +309,7 @@ def run_easgd_worker(
         n_epochs,
         rec,
         n_workers=size - 1,
-        server=_RemoteServer(server_address),
+        server=_RemoteServer(server_address, wire_dtype=wire_dtype),
         tau=tau,
     )
     joined = request(server_address, {"kind": "join", "rank": rank})
@@ -311,9 +380,12 @@ def run_gosgd_peer(
     val_freq: int = 1,
     verbose: bool = False,
     timeout: float = 3600.0,
+    wire_dtype=None,  # e.g. np.float16: compressed gossip payloads
 ):
     """One GOSGD peer process; rank 0 also aggregates the consensus."""
     mailbox = TcpMailbox(rank, addresses)
+    if wire_dtype:
+        mailbox = _CompressedMailbox(mailbox, wire_dtype)
     adapter = _GossipAdapter(mailbox)
     seed0 = int((model_config or {}).get("seed", 0))
     rec = Recorder(

@@ -57,17 +57,23 @@ def measure_step_time(
         shard_batch(model.mesh, b)
         for b in itertools.islice(model.data.train_batches(), max_batches)
     ]
-    p, s, o = model.params, model.net_state, model.opt_state
-    rng = jax.random.PRNGKey(0)
+    # copies: the jitted step donates its inputs, and a probe must not
+    # invalidate the model's live training state
+    p, s, o = jax.tree.map(
+        jax.numpy.copy, (model.params, model.net_state, model.opt_state)
+    )
+    # per-step keys — one key reused every step draws identical dropout
+    # masks (the round-1 bench wart), skewing timings vs real training
+    keys = list(jax.random.split(jax.random.PRNGKey(0), warmup + n_steps))
     loss = None
     for i in range(warmup):
         x, y = batches[i % len(batches)]
-        p, s, o, loss, _ = fn(p, s, o, x, y, rng)
+        p, s, o, loss, _ = fn(p, s, o, x, y, keys[i])
     jax.block_until_ready(loss)
     t0 = time.perf_counter()
     for i in range(n_steps):
         x, y = batches[i % len(batches)]
-        p, s, o, loss, _ = fn(p, s, o, x, y, rng)
+        p, s, o, loss, _ = fn(p, s, o, x, y, keys[warmup + i])
     jax.block_until_ready(loss)
     return (time.perf_counter() - t0) / n_steps
 
