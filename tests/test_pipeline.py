@@ -207,8 +207,13 @@ def _assert_pp_lm_matches_single_device(cfg_pp, pp):
     m_pp = TransformerLM(config=cfg_pp, mesh=mesh_pp)
     m_pp.compile_train()
     global_bs = int(cfg_pp["batch_size"]) * int(mesh_pp.shape[DATA_AXIS])
+    # the oracle: same model config minus every parallelism knob
+    base = {
+        k: v for k, v in cfg_pp.items()
+        if k not in ("pp", "pp_micro", "tp", "sp", "sp_mode", "batch_size")
+    }
     m_1 = TransformerLM(
-        config=dict(LM_CFG, batch_size=global_bs),
+        config=dict(base, batch_size=global_bs),
         mesh=make_mesh(devices=jax.devices()[:1]),
     )
     m_1.compile_train()
@@ -297,3 +302,23 @@ def test_pipelined_lm_3d_leaves_sharded_both_ways():
     shard = next(iter(wq.addressable_shards))
     assert shard.data.shape[0] == wq.shape[0] // 2  # stage / pp
     assert shard.data.shape[2] == wq.shape[2] // 2  # heads / tp
+
+
+def test_pipelined_lm_with_moe_matches_single_device():
+    """pp × ep: MoE blocks inside GPipe stages (emit_aux=False — the
+    scan carries activations only). With ample capacity, microbatched
+    routing is per-token independent, so the pipelined run must track
+    a single-device MoE run exactly from the same unstacked weights."""
+    cfg = dict(
+        LM_CFG, batch_size=4, pp=2, pp_micro=2,
+        moe_experts=4, moe_capacity_factor=8.0, moe_aux_coef=0.0,
+    )
+    _assert_pp_lm_matches_single_device(cfg, pp=2)
+
+
+def test_pipelined_lm_moe_requires_zero_aux():
+    from theanompi_tpu.models.transformer import TransformerLM
+
+    cfg = dict(LM_CFG, pp=2, moe_experts=4, moe_aux_coef=0.1)
+    with pytest.raises(ValueError, match="moe_aux_coef=0"):
+        TransformerLM(config=cfg, mesh=TransformerLM.build_mesh(config=cfg))

@@ -136,10 +136,15 @@ class TransformerLM(TpuModel):
         if pp > 1:
             from theanompi_tpu.runtime.mesh import PP_AXIS
 
-            if int(cfg.get("moe_experts", 0)):
+            if int(cfg.get("moe_experts", 0)) and float(
+                cfg.get("moe_aux_coef", self.default_config["moe_aux_coef"])
+            ):
                 raise ValueError(
-                    "pp does not compose with MoE blocks (the GPipe scan "
-                    "carries activations only; MoE aux flows through state)"
+                    "pp composes with MoE only at moe_aux_coef=0: the "
+                    "GPipe scan carries activations only, so the "
+                    "load-balance aux (which rides state) is unavailable "
+                    "— set moe_aux_coef=0 and size moe_capacity_factor "
+                    "generously instead"
                 )
             n_layers = int(cfg.get("n_layers", self.default_config["n_layers"]))
             if n_layers % pp:
@@ -290,6 +295,9 @@ class TransformerLM(TpuModel):
                 compute_dtype=dt,
                 tp_axis=tp_axis,  # 2-D expert sharding when tp > 1
                 tp_size=self.tp_size,
+                # inside the GPipe scan the layer must be stateless —
+                # __init__ enforces moe_aux_coef=0 for pp
+                emit_aux=self.pp_size == 1,
             )
 
         wrap = L.Remat if bool(cfg.remat) else (lambda b: b)

@@ -71,6 +71,7 @@ class MoeMlp(Layer):
         compute_dtype=None,
         tp_axis: Optional[str] = None,
         tp_size: int = 1,
+        emit_aux: bool = True,
     ):
         if top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {top_k}")
@@ -95,6 +96,11 @@ class MoeMlp(Layer):
         # over tp (w_in column-parallel, w_out row-parallel, f/g pair)
         self.tp_axis = tp_axis if tp_size > 1 else None
         self.tp_size = tp_size if tp_size > 1 else 1
+        # emit_aux=False: STATELESS layer (empty state, no aux_loss
+        # output) — required inside scanned schedules that carry
+        # activations only (the pipelined LM); size capacity generously
+        # there, the load-balance regularizer is unavailable
+        self.emit_aux = bool(emit_aux)
 
     def init(self, key, in_shape):
         (d,) = in_shape
@@ -111,6 +117,8 @@ class MoeMlp(Layer):
         # Switch load-balance scalar there, and the owning model adds
         # coef·aux to its task loss (gradients flow — state is a live
         # output of the same apply call)
+        if not self.emit_aux:
+            return params, {}, in_shape
         return params, {"aux_loss": jnp.zeros((), jnp.float32)}, in_shape
 
     def _capacity(self, n_tokens: int) -> int:
@@ -225,6 +233,8 @@ class MoeMlp(Layer):
             "nec,ecd->nd", comb, ye.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
+        if not self.emit_aux:
+            return y.astype(x.dtype), {}
         return y.astype(x.dtype), {"aux_loss": aux}
 
     @staticmethod
