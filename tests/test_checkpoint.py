@@ -300,3 +300,44 @@ def test_wire_roundtrip_property(tree):
     from theanompi_tpu.parallel import wire
 
     _assert_tree_equal(tree, wire.decode(wire.encode(tree)))
+
+
+# -- format stability + corruption robustness --------------------------------
+
+def test_golden_v2_file_restores():
+    """tests/data/golden_ckpt_v2.npz is a COMMITTED v2 checkpoint: any
+    format change that can't read it breaks every deployed snapshot —
+    this test pins backward compatibility forever."""
+    import os
+
+    p = os.path.join(os.path.dirname(__file__), "data", "golden_ckpt_v2.npz")
+    back = ckpt.restore(p)
+    assert back["tag"] == "golden-v2" and back["epoch"] == 3
+    np.testing.assert_array_equal(
+        back["params"]["w"], np.arange(6, dtype=np.float32).reshape(2, 3)
+    )
+    assert back["params"]["b"].dtype == np.float16
+    st = back["opt_state"]
+    assert st._fields == ("m", "v") and st.v.dtype == np.float64
+    assert back["flags"] == (True, None, 0.25)
+
+
+@pytest.mark.parametrize("cut", [1, 37, 200])
+def test_truncated_checkpoint_raises_cleanly(tmp_path, cut):
+    """A partially-written/corrupt file must raise, not hang or yield a
+    silently wrong tree (the atomic tmp+rename save makes this rare,
+    but restore must still be safe against torn files from elsewhere)."""
+    p = str(tmp_path / "c.npz")
+    ckpt.save(p, _sample_tree())
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[:-cut])
+    with pytest.raises(Exception) as ei:
+        ckpt.restore(p)
+    assert not isinstance(ei.value, (SystemExit, KeyboardInterrupt))
+
+
+def test_garbage_bytes_rejected(tmp_path):
+    p = str(tmp_path / "junk.npz")
+    open(p, "wb").write(b"\x13\x37" * 100)
+    with pytest.raises(Exception):
+        ckpt.restore(p)
