@@ -93,3 +93,90 @@ def test_launch_cli_parser():
     )
     assert args.rule == "EASGD"
     assert args.tau == 5
+
+
+def test_watchdog_stall_fires_and_dumps(capfd):
+    """No tick within timeout → stack dump + on_stall hook; dump mode
+    rearms and keeps the process alive."""
+    import time as _time
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    stalls = []
+    wd = Watchdog(timeout_s=0.3, poll_s=0.05, on_stall=stalls.append)
+    try:
+        _time.sleep(1.0)  # no ticks: must fire at least once
+    finally:
+        wd.close()
+    assert stalls and stalls[0] >= 0.3
+    err = capfd.readouterr().err
+    assert "WATCHDOG" in err and "thread stacks follow" in err
+
+
+def test_watchdog_ticks_keep_it_quiet():
+    import time as _time
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    stalls = []
+    wd = Watchdog(timeout_s=0.5, poll_s=0.05, on_stall=stalls.append)
+    try:
+        for _ in range(12):
+            wd.tick()
+            _time.sleep(0.08)  # always inside the window
+    finally:
+        wd.close()
+    assert not stalls
+
+
+def test_watchdog_exit_mode_terminates_process():
+    """action='exit' really ends the process with the watchdog's code —
+    verified in a SUBPROCESS (os._exit is unfakeable)."""
+    import subprocess
+    import sys
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    code = (
+        "from theanompi_tpu.runtime.fault import Watchdog\n"
+        "import time\n"
+        "Watchdog(timeout_s=0.2, poll_s=0.05, action='exit')\n"
+        "time.sleep(10)\n"
+        "print('survived')\n"
+    )
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=60,
+        cwd=repo_root,
+    )
+    assert r.returncode == Watchdog.EXIT_CODE
+    assert b"survived" not in r.stdout
+
+
+def test_watchdog_rejects_bad_action():
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    with pytest.raises(ValueError, match="dump"):
+        Watchdog(timeout_s=1, action="explode")
+
+
+def test_worker_threads_watchdog(tmp_path):
+    """BSP_Worker(watchdog_timeout=...) ticks per iteration — a normal
+    run never trips it."""
+    import jax
+
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.parallel.workers import BSP_Worker
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    m = Cifar10_model(
+        config=dict(batch_size=8, n_epochs=1, n_synth_train=32,
+                    n_synth_val=16, print_freq=1000, comm_probe=False),
+        mesh=make_mesh(devices=jax.devices()[:2]),
+    )
+    w = BSP_Worker(m, val_freq=0, checkpoint_dir=str(tmp_path),
+                   watchdog_timeout=300)
+    w.run()
+    assert w._watchdog is not None and not w._watchdog._fired

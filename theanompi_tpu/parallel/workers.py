@@ -47,6 +47,11 @@ class BSP_Worker:
         # behavior). With async saves the in-flight file lands after the
         # prune, so N+1 can exist transiently mid-run; a final prune
         # after the drain restores exactly N at exit.
+        watchdog_timeout: Optional[float] = None,  # seconds without a
+        # completed iteration before the stall watchdog fires (dumps all
+        # thread stacks; runtime.fault.Watchdog — pass action='exit' via
+        # watchdog_action for supervised multi-process deployments)
+        watchdog_action: str = "dump",
     ):
         import jax
 
@@ -72,6 +77,15 @@ class BSP_Worker:
         self.checkpoint_freq = checkpoint_freq
         self.resume = resume
         self.keep_last = keep_last
+        # the watchdog is CONSTRUCTED in run(): arming it here would
+        # count compile/startup time as a stall and leak the thread if
+        # run() is never reached
+        self._watchdog = None
+        self._watchdog_cfg = (
+            (float(watchdog_timeout), watchdog_action)
+            if watchdog_timeout
+            else None
+        )
         self._ckpt = None
         if async_checkpoint and checkpoint_dir and self.process_index == 0:
             from theanompi_tpu.utils.checkpoint import AsyncCheckpointer
@@ -153,6 +167,15 @@ class BSP_Worker:
             print(model.describe(), flush=True)
         count = model.current_epoch * model.data.n_batch_train
         try:
+            if self._watchdog_cfg is not None:
+                # armed only now — compile/resume/probe above must not
+                # count as a stall, and a failure before this point must
+                # not leak a live watchdog thread (the finally below
+                # always reaps it)
+                from theanompi_tpu.runtime.fault import Watchdog
+
+                timeout, action = self._watchdog_cfg
+                self._watchdog = Watchdog(timeout, action=action)
             for epoch in range(model.current_epoch, model.n_epochs):
                 model.adjust_hyperp(epoch)
                 rec.start_epoch()
@@ -161,8 +184,12 @@ class BSP_Worker:
                     count += 1
                     model.train_iter(count, rec)
                     rec.print_train_info(count)
+                    if self._watchdog is not None:
+                        self._watchdog.tick()
                 if self.val_freq and (epoch + 1) % self.val_freq == 0:
                     model.run_validation(count, rec)
+                    if self._watchdog is not None:
+                        self._watchdog.tick()  # a long validation is progress
                 rec.end_epoch(count, epoch)
                 self._log_memory(rec, f"epoch_{epoch + 1}")
                 model.current_epoch = epoch + 1
@@ -177,6 +204,8 @@ class BSP_Worker:
                         from theanompi_tpu.utils import checkpoint as ckpt
 
                         ckpt.prune(self.checkpoint_dir, self.keep_last)
+                if self._watchdog is not None:
+                    self._watchdog.tick()  # checkpoint/prune are progress
         finally:
             # drain the background writer EVEN when the loop raises — a
             # crash mid-epoch must not kill the daemon thread before the
@@ -206,6 +235,8 @@ class BSP_Worker:
             # flush+release the TB writer on BOTH paths — a crash must
             # not lose the last flush_secs of buffered scalars
             rec.close()
+            if self._watchdog is not None:
+                self._watchdog.close()
         if self.checkpoint_dir:
             rec.save()
         model.cleanup()

@@ -39,6 +39,97 @@ class FaultInjector:
             raise TrainingFault(f"injected fault at rank={rank} iter={iteration}")
 
 
+class Watchdog:
+    """Stall detector for training loops — the failure mode crash
+    handling can't see.
+
+    A crashed worker raises and ``run_with_restart`` recovers; a HUNG
+    worker (wedged accelerator tunnel, deadlocked collective, stuck
+    host IO) raises nothing and stalls the job forever — the reference
+    had the same blind spot, and on tunneled TPU rigs hangs are the
+    dominant real-world failure (observed repeatedly on this one).
+
+    The loop calls ``tick()`` once per iteration; a daemon thread fires
+    when no tick lands within ``timeout_s``:
+
+    - dumps every thread's stack via ``faulthandler`` (the diagnostic —
+      where the hang is),
+    - calls ``on_stall`` if given (log/alert hooks),
+    - and with ``action='exit'`` terminates the PROCESS via
+      ``os._exit(EXIT_CODE)``. A Python-level exception cannot preempt
+      a thread blocked in a C call (the hang case by definition), so
+      in-process recovery is impossible by construction; exit is the
+      honest action, and a supervisor — ``launch.py --spawn-procs``'s
+      parent, or ``run_with_restart`` around a spawned group — sees the
+      death and restarts from the latest checkpoint. The default
+      ``action='dump'`` only diagnoses.
+    """
+
+    EXIT_CODE = 86  # distinguishable from crashes in supervisor logs
+
+    def __init__(
+        self,
+        timeout_s: float,
+        action: str = "dump",
+        on_stall: Optional[Callable[[float], None]] = None,
+        poll_s: Optional[float] = None,
+    ):
+        if action not in ("dump", "exit"):
+            raise ValueError(f"action must be 'dump' or 'exit', got {action!r}")
+        import threading
+
+        self.timeout_s = float(timeout_s)
+        self.action = action
+        self.on_stall = on_stall
+        self._poll_s = poll_s if poll_s is not None else min(5.0, timeout_s / 4)
+        self._last = time.monotonic()
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch, name="watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def tick(self) -> None:
+        self._last = time.monotonic()
+
+    def _watch(self) -> None:
+        import faulthandler
+        import os
+        import sys
+
+        while not self._stop.wait(self._poll_s):
+            idle = time.monotonic() - self._last
+            if idle < self.timeout_s:
+                continue
+            self._fired = True
+            print(
+                f"WATCHDOG: no progress tick for {idle:.0f}s "
+                f"(timeout {self.timeout_s:.0f}s) — thread stacks follow",
+                file=sys.stderr,
+                flush=True,
+            )
+            faulthandler.dump_traceback(file=sys.stderr)
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(idle)
+                except Exception:
+                    pass  # a broken hook must not mask the stall report
+            if self.action == "exit":
+                os._exit(self.EXIT_CODE)
+            self._last = time.monotonic()  # dump mode: rearm, keep watching
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def run_with_restart(
     run_fn: Callable[[int], None],
     max_restarts: int = 3,
