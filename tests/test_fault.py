@@ -162,9 +162,58 @@ def test_watchdog_rejects_bad_action():
         Watchdog(timeout_s=1, action="explode")
 
 
-def test_worker_threads_watchdog(tmp_path):
-    """BSP_Worker(watchdog_timeout=...) ticks per iteration — a normal
-    run never trips it."""
+def test_worker_threads_watchdog(tmp_path, monkeypatch):
+    """BSP_Worker(watchdog_timeout=...) arms the watchdog at loop
+    entry, never trips it on a normal run, and reaps it on exit."""
+    import jax
+
+    import theanompi_tpu.runtime.fault as F
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.parallel.workers import BSP_Worker
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    created = []
+    orig = F.Watchdog
+
+    def spy(*a, **k):
+        w = orig(*a, **k)
+        created.append(w)
+        return w
+
+    monkeypatch.setattr(F, "Watchdog", spy)
+    m = Cifar10_model(
+        config=dict(batch_size=8, n_epochs=1, n_synth_train=32,
+                    n_synth_val=16, print_freq=1000, comm_probe=False),
+        mesh=make_mesh(devices=jax.devices()[:2]),
+    )
+    w = BSP_Worker(m, val_freq=1, checkpoint_dir=str(tmp_path),
+                   watchdog_timeout=300)
+    w.run()
+    assert len(created) == 1
+    assert not created[0]._fired  # a healthy run never trips it
+    assert created[0]._stop.is_set()  # reaped in the finally
+    assert w._watchdog is None
+
+
+def test_watchdog_pause_suspends_detection():
+    import time as _time
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    stalls = []
+    wd = Watchdog(timeout_s=0.3, poll_s=0.05, on_stall=stalls.append)
+    try:
+        wd.tick()
+        with wd.pause():
+            _time.sleep(0.8)  # longer than timeout: must NOT fire
+        assert not stalls
+        _time.sleep(0.8)  # resumed and unticked: MUST fire
+    finally:
+        wd.close()
+    assert stalls
+
+
+def test_worker_rejects_bad_watchdog_action(tmp_path):
     import jax
 
     from theanompi_tpu.models.cifar10 import Cifar10_model
@@ -174,9 +223,7 @@ def test_worker_threads_watchdog(tmp_path):
     m = Cifar10_model(
         config=dict(batch_size=8, n_epochs=1, n_synth_train=32,
                     n_synth_val=16, print_freq=1000, comm_probe=False),
-        mesh=make_mesh(devices=jax.devices()[:2]),
+        mesh=make_mesh(devices=jax.devices()[:1]),
     )
-    w = BSP_Worker(m, val_freq=0, checkpoint_dir=str(tmp_path),
-                   watchdog_timeout=300)
-    w.run()
-    assert w._watchdog is not None and not w._watchdog._fired
+    with pytest.raises(ValueError, match="watchdog_action"):
+        BSP_Worker(m, watchdog_timeout=10, watchdog_action="exi")

@@ -81,6 +81,12 @@ class BSP_Worker:
         # count compile/startup time as a stall and leak the thread if
         # run() is never reached
         self._watchdog = None
+        if watchdog_action not in ("dump", "exit"):
+            # fail at construction, not minutes later after compile
+            raise ValueError(
+                f"watchdog_action must be 'dump' or 'exit', "
+                f"got {watchdog_action!r}"
+            )
         self._watchdog_cfg = (
             (float(watchdog_timeout), watchdog_action)
             if watchdog_timeout
@@ -187,9 +193,13 @@ class BSP_Worker:
                     if self._watchdog is not None:
                         self._watchdog.tick()
                 if self.val_freq and (epoch + 1) % self.val_freq == 0:
-                    model.run_validation(count, rec)
                     if self._watchdog is not None:
-                        self._watchdog.tick()  # a long validation is progress
+                        # a full validation legitimately exceeds the
+                        # per-iteration cadence — suspend, don't race it
+                        with self._watchdog.pause():
+                            model.run_validation(count, rec)
+                    else:
+                        model.run_validation(count, rec)
                 rec.end_epoch(count, epoch)
                 self._log_memory(rec, f"epoch_{epoch + 1}")
                 model.current_epoch = epoch + 1
@@ -199,14 +209,28 @@ class BSP_Worker:
                     path = os.path.join(
                         self.checkpoint_dir, f"ckpt_{epoch + 1:04d}.npz"
                     )
-                    model.save_model(path, checkpointer=self._ckpt)
-                    if self.keep_last:
-                        from theanompi_tpu.utils import checkpoint as ckpt
+                    import contextlib
 
-                        ckpt.prune(self.checkpoint_dir, self.keep_last)
-                if self._watchdog is not None:
-                    self._watchdog.tick()  # checkpoint/prune are progress
+                    with (
+                        self._watchdog.pause()
+                        if self._watchdog is not None
+                        else contextlib.nullcontext()
+                    ):  # a big sync snapshot can exceed the cadence too
+                        model.save_model(path, checkpointer=self._ckpt)
+                        if self.keep_last:
+                            from theanompi_tpu.utils import checkpoint as ckpt
+
+                            ckpt.prune(self.checkpoint_dir, self.keep_last)
         finally:
+            # reap the watchdog FIRST — later finalizers (the async
+            # drain) may raise deliberately, and a leaked exit-mode
+            # watchdog would kill the restarted process mid-compile
+            if self._watchdog is not None:
+                self._watchdog.close()
+                self._watchdog = None
+            # flush+release the TB writer before the drain for the same
+            # reason — a deliberate drain error must not skip it
+            rec.close()
             # drain the background writer EVEN when the loop raises — a
             # crash mid-epoch must not kill the daemon thread before the
             # last enqueued snapshot hits disk (restart-from-fault reads
@@ -232,11 +256,6 @@ class BSP_Worker:
                     except Exception as ce:
                         print(f"async checkpoint error during crash "
                               f"drain: {type(ce).__name__}: {ce}", flush=True)
-            # flush+release the TB writer on BOTH paths — a crash must
-            # not lose the last flush_secs of buffered scalars
-            rec.close()
-            if self._watchdog is not None:
-                self._watchdog.close()
         if self.checkpoint_dir:
             rec.save()
         model.cleanup()

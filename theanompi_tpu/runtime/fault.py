@@ -84,6 +84,7 @@ class Watchdog:
         self._poll_s = poll_s if poll_s is not None else min(5.0, timeout_s / 4)
         self._last = time.monotonic()
         self._fired = False
+        self._paused = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._watch, name="watchdog", daemon=True
@@ -93,12 +94,32 @@ class Watchdog:
     def tick(self) -> None:
         self._last = time.monotonic()
 
+    def pause(self):
+        """Context manager suspending stall detection across a phase
+        that legitimately exceeds the tick cadence (full validation,
+        big checkpoint write): a post-hoc tick can't retract a firing
+        that already happened mid-phase."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _pause():
+            self._paused += 1
+            try:
+                yield
+            finally:
+                self._paused -= 1
+                self._last = time.monotonic()  # rearm fresh
+
+        return _pause()
+
     def _watch(self) -> None:
         import faulthandler
         import os
         import sys
 
         while not self._stop.wait(self._poll_s):
+            if self._paused:
+                continue
             idle = time.monotonic() - self._last
             if idle < self.timeout_s:
                 continue
