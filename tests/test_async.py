@@ -220,3 +220,49 @@ def test_easgd_keep_last_prunes_center(tmp_path):
     rule.wait()
     centers = sorted(f.name for f in tmp_path.glob("ckpt_center_*.npz"))
     assert centers == ["ckpt_center_0003.npz"]
+
+
+def test_async_driver_shared_watchdog(tmp_path):
+    """EASGD with a shared job-stall watchdog: a healthy run arms it at
+    the first iteration, never trips it, and reaps it before finalize."""
+    import theanompi_tpu
+    import theanompi_tpu.runtime.fault as F
+
+    created = []
+    orig = F.Watchdog
+
+    class Spy(orig):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            created.append(self)
+
+    F.Watchdog = Spy
+    try:
+        rule = theanompi_tpu.EASGD()
+        rule.init(
+            devices=4,
+            model_config=dict(batch_size=4, n_epochs=1, n_synth_train=32,
+                              n_synth_val=16, print_freq=1000,
+                              comm_probe=False),
+            n_workers=2,
+            checkpoint_dir=str(tmp_path),
+            watchdog_timeout=600,
+            val_freq=0,
+        )
+        rule.wait()
+    finally:
+        F.Watchdog = orig
+    assert len(created) == 1
+    assert created[0]._armed and not created[0]._fired
+    assert created[0]._stop.is_set()
+
+
+def test_async_driver_rejects_bad_watchdog_action():
+    from theanompi_tpu.parallel.async_workers import EASGD_Driver
+
+    with pytest.raises(ValueError, match="watchdog action"):
+        EASGD_Driver(
+            "theanompi_tpu.models.cifar10", "Cifar10_model", {},
+            devices=[None], n_workers=1, watchdog_action="nope", tau=2,
+            alpha=0.5,
+        )

@@ -175,12 +175,12 @@ def test_worker_threads_watchdog(tmp_path, monkeypatch):
     created = []
     orig = F.Watchdog
 
-    def spy(*a, **k):
-        w = orig(*a, **k)
-        created.append(w)
-        return w
+    class Spy(orig):  # a subclass: workers also call validate_action on it
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            created.append(self)
 
-    monkeypatch.setattr(F, "Watchdog", spy)
+    monkeypatch.setattr(F, "Watchdog", Spy)
     m = Cifar10_model(
         config=dict(batch_size=8, n_epochs=1, n_synth_train=32,
                     n_synth_val=16, print_freq=1000, comm_probe=False),
@@ -225,5 +225,5 @@ def test_worker_rejects_bad_watchdog_action(tmp_path):
                     n_synth_val=16, print_freq=1000, comm_probe=False),
         mesh=make_mesh(devices=jax.devices()[:1]),
     )
-    with pytest.raises(ValueError, match="watchdog_action"):
+    with pytest.raises(ValueError, match="watchdog action"):
         BSP_Worker(m, watchdog_timeout=10, watchdog_action="exi")

@@ -65,6 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(BSP snapshots / EASGD center; default: keep all)",
     )
     p.add_argument(
+        "--watchdog-timeout", type=float, default=None, metavar="SECONDS",
+        help="stall watchdog: fire when no training iteration completes "
+        "within this window (hangs don't raise — crashes do)",
+    )
+    p.add_argument(
+        "--watchdog-action", choices=["dump", "exit"], default="dump",
+        help="on stall: 'dump' thread stacks and keep watching, or "
+        "'exit' the process (code 86) so a supervisor restarts it",
+    )
+    p.add_argument(
         "--restarts", type=int, default=0,
         help="restart-from-checkpoint budget on crash (0 = fail fast)",
     )
@@ -140,11 +150,17 @@ def _async_distributed_main(args) -> int:
             )
         else:
             da.run_easgd_worker(
-                rank, size, addresses[0], tau=args.tau, **common
+                rank, size, addresses[0], tau=args.tau,
+                watchdog_timeout=args.watchdog_timeout,
+                watchdog_action=args.watchdog_action,
+                **common,
             )
     else:  # GOSGD
         da.run_gosgd_peer(
-            rank, size, addresses, p_push=args.p_push, **common
+            rank, size, addresses, p_push=args.p_push,
+            watchdog_timeout=args.watchdog_timeout,
+            watchdog_action=args.watchdog_action,
+            **common,
         )
     return 0
 
@@ -241,6 +257,9 @@ def main(argv=None) -> int:
         kw = {}
         if args.keep_last:
             kw["keep_last"] = args.keep_last
+        if args.watchdog_timeout:
+            kw.update(watchdog_timeout=args.watchdog_timeout,
+                      watchdog_action=args.watchdog_action)
         if args.rule == "BSP":
             kw.update(checkpoint_dir=args.checkpoint_dir, resume=resume)
         else:

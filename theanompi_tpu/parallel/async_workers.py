@@ -89,10 +89,16 @@ class _AsyncWorkerBase:
     """Common thread body: local model + train loop + exchange hook."""
 
     def __init__(self, rank, devices, modelfile, modelclass, model_config, n_epochs,
-                 recorder: Recorder, n_workers: Optional[int] = None):
+                 recorder: Recorder, n_workers: Optional[int] = None,
+                 watchdog=None):
         self.rank = rank
         self.devices = devices
         self.recorder = recorder
+        # shared job-stall watchdog (runtime.fault.Watchdog): ANY
+        # worker's progress ticks it, so it detects whole-job hangs
+        # (wedged tunnel stalls every worker) — per-worker hang
+        # isolation would need one watchdog per thread
+        self.watchdog = watchdog
         cfg = dict(model_config or {})
         cls = getattr(importlib.import_module(modelfile), modelclass)
         self.model = cls(
@@ -190,6 +196,8 @@ class EASGD_Worker(_AsyncWorkerBase):
                 count += 1
                 model.train_iter(count, rec)
                 rec.print_train_info(count)
+                if self.watchdog is not None:
+                    self.watchdog.tick()
                 since_exchange += 1
                 if since_exchange >= self.tau:
                     since_exchange = 0
@@ -259,6 +267,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
                 count += 1
                 model.train_iter(count, rec)
                 rec.print_train_info(count)
+                if self.watchdog is not None:
+                    self.watchdog.tick()
                 self._merge_inbox()
                 self._maybe_push()
             self._epoch_end(epoch)
@@ -284,7 +294,16 @@ class _AsyncDriverBase:
         keep_last: Optional[int] = None,  # EASGD: prune per-epoch center
         # snapshots to the newest N (None = keep all). No-op for GOSGD,
         # which only writes one final consensus file.
+        watchdog_timeout: Optional[float] = None,  # shared job-stall
+        # watchdog: fires when NO worker completes an iteration within
+        # the timeout (whole-job hang, e.g. a wedged accelerator
+        # tunnel); armed at the first completed iteration so per-thread
+        # compiles never count
+        watchdog_action: str = "dump",
     ):
+        from theanompi_tpu.runtime.fault import Watchdog
+
+        Watchdog.validate_action(watchdog_action)
         self.modelfile = modelfile
         self.modelclass = modelclass
         self.model_config = model_config
@@ -296,6 +315,12 @@ class _AsyncDriverBase:
         self.val_freq = val_freq
         self.tensorboard_dir = tensorboard_dir
         self.keep_last = keep_last
+        self._watchdog_cfg = (
+            (float(watchdog_timeout), watchdog_action)
+            if watchdog_timeout
+            else None
+        )
+        self._wd = None
         self.workers: List[_AsyncWorkerBase] = []
         self.result_model = None
 
@@ -323,15 +348,31 @@ class _AsyncDriverBase:
 
     def run(self):
         self._build_workers()
-        threads = [
-            threading.Thread(target=w.run, name=f"{type(w).__name__}-{w.rank}")
-            for w in self.workers
-        ]
-        self._start_aux()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        if self._watchdog_cfg is not None:
+            from theanompi_tpu.runtime.fault import Watchdog
+
+            timeout, action = self._watchdog_cfg
+            self._wd = Watchdog(timeout, action=action, arm_on_first_tick=True)
+            for w in self.workers:
+                w.watchdog = self._wd
+        try:
+            threads = [
+                threading.Thread(target=w.run, name=f"{type(w).__name__}-{w.rank}")
+                for w in self.workers
+            ]
+            self._start_aux()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            # reap even when start/join raises (Ctrl-C in a notebook):
+            # a leaked exit-mode watchdog would kill the process later.
+            # The consensus/validation tail below is not
+            # iteration-cadenced, so the success path reaps here too.
+            if self._wd is not None:
+                self._wd.close()
+                self._wd = None
         self._stop_aux()
         try:
             errs = [w.error for w in self.workers if w.error is not None]

@@ -291,6 +291,9 @@ def run_easgd_worker(
     checkpoint_dir: Optional[str] = None,
     verbose: bool = False,
     wire_dtype=None,  # e.g. np.float16: compressed exchange payloads
+    watchdog_timeout: Optional[float] = None,  # per-process stall
+    # watchdog (armed at the first completed iteration)
+    watchdog_action: str = "dump",
 ):
     """Ranks 1..N-1: the reference ``EASGD_Worker`` loop, one process."""
     widx = rank - 1  # data-shard index among the N-1 workers
@@ -324,11 +327,19 @@ def run_easgd_worker(
         {"kind": "epoch", "rank": rank, "epoch": e,
          "net_state": worker.host_net_state},
     )
+    if watchdog_timeout:
+        from theanompi_tpu.runtime.fault import Watchdog
+
+        worker.watchdog = Watchdog(
+            watchdog_timeout, action=watchdog_action, arm_on_first_tick=True
+        )
     failed = True
     try:
         worker._run()
         failed = False
     finally:
+        if worker.watchdog is not None:
+            worker.watchdog.close()
         try:
             request(
                 server_address, {"kind": "done", "rank": rank, "failed": failed}
@@ -381,6 +392,9 @@ def run_gosgd_peer(
     verbose: bool = False,
     timeout: float = 3600.0,
     wire_dtype=None,  # e.g. np.float16: compressed gossip payloads
+    watchdog_timeout: Optional[float] = None,  # per-process stall
+    # watchdog (armed at the first completed iteration)
+    watchdog_action: str = "dump",
 ):
     """One GOSGD peer process; rank 0 also aggregates the consensus."""
     mailbox = TcpMailbox(rank, addresses)
@@ -407,8 +421,19 @@ def run_gosgd_peer(
         p_push=p_push,
         rng=np.random.RandomState(10_000 + seed0 + rank),
     )
+    if watchdog_timeout:
+        from theanompi_tpu.runtime.fault import Watchdog
+
+        worker.watchdog = Watchdog(
+            watchdog_timeout, action=watchdog_action, arm_on_first_tick=True
+        )
     try:
         worker._run()  # ends with a final inbox drain
+        # training is done: the consensus/lingering phases below are
+        # not iteration-cadenced — reap the watchdog now
+        if worker.watchdog is not None:
+            worker.watchdog.close()
+            worker.watchdog = None
         if rank != 0:
             mailbox.send(0, ("final", worker.get_params(), worker.weight))
             # keep the listener open until rank 0 finishes the consensus:
@@ -461,4 +486,6 @@ def run_gosgd_peer(
                 pass  # peer already gone
         return model
     finally:
+        if worker.watchdog is not None:  # crash path: _run raised
+            worker.watchdog.close()
         mailbox.close()

@@ -81,12 +81,10 @@ class BSP_Worker:
         # count compile/startup time as a stall and leak the thread if
         # run() is never reached
         self._watchdog = None
-        if watchdog_action not in ("dump", "exit"):
-            # fail at construction, not minutes later after compile
-            raise ValueError(
-                f"watchdog_action must be 'dump' or 'exit', "
-                f"got {watchdog_action!r}"
-            )
+        # fail at construction, not minutes later after compile
+        from theanompi_tpu.runtime.fault import Watchdog
+
+        Watchdog.validate_action(watchdog_action)
         self._watchdog_cfg = (
             (float(watchdog_timeout), watchdog_action)
             if watchdog_timeout

@@ -16,6 +16,7 @@ hook the reference lacked, used by the chaos tests for the host-side async
 
 from __future__ import annotations
 
+import contextlib
 import time
 import traceback
 from typing import Callable, Optional
@@ -67,15 +68,26 @@ class Watchdog:
 
     EXIT_CODE = 86  # distinguishable from crashes in supervisor logs
 
+    @classmethod
+    def validate_action(cls, action: str) -> str:
+        """THE action check — every constructor that forwards an action
+        here calls this so misconfiguration fails early and the error
+        text can't drift across call sites."""
+        if action not in ("dump", "exit"):
+            raise ValueError(
+                f"watchdog action must be 'dump' or 'exit', got {action!r}"
+            )
+        return action
+
     def __init__(
         self,
         timeout_s: float,
         action: str = "dump",
         on_stall: Optional[Callable[[float], None]] = None,
         poll_s: Optional[float] = None,
+        arm_on_first_tick: bool = False,
     ):
-        if action not in ("dump", "exit"):
-            raise ValueError(f"action must be 'dump' or 'exit', got {action!r}")
+        self.validate_action(action)
         import threading
 
         self.timeout_s = float(timeout_s)
@@ -85,6 +97,10 @@ class Watchdog:
         self._last = time.monotonic()
         self._fired = False
         self._paused = 0
+        # arm_on_first_tick: detection starts only once the loop proves
+        # it's alive — arbitrarily long startup (per-thread compiles)
+        # can never count as a stall
+        self._armed = not arm_on_first_tick
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._watch, name="watchdog", daemon=True
@@ -92,25 +108,26 @@ class Watchdog:
         self._thread.start()
 
     def tick(self) -> None:
+        # _last BEFORE _armed: the watcher must never observe the armed
+        # state paired with a stale timestamp (a preemption between the
+        # two writes in the other order could false-fire on first tick)
         self._last = time.monotonic()
+        self._armed = True
 
+    @contextlib.contextmanager
     def pause(self):
         """Context manager suspending stall detection across a phase
         that legitimately exceeds the tick cadence (full validation,
         big checkpoint write): a post-hoc tick can't retract a firing
         that already happened mid-phase."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def _pause():
-            self._paused += 1
-            try:
-                yield
-            finally:
-                self._paused -= 1
-                self._last = time.monotonic()  # rearm fresh
-
-        return _pause()
+        self._paused += 1
+        try:
+            yield
+        finally:
+            # rearm fresh BEFORE unpausing — same ordering hazard as
+            # tick(): unpaused + stale _last would false-fire
+            self._last = time.monotonic()
+            self._paused -= 1
 
     def _watch(self) -> None:
         import faulthandler
@@ -118,7 +135,7 @@ class Watchdog:
         import sys
 
         while not self._stop.wait(self._poll_s):
-            if self._paused:
+            if self._paused or not self._armed:
                 continue
             idle = time.monotonic() - self._last
             if idle < self.timeout_s:
