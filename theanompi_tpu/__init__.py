@@ -31,3 +31,8 @@ def __getattr__(name):
 
         return getattr(rules, name)
     raise AttributeError(name)
+
+
+def __dir__():
+    # surface the lazy exports to dir()/tab-completion
+    return sorted(set(globals()) | set(__all__))

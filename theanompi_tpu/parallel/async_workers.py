@@ -89,16 +89,15 @@ class _AsyncWorkerBase:
     """Common thread body: local model + train loop + exchange hook."""
 
     def __init__(self, rank, devices, modelfile, modelclass, model_config, n_epochs,
-                 recorder: Recorder, n_workers: Optional[int] = None,
-                 watchdog=None):
+                 recorder: Recorder, n_workers: Optional[int] = None):
         self.rank = rank
         self.devices = devices
         self.recorder = recorder
-        # shared job-stall watchdog (runtime.fault.Watchdog): ANY
-        # worker's progress ticks it, so it detects whole-job hangs
-        # (wedged tunnel stalls every worker) — per-worker hang
-        # isolation would need one watchdog per thread
-        self.watchdog = watchdog
+        # stall watchdog slot, assigned by the owning driver/entrypoint
+        # after construction (the threaded driver shares ONE across
+        # workers — any worker's progress ticks it, detecting whole-job
+        # hangs; the per-process entrypoints assign one each)
+        self.watchdog = None
         cfg = dict(model_config or {})
         cls = getattr(importlib.import_module(modelfile), modelclass)
         self.model = cls(
@@ -352,7 +351,7 @@ class _AsyncDriverBase:
             from theanompi_tpu.runtime.fault import Watchdog
 
             timeout, action = self._watchdog_cfg
-            self._wd = Watchdog(timeout, action=action, arm_on_first_tick=True)
+            self._wd = Watchdog.maybe(timeout, action)
             for w in self.workers:
                 w.watchdog = self._wd
         try:

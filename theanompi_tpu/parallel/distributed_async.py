@@ -327,12 +327,9 @@ def run_easgd_worker(
         {"kind": "epoch", "rank": rank, "epoch": e,
          "net_state": worker.host_net_state},
     )
-    if watchdog_timeout:
-        from theanompi_tpu.runtime.fault import Watchdog
+    from theanompi_tpu.runtime.fault import Watchdog
 
-        worker.watchdog = Watchdog(
-            watchdog_timeout, action=watchdog_action, arm_on_first_tick=True
-        )
+    worker.watchdog = Watchdog.maybe(watchdog_timeout, watchdog_action)
     failed = True
     try:
         worker._run()
@@ -421,12 +418,9 @@ def run_gosgd_peer(
         p_push=p_push,
         rng=np.random.RandomState(10_000 + seed0 + rank),
     )
-    if watchdog_timeout:
-        from theanompi_tpu.runtime.fault import Watchdog
+    from theanompi_tpu.runtime.fault import Watchdog
 
-        worker.watchdog = Watchdog(
-            watchdog_timeout, action=watchdog_action, arm_on_first_tick=True
-        )
+    worker.watchdog = Watchdog.maybe(watchdog_timeout, watchdog_action)
     try:
         worker._run()  # ends with a final inbox drain
         # training is done: the consensus/lingering phases below are

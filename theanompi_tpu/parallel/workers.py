@@ -172,14 +172,14 @@ class BSP_Worker:
         count = model.current_epoch * model.data.n_batch_train
         try:
             if self._watchdog_cfg is not None:
-                # armed only now — compile/resume/probe above must not
-                # count as a stall, and a failure before this point must
-                # not leak a live watchdog thread (the finally below
-                # always reaps it)
+                # constructed only now — a failure before this point
+                # must not leak a live watchdog thread (the finally
+                # below always reaps it); armed at the first completed
+                # iteration, so compile/resume/probe never count
                 from theanompi_tpu.runtime.fault import Watchdog
 
                 timeout, action = self._watchdog_cfg
-                self._watchdog = Watchdog(timeout, action=action)
+                self._watchdog = Watchdog.maybe(timeout, action)
             for epoch in range(model.current_epoch, model.n_epochs):
                 model.adjust_hyperp(epoch)
                 rec.start_epoch()

@@ -69,6 +69,17 @@ class Watchdog:
     EXIT_CODE = 86  # distinguishable from crashes in supervisor logs
 
     @classmethod
+    def maybe(cls, timeout_s, action: str = "dump", **kw):
+        """THE optional-watchdog constructor every integration uses:
+        ``None`` for a falsy timeout, else an armed-on-first-tick
+        watchdog — one site for the deferral semantics instead of a
+        copy at every worker/driver."""
+        if not timeout_s:
+            return None
+        kw.setdefault("arm_on_first_tick", True)
+        return cls(float(timeout_s), action=action, **kw)
+
+    @classmethod
     def validate_action(cls, action: str) -> str:
         """THE action check — every constructor that forwards an action
         here calls this so misconfiguration fails early and the error

@@ -71,7 +71,13 @@ def _encode(node: Any, leaves: List[np.ndarray]) -> Any:
             "supported: arrays, bool/int/float/str, dict/list/tuple/None"
         )
     idx = len(leaves)
-    leaves.append(np.asarray(node))
+    if kind == "str":
+        # UTF-8 bytes, NOT np.asarray(str): numpy's fixed-width unicode
+        # silently drops trailing NUL code points ('\x00' → '' on
+        # restore — found by the hypothesis round-trip property)
+        leaves.append(np.frombuffer(node.encode("utf-8"), dtype=np.uint8))
+    else:
+        leaves.append(np.asarray(node))
     return {"t": "leaf", "i": idx, "kind": kind}
 
 
@@ -94,11 +100,13 @@ def _decode(desc: Any, leaves: List[np.ndarray]) -> Any:
         kind = desc.get("kind", "array")
         if kind == "array":
             return a
-        # python scalar round-trip (epoch counters, flags, tags); scalar
-        # kinds are always stored as 0-d arrays
-        return {"bool": bool, "int": int, "float": float, "str": str}[kind](
-            a.item()
-        )
+        if kind == "str":
+            if a.dtype == np.uint8:  # current format: UTF-8 bytes
+                return a.tobytes().decode("utf-8")
+            return str(a.item())  # legacy files: 0-d unicode array
+        # python scalar round-trip (epoch counters, flags); scalar kinds
+        # are stored as 0-d arrays
+        return {"bool": bool, "int": int, "float": float}[kind](a.item())
     raise ValueError(f"unknown checkpoint node type {t!r} (corrupt file?)")
 
 
