@@ -18,7 +18,21 @@ def load(path):
         rows = [json.loads(line) for line in f if line.strip()]
     train = [r for r in rows if r.get("kind") == "train"]
     val = [r for r in rows if r.get("kind") == "val"]
-    return train, val
+    events = [r for r in rows if r.get("kind") not in ("train", "val")]
+    return train, val, events
+
+
+def print_events(events):
+    """Structured one-off rows (comm-fraction probe, memory snapshots,
+    async wire dtype, restarts …) — the record's context lines."""
+    for r in events:
+        kind = r.get("kind", "?")
+        body = " ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in r.items()
+            if k != "kind"
+        )
+        print(f"[{kind}] {body}")
 
 
 def ascii_curve(xs, ys, label, width=60, height=10):
@@ -43,7 +57,8 @@ def main():
         print(__doc__)
         sys.exit(1)
     path = sys.argv[1]
-    train, val = load(path)
+    train, val, events = load(path)
+    print_events(events)
     try:
         import matplotlib
 

@@ -1,0 +1,62 @@
+"""The record-inspection script (reference's show_record analog,
+SURVEY §3.7): loads the Recorder's JSONL, renders curves, and surfaces
+the structured event rows (comm-fraction probe, memory, async wire)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_module():
+    p = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "show_record.py",
+    )
+    spec = importlib.util.spec_from_file_location("show_record", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_record(path):
+    rows = [
+        {"kind": "comm_fraction", "frac": 0.25, "n_dp": 8},
+        {"kind": "async_wire", "dtype": "float16", "n_exchanges": 12},
+        {"kind": "train", "iter": 10, "cost": 2.0, "error": 0.9,
+         "calc": 1.0, "comm": 0.1, "wait": 0.0, "load": 0.0},
+        {"kind": "train", "iter": 20, "cost": 1.5, "error": 0.7,
+         "calc": 1.0, "comm": 0.1, "wait": 0.0, "load": 0.0},
+        {"kind": "val", "iter": 20, "cost": 1.6, "error": 0.8,
+         "error_top5": 0.3},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_load_splits_kinds(tmp_path):
+    mod = _load_module()
+    p = str(tmp_path / "record.jsonl")
+    _write_record(p)
+    train, val, events = mod.load(p)
+    assert [r["iter"] for r in train] == [10, 20]
+    assert len(val) == 1
+    assert {e["kind"] for e in events} == {"comm_fraction", "async_wire"}
+
+
+def test_main_renders_and_prints_events(tmp_path, capsys, monkeypatch):
+    import pytest
+
+    pytest.importorskip("matplotlib")  # PNG assertion needs the renderer
+    mod = _load_module()
+    p = str(tmp_path / "record.jsonl")
+    _write_record(p)
+    out_png = str(tmp_path / "out.png")
+    monkeypatch.setattr(sys, "argv", ["show_record.py", p, out_png])
+    mod.main()
+    captured = capsys.readouterr().out
+    assert "[comm_fraction]" in captured and "frac=0.25" in captured
+    assert "[async_wire]" in captured and "dtype=float16" in captured
+    # matplotlib is present in this environment: a PNG must land
+    assert os.path.exists(out_png) and os.path.getsize(out_png) > 0
