@@ -214,3 +214,102 @@ def scaling_efficiency(
             }
         )
     return rows
+
+
+_DTYPE_BITS = {
+    "f64": 64, "f32": 32, "bf16": 16, "f16": 16,
+    "f8e4m3fn": 8, "f8e5m2": 8, "f8e4m3fnuz": 8, "f8e5m2fnuz": 8,
+    "s64": 64, "u64": 64, "s32": 32, "u32": 32, "s16": 16, "u16": 16,
+    "s8": 8, "u8": 8, "s4": 4, "u4": 4, "pred": 8,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute",
+)
+
+
+def collective_wire_bytes(model) -> Dict:
+    """Per-step collective payload bytes, parsed from the compiled HLO
+    of the train step — the STATIC complement to ``comm_fraction``'s
+    wall-clock split, and the honest proof a compressed wire is
+    engaged (the reference's fp16 kernels halved exactly these
+    numbers; the int8 strategy quarters them).
+
+    Returns ``{"total_bytes": N, "by_op": {op: {"bytes": N, "count": K}}}``.
+    Byte counts are the RESULT buffer sizes of every collective op in
+    the post-optimization HLO — a consistent proxy for wire traffic
+    across strategies. NOTE: lowers+compiles the step a second time
+    (AOT path) — run once at startup, not per iteration.
+
+    Run it ON THE TARGET BACKEND: backend-specific passes can change
+    the wire. Measured on the CPU rig, the cast-only ``bf16`` wire's
+    all-reduce is PROMOTED back to f32 (XLA folds the converts around
+    it — this util is how that was discovered), and interpret-mode
+    Pallas inlines to the same foldable ops; on TPU the pack kernel is
+    a mosaic custom call (a fold barrier) and bf16 is a native
+    all-reduce type. The ``int8`` strategies' reduce-scatter/all-gather
+    structure is fold-proof on every backend — s8 on the wire is
+    guaranteed, which the HLO tests assert.
+    """
+    import re
+
+    fn = model.train_fn or model.compile_train()
+    batch = next(iter(model.data.train_batches()))
+    sharded = shard_batch(model.mesh, batch, spec=model.batch_spec)
+    key = jax.random.PRNGKey(0)
+    try:  # supervised contract: (params, state, opt, x, y, key)
+        lowered = fn.lower(
+            model.params, model.net_state, model.opt_state, *sharded, key
+        )
+    except (TypeError, ValueError):
+        # unsupervised steps (LSGAN: no labels) take one fewer array —
+        # the arity mismatch surfaces as a shard_map pytree ValueError
+        lowered = fn.lower(
+            model.params, model.net_state, model.opt_state, sharded[0], key
+        )
+    hlo = lowered.compile().as_text()
+
+    shaped = re.compile(r"(\w+)\[([\d,]*)\]")
+    # one matcher for sync AND async forms: count the plain op or its
+    # '-done' half (which carries the final result shape); skip
+    # '-start' so overlapped TPU collectives aren't double-counted.
+    # The INVOCATION form is ` opname(` — a leading space and trailing
+    # '(' so operand references like '(%all-to-all.1)' never match
+    op_re = re.compile(
+        r" (" + "|".join(_COLLECTIVES) + r")(-start|-done)?\("
+    )
+    by_op: Dict[str, Dict[str, int]] = {}
+    unknown: set = set()
+    for line in hlo.splitlines():
+        if " = " not in line:
+            continue
+        rhs = line.split(" = ", 1)[1]
+        m = op_re.search(rhs)
+        if m is None or m.group(2) == "-start":
+            continue
+        op = m.group(1)
+        type_part = rhs[: m.start()]  # result type(s) precede the op
+        nbits = 0
+        for dt, dims in shaped.findall(type_part):
+            bits = _DTYPE_BITS.get(dt)
+            if bits is None:
+                unknown.add(dt)  # surfaced, never silently dropped
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbits += n * bits
+        if nbits == 0:
+            continue
+        slot = by_op.setdefault(op, {"bytes": 0, "count": 0})
+        slot["bytes"] += (nbits + 7) // 8
+        slot["count"] += 1
+    out = {
+        "total_bytes": sum(v["bytes"] for v in by_op.values()),
+        "by_op": by_op,
+    }
+    if unknown:
+        out["unknown_dtypes"] = sorted(unknown)
+    return out
