@@ -190,3 +190,43 @@ def test_imagenet_provider_train_only_raw_dir(tmp_path):
     assert data.n_batch_val == 0
     assert list(data.val_batches()) == []
     assert len(list(data.train_batches())) == 2
+
+
+# -- property-based bounds on the shared aug RNG stream ----------------------
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.integers(0, 2**63 - 1),  # seed
+    st.integers(0, 2**31 - 1),  # file index
+    st.integers(1, 64),         # images per shard
+    st.integers(0, 32),         # max_oh
+    st.integers(0, 32),         # max_ow
+    st.booleans(),              # mirror
+)
+def test_aug_draws_bounds_property(seed, file_idx, n, max_oh, max_ow, mirror):
+    """The splitmix64 stream the C++ loader and numpy fallback SHARE:
+    offsets always in range, flips binary (zero when mirror is off),
+    deterministic per (seed, file)."""
+    oh, ow, flip = shards.aug_draws(seed, file_idx, n, max_oh, max_ow, mirror)
+    assert oh.shape == ow.shape == flip.shape == (n,)
+    assert (0 <= oh).all() and (oh <= max_oh).all()
+    assert (0 <= ow).all() and (ow <= max_ow).all()
+    if mirror:
+        assert set(np.unique(flip)) <= {0, 1}
+    else:
+        assert (flip == 0).all()
+    oh2, ow2, flip2 = shards.aug_draws(seed, file_idx, n, max_oh, max_ow, mirror)
+    np.testing.assert_array_equal(oh, oh2)
+    np.testing.assert_array_equal(ow, ow2)
+    np.testing.assert_array_equal(flip, flip2)
+
+
+def test_aug_draws_vary_across_files_and_seeds():
+    a = shards.aug_draws(1, 0, 64, 20, 20, True)
+    b = shards.aug_draws(1, 1, 64, 20, 20, True)  # next file: new draws
+    c = shards.aug_draws(2, 0, 64, 20, 20, True)  # new seed: new draws
+    assert any((x != y).any() for x, y in zip(a, b))
+    assert any((x != y).any() for x, y in zip(a, c))
