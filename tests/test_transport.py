@@ -329,3 +329,51 @@ def test_compressed_mailbox_halves_param_bytes():
     box.close()
     assert got and got[0]["w"].dtype == np.float32
     np.testing.assert_allclose(got[0]["w"], params["w"], atol=2e-3)
+
+
+# -- property: the fp16 wire cast is transparent within fp16 precision -------
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_trees16 = st.dictionaries(
+    st.text(min_size=1, max_size=4),
+    st.one_of(
+        st.builds(
+            lambda shape, seed: np.asarray(
+                np.random.RandomState(seed).randn(*shape), np.float32
+            ),  # asarray: randn(*()) returns a python float, not a 0-d array
+            st.lists(st.integers(1, 8), min_size=0, max_size=2).map(tuple),
+            st.integers(0, 2**31 - 1),
+        ),
+        st.integers(-100, 100),
+        st.text(max_size=4),
+        st.none(),
+    ),
+    max_size=4,
+)
+
+
+@settings(max_examples=50, deadline=None)
+@given(_trees16)
+def test_fp16_wire_cast_roundtrip_property(tree):
+    """cast→uncast: fp32 leaves return as fp32 within fp16 precision,
+    every non-fp32 leaf bit-identical, structure preserved."""
+    from theanompi_tpu.parallel.distributed_async import (
+        _cast_wire, _uncast_wire,
+    )
+
+    back = _uncast_wire(_cast_wire(tree, np.float16))
+    assert list(back) == list(tree)
+    for k, v in tree.items():
+        b = back[k]
+        if isinstance(v, np.ndarray) and v.dtype == np.float32:
+            assert b.dtype == np.float32
+            # fp16 has 11 significand bits → rel err <= 2^-11 (+ range
+            # clipping for |x| > 65504 never hits randn-scaled values)
+            np.testing.assert_allclose(b, v, rtol=1e-3, atol=1e-6)
+        else:
+            assert type(b) is type(v)
+            if isinstance(v, np.ndarray):
+                np.testing.assert_array_equal(b, v)
+            else:
+                assert b == v or (v is None and b is None)
