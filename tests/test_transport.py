@@ -357,13 +357,16 @@ _trees16 = st.dictionaries(
 @given(_trees16)
 def test_fp16_wire_cast_roundtrip_property(tree):
     """cast→uncast: fp32 leaves return as fp32 within fp16 precision,
-    every non-fp32 leaf bit-identical, structure preserved."""
+    every non-fp32 leaf bit-identical, key set preserved."""
     from theanompi_tpu.parallel.distributed_async import (
         _cast_wire, _uncast_wire,
     )
 
     back = _uncast_wire(_cast_wire(tree, np.float16))
-    assert list(back) == list(tree)
+    # jax.tree.map canonicalizes dict key ORDER (sorted) — benign: both
+    # wire endpoints pair leaves through jax tree ops, which sort
+    # consistently. Same KEYS is the contract.
+    assert set(back) == set(tree)
     for k, v in tree.items():
         b = back[k]
         if isinstance(v, np.ndarray) and v.dtype == np.float32:
