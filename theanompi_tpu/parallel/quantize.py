@@ -100,7 +100,14 @@ def _quant_sr_kernel(x_ref, seed_ref, q_ref, s_ref):
     """Stochastic-rounding variant: ``floor(y + u)`` with per-element
     dither derived in-kernel from (seed, global element index) — no
     random tensor ever crosses HBM, unlike the XLA path where the
-    U[0,1) array is a full payload-sized input to the fusion."""
+    U[0,1) array is a full payload-sized input to the fusion.
+
+    Bound: the global element index is a single uint32, so the dither
+    sequence repeats after 2**32 elements — a leaf fused beyond ~4.3B
+    elements (16 GiB fp32, beyond one chip's HBM for a gradient leaf)
+    would see correlated (never biased) dither across distant rows in
+    one step. Widen ``idx`` to two uint32 words if that regime ever
+    becomes real."""
     i = pl.program_id(0)
     x = x_ref[...]
     s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0

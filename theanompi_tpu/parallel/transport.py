@@ -82,6 +82,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+class _OutConn:
+    """One sender-side persistent connection, mutated in place so the
+    owning dict entry never needs replacing (send/close race safety)."""
+
+    __slots__ = ("lock", "sock")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sock: Optional[socket.socket] = None
+
+
 class TcpMailbox:
     """Cross-process Mailbox: same send/drain/recv surface, TCP inside.
 
@@ -101,6 +112,24 @@ class TcpMailbox:
       own receive thread, so one slow or large sender never serializes
       other peers' deliveries (MPI's progress engine overlaps receives
       the same way).
+
+    Delivery is **at-most-once**: ``send`` returning means the frame
+    reached the local kernel's socket buffer, not that the peer decoded
+    it.  A sender whose push is refused outright gets an exception and
+    can compensate (GOSGD restores the halved weight mass,
+    ``async_workers.GOSGD_Worker._maybe_push``) — but if the receiver
+    dies AFTER the send lands in its kernel buffer and BEFORE its
+    receive thread reads it, the frame is lost with no error anywhere.
+    For GOSGD that window silently shrinks total consensus mass by the
+    in-flight weight.  This matches the reference's failure model (an
+    MPI_Send completing locally gives the same non-guarantee), and the
+    paper's gossip scheme tolerates it: consensus mass is conserved in
+    expectation among the survivors, and a crashed run restarts from
+    checkpoints anyway.  If exactly-once mass transfer is ever needed,
+    the fix is an app-level ack on mass-carrying frames (push/final)
+    with restore-on-timeout — not implemented because a worker crash
+    loses that worker's own mass regardless, so the ack only narrows,
+    never closes, the window.
     """
 
     def __init__(self, rank: int, addresses: Sequence[Tuple[str, int]]):
@@ -116,8 +145,10 @@ class TcpMailbox:
         self._listener.bind(("0.0.0.0", self.addresses[self.rank][1]))
         self._listener.listen(64)
         self._closed = False
-        # persistent sender connections: dst -> (lock, socket|None)
-        self._out: Dict[int, Tuple[threading.Lock, Optional[socket.socket]]] = {}
+        # persistent sender connections, one mutated-in-place holder per
+        # destination — send() works on the holder so close() clearing
+        # the dict can never yield a send-side KeyError
+        self._out: Dict[int, _OutConn] = {}
         self._out_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._serve, name=f"TcpMailbox-{rank}", daemon=True
@@ -144,32 +175,49 @@ class TcpMailbox:
                     self._q.put(self._wire.decode(recv_frame(conn)))
         except (ConnectionError, OSError):
             pass  # clean EOF between frames lands here too
+        except Exception:
+            # a corrupt/malformed frame must not silently kill this
+            # receive thread mid-stream: after a failed decode the
+            # stream offset is untrustworthy, so log, drop the
+            # connection (conn's `with` closed it), and let the sender
+            # reconnect cleanly on its next send
+            import traceback
+
+            print(f"TcpMailbox-{self.rank}: dropping sender stream after "
+                  "decode error:", flush=True)
+            traceback.print_exc()
 
     def send(self, dst: int, msg: Any) -> None:
         with self._out_lock:
-            if dst not in self._out:
-                self._out[dst] = (threading.Lock(), None)
-            lock, _ = self._out[dst]
+            if self._closed:
+                raise OSError("TcpMailbox is closed")
+            conn = self._out.get(dst)
+            if conn is None:
+                conn = self._out[dst] = _OutConn()
         payload = self._wire.encode(msg)
-        with lock:
-            sock = self._out[dst][1]
+        with conn.lock:
             for attempt in (0, 1):
-                if sock is None:
+                if conn.sock is None:
                     host, port = self.addresses[dst]
-                    sock = socket.create_connection((host, port), timeout=60)
-                    self._out[dst] = (lock, sock)
+                    fresh = socket.create_connection((host, port), timeout=60)
+                    # commit under _out_lock: a close() racing this send
+                    # must not leak a socket it already iterated past
+                    with self._out_lock:
+                        if self._closed:
+                            fresh.close()
+                            raise OSError("TcpMailbox is closed")
+                        conn.sock = fresh
                 try:
-                    send_frame(sock, payload)
+                    send_frame(conn.sock, payload)
                     return
                 except OSError:
                     # stale connection (receiver restarted): retry once
                     # on a fresh socket, then propagate
                     try:
-                        sock.close()
+                        conn.sock.close()
                     except OSError:
                         pass
-                    sock = None
-                    self._out[dst] = (lock, None)
+                    conn.sock = None
                     if attempt:
                         raise
 
@@ -192,14 +240,22 @@ class TcpMailbox:
             self._listener.close()
         except OSError:
             pass
+        # snapshot under _out_lock, then close each socket under ITS
+        # conn.lock — closing without it could yank the fd out from
+        # under a thread mid-send_frame (worst case the freed fd number
+        # is reused and the tail bytes land in the wrong stream). New
+        # sends are already refused: send() checks _closed first.
         with self._out_lock:
-            for lock, sock in self._out.values():
-                if sock is not None:
+            conns = list(self._out.values())
+            self._out.clear()
+        for conn in conns:
+            with conn.lock:
+                if conn.sock is not None:
                     try:
-                        sock.close()
+                        conn.sock.close()
                     except OSError:
                         pass
-            self._out.clear()
+                    conn.sock = None
 
 
 class TcpServerChannel:

@@ -116,6 +116,15 @@ class BSP_Worker:
             bytes_limit=int(stats.get("bytes_limit", 0)),
         )
 
+    def _prune_checkpoints(self) -> None:
+        """Retention: rank 0 trims the checkpoint dir to ``keep_last``
+        files (no-op otherwise) — one idiom for the epoch loop, the
+        clean final drain, and the crash drain."""
+        if self.keep_last and self.process_index == 0:
+            from theanompi_tpu.utils import checkpoint as ckpt
+
+            ckpt.prune(self.checkpoint_dir, self.keep_last)
+
     def _probe_comm(self, model, rec: Recorder) -> None:
         """One-shot comm-fraction measurement at train start.
 
@@ -215,10 +224,7 @@ class BSP_Worker:
                         else contextlib.nullcontext()
                     ):  # a big sync snapshot can exceed the cadence too
                         model.save_model(path, checkpointer=self._ckpt)
-                        if self.keep_last:
-                            from theanompi_tpu.utils import checkpoint as ckpt
-
-                            ckpt.prune(self.checkpoint_dir, self.keep_last)
+                        self._prune_checkpoints()
         finally:
             # reap the watchdog FIRST — later finalizers (the async
             # drain) may raise deliberately, and a leaked exit-mode
@@ -240,17 +246,17 @@ class BSP_Worker:
                 import sys
 
                 if sys.exc_info()[0] is None:
+                    # the last async save only lands during close();
+                    # without the final prune the run would exit with
+                    # keep_last+in-flight files on disk
                     self._ckpt.close()
-                    if self.keep_last and self.process_index == 0:
-                        # the last async save only lands during close();
-                        # without this final prune the run would exit
-                        # with keep_last+in-flight files on disk
-                        from theanompi_tpu.utils import checkpoint as ckpt
-
-                        ckpt.prune(self.checkpoint_dir, self.keep_last)
+                    self._prune_checkpoints()
                 else:
                     try:
+                        # same drain+prune on the crash path — a crashed
+                        # run must not exit over-retaining either
                         self._ckpt.close()
+                        self._prune_checkpoints()
                     except Exception as ce:
                         print(f"async checkpoint error during crash "
                               f"drain: {type(ce).__name__}: {ce}", flush=True)
