@@ -57,7 +57,7 @@ def test_avg_mode_runs_and_learns():
     np.testing.assert_array_equal(shards[0], shards[-1])
 
 
-@pytest.mark.parametrize("strategy", ["bf16", "fp16", "pallas_bf16", "int8"])
+@pytest.mark.parametrize("strategy", ["bf16", "fp16", "fp16s", "pallas_fp16s", "int8"])
 def test_compressed_strategies_track_fp32(strategy):
     losses_ar, _ = _run_steps(make_mesh(), per_shard_bs=8, n_steps=4)
     losses_c, _ = _run_steps(

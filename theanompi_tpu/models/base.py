@@ -48,9 +48,10 @@ COMMON_DEFAULTS = dict(
     nesterov=False,
     weight_decay=1e-4,
     sync_mode="cdd",  # 'cdd' = gradient reduce; 'avg' = param averaging
-    exch_strategy="ar",  # 'ar' | 'bf16' | 'fp16' | 'pallas_bf16' |
-    # 'int8' | 'pallas_int8' (int8 + per-block scale wire, ~4× fewer
-    # exchange bytes than fp32)
+    exch_strategy="ar",  # 'ar' | 'bf16' | 'fp16' (cast wire) |
+    # 'fp16s' | 'pallas_fp16s' (block-scaled fp16 wire: overflow-proof,
+    # ~2× fewer bytes) | 'int8' | 'pallas_int8' | 'int8_sr' |
+    # 'pallas_int8_sr' (int8 + per-block scale wire, ~4× fewer bytes)
     prefetch_depth=2,
     grad_clip_norm=None,  # global-norm clip after exchange (None = off)
     print_freq=40,

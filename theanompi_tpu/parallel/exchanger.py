@@ -18,9 +18,20 @@ applied inside the jitted, shard_mapped train step:
                 fused into the XLA program instead of pycuda-JIT'd.
 - ``fp16``    — same with IEEE fp16 (closer bit-parity with the
                 reference's kernels; bf16 is the TPU-preferred wire type).
-- ``pallas_bf16`` — like ``bf16`` but pack/unpack run as explicit Pallas
-                TPU kernels (the native-kernel parity item, SURVEY.md
-                §3.3 native list #1).
+- ``fp16s`` / ``pallas_fp16s`` — **block-scaled** fp16 wire (fused
+                cast+scale): per-256-element amax scale maps each block
+                into fp16's normal range, so large-magnitude gradient
+                blocks can't overflow to inf (fp16 max 65504) and small
+                ones aren't flushed to zero — the hazards of the plain
+                ``fp16`` cast. Same ~2× byte saving, and because the
+                payload rides the reduce-scatter/all-gather structure
+                (not a cast-wrapped psum), the compressed wire is
+                FOLD-PROOF on every backend — unlike ``bf16``/``fp16``,
+                whose cast-only all-reduce XLA promotes back to f32 on
+                CPU (docs/perf/NOTES.md "Wire-byte accounting"). The
+                pallas variant runs the fused cast+scale as a TPU
+                kernel (native-kernel parity item, SURVEY.md §3.3
+                native list #1).
 - ``int8`` / ``pallas_int8`` — int8 + per-block fp32 scale wire:
                 quantized reduce-scatter (all_to_all) + all-gather with
                 fp32 shard summation — ~4× fewer wire bytes than ``ar``
@@ -58,9 +69,12 @@ from theanompi_tpu.runtime.mesh import DATA_AXIS
 
 Pytree = Any
 
-STRATEGIES = ("ar", "bf16", "fp16", "pallas_bf16", "int8", "pallas_int8",
-              "int8_sr", "pallas_int8_sr")
+STRATEGIES = ("ar", "bf16", "fp16", "fp16s", "pallas_fp16s", "int8",
+              "pallas_int8", "int8_sr", "pallas_int8_sr")
 _INT8_STRATEGIES = ("int8", "pallas_int8", "int8_sr", "pallas_int8_sr")
+_FP16S_STRATEGIES = ("fp16s", "pallas_fp16s")
+# strategies riding the quantized reduce-scatter + all-gather structure
+_BLOCK_STRATEGIES = _INT8_STRATEGIES + _FP16S_STRATEGIES
 _SR_STRATEGIES = ("int8_sr", "pallas_int8_sr")
 
 
@@ -75,14 +89,6 @@ def spec_axis_names(spec) -> tuple:
         else:
             names.append(part)
     return tuple(names)
-
-
-def _compress_leaf_psum(g, axis: str, wire_dtype, pack, unpack):
-    """cast → (optional pallas pack) → psum → unpack → fp32."""
-    orig_dtype = g.dtype
-    wire = pack(g, wire_dtype)
-    red = lax.psum(wire, axis)
-    return unpack(red, orig_dtype)
 
 
 class BSP_Exchanger:
@@ -109,7 +115,7 @@ class BSP_Exchanger:
         # axis sizes must be STATIC for the int8 reduce-scatter reshape;
         # compile_train passes its mesh, direct users of int8 must too
         self._axis_sizes = dict(mesh.shape) if mesh is not None else None
-        if strategy in _INT8_STRATEGIES and self._axis_sizes is None:
+        if strategy in _BLOCK_STRATEGIES and self._axis_sizes is None:
             raise ValueError(
                 f"strategy {strategy!r} needs the mesh: "
                 "BSP_Exchanger(strategy=..., axis=..., mesh=mesh)"
@@ -134,16 +140,18 @@ class BSP_Exchanger:
         sharded = set(spec_axis_names(spec))
         return tuple(a for a in self._axes_tuple() if a not in sharded)
 
-    # -- int8 reduce-scatter + all-gather over a quantized wire -----------
-    def _int8_sum_one_axis(self, g, axis: str, rng=None):
-        """Sum ``g`` over one mesh axis moving ONLY int8 + per-block fp32
-        scales on the wire (wire bytes ≈ N/4 + N/64 each way vs 4N for a
-        fp32 ring — the reference's fp16 kernels halved bytes, this
-        quarters them; SURVEY.md §3.3 native #1, VERDICT round-1 #5).
+    # -- block-quantized reduce-scatter + all-gather wire -----------------
+    def _block_sum_one_axis(self, g, axis: str, rng=None):
+        """Sum ``g`` over one mesh axis moving ONLY the quantized payload
+        + per-block fp32 scales on the wire: int8 strategies ≈ N/4 + N/64
+        bytes each way vs 4N for a fp32 ring (the reference's fp16
+        kernels halved bytes, int8 quarters them; SURVEY.md §3.3 native
+        #1, VERDICT round-1 #5); fp16s strategies ≈ N/2 + N/64 with a
+        ~2^-11 relative error floor.
 
         reduce-scatter leg: all_to_all quantized shards; each device
         dequantizes and sums ITS shard in fp32 (quantized values are
-        never added in the int domain — that overflows immediately).
+        never added in the narrow domain — int8 overflows immediately).
         all-gather leg: requantize the reduced shard, all_gather, dequant.
 
         ``int8_sr`` (``rng`` required) uses stochastic rounding on both
@@ -155,7 +163,7 @@ class BSP_Exchanger:
         world = int(self._axis_sizes[axis])
         if world == 1:
             return g
-        pallas = self.strategy in ("pallas_int8", "pallas_int8_sr")
+        pallas = self.strategy.startswith("pallas_")
         k1 = k2 = None
         if self.strategy in _SR_STRATEGIES:
             if rng is None:
@@ -164,7 +172,12 @@ class BSP_Exchanger:
                     "call reduce_grads(grads, specs, rng=key)"
                 )
             k1, k2 = jax.random.split(rng)  # one per quantization leg
-        quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
+        if self.strategy in _FP16S_STRATEGIES:
+            quant = (
+                Q.pallas_quantize_blocks_fp16 if pallas else Q.quantize_blocks_fp16
+            )
+        else:
+            quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
         dequant = Q.pallas_dequantize_blocks if pallas else Q.dequantize_blocks
 
         orig_dtype = g.dtype
@@ -197,11 +210,11 @@ class BSP_Exchanger:
         out = dequant(q_all, s_all).reshape(-1)[:n]
         return out.reshape(g.shape).astype(orig_dtype)
 
-    def _int8_reduce_mean(self, g, axes: tuple, rng=None):
+    def _block_reduce_mean(self, g, axes: tuple, rng=None):
         total = 1
         for i, a in enumerate(axes):
             sub = jax.random.fold_in(rng, i) if rng is not None else None
-            g = self._int8_sum_one_axis(g, a, sub)  # hierarchical: ICI, DCN
+            g = self._block_sum_one_axis(g, a, sub)  # hierarchical: ICI, DCN
             total *= int(self._axis_sizes[a])
         return (g / total).astype(g.dtype)
 
@@ -210,17 +223,11 @@ class BSP_Exchanger:
             return g
         if self.strategy == "ar":
             return lax.pmean(g, axes).astype(g.dtype)
-        if self.strategy in _INT8_STRATEGIES:
-            return self._int8_reduce_mean(g, axes, rng)
-        if self.strategy in ("bf16", "fp16"):
-            wire = jnp.bfloat16 if self.strategy == "bf16" else jnp.float16
-            pack = lambda x, d: x.astype(d)  # noqa: E731
-            unpack = lambda x, d: x.astype(jnp.float32)  # noqa: E731
-        else:  # pallas_bf16
-            from theanompi_tpu.parallel.pallas_pack import pack_bf16, unpack_fp32
-
-            wire, pack, unpack = jnp.bfloat16, pack_bf16, unpack_fp32
-        r = _compress_leaf_psum(g, axes, wire, pack=pack, unpack=unpack)
+        if self.strategy in _BLOCK_STRATEGIES:
+            return self._block_reduce_mean(g, axes, rng)
+        # bf16 / fp16: cast-only wire around a psum
+        wire = jnp.bfloat16 if self.strategy == "bf16" else jnp.float16
+        r = lax.psum(g.astype(wire), axes).astype(jnp.float32)
         return (r / lax.psum(1, axes)).astype(g.dtype)
 
     # -- in-graph collectives (call inside shard_map) ---------------------
