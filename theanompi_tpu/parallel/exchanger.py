@@ -183,15 +183,16 @@ class BSP_Exchanger:
         orig_dtype = g.dtype
         flat = g.astype(jnp.float32).reshape(-1)
         n = flat.size
-        if n < world * Q.BLOCK:
-            # leaf smaller than one quant block per device: padding would
-            # cost MORE wire than fp32 — just psum it (biases, BN scales)
-            return lax.psum(g, axis)
         # pad so each device's shard is a whole number of quant blocks;
-        # only the Pallas kernels additionally need 32-row-aligned tiles
-        # (a 32× pad on the XLA path would make small leaves — biases,
-        # BN scales — cost more wire than uncompressed fp32)
+        # the Pallas kernels additionally need 32-row-aligned tiles
         chunk = world * Q.BLOCK * (32 if pallas else 1)
+        if n < chunk:
+            # leaf smaller than one padded chunk: the pad-up would cost
+            # MORE wire than uncompressed fp32 (for the pallas tier the
+            # crossover is 32 blocks/device — a mid-size leaf padded 16×
+            # would move ~8× the bytes of a plain psum) — just psum it
+            # (biases, BN scales, small dense layers)
+            return lax.psum(g, axis)
         pad = (-n) % chunk
         if pad:
             flat = jnp.pad(flat, (0, pad))

@@ -97,10 +97,16 @@ _ROWS = 32  # int8 TPU tile: (32, 128); 32 is also a legal f32 sublane count
 _LANES = 256  # = BLOCK: one quant block per row segment
 
 
+def _block_scale(x, cap):
+    """Per-row amax scale (keepdims) + divide-safe variant — the shared
+    head of every quant kernel."""
+    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / cap
+    return s, jnp.where(s > 0, s, 1.0)
+
+
 def _quant_kernel(x_ref, q_ref, s_ref):
     x = x_ref[...]  # (_ROWS, _LANES) fp32 — one quant block per row
-    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0  # (_ROWS, 1)
-    safe = jnp.where(s > 0, s, 1.0)
+    s, safe = _block_scale(x, 127.0)
     q_ref[...] = jnp.round(x / safe).astype(jnp.int8)
     s_ref[...] = s.astype(jnp.float32)
 
@@ -135,8 +141,7 @@ def _quant_sr_kernel(x_ref, seed_ref, q_ref, s_ref):
     becomes real."""
     i = pl.program_id(0)
     x = x_ref[...]
-    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
-    safe = jnp.where(s > 0, s, 1.0)
+    s, safe = _block_scale(x, 127.0)
     y = x / safe
     row = jax.lax.broadcasted_iota(jnp.uint32, (_ROWS, _LANES), 0)
     lane = jax.lax.broadcasted_iota(jnp.uint32, (_ROWS, _LANES), 1)
@@ -154,14 +159,45 @@ def _quant_fp16_kernel(x_ref, q_ref, s_ref):
     the former ``pallas_bf16`` strategy was retired): one VMEM pass
     computes the block amax, normalizes, and narrows to fp16."""
     x = x_ref[...]  # (_ROWS, _LANES) fp32 — one quant block per row
-    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / FP16_CAP
-    safe = jnp.where(s > 0, s, 1.0)
+    s, safe = _block_scale(x, FP16_CAP)
     q_ref[...] = (x / safe).astype(jnp.float16)
     s_ref[...] = s.astype(jnp.float32)
 
 
 def _dequant_kernel(q_ref, s_ref, o_ref):
     o_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[...]
+
+
+def _run_quant_kernel(x, kernel, out_dtype, seed=None):
+    """Shared pallas_call scaffolding for all block-quant kernels:
+    flatten (…, BLOCK) → (rows, BLOCK), tile (32, BLOCK) per grid step,
+    return (payload, scales) reshaped back. ``rows`` must be a multiple
+    of 32 (the exchanger pads to this)."""
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    x2 = x.reshape(rows, BLOCK)
+    in_specs = [pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0))]
+    args = [x2]
+    if seed is not None:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+        args.append(seed)
+    q2, s2 = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, BLOCK), out_dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ),
+        grid=(rows // _ROWS,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
+        ),
+        interpret=(jax.default_backend() == "cpu"),
+    )(*args)
+    return q2.reshape(*lead, BLOCK), s2.reshape(lead)
 
 
 def pallas_quantize_blocks(x: jnp.ndarray, key=None):
@@ -173,44 +209,10 @@ def pallas_quantize_blocks(x: jnp.ndarray, key=None):
     (not the jax.random bit stream), so outputs are deterministic per
     key but NOT bit-identical to ``quantize_blocks(x, key)`` — both are
     valid unbiased rounding dither."""
-    lead = x.shape[:-1]
-    rows = 1
-    for d in lead:
-        rows *= d
-    x2 = x.reshape(rows, BLOCK)
-    grid = rows // _ROWS
-    out_shape = (
-        jax.ShapeDtypeStruct((rows, BLOCK), jnp.int8),
-        jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-    )
-    out_specs = (
-        pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
-        pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
-    )
-    interpret = jax.default_backend() == "cpu"
     if key is None:
-        q2, s2 = pl.pallas_call(
-            _quant_kernel,
-            out_shape=out_shape,
-            grid=(grid,),
-            in_specs=[pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0))],
-            out_specs=out_specs,
-            interpret=interpret,
-        )(x2)
-    else:
-        seed = jax.random.bits(key, (1, 1), jnp.uint32)
-        q2, s2 = pl.pallas_call(
-            _quant_sr_kernel,
-            out_shape=out_shape,
-            grid=(grid,),
-            in_specs=[
-                pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
-                pl.BlockSpec((1, 1), lambda i: (0, 0)),
-            ],
-            out_specs=out_specs,
-            interpret=interpret,
-        )(x2, seed)
-    return q2.reshape(*lead, BLOCK), s2.reshape(lead)
+        return _run_quant_kernel(x, _quant_kernel, jnp.int8)
+    seed = jax.random.bits(key, (1, 1), jnp.uint32)
+    return _run_quant_kernel(x, _quant_sr_kernel, jnp.int8, seed=seed)
 
 
 def pallas_quantize_blocks_fp16(x: jnp.ndarray, key=None):
@@ -218,27 +220,7 @@ def pallas_quantize_blocks_fp16(x: jnp.ndarray, key=None):
     see there), input rows padded to a multiple of 32 by the exchanger.
     fp16's TPU tile is (16, 128); 32 rows is a legal multiple for both
     the fp32 input and the fp16 output."""
-    lead = x.shape[:-1]
-    rows = 1
-    for d in lead:
-        rows *= d
-    x2 = x.reshape(rows, BLOCK)
-    grid = rows // _ROWS
-    q2, s2 = pl.pallas_call(
-        _quant_fp16_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((rows, BLOCK), jnp.float16),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-        ),
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0))],
-        out_specs=(
-            pl.BlockSpec((_ROWS, BLOCK), lambda i: (i, 0)),
-            pl.BlockSpec((_ROWS, 1), lambda i: (i, 0)),
-        ),
-        interpret=(jax.default_backend() == "cpu"),
-    )(x2)
-    return q2.reshape(*lead, BLOCK), s2.reshape(lead)
+    return _run_quant_kernel(x, _quant_fp16_kernel, jnp.float16)
 
 
 def pallas_dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
