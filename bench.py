@@ -6,6 +6,15 @@ ImageNet-128px BSP configuration. Protocol per BASELINE.md: warmup steps
 excluded, compile excluded, `block_until_ready` fenced, per-chip img/s =
 global_throughput / chips.
 
+``detail`` additionally carries the roofline view (VERDICT r2 #2):
+``flops_per_step`` from XLA's own cost analysis of the compiled step,
+``tflops_sustained``, and ``mfu_pct`` against the detected chip's bf16
+peak — so cross-round progress is judged against the hardware ceiling,
+not only against last round's number. It also carries ``efficiency``
+(VERDICT r2 #4): the BASELINE scaling-efficiency curve via
+``utils.benchmark.scaling_efficiency`` whenever more than one chip is
+visible, else the trivial 1-chip row.
+
 ``vs_baseline`` is 1.0: the reference's published numbers are not
 recoverable in this environment (BASELINE.json `published: {}` — see
 BASELINE.md), so there is no external denominator; cross-round progress
@@ -13,6 +22,7 @@ is tracked by the driver's BENCH_r{N}.json history.
 """
 
 import json
+import subprocess
 import sys
 import threading
 import time
@@ -37,29 +47,145 @@ def emit(value: float, vs_baseline: float, detail: dict) -> None:
     )
 
 
-def _require_devices(timeout_s: float = 120.0):
-    """Fail FAST if the accelerator backend is unreachable — a wedged
-    tunnel makes jax.devices() hang, not error, and a hung bench tells
-    the driver nothing."""
-    out = {}
+def _child_probe(timeout_s: float):
+    """Probe the backend in a SUBPROCESS (a hung in-process jax.devices()
+    thread holds jax's backend lock forever — see __graft_entry__).
+    Returns device count, or 0 on hang/error."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True,
+            timeout=timeout_s,
+        )
+        return int(out.stdout.strip() or 0)
+    except (subprocess.SubprocessError, ValueError, OSError):
+        return 0
+
+
+def _require_devices(budget_s: float = 960.0, interval_s: float = 120.0):
+    """Bounded retry loop (VERDICT r2 weak #1): the axon tunnel provably
+    wedges AND recovers on hour scales, and the driver's bench window is
+    the one shot per round at a number — one 120s probe wasted round 2's.
+    Probe a child every ``interval_s`` for up to ``budget_s`` before
+    emitting the failure JSON."""
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        n = _child_probe(90)
+        if n > 0:
+            break
+        remaining = deadline - time.monotonic()
+        print(
+            f"[bench] probe {attempt}: backend unreachable "
+            f"({max(0, remaining):.0f}s of budget left)",
+            file=sys.stderr,
+            flush=True,
+        )
+        if remaining <= interval_s:
+            emit(
+                0.0, 0.0,
+                {"error": f"no accelerator within {budget_s}s "
+                 f"({attempt} probes, 1 every {interval_s}s)"},
+            )
+            sys.exit(1)
+        time.sleep(interval_s)
+
+    # the child saw a backend; enumerate in-process behind a deadline —
+    # on a hang we must exit loudly, NOT retry (the hung thread holds
+    # jax's backend lock; any fallback would deadlock — observed on
+    # this rig, see __graft_entry__._probe_devices)
+    got = {}
 
     def probe():
         try:
-            out["devs"] = jax.devices()
+            got["devs"] = jax.devices()
         except Exception as e:  # pragma: no cover
-            out["err"] = e
+            got["err"] = e
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    t.join(timeout=timeout_s)
-    if "devs" not in out:
+    t.join(timeout=120)
+    if "devs" not in got:
         emit(
             0.0, 0.0,
-            {"error": f"no accelerator within {timeout_s}s: "
-             f"{out.get('err', 'device probe hung')}"},
+            {"error": "backend answered a child probe but hung/errored "
+             f"in-process: {got.get('err', 'probe hung')}"},
         )
         sys.exit(1)
-    return out["devs"]
+    return got["devs"]
+
+
+# approximate bf16 peak TFLOP/s per chip by device_kind substring —
+# roofline denominators, not guarantees (public spec-sheet numbers)
+_PEAK_BF16_TFLOPS = (
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5 lite", 197.0), ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 46.0),
+)
+
+
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _flops_per_step(train_fn, example_args):
+    """Per-step FLOPs from XLA's cost analysis of the compiled step —
+    the analytic numerator for MFU, computed by the compiler (not
+    hand-math in a doc, per VERDICT r2 weak #2)."""
+    try:
+        cost = train_fn.lower(*example_args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # old jax: one dict per device
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception as e:  # cost analysis must never kill the bench
+        print(f"[bench] cost_analysis unavailable: {e}", file=sys.stderr)
+        return None
+
+
+def _efficiency_curve(n_chips: int, per_chip_value: float):
+    """BASELINE.md's second metric: efficiency(N) = per-chip img/s at N
+    ÷ per-chip img/s at 1. With one visible chip the curve is the
+    trivial row; with more, measure the real 1→N curve."""
+    if n_chips <= 1:
+        return [
+            {
+                "devices": 1,
+                "images_per_sec": round(per_chip_value, 2),
+                "per_chip": round(per_chip_value, 2),
+                "efficiency": 1.0,
+            }
+        ]
+    from theanompi_tpu.models.alex_net import AlexNet
+    from theanompi_tpu.utils.benchmark import scaling_efficiency
+
+    counts = [n for n in (1, 2, 4, 8, 16, 32) if n <= n_chips]
+    if counts[-1] != n_chips:
+        counts.append(n_chips)
+    rows = scaling_efficiency(
+        AlexNet,
+        dict(
+            batch_size=256,
+            compute_dtype="bfloat16",
+            lr=1e-3,
+            n_synth_batches=4,
+            print_freq=10_000,
+        ),
+        device_counts=counts,
+        n_steps=10,
+    )
+    return [
+        {k: (round(v, 4) if isinstance(v, float) else v) for k, v in r.items()}
+        for r in rows
+    ]
 
 
 def main():
@@ -72,6 +198,7 @@ def main():
     from theanompi_tpu.utils.benchmark import BENCH_CANDIDATES as CANDIDATES
 
     n_chips = jax.device_count()
+    device_kind = jax.devices()[0].device_kind
     mesh = make_mesh()
     per_chip_bs = 512  # throughput knee from the bs sweep (128→512: +27%)
 
@@ -178,20 +305,46 @@ def main():
 
     global_bs = per_chip_bs * n_chips
     imgs_per_sec = n_steps * global_bs / dt
-    emit(
-        imgs_per_sec / n_chips,
-        1.0,
-        {
-            "chips": n_chips,
-            "per_chip_batch": per_chip_bs,
-            "steps": n_steps,
-            "total_s": round(dt, 3),
-            "loss_final": float(loss),
-            "compute_dtype": "bfloat16",
-            "config": chosen,
-            "candidate_ms_per_step": picks,
-        },
+    per_chip = imgs_per_sec / n_chips
+
+    # roofline: FLOPs of the winner's compiled step (fwd+bwd+exchange+
+    # update), sustained TFLOP/s, and % of the chip's bf16 peak.
+    # cost_analysis of the SPMD-partitioned executable reports the
+    # PER-DEVICE module's work, so this is per-chip already — no second
+    # division by n_chips (that would under-report MFU n_chips-fold)
+    x0, y0 = batches[0]
+    flops = _flops_per_step(
+        train_fn, (params, net_state, opt_state, x0, y0, keys[0])
     )
+    peak = _peak_tflops(device_kind)
+    tflops = mfu = None
+    if flops is not None:
+        tflops = flops * n_steps / dt / 1e12
+        if peak:
+            mfu = 100.0 * tflops / peak
+
+    detail = {
+        "chips": n_chips,
+        "device_kind": device_kind,
+        "per_chip_batch": per_chip_bs,
+        "steps": n_steps,
+        "total_s": round(dt, 3),
+        "loss_final": float(loss),
+        "compute_dtype": "bfloat16",
+        "config": chosen,
+        "candidate_ms_per_step": picks,
+        "flops_per_step_per_chip": flops,
+        "tflops_sustained_per_chip": round(tflops, 2) if tflops else None,
+        "peak_bf16_tflops": peak,
+        "mfu_pct": round(mfu, 1) if mfu else None,
+    }
+    try:
+        # post-measurement extra: must never discard the round's one
+        # measured number (fresh models per device count can OOM)
+        detail["efficiency"] = _efficiency_curve(n_chips, per_chip)
+    except Exception as e:
+        detail["efficiency"] = f"failed: {type(e).__name__}: {e}"
+    emit(per_chip, 1.0, detail)
 
 
 if __name__ == "__main__":

@@ -103,3 +103,81 @@ def test_collective_wire_bytes_accounting():
     _, i8 = run("int8")
     assert i8["total_bytes"] < 0.65 * ar["total_bytes"]
     assert "all-to-all" in i8["by_op"] and "all-gather" in i8["by_op"]
+
+
+# -- bench.py roofline + retry-probe pieces (VERDICT r2 #1/#2/#4) ------------
+
+
+def test_bench_flops_per_step_from_cost_analysis():
+    """XLA's cost analysis must yield a positive per-step FLOP count for
+    a compiled train step — the MFU numerator bench.py emits."""
+    import jax
+
+    import bench
+    from theanompi_tpu.runtime.mesh import shard_batch
+
+    model = Cifar10_model(config=CFG, mesh=make_mesh())
+    fn = model.compile_train()
+    x, y = shard_batch(model.mesh, next(iter(model.data.train_batches())))
+    flops = bench._flops_per_step(
+        fn,
+        (model.params, model.net_state, model.opt_state, x, y,
+         jax.random.PRNGKey(0)),
+    )
+    assert flops is not None and flops > 0
+    # sanity scale: a 1.5M-param CNN step on batch 64 is many MFLOPs,
+    # not KFLOPs — and not absurdly beyond a PFLOP
+    assert 1e6 < flops < 1e15
+
+
+def test_bench_peak_table_lookup():
+    import bench
+
+    assert bench._peak_tflops("TPU v5 lite") == 197.0
+    assert bench._peak_tflops("TPU v4") == 275.0
+    assert bench._peak_tflops("NVIDIA H100") is None  # unknown: no MFU
+
+
+def test_bench_efficiency_curve_single_chip():
+    import bench
+
+    rows = bench._efficiency_curve(1, 44_676.0)
+    assert rows == [
+        {"devices": 1, "images_per_sec": 44676.0, "per_chip": 44676.0,
+         "efficiency": 1.0}
+    ]
+
+
+def test_bench_probe_budget_exhaustion_emits_error_json(monkeypatch, capsys):
+    """The retry loop must emit the failure JSON (not hang, not raise)
+    when the backend never answers within budget."""
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_child_probe", lambda t: 0)
+    try:
+        bench._require_devices(budget_s=0.5, interval_s=0.2)
+        assert False, "should have exited"
+    except SystemExit as e:
+        assert e.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0
+    assert "no accelerator" in out["detail"]["error"]
+
+
+def test_bench_probe_retries_until_backend_appears(monkeypatch):
+    """A tunnel that recovers mid-budget must be caught (the r2 failure
+    mode: one probe, then give-up, while the tunnel recovered later)."""
+    import bench
+
+    calls = {"n": 0}
+
+    def flaky(timeout):
+        calls["n"] += 1
+        return 0 if calls["n"] < 3 else 8
+
+    monkeypatch.setattr(bench, "_child_probe", flaky)
+    devs = bench._require_devices(budget_s=30.0, interval_s=0.05)
+    assert calls["n"] == 3
+    assert len(devs) == 8  # the fake CPU mesh answered in-process

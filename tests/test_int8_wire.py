@@ -90,9 +90,14 @@ def test_int8_reduce_matches_true_mean(strategy):
     g = rng.randn(n_dev, 1000).astype(np.float32)  # shard i = row i
     out = _int8_mean(mesh, g, strategy)
     true_mean = g.mean(axis=0)
-    # every shard holds the same reduced values
+    # error bound: two quant legs, each within one quantum ~ amax/127
+    # (RN: half; SR: a full quantum of dither) — ~0.055 for this amax.
+    # At this size the XLA strategies quantize (4n > world*BLOCK) while
+    # the pallas tier's 32x-chunk crossover falls back to exact psum;
+    # pallas engagement at scale is covered by the fp16s tight test.
+    atol = 2.0 * np.abs(g).max() / 127.0
     for i in range(n_dev):
-        np.testing.assert_allclose(out[i], true_mean, atol=2e-2)
+        np.testing.assert_allclose(out[i], true_mean, atol=atol)
 
 
 def test_int8_requires_mesh():

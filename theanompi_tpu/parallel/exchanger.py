@@ -186,12 +186,13 @@ class BSP_Exchanger:
         # pad so each device's shard is a whole number of quant blocks;
         # the Pallas kernels additionally need 32-row-aligned tiles
         chunk = world * Q.BLOCK * (32 if pallas else 1)
-        if n < chunk:
-            # leaf smaller than one padded chunk: the pad-up would cost
-            # MORE wire than uncompressed fp32 (for the pallas tier the
-            # crossover is 32 blocks/device — a mid-size leaf padded 16×
-            # would move ~8× the bytes of a plain psum) — just psum it
-            # (biases, BN scales, small dense layers)
+        # wire-cost crossover: a leaf below one chunk pads UP to exactly
+        # chunk elements, so the quantized leg moves ~chunk×(payload
+        # bytes/elem) while a plain psum moves 4n fp32 bytes — quantize
+        # only when that's a win. (int8: fall back below chunk/4; fp16s:
+        # below chunk/2. Scales add ~4/BLOCK ≈ 1.6%, ignored.)
+        payload_bytes = 2 if self.strategy in _FP16S_STRATEGIES else 1
+        if 4 * n < chunk * payload_bytes:
             return lax.psum(g, axis)
         pad = (-n) % chunk
         if pad:
