@@ -10,12 +10,18 @@ anything imports jax.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# THEANOMPI_TPU_TESTS=1 leaves the real backend in place for the
+# `-m tpu` Mosaic kernel-validation suite (test_tpu_kernels.py) — every
+# other run is pinned to the 8-fake-device CPU mesh below.
+_TPU_MODE = os.environ.get("THEANOMPI_TPU_TESTS") == "1"
+
+if not _TPU_MODE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # repo root on sys.path so `import theanompi_tpu` works without install
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,7 +33,8 @@ sys.path.insert(0, _repo_root)
 # lands before any device is touched.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_MODE:
+    jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: the zoo smoke tests compile full
 # ResNet50/GoogLeNet/VGG16 graphs on one CPU core (~6 min cold); cached
@@ -43,4 +50,9 @@ def pytest_configure(config):
         "markers",
         "distributed: spawns real OS processes joined by jax.distributed "
         "(deselect with -m 'not distributed' where spawning is unavailable)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "tpu: Mosaic-compiled Pallas kernel validation — needs a live "
+        "chip and THEANOMPI_TPU_TESTS=1 (auto-skipped on the CPU rig)",
     )

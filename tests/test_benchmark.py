@@ -155,7 +155,7 @@ def test_bench_probe_budget_exhaustion_emits_error_json(monkeypatch, capsys):
 
     import bench
 
-    monkeypatch.setattr(bench, "_child_probe", lambda t: 0)
+    monkeypatch.setattr(bench, "_child_probe", lambda t: (0, "boom: tunnel"))
     try:
         bench._require_devices(budget_s=0.5, interval_s=0.2)
         assert False, "should have exited"
@@ -164,6 +164,8 @@ def test_bench_probe_budget_exhaustion_emits_error_json(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 0.0
     assert "no accelerator" in out["detail"]["error"]
+    # the triage breadcrumb: the last probe's cause rides the JSON
+    assert out["detail"]["last_probe_error"] == "boom: tunnel"
 
 
 def test_bench_probe_retries_until_backend_appears(monkeypatch):
@@ -175,7 +177,7 @@ def test_bench_probe_retries_until_backend_appears(monkeypatch):
 
     def flaky(timeout):
         calls["n"] += 1
-        return 0 if calls["n"] < 3 else 8
+        return (0, "still wedged") if calls["n"] < 3 else (8, "")
 
     monkeypatch.setattr(bench, "_child_probe", flaky)
     devs = bench._require_devices(budget_s=30.0, interval_s=0.05)

@@ -1,0 +1,290 @@
+"""Mosaic-compiled validation of every Pallas kernel (VERDICT r2 #3).
+
+On the CPU rig every Pallas kernel runs in INTERPRET mode (the
+``interpret=not _on_tpu()`` gates in ops/pallas_flash.py,
+ops/pallas_lrn.py, parallel/quantize.py) — so CI proves kernel *math*,
+while a Mosaic lowering failure (tiling/dtype constraint) would first
+surface mid-bench on a live chip. This module closes that gap: on a
+real TPU it re-runs each kernel COMPILED against its XLA oracle, and
+asserts the compiled step really contains Mosaic custom calls (the
+fold barrier the wire claims rest on).
+
+Run on a live chip (ONE TPU process at a time — a second client can
+wedge the axon tunnel):
+
+    THEANOMPI_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+``THEANOMPI_TPU_TESTS=1`` stops conftest.py from pinning the CPU
+platform. On the CPU rig the whole module auto-skips. Commit the first
+live session's output to ``docs/perf/`` (VERDICT r2 #3 acceptance).
+
+The multi-chip wire assertions (s8 rides the ICI, bf16 all-reduce NOT
+promoted back to f32 on TPU — the open half of VERDICT r2 weak #4)
+additionally need ``jax.device_count() >= 2`` and stay staged until a
+pod is reachable.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(
+        jax.default_backend() != "tpu",
+        reason="needs a live TPU (THEANOMPI_TPU_TESTS=1; see module docstring)",
+    ),
+]
+
+
+# -- flash attention: fwd + bwd kernels vs the XLA dense oracle --------------
+
+def _rand_qkv(key, b=2, t=64, h=4, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    mk = lambda k: jax.random.normal(k, (b, t, h, d), dtype)  # noqa: E731
+    return mk(kq), mk(kk), mk(kv)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t", [64, 96])  # 96: non-power-of-two blocks
+def test_flash_forward_compiled(causal, t):
+    from theanompi_tpu.ops.pallas_flash import flash_attention
+    from theanompi_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), t=t)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal))(q, k, v)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_compiled(causal):
+    """The FA-2 dq + dkv kernels under jit — the kernels the ring-SP
+    backward reuses blockwise (flash_backward_rows)."""
+    from theanompi_tpu.ops.pallas_flash import flash_attention
+    from theanompi_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), t=96)
+
+    g1 = jax.jit(
+        jax.grad(
+            lambda a, b, c: jnp.sum(jnp.square(flash_attention(a, b, c, causal))),
+            argnums=(0, 1, 2),
+        )
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(full_attention(a, b, c, causal=causal))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_flash_bf16_compiled():
+    from theanompi_tpu.ops.pallas_flash import flash_attention
+    from theanompi_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), t=64, dtype=jnp.bfloat16)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, True))(q, k, v)
+    ref = full_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=2e-2
+    )
+
+
+# -- LRN fused kernel vs the reduce_window baseline --------------------------
+
+@pytest.mark.parametrize("size", [3, 5])
+def test_lrn_pallas_compiled(size):
+    from theanompi_tpu.ops import layers as L
+
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 8, 8, 96), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(6), x.shape)
+    lp = L.LRN(size=size, impl="pallas")
+    lw = L.LRN(size=size, impl="window")
+    yp = jax.jit(lambda a: lp.apply({}, {}, a)[0])(x)
+    yw = lw.apply({}, {}, x)[0]
+    np.testing.assert_allclose(np.asarray(yp), np.asarray(yw), atol=5e-5, rtol=5e-5)
+    gp = jax.jit(jax.grad(lambda a: jnp.sum(lp.apply({}, {}, a)[0] * w)))(x)
+    gw = jax.grad(lambda a: jnp.sum(lw.apply({}, {}, a)[0] * w))(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gw), atol=5e-5, rtol=5e-5)
+
+
+# -- quantizer kernels: int8 RN/SR + fp16s fused cast+scale ------------------
+
+def test_quant_int8_kernel_compiled_matches_xla():
+    from theanompi_tpu.parallel import quantize as Q
+
+    x = np.random.RandomState(1).randn(64, Q.BLOCK).astype(np.float32)
+    q_x, s_x = Q.quantize_blocks(x)
+    q_p, s_p = jax.jit(Q.pallas_quantize_blocks)(x)
+    np.testing.assert_array_equal(np.asarray(q_x), np.asarray(q_p))
+    np.testing.assert_allclose(np.asarray(s_x), np.asarray(s_p), rtol=1e-6)
+    d_p = jax.jit(Q.pallas_dequantize_blocks)(q_p, s_p)
+    np.testing.assert_allclose(
+        np.asarray(Q.dequantize_blocks(q_x, s_x)), np.asarray(d_p), rtol=1e-6
+    )
+
+
+def test_quant_sr_kernel_compiled_bounds_and_determinism():
+    """Mosaic must reproduce the interpret-mode SR contract: within one
+    quantum of the input, deterministic per key, different across keys."""
+    from theanompi_tpu.parallel import quantize as Q
+
+    x = np.random.RandomState(2).randn(32, Q.BLOCK).astype(np.float32) * 2.0
+    fn = jax.jit(Q.pallas_quantize_blocks)
+    q0, s0 = fn(x, jax.random.PRNGKey(0))
+    back = np.asarray(Q.pallas_dequantize_blocks(q0, s0))
+    quantum = np.asarray(s0)[:, None] + 1e-7
+    assert (np.abs(back - x) < quantum).all()
+    q0b, _ = fn(x, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(q0), np.asarray(q0b))
+    q1, _ = fn(x, jax.random.PRNGKey(1))
+    assert (np.asarray(q0) != np.asarray(q1)).any()
+
+
+def test_quant_fp16s_kernel_compiled_matches_xla():
+    from theanompi_tpu.parallel import quantize as Q
+
+    x = np.random.RandomState(3).randn(64, Q.BLOCK).astype(np.float32)
+    q_x, s_x = Q.quantize_blocks_fp16(x)
+    q_p, s_p = jax.jit(Q.pallas_quantize_blocks_fp16)(x)
+    np.testing.assert_array_equal(np.asarray(q_x), np.asarray(q_p))
+    np.testing.assert_allclose(np.asarray(s_x), np.asarray(s_p), rtol=1e-6)
+
+
+def test_pallas_lowers_to_mosaic_custom_call():
+    """The fold-barrier claim: on TPU a pallas_call is a Mosaic custom
+    call in the compiled HLO, not inlined foldable ops (on CPU the
+    interpret path IS foldable — docs/perf/NOTES.md wire accounting)."""
+    from theanompi_tpu.parallel import quantize as Q
+
+    x = jnp.ones((32, Q.BLOCK), jnp.float32)
+    hlo = jax.jit(Q.pallas_quantize_blocks).lower(x).compile().as_text()
+    assert "custom-call" in hlo and ("tpu_custom_call" in hlo or "Mosaic" in hlo), (
+        "pallas quant kernel did not lower to a Mosaic custom call:\n"
+        + hlo[:2000]
+    )
+
+
+# -- ring-SP flash backward on a real multi-chip mesh ------------------------
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 chips")
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_grads_compiled_multichip(causal):
+    """The blockwise FA-2 ring backward (traveling dk/dv accumulators)
+    over a REAL sp axis — the CPU suite proves this in interpret mode
+    only (test_flash.py::test_ring_flash_grads_match_dense)."""
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.ring_attention import (
+        SEQ_AXIS, full_attention, ring_attention,
+    )
+    from theanompi_tpu.runtime.mesh import make_mesh
+
+    sp = 2
+    mesh = make_mesh(
+        shape=(sp,), axis_names=(SEQ_AXIS,), devices=jax.devices()[:sp]
+    )
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), t=64)
+
+    def sharded_loss(a, b, c):
+        def inner(aa, bb, cc):
+            return jnp.sum(
+                jnp.square(
+                    ring_attention(
+                        aa, bb, cc, axis_name=SEQ_AXIS, axis_size=sp,
+                        causal=causal, attn_impl="flash",
+                    )
+                )
+            )
+
+        per = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, SEQ_AXIS), P(None, SEQ_AXIS), P(None, SEQ_AXIS)),
+            out_specs=P(),
+            check_vma=False,
+        )(a, b, c)
+        return per
+
+    g1 = jax.jit(jax.grad(sharded_loss, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(full_attention(a, b, c, causal=causal))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+# -- wire honesty on real ICI (VERDICT r2 weak #4, open half) ----------------
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 chips")
+def test_int8_wire_rides_s8_on_tpu():
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel import quantize as Q
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh
+
+    mesh = make_mesh()
+    world = jax.device_count()
+    n = world * Q.BLOCK * 32 * 2
+    ex = BSP_Exchanger(strategy="pallas_int8", axis=DATA_AXIS, mesh=mesh)
+
+    hlo = (
+        jax.jit(
+            jax.shard_map(
+                lambda g: ex.reduce_grads({"g": g})["g"], mesh=mesh,
+                in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+                check_vma=False,
+            )
+        )
+        .lower(jax.ShapeDtypeStruct((world, n), jnp.float32))
+        .compile()
+        .as_text()
+    )
+    coll = [l for l in hlo.splitlines() if "all-to-all" in l or "all-gather" in l]
+    assert any("s8[" in l for l in coll), "s8 payload missing on TPU wire"
+
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 chips")
+def test_bf16_allreduce_not_promoted_on_tpu():
+    """On CPU, XLA folds the casts around the bf16 strategy's all-reduce
+    and promotes it back to f32 (discovered by collective_wire_bytes).
+    The claim 'bf16 halves exchange bytes' is only honest if the TPU
+    backend keeps the all-reduce in bf16 — assert exactly that."""
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh
+
+    mesh = make_mesh()
+    world = jax.device_count()
+    n = 1 << 16
+    ex = BSP_Exchanger(strategy="bf16", axis=DATA_AXIS, mesh=mesh)
+
+    hlo = (
+        jax.jit(
+            jax.shard_map(
+                lambda g: ex.reduce_grads({"g": g})["g"], mesh=mesh,
+                in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+                check_vma=False,
+            )
+        )
+        .lower(jax.ShapeDtypeStruct((world, n), jnp.float32))
+        .compile()
+        .as_text()
+    )
+    ar = [
+        l for l in hlo.splitlines()
+        if " = " in l and ("all-reduce(" in l or "all-reduce-start(" in l)
+    ]
+    assert ar, "bf16 strategy lost its all-reduce"
+    assert any("bf16[" in l for l in ar), (
+        "bf16 all-reduce was promoted to f32 on TPU too — scope the "
+        "strategy's docstring claim:\n" + "\n".join(ar)
+    )
