@@ -56,3 +56,21 @@ def pytest_configure(config):
         "tpu: Mosaic-compiled Pallas kernel validation — needs a live "
         "chip and THEANOMPI_TPU_TESTS=1 (auto-skipped on the CPU rig)",
     )
+
+
+def pytest_collection_modifyitems(config, items):
+    """In TPU mode, only the tpu-marked tests may run: the rest of the
+    suite is calibrated for the 8-fake-device CPU mesh and would fail
+    confusingly (and burn the single-client TPU tunnel) against a live
+    chip with a different device count."""
+    if not _TPU_MODE:
+        return
+    import pytest as _pytest
+
+    skip = _pytest.mark.skip(
+        reason="THEANOMPI_TPU_TESTS=1 runs only -m tpu tests; unset it "
+        "for the CPU suite"
+    )
+    for item in items:
+        if "tpu" not in item.keywords:
+            item.add_marker(skip)

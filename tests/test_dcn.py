@@ -93,3 +93,20 @@ def test_hybrid_compressed_strategies_track_flat_ar(strategy):
     hybrid = _losses(make_mesh(dcn_shape=2), 8, exch_strategy=strategy)
     flat_ar = _losses(make_mesh(), 8, exch_strategy="ar")
     np.testing.assert_allclose(hybrid, flat_ar, rtol=5e-2)
+
+
+def test_dcn_engaged_on_direct_construction():
+    """dcn_shape in CONFIG alone must build the two-level mesh — direct
+    construction (no rule.init, no explicit mesh) included."""
+    m = Cifar10_model(config=dict(TINY, batch_size=8, dcn_shape=2))
+    assert DCN_AXIS in m.mesh.shape and m.mesh.shape[DCN_AXIS] == 2
+    assert m.n_workers == 8  # batch still shards over all devices
+
+
+def test_dcn_shape_with_flat_mesh_is_loud():
+    """A config asking for DCN with a mesh that has no dp_dcn axis must
+    hard-fail, not silently train on a different collective layout."""
+    with pytest.raises(ValueError, match=DCN_AXIS):
+        Cifar10_model(
+            config=dict(TINY, batch_size=8, dcn_shape=2), mesh=make_mesh()
+        )

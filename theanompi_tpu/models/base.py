@@ -74,6 +74,14 @@ COMMON_DEFAULTS = dict(
     grad_accum=1,  # microbatches per step (lax.scan): grads accumulate
     # across K sequential fwd+bwd passes before ONE exchange+update —
     # K× the effective batch at 1/K the activation HBM
+    dcn_shape=None,  # N = two-level ('dp_dcn', dp...) mesh: intra-slice
+    # collectives ride ICI, only the outer reduction crosses DCN
+    # (make_mesh(dcn_shape=...)); honored by the DP build_mesh so
+    # rule.init / launch.py / direct construction engage it from config
+    # alone — on a multi-process run slices align with process
+    # boundaries. Models whose build_mesh doesn't support it (the
+    # sp/tp/pp/ep overrides) hard-fail at init instead of silently
+    # training on a flat mesh.
 )
 
 
@@ -99,7 +107,22 @@ class TpuModel:
         self.config.update(overrides)
         cfg = self.config
 
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # default mesh goes through the CLASS's build_mesh so config-
+        # driven topology (dcn_shape here; sp/tp/pp/ep in subclasses
+        # that override both) is honored on direct construction too,
+        # not only via rule.init/launch
+        self.mesh = (
+            mesh if mesh is not None else type(self).build_mesh(config=cfg.asdict())
+        )
+        if cfg.get("dcn_shape") and DCN_AXIS not in self.mesh.shape:
+            # loud, not silent: either this model's build_mesh doesn't
+            # support dcn_shape or an explicit flat mesh was passed
+            # alongside it — training would quietly use a different
+            # collective layout than the config requested
+            raise ValueError(
+                f"config dcn_shape={cfg.get('dcn_shape')} but the mesh "
+                f"{dict(self.mesh.shape)} has no '{DCN_AXIS}' axis"
+            )
         self._engage_dcn_axis()
         self.n_workers = 1
         for ax in self.batch_axes:
@@ -171,11 +194,14 @@ class TpuModel:
     def build_mesh(cls, devices=None, config: Optional[dict] = None):
         """Mesh the rules should build for this model class.
 
-        Plain data-parallel models use one ``dp`` axis; models with
-        extra mesh axes (the sequence-parallel transformer) override so
-        ``rule.init(...)`` engages them without the caller hand-building
-        a mesh."""
-        return make_mesh(devices=devices)
+        Plain data-parallel models use one ``dp`` axis (two-level
+        ``('dp_dcn', 'dp')`` when the config carries ``dcn_shape``);
+        models with extra mesh axes (the sequence-parallel transformer)
+        override so ``rule.init(...)`` engages them without the caller
+        hand-building a mesh."""
+        return make_mesh(
+            devices=devices, dcn_shape=(config or {}).get("dcn_shape")
+        )
 
     def build_data(self) -> None:
         raise NotImplementedError
