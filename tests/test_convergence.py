@@ -1,0 +1,51 @@
+"""Convergence-grade training check (VERDICT r2 #6).
+
+The reference established correctness by training to convergence
+(SURVEY.md §5), not by few-step smokes. This test trains the CIFAR CNN
+on the synthetic class-conditional-Gaussian set to a target VAL error —
+generalization, not memorization — in the default suite. The longer
+1-vs-8-device, EASGD-vs-BSP, and LSGAN/GOSGD evidence lives in
+``docs/convergence/`` (reproducer: ``scripts/convergence.py``).
+"""
+
+import jax
+
+import theanompi_tpu
+
+
+def test_bsp_trains_to_target_val_error(tmp_path):
+    import json
+
+    rule = theanompi_tpu.BSP()
+    rule.init(
+        devices=jax.devices(),
+        model_config=dict(
+            batch_size=16,  # global 128 over the 8-device mesh
+            n_synth_train=2048,
+            n_synth_val=512,
+            n_epochs=3,
+            lr=0.01,
+            lr_linear_scaling=False,  # global batch is fixed here; the
+            # per-worker scaling rule would overshoot (0.08 diverges)
+            dropout_rate=0.0,
+            print_freq=1000,
+            comm_probe=False,
+            seed=7,
+        ),
+        checkpoint_dir=str(tmp_path),
+        val_freq=1,
+        checkpoint_freq=0,
+    )
+    rule.wait()
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "record_rank0.jsonl").read_text().splitlines()
+    ]
+    val = [r for r in rows if r["kind"] == "val"]
+    assert len(val) == 3
+    # chance is 0.9; the class-conditional Gaussians are separable, so a
+    # trained CNN must generalize to near-zero val error — this is the
+    # assertion that caught the val-set-with-different-prototypes bug
+    assert val[-1]["error"] <= 0.10, [r["error"] for r in val]
+    # and it LEARNED, monotically-ish: final far below the first epoch
+    assert val[-1]["error"] <= val[0]["error"]

@@ -116,13 +116,24 @@ class ArrayDataset:
 
 
 def _synthetic_classification(
-    n: int, shape: Tuple[int, ...], n_classes: int, seed: int
+    n: int, shape: Tuple[int, ...], n_classes: int, seed: int,
+    proto_seed: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Class-conditional Gaussians: mean pattern per class + noise.
 
-    Learnable by a linear model, so loss curves in tests/benches move."""
+    ``proto_seed`` (default: ``seed``) draws the class prototypes
+    SEPARATELY from the samples, so a train and a val split generated
+    with different sample seeds but one proto_seed describe the same
+    classes — without that, val error on the synthetic sets was stuck
+    at chance by construction (each split had its own prototypes) and
+    "learnable" only meant the train loss (found by the r3 convergence
+    runs, scripts/convergence.py)."""
     rng = np.random.RandomState(seed)
-    protos = rng.randn(n_classes, *shape).astype(np.float32) * 0.5
+    protos = (
+        np.random.RandomState(seed if proto_seed is None else proto_seed)
+        .randn(n_classes, *shape)
+        .astype(np.float32) * 0.5
+    )
     y = rng.randint(0, n_classes, size=n).astype(np.int32)
     x = protos[y] + rng.randn(n, *shape).astype(np.float32) * 0.3
     return x, y
@@ -157,7 +168,9 @@ class Cifar10Data:
                 n_synth_train, self.shape, self.n_classes, seed
             )
             xva, yva = _synthetic_classification(
-                n_synth_val, self.shape, self.n_classes, seed + 1
+                n_synth_val, self.shape, self.n_classes, seed + 1,
+                proto_seed=seed,  # same classes as train — val is
+                # meaningful, not chance-by-construction
             )
             self.synthetic = True
         # mean subtraction, as the reference does with the stored img_mean
@@ -241,7 +254,9 @@ class MnistData:
                 n_synth_train, self.shape, self.n_classes, seed
             )
             xva, yva = _synthetic_classification(
-                n_synth_val, self.shape, self.n_classes, seed + 1
+                n_synth_val, self.shape, self.n_classes, seed + 1,
+                proto_seed=seed,  # same classes as train — val is
+                # meaningful, not chance-by-construction
             )
             self.synthetic = True
         self.dataset = ArrayDataset(xtr, ytr, xva, yva, batch_size, seed)
@@ -507,7 +522,8 @@ class ImageNetData:
             i = int(path.split("//")[1])
             shape = (self.image_size, self.image_size, 3)
             x, y = _synthetic_classification(
-                self.batch_size, shape, self.n_classes, seed=i
+                self.batch_size, shape, self.n_classes, seed=i,
+                proto_seed=0,  # one class structure across all batches
             )
         else:
             with np.load(path) as d:
