@@ -312,6 +312,15 @@ class MaxPool(Layer):
     def init(self, key, in_shape):
         h, w, c = in_shape
         oh, ow = _conv_out_hw((h, w), self.window, self.stride, self.padding)
+        if oh <= 0 or ow <= 0:
+            # a zero-size feature map silently trains on biases alone in
+            # the native path and crashes the mask backward — refuse at
+            # init where the architecture mistake is visible
+            raise ValueError(
+                f"MaxPool window {self.window} on {h}x{w} input produces "
+                f"an empty {oh}x{ow} output — input image too small for "
+                "this architecture"
+            )
         return {}, {}, (oh, ow, c)
 
     def apply(self, params, state, x, train=False, rng=None):
