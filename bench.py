@@ -100,7 +100,20 @@ def _require_devices(budget_s: float = None, interval_s: float = 120.0):
     (``THEANOMPI_BENCH_BUDGET_S``, VERDICT r3 #2) so a short driver
     window isn't consumed entirely by probing."""
     if budget_s is None:
-        budget_s = float(os.environ.get("THEANOMPI_BENCH_BUDGET_S", 960.0))
+        raw = os.environ.get("THEANOMPI_BENCH_BUDGET_S", "")
+        try:
+            budget_s = float(raw) if raw else 960.0
+        except ValueError:
+            # a malformed env var must not crash before the JSON line —
+            # every failure path goes through emit(), and a bad budget
+            # spelling is not worth losing the round's measurement over
+            print(
+                f"[bench] ignoring malformed THEANOMPI_BENCH_BUDGET_S={raw!r}"
+                " (want seconds as a number); using 960",
+                file=sys.stderr,
+                flush=True,
+            )
+            budget_s = 960.0
     interval_s = min(interval_s, max(10.0, budget_s / 4))
     deadline = time.monotonic() + budget_s
     attempt = 0
@@ -291,17 +304,13 @@ def main():
     # re-runs skip the ~minutes of AlexNet compiles, and the post-window
     # cost-analysis lowering of the already-compiled winner
     # deserializes instead of recompiling inside the scarce bench window.
-    # The rehearsal caches per-host under tmp instead: CPU AOT results
-    # compiled on another host can SIGILL here, and rehearsal entries
-    # must not pollute the cache the scarce TPU window depends on
+    # The rehearsal caches per-host+user under tmp instead: CPU AOT
+    # results compiled on another host can SIGILL here, and rehearsal
+    # entries must not pollute the cache the scarce TPU window depends on
     if CPU_REHEARSAL:
-        import platform
-        import tempfile
+        from theanompi_tpu.cachedir import cpu_cache_dir
 
-        cache_dir = os.path.join(
-            tempfile.gettempdir(),
-            f"theanompi_jax_cache_{platform.node() or 'host'}",
-        )
+        cache_dir = cpu_cache_dir()
     else:
         cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".jax_cache")

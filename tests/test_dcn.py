@@ -110,3 +110,14 @@ def test_dcn_shape_with_flat_mesh_is_loud():
         Cifar10_model(
             config=dict(TINY, batch_size=8, dcn_shape=2), mesh=make_mesh()
         )
+
+
+def test_dcn_shape_size_mismatch_is_loud():
+    """ADVICE r3: the axis EXISTING is not enough — an explicit mesh
+    whose dp_dcn size disagrees with the config is the same silent
+    layout divergence and must also hard-fail."""
+    with pytest.raises(ValueError, match="dcn_shape=4"):
+        Cifar10_model(
+            config=dict(TINY, batch_size=8, dcn_shape=4),
+            mesh=make_mesh(dcn_shape=2),
+        )

@@ -227,3 +227,38 @@ def test_worker_rejects_bad_watchdog_action(tmp_path):
     )
     with pytest.raises(ValueError, match="watchdog action"):
         BSP_Worker(m, watchdog_timeout=10, watchdog_action="exi")
+
+
+def test_faulthandler_enabled_and_dumps_on_fatal():
+    """VERDICT r3 #8: a fatal crash must leave per-thread tracebacks.
+    conftest enables faulthandler for the suite (asserted in-process);
+    the launcher enables it at main() entry (asserted in a subprocess
+    that then dies of a real SIGSEGV — the dump must name the thread)."""
+    import faulthandler
+    import subprocess
+    import sys
+
+    assert faulthandler.is_enabled()  # conftest's enable covers the suite
+
+    code = r"""
+import sys
+from unittest import mock
+import theanompi_tpu.launch as L
+
+# stop main() right after its faulthandler.enable() line
+with mock.patch.object(L, "build_parser", side_effect=SystemExit(0)):
+    try:
+        L.main([])
+    except SystemExit:
+        pass
+import faulthandler
+assert faulthandler.is_enabled(), "launcher did not enable faulthandler"
+faulthandler._sigsegv()  # real fatal signal, not an exception
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode != 0
+    assert "Segmentation fault" in out.stderr or "SIGSEGV" in out.stderr
+    assert "Current thread" in out.stderr or "Thread 0x" in out.stderr

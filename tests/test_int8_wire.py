@@ -403,3 +403,63 @@ def test_quantize_sr_error_bound_property(x, key):
     back = np.asarray(Q.dequantize_blocks(q, s))
     bound = np.asarray(s)[:, None] * (1.0 + 1e-5)
     assert (np.abs(back - x) < bound).all()
+
+
+# -- avg mode rides the configured wire (VERDICT r3 #5) ----------------------
+
+
+def test_avg_mode_params_ride_compressed_wire():
+    """sync_mode='avg' + a block strategy must carry the quantized
+    payload on the parameter-averaging collectives — round 3 silently
+    fell back to an fp32 pmean, discarding the configured strategy."""
+    mesh = make_mesh()
+    n = 8 * Q.BLOCK * 32 * 2
+
+    def lower(strategy):
+        ex = BSP_Exchanger(strategy=strategy, axis=DATA_AXIS, mesh=mesh)
+
+        def step(p):
+            return ex.average_params({"p": p})["p"]
+
+        return (
+            jax.jit(
+                jax.shard_map(
+                    step, mesh=mesh, in_specs=P(DATA_AXIS),
+                    out_specs=P(DATA_AXIS), check_vma=False,
+                )
+            )
+            .lower(jax.ShapeDtypeStruct((8, n), jnp.float32))
+            .compile()
+            .as_text()
+        )
+
+    hlo = lower("int8")
+    lines = [
+        l for l in hlo.splitlines() if re.search(r"all-to-all|all-gather", l)
+    ]
+    assert lines, "avg path lost its collectives"
+    assert any("s8[" in l for l in lines), hlo[:2000]
+    # no payload-sized fp32 on the wire (scales only)
+    for l in lines:
+        for dims in re.findall(r"f32\[([\d,]*)\]", l):
+            sz = int(np.prod([int(d) for d in dims.split(",") if d]))
+            assert sz <= n // Q.BLOCK, f"fp32 payload on the avg wire: {l}"
+
+
+@pytest.mark.parametrize("strategy", ["fp16s", "int8_sr"])
+def test_avg_mode_training_tracks_ar(strategy):
+    """End-to-end: sync_mode='avg' with a compressed wire must track the
+    fp32 avg run closely — params AND optimizer moments now both ride
+    the configured strategy."""
+    def run(strat):
+        model = Cifar10_model(
+            config=dict(TINY, batch_size=8, sync_mode="avg",
+                        exch_strategy=strat),
+            mesh=make_mesh(),
+        )
+        model.compile_train()
+        model.reset_train_iter(0)
+        rec = Recorder(verbose=False)
+        return [float(model.train_iter(i, rec)[0]) for i in range(1, 5)]
+
+    np.testing.assert_allclose(run(strategy), run("ar"), rtol=5e-2)

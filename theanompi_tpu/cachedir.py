@@ -1,0 +1,34 @@
+"""Per-host, per-user XLA compile-cache location for CPU runs.
+
+One definition shared by tests/conftest.py, bench.py's rehearsal, and
+scripts/convergence.py — the three CPU entrypoints must agree or their
+caches silently diverge.  Deliberately import-light (no jax, nothing
+heavy): conftest calls this before it pins the platform.
+
+Why not the repo's ``.jax_cache``: XLA:CPU persists AOT-compiled
+executables keyed by the *compiling* machine's features; loading one on
+a host without those features logs ``cpu_aot_loader`` errors and can
+SIGILL mid-run (the most plausible cause of round 3's one
+nondeterministic 'Fatal Python error').  The repo cache stays reserved
+for the real-TPU path, whose Mosaic binaries are host-independent.
+
+Keyed by host AND user: a shared rig's tempdir is world-writable but a
+cache dir created by user A is not writable by user B — a host-only key
+would reintroduce per-user nondeterministic breakage.
+"""
+
+import getpass
+import os
+import platform
+import tempfile
+
+
+def cpu_cache_dir() -> str:
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):  # no passwd entry (containers)
+        user = str(os.getuid()) if hasattr(os, "getuid") else "user"
+    return os.path.join(
+        tempfile.gettempdir(),
+        f"theanompi_jax_cache_{platform.node() or 'host'}_{user}",
+    )

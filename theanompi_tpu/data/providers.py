@@ -118,6 +118,9 @@ class ArrayDataset:
 def _synthetic_classification(
     n: int, shape: Tuple[int, ...], n_classes: int, seed: int,
     proto_seed: Optional[int] = None,
+    proto_scale: float = 0.5,
+    noise: float = 0.3,
+    label_noise: float = 0.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Class-conditional Gaussians: mean pattern per class + noise.
 
@@ -127,15 +130,41 @@ def _synthetic_classification(
     classes — without that, val error on the synthetic sets was stuck
     at chance by construction (each split had its own prototypes) and
     "learnable" only meant the train loss (found by the r3 convergence
-    runs, scripts/convergence.py)."""
-    rng = np.random.RandomState(seed)
+    runs, scripts/convergence.py).
+
+    Difficulty knobs (VERDICT r3 weak #3 — the default task saturates
+    at 0.0 val error mid-run, and saturated curves cannot discriminate
+    1-vs-8, EASGD staleness, or τ/α choices):
+
+    - ``proto_scale`` / ``noise``: class overlap.  In the full input
+      dimension the prototypes are far apart, so overlap alone barely
+      moves the Bayes floor; it mostly slows early learning.
+    - ``label_noise``: fraction of labels reassigned to a uniformly
+      random OTHER class.  Applied to a VAL split it puts a hard floor
+      of ≈``label_noise`` on achievable val error; applied to TRAIN it
+      adds the gradient noise that makes optimizer/rule differences
+      visible.  This is the knob that guarantees curves sit strictly
+      between chance and zero.
+    """
+    # samples from a seed-derived stream, prototypes from proto_seed:
+    # identical seeds would make the first draws of sample noise reuse
+    # the exact sequence that generated the prototypes (ADVICE r3)
+    rng = np.random.RandomState(seed + 1_000_003)
     protos = (
         np.random.RandomState(seed if proto_seed is None else proto_seed)
         .randn(n_classes, *shape)
-        .astype(np.float32) * 0.5
+        .astype(np.float32) * proto_scale
     )
     y = rng.randint(0, n_classes, size=n).astype(np.int32)
-    x = protos[y] + rng.randn(n, *shape).astype(np.float32) * 0.3
+    x = protos[y] + rng.randn(n, *shape).astype(np.float32) * noise
+    if label_noise > 0.0:
+        flip = rng.rand(n) < label_noise
+        # uniform over the OTHER classes: add 1..k-1 mod k
+        y = np.where(
+            flip,
+            (y + rng.randint(1, n_classes, size=n)) % n_classes,
+            y,
+        ).astype(np.int32)
     return x, y
 
 
@@ -157,6 +186,7 @@ class Cifar10Data:
         n_synth_train: int = 8192,
         n_synth_val: int = 1024,
         seed: int = 0,
+        synth_hardness: Optional[dict] = None,
     ):
         data_dir = data_dir or os.environ.get("CIFAR10_DIR", "")
         loaded = self._try_load_real(data_dir) if data_dir else None
@@ -164,13 +194,18 @@ class Cifar10Data:
             xtr, ytr, xva, yva = loaded
             self.synthetic = False
         else:
+            # difficulty knobs (proto_scale/noise/label_noise) — see
+            # _synthetic_classification; applied to BOTH splits so the
+            # val floor is real, not an artifact of a clean val set
+            hard = dict(synth_hardness or {})
             xtr, ytr = _synthetic_classification(
-                n_synth_train, self.shape, self.n_classes, seed
+                n_synth_train, self.shape, self.n_classes, seed, **hard
             )
             xva, yva = _synthetic_classification(
                 n_synth_val, self.shape, self.n_classes, seed + 1,
                 proto_seed=seed,  # same classes as train — val is
                 # meaningful, not chance-by-construction
+                **hard,
             )
             self.synthetic = True
         # mean subtraction, as the reference does with the stored img_mean

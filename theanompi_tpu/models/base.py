@@ -114,15 +114,23 @@ class TpuModel:
         self.mesh = (
             mesh if mesh is not None else type(self).build_mesh(config=cfg.asdict())
         )
-        if cfg.get("dcn_shape") and DCN_AXIS not in self.mesh.shape:
+        if cfg.get("dcn_shape"):
             # loud, not silent: either this model's build_mesh doesn't
-            # support dcn_shape or an explicit flat mesh was passed
-            # alongside it — training would quietly use a different
-            # collective layout than the config requested
-            raise ValueError(
-                f"config dcn_shape={cfg.get('dcn_shape')} but the mesh "
-                f"{dict(self.mesh.shape)} has no '{DCN_AXIS}' axis"
-            )
+            # support dcn_shape or an explicit mesh was passed with a
+            # missing OR differently-sized dcn axis — training would
+            # quietly use a different collective layout than the config
+            # requested (ADVICE r3: the axis-exists check alone let a
+            # size mismatch through)
+            if DCN_AXIS not in self.mesh.shape:
+                raise ValueError(
+                    f"config dcn_shape={cfg.get('dcn_shape')} but the mesh "
+                    f"{dict(self.mesh.shape)} has no '{DCN_AXIS}' axis"
+                )
+            if int(self.mesh.shape[DCN_AXIS]) != int(cfg.get("dcn_shape")):
+                raise ValueError(
+                    f"config dcn_shape={cfg.get('dcn_shape')} but the mesh "
+                    f"has {DCN_AXIS}={int(self.mesh.shape[DCN_AXIS])}"
+                )
         self._engage_dcn_axis()
         self.n_workers = 1
         for ax in self.batch_axes:
@@ -432,19 +440,29 @@ class TpuModel:
             else:  # avg: local step, then parameter averaging (DP-only;
                 # TP models are rejected above, so no per-leaf specs here)
                 params, opt_state = opt.update(params, maybe_clip(grads), opt_state)
-                params = exchanger.average_params(params)
+                params = exchanger.average_params(params, rng=ex_key)
                 # moments drift per-replica under avg: sync every
-                # param-shaped entry (SGD velocity, Adam mu/nu, ...)
+                # param-shaped entry (SGD velocity, Adam mu/nu, ...) —
+                # through the SAME wire as the params, or a plain fp32
+                # pmean here would move more bytes than the compressed
+                # param exchange saves
                 sync_keys = optim_lib.param_shaped_entries(
                     opt_state, jax.tree.structure(self.params)
                 )
                 opt_state = {
                     k: (
-                        jax.tree.map(lambda v: lax.pmean(v, axis), v)
+                        exchanger.average_params(
+                            v,
+                            rng=(
+                                jax.random.fold_in(ex_key, 1_000 + i)
+                                if ex_key is not None
+                                else None
+                            ),
+                        )
                         if k in sync_keys
                         else v
                     )
-                    for k, v in opt_state.items()
+                    for i, (k, v) in enumerate(opt_state.items())
                 }
             # BN running stats: sync so the replicated out-spec holds
             new_state = jax.tree.map(lambda s: lax.pmean(s, axis), new_state)
@@ -542,7 +560,7 @@ class TpuModel:
         return self.val_fn(self.params, self.net_state, x, y)
 
     def run_validation(
-        self, count: int, recorder, params=None, net_state=None
+        self, count: int, recorder, params=None, net_state=None, extra=None
     ) -> Tuple[float, float, float]:
         """Full-set validation.
 
@@ -550,7 +568,8 @@ class TpuModel:
         validating FOREIGN weights (the EASGD server validates the center
         params mid-training this way — reference ``easgd_server.py``
         duties, SURVEY.md §4.3 — without touching the live training
-        state, whose buffers the jitted step donates)."""
+        state, whose buffers the jitted step donates).  ``extra`` rides
+        the recorder's val row (provenance stamps)."""
         if not self.data.n_batch_val:
             return float("nan"), float("nan"), float("nan")
         if self.val_fn is None:
@@ -566,7 +585,7 @@ class TpuModel:
             tot = tot + jnp.array([loss, err, err5])
             n += 1
         loss, err, err5 = (float(v) / n for v in tot)
-        recorder.val_error(count, loss, err, err5)
+        recorder.val_error(count, loss, err, err5, extra=extra)
         recorder.print_val_info(count)
         return loss, err, err5
 

