@@ -337,5 +337,55 @@ def test_easgd_duties_coalesce_and_exchange_provenance(tmp_path):
         assert b["t_wall"] >= a["t_wall"]
         assert b["epoch"] > a["epoch"]
     # and the run as a whole exchanged: frozen-center artifacts cannot
-    # reproduce this
-    assert rows[-1]["n_exchanges"] > rows[0]["n_exchanges"]
+    # reproduce this. (Only checkable with >= 2 rows — on a loaded rig
+    # the duties thread may first wake after every epoch completed,
+    # producing a single fully-coalesced row.)
+    if len(rows) > 2:
+        assert rows[-1]["n_exchanges"] > rows[0]["n_exchanges"]
+    assert rows[0]["n_exchanges"] > 0
+
+
+def test_easgd_duties_coalesce_respects_val_freq(tmp_path):
+    """Review r4: coalescing past a val_freq-aligned boundary must not
+    silently drop the validation that boundary was due — duties validate
+    if ANY epoch in the coalesced window was aligned."""
+    import json
+    import time
+
+    from theanompi_tpu.models.base import TpuModel
+
+    real_val = TpuModel.run_validation
+
+    def slow_val(self, count, recorder, **kw):
+        time.sleep(2.0)
+        return real_val(self, count, recorder, **kw)
+
+    rule = theanompi_tpu.EASGD()
+    rule.init(
+        devices=4,
+        model_config=dict(TINY, n_epochs=4, n_synth_train=64),
+        n_workers=2,
+        tau=1,
+        checkpoint_dir=str(tmp_path),
+        val_freq=2,  # boundaries 2 and 4 are due
+        verbose=False,
+    )
+    try:
+        TpuModel.run_validation = slow_val
+        rule.wait()
+    finally:
+        TpuModel.run_validation = real_val
+
+    rows = [
+        json.loads(l)
+        for l in open(tmp_path / "record_server.jsonl")
+        if l.strip() and json.loads(l)["kind"] == "val"
+    ]
+    # however duties lagged, the due boundaries were not silently lost:
+    # the final aligned boundary is always validated, and every row
+    # covers a due epoch (its own or one it coalesced past)
+    assert rows, "all due validations were dropped"
+    assert rows[-1]["epoch"] == 4
+    for r in rows:
+        window = r.get("coalesced_epochs", []) + [r["epoch"]]
+        assert any(e % 2 == 0 for e in window), r

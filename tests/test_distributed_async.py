@@ -45,6 +45,9 @@ def test_easgd_across_processes(tmp_path):
             "--checkpoint-dir", str(tmp_path),
             "--tau", "2",
             "--async-port-base", str(port),
+            # strict per-epoch duties: this test pins one row/checkpoint
+            # per epoch; coalescing (the default) is timing-dependent
+            "--duties-coalesce", "0",
         ],
         local_device_count=1,
         env_extra=_cache_env(tmp_path),

@@ -82,6 +82,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-workers", type=int, default=None)
     p.add_argument("--tau", type=int, default=10, help="EASGD exchange period")
     p.add_argument("--alpha", type=float, default=0.5, help="EASGD elastic coef")
+    p.add_argument(
+        "--duties-coalesce", type=int, choices=(0, 1), default=1,
+        help="EASGD server: 1 = validate the newest completed epoch when "
+        "duties lag (fresh-center rows); 0 = strictly one row per epoch",
+    )
     p.add_argument("--p-push", type=float, default=0.25, help="GOSGD push prob")
     # multi-process launch (the mpirun analog; SURVEY.md §3.1)
     p.add_argument(
@@ -146,6 +151,7 @@ def _async_distributed_main(args) -> int:
             da.run_easgd_server(
                 size, addresses[0], alpha=args.alpha, resume=args.resume,
                 keep_last=args.keep_last,
+                duties_coalesce=bool(args.duties_coalesce),
                 **common,
             )
         else:

@@ -580,7 +580,14 @@ class EASGD_Driver(_AsyncDriverBase):
                     self.checkpoint_dir, self.keep_last,
                     prefix="ckpt_center_",
                 )
-        if self.val_freq and (epoch + 1) % self.val_freq == 0:
+        # due if the target boundary OR any coalesced-past boundary was
+        # val_freq-aligned — coalescing must never silently drop a due
+        # validation just because the newest epoch isn't aligned
+        due = self.val_freq and any(
+            (e + 1) % self.val_freq == 0
+            for e in list(skipped) + [epoch]
+        )
+        if due:
             w0 = self.workers[0]
             loss, err, _ = m.run_validation(
                 (epoch + 1) * m.data.n_batch_train,
@@ -600,7 +607,12 @@ class EASGD_Driver(_AsyncDriverBase):
                     "epoch": epoch + 1,
                     "n_exchanges": n_exchanges,
                     "t_wall": round(_time.time(), 3),
-                    **({"coalesced_epochs": list(skipped)} if skipped else {}),
+                    # 1-based, matching the row's "epoch" field
+                    **(
+                        {"coalesced_epochs": [e + 1 for e in skipped]}
+                        if skipped
+                        else {}
+                    ),
                 },
             )
             if self.verbose:

@@ -142,6 +142,9 @@ def run_easgd_server(
     timeout: float = 3600.0,
     keep_last: Optional[int] = None,  # prune center snapshots to newest N
     wire_dtype=None,  # e.g. np.float16: compressed exchange replies
+    duties_coalesce: bool = True,  # jump to the newest completed epoch
+    # when validation is slower than a worker epoch (same semantics and
+    # rationale as EASGD_Driver.duties_coalesce, async_workers.py)
 ):
     """Rank 0: the reference ``EASGD_Server.run()`` loop, TCP-served.
 
@@ -222,12 +225,13 @@ def run_easgd_server(
     channel = TcpServerChannel(address[1], handler)
     deadline = time.monotonic() + timeout
     try:
-        for epoch in range(start_epoch, model.n_epochs):
+        epoch = start_epoch
+        while epoch < model.n_epochs:
             with cv:
+                need = lambda e: (state["epoch_counts"].get(e, 0)
+                                  >= n_workers - state["failed"])
                 ok = cv.wait_for(
-                    lambda: state["epoch_counts"].get(epoch, 0)
-                    >= n_workers - state["failed"]
-                    or state["done"] >= n_workers,
+                    lambda: need(epoch) or state["done"] >= n_workers,
                     timeout=max(1.0, deadline - time.monotonic()),
                 )
                 if not ok:
@@ -237,28 +241,57 @@ def run_easgd_server(
                     )
                 if state["epoch_counts"].get(epoch, 0) == 0:
                     break  # all workers gone before this boundary
+                # coalesce lagging duties to the NEWEST completed epoch
+                # so every validated row reflects a fresh center — the
+                # threaded driver's frozen-curve fix (VERDICT r3 #1),
+                # applied to this sibling implementation too
+                newest = epoch
+                while (duties_coalesce and newest + 1 < model.n_epochs
+                       and need(newest + 1)):
+                    newest += 1
                 center = jax.tree.map(np.copy, state["center"])
+                # snapshot with the center: the provenance must say how
+                # many exchanges produced exactly these params
+                n_ex = state["n_exchanges"]
                 net_state = state["net_state"]
+            skipped = list(range(epoch, newest))
             if checkpoint_dir:
                 from theanompi_tpu.utils import checkpoint as ckpt
 
                 ckpt.save(
-                    os.path.join(checkpoint_dir, f"ckpt_center_{epoch + 1:04d}.npz"),
-                    {"params": center, "epoch": epoch + 1, "alpha": alpha},
+                    os.path.join(checkpoint_dir, f"ckpt_center_{newest + 1:04d}.npz"),
+                    {"params": center, "epoch": newest + 1, "alpha": alpha},
                 )
                 if keep_last:
                     ckpt.prune(checkpoint_dir, keep_last,
                                prefix="ckpt_center_")
-            if val_freq and (epoch + 1) % val_freq == 0:
+            # due if the target OR any coalesced-past boundary was
+            # aligned — coalescing must not silently drop a due val
+            due = val_freq and any(
+                (e + 1) % val_freq == 0 for e in skipped + [newest]
+            )
+            if due:
                 loss, err, _ = model.run_validation(
-                    (epoch + 1) * model.data.n_batch_train,
+                    (newest + 1) * model.data.n_batch_train,
                     rec,
                     params=replicate(model.mesh, center),
                     net_state=net_state,  # workers' trained BN stats
+                    extra={
+                        "epoch": newest + 1,
+                        "n_exchanges": n_ex,
+                        "t_wall": round(time.time(), 3),
+                        **(
+                            {"coalesced_epochs": [e + 1 for e in skipped]}
+                            if skipped
+                            else {}
+                        ),
+                    },
                 )
                 if verbose:
-                    print(f"[EASGD center] epoch {epoch}: val cost "
-                          f"{loss:.4f} err {err:.4f}", flush=True)
+                    print(f"[EASGD center] epoch {newest}: val cost "
+                          f"{loss:.4f} err {err:.4f} (n_exchanges {n_ex})",
+                          flush=True)
+            epoch = newest + 1
         with cv:
             cv.wait_for(
                 lambda: state["done"] >= n_workers,
