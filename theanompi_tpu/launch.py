@@ -280,7 +280,8 @@ def main(argv=None) -> int:
             if args.n_workers:
                 kw["n_workers"] = args.n_workers
             if args.rule == "EASGD":
-                kw.update(tau=args.tau, alpha=args.alpha)
+                kw.update(tau=args.tau, alpha=args.alpha,
+                          duties_coalesce=bool(args.duties_coalesce))
             else:
                 kw.update(p_push=args.p_push)
         return kw

@@ -120,16 +120,14 @@ class TcpMailbox:
     ``async_workers.GOSGD_Worker._maybe_push``) — but if the receiver
     dies AFTER the send lands in its kernel buffer and BEFORE its
     receive thread reads it, the frame is lost with no error anywhere.
-    For GOSGD that window silently shrinks total consensus mass by the
-    in-flight weight.  This matches the reference's failure model (an
-    MPI_Send completing locally gives the same non-guarantee), and the
-    paper's gossip scheme tolerates it: consensus mass is conserved in
-    expectation among the survivors, and a crashed run restarts from
-    checkpoints anyway.  If exactly-once mass transfer is ever needed,
-    the fix is an app-level ack on mass-carrying frames (push/final)
-    with restore-on-timeout — not implemented because a worker crash
-    loses that worker's own mass regardless, so the ack only narrows,
-    never closes, the window.
+    For GOSGD that window would silently shrink total consensus mass by
+    the in-flight weight.  This matches the reference's failure model
+    (an MPI_Send completing locally gives the same non-guarantee).
+    GOSGD closes it ABOVE this layer: mass-carrying frames (push/final)
+    ride an app-level ack protocol with reclaim-on-timeout for pushes
+    and resend for finals (``distributed_async._GossipAdapter``,
+    VERDICT r3 #6).  The transport itself stays at-most-once — that is
+    the honest contract for every other frame kind.
     """
 
     def __init__(self, rank: int, addresses: Sequence[Tuple[str, int]]):
