@@ -46,39 +46,78 @@ def _rand_qkv(key, b=2, t=64, h=4, d=16, dtype=jnp.float32):
     return mk(kq), mk(kk), mk(kv)
 
 
+# Two precision tiers per kernel (r4 first-chip finding: the TPU MXU's
+# default f32 matmul is bf16 multiply passes, ~3e-3 abs error on
+# unit-scale data — in BOTH the kernel and the XLA oracle, but with
+# different groupings, so they disagree at that scale):
+#   highest — kernel at lax.Precision.HIGHEST, oracle under
+#             default_matmul_precision('highest'): exact-f32 on both
+#             sides proves the kernel MATH to 2e-5.
+#   default — both sides at the backend default: proves the TRAINING
+#             configuration stays inside the mixed-precision envelope.
+_PREC_FWD = [("highest", 2e-5), ("default", 5e-3)]
+# backward compares gradients of a sum-of-squares (element magnitudes
+# up to ~1e-1), so a pure atol is brittle exactly at the tolerance —
+# the chip run measured 2 of 12288 elements at 2.2e-4 abs / 6.8e-5 rel
+# under 'highest'. atol catches the near-zero elements, rtol the rest.
+_PREC_BWD = [("highest", 2e-4, 1e-4), ("default", 2e-2, 1e-2)]
+
+
+def _resolve_prec(name):
+    return jax.lax.Precision.HIGHEST if name == "highest" else None
+
+
+@pytest.mark.parametrize("prec,atol", _PREC_FWD)
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("t", [64, 96])  # 96: non-power-of-two blocks
-def test_flash_forward_compiled(causal, t):
+def test_flash_forward_compiled(causal, t, prec, atol):
     from theanompi_tpu.ops.pallas_flash import flash_attention
     from theanompi_tpu.parallel.ring_attention import full_attention
 
     q, k, v = _rand_qkv(jax.random.PRNGKey(0), t=t)
-    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal))(q, k, v)
-    ref = full_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    p = _resolve_prec(prec)
+    out = jax.jit(
+        lambda a, b, c: flash_attention(a, b, c, causal, None, p)
+    )(q, k, v)
+    with jax.default_matmul_precision(prec):
+        ref = jax.jit(
+            lambda a, b, c: full_attention(a, b, c, causal=causal)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=atol)
 
 
+@pytest.mark.parametrize("prec,atol,rtol", _PREC_BWD)
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_backward_compiled(causal):
+def test_flash_backward_compiled(causal, prec, atol, rtol):
     """The FA-2 dq + dkv kernels under jit — the kernels the ring-SP
     backward reuses blockwise (flash_backward_rows)."""
     from theanompi_tpu.ops.pallas_flash import flash_attention
     from theanompi_tpu.parallel.ring_attention import full_attention
 
     q, k, v = _rand_qkv(jax.random.PRNGKey(1), t=96)
+    p = _resolve_prec(prec)
 
     g1 = jax.jit(
         jax.grad(
-            lambda a, b, c: jnp.sum(jnp.square(flash_attention(a, b, c, causal))),
+            lambda a, b, c: jnp.sum(
+                jnp.square(flash_attention(a, b, c, causal, None, p))
+            ),
             argnums=(0, 1, 2),
         )
     )(q, k, v)
-    g2 = jax.grad(
-        lambda a, b, c: jnp.sum(jnp.square(full_attention(a, b, c, causal=causal))),
-        argnums=(0, 1, 2),
-    )(q, k, v)
+    with jax.default_matmul_precision(prec):
+        g2 = jax.jit(
+            jax.grad(
+                lambda a, b, c: jnp.sum(
+                    jnp.square(full_attention(a, b, c, causal=causal))
+                ),
+                argnums=(0, 1, 2),
+            )
+        )(q, k, v)
     for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=atol, rtol=rtol
+        )
 
 
 def test_flash_bf16_compiled():
@@ -150,6 +189,13 @@ def test_quant_sr_kernel_compiled_bounds_and_determinism():
 def test_quant_fp16s_kernel_compiled_matches_xla():
     from theanompi_tpu.parallel import quantize as Q
 
+    if not Q.mosaic_supports_f16():
+        # r4 first-chip finding: this toolchain's Mosaic rejects f16
+        # outright; pallas_quantize_blocks_fp16 delegates to the fused
+        # XLA path (exercised by the default suite), so there is no
+        # Mosaic f16 kernel to validate here — skip LOUDLY rather than
+        # green-stamp a delegated path as Mosaic-compiled.
+        pytest.skip("Mosaic lacks f16 on this backend (delegated to XLA)")
     x = np.random.RandomState(3).randn(64, Q.BLOCK).astype(np.float32)
     q_x, s_x = Q.quantize_blocks_fp16(x)
     q_p, s_p = jax.jit(Q.pallas_quantize_blocks_fp16)(x)

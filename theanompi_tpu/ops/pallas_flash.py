@@ -77,8 +77,15 @@ def _pick_block(t: int, pref: int) -> int:
     return t  # fall back to one block (still correct, more VMEM)
 
 
-def _dot(a, b, dims):
+def _dot(a, b, dims, precision=None):
+    """f32-accumulating block matmul.  ``precision`` matters on real
+    MXUs: the TPU default multiplies f32 operands in bf16 passes
+    (~3e-3 abs error on unit-scale data — measured on the first r4
+    chip run), which is the right trade for training throughput;
+    ``lax.Precision.HIGHEST`` buys exact-f32 multiplies at ~3× the
+    MXU passes for callers that need oracle-grade numerics."""
     return lax.dot_general(a, b, (dims, ((), ())),
+                           precision=precision,
                            preferred_element_type=jnp.float32)
 
 
@@ -86,7 +93,8 @@ def _dot(a, b, dims):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, t):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, t,
+                precision=None):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     d = q.shape[-1]
@@ -102,7 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, t
         m, den, acc = carry
         k_blk = k_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
         v_blk = v_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
-        s = _dot(q, k_blk, ((1,), (1,))) * scale  # (bq, bk)
+        s = _dot(q, k_blk, ((1,), (1,)), precision) * scale  # (bq, bk)
         if causal:
             k_pos = kc * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
@@ -112,7 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, t
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         corr = jnp.exp(m - m_new)
         den = den * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[:, None] + _dot(p, v_blk, ((1,), (0,)))
+        acc = acc * corr[:, None] + _dot(p, v_blk, ((1,), (0,)), precision)
         return m_new, den, acc
 
     if causal:
@@ -124,10 +132,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, t
         nk_eff = nk
     m, den, acc = lax.fori_loop(0, nk_eff, body, (m0, den0, acc0))
     o_ref[0] = (acc / den[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(den)
+    # stats ride a trailing singleton dim: Mosaic requires the last two
+    # block dims to be (8,128)-divisible or full, which a rank-2 (1, bq)
+    # block violates (found on the first real-chip run, r4) — (bq, 1)
+    # satisfies it as (8-divisible, equal-to-array)
+    lse_ref[0] = (m + jnp.log(den))[:, None]
 
 
-def _flash_forward(q, k, v, causal, scale):
+def _flash_forward(q, k, v, causal, scale, precision=None):
     b, t, h, d = q.shape
     bq = _pick_block(t, BLOCK_Q)
     bk = _pick_block(t, BLOCK_K)
@@ -136,13 +148,14 @@ def _flash_forward(q, k, v, causal, scale):
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t,
+        precision=precision,
     )
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
         ),
         grid=(b * h, t // bq),
         in_specs=[
@@ -152,11 +165,11 @@ def _flash_forward(q, k, v, causal, scale):
         ],
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
         ),
         interpret=not _on_tpu(),
     )(qr, kr, vr)
-    return out, lse  # both in (B*H, ...) layout
+    return out, lse[..., 0]  # both in (B*H, ...) layout
 
 
 # ---------------------------------------------------------------------------
@@ -164,12 +177,12 @@ def _flash_forward(q, k, v, causal, scale):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
-               *, scale, causal, bq, bk, t):
+               *, scale, causal, bq, bk, t, precision=None):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # (bq,)
-    dlt = dlt_ref[0]  # (bq,)
+    lse = lse_ref[0][:, 0]  # (bq,) — stats carry a trailing unit dim
+    dlt = dlt_ref[0][:, 0]  # (see _fwd_kernel: Mosaic block-shape rule)
     d = q.shape[-1]
     nk = t // bk
     q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -177,16 +190,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
     def body(kc, dq):
         k_blk = k_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
         v_blk = v_ref[0, pl.dslice(kc * bk, bk)].astype(jnp.float32)
-        s = _dot(q, k_blk, ((1,), (1,))) * scale
+        s = _dot(q, k_blk, ((1,), (1,)), precision) * scale
         if causal:
             k_pos = kc * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])  # normalized probabilities
         if causal:
             p = jnp.where(q_pos >= k_pos, p, 0.0)
-        dp = _dot(do, v_blk, ((1,), (1,)))  # (bq, bk)
+        dp = _dot(do, v_blk, ((1,), (1,)), precision)  # (bq, bk)
         ds = p * (dp - dlt[:, None]) * scale
-        return dq + _dot(ds, k_blk, ((1,), (0,)))
+        return dq + _dot(ds, k_blk, ((1,), (0,)), precision)
 
     nk_eff = jnp.minimum(nk, ((qi + 1) * bq + bk - 1) // bk) if causal else nk
     dq = lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
@@ -194,7 +207,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref,
-                *, scale, causal, bq, bk, t):
+                *, scale, causal, bq, bk, t, precision=None):
     kc = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)  # (bk, d)
     v_blk = v_ref[0].astype(jnp.float32)
@@ -206,19 +219,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.dslice(qi * bq, bq)].astype(jnp.float32)
         do_blk = do_ref[0, pl.dslice(qi * bq, bq)].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(qi * bq, bq)]
-        dlt = dlt_ref[0, pl.dslice(qi * bq, bq)]
-        s = _dot(q_blk, k_blk, ((1,), (1,))) * scale  # (bq, bk)
+        lse = lse_ref[0, pl.dslice(qi * bq, bq), 0]
+        dlt = dlt_ref[0, pl.dslice(qi * bq, bq), 0]
+        s = _dot(q_blk, k_blk, ((1,), (1,)), precision) * scale  # (bq, bk)
         if causal:
             q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         if causal:
             p = jnp.where(q_pos >= k_pos, p, 0.0)
-        dv = dv + _dot(p, do_blk, ((0,), (0,)))  # (bk, d)
-        dp = _dot(do_blk, v_blk, ((1,), (1,)))  # (bq, bk)
+        dv = dv + _dot(p, do_blk, ((0,), (0,)), precision)  # (bk, d)
+        dp = _dot(do_blk, v_blk, ((1,), (1,)), precision)  # (bq, bk)
         ds = p * (dp - dlt[:, None]) * scale
-        dk = dk + _dot(ds, q_blk, ((0,), (0,)))  # (bk, d)
+        dk = dk + _dot(ds, q_blk, ((0,), (0,)), precision)  # (bk, d)
         return dk, dv
 
     # causal: q-blocks strictly above the diagonal see only masked rows
@@ -229,17 +242,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(causal, scale, res, ct):
+def _flash_backward(causal, scale, precision, res, ct):
     qr, kr, vr, out, lse = res  # all (B*H, T, D) / (B*H, T)
     do = ct  # (B*H, T, D) fp32-or-input-dtype cotangent
     # Δ_i = Σ_d dout·out — XLA elementwise, prefetched per tile
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # (B*H, T)
-    return flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale)
+    return flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale,
+                               precision=precision)
 
 
-def flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale):
+def flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale,
+                        precision=None):
     """FA-2 backward kernels on row-layout operands with a precomputed
     Δ — the entry the ring backward drives per block, so that the
     loop-invariant pieces (Q/dO transposes, lse reshape, Δ) are
@@ -261,12 +276,17 @@ def flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale):
     bq = _pick_block(t, BLOCK_Q)
     bk = _pick_block(t, BLOCK_K)
 
+    # stats enter the kernels with a trailing unit dim (Mosaic block-
+    # shape rule — see _fwd_kernel); same bytes, legal (… , bq, 1) tiles
+    lse3 = lse[..., None]
+    dlt3 = delta[..., None]
+
     row = lambda bhi, i: (bhi, 0, 0)  # noqa: E731 — whole-row spec
-    rowv = lambda bhi, i: (bhi, 0)  # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
+            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t,
+            precision=precision,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qr.dtype),
         grid=(bh, t // bq),
@@ -275,16 +295,17 @@ def flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale):
             pl.BlockSpec((1, t, d), row),
             pl.BlockSpec((1, t, d), row),
             pl.BlockSpec((1, bq, d), lambda bhi, qi: (bhi, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bhi, qi: (bhi, qi)),
-            pl.BlockSpec((1, bq), lambda bhi, qi: (bhi, qi)),
+            pl.BlockSpec((1, bq, 1), lambda bhi, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bhi, qi: (bhi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bhi, qi: (bhi, qi, 0)),
         interpret=not _on_tpu(),
-    )(qr, kr, vr, do, lse, delta)
+    )(qr, kr, vr, do, lse3, dlt3)
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t
+            _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, t=t,
+            precision=precision,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), kr.dtype),
@@ -296,15 +317,15 @@ def flash_backward_rows(qr, kr, vr, do, lse, delta, causal, scale):
             pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
             pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
             pl.BlockSpec((1, t, d), row),
-            pl.BlockSpec((1, t), rowv),
-            pl.BlockSpec((1, t), rowv),
+            pl.BlockSpec((1, t, 1), row),
+            pl.BlockSpec((1, t, 1), row),
         ],
         out_specs=(
             pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
             pl.BlockSpec((1, bk, d), lambda bhi, kc: (bhi, kc, 0)),
         ),
         interpret=not _on_tpu(),
-    )(qr, kr, vr, do, lse, delta)
+    )(qr, kr, vr, do, lse3, dlt3)
     return dq, dk, dv
 
 
@@ -322,14 +343,14 @@ def from_rows(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def flash_forward_with_lse(q, k, v, causal=False, scale=None):
+def flash_forward_with_lse(q, k, v, causal=False, scale=None, precision=None):
     """Forward-only kernel entry returning ``(out, lse)`` with
     lse shaped (B, H, T). NO AD rule — callers (the ring-flash path)
     wrap it in their own custom_vjp; differentiating this directly
     raises at trace time (pallas_call has no autodiff registration).
     """
     s = resolve_scale(scale, q.shape[-1])
-    out, lse = _flash_forward(q, k, v, causal, s)
+    out, lse = _flash_forward(q, k, v, causal, s, precision)
     b, h = q.shape[0], q.shape[2]
     return from_rows(out, b, h), lse.reshape(b, h, -1)
 
@@ -339,31 +360,42 @@ def resolve_scale(scale, d: int) -> float:
     return float(scale) if scale is not None else d ** -0.5
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
+    precision=None,
 ):
     """softmax(QKᵀ·scale)V, fused fwd+bwd. Shapes (B, T, H, D) like
-    ``full_attention``; same numerics (fp32 statistics) by test."""
-    out, _ = _flash_forward(q, k, v, causal, resolve_scale(scale, q.shape[-1]))
+    ``full_attention``; same numerics (fp32 statistics) by test.
+
+    ``precision``: forwarded to every block matmul (see ``_dot``).
+    None = backend default (bf16 multiply passes on TPU — the training
+    configuration); ``lax.Precision.HIGHEST`` = exact-f32 multiplies
+    (oracle-grade, ~3× MXU passes; what the chip-vs-oracle tests use).
+    """
+    out, _ = _flash_forward(
+        q, k, v, causal, resolve_scale(scale, q.shape[-1]), precision
+    )
     return from_rows(out, q.shape[0], q.shape[2])
 
 
-def _vjp_fwd(q, k, v, causal, scale):
+def _vjp_fwd(q, k, v, causal, scale, precision):
     s = resolve_scale(scale, q.shape[-1])
-    out, lse = _flash_forward(q, k, v, causal, s)
+    out, lse = _flash_forward(q, k, v, causal, s, precision)
     b, h = q.shape[0], q.shape[2]
     res = (to_rows(q), to_rows(k), to_rows(v), out, lse, b, h, s)
     return from_rows(out, b, h), res
 
 
-def _vjp_bwd(causal, scale, res, ct):
+def _vjp_bwd(causal, scale, precision, res, ct):
     qr, kr, vr, out, lse, b, h, s = res  # s: the scale the fwd ran with
-    dq, dk, dv = _flash_backward(causal, s, (qr, kr, vr, out, lse), to_rows(ct))
+    dq, dk, dv = _flash_backward(
+        causal, s, precision, (qr, kr, vr, out, lse), to_rows(ct)
+    )
     return from_rows(dq, b, h), from_rows(dk, b, h), from_rows(dv, b, h)
 
 

@@ -123,8 +123,12 @@ def _hash_uniform(counter: jnp.ndarray) -> jnp.ndarray:
     x = x ^ (x >> 15)
     x = x * jnp.uint32(0x846CA68B)
     x = x ^ (x >> 16)
-    # top 24 bits → exactly representable fp32 in [0, 1)
-    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    # top 24 bits → exactly representable fp32 in [0, 1). Mosaic has no
+    # uint32→f32 convert (first-chip-run finding, r4); after the >>8 the
+    # top byte is zero, so the value is int32-exact — bitcast to i32
+    # (identical bits, now non-negative) and convert from there.
+    x24 = jax.lax.bitcast_convert_type(x >> 8, jnp.int32)
+    return x24.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
 def _quant_sr_kernel(x_ref, seed_ref, q_ref, s_ref):
@@ -151,6 +155,53 @@ def _quant_sr_kernel(x_ref, seed_ref, q_ref, s_ref):
     q = jnp.floor(y + u)
     q_ref[...] = jnp.clip(q, -127, 127).astype(jnp.int8)
     s_ref[...] = s.astype(jnp.float32)
+
+
+_MOSAIC_F16 = None  # None = unprobed; probe result cached per process
+
+
+def mosaic_supports_f16() -> bool:
+    """Whether this backend's Mosaic dialect can lower float16.
+
+    The first real-chip run (r4) found the v5e toolchain rejects f16
+    outright ("Unsupported type in mosaic dialect: 'f16'") even though
+    XLA itself converts/stores f16 fine on TPU.  Probed by compiling a
+    trivial f16-output kernel once and caching the verdict; interpret
+    mode (CPU) supports every dtype, so the probe only runs on real
+    accelerators."""
+    global _MOSAIC_F16
+    if _MOSAIC_F16 is None:
+        if jax.default_backend() == "cpu":
+            _MOSAIC_F16 = True
+        else:
+            def k(x_ref, o_ref):
+                o_ref[...] = x_ref[...].astype(jnp.float16)
+
+            try:
+                jax.jit(
+                    lambda x: pl.pallas_call(
+                        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float16)
+                    )(x)
+                ).lower(
+                    jax.ShapeDtypeStruct((8, 128), jnp.float32)
+                ).compile()
+                _MOSAIC_F16 = True
+            except Exception as e:
+                # only the known capability error may cache False — a
+                # transient fault (wedged tunnel, OOM) caching False
+                # would silently reroute the wire for the whole process
+                if "mosaic" not in str(e).lower():
+                    raise
+                import warnings
+
+                warnings.warn(
+                    "Mosaic on this backend cannot lower float16; the "
+                    "pallas_fp16s wire falls back to the (equally "
+                    "fold-proof) fused XLA cast+scale path.",
+                    stacklevel=2,
+                )
+                _MOSAIC_F16 = False
+    return _MOSAIC_F16
 
 
 def _quant_fp16_kernel(x_ref, q_ref, s_ref):
@@ -219,11 +270,17 @@ def pallas_quantize_blocks_fp16(x: jnp.ndarray, key=None):
     """Same contract as :func:`quantize_blocks_fp16` (``key`` ignored —
     see there), input rows padded to a multiple of 32 by the exchanger.
     fp16's TPU tile is (16, 128); 32 rows is a legal multiple for both
-    the fp32 input and the fp16 output."""
+    the fp32 input and the fp16 output.  On backends whose Mosaic lacks
+    f16 (see :func:`mosaic_supports_f16`) this delegates to the XLA
+    fused path — same wire bytes, same numerics."""
+    if not mosaic_supports_f16():
+        return quantize_blocks_fp16(x)
     return _run_quant_kernel(x, _quant_fp16_kernel, jnp.float16)
 
 
 def pallas_dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    if q.dtype == jnp.float16 and not mosaic_supports_f16():
+        return dequantize_blocks(q, scale)
     lead = q.shape[:-1]
     rows = 1
     for d in lead:
