@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Aggregate a committed jax.profiler Perfetto trace into a per-op time
+table — the offline replacement for TensorBoard on this rig.
+
+Usage: python scripts/analyze_trace.py [trace_dir_or_json_gz] [top_n]
+
+Works on the ``*.trace.json.gz`` half of a profiler dump (plain JSON);
+sums complete ('X') events on the device pid's "XLA Ops" thread, so
+module-level and async-overlay rows don't double-count.
+
+NOTE: do NOT capture new traces through the axon tunnel —
+``jax.profiler.trace`` hung it in r4 (docs/perf/NOTES.md). Analyze the
+committed ``docs/perf/trace_r2`` instead.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                            recursive=True))
+    if not hits:
+        sys.exit(f"no *.trace.json.gz under {path}")
+    return hits[-1]
+
+
+def main():
+    path = find_trace(sys.argv[1] if len(sys.argv) > 1 else "docs/perf/trace_r2")
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    d = json.load(gzip.open(path, "rt"))
+    ev = d["traceEvents"]
+
+    device_pids = {
+        e["pid"]
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "TPU" in (e["args"].get("name") or "")
+    }
+    ops_tids = {
+        (e["pid"], e["tid"])
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and (not device_pids or e["pid"] in device_pids)  # CPU traces
+        and e["args"].get("name") == "XLA Ops"
+    }
+    module_tids = {
+        (e["pid"], e["tid"])
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and (not device_pids or e["pid"] in device_pids)
+        and e["args"].get("name") == "XLA Modules"
+    }
+    agg, cnt = collections.Counter(), collections.Counter()
+    total, n_modules = 0.0, 0
+    for e in ev:
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        if key in ops_tids:
+            ms = e.get("dur", 0) / 1e3
+            agg[e["name"]] += ms
+            cnt[e["name"]] += 1
+            total += ms
+        elif key in module_tids:
+            n_modules += 1
+    # steps = module executions, NOT max per-op count: loop bodies
+    # (grad_accum scans etc.) fire the same op name many times per step
+    steps = n_modules or (max(cnt.values()) if cnt else 1)
+    print(f"{path}: {total:.1f} ms busy over ~{steps} steps "
+          f"= {total / steps:.3f} ms/step")
+    run = 0.0
+    for name, ms in agg.most_common(top_n):
+        run += ms
+        print(f"{ms / steps:7.3f} ms/step {100 * ms / total:5.1f}% "
+              f"cum{100 * run / total:5.1f}%  {name[:90]}")
+
+
+if __name__ == "__main__":
+    main()

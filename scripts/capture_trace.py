@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of the flagship AlexNet BSP step.
+
+Usage: python scripts/capture_trace.py [outdir] [config_overrides_json]
+
+The Perfetto half of the dump (``*.trace.json.gz``) is plain JSON —
+``scripts/analyze_trace.py`` aggregates it into a per-op time table so
+the hot spots are readable without TensorBoard.
+
+DEFAULTS TO THE FAKE-CPU MESH: ``jax.profiler.trace`` against the axon
+TPU tunnel hung and wedged it in r4 (docs/perf/NOTES.md). Set
+``THEANOMPI_ALLOW_AXON_TRACE=1`` only if that backend bug is known
+fixed; otherwise op-level TPU analysis comes from the committed
+``docs/perf/trace_r2``.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("THEANOMPI_ALLOW_AXON_TRACE") != "1":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+if os.environ.get("THEANOMPI_ALLOW_AXON_TRACE") != "1":
+    # config API, not env: axon's sitecustomize pre-imports jax, so
+    # JAX_PLATFORMS alone is ignored (verify SKILL.md gotcha)
+    jax.config.update("jax_platforms", "cpu")
+
+from theanompi_tpu.models.alex_net import AlexNet
+from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
+
+
+def main():
+    on_cpu = os.environ.get("THEANOMPI_ALLOW_AXON_TRACE") != "1"
+    # CPU smokes must not land in docs/perf/ next to real-chip traces
+    outdir = sys.argv[1] if len(sys.argv) > 1 else (
+        "/tmp/trace_cpu_smoke" if on_cpu else "docs/perf/trace_r4")
+    overrides = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
+    mesh = make_mesh()
+    model = AlexNet(
+        config=dict(
+            # full-size AlexNet steps take ~30s EACH on the 1-core CPU
+            # fallback — shrink there so the smoke path finishes
+            batch_size=64 if on_cpu else 512,
+            compute_dtype="bfloat16",
+            lr=1e-3,
+            n_synth_batches=2 if on_cpu else 8,
+            print_freq=10_000,
+            **overrides,
+        ),
+        mesh=mesh,
+    )
+    n_warm, n_trace = (2, 3) if on_cpu else (10, 20)
+    train_fn = model.compile_train()
+    batches = [shard_batch(mesh, b) for b in model.data.train_batches()]
+    p, s, o = model.params, model.net_state, model.opt_state
+    keys = list(jax.random.split(jax.random.PRNGKey(0), 64))
+
+    def step(p, s, o, i):
+        x, y = batches[i % len(batches)]
+        return train_fn(p, s, o, x, y, keys[i % len(keys)])
+
+    for i in range(n_warm):  # compile + steady-state warmup outside the trace
+        p, s, o, loss, err = step(p, s, o, i)
+    jax.block_until_ready(loss)
+
+    os.makedirs(outdir, exist_ok=True)
+    with jax.profiler.trace(outdir):
+        t0 = time.perf_counter()
+        for i in range(n_trace):
+            p, s, o, loss, err = step(p, s, o, i)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    print(f"traced {n_trace} steps in {dt:.3f}s -> {dt / n_trace * 1e3:.2f} "
+          f"ms/step ({n_trace * model.global_batch / dt:.0f} img/s) -> {outdir}")
+
+
+if __name__ == "__main__":
+    main()
