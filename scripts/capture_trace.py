@@ -45,19 +45,17 @@ def main():
         "/tmp/trace_cpu_smoke" if on_cpu else "docs/perf/trace_r4")
     overrides = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
     mesh = make_mesh()
-    model = AlexNet(
-        config=dict(
-            # full-size AlexNet steps take ~30s EACH on the 1-core CPU
-            # fallback — shrink there so the smoke path finishes
-            batch_size=64 if on_cpu else 512,
-            compute_dtype="bfloat16",
-            lr=1e-3,
-            n_synth_batches=2 if on_cpu else 8,
-            print_freq=10_000,
-            **overrides,
-        ),
-        mesh=mesh,
+    cfg = dict(
+        # full-size AlexNet steps take ~30s EACH on the 1-core CPU
+        # fallback — shrink there so the smoke path finishes
+        batch_size=64 if on_cpu else 512,
+        compute_dtype="bfloat16",
+        lr=1e-3,
+        n_synth_batches=2 if on_cpu else 8,
+        print_freq=10_000,
     )
+    cfg.update(overrides)  # update, not **: overrides may replace defaults
+    model = AlexNet(config=cfg, mesh=mesh)
     n_warm, n_trace = (2, 3) if on_cpu else (10, 20)
     train_fn = model.compile_train()
     batches = [shard_batch(mesh, b) for b in model.data.train_batches()]

@@ -378,3 +378,89 @@ def test_convtranspose_bf16_backward():
 
     g = jax.grad(loss)(p)
     assert np.isfinite(np.asarray(jax.tree.leaves(g)[0], np.float32)).all()
+
+
+# ---------------------------------------------------------------------------
+# space-to-depth conv (r4 perf path: MXU-friendly strided stems)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "kernel,stride,padding,hw",
+    [
+        (11, 4, "SAME", (32, 32)),   # the AlexNet-128 stem (pad 3/4)
+        (7, 2, "SAME", (16, 16)),    # ResNet-style stem
+        (4, 4, "VALID", (16, 16)),   # patchify (ViT-style), zero pad
+        (5, (2, 4), "SAME", (12, 16)),  # anisotropic stride
+        (3, 2, ((2, 2), (1, 1)), (8, 8)),  # explicit padding
+    ],
+)
+def test_conv_s2d_matches_plain_conv(kernel, stride, padding, hw):
+    """s2d computes the SAME dot products as the strided conv (fwd and
+    both grads) — only the accumulation order differs, so fp32 agreement
+    is to float-roundoff."""
+    plain = L.Conv2d(8, kernel, stride=stride, padding=padding)
+    s2d = L.Conv2d(8, kernel, stride=stride, padding=padding, s2d=True)
+    p, st, out_shape = plain.init(KEY, (*hw, 3))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, *hw, 3))
+
+    y_plain, _ = plain.apply(p, st, x)
+    y_s2d, _ = s2d.apply(p, st, x)
+    assert y_s2d.shape == y_plain.shape == (2, *out_shape)
+    np.testing.assert_allclose(y_s2d, y_plain, rtol=2e-5, atol=2e-5)
+
+    def loss(layer, p, x):
+        y, _ = layer.apply(p, st, x)
+        return jnp.sum(jnp.sin(y))  # nonuniform cotangent
+
+    gp, gx = jax.grad(lambda p, x: loss(plain, p, x), argnums=(0, 1))(p, x)
+    sp, sx = jax.grad(lambda p, x: loss(s2d, p, x), argnums=(0, 1))(p, x)
+    np.testing.assert_allclose(sp["w"], gp["w"], rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(sp["b"], gp["b"], rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(sx, gx, rtol=2e-4, atol=2e-5)
+
+
+def test_conv_s2d_bf16_flow_matches_plain_bf16():
+    plain = L.Conv2d(8, 11, stride=4, compute_dtype=jnp.bfloat16)
+    s2d = L.Conv2d(8, 11, stride=4, compute_dtype=jnp.bfloat16, s2d=True)
+    p, st, _ = plain.init(KEY, (32, 32, 3))
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    y_plain, _ = plain.apply(p, st, x)
+    y_s2d, _ = s2d.apply(p, st, x)
+    assert y_s2d.dtype == y_plain.dtype
+    np.testing.assert_allclose(
+        np.asarray(y_s2d, np.float32), np.asarray(y_plain, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_conv_s2d_rejects_indivisible_input_and_unit_stride():
+    with pytest.raises(ValueError, match="strided"):
+        L.Conv2d(8, 3, stride=1, s2d=True)
+    layer = L.Conv2d(8, 11, stride=4, s2d=True)
+    with pytest.raises(ValueError, match="divisible"):
+        layer.init(KEY, (30, 30, 3))  # at init, not at jit trace time
+
+
+def test_lrn_pallas_rejects_narrow_stats_and_remat():
+    with pytest.raises(ValueError, match="pallas"):
+        L.LRN(impl="pallas", stats_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="pallas"):
+        L.LRN(impl="pallas", remat=True)
+
+
+def test_lrn_bf16_stats_close_to_f32():
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, 4, 16)) * 2.0
+    ref = L.LRN(size=5, k=2.0)
+    narrow = L.LRN(size=5, k=2.0, stats_dtype=jnp.bfloat16)
+    y_ref, _ = ref.apply({}, {}, x)
+    y_n, _ = narrow.apply({}, {}, x)
+    assert y_n.dtype == x.dtype  # flowing dtype unchanged
+    # denominator carries bf16 relative error (~0.4%), amplified by ~beta
+    np.testing.assert_allclose(y_n, y_ref, rtol=2e-2, atol=2e-2)
+    # and the narrow path must also hold under bf16 activations
+    xb = x.astype(jnp.bfloat16)
+    yb, _ = narrow.apply({}, {}, xb)
+    assert yb.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(yb, np.float32), y_ref, rtol=5e-2, atol=5e-2
+    )

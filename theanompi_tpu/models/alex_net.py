@@ -34,8 +34,12 @@ class AlexNet(TpuModel):
         n_synth_batches=64,
         lrn_impl="auto",  # see ops.layers.LRN: auto|xla|shift|window|pallas
         lrn_remat=False,  # recompute LRN internals in bwd (saves HBM)
+        lrn_stats=None,  # 'bf16' narrows the LRN window-sum/residual
+        # dtype (halves the saved-denominator HBM round-trip; see LRN)
         pool_grad="native",  # 'mask' = fused maxpool bwd (no
         # select-and-scatter; see ops.layers.MaxPool)
+        stem="conv",  # 's2d' folds conv1's stride into channels
+        # (space-to-depth: 3ch stride-4 11x11 -> 48ch stride-1 3x3)
     )
 
     def build_data(self):
@@ -57,11 +61,22 @@ class AlexNet(TpuModel):
         cfg = self.config
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
         drop = float(cfg.dropout_rate)
-        lrn = dict(impl=str(cfg.lrn_impl), remat=bool(cfg.lrn_remat))
+        if cfg.lrn_stats not in (None, "f32", "float32", "bf16", "bfloat16"):
+            raise ValueError(f"lrn_stats must be None|f32|bf16, got {cfg.lrn_stats!r}")
+        if cfg.stem not in ("conv", "s2d"):
+            raise ValueError(f"stem must be conv|s2d, got {cfg.stem!r}")
+        lrn = dict(
+            impl=str(cfg.lrn_impl),
+            remat=bool(cfg.lrn_remat),
+            stats_dtype=(
+                jnp.bfloat16 if cfg.lrn_stats in ("bf16", "bfloat16") else None
+            ),
+        )
         pg = str(cfg.pool_grad)
         net = L.Sequential(
             [
-                L.Conv2d(96, 11, stride=4, padding="SAME", compute_dtype=dt),
+                L.Conv2d(96, 11, stride=4, padding="SAME", compute_dtype=dt,
+                         s2d=(cfg.stem == "s2d")),
                 L.Relu(),
                 L.LRN(**lrn),
                 L.MaxPool(3, stride=2, grad_impl=pg),

@@ -83,12 +83,87 @@ class Layer:
         return f"{type(self).__name__}({fields})"
 
 
+def _explicit_padding(padding, kernel, stride, hw):
+    """Resolve a padding spec to explicit ((lo,hi),(lo,hi)) pairs.
+    SAME uses XLA's convention: lo = total//2 (hi gets the odd pixel)."""
+    if isinstance(padding, str):
+        if padding.upper() == "SAME":
+            pads = []
+            for d in range(2):
+                out = -(-hw[d] // stride[d])
+                total = max(0, (out - 1) * stride[d] + kernel[d] - hw[d])
+                pads.append((total // 2, total - total // 2))
+            return tuple(pads)
+        return ((0, 0), (0, 0))  # VALID
+    return tuple((int(p[0]), int(p[1])) for p in padding)
+
+
+def _conv_s2d(x, w, stride, padding):
+    """Strided conv via space-to-depth: fold the (bh, bw) stride into
+    channels so the MXU sees a stride-1 conv with a bh·bw·Cin contraction.
+
+    Why: a stem like AlexNet's 11×11/stride-4 over 3 channels runs the
+    MXU at ~27% efficiency (contraction dim 3, pad-heavy strided im2col —
+    measured in docs/perf/trace_r2). Folding gives contraction dim 48 and
+    no stride. The canonical HWIO kernel stays the parameter (checkpoint-
+    and init-compatible); it is zero-front-padded so every tap lands at a
+    fixed (block, phase) pair, then reshaped to blocks — tap u maps to
+    block (u+f)//b, phase (u+f)%b with f ≡ -pad_lo (mod b), so the padded
+    taps are zeros and the result is the SAME dot products re-ordered.
+    """
+    bh, bw = stride
+    n, h, wid, cin = x.shape
+    kh, kw, _, cout = w.shape
+    if h % bh or wid % bw:
+        raise ValueError(
+            f"s2d conv needs input {h}x{wid} divisible by stride {stride}"
+        )
+    pads = _explicit_padding(padding, (kh, kw), stride, (h, wid))
+    f = ((-pads[0][0]) % bh, (-pads[1][0]) % bw)  # kernel front zeros
+    kbh, kbw = -(-(kh + f[0]) // bh), -(-(kw + f[1]) // bw)  # kernel blocks
+    wp = jnp.pad(
+        w,
+        (
+            (f[0], kbh * bh - kh - f[0]),
+            (f[1], kbw * bw - kw - f[1]),
+            (0, 0),
+            (0, 0),
+        ),
+    )
+    # (kbh, bh, kbw, bw, cin, cout) -> blocks spatial, phases into channels;
+    # channel order (phase_h, phase_w, cin) must match the input fold below
+    wp = wp.reshape(kbh, bh, kbw, bw, cin, cout)
+    wp = wp.transpose(0, 2, 1, 3, 4, 5).reshape(kbh, kbw, bh * bw * cin, cout)
+    xs = x.reshape(n, h // bh, bh, wid // bw, bw, cin)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // bh, wid // bw, bh * bw * cin)
+    blo = ((pads[0][0] + f[0]) // bh, (pads[1][0] + f[1]) // bw)
+    # hi-side block pad chosen so the stride-1 block conv yields exactly
+    # the plain conv's output count (may be negative = trim, which XLA
+    # supports); over-covered padding pixels multiply the kernel's zero
+    # back-padding, under-coverage cannot happen (padding is zeros on
+    # both sides of the equivalence)
+    oh = (h + pads[0][0] + pads[0][1] - kh) // bh + 1
+    ow = (wid + pads[1][0] + pads[1][1] - kw) // bw + 1
+    bhi = (oh + kbh - 1 - blo[0] - h // bh, ow + kbw - 1 - blo[1] - wid // bw)
+    return lax.conv_general_dilated(
+        xs,
+        wp,
+        window_strides=(1, 1),
+        padding=((blo[0], bhi[0]), (blo[1], bhi[1])),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
 class Conv2d(Layer):
     """2-D convolution, NHWC / HWIO, fp32 MXU accumulation.
 
     Reference analog: ``Conv`` in layers2.py (cuDNN NCHW). NHWC is the
     TPU-preferred layout; ``compute_dtype=bfloat16`` casts inputs/weights
     for the MXU while keeping master params fp32.
+
+    ``s2d=True`` computes the strided conv through space-to-depth
+    (``_conv_s2d``) — same parameters, same math, MXU-friendly layout for
+    few-channel strided stems. Requires stride > 1 dividing the input.
     """
 
     def __init__(
@@ -101,6 +176,7 @@ class Conv2d(Layer):
         w_init: Optional[Callable] = None,
         compute_dtype: Optional[jnp.dtype] = None,
         output_dtype: Optional[jnp.dtype] = None,
+        s2d: bool = False,
     ):
         self.filters = filters
         self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
@@ -110,10 +186,20 @@ class Conv2d(Layer):
         self.w_init = w_init or he_normal
         self.compute_dtype = compute_dtype
         self.output_dtype = output_dtype
+        if s2d and (self.stride[0] < 2 and self.stride[1] < 2):
+            raise ValueError("s2d=True only makes sense for strided convs")
+        self.s2d = s2d
 
     def init(self, key, in_shape):
         h, w, cin = in_shape
         kh, kw = self.kernel
+        if self.s2d and (h % self.stride[0] or w % self.stride[1]):
+            # refuse at init where the architecture mistake is visible,
+            # not at jit trace time (same convention as MaxPool.init)
+            raise ValueError(
+                f"s2d conv needs input {h}x{w} divisible by stride "
+                f"{self.stride}"
+            )
         fan_in = kh * kw * cin
         wkey, _ = jax.random.split(key)
         params = {"w": self.w_init(wkey, (kh, kw, cin, self.filters), fan_in)}
@@ -126,13 +212,16 @@ class Conv2d(Layer):
         x, w, narrow_to = _conv_operand_dtypes(
             x, params["w"], self.compute_dtype
         )
-        y = lax.conv_general_dilated(
-            x,
-            w,
-            window_strides=self.stride,
-            padding=self.padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        if self.s2d:
+            y = _conv_s2d(x, w, self.stride, self.padding)
+        else:
+            y = lax.conv_general_dilated(
+                x,
+                w,
+                window_strides=self.stride,
+                padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if narrow_to is not None:
             y = y.astype(narrow_to)
         if self.output_dtype is not None:
@@ -395,10 +484,16 @@ class LRN(Layer):
     """
 
     def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0, impl="auto",
-                 remat=False):
+                 remat=False, stats_dtype=None):
         if impl not in ("auto", "xla", "pallas", "window", "shift"):
             raise ValueError(
                 f"impl must be auto|xla|pallas|window|shift, got {impl!r}"
+            )
+        if impl == "pallas" and (remat or stats_dtype):
+            # the Pallas kernel path returns before _normalize, so these
+            # knobs would be silently discarded — refuse loudly instead
+            raise ValueError(
+                "impl='pallas' supports neither remat nor stats_dtype"
             )
         self.size = size
         self.alpha = alpha
@@ -409,6 +504,13 @@ class LRN(Layer):
         # saving the fp32 denominator activation — trades a second cheap
         # window sum for a [N,H,W,C] fp32 HBM round-trip
         self.remat = remat
+        # stats_dtype (e.g. bf16): narrow the window sum AFTER its fp32
+        # accumulation, so the power/divide chain AND the autodiff
+        # residuals that cross the fwd/bwd boundary are narrow — the r2
+        # trace shows the saved f32 [N,H,W,C] denominator is a top-10 HBM
+        # cost of the AlexNet step. Denominator relative error is ~bf16
+        # eps (0.4%), amplified by ~beta; fp32 (None) stays the default.
+        self.stats_dtype = jnp.dtype(stats_dtype) if stats_dtype else None
 
     def apply(self, params, state, x, train=False, rng=None):
         if self.impl == "pallas":
@@ -453,6 +555,13 @@ class LRN(Layer):
                 "bhwc,cd->bhwd", jnp.square(x), band,
                 preferred_element_type=jnp.float32,
             )
+        if self.stats_dtype is not None:
+            win = win.astype(self.stats_dtype)
+            denom = jnp.power(
+                jnp.asarray(self.k, win.dtype) + jnp.asarray(self.alpha, win.dtype) * win,
+                jnp.asarray(self.beta, win.dtype),
+            )
+            return (x.astype(denom.dtype) / denom).astype(x.dtype)
         denom = jnp.power(self.k + self.alpha * win, self.beta)
         return (x.astype(jnp.float32) / denom).astype(x.dtype)
 

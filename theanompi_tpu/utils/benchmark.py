@@ -32,14 +32,22 @@ PERF_SWEEP_CONFIGS = (
     ("window", {"lrn_impl": "window"}),
     ("maskpool", {"pool_grad": "mask"}),
     ("shift+maskpool", {"lrn_impl": "shift", "pool_grad": "mask"}),
+    ("s2d", {"stem": "s2d"}),
+    ("lrnbf16", {"lrn_stats": "bf16"}),
+    ("s2d+lrnbf16", {"stem": "s2d", "lrn_stats": "bf16"}),
 )
 
 # bench.py's candidate subset: the r1-measured default plus the
-# trace-driven contenders worth a compile each at bench time
+# trace-driven contenders worth a compile each at bench time.
+# r4 sweep retired maskpool / shift+maskpool (measured 2.2x SLOWER than
+# the default on v5e — docs/perf/NOTES.md); the new contenders attack
+# the two biggest r2-trace line items: the conv1 stem (space-to-depth)
+# and the LRN saved-stats HBM round-trip (bf16 window sums).
 BENCH_CANDIDATES = (
     ("r1-default", {}),
-    ("maskpool", {"pool_grad": "mask"}),
-    ("shift+maskpool", {"lrn_impl": "shift", "pool_grad": "mask"}),
+    ("s2d", {"stem": "s2d"}),
+    ("lrnbf16", {"lrn_stats": "bf16"}),
+    ("s2d+lrnbf16", {"stem": "s2d", "lrn_stats": "bf16"}),
 )
 
 
