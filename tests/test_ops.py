@@ -464,3 +464,10 @@ def test_lrn_bf16_stats_close_to_f32():
     np.testing.assert_allclose(
         np.asarray(yb, np.float32), y_ref, rtol=5e-2, atol=5e-2
     )
+
+
+def test_conv_s2d_rejects_unmodeled_padding_strings():
+    layer = L.Conv2d(4, 3, stride=2, padding="SAME_LOWER", s2d=True)
+    p, st, _ = layer.init(KEY, (8, 8, 3))
+    with pytest.raises(ValueError, match="padding"):
+        layer.apply(p, st, jnp.zeros((1, 8, 8, 3)))

@@ -198,3 +198,17 @@ def test_recorder_without_tensorboard_unchanged(tmp_path):
     rec.save()
     rec.close()  # no-op without a writer
     assert (tmp_path / "record_rank0.jsonl").exists()
+
+
+def test_cpu_cache_dir_keys_on_cpu_features():
+    """r4: rigs here all share hostname 'vm', so the cache key must carry
+    the CPU-feature fingerprint or AOT executables cross machine types
+    and abort mid-suite (the r3 'Fatal Python error')."""
+    import re
+
+    from theanompi_tpu.cachedir import _cpu_fingerprint, cpu_cache_dir
+
+    assert cpu_cache_dir() == cpu_cache_dir()  # stable within a host
+    fp = _cpu_fingerprint()
+    assert re.fullmatch(r"[0-9a-f]{10}", fp)
+    assert fp in cpu_cache_dir()

@@ -8,19 +8,42 @@ heavy): conftest calls this before it pins the platform.
 Why not the repo's ``.jax_cache``: XLA:CPU persists AOT-compiled
 executables keyed by the *compiling* machine's features; loading one on
 a host without those features logs ``cpu_aot_loader`` errors and can
-SIGILL mid-run (the most plausible cause of round 3's one
-nondeterministic 'Fatal Python error').  The repo cache stays reserved
-for the real-TPU path, whose Mosaic binaries are host-independent.
+SIGILL/SIGABRT mid-run.  The repo cache stays reserved for the real-TPU
+path, whose Mosaic binaries are host-independent.
 
-Keyed by host AND user: a shared rig's tempdir is world-writable but a
-cache dir created by user A is not writable by user B — a host-only key
-would reintroduce per-user nondeterministic breakage.
+Keyed by CPU-FEATURE FINGERPRINT, host, and user — r4 diagnosed round
+3's nondeterministic mid-suite ``Fatal Python error: Aborted`` (a
+faulthandler dump finally caught it inside a compiled module in
+``run_validation``): every rig in this environment is hostname ``vm``,
+so a hostname key let rounds running on different physical machine
+types share one cache, and stale AOT executables from a
+different-microarchitecture host loaded with "machine type ... doesn't
+match" warnings and aborted under load.  Hashing the cpuinfo flags set
+separates those machines; host+user stay in the key for shared-tempdir
+hygiene (a cache dir created by user A is not writable by user B).
 """
 
 import getpass
+import hashlib
 import os
 import platform
 import tempfile
+
+
+def _cpu_fingerprint() -> str:
+    """Hash of the host's CPU feature flags (codegen-relevant identity).
+    Order-insensitive; falls back to the machine arch string."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    basis = flags or platform.machine() or "unknown"
+    return hashlib.sha256(basis.encode()).hexdigest()[:10]
 
 
 def cpu_cache_dir() -> str:
@@ -30,5 +53,6 @@ def cpu_cache_dir() -> str:
         user = str(os.getuid()) if hasattr(os, "getuid") else "user"
     return os.path.join(
         tempfile.gettempdir(),
-        f"theanompi_jax_cache_{platform.node() or 'host'}_{user}",
+        f"theanompi_jax_cache_{_cpu_fingerprint()}_"
+        f"{platform.node() or 'host'}_{user}",
     )

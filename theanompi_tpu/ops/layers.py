@@ -94,7 +94,12 @@ def _explicit_padding(padding, kernel, stride, hw):
                 total = max(0, (out - 1) * stride[d] + kernel[d] - hw[d])
                 pads.append((total // 2, total - total // 2))
             return tuple(pads)
-        return ((0, 0), (0, 0))  # VALID
+        if padding.upper() == "VALID":
+            return ((0, 0), (0, 0))
+        # loud, not VALID-by-default: lax accepts strings this helper
+        # doesn't model (SAME_LOWER), and silently computing VALID for
+        # them would make the s2d path diverge from the plain conv
+        raise ValueError(f"unsupported padding spec {padding!r}")
     return tuple((int(p[0]), int(p[1])) for p in padding)
 
 
@@ -142,8 +147,7 @@ def _conv_s2d(x, w, stride, padding):
     # supports); over-covered padding pixels multiply the kernel's zero
     # back-padding, under-coverage cannot happen (padding is zeros on
     # both sides of the equivalence)
-    oh = (h + pads[0][0] + pads[0][1] - kh) // bh + 1
-    ow = (wid + pads[1][0] + pads[1][1] - kw) // bw + 1
+    oh, ow = _conv_out_hw((h, wid), (kh, kw), (bh, bw), pads)
     bhi = (oh + kbh - 1 - blo[0] - h // bh, ow + kbw - 1 - blo[1] - wid // bw)
     return lax.conv_general_dilated(
         xs,
