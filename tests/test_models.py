@@ -235,6 +235,30 @@ def test_alexnet_bad_stem_and_lrn_stats_raise():
                             n_synth_batches=2, lrn_stats="fp8"), mesh=make_mesh())
 
 
+def test_resnet_and_googlenet_s2d_stems_train():
+    """stem='s2d' on the 7x7/2 stems: same params, close numerics,
+    finite training (the AlexNet variant has the full equivalence
+    tests; these prove the wiring)."""
+    from theanompi_tpu.models.googlenet import GoogLeNet
+    from theanompi_tpu.models.resnet50 import ResNet50
+
+    for cls, extra in ((ResNet50, {}), (GoogLeNet, {"aux_heads": False})):
+        model = cls(
+            config=dict(
+                batch_size=4, image_size=64, n_classes=8,
+                n_synth_batches=2, n_synth_val_batches=1, stem="s2d",
+                **extra,
+            ),
+            mesh=make_mesh(),
+        )
+        losses, _ = _smoke(model, n_steps=2)
+        assert np.isfinite(losses).all(), cls.__name__
+        with pytest.raises(ValueError, match="stem"):
+            cls(config=dict(batch_size=4, image_size=64, n_classes=8,
+                            n_synth_batches=2, stem="nope", **extra),
+                mesh=make_mesh())
+
+
 def test_lsgan_rejects_unsupported_base_features():
     from theanompi_tpu.models.lsgan import LSGAN
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from theanompi_tpu.data.providers import ImageNetData
-from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.models.base import TpuModel, stem_is_s2d
 from theanompi_tpu.ops import layers as L
 from theanompi_tpu.ops import optim
 
@@ -63,8 +63,6 @@ class AlexNet(TpuModel):
         drop = float(cfg.dropout_rate)
         if cfg.lrn_stats not in (None, "f32", "float32", "bf16", "bfloat16"):
             raise ValueError(f"lrn_stats must be None|f32|bf16, got {cfg.lrn_stats!r}")
-        if cfg.stem not in ("conv", "s2d"):
-            raise ValueError(f"stem must be conv|s2d, got {cfg.stem!r}")
         lrn = dict(
             impl=str(cfg.lrn_impl),
             remat=bool(cfg.lrn_remat),
@@ -73,10 +71,11 @@ class AlexNet(TpuModel):
             ),
         )
         pg = str(cfg.pool_grad)
+        s2d_stem = stem_is_s2d(cfg)
         net = L.Sequential(
             [
                 L.Conv2d(96, 11, stride=4, padding="SAME", compute_dtype=dt,
-                         s2d=(cfg.stem == "s2d")),
+                         s2d=s2d_stem),
                 L.Relu(),
                 L.LRN(**lrn),
                 L.MaxPool(3, stride=2, grad_impl=pg),

@@ -85,6 +85,17 @@ COMMON_DEFAULTS = dict(
 )
 
 
+def stem_is_s2d(cfg) -> bool:
+    """Validate the shared ``stem`` config knob ('conv' | 's2d') and
+    return whether the model should build its strided stem through
+    space-to-depth (ops.layers.Conv2d(s2d=True)). One definition for
+    every model that exposes the knob."""
+    stem = cfg.get("stem", "conv") if hasattr(cfg, "get") else cfg.stem
+    if stem not in ("conv", "s2d"):
+        raise ValueError(f"stem must be conv|s2d, got {stem!r}")
+    return stem == "s2d"
+
+
 class TpuModel:
     default_config: dict = {}
     # Sharding surface of the step function. Plain data-parallel models

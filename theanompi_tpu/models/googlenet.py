@@ -15,16 +15,17 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from theanompi_tpu.data.providers import ImageNetData
-from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.models.base import TpuModel, stem_is_s2d
 from theanompi_tpu.ops import layers as L
 from theanompi_tpu.ops import losses
 from theanompi_tpu.ops import optim
 
 
-def _conv(filters, kernel, dt, stride=1):
+def _conv(filters, kernel, dt, stride=1, s2d=False):
     return L.Sequential(
         [
-            L.Conv2d(filters, kernel, stride=stride, padding="SAME", compute_dtype=dt),
+            L.Conv2d(filters, kernel, stride=stride, padding="SAME",
+                     compute_dtype=dt, s2d=s2d),
             L.Relu(),
         ]
     )
@@ -74,6 +75,7 @@ class GoogLeNet(TpuModel):
         exch_strategy="bf16",  # BASELINE.json config #3 exchanger path
         aux_heads=True,  # reference-parity train-only aux classifiers
         aux_weight=0.3,  # classic 0.3 weighting of each aux loss
+        stem="conv",  # 's2d': space-to-depth 7x7/2 stem (ops.layers.Conv2d)
     )
 
     def build_data(self):
@@ -91,9 +93,10 @@ class GoogLeNet(TpuModel):
         cfg = self.config
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
         nc = int(cfg.n_classes)
+        s2d_stem = stem_is_s2d(cfg)
         stem_to_4a = L.Sequential(
             [
-                _conv(64, 7, dt, stride=2),
+                _conv(64, 7, dt, stride=2, s2d=s2d_stem),
                 L.MaxPool(3, stride=2, padding="SAME"),
                 L.LRN(),
                 _conv(64, 1, dt),

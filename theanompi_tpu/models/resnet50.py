@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from theanompi_tpu.data.providers import ImageNetData
-from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.models.base import TpuModel, stem_is_s2d
 from theanompi_tpu.ops import layers as L
 from theanompi_tpu.ops import optim
 from theanompi_tpu.runtime.mesh import DATA_AXIS
@@ -57,6 +57,8 @@ class ResNet50(TpuModel):
         data_dir=None,
         n_synth_batches=32,
         sync_bn=False,
+        stem="conv",  # 's2d' folds the 7x7/2 stem's stride into
+        # channels (space-to-depth; see ops.layers.Conv2d)
     )
 
     def build_data(self):
@@ -74,6 +76,7 @@ class ResNet50(TpuModel):
         cfg = self.config
         dt = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
         bn_axis = DATA_AXIS if cfg.sync_bn else None
+        s2d_stem = stem_is_s2d(cfg)
         stages = [  # (n_blocks, cmid, cout, first_stride)
             (3, 64, 256, 1),
             (4, 128, 512, 2),
@@ -81,7 +84,8 @@ class ResNet50(TpuModel):
             (3, 512, 2048, 2),
         ]
         seq = [
-            L.Conv2d(64, 7, stride=2, padding="SAME", use_bias=False, compute_dtype=dt),
+            L.Conv2d(64, 7, stride=2, padding="SAME", use_bias=False,
+                     compute_dtype=dt, s2d=s2d_stem),
             L.BatchNorm(axis_name=bn_axis),
             L.Relu(),
             L.MaxPool(3, stride=2, padding="SAME"),

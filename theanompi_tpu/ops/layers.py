@@ -945,13 +945,11 @@ class ConvTranspose2d(Layer):
 # ---------------------------------------------------------------------------
 
 def _conv_out_hw(hw, window, stride, padding):
+    # delegate string resolution to _explicit_padding so an unmodeled
+    # spec (SAME_LOWER) is refused HERE, at init time, instead of
+    # init reporting a silently-VALID shape that apply then contradicts
     h, w = hw
-    if isinstance(padding, str):
-        if padding.upper() == "SAME":
-            return math.ceil(h / stride[0]), math.ceil(w / stride[1])
-        pads = ((0, 0), (0, 0))
-    else:
-        pads = padding
+    pads = _explicit_padding(padding, window, stride, hw)
     oh = (h + pads[0][0] + pads[0][1] - window[0]) // stride[0] + 1
     ow = (w + pads[1][0] + pads[1][1] - window[1]) // stride[1] + 1
     return oh, ow
