@@ -466,8 +466,12 @@ def test_lrn_bf16_stats_close_to_f32():
     )
 
 
-def test_conv_s2d_rejects_unmodeled_padding_strings():
-    layer = L.Conv2d(4, 3, stride=2, padding="SAME_LOWER", s2d=True)
-    p, st, _ = layer.init(KEY, (8, 8, 3))
-    with pytest.raises(ValueError, match="padding"):
-        layer.apply(p, st, jnp.zeros((1, 8, 8, 3)))
+def test_conv_rejects_unmodeled_padding_strings_at_init():
+    """_conv_out_hw resolves strings through _explicit_padding, so an
+    unmodeled spec (SAME_LOWER) is refused when the architecture is
+    built — for the plain path too, where init used to silently report
+    a VALID shape that lax's apply would then contradict."""
+    for s2d in (False, True):
+        layer = L.Conv2d(4, 3, stride=2, padding="SAME_LOWER", s2d=s2d)
+        with pytest.raises(ValueError, match="padding"):
+            layer.init(KEY, (8, 8, 3))
