@@ -377,16 +377,9 @@ def main():
     # The rehearsal caches per-host+user under tmp instead: CPU AOT
     # results compiled on another host can SIGILL here, and rehearsal
     # entries must not pollute the cache the scarce TPU window depends on
-    if CPU_REHEARSAL:
-        from theanompi_tpu.cachedir import cpu_cache_dir
+    from theanompi_tpu.cachedir import configure_compile_cache
 
-        cache_dir = cpu_cache_dir()
-    else:
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    configure_compile_cache(jax, use_repo_cache=not CPU_REHEARSAL)
 
     from theanompi_tpu.models.alex_net import AlexNet
     from theanompi_tpu.runtime.mesh import make_mesh, shard_batch

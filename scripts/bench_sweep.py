@@ -14,6 +14,13 @@ import jax.numpy as jnp
 from theanompi_tpu.models.alex_net import AlexNet
 from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
 
+# ONE cache policy for the whole repo (theanompi_tpu/cachedir.py):
+# TPU runs share the repo cache so sweep compiles warm the scarce bench
+# window; CPU runs stay in the per-host-fingerprint dir
+from theanompi_tpu.cachedir import configure_compile_cache
+
+configure_compile_cache(jax, use_repo_cache=jax.default_backend() == "tpu")
+
 
 def measure(cfg_overrides, steps=120):
     mesh = make_mesh()

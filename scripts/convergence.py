@@ -46,13 +46,10 @@ def _force_cpu_mesh():
     clear_backends()
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", N_DEVICES)
-    # per-host+user CPU cache (not the repo's): foreign-host XLA:CPU
-    # AOT entries can SIGILL — see theanompi_tpu/cachedir.py
-    from theanompi_tpu.cachedir import cpu_cache_dir
+    # the repo's one cache policy (CPU -> per-host-fingerprint dir)
+    from theanompi_tpu.cachedir import configure_compile_cache
 
-    jax.config.update("jax_compilation_cache_dir", cpu_cache_dir())
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    configure_compile_cache(jax, use_repo_cache=False)
 
 
 def _rows(record_path):

@@ -46,22 +46,17 @@ if not _TPU_MODE:
 # ResNet50/GoogLeNet/VGG16 graphs on one CPU core (~6 min cold); cached
 # re-runs of the suite drop to seconds of compile time.
 #
-# CPU runs cache PER HOST under tmp, not in the shared repo cache:
-# XLA:CPU AOT executables compiled on another machine load here with
-# "machine type ... doesn't match" errors and can SIGILL mid-suite —
-# the most plausible cause of round 3's one nondeterministic
-# 'Fatal Python error' (VERDICT r3 weak #6).  The repo cache stays
-# reserved for the real-TPU path (THEANOMPI_TPU_TESTS=1), whose Mosaic
-# binaries are host-independent.
-if _TPU_MODE:
-    _cache_dir = os.path.join(_repo_root, ".jax_cache")
-else:
-    from theanompi_tpu.cachedir import cpu_cache_dir
+# CPU runs cache per host-FINGERPRINT under tmp, not in the shared repo
+# cache: XLA:CPU AOT executables compiled on another machine type load
+# with "machine type ... doesn't match" errors and abort mid-suite —
+# CONFIRMED in r4 as round 3's nondeterministic 'Fatal Python error'
+# (faulthandler caught the SIGABRT inside a compiled module; all rigs
+# share hostname 'vm', hence the fingerprint key in cachedir.py). The
+# repo cache stays reserved for the real-TPU path
+# (THEANOMPI_TPU_TESTS=1), whose Mosaic binaries are host-independent.
+from theanompi_tpu.cachedir import configure_compile_cache  # noqa: E402
 
-    _cache_dir = cpu_cache_dir()
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+configure_compile_cache(jax, use_repo_cache=_TPU_MODE)
 
 
 def pytest_configure(config):

@@ -60,3 +60,27 @@ def test_main_renders_and_prints_events(tmp_path, capsys, monkeypatch):
     assert "[async_wire]" in captured and "dtype=float16" in captured
     # matplotlib is present in this environment: a PNG must land
     assert os.path.exists(out_png) and os.path.getsize(out_png) > 0
+
+
+def test_analyze_trace_reproduces_r2_op_budget():
+    """scripts/analyze_trace.py is the only op-level attribution path on
+    this rig (profiling through the tunnel is forbidden — NOTES.md);
+    pin its aggregation against the committed r2 chip trace."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace = os.path.join(repo, "docs", "perf", "trace_r2")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "analyze_trace.py"),
+         trace, "5"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = out.stdout.strip().splitlines()
+    # 30 traced steps at ~11.15 ms/step busy
+    assert "~30 steps" in lines[0] and "11.15" in lines[0]
+    # the top op is the LRN1 bwd banded matmul at ~9.6% of busy time
+    assert "fusion.545" in lines[1] and "9.6%" in lines[1]
+    assert len(lines) == 6  # header + top_n rows

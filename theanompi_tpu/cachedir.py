@@ -56,3 +56,25 @@ def cpu_cache_dir() -> str:
         f"theanompi_jax_cache_{_cpu_fingerprint()}_"
         f"{platform.node() or 'host'}_{user}",
     )
+
+
+def configure_compile_cache(jax_mod, use_repo_cache: bool) -> str:
+    """Apply the repo's ONE persistent-compile-cache policy and return
+    the chosen dir. ``use_repo_cache=True`` = the committed ``.jax_cache``
+    (real-TPU runs only: Mosaic executables are host-independent, and
+    warm entries are what make the scarce bench window cheap);
+    False = the per-host-fingerprint tempdir (everything CPU — see the
+    module docstring for why foreign AOT entries are dangerous).
+    Takes the caller's ``jax`` module so this file stays import-light."""
+    cache = (
+        os.path.abspath(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, ".jax_cache")
+        )
+        if use_repo_cache
+        else cpu_cache_dir()
+    )
+    jax_mod.config.update("jax_compilation_cache_dir", cache)
+    jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax_mod.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache
