@@ -341,3 +341,49 @@ def test_garbage_bytes_rejected(tmp_path):
     open(p, "wb").write(b"\x13\x37" * 100)
     with pytest.raises(Exception):
         ckpt.restore(p)
+
+
+def test_orbax_interop_roundtrip(tmp_path):
+    """export_orbax/import_orbax bridge the native npz format to the
+    TPU-ecosystem's standard checkpoint layout: same pytree in, same
+    leaves out, and the exported dir is readable by plain Orbax."""
+    tree = {
+        "params": [{"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                    "b": np.zeros(3, np.float32)}],
+        "step": np.int32(7),
+    }
+    d = str(tmp_path / "orbax_ckpt")
+    ckpt.export_orbax(d, tree)
+    back = ckpt.import_orbax(d)
+    assert set(back) == {"params", "step"}
+    np.testing.assert_array_equal(back["params"][0]["w"], tree["params"][0]["w"])
+    np.testing.assert_array_equal(back["step"], tree["step"])
+    # and a straight Orbax reader sees it too (the interop claim)
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as c:
+        raw = c.restore(d)
+    np.testing.assert_array_equal(
+        np.asarray(raw["params"][0]["b"]), tree["params"][0]["b"]
+    )
+
+
+def test_orbax_export_scoping_and_overwrite(tmp_path):
+    """Review findings r4: str leaves refused loudly WITH their path
+    (orbax would crash and wedge its executor), repeated export to one
+    dir overwrites (native save semantics), and a target pytree
+    restores namedtuple structure."""
+    import collections
+
+    d = str(tmp_path / "ck")
+    with pytest.raises(ValueError, match="tag"):
+        ckpt.export_orbax(d, {"tag": "run-7", "x": np.ones(2, np.float32)})
+
+    Opt = collections.namedtuple("Opt", ["mu", "nu"])
+    tree = {"opt": Opt(np.ones(2, np.float32), np.zeros(2, np.float32)),
+            "step": np.int32(1)}
+    ckpt.export_orbax(d, tree)
+    ckpt.export_orbax(d, tree)  # second save-to-same-path must not raise
+    back = ckpt.import_orbax(d, target=tree)
+    assert isinstance(back["opt"], Opt)  # structure reconstructed
+    np.testing.assert_array_equal(back["opt"].mu, tree["opt"].mu)

@@ -323,3 +323,64 @@ def prune(dir_path: str, keep_last: int, prefix: str = "ckpt_") -> List[str]:
         except OSError:
             pass  # already gone (concurrent prune) — not an error
     return doomed
+
+
+# ---------------------------------------------------------------------------
+# Orbax interop
+# ---------------------------------------------------------------------------
+
+def export_orbax(ckpt_dir: str, tree: Any) -> str:
+    """Write ``tree`` as an Orbax StandardCheckpointer directory.
+
+    The native format stays the npz+JSON-sidecar above (golden-file
+    pinned, single-file, pickle-free); this adapter exists for interop —
+    TPU-ecosystem tooling (serving stacks, conversion scripts, other
+    JAX training codebases) speaks Orbax. Scope: numeric/bool leaves
+    only — ``StandardCheckpointer`` cannot hold str leaves (which the
+    native format can), so those are refused HERE with their tree path
+    (a failed save inside orbax additionally wedges its executor for
+    the rest of the process — validate first, save second). Overwrites
+    an existing dir, matching native ``save``'s atomic-overwrite
+    semantics. Returns the checkpoint directory."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    snap = host_snapshot(tree)
+    bad = [
+        jax.tree_util.keystr(kp)
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(snap)[0]
+        if not isinstance(leaf, (np.ndarray, np.generic, int, float, bool))
+    ]
+    if bad:
+        raise ValueError(
+            "Orbax StandardCheckpointer cannot hold non-numeric leaves "
+            f"(native npz save() can): {bad[:5]} — strip them before "
+            "export_orbax"
+        )
+    path = os.path.abspath(ckpt_dir)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, snap, force=True)
+    return path
+
+
+def import_orbax(ckpt_dir: str, target: Any = None) -> Any:
+    """Inverse of :func:`export_orbax`: read an Orbax checkpoint dir
+    into host numpy leaves.
+
+    Pass ``target`` (a pytree of the expected structure, e.g. a
+    freshly-built model's ``(params, net_state, opt_state)``) to get
+    namedtuple/custom nodes reconstructed — without it Orbax returns
+    plain dicts/lists with 0-d arrays for scalars (native ``restore``
+    rebuilds structure from its sidecar and needs no target)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            out = ckptr.restore(
+                os.path.abspath(ckpt_dir),
+                jax.tree.map(np.asarray, host_snapshot(target)),
+            )
+        else:
+            out = ckptr.restore(os.path.abspath(ckpt_dir))
+    return jax.tree.map(np.asarray, out)
