@@ -80,3 +80,24 @@ def test_zoo_rule_import_path():
     mod = importlib.import_module("theanompi_tpu.models.keras_model_zoo")
     for name in ("MnistCnn", "MnistMlp", "Cifar10Cnn"):
         assert hasattr(mod, name)
+
+
+def test_klayers_average_pooling_layers():
+    """The two average-pooling frontends (the only klayers without a
+    prior test): shapes and the keras 'valid'/'same' padding spelling."""
+    model = K.Sequential()
+    model.add(K.Conv2D(6, 3, padding="same"))
+    model.add(K.AveragePooling2D(2))
+    model.add(K.AveragePooling2D(2, strides=1, padding="same"))
+    model.add(K.GlobalAveragePooling2D())
+    model.add(K.Dense(4))
+    params, state, out = model.init(jax.random.PRNGKey(0), (16, 16, 3))
+    assert out == (4,)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    y, _ = model.apply(params, state, x, train=False)
+    assert y.shape == (2, 4)
+    # avgpool really averages: constant input stays constant through it
+    ones = np.ones((1, 8, 8, 6), np.float32)
+    pool = K.AveragePooling2D(2)
+    py, _ = pool.apply({}, {}, ones)
+    np.testing.assert_allclose(np.asarray(py), 1.0, rtol=1e-6)

@@ -316,20 +316,3 @@ def request(address: Tuple[str, int], msg: Any, timeout: float = 600.0) -> Any:
         send_frame(s, wire.encode(msg))
         return wire.decode(recv_frame(s))
 
-
-class SharedCounter:
-    """Thread-safe counter (e.g. total iterations across async workers)."""
-
-    def __init__(self):
-        self._v = 0
-        self._lock = threading.Lock()
-
-    def add(self, k: int = 1) -> int:
-        with self._lock:
-            self._v += k
-            return self._v
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._v
