@@ -191,3 +191,21 @@ def test_rule_end_to_end_on_disk_dataset(tmp_path):
     # of the field is the contract, disk this small may round to ~0
     rec_files = [f for f in files if f.name.startswith("record_")]
     assert rec_files
+
+
+@pytest.mark.parametrize("opt_name", ["lars", "lamb"])
+def test_large_batch_optimizers_train_under_bsp(opt_name):
+    """LARS/LAMB (the large-global-batch optimizers the BASELINE
+    scaling target implies) through the full sharded BSP step: the
+    param-shaped state entries must shard like params and the loss
+    must move finitely."""
+    losses, model = _run_steps(
+        make_mesh(), per_shard_bs=8, n_steps=4,
+        optimizer=opt_name, lr=0.02,
+    )
+    assert np.isfinite(losses).all()
+    assert losses[-1] != losses[0]  # actually updating
+    model.scale_lr(4.0)  # reference-heritage linear scaling still works
+    from theanompi_tpu.ops import optim as optim_lib
+
+    assert optim_lib.get_lr(model.opt_state) == pytest.approx(0.08)

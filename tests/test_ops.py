@@ -475,3 +475,70 @@ def test_conv_rejects_unmodeled_padding_strings_at_init():
         layer = L.Conv2d(4, 3, stride=2, padding="SAME_LOWER", s2d=s2d)
         with pytest.raises(ValueError, match="padding"):
             layer.init(KEY, (8, 8, 3))
+
+
+def test_lars_matches_numpy_and_skips_1d():
+    """LARS oracle: trust ratio η||p||/||g+wd·p|| scales the lr for
+    matrices; 1-D tensors take the plain momentum path."""
+    opt = optim.lars(lr=0.1, momentum=0.9, weight_decay=0.01,
+                     trust_coefficient=0.001)
+    params = {"w": jnp.full((2, 2), 2.0), "b": jnp.full((2,), 2.0)}
+    state = opt.init(params)
+    grads = {"w": jnp.full((2, 2), 0.5), "b": jnp.full((2,), 0.5)}
+    p, state = opt.update(params, grads, state)
+    p, state = opt.update(p, grads, state)
+
+    w, b = np.full((2, 2), 2.0), np.full(2, 2.0)
+    vw, vb = np.zeros((2, 2)), np.zeros(2)
+    for _ in range(2):
+        gw = 0.5 + 0.01 * w
+        ratio = 0.001 * np.linalg.norm(w) / (np.linalg.norm(gw) + 1e-9)
+        vw = 0.9 * vw - 0.1 * ratio * gw
+        w = w + vw
+        gb = 0.5 + 0.01 * b
+        vb = 0.9 * vb - 0.1 * gb  # no ratio on 1-D
+        b = b + vb
+    np.testing.assert_allclose(np.asarray(p["w"]), w, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p["b"]), b, rtol=1e-6)
+    assert int(state["step"]) == 2
+
+
+def test_lamb_matches_numpy():
+    opt = optim.lamb(lr=0.01, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.1)
+    params = {"w": jnp.full((2, 3), 1.0)}
+    state = opt.init(params)
+    g = np.full((2, 3), 0.25)
+    p = params
+    for _ in range(3):
+        p, state = opt.update(p, {"w": jnp.full((2, 3), 0.25)}, state)
+
+    w = np.full((2, 3), 1.0)
+    m = np.zeros((2, 3))
+    v = np.zeros((2, 3))
+    for t in range(1, 4):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        r = (m / (1 - 0.9**t)) / (np.sqrt(v / (1 - 0.999**t)) + 1e-6)
+        r = r + 0.1 * w
+        scale = np.linalg.norm(w) / (np.linalg.norm(r) + 1e-9)
+        w = w - 0.01 * scale * r
+    np.testing.assert_allclose(np.asarray(p["w"]), w, rtol=1e-5)
+
+
+def test_lars_lamb_zero_norm_guard_and_from_config():
+    """Zero-init params / zero updates must not freeze or NaN the layer
+    (ratio defined as 1), and the config names resolve."""
+    from theanompi_tpu.runtime.config import Config
+
+    for name in ("lars", "lamb"):
+        opt = optim.from_config(Config(dict(
+            optimizer=name, lr=0.1, momentum=0.9, nesterov=False,
+            weight_decay=0.0,
+        )))
+        params = {"w": jnp.zeros((2, 2))}
+        state = opt.init(params)
+        p, _ = opt.update(params, {"w": jnp.ones((2, 2))}, state)
+        assert np.isfinite(np.asarray(p["w"])).all(), name
+        assert not np.array_equal(np.asarray(p["w"]), 0.0), name
+    with pytest.raises(ValueError, match="lamb"):
+        optim.from_config(Config(dict(optimizer="lion", lr=0.1)))

@@ -141,9 +141,134 @@ def adam(
     return Optimizer(init, update)
 
 
+def _trust_ratio(p_norm, u_norm, trust_coefficient, eps):
+    """LARS/LAMB layer-adaptive scale: η·||p||/||u||, defined as 1 when
+    either norm is 0 (fresh zero-init params or vanished updates must
+    not freeze/explode the layer)."""
+    ratio = trust_coefficient * p_norm / (u_norm + eps)
+    return jnp.where((p_norm > 0.0) & (u_norm > 0.0), ratio, 1.0)
+
+
+def lars(
+    lr: float,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+    trust_coefficient: float = 0.001,
+    eps: float = 1e-9,
+) -> Optimizer:
+    """LARS (You et al. 2017, arXiv:1708.03888) — layer-wise adaptive
+    rate scaling for LARGE-batch data parallelism.  Beyond-reference but
+    squarely in its theme: the BASELINE scaling-efficiency metric at 32
+    chips implies global batches (16k+) where plain momentum SGD stops
+    converging; LARS is the standard fix for exactly the AlexNet/ResNet
+    ImageNet configs this framework benchmarks.
+
+    Per-TENSOR trust ratio η·||p||/||g + wd·p|| scales the lr before the
+    momentum update (decay folded into the gradient BEFORE the norm — a
+    standard variant; the paper's additive form ||g||+wd·||p|| differs
+    whenever g and p aren't parallel).  1-D tensors (biases, BN scales)
+    take the plain momentum path, per the paper's practice.  Same design
+    rules as :func:`sgd`: lr in state, param-shaped `velocity` entry.
+    """
+
+    def init(params: Params) -> OptState:
+        return {
+            "velocity": jax.tree.map(jnp.zeros_like, params),
+            "lr": jnp.asarray(lr, jnp.float32),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(params: Params, grads: Grads, state: OptState):
+        lr_t = state["lr"]
+
+        def upd(p, g, v):
+            g = g.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p
+            if p.ndim >= 2:
+                local_lr = lr_t * _trust_ratio(
+                    jnp.linalg.norm(p), jnp.linalg.norm(g),
+                    trust_coefficient, eps,
+                )
+            else:
+                local_lr = lr_t
+            v_new = momentum * v - local_lr * g
+            return p + v_new, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_v = treedef.flatten_up_to(state["velocity"])
+        out = [upd(p, g, v) for p, g, v in zip(flat_p, flat_g, flat_v)]
+        return treedef.unflatten([o[0] for o in out]), {
+            "velocity": treedef.unflatten([o[1] for o in out]),
+            "lr": lr_t,
+            "step": state["step"] + 1,
+        }
+
+    return Optimizer(init, update)
+
+
+def lamb(
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.0,
+) -> Optimizer:
+    """LAMB (You et al. 2019, arXiv:1904.00962) — the Adam-family
+    counterpart of :func:`lars` (large-batch transformer training).
+    Bias-corrected Adam direction r = m̂/(√v̂+ε), decoupled decay folded
+    into the update (r + wd·p), then the per-tensor trust ratio
+    ||p||/||update|| (trust coefficient 1, as in the paper); 1-D tensors
+    skip the ratio."""
+
+    def init(params: Params) -> OptState:
+        return {
+            "mu": jax.tree.map(jnp.zeros_like, params),
+            "nu": jax.tree.map(jnp.zeros_like, params),
+            "lr": jnp.asarray(lr, jnp.float32),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(params: Params, grads: Grads, state: OptState):
+        lr_t = state["lr"]
+        t = state["step"] + 1
+        c1 = 1.0 - jnp.power(b1, t.astype(jnp.float32))
+        c2 = 1.0 - jnp.power(b2, t.astype(jnp.float32))
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * jnp.square(g)
+            r = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+            if weight_decay:
+                r = r + weight_decay * p
+            if p.ndim >= 2:
+                scale = _trust_ratio(
+                    jnp.linalg.norm(p), jnp.linalg.norm(r), 1.0, 1e-9
+                )
+            else:
+                scale = jnp.asarray(1.0, jnp.float32)
+            return p - lr_t * scale * r, m_new, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["mu"])
+        flat_v = treedef.flatten_up_to(state["nu"])
+        out = [upd(*a) for a in zip(flat_p, flat_g, flat_m, flat_v)]
+        return treedef.unflatten([o[0] for o in out]), {
+            "mu": treedef.unflatten([o[1] for o in out]),
+            "nu": treedef.unflatten([o[2] for o in out]),
+            "lr": lr_t,
+            "step": t,
+        }
+
+    return Optimizer(init, update)
+
+
 def from_config(cfg) -> Optimizer:
     """Build the optimizer a model config names (``optimizer`` key:
-    'sgd' default, 'adam', 'adamw')."""
+    'sgd' default, 'adam', 'adamw', 'lars', 'lamb')."""
     name = str(cfg.get("optimizer", "sgd")).lower()
     if name == "sgd":
         return sgd(
@@ -161,7 +286,24 @@ def from_config(cfg) -> Optimizer:
             weight_decay=float(cfg.weight_decay),
             decoupled=(name == "adamw"),
         )
-    raise ValueError(f"unknown optimizer {name!r} (sgd|adam|adamw)")
+    if name == "lars":
+        return lars(
+            lr=float(cfg.lr),
+            momentum=float(cfg.momentum),
+            weight_decay=float(cfg.weight_decay),
+            trust_coefficient=float(cfg.get("lars_trust", 0.001)),
+        )
+    if name == "lamb":
+        return lamb(
+            lr=float(cfg.lr),
+            b1=float(cfg.get("adam_b1", 0.9)),
+            b2=float(cfg.get("adam_b2", 0.999)),
+            eps=float(cfg.get("adam_eps", 1e-6)),
+            weight_decay=float(cfg.weight_decay),
+        )
+    raise ValueError(
+        f"unknown optimizer {name!r} (sgd|adam|adamw|lars|lamb)"
+    )
 
 
 def param_shaped_entries(state: OptState, params_treedef) -> tuple:
