@@ -29,7 +29,7 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from theanompi_tpu.data.loader import prefetch_to_mesh
 from theanompi_tpu.ops import losses
@@ -287,8 +287,18 @@ class TpuModel:
         (velocity, Adam moments, …) mirrors ``param_specs``; everything
         else (lr, step counters) is replicated. Keeps the base class
         optimizer-agnostic."""
+        ef_spec = P(self.exchange_axes)  # leading per-device axis
         if self.param_specs is None:
-            return P()
+            if "ef_wire" not in self.opt_state:
+                return P()
+            return {
+                k: (
+                    jax.tree.map(lambda _: ef_spec, v)
+                    if k == "ef_wire"
+                    else jax.tree.map(lambda _: P(), v)
+                )
+                for k, v in self.opt_state.items()
+            }
         shard_keys = optim_lib.param_shaped_entries(
             self.opt_state, jax.tree.structure(self.params)
         )
@@ -296,7 +306,11 @@ class TpuModel:
             k: (
                 self.param_specs
                 if k in shard_keys
-                else jax.tree.map(lambda _: P(), v)
+                else (
+                    jax.tree.map(lambda _: ef_spec, v)
+                    if k == "ef_wire"
+                    else jax.tree.map(lambda _: P(), v)
+                )
             )
             for k, v in self.opt_state.items()
         }
@@ -324,6 +338,47 @@ class TpuModel:
 
     def compile_train(self, exchanger: Optional[BSP_Exchanger] = None):
         cfg = self.config
+        ef = bool(cfg.get("error_feedback", False))
+        if ef:
+            # EF keeps a per-device residual of what the lossy wire
+            # dropped and re-sends it next step — low-bit exchanges then
+            # converge like fp32 instead of silently flooring small
+            # gradient components. Scope (same style as zero1 below):
+            # plain single-axis DP, cdd, a lossy strategy.
+            axes = self.exchange_axes
+            unsupported = {
+                "exch_strategy 'ar' (lossless wire)": cfg.exch_strategy == "ar",
+                "sync_mode != 'cdd'": cfg.sync_mode != "cdd",
+                "sharded params (tp/pp/ep)": self.param_specs is not None,
+                "multi-axis exchange (dcn)": isinstance(axes, (tuple, list))
+                and len(tuple(axes)) != 1,
+                "zero1": self._zero is not None,
+            }
+            bad = [k for k, v in unsupported.items() if v]
+            if bad:
+                raise ValueError(
+                    f"error_feedback does not support: {', '.join(bad)}"
+                )
+            if "ef_wire" not in self.opt_state:
+                world = int(self.mesh.shape[DATA_AXIS])
+                sh = NamedSharding(self.mesh, P(DATA_AXIS))
+                # create ALREADY sharded over the exchange axis — a
+                # world×fp32 copy of every param materialized on one
+                # device first would spike HBM for nothing
+                self.opt_state["ef_wire"] = jax.tree.map(
+                    lambda p: jnp.zeros(
+                        (world, *p.shape), jnp.float32, device=sh
+                    ),
+                    self.params,
+                )
+        elif "ef_wire" in self.opt_state:
+            # flag off but residuals present (EF checkpoint resumed with
+            # error_feedback=False, or a recompile after flipping the
+            # config): the step would drop the entry while out_specs
+            # still expect it — remove it here instead
+            self.opt_state = {
+                k: v for k, v in self.opt_state.items() if k != "ef_wire"
+            }
         self._place_sharded_state()
         exchanger = exchanger or BSP_Exchanger(
             strategy=cfg.exch_strategy, axis=self.exchange_axes, mesh=self.mesh
@@ -444,10 +499,31 @@ class TpuModel:
                 # exchanger is bypassed (the reduction IS the scatter)
                 params, opt_state = zero.update_shard(params, grads, opt_state)
             elif sync_mode == "cdd":
+                if ef:
+                    # error feedback: send grads + residual, keep what
+                    # the wire's first quantization leg drops. The
+                    # residual leaf carries a leading per-device axis
+                    # (size 1 inside this shard) so shard_map can keep
+                    # genuinely different values on every device.
+                    ef_local = jax.tree.map(
+                        lambda e: e[0], opt_state["ef_wire"]
+                    )
+                    send = jax.tree.map(
+                        lambda g, e: g.astype(jnp.float32) + e, grads, ef_local
+                    )
+                    rt = exchanger.local_roundtrip(send, param_specs, rng=ex_key)
+                    new_ef = jax.tree.map(
+                        lambda s, r: (s - r)[None], send, rt
+                    )
+                    grads = send
                 grads = maybe_clip(
                     exchanger.reduce_grads(grads, param_specs, rng=ex_key)
                 )
                 params, opt_state = opt.update(params, grads, opt_state)
+                if ef:
+                    # AFTER update: optimizers rebuild their state dict
+                    # from known keys, which would silently drop ef_wire
+                    opt_state = {**opt_state, "ef_wire": new_ef}
             else:  # avg: local step, then parameter averaging (DP-only;
                 # TP models are rejected above, so no per-leaf specs here)
                 params, opt_state = opt.update(params, maybe_clip(grads), opt_state)

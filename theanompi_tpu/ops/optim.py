@@ -310,10 +310,16 @@ def param_shaped_entries(state: OptState, params_treedef) -> tuple:
     """Top-level state keys whose value mirrors the params pytree
     (velocity, Adam moments, …) — THE discriminator for 'shard/sync this
     entry like a parameter' used by opt-state placement, avg-mode moment
-    sync, and ZeRO; keep the rule in one place."""
+    sync, and ZeRO; keep the rule in one place.
+
+    ``ef_wire`` is excluded by name: its TREE structure matches params
+    (it is built by tree_map over them) but its leaves carry a leading
+    per-device axis and its values are deliberately different on every
+    device — syncing or param-sharding it would destroy the error-
+    feedback residuals (models/base.py owns its placement)."""
     return tuple(
         k for k, v in state.items()
-        if jax.tree.structure(v) == params_treedef
+        if k != "ef_wire" and jax.tree.structure(v) == params_treedef
     )
 
 

@@ -45,6 +45,14 @@ applied inside the jitted, shard_mapped train step:
                 pallas variant derives its dither from an in-kernel
                 counter hash, so no U[0,1) tensor ever crosses HBM.
 
+``error_feedback=True`` (model config) adds the EF-SGD residual
+recurrence around any lossy strategy: each device keeps what the wire's
+first quantization leg dropped (``local_roundtrip``) and re-sends it
+next step, so components below a block's quantization floor accumulate
+instead of vanishing — low-bit wires then converge like fp32 (bounded
+per-window error of one quantization step; see
+tests/test_int8_wire.py::test_error_feedback_recovers_floored_gradients).
+
 Because the exchange executes inside the step function, XLA overlaps it
 with backprop where the schedule allows — the fusion the reference could
 only approximate by hiding MPI behind CUDA streams.
@@ -141,28 +149,21 @@ class BSP_Exchanger:
         return tuple(a for a in self._axes_tuple() if a not in sharded)
 
     # -- block-quantized reduce-scatter + all-gather wire -----------------
-    def _block_sum_one_axis(self, g, axis: str, rng=None):
-        """Sum ``g`` over one mesh axis moving ONLY the quantized payload
-        + per-block fp32 scales on the wire: int8 strategies ≈ N/4 + N/64
-        bytes each way vs 4N for a fp32 ring (the reference's fp16
-        kernels halved bytes, int8 quarters them; SURVEY.md §3.3 native
-        #1, VERDICT round-1 #5); fp16s strategies ≈ N/2 + N/64 with a
-        ~2^-11 relative error floor.
+    def _leg1_pack(self, g, axis: str, rng=None):
+        """First-leg quantization of THIS device's contribution — the
+        ONE definition both the wire (``_block_sum_one_axis``) and the
+        EF residual (``_leaf_roundtrip``) use, so they cannot drift:
+        EF correctness depends on the residual being computed against
+        byte-identical quantization (same fallback threshold, padding,
+        kernel selection, rng split).
 
-        reduce-scatter leg: all_to_all quantized shards; each device
-        dequantizes and sums ITS shard in fp32 (quantized values are
-        never added in the narrow domain — int8 overflows immediately).
-        all-gather leg: requantize the reduced shard, all_gather, dequant.
-
-        ``int8_sr`` (``rng`` required) uses stochastic rounding on both
-        quantization legs — unbiased, so the rounding error averages out
-        across steps instead of accumulating (see quantize_blocks).
-        """
+        Returns ``None`` when the leaf rides the lossless fp32-psum
+        fallback (too small to win), else a dict with the quantized
+        payload ``q``/``s``, the second-leg key ``k2``, the original
+        element count ``n``, and the quant/dequant kernel pair."""
         from theanompi_tpu.parallel import quantize as Q
 
         world = int(self._axis_sizes[axis])
-        if world == 1:
-            return g
         pallas = self.strategy.startswith("pallas_")
         k1 = k2 = None
         if self.strategy in _SR_STRATEGIES:
@@ -180,7 +181,6 @@ class BSP_Exchanger:
             quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
         dequant = Q.pallas_dequantize_blocks if pallas else Q.dequantize_blocks
 
-        orig_dtype = g.dtype
         flat = g.astype(jnp.float32).reshape(-1)
         n = flat.size
         # pad so each device's shard is a whole number of quant blocks;
@@ -193,14 +193,41 @@ class BSP_Exchanger:
         # below chunk/2. Scales add ~4/BLOCK ≈ 1.6%, ignored.)
         payload_bytes = 2 if self.strategy in _FP16S_STRATEGIES else 1
         if 4 * n < chunk * payload_bytes:
-            return lax.psum(g, axis)
+            return None
         pad = (-n) % chunk
         if pad:
             flat = jnp.pad(flat, (0, pad))
         nb = flat.size // (world * Q.BLOCK)  # blocks per device shard
         x = flat.reshape(world, nb, Q.BLOCK)
+        q, s = quant(x, k1)  # (world, nb, BLOCK) payload, (world, nb) f32
+        return {"q": q, "s": s, "k2": k2, "n": n, "quant": quant,
+                "dequant": dequant}
 
-        q, s = quant(x, k1)  # (world, nb, BLOCK) int8, (world, nb) f32
+    def _block_sum_one_axis(self, g, axis: str, rng=None):
+        """Sum ``g`` over one mesh axis moving ONLY the quantized payload
+        + per-block fp32 scales on the wire: int8 strategies ≈ N/4 + N/64
+        bytes each way vs 4N for a fp32 ring (the reference's fp16
+        kernels halved bytes, int8 quarters them; SURVEY.md §3.3 native
+        #1, VERDICT round-1 #5); fp16s strategies ≈ N/2 + N/64 with a
+        ~2^-11 relative error floor.
+
+        reduce-scatter leg: all_to_all quantized shards; each device
+        dequantizes and sums ITS shard in fp32 (quantized values are
+        never added in the narrow domain — int8 overflows immediately).
+        all-gather leg: requantize the reduced shard, all_gather, dequant.
+
+        ``int8_sr`` (``rng`` required) uses stochastic rounding on both
+        quantization legs — unbiased, so the rounding error averages out
+        across steps instead of accumulating (see quantize_blocks).
+        """
+        world = int(self._axis_sizes[axis])
+        if world == 1:
+            return g
+        packed = self._leg1_pack(g, axis, rng)
+        if packed is None:
+            return lax.psum(g, axis)
+        q, s, k2 = packed["q"], packed["s"], packed["k2"]
+        n, quant, dequant = packed["n"], packed["quant"], packed["dequant"]
         # all_to_all: row p of the result is peer p's shard-for-me
         q_t = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
         s_t = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
@@ -210,7 +237,7 @@ class BSP_Exchanger:
         q_all = lax.all_gather(q2, axis, axis=0)  # (world, nb, BLOCK)
         s_all = lax.all_gather(s2, axis, axis=0)
         out = dequant(q_all, s_all).reshape(-1)[:n]
-        return out.reshape(g.shape).astype(orig_dtype)
+        return out.reshape(g.shape).astype(g.dtype)
 
     def _block_reduce_mean(self, g, axes: tuple, rng=None):
         total = 1
@@ -236,8 +263,40 @@ class BSP_Exchanger:
     def _tree_mean(self, tree: Pytree, specs: Optional[Pytree], rng) -> Pytree:
         """Per-leaf mean over the exchange axes through the configured
         wire recipe — the shared body of cdd's gradient reduction and
-        avg's parameter averaging.  Each leaf folds its own index into
-        ``rng`` so no two leaves share stochastic-rounding noise."""
+        avg's parameter averaging."""
+        return self._tree_wire_map(self._reduce_leaf_mean, tree, specs, rng)
+
+    # -- error-feedback support -------------------------------------------
+    def _leaf_roundtrip(self, g, axes: tuple, rng=None):
+        """This device's contribution to one leaf as the wire will
+        represent it after the FIRST quantization leg — the per-device
+        lossy image whose difference from ``g`` is the EF residual.
+        Quantization goes through the SAME ``_leg1_pack`` the wire uses
+        (identical fallback threshold, padding, kernels, rng split), so
+        the two cannot drift."""
+        if not axes or self.strategy == "ar":
+            return g
+        if self.strategy not in _BLOCK_STRATEGIES:
+            # cast wire: the per-device loss is the down-cast
+            wire = jnp.bfloat16 if self.strategy == "bf16" else jnp.float16
+            return g.astype(wire).astype(g.dtype)
+        axis = axes[0]  # EF is scoped to a single exchange axis
+        if int(self._axis_sizes[axis]) == 1:
+            return g
+        # same per-axis fold as _block_reduce_mean's first iteration
+        sub = jax.random.fold_in(rng, 0) if rng is not None else None
+        packed = self._leg1_pack(g, axis, sub)
+        if packed is None:
+            return g  # wire rides the lossless fp32 psum fallback here
+        img = packed["dequant"](packed["q"], packed["s"])
+        return (
+            img.reshape(-1)[: packed["n"]].reshape(g.shape).astype(g.dtype)
+        )
+
+    def _tree_wire_map(self, leaf_fn, tree, specs, rng):
+        """Map a per-leaf wire function with reduce_grads' EXACT rng fold
+        sequence (each leaf folds its index), so stochastic-rounding
+        dither matches between the reduction and the EF roundtrip."""
         leaves_seen = [0]
 
         def leaf_rng():
@@ -249,16 +308,24 @@ class BSP_Exchanger:
 
         if specs is None:
             return jax.tree.map(
-                lambda g: self._reduce_leaf_mean(
-                    g, self._axes_tuple(), leaf_rng()
-                ),
-                tree,
+                lambda g: leaf_fn(g, self._axes_tuple(), leaf_rng()), tree
             )
         return jax.tree.map(
-            lambda g, s: self._reduce_leaf_mean(g, self._leaf_axes(s), leaf_rng()),
+            lambda g, s: leaf_fn(g, self._leaf_axes(s), leaf_rng()),
             tree,
             specs,
         )
+
+    def local_roundtrip(
+        self, tree: Pytree, specs: Optional[Pytree] = None, rng=None
+    ) -> Pytree:
+        """Per-leaf lossy image of THIS device's wire contribution, for
+        error feedback: ``residual = tree - local_roundtrip(tree)`` is
+        exactly the information the first quantization leg drops (the
+        second leg re-quantizes the cross-device SUM, a shared error no
+        per-device residual can represent — EF compensates leg 1, which
+        is where per-device drift lives)."""
+        return self._tree_wire_map(self._leaf_roundtrip, tree, specs, rng)
 
     def reduce_grads(
         self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
