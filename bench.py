@@ -40,11 +40,9 @@ CPU_REHEARSAL = os.environ.get("THEANOMPI_BENCH_CPU") == "1"
 if CPU_REHEARSAL:
     # force, don't setdefault: this rig exports JAX_PLATFORMS=axon
     os.environ["JAX_PLATFORMS"] = "cpu"
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    from theanompi_tpu.cachedir import cpu_xla_flags
+
+    os.environ["XLA_FLAGS"] = cpu_xla_flags(os.environ.get("XLA_FLAGS", ""))
 
 import jax
 import jax.numpy as jnp

@@ -40,6 +40,15 @@ def _force_cpu_mesh():
     """Pin this process to 8 fake CPU devices (the axon sitecustomize
     pre-imports jax, so env vars alone are ignored — config API only;
     see tests/conftest.py and the verify skill notes)."""
+    from theanompi_tpu.cachedir import configure_compile_cache, cpu_xla_flags
+
+    # before any backend touch: a starved collective rendezvous would
+    # otherwise TERMINATE the run under concurrent load (cachedir.py);
+    # devices are sized via the config API below, not the env flag
+    os.environ["XLA_FLAGS"] = cpu_xla_flags(
+        os.environ.get("XLA_FLAGS", ""), fake_devices=None
+    )
+
     import jax
     from jax.extend.backend import clear_backends
 
@@ -47,8 +56,6 @@ def _force_cpu_mesh():
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", N_DEVICES)
     # the repo's one cache policy (CPU -> per-host-fingerprint dir)
-    from theanompi_tpu.cachedir import configure_compile_cache
-
     configure_compile_cache(jax, use_repo_cache=False)
 
 

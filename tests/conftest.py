@@ -21,17 +21,20 @@ faulthandler.enable()
 # other run is pinned to the 8-fake-device CPU mesh below.
 _TPU_MODE = os.environ.get("THEANOMPI_TPU_TESTS") == "1"
 
-if not _TPU_MODE:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-
-# repo root on sys.path so `import theanompi_tpu` works without install
+# repo root on sys.path FIRST: `import theanompi_tpu` must work without
+# install, and the shared flag recipe below needs it
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _repo_root)
+
+if not _TPU_MODE:
+    from theanompi_tpu.cachedir import cpu_xla_flags
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # fake mesh + the rendezvous-termination guard (without the guard a
+    # starved collective rendezvous KILLS the suite — the r3/r4
+    # 'Fatal Python error: Aborted'; see cachedir.py)
+    os.environ["XLA_FLAGS"] = cpu_xla_flags(os.environ.get("XLA_FLAGS", ""))
+
 
 # The axon environment pre-imports jax at interpreter startup (PYTHONPATH
 # sitecustomize), so the env vars above can be too late; force the platform
@@ -48,10 +51,10 @@ if not _TPU_MODE:
 #
 # CPU runs cache per host-FINGERPRINT under tmp, not in the shared repo
 # cache: XLA:CPU AOT executables compiled on another machine type load
-# with "machine type ... doesn't match" errors and abort mid-suite —
-# CONFIRMED in r4 as round 3's nondeterministic 'Fatal Python error'
-# (faulthandler caught the SIGABRT inside a compiled module; all rigs
-# share hostname 'vm', hence the fingerprint key in cachedir.py). The
+# with "machine type ... doesn't match" errors and can SIGILL (all rigs
+# share hostname 'vm', hence the fingerprint key in cachedir.py; the
+# r3/r4 mid-suite aborts themselves were the collective-rendezvous
+# termination — see CPU_RENDEZVOUS_FLAG above). The
 # repo cache stays reserved for the real-TPU path
 # (THEANOMPI_TPU_TESTS=1), whose Mosaic binaries are host-independent.
 from theanompi_tpu.cachedir import configure_compile_cache  # noqa: E402

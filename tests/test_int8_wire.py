@@ -596,3 +596,42 @@ def test_error_feedback_off_after_on_recompiles_cleanly():
     model.reset_train_iter(0)
     loss, _ = model.train_iter(1, Recorder(print_freq=1000))
     assert np.isfinite(loss)
+
+
+@pytest.mark.parametrize("n_extra", [-1, 0, 1])
+def test_leg1_pack_threshold_and_padding_edges(n_extra):
+    """_leg1_pack at the exact chunk boundary: one element below the
+    crossover rides the lossless fallback (None); at/above it the
+    padded image still round-trips to the leaf's length."""
+    mesh = make_mesh()
+    world = len(mesh.devices.reshape(-1))
+    ex = BSP_Exchanger(strategy="int8", axis=DATA_AXIS, mesh=mesh)
+    chunk = world * Q.BLOCK  # non-pallas chunk
+    # crossover: quantize iff 4*n >= chunk (payload 1 byte)
+    n = chunk // 4 + n_extra
+    g = jnp.asarray(np.random.RandomState(7).randn(n).astype(np.float32))
+    packed = ex._leg1_pack(g, DATA_AXIS)
+    if 4 * n < chunk:
+        assert packed is None
+    else:
+        assert packed["n"] == n
+        img = packed["dequant"](packed["q"], packed["s"]).reshape(-1)
+        assert img.size % chunk == 0  # padded to whole chunks
+        rt = np.asarray(ex._leaf_roundtrip(g, (DATA_AXIS,)))
+        np.testing.assert_array_equal(rt, np.asarray(img)[:n])
+
+
+def test_error_feedback_rejects_cast_wires():
+    """EF over a cast wire is ill-defined (XLA can fold the casts away,
+    provably does on CPU): both the model scope check and the exchanger
+    itself refuse."""
+    model = Cifar10_model(
+        config=dict(TINY, batch_size=8, exch_strategy="bf16",
+                    error_feedback=True),
+        mesh=make_mesh(),
+    )
+    with pytest.raises(ValueError, match="cast"):
+        model.compile_train()
+    ex = BSP_Exchanger(strategy="fp16", axis=DATA_AXIS, mesh=make_mesh())
+    with pytest.raises(ValueError, match="block"):
+        ex.local_roundtrip({"g": jnp.ones(8)})

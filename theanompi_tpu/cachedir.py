@@ -23,6 +23,19 @@ separates those machines; host+user stay in the key for shared-tempdir
 hygiene (a cache dir created by user A is not writable by user B).
 """
 
+# XLA:CPU collective-call rendezvous TERMINATES the process ("Exiting to
+# ensure a consistent program state") when its worker threads don't all
+# arrive within the default timeout — on this 1-core rig two concurrent
+# 8-fake-device JAX processes starve each other past it, which is the
+# r3/r4 nondeterministic mid-suite SIGABRT (reproduced twice under
+# concurrent load, including once on a clean compile cache; the stale-
+# AOT warnings were a contributing hazard, not the trigger). Every CPU
+# entrypoint appends this to XLA_FLAGS so starvation degrades to
+# slowness instead of killing the suite.
+CPU_RENDEZVOUS_FLAG = (
+    "--xla_cpu_collective_call_terminate_timeout_seconds=600"
+)
+
 import getpass
 import hashlib
 import os
@@ -78,3 +91,18 @@ def configure_compile_cache(jax_mod, use_repo_cache: bool) -> str:
     jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax_mod.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return cache
+
+
+def cpu_xla_flags(existing: str = "", fake_devices=8) -> str:
+    """The CPU entrypoints' shared XLA_FLAGS recipe: the fake-device
+    mesh (``fake_devices=None`` to skip — convergence.py sizes devices
+    via the config API instead) plus the rendezvous-termination guard.
+    Idempotent: flags already present are not appended twice."""
+    flags = existing or ""
+    if fake_devices and "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            f"{flags} --xla_force_host_platform_device_count={fake_devices}"
+        ).strip()
+    if "collective_call_terminate_timeout" not in flags:
+        flags = f"{flags} {CPU_RENDEZVOUS_FLAG}".strip()
+    return flags

@@ -348,10 +348,13 @@ class TpuModel:
             axes = self.exchange_axes
             unsupported = {
                 "exch_strategy 'ar' (lossless wire)": cfg.exch_strategy == "ar",
+                "cast wires (XLA can fold their casts — block "
+                "strategies only)": cfg.exch_strategy in ("bf16", "fp16"),
                 "sync_mode != 'cdd'": cfg.sync_mode != "cdd",
                 "sharded params (tp/pp/ep)": self.param_specs is not None,
-                "multi-axis exchange (dcn)": isinstance(axes, (tuple, list))
-                and len(tuple(axes)) != 1,
+                "exchange axes beyond dp": (
+                    tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+                ) != (DATA_AXIS,),
                 "zero1": self._zero is not None,
             }
             bad = [k for k, v in unsupported.items() if v]
@@ -505,20 +508,26 @@ class TpuModel:
                     # residual leaf carries a leading per-device axis
                     # (size 1 inside this shard) so shard_map can keep
                     # genuinely different values on every device.
+                    # reduce_with_residual packs leg 1 ONCE per leaf —
+                    # a separate local_roundtrip would double the
+                    # Pallas kernel launches.
                     ef_local = jax.tree.map(
                         lambda e: e[0], opt_state["ef_wire"]
                     )
                     send = jax.tree.map(
                         lambda g, e: g.astype(jnp.float32) + e, grads, ef_local
                     )
-                    rt = exchanger.local_roundtrip(send, param_specs, rng=ex_key)
+                    reduced, rt = exchanger.reduce_with_residual(
+                        send, param_specs, rng=ex_key
+                    )
                     new_ef = jax.tree.map(
                         lambda s, r: (s - r)[None], send, rt
                     )
-                    grads = send
-                grads = maybe_clip(
-                    exchanger.reduce_grads(grads, param_specs, rng=ex_key)
-                )
+                    grads = maybe_clip(reduced)
+                else:
+                    grads = maybe_clip(
+                        exchanger.reduce_grads(grads, param_specs, rng=ex_key)
+                    )
                 params, opt_state = opt.update(params, grads, opt_state)
                 if ef:
                     # AFTER update: optimizers rebuild their state dict
@@ -728,8 +737,23 @@ class TpuModel:
                 "Rebuild the model with the config the checkpoint was "
                 "trained with."
             )
-        ck_shapes = [jnp.shape(l) for l in jax.tree.leaves(blob["opt_state"])]
-        my_shapes = [jnp.shape(l) for l in jax.tree.leaves(self.opt_state)]
+        ck_opt = blob["opt_state"]
+        ck_ef = None
+        if isinstance(ck_opt, dict) and "ef_wire" in ck_opt:
+            # error-feedback residuals are handled apart from the rest of
+            # the state: a fresh model has no ef_wire until compile_train
+            # (the layout check below must not trip on it), and the
+            # leaves must go back SHARDED over dp — replicate() would put
+            # world x params of fp32 on every device (review r4)
+            ck_ef = ck_opt["ef_wire"]
+            ck_opt = {k: v for k, v in ck_opt.items() if k != "ef_wire"}
+        my_opt = (
+            {k: v for k, v in self.opt_state.items() if k != "ef_wire"}
+            if isinstance(self.opt_state, dict)
+            else self.opt_state
+        )
+        ck_shapes = [jnp.shape(l) for l in jax.tree.leaves(ck_opt)]
+        my_shapes = [jnp.shape(l) for l in jax.tree.leaves(my_opt)]
         if ck_shapes != my_shapes:
             raise ValueError(
                 f"checkpoint {path!r} has a different optimizer-state "
@@ -737,9 +761,38 @@ class TpuModel:
                 "changed between save and load (zero1 stores flat "
                 "dp-sharded moments). Rebuild with the saving config."
             )
+        had_ef = isinstance(self.opt_state, dict) and "ef_wire" in self.opt_state
         self.params = replicate(self.mesh, blob["params"])
         self.net_state = replicate(self.mesh, blob["net_state"])
-        self.opt_state = replicate(self.mesh, blob["opt_state"])
+        self.opt_state = replicate(self.mesh, ck_opt)
+        if ck_ef is not None:
+            world = int(self.mesh.shape[DATA_AXIS])
+            lead = jax.tree.leaves(ck_ef)[0].shape[0]
+            if not bool(self.config.get("error_feedback", False)):
+                print(
+                    "[load_model] dropping ef_wire residuals: this model "
+                    "has error_feedback=False",
+                    flush=True,
+                )
+            elif lead != world:
+                # resuming on a different dp size: residuals are an
+                # optimization, not training state — reset (compile_train
+                # re-creates zeros) rather than guess a re-layout
+                print(
+                    f"[load_model] dropping ef_wire residuals: checkpoint "
+                    f"world {lead} != mesh dp {world}",
+                    flush=True,
+                )
+            else:
+                sh = NamedSharding(self.mesh, P(DATA_AXIS))
+                self.opt_state["ef_wire"] = jax.tree.map(
+                    lambda a: jax.device_put(a, sh), ck_ef
+                )
+        if ("ef_wire" in self.opt_state) != had_ef:
+            # the restored state's EF composition differs from what the
+            # compiled step's in/out specs expect — force a recompile
+            # (train_iter compiles lazily when train_fn is None)
+            self.train_fn = None
         self.current_epoch = int(blob["epoch"])
         self.rng = blob["rng"]
         # tensor-parallel leaves go back to their sharded layout
