@@ -635,3 +635,14 @@ def test_error_feedback_rejects_cast_wires():
     ex = BSP_Exchanger(strategy="fp16", axis=DATA_AXIS, mesh=make_mesh())
     with pytest.raises(ValueError, match="block"):
         ex.local_roundtrip({"g": jnp.ones(8)})
+
+
+def test_reduce_with_residual_rejects_multi_axis():
+    """A single-axis-only EF reduction on a two-level mesh would
+    silently under-reduce (each dcn group on its own mean) — refuse."""
+    from theanompi_tpu.runtime.mesh import make_mesh as _mm
+
+    mesh = _mm(dcn_shape=2)
+    ex = BSP_Exchanger(strategy="int8", axis=("dp_dcn", DATA_AXIS), mesh=mesh)
+    with pytest.raises(ValueError, match="single exchange axis"):
+        ex.reduce_with_residual({"g": jnp.ones(4096)})

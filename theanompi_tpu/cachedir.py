@@ -25,15 +25,21 @@ hygiene (a cache dir created by user A is not writable by user B).
 
 # XLA:CPU collective-call rendezvous TERMINATES the process ("Exiting to
 # ensure a consistent program state") when its worker threads don't all
-# arrive within the default timeout — on this 1-core rig two concurrent
+# arrive within the timeout — on this 1-core rig concurrent
 # 8-fake-device JAX processes starve each other past it, which is the
-# r3/r4 nondeterministic mid-suite SIGABRT (reproduced twice under
-# concurrent load, including once on a clean compile cache; the stale-
-# AOT warnings were a contributing hazard, not the trigger). Every CPU
-# entrypoint appends this to XLA_FLAGS so starvation degrades to
-# slowness instead of killing the suite.
+# r3/r4 nondeterministic mid-suite SIGABRT. PROVEN in r4 by setting the
+# flag to 5s and watching rendezvous.cc terminate with "of 5 seconds
+# exceeded ... only 7 of them arrived"; a 600s setting then died to a
+# contention window that lasted ~10 min, confirming the arithmetic
+# (kill = stuck-warn 20s + this timeout). CI semantics want "hang until
+# the outer `timeout` kills the whole run, never abort mid-suite" —
+# so the value is effectively-infinite, and the real rule is: NEVER run
+# two heavy JAX CPU processes concurrently on this rig. (The stale-AOT
+# "machine type doesn't match" log spam is mostly XLA's own
+# prefer-no-scatter/gather hint flags and appears on every cached
+# load; the cpuinfo-fingerprint cache key stays as cheap hygiene.)
 CPU_RENDEZVOUS_FLAG = (
-    "--xla_cpu_collective_call_terminate_timeout_seconds=600"
+    "--xla_cpu_collective_call_terminate_timeout_seconds=7200"
 )
 
 import getpass

@@ -301,7 +301,12 @@ class BSP_Exchanger:
         self._require_ef_capable()
         if not axes or self.strategy == "ar":
             return g
-        axis = axes[0]  # EF is scoped to a single exchange axis
+        if len(axes) > 1:
+            raise ValueError(
+                "error feedback supports a single exchange axis; got "
+                f"{axes}"
+            )
+        axis = axes[0]
         if int(self._axis_sizes[axis]) == 1:
             return g
         # same per-axis fold as _block_reduce_mean's first iteration
@@ -342,7 +347,14 @@ class BSP_Exchanger:
         self._require_ef_capable()
         if not axes or self.strategy == "ar":
             return self._reduce_leaf_mean(g, axes, rng), g
-        axis = axes[0]  # EF is scoped to a single exchange axis
+        if len(axes) > 1:
+            # a single-axis-only reduction here would silently UNDER-
+            # reduce (each outer-axis group training on its own mean)
+            raise ValueError(
+                "error feedback supports a single exchange axis; got "
+                f"{axes}"
+            )
+        axis = axes[0]
         world = int(self._axis_sizes[axis])
         if world == 1:
             return g, g
