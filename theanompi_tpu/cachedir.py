@@ -38,8 +38,15 @@ hygiene (a cache dir created by user A is not writable by user B).
 # "machine type doesn't match" log spam is mostly XLA's own
 # prefer-no-scatter/gather hint flags and appears on every cached
 # load; the cpuinfo-fingerprint cache key stays as cheap hygiene.)
+# 1200 s, not infinite: a SOLO run later stalled the same rendezvous
+# with every thread futex-parked (a real in-XLA deadlock of overlapped
+# async executions, now also fenced at the train->val boundary in
+# models/base.py run_validation) — an infinite timeout turns that into
+# a silent suite-budget-eating hang, while 1200 s survives any
+# plausible transient starvation and converts a true deadlock into a
+# diagnosable rendezvous.cc F-log abort after 20 min.
 CPU_RENDEZVOUS_FLAG = (
-    "--xla_cpu_collective_call_terminate_timeout_seconds=7200"
+    "--xla_cpu_collective_call_terminate_timeout_seconds=1200"
 )
 
 import getpass

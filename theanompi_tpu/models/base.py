@@ -672,6 +672,21 @@ class TpuModel:
             self.compile_val()
         p = self.params if params is None else params
         s = self.net_state if net_state is None else net_state
+        # FENCE the train->val boundary: with sync_each_iter=False the
+        # last train step is still executing asynchronously on the
+        # 8-thread fake-device pool when validation dispatches its own
+        # 8-participant program. On the CPU backend that overlap can
+        # deadlock the collective rendezvous (r4: a SOLO suite run
+        # stalled here with every thread futex-parked and zero CPU; the
+        # same stall under the default terminate timeout is the r3/r4
+        # intermittent mid-suite abort). Block on the model's OWN params
+        # — on the foreign-params path (EASGD center validation) ``p``
+        # is a freshly replicated array that is ready immediately while
+        # the live training state is the thing still in flight. One
+        # blocking sync per validation is noise next to a full val sweep.
+        jax.block_until_ready(self.params)
+        if params is not None:
+            jax.block_until_ready(p)
         self.reset_val_iter()
         tot = jnp.zeros((3,))
         n = 0
