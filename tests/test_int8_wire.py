@@ -646,3 +646,19 @@ def test_reduce_with_residual_rejects_multi_axis():
     ex = BSP_Exchanger(strategy="int8", axis=("dp_dcn", DATA_AXIS), mesh=mesh)
     with pytest.raises(ValueError, match="single exchange axis"):
         ex.reduce_with_residual({"g": jnp.ones(4096)})
+
+
+def test_error_feedback_composes_with_grad_accum_and_clip():
+    """EF runs after microbatch accumulation and before the clip — the
+    three features must compose: finite training, residuals updating."""
+    from tests.test_bsp import _run_steps
+
+    losses, model = _run_steps(
+        make_mesh(), per_shard_bs=8, n_steps=3,
+        exch_strategy="int8", error_feedback=True,
+        grad_accum=2, grad_clip_norm=5.0,
+    )
+    assert np.isfinite(losses).all()
+    ef_leaves = jax.tree.leaves(model.opt_state["ef_wire"])
+    # residuals are live (nonzero somewhere) after real quantized steps
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in ef_leaves)
