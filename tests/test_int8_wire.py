@@ -662,3 +662,31 @@ def test_error_feedback_composes_with_grad_accum_and_clip():
     ef_leaves = jax.tree.leaves(model.opt_state["ef_wire"])
     # residuals are live (nonzero somewhere) after real quantized steps
     assert any(float(jnp.max(jnp.abs(l))) > 0 for l in ef_leaves)
+
+
+def test_error_feedback_checkpoint_resume_happy_path(tmp_path):
+    """EF residuals survive save -> fresh model -> load -> continue:
+    restored sharded over dp (not replicated), training proceeds, and
+    the restored residuals equal the saved ones."""
+    from tests.test_bsp import _run_steps
+
+    _, model = _run_steps(
+        make_mesh(), per_shard_bs=8, n_steps=3,
+        exch_strategy="int8", error_feedback=True,
+    )
+    path = model.save_model(str(tmp_path / "ckpt_0001.npz"))
+    saved_ef = jax.tree.map(np.asarray, model.opt_state["ef_wire"])
+
+    fresh = Cifar10_model(
+        config=dict(TINY, batch_size=8, exch_strategy="int8",
+                    error_feedback=True),
+        mesh=make_mesh(),
+    )
+    fresh.compile_train()  # EF state exists before load, like a restart
+    fresh.load_model(path)
+    for a, b in zip(jax.tree.leaves(saved_ef),
+                    jax.tree.leaves(fresh.opt_state["ef_wire"])):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    fresh.reset_train_iter(0)
+    loss, _ = fresh.train_iter(1, Recorder(print_freq=1000))
+    assert np.isfinite(loss)
