@@ -57,8 +57,10 @@ def main():
         and (not device_pids or e["pid"] in device_pids)
         and e["args"].get("name") == "XLA Modules"
     }
-    agg, cnt = collections.Counter(), collections.Counter()
-    total, n_modules = 0.0, 0
+    agg, cnt_per_tid = collections.Counter(), collections.Counter()
+    modules_per_tid = collections.Counter()
+    ops_tids_seen = set()
+    total = 0.0
     for e in ev:
         if e.get("ph") != "X":
             continue
@@ -66,19 +68,30 @@ def main():
         if key in ops_tids:
             ms = e.get("dur", 0) / 1e3
             agg[e["name"]] += ms
-            cnt[e["name"]] += 1
+            cnt_per_tid[(key, e["name"])] += 1
+            ops_tids_seen.add(key)
             total += ms
         elif key in module_tids:
-            n_modules += 1
-    # steps = module executions, NOT max per-op count: loop bodies
-    # (grad_accum scans etc.) fire the same op name many times per step
-    steps = n_modules or (max(cnt.values()) if cnt else 1)
-    print(f"{path}: {total:.1f} ms busy over ~{steps} steps "
-          f"= {total / steps:.3f} ms/step")
+            modules_per_tid[key] += 1
+    # A multi-device trace mirrors the SAME step on every device: both
+    # the step count (module executions) and the op sums accumulate once
+    # per device. Normalize BOTH sides to one device — steps = the max
+    # per-(pid,tid) module count (not the sum across tids), and ms sums
+    # divided by the number of ops threads that produced events — so
+    # ms/step stays device-count invariant and comparable to the pinned
+    # single-device r2 budget. NOT max per-op count for steps: loop
+    # bodies (grad_accum scans etc.) fire one op name many times/step.
+    steps = (max(modules_per_tid.values()) if modules_per_tid else 0) or (
+        max(cnt_per_tid.values()) if cnt_per_tid else 1)
+    n_dev = max(1, len(ops_tids_seen))
+    norm = steps * n_dev
+    print(f"{path}: {total:.1f} ms busy over ~{steps} steps"
+          + (f" x {n_dev} devices" if n_dev > 1 else "")
+          + f" = {total / norm:.3f} ms/step")
     run = 0.0
     for name, ms in agg.most_common(top_n):
         run += ms
-        print(f"{ms / steps:7.3f} ms/step {100 * ms / total:5.1f}% "
+        print(f"{ms / norm:7.3f} ms/step {100 * ms / total:5.1f}% "
               f"cum{100 * run / total:5.1f}%  {name[:90]}")
 
 

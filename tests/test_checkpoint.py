@@ -378,6 +378,23 @@ def test_orbax_export_scoping_and_overwrite(tmp_path):
     d = str(tmp_path / "ck")
     with pytest.raises(ValueError, match="tag"):
         ckpt.export_orbax(d, {"tag": "run-7", "x": np.ones(2, np.float32)})
+    # advisor r4 low: np.str_/np.bytes_ ARE np.generic and str-dtype
+    # ndarrays ARE ndarrays — an isinstance check alone let them slip
+    # through to the exact orbax wedge the validation exists to prevent
+    with pytest.raises(ValueError, match="tag"):
+        ckpt.export_orbax(d, {"tag": np.str_("run-7"),
+                              "x": np.ones(2, np.float32)})
+    with pytest.raises(ValueError, match="names"):
+        ckpt.export_orbax(d, {"names": np.array(["a", "b"]),
+                              "x": np.ones(2, np.float32)})
+    # ...while bf16 (ml_dtypes kind 'V' — the TPU norm) must stay
+    # storable: the kind check rejects strings, not non-native dtypes
+    import ml_dtypes
+    tree_bf16 = {"w": np.ones(2, ml_dtypes.bfloat16)}
+    ckpt.export_orbax(d, tree_bf16)
+    back16 = ckpt.import_orbax(d, target=tree_bf16)
+    assert back16["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(back16["w"], tree_bf16["w"])
 
     Opt = collections.namedtuple("Opt", ["mu", "nu"])
     tree = {"opt": Opt(np.ones(2, np.float32), np.zeros(2, np.float32)),

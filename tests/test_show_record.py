@@ -84,3 +84,50 @@ def test_analyze_trace_reproduces_r2_op_budget():
     # the top op is the LRN1 bwd banded matmul at ~9.6% of busy time
     assert "fusion.545" in lines[1] and "9.6%" in lines[1]
     assert len(lines) == 6  # header + top_n rows
+
+
+def test_analyze_trace_counts_steps_per_device_not_summed(tmp_path):
+    """Advisor r4 low: a multi-device trace runs the same step once per
+    device; summing module events across ALL module tids inflated the
+    step count (and deflated ms/step) by the device count. Steps must be
+    the per-(pid,tid) max."""
+    import gzip
+    import json
+    import subprocess
+    import sys
+
+    def meta(pid, tid, name, kind):
+        e = {"ph": "M", "pid": pid, "name": kind,
+             "args": {"name": name}}
+        if tid is not None:
+            e["tid"] = tid
+        return e
+
+    ev = []
+    for pid in (1, 2):  # two devices
+        ev.append(meta(pid, None, f"TPU:{pid}", "process_name"))
+        ev.append(meta(pid, 10, "XLA Ops", "thread_name"))
+        ev.append(meta(pid, 20, "XLA Modules", "thread_name"))
+        for step in range(3):  # 3 steps, mirrored on both devices
+            ev.append({"ph": "X", "pid": pid, "tid": 20,
+                       "name": "jit_step", "ts": step * 100, "dur": 90})
+            ev.append({"ph": "X", "pid": pid, "tid": 10,
+                       "name": "fusion.1", "ts": step * 100, "dur": 80_000})
+    trace = tmp_path / "t.trace.json.gz"
+    with gzip.open(trace, "wt") as f:
+        json.dump({"traceEvents": ev}, f)
+
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "analyze_trace.py"),
+         str(trace), "3"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    head = out.stdout.strip().splitlines()[0]
+    # 6 ops x 80ms = 480ms busy, mirrored on 2 devices over 3 steps:
+    # per-device per-step = 480 / (3 x 2) = 80 ms — the same number a
+    # single-device trace of this workload would report
+    assert "~3 steps x 2 devices" in head, head
+    assert "80.000 ms/step" in head, head

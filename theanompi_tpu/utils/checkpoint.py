@@ -346,10 +346,25 @@ def export_orbax(ckpt_dir: str, tree: Any) -> str:
     import orbax.checkpoint as ocp
 
     snap = host_snapshot(tree)
+
+    def _orbax_storable(leaf) -> bool:
+        # isinstance alone is not enough: np.str_/np.bytes_ ARE
+        # np.generic, and object/str-dtype ndarrays pass the ndarray
+        # check — all of which hit the exact orbax failure-and-wedged-
+        # executor path this validation exists to prevent. Reject the
+        # string/object dtype KINDS rather than allow-listing numeric
+        # ones: ml_dtypes (bfloat16/float8 — the norm on TPU) register
+        # as kind 'V' and must stay storable.
+        if isinstance(leaf, (bool, int, float)):
+            return True
+        if isinstance(leaf, (np.ndarray, np.generic)):
+            return leaf.dtype.kind not in "USO"
+        return False
+
     bad = [
         jax.tree_util.keystr(kp)
         for kp, leaf in jax.tree_util.tree_flatten_with_path(snap)[0]
-        if not isinstance(leaf, (np.ndarray, np.generic, int, float, bool))
+        if not _orbax_storable(leaf)
     ]
     if bad:
         raise ValueError(
