@@ -522,6 +522,37 @@ class ImageNetData:
             self.train_files = [f"synthetic://{i}" for i in range(n_synth_batches)]
             self.val_files = [f"synthetic://{i}" for i in range(n_synth_val_batches)]
             self.synthetic = True
+        # preprocess sidecars (datasets/preprocess.py): the stored
+        # img_mean is SUBTRACTED from every delivered batch (the
+        # reference's mean-subtraction step, models/data/imagenet.py) —
+        # reduced to its per-channel mean so one rule applies to
+        # full-size batches AND loader-cropped batches (the reference
+        # subtracted the per-pixel mean before cropping; per-channel is
+        # the crop-invariant equivalent). labels.json is validated
+        # against n_classes — a silent mismatch would train a wrong-
+        # width head on real data.
+        self.img_mean_rgb = None
+        self.label_map = None
+        if not self.synthetic:
+            mp = os.path.join(data_dir, "img_mean.npy")
+            if os.path.isfile(mp):
+                m = np.load(mp)
+                self.img_mean_rgb = (
+                    m.reshape(-1, m.shape[-1]).mean(0).astype(np.float32)
+                )
+            lp = os.path.join(data_dir, "labels.json")
+            if os.path.isfile(lp):
+                import json
+
+                with open(lp) as f:
+                    self.label_map = json.load(f)
+                if len(self.label_map) != self.n_classes:
+                    raise ValueError(
+                        f"{lp} maps {len(self.label_map)} classes but the "
+                        f"model was configured with n_classes="
+                        f"{self.n_classes} — set n_classes to match the "
+                        "preprocessed dataset"
+                    )
         self._order = np.arange(len(self.train_files))
         self._worker_rank, self._n_workers = 0, 1
 
@@ -569,15 +600,23 @@ class ImageNetData:
             x, y = x[: self.batch_size], y[: self.batch_size]
         return self._postprocess(x, train), y
 
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        """Subtract the preprocess-time per-channel image mean (no-op
+        without an ``img_mean.npy`` sidecar). Applied after crop —
+        per-channel, so crop alignment doesn't matter."""
+        if self.img_mean_rgb is None:
+            return x
+        return x - self.img_mean_rgb
+
     def _postprocess(self, x: np.ndarray, train: bool) -> np.ndarray:
         """Shared aug/center-crop tail for the npz and raw-shard paths."""
         if train:
-            return self._augment(x) if self.train_aug else x
+            return self._normalize(self._augment(x) if self.train_aug else x)
         if self.crop_size:
             c = self.crop_size
             off = (x.shape[1] - c) // 2
             x = x[:, off : off + c, off : off + c, :]
-        return x
+        return self._normalize(x)
 
     def _augment(self, x: np.ndarray) -> np.ndarray:
         """PER-IMAGE random crop + mirror, the reference's ImageNet
@@ -606,7 +645,9 @@ class ImageNetData:
                 aug_seed=int(self._rng.randint(0, 2**31 - 1)),
             )
             for x, y in reader:
-                yield x[: self.batch_size], y[: self.batch_size]
+                # loader already cropped/mirrored; mean subtraction is
+                # crop-invariant (per-channel) so it composes here
+                yield self._normalize(x[: self.batch_size]), y[: self.batch_size]
             return
         reader = RawShardReader(paths, meta["x_shape"], meta["y_shape"])
         for x, y in reader:

@@ -346,15 +346,22 @@ class TpuModel:
             # gradient components. Scope (same style as zero1 below):
             # plain single-axis DP, cdd, a lossy strategy.
             axes = self.exchange_axes
+            axes_t = (
+                tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+            )
             unsupported = {
                 "exch_strategy 'ar' (lossless wire)": cfg.exch_strategy == "ar",
                 "cast wires (XLA can fold their casts — block "
                 "strategies only)": cfg.exch_strategy in ("bf16", "fp16"),
                 "sync_mode != 'cdd'": cfg.sync_mode != "cdd",
                 "sharded params (tp/pp/ep)": self.param_specs is not None,
-                "exchange axes beyond dp": (
-                    tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
-                ) != (DATA_AXIS,),
+                # data-parallel axes only — incl. the two-level dp_dcn×dp
+                # mesh (the residual chains over the hierarchical wire's
+                # per-axis folds; exchanger._chain_with_rt). sp/tp/ep
+                # exchanges carry different semantics and stay out.
+                "exchange axes beyond dp/dp_dcn": (
+                    not set(axes_t) <= {DATA_AXIS, DCN_AXIS}
+                ),
                 "zero1": self._zero is not None,
             }
             bad = [k for k, v in unsupported.items() if v]
@@ -363,9 +370,11 @@ class TpuModel:
                     f"error_feedback does not support: {', '.join(bad)}"
                 )
             if "ef_wire" not in self.opt_state:
-                world = int(self.mesh.shape[DATA_AXIS])
-                sh = NamedSharding(self.mesh, P(DATA_AXIS))
-                # create ALREADY sharded over the exchange axis — a
+                world = 1
+                for a in axes_t:
+                    world *= int(self.mesh.shape[a])
+                sh = NamedSharding(self.mesh, P(axes_t))
+                # create ALREADY sharded over the exchange axes — a
                 # world×fp32 copy of every param materialized on one
                 # device first would spike HBM for nothing
                 self.opt_state["ef_wire"] = jax.tree.map(
@@ -655,6 +664,13 @@ class TpuModel:
         # device scalars; run_validation accumulates on device and syncs once
         return self.val_fn(self.params, self.net_state, x, y)
 
+    def _val_batch(self, p, s, x, y):
+        """One validation batch → (loss, err, err5) device scalars.
+        The hook models with a different val_fn signature override
+        (LSGAN's takes no labels) so run_validation's fence/override/
+        recording semantics stay in ONE place."""
+        return self.val_fn(p, s, x, y)
+
     def run_validation(
         self, count: int, recorder, params=None, net_state=None, extra=None
     ) -> Tuple[float, float, float]:
@@ -692,7 +708,7 @@ class TpuModel:
         n = 0
         for _ in range(self.data.n_batch_val):
             x, y = next(self._val_it)
-            loss, err, err5 = self.val_fn(p, s, x, y)
+            loss, err, err5 = self._val_batch(p, s, x, y)
             tot = tot + jnp.array([loss, err, err5])
             n += 1
         loss, err, err5 = (float(v) / n for v in tot)

@@ -245,6 +245,18 @@ class LSGAN(TpuModel):
         (loss,) = self.val_fn(self.params, self.net_state, x)
         return float(loss), 0.0, 0.0
 
+    def _val_batch(self, p, s, x, y):
+        """The GAN's val signal is the discriminator's real-vs-one loss
+        and takes no labels — err/err5 slots report 0. Overriding this
+        hook (not run_validation itself) keeps the base method's
+        train→val fence and foreign-params semantics in one place; the
+        GOSGD driver validates the CONSENSUS model through exactly that
+        path after the join (found by the lsgan-gosgd preset E2E test —
+        the convergence artifact ran with val_freq=0 and never hit it)."""
+        (loss,) = self.val_fn(p, s, x)
+        z = jnp.zeros(())
+        return loss, z, z
+
     def adjust_hyperp(self, epoch: int) -> None:
         self.current_epoch = epoch
         lr = self.lr_schedule(epoch) * self._lr_scale

@@ -291,30 +291,76 @@ class BSP_Exchanger:
                 f"strategy: {sorted(_BLOCK_STRATEGIES)})"
             )
 
+    def _live_axes(self, axes: tuple):
+        """(enumerate_index, axis) pairs for axes with world > 1 —
+        indices preserved so the rng fold sequence stays byte-identical
+        with ``_block_reduce_mean``'s (which folds at EVERY enumerate
+        position, size-1 axes included)."""
+        return [
+            (i, a) for i, a in enumerate(axes)
+            if int(self._axis_sizes[a]) > 1
+        ]
+
     def _leaf_roundtrip(self, g, axes: tuple, rng=None):
         """This device's contribution to one leaf as the wire will
-        represent it after the FIRST quantization leg — the per-device
-        lossy image whose difference from ``g`` is the EF residual.
-        Quantization goes through the SAME ``_leg1_pack`` the wire uses
-        (identical fallback threshold, padding, kernels, rng split), so
-        the two cannot drift."""
+        represent it after the per-axis FIRST quantization legs — the
+        per-device lossy image whose difference from ``g`` is the EF
+        residual. Quantization goes through the SAME ``_leg1_pack`` the
+        wire uses (identical fallback threshold, padding, kernels, rng
+        split), so the two cannot drift.
+
+        Single live axis: collective-free (leg-1 image only — callable
+        outside shard_map). Multi-axis (two-level dp_dcn×dp mesh): the
+        later axes' leg-1 losses apply to the already-summed value, so
+        the chain needs the earlier axes' collectives — call inside
+        shard_map (the EF step does; see ``_chain_with_rt``)."""
         self._require_ef_capable()
         if not axes or self.strategy == "ar":
             return g
-        if len(axes) > 1:
-            raise ValueError(
-                "error feedback supports a single exchange axis; got "
-                f"{axes}"
-            )
-        axis = axes[0]
-        if int(self._axis_sizes[axis]) == 1:
+        live = self._live_axes(axes)
+        if not live:
             return g
-        # same per-axis fold as _block_reduce_mean's first iteration
-        sub = jax.random.fold_in(rng, 0) if rng is not None else None
-        packed = self._leg1_pack(g, axis, sub)
-        if packed is None:
-            return g  # wire rides the lossless fp32 psum fallback here
-        return self._img_from_packed(packed, g)
+        if len(live) == 1:
+            i, axis = live[0]
+            sub = jax.random.fold_in(rng, i) if rng is not None else None
+            packed = self._leg1_pack(g, axis, sub)
+            if packed is None:
+                return g  # wire rides the lossless fp32 psum fallback
+            return self._img_from_packed(packed, g)
+        return self._chain_with_rt(g, axes, rng)[1]
+
+    def _chain_with_rt(self, g, axes: tuple, rng=None):
+        """Walk the SAME per-axis folds as ``_block_reduce_mean``,
+        additionally collecting each axis's leg-1 quantization loss
+        scaled back to per-device units: the loss at fold j applies to
+        the partial sum over the previously-folded axes (identical
+        across that group after the all-gather), so re-presenting it
+        from EVERY group member next step over-counts by the group size
+        — divide by it. Returns ``(mean, roundtrip)`` with
+        ``g - roundtrip`` = the total per-device EF residual; summing
+        residuals over the full mesh re-presents each fold's dropped
+        mass exactly once at the fold where it was dropped."""
+        s = g
+        total = 1
+        losses = []
+        for i, ax in enumerate(axes):
+            world = int(self._axis_sizes[ax])
+            if world == 1:
+                continue
+            sub = jax.random.fold_in(rng, i) if rng is not None else None
+            packed = self._leg1_pack(s, ax, sub)
+            if packed is None:  # lossless fp32 psum fallback: no loss
+                s = lax.psum(s, ax)
+            else:
+                img = self._img_from_packed(packed, s)
+                losses.append((s - img) / total)
+                s = self._wire_from_packed(packed, ax, s)
+            total *= world
+        mean = (s / total).astype(g.dtype)
+        rt = g
+        for loss in losses:
+            rt = rt - loss
+        return mean, rt.astype(g.dtype)
 
     def _tree_wire_map(self, leaf_fn, tree, specs, rng):
         """Map a per-leaf wire function with reduce_grads' EXACT rng fold
@@ -340,31 +386,17 @@ class BSP_Exchanger:
         )
 
     def _leaf_mean_with_rt(self, g, axes: tuple, rng=None):
-        """(mean-reduced leaf, leg-1 roundtrip image) with ONE leg-1
-        quantization — the EF step needs both, and packing twice would
-        double the Pallas kernel launches (XLA CSE across custom calls
-        is not assured)."""
+        """(mean-reduced leaf, roundtrip image) with ONE leg-1
+        quantization per axis fold — the EF step needs both, and packing
+        twice would double the Pallas kernel launches (XLA CSE across
+        custom calls is not assured). Handles the two-level dp_dcn×dp
+        mesh by chaining the per-axis folds (``_chain_with_rt``)."""
         self._require_ef_capable()
         if not axes or self.strategy == "ar":
             return self._reduce_leaf_mean(g, axes, rng), g
-        if len(axes) > 1:
-            # a single-axis-only reduction here would silently UNDER-
-            # reduce (each outer-axis group training on its own mean)
-            raise ValueError(
-                "error feedback supports a single exchange axis; got "
-                f"{axes}"
-            )
-        axis = axes[0]
-        world = int(self._axis_sizes[axis])
-        if world == 1:
+        if not self._live_axes(axes):
             return g, g
-        sub = jax.random.fold_in(rng, 0) if rng is not None else None
-        packed = self._leg1_pack(g, axis, sub)
-        if packed is None:  # lossless psum fallback: no residual
-            return (lax.psum(g, axis) / world).astype(g.dtype), g
-        img = self._img_from_packed(packed, g)
-        summed = self._wire_from_packed(packed, axis, g)
-        return (summed / world).astype(g.dtype), img
+        return self._chain_with_rt(g, axes, rng)
 
     def reduce_with_residual(
         self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
