@@ -40,6 +40,18 @@ def _force_cpu_mesh():
     """Pin this process to 8 fake CPU devices (the axon sitecustomize
     pre-imports jax, so env vars alone are ignored — config API only;
     see tests/conftest.py and the verify skill notes)."""
+    # stall forensics (r5: a sweep run parked at zero CPU with no
+    # external debugger on the rig): SIGUSR1 dumps all Python thread
+    # stacks to stderr, and a 30-min hard fault catches a deadlocked
+    # collective long before the 7200 s rendezvous terminate timeout
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # periodic (not fatal): a healthy long sweep just logs a stack set
+    # every 30 min; a parked one leaves the evidence in its log
+    faulthandler.dump_traceback_later(1800, repeat=True, exit=False)
+
     from theanompi_tpu.cachedir import configure_compile_cache, cpu_xla_flags
 
     # before any backend touch: a starved collective rendezvous would

@@ -48,6 +48,28 @@ def test_cdd_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+def test_metrics_hosted_on_cpu_backend():
+    """r5 deadlock regression (docs/forensics/): on XLA:CPU, train_iter
+    must hand the recorder HOST floats — a deferred device-scalar add
+    dispatches a new program while the collective step is in flight,
+    which can park the whole run in the CPU runtime's rendezvous. (On
+    TPU the scalars stay lazy on device; this test runs on the CPU rig
+    so it asserts the hosted path.)"""
+    from theanompi_tpu.models.base import metrics_must_sync
+
+    assert metrics_must_sync()  # the suite rig is the CPU backend
+    rec = Recorder(verbose=False, print_freq=1000)
+    model = Cifar10_model(
+        config=dict(TINY, batch_size=8), mesh=make_mesh()
+    )
+    model.compile_train()
+    model.reset_train_iter(0)
+    loss, err = model.train_iter(1, rec)
+    assert type(loss) is float and type(err) is float
+    # the recorder's accumulators therefore stay host floats too
+    assert isinstance(rec._train_cost, float)
+
+
 def test_avg_mode_runs_and_learns():
     losses, model = _run_steps(make_mesh(), per_shard_bs=8, n_steps=8, sync_mode="avg")
     assert losses[-1] < losses[0]

@@ -187,15 +187,18 @@ def test_lsgan_adversarial_step():
     assert np.isfinite(np.asarray(imgs)).all()
 
 
-def test_alexnet_mask_pool_grad_trains():
-    """pool_grad='mask' (fused maxpool bwd): identical forward, valid
-    subgradient backward — training stays finite and learns."""
+@pytest.mark.parametrize("impl", ["mask", "pallas"])
+def test_alexnet_alt_pool_grad_trains(impl):
+    """pool_grad='mask' (fused XLA maxpool bwd) and 'pallas' (r5
+    single-pass kernel, ops/pallas_pool.py — the staged bench
+    candidate): identical forward, valid subgradient backward —
+    training stays finite and learns through the full model."""
     from theanompi_tpu.models.alex_net import AlexNet
 
     model = AlexNet(
         config=dict(
             batch_size=4, image_size=64, n_classes=8, n_synth_batches=4,
-            n_synth_val_batches=1, pool_grad="mask", dropout_rate=0.0,
+            n_synth_val_batches=1, pool_grad=impl, dropout_rate=0.0,
         ),
         mesh=make_mesh(),
     )

@@ -265,6 +265,67 @@ def test_maxpool_mask_rejects_same_padding():
 
     with pytest.raises(ValueError, match="VALID"):
         MaxPool(3, stride=2, padding="SAME", grad_impl="mask")
+    with pytest.raises(ValueError, match="VALID"):
+        MaxPool(3, stride=2, padding="SAME", grad_impl="pallas")
+
+
+@pytest.mark.parametrize("window,stride", [(2, 2), (3, 2), (3, 1)])
+def test_maxpool_pallas_grad_matches_native(window, stride):
+    """Single-pass Pallas backward (ops/pallas_pool.py, interpret mode
+    on CPU) == select-and-scatter backward on tie-free inputs — the r5
+    kernel answer to the 7% pool-bwd budget line."""
+    from theanompi_tpu.ops.layers import MaxPool
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 9, 9, 3))
+
+    def loss(x, impl):
+        pool = MaxPool(window, stride=stride, grad_impl=impl)
+        y, _ = pool.apply({}, {}, x)
+        return jnp.sum(jnp.square(y)), y
+
+    (l_p, y_p), g_p = jax.value_and_grad(loss, has_aux=True)(x, "pallas")
+    (l_n, y_n), g_n = jax.value_and_grad(loss, has_aux=True)(x, "native")
+    np.testing.assert_array_equal(np.asarray(y_p), np.asarray(y_n))
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_n), atol=1e-6)
+
+
+def test_maxpool_pallas_tie_split_and_batch_padding():
+    """Equal tie split conserves cotangent mass (mask semantics), and a
+    batch that doesn't divide the kernel's block size exercises the
+    zero-padded grid rows."""
+    from theanompi_tpu.ops.layers import MaxPool
+    from theanompi_tpu.ops import pallas_pool
+
+    x = jnp.zeros((1, 4, 4, 1))  # all tied
+
+    def loss(x):
+        y, _ = MaxPool(2, stride=2, grad_impl="pallas").apply({}, {}, x)
+        return jnp.sum(y)
+
+    g = jax.grad(loss)(x)
+    np.testing.assert_allclose(float(jnp.sum(g)), 4.0)
+    # agreement with the mask impl on ties (same equal-split semantics)
+    def loss_m(x):
+        y, _ = MaxPool(2, stride=2, grad_impl="mask").apply({}, {}, x)
+        return jnp.sum(y)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(loss_m)(x)), np.asarray(g))
+
+    # force multiple grid blocks + padding: row budget makes nb < n
+    old = pallas_pool._ROW_BUDGET
+    pallas_pool._ROW_BUDGET = 81  # 9x9 plane -> nb=1
+    try:
+        xr = jax.random.normal(jax.random.PRNGKey(3), (3, 9, 9, 2))
+
+        def loss_r(x, impl):
+            y, _ = MaxPool(3, stride=2, grad_impl=impl).apply({}, {}, x)
+            return jnp.sum(jnp.square(y))
+
+        g_p = jax.grad(lambda x: loss_r(x, "pallas"))(xr)
+        g_n = jax.grad(lambda x: loss_r(x, "native"))(xr)
+        np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_n), atol=1e-6)
+    finally:
+        pallas_pool._ROW_BUDGET = old
 
 
 def test_adam_matches_numpy():

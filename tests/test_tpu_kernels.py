@@ -153,6 +153,25 @@ def test_lrn_pallas_compiled(size):
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gw), atol=5e-5, rtol=5e-5)
 
 
+def test_maxpool_pallas_bwd_compiled_matches_native():
+    """The r5 single-pass maxpool backward (ops/pallas_pool.py) under
+    Mosaic: dx must match select-and-scatter on tie-free inputs at the
+    AlexNet pool-1 geometry (3x3 stride 2 VALID)."""
+    from theanompi_tpu.ops import layers as L
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, 32, 32, 96), jnp.float32)
+
+    def loss(x, impl):
+        y, _ = L.MaxPool(3, stride=2, grad_impl=impl).apply({}, {}, x)
+        return jnp.sum(jnp.square(y))
+
+    g_p = jax.jit(jax.grad(lambda a: loss(a, "pallas")))(x)
+    g_n = jax.jit(jax.grad(lambda a: loss(a, "native")))(x)
+    np.testing.assert_allclose(
+        np.asarray(g_p), np.asarray(g_n), atol=1e-5, rtol=1e-5
+    )
+
+
 # -- quantizer kernels: int8 RN/SR + fp16s fused cast+scale ------------------
 
 def test_quant_int8_kernel_compiled_matches_xla():

@@ -39,6 +39,26 @@ from theanompi_tpu.parallel.exchanger import BSP_Exchanger
 from theanompi_tpu.runtime.config import Config
 from theanompi_tpu.runtime.mesh import DATA_AXIS, DCN_AXIS, make_mesh, replicate
 
+_METRICS_SYNC: Optional[bool] = None
+
+
+def metrics_must_sync() -> bool:
+    """True on the XLA:CPU backend only: there, DISPATCHING any new
+    program (even the recorder's deferred one-op scalar add) while an
+    8-participant collective step is still in flight can deadlock the
+    runtime's collective rendezvous — proven by the r5 easgd_sweep
+    stall, parked at 0 CPU inside ``recorder.train_error``'s
+    ``deferring_binary_op`` with the loader blocked on a full queue
+    (SIGUSR1 stack dump; same hazard CLASS as the r4 train→val fence in
+    ``run_validation``, at a different dispatch site). Hosting the
+    metrics first is a blocking device→host READ, not a program launch,
+    so it serializes the hazard away. TPU keeps the lazy device-scalar
+    pipeline the r1 perf push introduced."""
+    global _METRICS_SYNC
+    if _METRICS_SYNC is None:
+        _METRICS_SYNC = jax.default_backend() == "cpu"
+    return _METRICS_SYNC
+
 COMMON_DEFAULTS = dict(
     seed=0,
     batch_size=128,  # per data-parallel shard, like the reference's per-GPU bs
@@ -649,7 +669,7 @@ class TpuModel:
         )
         self.params, self.net_state, self.opt_state = out[0], out[1], out[2]
         loss, err = out[3], out[4]
-        if self.config.sync_each_iter:
+        if self.config.sync_each_iter or metrics_must_sync():
             # pulling the scalars fences the step (honest per-step calc
             # timing; the comm is fused in-graph so calc includes exchange)
             loss, err = float(loss), float(err)
@@ -704,12 +724,23 @@ class TpuModel:
         if params is not None:
             jax.block_until_ready(p)
         self.reset_val_iter()
-        tot = jnp.zeros((3,))
+        sync = metrics_must_sync()
+        # XLA:CPU: host each batch's scalars (blocking read) and
+        # accumulate on the HOST — zero extra program dispatches (see
+        # metrics_must_sync). TPU accumulates on device, one sync at end.
+        tot = [0.0, 0.0, 0.0] if sync else jnp.zeros((3,))
         n = 0
         for _ in range(self.data.n_batch_val):
             x, y = next(self._val_it)
             loss, err, err5 = self._val_batch(p, s, x, y)
-            tot = tot + jnp.array([loss, err, err5])
+            if sync:
+                tot = [
+                    tot[0] + float(loss),
+                    tot[1] + float(err),
+                    tot[2] + float(err5),
+                ]
+            else:
+                tot = tot + jnp.array([loss, err, err5])
             n += 1
         loss, err, err5 = (float(v) / n for v in tot)
         recorder.val_error(count, loss, err, err5, extra=extra)

@@ -231,7 +231,9 @@ class LSGAN(TpuModel):
         out = self.train_fn(self.params, self.net_state, self.opt_state, x, step_key)
         self.params, self.net_state, self.opt_state = out[0], out[1], out[2]
         d_loss, g_loss = out[3], out[4]
-        if self.config.sync_each_iter:
+        from theanompi_tpu.models.base import metrics_must_sync
+
+        if self.config.sync_each_iter or metrics_must_sync():
             d_loss, g_loss = float(d_loss), float(g_loss)
         recorder.end("calc")
         # recorder's (cost, error) slots carry (d_loss, g_loss)

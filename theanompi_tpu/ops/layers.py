@@ -389,17 +389,23 @@ _maxpool_mask.defvjp(_maxpool_mask_fwd, _maxpool_mask_bwd)
 class MaxPool(Layer):
     """Max pooling. ``grad_impl``: 'native' = XLA select-and-scatter
     backward; 'mask' = the fused shifted-mask backward (VALID only; see
-    ``_maxpool_mask``)."""
+    ``_maxpool_mask``); 'pallas' = the single-pass VMEM-resident kernel
+    backward (VALID only; see ``ops.pallas_pool`` — the r5 answer to the
+    mask path's unfusable overlap-add)."""
 
     def __init__(self, window=2, stride=None, padding="VALID", grad_impl="native"):
         self.window = (window, window) if isinstance(window, int) else tuple(window)
         stride = stride if stride is not None else self.window
         self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
         self.padding = padding
-        if grad_impl not in ("native", "mask"):
-            raise ValueError(f"grad_impl must be native|mask, got {grad_impl!r}")
-        if grad_impl == "mask" and padding != "VALID":
-            raise ValueError("grad_impl='mask' supports VALID padding only")
+        if grad_impl not in ("native", "mask", "pallas"):
+            raise ValueError(
+                f"grad_impl must be native|mask|pallas, got {grad_impl!r}"
+            )
+        if grad_impl in ("mask", "pallas") and padding != "VALID":
+            raise ValueError(
+                f"grad_impl={grad_impl!r} supports VALID padding only"
+            )
         self.grad_impl = grad_impl
 
     def init(self, key, in_shape):
@@ -419,6 +425,10 @@ class MaxPool(Layer):
     def apply(self, params, state, x, train=False, rng=None):
         if self.grad_impl == "mask":
             return _maxpool_mask(x, self.window, self.stride, self.padding), state
+        if self.grad_impl == "pallas":
+            from theanompi_tpu.ops.pallas_pool import maxpool_pallas
+
+            return maxpool_pallas(x, self.window, self.stride, self.padding), state
         return _maxpool_fwd_raw(x, self.window, self.stride, self.padding), state
 
 

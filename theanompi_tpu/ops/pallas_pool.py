@@ -1,0 +1,193 @@
+"""Single-pass Pallas TPU kernel for the max-pool backward.
+
+XLA lowers the max-pool VJP to ``select-and-scatter`` — a sequential
+window scan measured at ~7% of the AlexNet-128 step (docs/perf/NOTES.md
+op budget, select-and-scatter.{1,2}).  The pure-XLA alternative
+(``layers._maxpool_mask_bwd``) measured 2.2× slower END-TO-END because
+its kh·kw interior-padded overlap-adds at distinct offsets cannot fuse:
+each one is a full input-sized HBM read-modify-write plus stride-2
+slice relayouts (the r5 layout diagnosis in NOTES.md).
+
+This kernel runs the SAME shifted-mask math but entirely in VMEM per
+batch block: one HBM read of x, one of (y, dy) at output resolution,
+one HBM write of dx.  The kh·kw offset loop happens on values already
+resident in VMEM — cheap VPU shifts instead of HBM round-trips.  The
+AlexNet/GoogLeNet-era pools have small spatial extents (≤ 32×32), so a
+block holds the FULL spatial plane and no halo exchange is needed; the
+grid walks the batch axis.
+
+Tie semantics match ``_maxpool_mask_bwd``: the cotangent is split
+EQUALLY across tied window maxima (select-and-scatter routes to the
+first max; both are valid subgradients, the equal split conserves
+per-window cotangent mass and keeps the kernel order-free).  VALID
+padding only, like the mask path.
+
+On CPU (the test rig) the kernel runs in interpreter mode; numerical
+equivalence against the mask backward is covered by tests/test_ops.py.
+Reference analog: the maxpool gradient op of the reference's
+``theanompi/models/layers2.py`` pool layer (cuDNN there; SURVEY.md
+§3.5) — re-designed as a TPU kernel rather than translated.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# rows (= H·W positions) of the input plane per batch-block; the f32
+# working set per block is ~4 buffers × rows × C × 4B (x, acc, and the
+# transient dilated contribution) — 4096 rows × 96ch ≈ 6 MB, inside the
+# v5e VMEM budget with headroom for double buffering
+_ROW_BUDGET = 4096
+
+
+def _dilate(a: jnp.ndarray, axis: int, stride: int) -> jnp.ndarray:
+    """Interior-dilate ``a`` by ``stride`` along ``axis`` (insert
+    stride-1 zeros between elements) using stack+reshape — Mosaic
+    lowers these as VMEM data movement, no scatter needed."""
+    if stride == 1:
+        return a
+    parts = [a] + [jnp.zeros_like(a)] * (stride - 1)
+    stacked = jnp.stack(parts, axis=axis + 1)
+    shape = list(a.shape)
+    shape[axis] = a.shape[axis] * stride
+    dilated = stacked.reshape(shape)
+    # trailing stride-1 zeros exceed the interior-dilated span — drop
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(0, a.shape[axis] * stride - (stride - 1))
+    return dilated[tuple(idx)]
+
+
+def _pool_bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, *, window, stride):
+    kh, kw = window
+    sh, sw = stride
+    x = x_ref[...].astype(jnp.float32)
+    y = y_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    nb, h, w, c = x.shape
+    oh, ow = y.shape[1:3]
+    span_h = (oh - 1) * sh + 1
+    span_w = (ow - 1) * sw + 1
+
+    def strided_window(di, dj):
+        """x sample each window reads at offset (di, dj): (nb,oh,ow,c).
+
+        Static start + stack/reshape subsampling instead of a strided
+        slice — strides on the second-minor axes are a relayout Mosaic
+        handles poorly, while reshapes over full planes are free-ish."""
+        xs = jax.lax.slice(
+            x, (0, di, dj, 0), (nb, di + span_h, dj + span_w, c)
+        )
+        if sh > 1:
+            pad_h = oh * sh - span_h
+            xs = jnp.concatenate(
+                [xs, jnp.zeros((nb, pad_h, span_w, c), xs.dtype)], axis=1
+            )
+            xs = xs.reshape(nb, oh, sh, span_w, c)[:, :, 0]
+        if sw > 1:
+            pad_w = ow * sw - span_w
+            xs = jnp.concatenate(
+                [xs, jnp.zeros((nb, oh, pad_w, c), xs.dtype)], axis=2
+            )
+            xs = xs.reshape(nb, oh, ow, sw, c)[:, :, :, 0]
+        return xs
+
+    offsets = [
+        (di, dj)
+        for di in range(kh)
+        for dj in range(kw)
+        if di + span_h <= h and dj + span_w <= w
+    ]
+    # pass 1 (VMEM-resident): ties per window, for the mass-conserving
+    # equal split
+    cnt = jnp.zeros(y.shape, jnp.float32)
+    for di, dj in offsets:
+        cnt = cnt + (strided_window(di, dj) == y).astype(jnp.float32)
+    dyc = dy / cnt  # every window has >= 1 max
+
+    acc = jnp.zeros(x.shape, jnp.float32)
+    for di, dj in offsets:
+        contrib = jnp.where(strided_window(di, dj) == y, dyc, 0.0)
+        d = _dilate(_dilate(contrib, 1, sh), 2, sw)  # (nb,span_h,span_w,c)
+        acc = acc + jnp.pad(
+            d,
+            (
+                (0, 0),
+                (di, h - di - span_h),
+                (dj, w - dj - span_w),
+                (0, 0),
+            ),
+        )
+    dx_ref[...] = acc.astype(dx_ref.dtype)
+
+
+def maxpool_bwd(x, y, dy, window, stride) -> jnp.ndarray:
+    """dx for a VALID max pool, via the batch-blocked Pallas kernel."""
+    n, h, w, c = x.shape
+    oh, ow = y.shape[1:3]
+    # clamp to n: without it a small batch pads UP to the row budget
+    # (e.g. batch 4 on a 7x7 plane -> 83 rows, ~20x wasted work)
+    nb = max(1, min(n, _ROW_BUDGET // (h * w)))
+    pad = (-n) % nb
+    if pad:
+        zx = ((0, pad), (0, 0), (0, 0), (0, 0))
+        x = jnp.pad(x, zx)
+        # padded batch rows: y=0 matches x=0 at every offset, dy=0 so
+        # their dx contribution is exactly 0 — no masking needed
+        y = jnp.pad(y, zx)
+        dy = jnp.pad(dy, zx)
+    np_ = n + pad
+    in_specs = [
+        pl.BlockSpec((nb, h, w, c), lambda i: (i, 0, 0, 0)),
+        pl.BlockSpec((nb, oh, ow, c), lambda i: (i, 0, 0, 0)),
+        pl.BlockSpec((nb, oh, ow, c), lambda i: (i, 0, 0, 0)),
+    ]
+    out = pl.pallas_call(
+        partial(_pool_bwd_kernel, window=window, stride=stride),
+        out_shape=jax.ShapeDtypeStruct((np_, h, w, c), x.dtype),
+        grid=(np_ // nb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((nb, h, w, c), lambda i: (i, 0, 0, 0)),
+        interpret=(jax.default_backend() == "cpu"),
+    )(x, y, dy)
+    return out[:n]
+
+
+def _require_valid(padding):
+    # guard HERE, not only in the MaxPool constructor: a direct call
+    # with SAME would run the SAME forward while the backward's offset
+    # filter silently drops padded-region window taps — wrong dx, no
+    # error (review r5)
+    if padding != "VALID":
+        raise ValueError(
+            f"maxpool_pallas supports VALID padding only, got {padding!r}"
+        )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def maxpool_pallas(x, window, stride, padding):
+    """MaxPool whose backward is the single-pass Pallas kernel (forward
+    stays XLA's reduce_window — it fuses fine)."""
+    from theanompi_tpu.ops.layers import _maxpool_fwd_raw
+
+    _require_valid(padding)
+    return _maxpool_fwd_raw(x, window, stride, padding)
+
+
+def _fwd(x, window, stride, padding):
+    from theanompi_tpu.ops.layers import _maxpool_fwd_raw
+
+    _require_valid(padding)
+    y = _maxpool_fwd_raw(x, window, stride, padding)
+    return y, (x, y)
+
+
+def _bwd(window, stride, padding, res, dy):
+    x, y = res
+    return (maxpool_bwd(x, y, dy, window, stride).astype(x.dtype),)
+
+
+maxpool_pallas.defvjp(_fwd, _bwd)

@@ -35,6 +35,9 @@ PERF_SWEEP_CONFIGS = (
     ("s2d", {"stem": "s2d"}),
     ("lrnbf16", {"lrn_stats": "bf16"}),
     ("s2d+lrnbf16", {"stem": "s2d", "lrn_stats": "bf16"}),
+    ("poolbwd", {"pool_grad": "pallas"}),
+    ("s2d+lrnbf16+poolbwd",
+     {"stem": "s2d", "lrn_stats": "bf16", "pool_grad": "pallas"}),
 )
 
 # bench.py's candidate subset: the r1-measured default plus the
@@ -48,6 +51,12 @@ BENCH_CANDIDATES = (
     ("s2d", {"stem": "s2d"}),
     ("lrnbf16", {"lrn_stats": "bf16"}),
     ("s2d+lrnbf16", {"stem": "s2d", "lrn_stats": "bf16"}),
+    # r5: single-pass Pallas maxpool backward (ops/pallas_pool.py) —
+    # attacks the ~7% select-and-scatter budget line; the pure-XLA mask
+    # variant measured 2.2x slower (unfusable overlap-add, NOTES.md)
+    ("poolbwd", {"pool_grad": "pallas"}),
+    ("s2d+lrnbf16+poolbwd",
+     {"stem": "s2d", "lrn_stats": "bf16", "pool_grad": "pallas"}),
 )
 
 
