@@ -94,6 +94,36 @@ def test_bsp_worker_reprobes_comm_each_epoch(tmp_path):
         assert p["n_dp"] == 4
 
 
+def test_bsp_worker_logs_wire_bytes_when_enabled(tmp_path):
+    """log_wire_bytes=True: the record carries the static per-step
+    collective payload accounting (HLO-derived) next to the wall-clock
+    comm probe — per-op byte fields + a positive total for a 4-device
+    exchange. Off by default (it costs a second compile)."""
+    import json
+
+    import theanompi_tpu
+
+    rule = theanompi_tpu.BSP()
+    rule.init(
+        devices=4,
+        model_config=dict(CFG, n_epochs=1, comm_probe=False,
+                          log_wire_bytes=True),
+        checkpoint_dir=str(tmp_path),
+        val_freq=0,
+    )
+    rule.wait()
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "record_rank0.jsonl").read_text().splitlines()
+    ]
+    wb = [r for r in rows if r["kind"] == "wire_bytes"]
+    assert len(wb) == 1
+    assert wb[0]["total_bytes"] > 0
+    per_op = {k: v for k, v in wb[0].items()
+              if k.endswith("_bytes") and k != "total_bytes"}
+    assert per_op and sum(per_op.values()) == wb[0]["total_bytes"]
+
+
 def test_scaling_efficiency_rows():
     rows = B.scaling_efficiency(
         Cifar10_model, CFG, device_counts=[1, 2], n_steps=2

@@ -155,6 +155,35 @@ class BSP_Worker:
         except Exception as e:  # never let diagnostics kill training
             print(f"comm probe skipped: {type(e).__name__}: {e}", flush=True)
 
+    def _probe_wire_bytes(self, model, rec: Recorder) -> None:
+        """Static complement to the wall-clock comm probe: per-step
+        collective payload bytes off the compiled HLO — the numbers the
+        reference's fp16 kernels halved. Opt-in via config
+        ``log_wire_bytes`` (it lowers+compiles the step a second time);
+        rank 0 only — the result is rank-invariant, so N-1 hosts would
+        burn a redundant compile for an identical row."""
+        if not bool(model.config.get("log_wire_bytes", False)):
+            return
+        if self.process_index != 0:
+            return
+        try:
+            from theanompi_tpu.utils.benchmark import collective_wire_bytes
+
+            wb = collective_wire_bytes(model)
+            rec.log_event(
+                "wire_bytes",
+                total_bytes=int(wb["total_bytes"]),
+                **{
+                    f"{op}_bytes": int(d["bytes"])
+                    for op, d in wb["by_op"].items()
+                },
+            )
+        except Exception as e:  # diagnostics never kill training
+            print(
+                f"wire-bytes probe skipped: {type(e).__name__}: {e}",
+                flush=True,
+            )
+
     def run(self) -> None:
         model, rec = self.model, self.recorder
         if self.resume and self.checkpoint_dir:
@@ -183,6 +212,7 @@ class BSP_Worker:
             # fresh runs only: a crash-restart loop must not re-pay the
             # probe's two extra compiles on every recovery attempt
             self._probe_comm(model, rec)
+            self._probe_wire_bytes(model, rec)
         self._log_memory(rec, "train_start")
         if self.process_index == 0 and hasattr(model, "describe"):
             print(model.describe(), flush=True)

@@ -307,7 +307,16 @@ def collective_wire_bytes(model) -> Dict:
     import re
 
     fn = model.train_fn or model.compile_train()
-    batch = next(iter(model.data.train_batches()))
+    # pulling a batch advances the provider's aug RNG on the ImageNet
+    # paths — save/restore it (same hazard comm_fraction_probe guards:
+    # a diagnostics call must not change the training aug stream)
+    data_rng = getattr(model.data, "_rng", None)
+    rng_state = data_rng.get_state() if data_rng is not None else None
+    try:
+        batch = next(iter(model.data.train_batches()))
+    finally:
+        if rng_state is not None:
+            data_rng.set_state(rng_state)
     sharded = shard_batch(model.mesh, batch, spec=model.batch_spec)
     key = jax.random.PRNGKey(0)
     try:  # supervised contract: (params, state, opt, x, y, key)
