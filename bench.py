@@ -364,7 +364,10 @@ _KNOBS_REHEARSAL = dict(
     # non-degenerate (32 empties the last MaxPool — see MaxPool.init)
     image_size=64,
     n_synth_batches=2,
-    n_candidates=2,
+    # ALL candidates: the scarce TPU window runs every staged config,
+    # so every one must have executed end-to-end in rehearsal first
+    # (r5: poolbwd's Pallas bwd would otherwise first run on the chip)
+    n_candidates=None,
     est_steps=2,
     warmup_steps=1,
     calib_steps=2,
@@ -472,6 +475,12 @@ def main():
             m, fn = build(dict(extra))
             est = short_est(m, fn)
         except Exception as e:  # a candidate must never kill the bench
+            if CPU_REHEARSAL:
+                # ...except in rehearsal, whose entire purpose is to
+                # prove every staged config runs BEFORE the TPU window —
+                # a swallowed failure here would pass green while the
+                # config's first real execution happens on the chip
+                raise
             picks[name] = f"failed: {type(e).__name__}"
             del m, fn  # a failed candidate must not stay HBM-resident
             continue
