@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# TPU window watcher — probe the axon tunnel on a cadence; the moment a
+# short-lived child probe sees the chip, execute the standing live-window
+# plan (docs/perf/NOTES.md) sequentially, ONE TPU process at a time,
+# then exit. Every step logs under /tmp/tpu_window/.
+#
+# Probe discipline (verify skill / NOTES.md): probes are short-lived
+# child processes under `timeout`; never two TPU clients at once; never
+# jax.profiler through the tunnel. The watcher serializes everything.
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/tpu_window
+mkdir -p "$OUT"
+LOCK="$OUT/active.lock"
+# single instance: two watchers racing a recovered tunnel would be the
+# exact two-concurrent-TPU-clients condition the lock exists to prevent
+if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK" 2>/dev/null)" 2>/dev/null; then
+  echo "watcher already running (pid $(cat "$LOCK")) — refusing to start"
+  exit 1
+fi
+rm -f "$LOCK"  # stale lock from a SIGKILL'd watcher
+echo $$ > "$LOCK"
+trap 'rm -f "$LOCK"' EXIT
+
+log() { echo "[watcher $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/watcher.log"; }
+
+probe() {
+  # platform MUST be tpu: a fast tunnel error makes jax fall back to
+  # CPU with 1 device — that is a dead tunnel, not a window (bench.py
+  # guards the same case with default_backend() == 'tpu')
+  timeout 90 python -c "
+import jax, sys
+ds = jax.devices()
+if ds[0].platform != 'tpu':
+    print(f'non-tpu backend: {ds[0].platform}', file=sys.stderr)
+    sys.exit(1)
+print(len(ds))
+" > "$OUT/probe.txt" 2>&1
+}
+
+log "watcher started (pid $$)"
+while true; do
+  if probe; then
+    n=$(tail -1 "$OUT/probe.txt")
+    log "tunnel ALIVE (devices=$n) — executing standing plan"
+    break
+  fi
+  log "tunnel wedged; sleeping 600"
+  sleep 600
+done
+
+run() {  # run <name> <timeout_s> <cmd...> — ABORTS the plan on timeout:
+  # a timeout means the step's TPU client was killed mid-run, which is
+  # the documented event that wedges the tunnel for hours; launching
+  # the remaining steps against a wedged tunnel would burn every
+  # timeout producing garbage and re-trigger the hazard each time.
+  local name=$1 t=$2; shift 2
+  log "START $name"
+  timeout "$t" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  log "END $name rc=$rc"
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    log "step $name TIMED OUT — tunnel likely re-wedged by the kill; aborting remaining plan"
+    exit 2
+  fi
+  sleep 10  # let the tunnel settle between clients
+  return 0
+}
+
+# Standing plan (NOTES.md), in order; each step its own process.
+# Non-timeout failures log and continue (an assertion in one sweep
+# config must not cost the bench its window).
+run sweep_s2d            420 python scripts/bench_sweep.py s2d
+run sweep_lrnbf16        420 python scripts/bench_sweep.py lrnbf16
+run sweep_s2d_lrnbf16    420 python scripts/bench_sweep.py s2d+lrnbf16
+run sweep_poolbwd        420 python scripts/bench_sweep.py poolbwd
+run sweep_triple         420 python scripts/bench_sweep.py s2d+lrnbf16+poolbwd
+THEANOMPI_TPU_TESTS=1 run tpu_suite 1500 python -m pytest tests/ -m tpu -q
+run bench                1200 python bench.py
+# NOTE: the NOTES.md item-6 wire-bytes confirmation needs >= 2 chips
+# (a 1-device mesh compiles no collectives — nothing on the wire to
+# measure); it stays environment-blocked until a multi-chip window.
+
+log "standing plan complete — logs in $OUT; remember to commit results"
