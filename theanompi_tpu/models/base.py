@@ -262,7 +262,15 @@ class TpuModel:
         if bool(cfg.zero1) and self.n_workers > 1:
             from theanompi_tpu.parallel.zero import Zero1
 
-            self._zero = Zero1(self.optimizer, world=self.n_workers)
+            # the configured exchange strategy selects zero's wire too
+            # (r5): block strategies quantize the reduce-scatter and
+            # ride the fp16-block param gather with exact fp32 master
+            # shards; 'ar' keeps the plain fp32 legs. Cast wires are
+            # rejected by Zero1 itself (foldable — see exchanger).
+            self._zero = Zero1(
+                self.optimizer, world=self.n_workers,
+                strategy=str(cfg.exch_strategy),
+            )
             opt_state = self._zero.init(params)
         else:
             opt_state = self.optimizer.init(params)
@@ -427,13 +435,15 @@ class TpuModel:
             )
         zero = self._zero
         if zero is not None:
-            # ZeRO-1 fuses the gradient reduction into the sharded update;
-            # scope: plain single-level dp with the fp32 wire
+            # ZeRO-1 fuses the gradient reduction into the sharded
+            # update; scope: plain single-level dp. The wire may be fp32
+            # ('ar') or a block strategy (r5: quantized reduce-scatter +
+            # fp16-block param gather with exact master shards); cast
+            # wires were already rejected at Zero1 construction.
             unsupported = {
                 "sync_mode != 'cdd'": sync_mode != "cdd",
                 "sharded params (tp/pp/ep)": self.param_specs is not None,
                 "exchange axes beyond dp": self.exchange_axes != DATA_AXIS,
-                "compressed exch_strategy": cfg.exch_strategy != "ar",
                 "grad_clip_norm": cfg.grad_clip_norm is not None,
             }
             bad = [k for k, v in unsupported.items() if v]
@@ -529,7 +539,9 @@ class TpuModel:
             if zero is not None:
                 # reduce-scatter + shard update + params all-gather; the
                 # exchanger is bypassed (the reduction IS the scatter)
-                params, opt_state = zero.update_shard(params, grads, opt_state)
+                params, opt_state = zero.update_shard(
+                    params, grads, opt_state, rng=ex_key
+                )
             elif sync_mode == "cdd":
                 if ef:
                     # error feedback: send grads + residual, keep what

@@ -86,6 +86,27 @@ _BLOCK_STRATEGIES = _INT8_STRATEGIES + _FP16S_STRATEGIES
 _SR_STRATEGIES = ("int8_sr", "pallas_int8_sr")
 
 
+def block_wire_kernels(strategy: str):
+    """``(quant, quant_fp16, dequant)`` kernel triple for a block
+    strategy — the ONE selection shared by the BSP exchanger's
+    ``_leg1_pack`` and compressed ZeRO, so a new wire tier cannot be
+    wired into one and silently mis-selected in the other."""
+    from theanompi_tpu.parallel import quantize as Q
+
+    pallas = strategy.startswith("pallas_")
+    if strategy in _FP16S_STRATEGIES:
+        quant = (
+            Q.pallas_quantize_blocks_fp16 if pallas else Q.quantize_blocks_fp16
+        )
+    else:
+        quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
+    quant_fp16 = (
+        Q.pallas_quantize_blocks_fp16 if pallas else Q.quantize_blocks_fp16
+    )
+    dequant = Q.pallas_dequantize_blocks if pallas else Q.dequantize_blocks
+    return quant, quant_fp16, dequant
+
+
 def spec_axis_names(spec) -> tuple:
     """Mesh-axis names a PartitionSpec shards over (flattening sub-tuples)."""
     names = []
@@ -173,13 +194,7 @@ class BSP_Exchanger:
                     "call reduce_grads(grads, specs, rng=key)"
                 )
             k1, k2 = jax.random.split(rng)  # one per quantization leg
-        if self.strategy in _FP16S_STRATEGIES:
-            quant = (
-                Q.pallas_quantize_blocks_fp16 if pallas else Q.quantize_blocks_fp16
-            )
-        else:
-            quant = Q.pallas_quantize_blocks if pallas else Q.quantize_blocks
-        dequant = Q.pallas_dequantize_blocks if pallas else Q.dequantize_blocks
+        quant, _, dequant = block_wire_kernels(self.strategy)
 
         flat = g.astype(jnp.float32).reshape(-1)
         n = flat.size

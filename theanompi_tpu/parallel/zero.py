@@ -18,6 +18,33 @@ padded to a multiple of N, and sharded ``P(dp)`` on that flat dim
 code) on slice pytrees, and all-gathers the updated param slices.
 Scalars (lr, step) stay replicated, so ``set_lr``/``adjust_hyperp``
 work untouched.
+
+**Compressed wire (r5):** with a block ``strategy`` (the exchanger's
+``int8``/``int8_sr``/``fp16s`` families, incl. their ``pallas_``
+kernel tiers), both collective legs shrink:
+
+- the gradient reduce-scatter moves quantized payloads + per-256-block
+  fp32 scales (int8: ~¼ the fp32 bytes; SR variants take the per-step
+  ``rng`` for unbiased rounding), dequantized and mean-summed in fp32
+  on the owning shard — the same leg-1 structure the BSP exchanger
+  uses, so the byte claims carry over;
+- the parameter all-gather ALWAYS rides block-scaled **fp16** (never
+  int8, regardless of the gradient strategy): the reference's asa16
+  exchanger compressed its param exchanges the same way (SURVEY.md
+  §3.3). Crucially the lossy gather never feeds back into the update:
+  a compressed Zero1 keeps an EXACT fp32 ``zero_master`` weight shard
+  in the (dp-sharded) optimizer state — the standard mixed-precision
+  ZeRO layout — so each step updates exact masters and broadcasts a
+  fresh fp16-block view for compute; quantization error cannot
+  accumulate in the weights (without the master shard, tiny updates
+  below the fp16 block grid would stall exactly like fp16 master
+  weights do).
+
+Small leaves ride the lossless fp32 path (same crossover rule as
+``BSP_Exchanger._leg1_pack``); the layout decision is STATIC per leaf
+(size-based), so ``init``'s padding and the step's padding can't
+disagree. Cast wires (``bf16``/``fp16``) are rejected — XLA may fold
+their casts (exchanger module docstring), so they'd silently be ``ar``.
 """
 
 from __future__ import annotations
@@ -27,6 +54,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from theanompi_tpu.parallel.exchanger import (
+    _BLOCK_STRATEGIES as _BLOCK_FAMILIES,
+    _SR_STRATEGIES as _SR,
+    block_wire_kernels,
+)
 from theanompi_tpu.runtime.mesh import DATA_AXIS
 
 
@@ -38,13 +70,55 @@ class Zero1:
     """Wraps an ``ops.optim.Optimizer``; state entries that are
     param-shaped pytrees become flat dp-sharded arrays."""
 
-    def __init__(self, inner, world: int, axis: str = DATA_AXIS):
+    def __init__(self, inner, world: int, axis: str = DATA_AXIS,
+                 strategy: str = "ar"):
         if world < 2:
             raise ValueError("zero1 needs a dp axis of size >= 2")
+        if strategy != "ar" and strategy not in _BLOCK_FAMILIES:
+            raise ValueError(
+                f"zero1 wire strategy must be 'ar' or one of "
+                f"{_BLOCK_FAMILIES}, got {strategy!r} (cast wires are "
+                "foldable into plain fp32 — see exchanger docstring)"
+            )
         self.inner = inner
         self.world = int(world)
         self.axis = axis
+        self.strategy = strategy
+        self._pallas = strategy.startswith("pallas_")
         self._ptree = None  # params treedef, set at init
+
+    # -- compressed-wire layout (static per leaf) --------------------------
+    def _align(self) -> int:
+        from theanompi_tpu.parallel import quantize as Q
+
+        return Q.BLOCK * (32 if self._pallas else 1)
+
+    def _leaf_compressed(self, n: int) -> bool:
+        """Wire-cost crossover over BOTH zero legs: compress only when
+        the quantized reduce-scatter PLUS the always-fp16 param gather
+        (plus their fp32 block scales) move fewer bytes than the two
+        fp32 legs — zero's gather leg is fp16 even for int8 gradient
+        strategies, so the exchanger's single-leg rule would compress
+        leaves that net-lose here. STATIC (size-only), so init-time
+        padding and step-time packing always agree."""
+        if self.strategy == "ar":
+            return False
+        from theanompi_tpu.parallel import quantize as Q
+
+        npad_c = _pad_len(n, self.world * self._align())
+        payload_g = 2 if "fp16s" in self.strategy else 1
+        # grad leg + fp16 param leg + two sets of per-block fp32 scales
+        compressed = (payload_g + 2) * npad_c + 8 * (npad_c // Q.BLOCK)
+        plain = 8 * _pad_len(n, self.world)  # fp32 scatter + fp32 gather
+        return compressed < plain
+
+    def _npad(self, n: int) -> int:
+        if self._leaf_compressed(n):
+            return _pad_len(n, self.world * self._align())
+        return _pad_len(n, self.world)
+
+    def _quant_fns(self):
+        return block_wire_kernels(self.strategy)
 
     # -- host side ---------------------------------------------------------
     def init(self, params):
@@ -58,13 +132,22 @@ class Zero1:
             if k in shard_keys:
                 out[k] = jax.tree.map(
                     lambda a: jnp.pad(
-                        a.reshape(-1),
-                        (0, _pad_len(a.size, self.world) - a.size),
+                        a.reshape(-1), (0, self._npad(a.size) - a.size)
                     ),
                     v,
                 )
             else:
                 out[k] = v
+        if self.strategy != "ar":
+            # exact fp32 master-weight shard (module docstring): the
+            # lossy param gather serves compute only; updates apply here
+            out["zero_master"] = jax.tree.map(
+                lambda a: jnp.pad(
+                    a.astype(jnp.float32).reshape(-1),
+                    (0, self._npad(a.size) - a.size),
+                ),
+                params,
+            )
         return out
 
     def state_specs(self, state):
@@ -82,43 +165,99 @@ class Zero1:
         }
 
     # -- inside shard_map --------------------------------------------------
-    def update_shard(self, params, grads, state):
+    def update_shard(self, params, grads, state, rng=None):
         """One ZeRO step. ``params``/``grads`` are FULL (replicated /
         locally-complete unreduced grads); ``state``'s flat entries are
-        the LOCAL dp shard. Returns (full params, local-shard state)."""
+        the LOCAL dp shard. Returns (full params, local-shard state).
+        ``rng``: per-step key, required by (and only used for) the SR
+        gradient wires."""
         from theanompi_tpu.ops.optim import param_shaped_entries
 
+        if self.strategy in _SR and rng is None:
+            raise ValueError(
+                f"zero1 strategy '{self.strategy}' needs per-step "
+                "randomness: call update_shard(..., rng=key)"
+            )
         world, axis = self.world, self.axis
         flat_p, ptree = jax.tree.flatten(params)
         flat_g = ptree.flatten_up_to(grads)
         shard_entries = param_shaped_entries(state, ptree)
         flat_s = {k: ptree.flatten_up_to(state[k]) for k in shard_entries}
+        # the master shard is zero's own, not the inner optimizer's —
+        # inner optimizers rebuild their state from known keys and
+        # would silently drop it (the ef_wire hazard, base.py)
+        inner_entries = [k for k in shard_entries if k != "zero_master"]
+        has_master = "zero_master" in shard_entries
 
         new_p, new_s = [], {k: [] for k in shard_entries}
         for i, (p, g) in enumerate(zip(flat_p, flat_g)):
             n = p.size
-            npad = _pad_len(n, world)
+            npad = self._npad(n)
             nloc = npad // world
+            compressed = self._leaf_compressed(n)
             gf = jnp.pad(g.astype(jnp.float32).reshape(-1), (0, npad - n))
-            # reduce-scatter: my tile of the gradient SUM over dp
-            g_shard = (
-                lax.psum_scatter(gf, axis, scatter_dimension=0, tiled=True)
-                / world
-            )
-            idx = lax.axis_index(axis) * nloc
-            p_shard = lax.dynamic_slice_in_dim(
-                jnp.pad(p.reshape(-1), (0, npad - n)), idx, nloc
-            )
+            if compressed:
+                from theanompi_tpu.parallel import quantize as Q
+
+                gq, _, dq = self._quant_fns()
+                key = (
+                    jax.random.fold_in(rng, i)
+                    if (rng is not None and self.strategy in _SR)
+                    else None
+                )
+                # quantized reduce-scatter: all_to_all the per-peer
+                # shards of MY contribution, dequantize + mean in fp32
+                # on the owner (exchanger leg-1 structure — q payload +
+                # per-block fp32 scales on the wire, nothing else)
+                x = gf.reshape(world, nloc // Q.BLOCK, Q.BLOCK)
+                q, s = gq(x, key)
+                q_t = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                                     tiled=True)
+                s_t = lax.all_to_all(s, axis, split_axis=0, concat_axis=0,
+                                     tiled=True)
+                g_shard = (
+                    jnp.sum(dq(q_t, s_t), axis=0).reshape(-1) / world
+                )
+            else:
+                # reduce-scatter: my tile of the gradient SUM over dp
+                g_shard = (
+                    lax.psum_scatter(
+                        gf, axis, scatter_dimension=0, tiled=True
+                    )
+                    / world
+                )
+            if has_master:
+                # exact fp32 masters live in the sharded state; the
+                # replicated (lossy-gathered) params never feed back
+                p_shard = flat_s["zero_master"][i]
+            else:
+                idx = lax.axis_index(axis) * nloc
+                p_shard = lax.dynamic_slice_in_dim(
+                    jnp.pad(p.reshape(-1), (0, npad - n)), idx, nloc
+                )
             slice_state = {
                 k: v for k, v in state.items() if k not in shard_entries
             }
-            slice_state.update({k: flat_s[k][i] for k in shard_entries})
+            slice_state.update({k: flat_s[k][i] for k in inner_entries})
             p_new, s_new = self.inner.update(p_shard, g_shard, slice_state)
-            # all-gather the updated shards back to the full leaf
-            full = lax.all_gather(p_new, axis, axis=0, tiled=True)
+            if compressed:
+                from theanompi_tpu.parallel import quantize as Q
+
+                _, pq, dq = self._quant_fns()
+                # param all-gather on the block-fp16 wire (see module
+                # docstring: params always fp16s, never int8)
+                q2, s2 = pq(p_new.reshape(-1, Q.BLOCK).astype(jnp.float32))
+                q_all = lax.all_gather(q2, axis, axis=0)
+                s_all = lax.all_gather(s2, axis, axis=0)
+                full = dq(q_all, s_all).reshape(-1)
+            else:
+                # all-gather the updated shards back to the full leaf
+                full = lax.all_gather(p_new, axis, axis=0, tiled=True)
             new_p.append(full[:n].reshape(p.shape).astype(p.dtype))
-            for k in shard_entries:
+            for k in inner_entries:
                 new_s[k].append(s_new[k])
+            if has_master:
+                new_s["zero_master"].append(p_new.astype(jnp.float32))
         if flat_p:
             # scalar entries (lr, step) advance identically for every
             # leaf — take them once, from the last inner update
