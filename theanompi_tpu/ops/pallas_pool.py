@@ -10,11 +10,16 @@ slice relayouts (the r5 layout diagnosis in NOTES.md).
 
 This kernel runs the SAME shifted-mask math but entirely in VMEM per
 batch block: one HBM read of x, one of (y, dy) at output resolution,
-one HBM write of dx.  The kh·kw offset loop happens on values already
-resident in VMEM — cheap VPU shifts instead of HBM round-trips.  The
-AlexNet/GoogLeNet-era pools have small spatial extents (≤ 32×32), so a
-block holds the FULL spatial plane and no halo exchange is needed; the
-grid walks the batch axis.
+one HBM write of dx.  The per-offset gather (strided window sample)
+and scatter (interior-dilated placement) are expressed as matmuls with
+0/1 selection matrices built by iota in registers — the ``pallas_lrn``
+``_win_sum`` idiom — because cross-sublane reshapes/strided slices are
+exactly the data movements Mosaic lowers poorly; a (rows, h)×(h, oh)
+band matmul instead rides the MXU, and 0/1 × value sums a single term
+per output, so the selection is EXACT in fp32.  The AlexNet/GoogLeNet-
+era pools have small spatial extents (≤ 32×32), so a block holds the
+FULL spatial plane and no halo exchange is needed; the grid walks the
+batch axis.
 
 Tie semantics match ``_maxpool_mask_bwd``: the cotangent is split
 EQUALLY across tied window maxima (select-and-scatter routes to the
@@ -23,7 +28,7 @@ per-window cotangent mass and keeps the kernel order-free).  VALID
 padding only, like the mask path.
 
 On CPU (the test rig) the kernel runs in interpreter mode; numerical
-equivalence against the mask backward is covered by tests/test_ops.py.
+equivalence against the native backward is covered by tests/test_ops.py.
 Reference analog: the maxpool gradient op of the reference's
 ``theanompi/models/layers2.py`` pool layer (cuDNN there; SURVEY.md
 §3.5) — re-designed as a TPU kernel rather than translated.
@@ -39,26 +44,20 @@ from jax.experimental import pallas as pl
 
 # rows (= H·W positions) of the input plane per batch-block; the f32
 # working set per block is ~4 buffers × rows × C × 4B (x, acc, and the
-# transient dilated contribution) — 4096 rows × 96ch ≈ 6 MB, inside the
+# transient per-offset products) — 4096 rows × 96ch ≈ 6 MB, inside the
 # v5e VMEM budget with headroom for double buffering
 _ROW_BUDGET = 4096
 
 
-def _dilate(a: jnp.ndarray, axis: int, stride: int) -> jnp.ndarray:
-    """Interior-dilate ``a`` by ``stride`` along ``axis`` (insert
-    stride-1 zeros between elements) using stack+reshape — Mosaic
-    lowers these as VMEM data movement, no scatter needed."""
-    if stride == 1:
-        return a
-    parts = [a] + [jnp.zeros_like(a)] * (stride - 1)
-    stacked = jnp.stack(parts, axis=axis + 1)
-    shape = list(a.shape)
-    shape[axis] = a.shape[axis] * stride
-    dilated = stacked.reshape(shape)
-    # trailing stride-1 zeros exceed the interior-dilated span — drop
-    idx = [slice(None)] * a.ndim
-    idx[axis] = slice(0, a.shape[axis] * stride - (stride - 1))
-    return dilated[tuple(idx)]
+def _select_band(out_len: int, in_len: int, offset: int, stride: int,
+                 dtype=jnp.float32) -> jnp.ndarray:
+    """(out_len, in_len) 0/1 matrix with ``B[p, offset + p*stride] = 1``
+    — built by iota in registers (never touches HBM).  Right-applied it
+    GATHERS the strided window sample; its transpose SCATTERS values
+    back to the dilated+offset positions."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (out_len, in_len), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (out_len, in_len), 1)
+    return (cols == offset + rows * stride).astype(dtype)
 
 
 def _pool_bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, *, window, stride):
@@ -72,55 +71,51 @@ def _pool_bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, *, window, stride):
     span_h = (oh - 1) * sh + 1
     span_w = (ow - 1) * sw + 1
 
-    def strided_window(di, dj):
-        """x sample each window reads at offset (di, dj): (nb,oh,ow,c).
-
-        Static start + stack/reshape subsampling instead of a strided
-        slice — strides on the second-minor axes are a relayout Mosaic
-        handles poorly, while reshapes over full planes are free-ish."""
-        xs = jax.lax.slice(
-            x, (0, di, dj, 0), (nb, di + span_h, dj + span_w, c)
-        )
-        if sh > 1:
-            pad_h = oh * sh - span_h
-            xs = jnp.concatenate(
-                [xs, jnp.zeros((nb, pad_h, span_w, c), xs.dtype)], axis=1
-            )
-            xs = xs.reshape(nb, oh, sh, span_w, c)[:, :, 0]
-        if sw > 1:
-            pad_w = ow * sw - span_w
-            xs = jnp.concatenate(
-                [xs, jnp.zeros((nb, oh, pad_w, c), xs.dtype)], axis=2
-            )
-            xs = xs.reshape(nb, oh, ow, sw, c)[:, :, :, 0]
-        return xs
-
     offsets = [
         (di, dj)
         for di in range(kh)
         for dj in range(kw)
         if di + span_h <= h and dj + span_w <= w
     ]
+
+    # HIGHEST precision is LOAD-BEARING on every band matmul: the
+    # kernel's correctness hinges on bit-exact `window_sample == y`
+    # equality, and the MXU's default f32 matmul rounds operands
+    # through bf16 (see pallas_flash.py on exact-f32 multiplies) —
+    # a max with >8 mantissa bits would then match NO tap and its
+    # window's cotangent mass would silently vanish.
+    _EXACT = dict(
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    bands = {
+        (di, dj): (_select_band(oh, h, di, sh), _select_band(ow, w, dj, sw))
+        for di, dj in offsets
+    }
+
+    def window_sample(di, dj):
+        """x sample each window reads at offset (di, dj): (nb,oh,ow,c),
+        via two exact 0/1 band matmuls (gather = B_h · x · B_wᵀ)."""
+        bh, bw = bands[(di, dj)]
+        # contract H: (oh,h) × (nb,h,w,c) over h
+        xs = jnp.einsum("ph,nhwc->npwc", bh, x, **_EXACT)
+        # contract W: (ow,w) × (nb,oh,w,c) over w
+        return jnp.einsum("qw,npwc->npqc", bw, xs, **_EXACT)
+
     # pass 1 (VMEM-resident): ties per window, for the mass-conserving
     # equal split
     cnt = jnp.zeros(y.shape, jnp.float32)
     for di, dj in offsets:
-        cnt = cnt + (strided_window(di, dj) == y).astype(jnp.float32)
+        cnt = cnt + (window_sample(di, dj) == y).astype(jnp.float32)
     dyc = dy / cnt  # every window has >= 1 max
 
     acc = jnp.zeros(x.shape, jnp.float32)
     for di, dj in offsets:
-        contrib = jnp.where(strided_window(di, dj) == y, dyc, 0.0)
-        d = _dilate(_dilate(contrib, 1, sh), 2, sw)  # (nb,span_h,span_w,c)
-        acc = acc + jnp.pad(
-            d,
-            (
-                (0, 0),
-                (di, h - di - span_h),
-                (dj, w - dj - span_w),
-                (0, 0),
-            ),
-        )
+        contrib = jnp.where(window_sample(di, dj) == y, dyc, 0.0)
+        # scatter = the same bands transposed: Bᵀ_h · contrib · B_w
+        bh, bw = bands[(di, dj)]
+        up = jnp.einsum("ph,npqc->nhqc", bh, contrib, **_EXACT)
+        acc = acc + jnp.einsum("qw,nhqc->nhwc", bw, up, **_EXACT)
     dx_ref[...] = acc.astype(dx_ref.dtype)
 
 
