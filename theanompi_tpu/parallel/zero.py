@@ -86,6 +86,25 @@ class Zero1:
         self.strategy = strategy
         self._pallas = strategy.startswith("pallas_")
         self._ptree = None  # params treedef, set at init
+        if strategy in ("int8", "pallas_int8"):
+            # measured (docs/convergence/zero_compressed.json): the RN
+            # int8 gradient scatter converges but takes a transient
+            # mid-run excursion costing ~+25% epochs; SR's unbiased
+            # rounding or the fp16s tier reach the floor on the fp32
+            # budget. Warn, don't refuse — the tradeoff is the user's.
+            import warnings
+
+            fp16s_tier = "pallas_fp16s" if self._pallas else "fp16s"
+            warnings.warn(
+                f"zero1 strategy {strategy!r}: round-to-nearest int8 "
+                "gradients showed a transient convergence excursion in "
+                "the committed evidence (docs/convergence/"
+                "zero_compressed.json) — consider "
+                f"{strategy + '_sr'!r} or {fp16s_tier!r} for the "
+                "gradient leg",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     # -- compressed-wire layout (static per leaf) --------------------------
     def _align(self) -> int:
