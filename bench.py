@@ -379,6 +379,14 @@ _KNOBS_REHEARSAL = dict(
 
 
 def main():
+    if os.environ.get("THEANOMPI_BENCH_SERVE") == "1":
+        # serving-side bench (BENCH_serve schema: generated tokens/s +
+        # TTFT/TPOT percentiles under a Poisson workload) — one driver
+        # entry point, two benches; bench_serve.py owns the schema
+        import bench_serve
+
+        bench_serve.main()
+        return
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
     if CPU_REHEARSAL:
         print(

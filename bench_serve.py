@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Serving benchmark — prints ONE ``BENCH_serve`` JSON line.
+
+The training bench (bench.py) answers "how fast does a step train";
+this answers the serving-side questions: sustained generated tokens/s
+through the continuous-batching scheduler, and request latency (TTFT /
+TPOT, p50/p99) under a synthetic open-loop Poisson arrival process —
+the standard serving-bench shape (requests arrive on their own clock;
+a backed-up server cannot slow the arrivals down).
+
+Protocol:
+- ``TransformerLM`` at the flagship serve config (rehearsal shrinks it,
+  same code path — the bench.py CPU-rehearsal discipline, VERDICT r3
+  #2), fresh-initialized params (throughput does not depend on weight
+  values; loader round-trips are covered by tests/test_serving.py).
+- Arrivals: exponential inter-arrival gaps at ``arrival_rate_rps``,
+  prompt lengths uniform over the engine's bucket range, fixed
+  ``max_new_tokens``.
+- Drive loop: submit every request whose arrival time has passed, then
+  one scheduler tick; repeat until drained.  Wall-clock is real (the
+  engine really runs); arrival times are pre-drawn from a seeded RNG so
+  two runs see the same workload.
+
+Env: ``THEANOMPI_BENCH_CPU=1`` = CPU rehearsal (fake 8-device mesh,
+shrunk sizes); ``THEANOMPI_BENCH_SERVE_OUT`` = also write the JSON to a
+file (default: print only).  bench.py delegates here when
+``THEANOMPI_BENCH_SERVE=1`` so the driver's one entry point covers both
+benches.
+"""
+
+import json
+import os
+import sys
+import time
+
+CPU_REHEARSAL = os.environ.get("THEANOMPI_BENCH_CPU") == "1"
+if CPU_REHEARSAL:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from theanompi_tpu.cachedir import cpu_xla_flags
+
+    os.environ["XLA_FLAGS"] = cpu_xla_flags(os.environ.get("XLA_FLAGS", ""))
+
+import jax
+
+if CPU_REHEARSAL:
+    # the axon sitecustomize pre-imports jax; pin through the config API
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def emit(value: float, detail: dict, measured_now: bool) -> None:
+    """THE one JSON line — same schema discipline as bench.py."""
+    line = json.dumps(
+        {
+            "metric": "transformer_serve_tokens_per_sec",
+            "value": round(value, 2),
+            "unit": "generated tokens/sec",
+            "vs_baseline": 1.0,
+            "measured_now": measured_now,
+            "detail": detail,
+        }
+    )
+    print(line)
+    out = os.environ.get("THEANOMPI_BENCH_SERVE_OUT")
+    if out:
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(line + "\n")
+        os.replace(tmp, out)
+
+
+# every size that differs between the real bench and the CPU rehearsal
+_KNOBS_REAL = dict(
+    d_model=512, n_heads=8, n_layers=8, vocab_size=4096, seq_len=1024,
+    n_slots=8, max_len=1024, n_requests=64, arrival_rate_rps=16.0,
+    max_new_tokens=32, prompt_lo=16, prompt_hi=256,
+)
+_KNOBS_REHEARSAL = dict(
+    d_model=32, n_heads=4, n_layers=2, vocab_size=64, seq_len=64,
+    n_slots=2, max_len=64, n_requests=6, arrival_rate_rps=50.0,
+    max_new_tokens=4, prompt_lo=2, prompt_hi=8,
+)
+
+
+def main():
+    import numpy as np
+
+    knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
+    if not CPU_REHEARSAL and jax.default_backend() not in ("tpu",):
+        # same guard shape as bench.py: a dead tunnel silently falling
+        # back to 1 CPU device must not masquerade as a TPU number
+        emit(0.0, {"error": f"backend is {jax.default_backend()!r}, not "
+                   "tpu — set THEANOMPI_BENCH_CPU=1 for the rehearsal"},
+             measured_now=False)
+        sys.exit(1)
+
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.runtime.recorder import Recorder
+    from theanompi_tpu.serving import (
+        ContinuousBatchingScheduler, Request, ServingEngine, ServingMetrics,
+    )
+
+    cfg = dict(
+        seq_len=knobs["seq_len"], vocab_size=knobs["vocab_size"],
+        d_model=knobs["d_model"], n_heads=knobs["n_heads"],
+        n_layers=knobs["n_layers"], batch_size=1, n_synth_train=2,
+        n_synth_val=1, comm_probe=False, print_freq=10_000,
+    )
+    model = TransformerLM(config=cfg)
+    engine = ServingEngine(
+        model, n_slots=knobs["n_slots"], max_len=knobs["max_len"]
+    )
+    rec = Recorder(verbose=False)
+    metrics = ServingMetrics(recorder=rec)
+    sched = ContinuousBatchingScheduler(engine, metrics=metrics)
+
+    # seeded open-loop Poisson workload, pre-drawn
+    rng = np.random.RandomState(0)
+    n = knobs["n_requests"]
+    arrivals = np.cumsum(rng.exponential(
+        1.0 / knobs["arrival_rate_rps"], size=n
+    ))
+    prompts = [
+        rng.randint(0, knobs["vocab_size"],
+                    size=rng.randint(knobs["prompt_lo"],
+                                     knobs["prompt_hi"] + 1)).tolist()
+        for _ in range(n)
+    ]
+
+    # warm the compiles OUTSIDE the measured window (one prefill bucket
+    # per distinct bucket + the decode step), mirroring bench.py's
+    # warmup-exclusion protocol
+    warm = ContinuousBatchingScheduler(engine, metrics=None)
+    warm.submit(Request(id="warm", prompt=prompts[0],
+                        max_new_tokens=min(2, knobs["max_new_tokens"])))
+    warm.run()
+
+    t0 = time.perf_counter()
+    submitted = 0
+    while submitted < n or sched.queue or sched.n_active:
+        now = time.perf_counter() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            sched.submit(Request(
+                id=f"req{submitted}", prompt=prompts[submitted],
+                max_new_tokens=knobs["max_new_tokens"],
+            ))
+            submitted += 1
+        if sched.queue or sched.n_active:
+            sched.step()
+        elif submitted < n:
+            time.sleep(min(0.005, max(0.0, arrivals[submitted] - now)))
+    dt = time.perf_counter() - t0
+
+    summary = metrics.summary()
+    n_tokens = summary["n_tokens_out"]
+    detail = {
+        "chips": jax.device_count(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": {k: knobs[k] for k in
+                  ("d_model", "n_heads", "n_layers", "vocab_size")},
+        "n_slots": knobs["n_slots"],
+        "max_len": knobs["max_len"],
+        "buckets": list(engine.buckets),
+        "workload": {
+            "n_requests": n,
+            "arrival_rate_rps": knobs["arrival_rate_rps"],
+            "prompt_len_range": [knobs["prompt_lo"], knobs["prompt_hi"]],
+            "max_new_tokens": knobs["max_new_tokens"],
+            "distribution": "poisson(open-loop), seeded",
+        },
+        "wall_s": round(dt, 3),
+        "ttft_p50_s": round(summary["ttft_p50_s"], 4),
+        "ttft_p99_s": round(summary["ttft_p99_s"], 4),
+        "tpot_p50_s": round(summary["tpot_p50_s"], 4),
+        "tpot_p99_s": round(summary["tpot_p99_s"], 4),
+        "cpu_rehearsal": CPU_REHEARSAL,
+    }
+    emit(n_tokens / dt, detail, measured_now=True)
+
+
+if __name__ == "__main__":
+    main()

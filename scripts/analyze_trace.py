@@ -77,13 +77,16 @@ def main():
     # the step count (module executions) and the op sums accumulate once
     # per device. Normalize BOTH sides to one device — steps = the max
     # per-(pid,tid) module count (not the sum across tids), and ms sums
-    # divided by the number of ops threads that produced events — so
-    # ms/step stays device-count invariant and comparable to the pinned
-    # single-device r2 budget. NOT max per-op count for steps: loop
-    # bodies (grad_accum scans etc.) fire one op name many times/step.
+    # divided by the number of DEVICES (distinct pids) that produced ops
+    # events — so ms/step stays device-count invariant and comparable to
+    # the pinned single-device r2 budget. NOT max per-op count for
+    # steps: loop bodies (grad_accum scans etc.) fire one op name many
+    # times/step. NOT (pid,tid) ops-thread tuples for the divisor: a
+    # device exposing several ops threads (or idle ops tids emitting no
+    # events) would under/over-normalize (ADVICE r5 item 4).
     steps = (max(modules_per_tid.values()) if modules_per_tid else 0) or (
         max(cnt_per_tid.values()) if cnt_per_tid else 1)
-    n_dev = max(1, len(ops_tids_seen))
+    n_dev = max(1, len({pid for pid, _tid in ops_tids_seen}))
     norm = steps * n_dev
     print(f"{path}: {total:.1f} ms busy over ~{steps} steps"
           + (f" x {n_dev} devices" if n_dev > 1 else "")

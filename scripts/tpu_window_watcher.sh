@@ -11,16 +11,28 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=/tmp/tpu_window
 mkdir -p "$OUT"
-LOCK="$OUT/active.lock"
+LOCK="$OUT/active.lock.d"
 # single instance: two watchers racing a recovered tunnel would be the
-# exact two-concurrent-TPU-clients condition the lock exists to prevent
-if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK" 2>/dev/null)" 2>/dev/null; then
-  echo "watcher already running (pid $(cat "$LOCK")) — refusing to start"
-  exit 1
+# exact two-concurrent-TPU-clients condition the lock exists to prevent.
+# mkdir is the ATOMIC acquire (check-then-write raced: two watchers
+# started near-simultaneously could both pass a kill -0 test and run —
+# ADVICE r5 item 5); the pid file inside is only for liveness/reporting.
+acquire() { mkdir "$LOCK" 2>/dev/null && echo $$ > "$LOCK/pid"; }
+if ! acquire; then
+  holder=$(cat "$LOCK/pid" 2>/dev/null)
+  if [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; then
+    echo "watcher already running (pid $holder) — refusing to start"
+    exit 1
+  fi
+  # stale lock from a SIGKILL'd watcher: remove and re-race; only one
+  # contender's mkdir wins, the loser exits above or here
+  rm -rf "$LOCK"
+  if ! acquire; then
+    echo "lost the lock re-acquire race to pid $(cat "$LOCK/pid" 2>/dev/null) — refusing to start"
+    exit 1
+  fi
 fi
-rm -f "$LOCK"  # stale lock from a SIGKILL'd watcher
-echo $$ > "$LOCK"
-trap 'rm -f "$LOCK"' EXIT
+trap 'rm -rf "$LOCK"' EXIT
 
 log() { echo "[watcher $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/watcher.log"; }
 

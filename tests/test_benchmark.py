@@ -63,10 +63,12 @@ def test_bsp_worker_logs_comm_fraction(tmp_path):
 
 def test_bsp_worker_reprobes_comm_each_epoch(tmp_path):
     """r4 judge weak #6: the comm fraction drifts over a long run, so
-    the worker re-probes at epoch boundaries (cadence comm_probe_every,
-    default 1) — each re-probe row carries its epoch, the final
+    the worker re-probes at epoch boundaries (cadence comm_probe_every;
+    pinned to 1 here — the default is 5, per-epoch probing is overhead,
+    ADVICE r5 item 3) — each re-probe row carries its epoch, the final
     boundary is skipped, and the cached no-exchange step means the
-    re-probe re-TIMES rather than re-traces."""
+    re-probe re-TIMES (at a scaled-down step count) rather than
+    re-traces."""
     import json
 
     import theanompi_tpu
@@ -74,7 +76,8 @@ def test_bsp_worker_reprobes_comm_each_epoch(tmp_path):
     rule = theanompi_tpu.BSP()
     rule.init(
         devices=4,
-        model_config=dict(CFG, n_epochs=3, comm_probe=True),
+        model_config=dict(CFG, n_epochs=3, comm_probe=True,
+                          comm_probe_every=1),
         checkpoint_dir=str(tmp_path),
         val_freq=0,
     )

@@ -238,7 +238,29 @@ def test_worker_keep_last_prunes_async(tmp_path):
 
 # -- property-based round-trips (hypothesis) ---------------------------------
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ModuleNotFoundError:  # noqa: E402 — container without hypothesis:
+    # the property tests skip; the rest of the module still collects
+    import pytest as _pytest
+
+    class _StrategyStub:
+        """Chainable stand-in so module-level strategy expressions
+        (st.one_of(...).map(...) etc.) still evaluate."""
+
+        def __call__(self, *a, **k):
+            return self
+
+        def __getattr__(self, name):
+            return self
+
+    st = _StrategyStub()
+
+    def given(*a, **k):
+        return _pytest.mark.skip(reason="hypothesis not installed")
+
+    def settings(*a, **k):
+        return lambda f: f
 
 _scalars = st.one_of(
     st.booleans(),

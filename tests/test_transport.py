@@ -333,7 +333,29 @@ def test_compressed_mailbox_halves_param_bytes():
 
 # -- property: the fp16 wire cast is transparent within fp16 precision -------
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ModuleNotFoundError:  # noqa: E402 — container without hypothesis:
+    # the property tests skip; the rest of the module still collects
+    import pytest as _pytest
+
+    class _StrategyStub:
+        """Chainable stand-in so module-level strategy expressions
+        (st.one_of(...).map(...) etc.) still evaluate."""
+
+        def __call__(self, *a, **k):
+            return self
+
+        def __getattr__(self, name):
+            return self
+
+    st = _StrategyStub()
+
+    def given(*a, **k):
+        return _pytest.mark.skip(reason="hypothesis not installed")
+
+    def settings(*a, **k):
+        return lambda f: f
 
 _trees16 = st.dictionaries(
     st.text(min_size=1, max_size=4),

@@ -53,7 +53,72 @@ import getpass
 import hashlib
 import os
 import platform
+import subprocess
+import sys
 import tempfile
+
+_rendezvous_flag_ok = None  # per-process memo of the probe below
+
+
+def _jaxlib_version() -> str:
+    try:  # jaxlib.version is import-light (no backend machinery)
+        from jaxlib import version
+
+        return version.__version__
+    except Exception:
+        return "unknown"
+
+
+def rendezvous_flag_supported() -> bool:
+    """Whether the installed jaxlib's XLA parses CPU_RENDEZVOUS_FLAG.
+
+    XLA *aborts the process* (parse_flags_from_env.cc F-log) on an
+    unknown flag in XLA_FLAGS, so appending the rendezvous guard on a
+    jaxlib that predates it (observed: 0.4.x rejects it) kills every
+    CPU entrypoint at first backend init — the whole suite, bench
+    rehearsals, convergence runs.  There is no Python-level flag query,
+    so this probes once in a SUBPROCESS (the abort must not take this
+    process down) and caches the verdict in tempdir keyed by jaxlib
+    version + CPU fingerprint, making the probe a once-per-environment
+    cost instead of once per run."""
+    global _rendezvous_flag_ok
+    if _rendezvous_flag_ok is not None:
+        return _rendezvous_flag_ok
+    marker = os.path.join(
+        tempfile.gettempdir(),
+        f"theanompi_xla_flagprobe_{_jaxlib_version()}_{_cpu_fingerprint()}",
+    )
+    try:
+        with open(marker) as f:
+            _rendezvous_flag_ok = f.read().strip() == "1"
+        return _rendezvous_flag_ok
+    except OSError:
+        pass
+    code = (
+        "import os;"
+        f"os.environ['XLA_FLAGS']='{CPU_RENDEZVOUS_FLAG}';"
+        "import jax;"
+        "jax.config.update('jax_platforms','cpu');"
+        "jax.devices()"
+    )
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, timeout=240,
+            ).returncode == 0
+        )
+    except (subprocess.SubprocessError, OSError):
+        ok = False  # can't prove support -> don't risk the F-abort
+    _rendezvous_flag_ok = ok
+    try:
+        tmp = marker + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("1" if ok else "0")
+        os.replace(tmp, marker)
+    except OSError:
+        pass  # uncached probes re-run; never fail the caller
+    return ok
 
 
 def _cpu_fingerprint() -> str:
@@ -84,6 +149,22 @@ def cpu_cache_dir() -> str:
     )
 
 
+def legacy_jaxlib() -> bool:
+    """jaxlib < 0.5: the era before the modern ``jax.shard_map`` surface.
+    On these, re-loading a persistently-cached CPU executable SEGFAULTS
+    inside the compiled call (reproduced in this container with 0.4.36
+    on a FRESH cache dir: probe compiles the step, the post-probe
+    recompile deserializes the just-written entry, the next execution
+    dies) — so the persistent compile cache must stay off."""
+    try:
+        parts = tuple(
+            int(x) for x in _jaxlib_version().split(".")[:2]
+        )
+    except ValueError:
+        return False  # unparseable = assume modern
+    return parts < (0, 5)
+
+
 def configure_compile_cache(jax_mod, use_repo_cache: bool) -> str:
     """Apply the repo's ONE persistent-compile-cache policy and return
     the chosen dir. ``use_repo_cache=True`` = the committed ``.jax_cache``
@@ -91,7 +172,12 @@ def configure_compile_cache(jax_mod, use_repo_cache: bool) -> str:
     warm entries are what make the scarce bench window cheap);
     False = the per-host-fingerprint tempdir (everything CPU — see the
     module docstring for why foreign AOT entries are dangerous).
-    Takes the caller's ``jax`` module so this file stays import-light."""
+    Takes the caller's ``jax`` module so this file stays import-light.
+
+    No-op on a legacy jaxlib (:func:`legacy_jaxlib`): cached-executable
+    reloads segfault there, and cold compiles beat dead processes."""
+    if legacy_jaxlib():
+        return ""
     cache = (
         os.path.abspath(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -116,6 +202,12 @@ def cpu_xla_flags(existing: str = "", fake_devices=8) -> str:
         flags = (
             f"{flags} --xla_force_host_platform_device_count={fake_devices}"
         ).strip()
-    if "collective_call_terminate_timeout" not in flags:
+    if (
+        "collective_call_terminate_timeout" not in flags
+        and rendezvous_flag_supported()
+    ):
+        # version-gated: see rendezvous_flag_supported — an unknown flag
+        # in XLA_FLAGS is a process-killing F-abort, strictly worse than
+        # running without the rendezvous guard
         flags = f"{flags} {CPU_RENDEZVOUS_FLAG}".strip()
     return flags

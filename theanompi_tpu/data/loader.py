@@ -10,6 +10,14 @@ a process would force an extra copy through shared memory) pulls host
 batches from the provider, shards them onto the mesh with ``device_put``
 (async under JAX dispatch), and keeps ``depth`` batches in flight so the
 ICI/MXU step, not input, bounds iteration time.
+
+Legacy-jaxlib note: pre-``jax.shard_map`` jaxlibs (0.4.x) have a CPU
+client that SEGFAULTS when one thread runs ``device_put`` while another
+executes a compiled program — exactly this loader's steady state
+(observed killing the suite in this container's image). Under
+``runtime.jax_compat.LEGACY_JAX`` the loader degrades to synchronous
+in-line placement: same iterator contract, no thread, no prefetch
+overlap — correctness over throughput on the rigs that need it.
 """
 
 from __future__ import annotations
@@ -19,6 +27,8 @@ import threading
 from typing import Callable, Iterator, Optional
 
 import jax
+
+from theanompi_tpu.runtime import jax_compat
 
 
 class PrefetchLoader:
@@ -38,6 +48,13 @@ class PrefetchLoader:
         depth: int = 2,
     ):
         self._place = place
+        self._sync_it = None
+        if jax_compat.LEGACY_JAX:
+            # no worker thread: this jaxlib's CPU client is not safe
+            # against device_put concurrent with compiled execution
+            # (module docstring) — place batches in-line instead
+            self._sync_it = iter(batches)
+            return
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err: Optional[BaseException] = None
         self._thread = threading.Thread(
@@ -58,6 +75,8 @@ class PrefetchLoader:
         return self
 
     def __next__(self):
+        if self._sync_it is not None:
+            return self._place(next(self._sync_it))
         item = self._q.get()
         if item is self._SENTINEL:
             if self._err is not None:

@@ -475,12 +475,18 @@ class ImageNetData:
         crop_size: Optional[int] = None,
         mirror: bool = True,
         train_aug: bool = True,
+        mean_subtract: bool = True,
     ):
         self.batch_size = int(batch_size)
         self.image_size = image_size
         self.n_classes = n_classes
         self.crop_size = crop_size
         self.mirror = mirror
+        # False = ignore an img_mean.npy sidecar entirely (config
+        # ``mean_subtract``): lets a pre-sidecar checkpoint resume on a
+        # data dir that has since grown one without a silent input-
+        # distribution shift (ADVICE r5 item 2)
+        self.mean_subtract = bool(mean_subtract)
         # False = deliver raw full-size train images; the model augments
         # on device inside the jitted step (config device_aug=True)
         self.train_aug = train_aug
@@ -536,10 +542,27 @@ class ImageNetData:
         if not self.synthetic:
             mp = os.path.join(data_dir, "img_mean.npy")
             if os.path.isfile(mp):
-                m = np.load(mp)
-                self.img_mean_rgb = (
-                    m.reshape(-1, m.shape[-1]).mean(0).astype(np.float32)
-                )
+                if self.mean_subtract:
+                    m = np.load(mp)
+                    self.img_mean_rgb = (
+                        m.reshape(-1, m.shape[-1]).mean(0).astype(np.float32)
+                    )
+                    # say so ONCE at startup: the sidecar silently
+                    # changes the numerics of every delivered batch —
+                    # a resumed pre-sidecar run must be able to see the
+                    # shift in its log (ADVICE r5 item 2)
+                    print(
+                        f"[ImageNetData] applying per-channel mean from "
+                        f"{mp}: {self.img_mean_rgb.tolist()} "
+                        "(mean_subtract=False to disable)",
+                        flush=True,
+                    )
+                else:
+                    print(
+                        f"[ImageNetData] img_mean.npy present at {mp} but "
+                        "mean_subtract=False — NOT subtracting",
+                        flush=True,
+                    )
             lp = os.path.join(data_dir, "labels.json")
             if os.path.isfile(lp):
                 import json

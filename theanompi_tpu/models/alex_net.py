@@ -55,6 +55,7 @@ class AlexNet(TpuModel):
             mirror=bool(cfg.mirror),
             # device_aug: the jitted step augments; host ships raw images
             train_aug=not bool(cfg.get("device_aug", False)),
+            mean_subtract=bool(cfg.get("mean_subtract", True)),
         )
 
     def build_net(self):

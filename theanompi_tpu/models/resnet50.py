@@ -70,6 +70,7 @@ class ResNet50(TpuModel):
             n_classes=int(cfg.n_classes),
             n_synth_batches=int(cfg.n_synth_batches),
             seed=int(cfg.seed),
+            mean_subtract=bool(cfg.get("mean_subtract", True)),
         )
 
     def build_net(self):

@@ -426,8 +426,32 @@ class MaxPool(Layer):
         if self.grad_impl == "mask":
             return _maxpool_mask(x, self.window, self.stride, self.padding), state
         if self.grad_impl == "pallas":
-            from theanompi_tpu.ops.pallas_pool import maxpool_pallas
+            from theanompi_tpu.ops.pallas_pool import (
+                maxpool_pallas, plane_fits_vmem,
+            )
 
+            h, w = x.shape[1], x.shape[2]
+            if not plane_fits_vmem(h, w):
+                # the kernel's grid blocks over batch only — a plane
+                # past the VMEM row budget cannot be block-resident and
+                # Mosaic would fail to compile. Fall back to the native
+                # select-and-scatter backward rather than crash
+                # (ADVICE r5 item 1); warn once per layer instance.
+                if not getattr(self, "_pallas_fallback_warned", False):
+                    self._pallas_fallback_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        f"MaxPool grad_impl='pallas': {h}x{w} plane "
+                        "exceeds the kernel's VMEM row budget — falling "
+                        "back to the 'native' backward for this layer",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                return (
+                    _maxpool_fwd_raw(x, self.window, self.stride, self.padding),
+                    state,
+                )
             return maxpool_pallas(x, self.window, self.stride, self.padding), state
         return _maxpool_fwd_raw(x, self.window, self.stride, self.padding), state
 

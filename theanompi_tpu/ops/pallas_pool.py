@@ -119,10 +119,26 @@ def _pool_bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, *, window, stride):
     dx_ref[...] = acc.astype(dx_ref.dtype)
 
 
+def plane_fits_vmem(h: int, w: int) -> bool:
+    """Whether one (h, w) spatial plane fits the kernel's per-block VMEM
+    budget.  The grid walks the BATCH axis only, so even at nb=1 the
+    whole plane plus the fp32 accumulator must be VMEM-resident — past
+    the row budget Mosaic fails to compile with no fallback (ADVICE r5
+    item 1; in-repo pools are <= 32x32 and comfortably inside)."""
+    return h * w <= _ROW_BUDGET
+
+
 def maxpool_bwd(x, y, dy, window, stride) -> jnp.ndarray:
     """dx for a VALID max pool, via the batch-blocked Pallas kernel."""
     n, h, w, c = x.shape
     oh, ow = y.shape[1:3]
+    if not plane_fits_vmem(h, w):
+        raise ValueError(
+            f"maxpool_bwd: {h}x{w} spatial plane ({h * w} rows) exceeds "
+            f"the kernel's VMEM row budget ({_ROW_BUDGET}); the grid "
+            "blocks over batch only, so a plane this large cannot be "
+            "VMEM-resident — use grad_impl='native' for this pool"
+        )
     # clamp to n: without it a small batch pads UP to the row budget
     # (e.g. batch 4 on a 7x7 plane -> 83 rows, ~20x wasted work)
     nb = max(1, min(n, _ROW_BUDGET // (h * w)))

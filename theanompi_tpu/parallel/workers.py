@@ -128,6 +128,14 @@ class BSP_Worker:
 
             ckpt.prune(self.checkpoint_dir, self.keep_last)
 
+    # epoch-boundary re-probes are TIMING-ONLY refreshes of a drifting
+    # fraction: a third of the train-start probe's steps is plenty, and
+    # the default cadence is every 5 epochs, not 1 — per-epoch probing
+    # cost ~8 extra compiled steps + a host sync at EVERY boundary
+    # (ADVICE r5 item 3)
+    _REPROBE_STEPS = 2
+    _REPROBE_WARMUP = 1
+
     def _probe_comm(self, model, rec: Recorder, epoch=None) -> None:
         """Comm-fraction measurement: at train start AND (r4 judge weak
         #6) re-probed at epoch boundaries, since on a pod the fraction
@@ -137,17 +145,25 @@ class BSP_Worker:
         equivalent is a differenced measurement (step-with vs
         step-without exchange) logged as a record event. Gated by config
         ``comm_probe`` (default on; no-op on a 1-device data axis);
-        re-probe cadence via ``comm_probe_every`` (epochs, default 1;
+        re-probe cadence via ``comm_probe_every`` (epochs, default 5;
         0 = train-start only). The compiled no-exchange step is cached
         across probes, so a re-probe is two short timing windows, not
-        two retraces. Diagnostics only — a probe failure warns and
-        training proceeds."""
+        two retraces — and boundary re-probes run at _REPROBE_STEPS
+        (scaled down from the train-start window). Diagnostics only — a
+        probe failure warns and training proceeds."""
         if not bool(model.config.get("comm_probe", True)):
             return
         try:
             from theanompi_tpu.utils.benchmark import comm_fraction_probe
 
-            stats = comm_fraction_probe(model, cache=self._comm_probe_cache)
+            probe_kw = (
+                dict(n_steps=self._REPROBE_STEPS, warmup=self._REPROBE_WARMUP)
+                if epoch is not None
+                else {}
+            )
+            stats = comm_fraction_probe(
+                model, cache=self._comm_probe_cache, **probe_kw
+            )
             if stats.get("n_dp", 1) > 1:
                 if epoch is not None:
                     stats = {**stats, "epoch": epoch}
@@ -247,15 +263,17 @@ class BSP_Worker:
                         model.run_validation(count, rec)
                 rec.end_epoch(count, epoch)
                 self._log_memory(rec, f"epoch_{epoch + 1}")
-                # per-epoch comm re-probe (cadence: comm_probe_every
-                # epochs, 0 = train-start only); the final boundary is
+                # comm re-probe every comm_probe_every epochs (default
+                # 5 — per-epoch probing cost ~8 extra compiled steps and
+                # a host sync at every boundary, ADVICE r5 item 3;
+                # 0 = train-start only); the final boundary is
                 # skipped — nothing trains after it. Gated on a warm
                 # probe cache: on a crash-restart the train-start probe
                 # is skipped (current_epoch > 0), so boundary re-probes
                 # would re-pay its two compiles on every recovery —
                 # resume runs therefore re-probe only if a start probe
                 # cached its programs in THIS process.
-                probe_every = int(model.config.get("comm_probe_every", 1))
+                probe_every = int(model.config.get("comm_probe_every", 5))
                 if (
                     probe_every
                     and (epoch + 1) % probe_every == 0

@@ -1,0 +1,49 @@
+"""Version shims for the jax surface this framework targets.
+
+The codebase is written against the current stable jax API
+(``jax.shard_map`` with ``check_vma=``).  Some deployment containers
+pin an older jaxlib where that spelling doesn't exist yet
+(``jax.experimental.shard_map.shard_map`` with ``check_rep=``) — and
+where some newer XLA flags are unknown (see
+``cachedir.rendezvous_flag_supported``).  Rather than fork every call
+site, :func:`install` aliases the modern spelling onto the installed
+``jax`` module once, translating renamed kwargs.
+
+Installed from ``theanompi_tpu.runtime.__init__`` (every framework
+module imports through there) and from ``tests/conftest.py`` (tests
+call ``jax.shard_map`` directly).  Idempotent; a no-op on modern jax.
+"""
+
+from __future__ import annotations
+
+# True when the installed jax predates the modern surface (no
+# jax.shard_map before install() aliases it).  Beyond spelling, these
+# jaxlibs have a CPU client that is UNSAFE against concurrent
+# device_put / compiled execution from multiple threads (segfaults
+# observed in this container's image): the prefetch loader degrades to
+# synchronous placement (data/loader.py) and the in-process threaded
+# async rules' tests auto-skip (tests/conftest.py).
+LEGACY_JAX = False
+
+
+def install() -> None:
+    global LEGACY_JAX
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    LEGACY_JAX = True
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kwargs):
+        # modern name for the replication check; old API calls it
+        # check_rep (same meaning: verify out_specs against inferred
+        # per-output replication — every call site here disables it)
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+install()

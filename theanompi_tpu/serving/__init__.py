@@ -1,0 +1,37 @@
+"""theanompi_tpu.serving — TPU-native inference for the transformer LM.
+
+The training side of the train→serve gap is closed by the rest of the
+framework (BSP over a mesh, ZeRO, checkpoints); this package closes the
+serving side with the same sharded-parameter machinery:
+
+- ``engine``    — jit-compiled prefill + single-token KV-cache decode for
+  ``TransformerLM``, with a preallocated, length-bucketed cache laid out
+  on the model's own ``build_mesh()`` mesh.
+- ``scheduler`` — continuous batching: an admission queue feeding a fixed
+  set of decode slots, join-on-finish slot recycling, no recompiles as
+  requests come and go.
+- ``loader``    — restore a *training* checkpoint
+  (``utils/checkpoint.restore``) and re-lay the params into inference
+  sharding (reusing ``TransformerLM._build_param_specs``).
+- ``metrics``   — per-request TTFT / TPOT / throughput counters emitted
+  through ``runtime.recorder.Recorder.log_event`` so serving shares the
+  training observability pipeline.
+
+Bench entry point: ``bench_serve.py`` at the repo root (hooked from
+``bench.py`` via ``THEANOMPI_BENCH_SERVE=1``) produces the
+``BENCH_serve`` JSON under a synthetic Poisson workload.
+"""
+
+from theanompi_tpu.serving.engine import ServingEngine
+from theanompi_tpu.serving.loader import load_engine, restore_params_for_serving
+from theanompi_tpu.serving.metrics import ServingMetrics
+from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = [
+    "ServingEngine",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "ServingMetrics",
+    "load_engine",
+    "restore_params_for_serving",
+]

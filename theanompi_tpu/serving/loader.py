@@ -1,0 +1,89 @@
+"""Checkpoint → serving: restore training params into inference layout.
+
+A training checkpoint (``utils/checkpoint.save`` of
+``TpuModel.checkpoint_state()``) carries the full state pytree — params,
+optimizer moments, BN state, epoch, rng.  Serving needs exactly the
+params, laid out for *inference*: replicated over the serving mesh for
+plain data parallelism, or Megatron-sharded via the SAME
+``TransformerLM._build_param_specs`` tree training shards by when the
+serving mesh has a ``tp`` axis.  Optimizer state is deliberately
+dropped — a serving process holding Adam moments would waste 2× the
+param HBM.
+
+The serving mesh does NOT have to match the training mesh: checkpoints
+store full global arrays (``host_snapshot`` gathers), so a model trained
+dp=8 restores onto a dp=1, dp×tp, or any other serving topology —
+``_place_sharded_state`` re-lays the leaves per the target specs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from theanompi_tpu.runtime.mesh import replicate
+from theanompi_tpu.utils import checkpoint
+
+
+def restore_params_for_serving(model, path: str):
+    """Load ``path`` and install its params on ``model``'s mesh in
+    inference sharding.  Returns the placed params (also set on the
+    model).  Raises on a params-structure mismatch — a checkpoint from
+    a different architecture config must fail loudly, not serve noise."""
+    blob = checkpoint.restore(path)
+    if "params" not in blob:
+        raise ValueError(f"{path!r} is not a training checkpoint "
+                         "(no 'params' entry)")
+    if jax.tree.structure(blob["params"]) != jax.tree.structure(model.params):
+        raise ValueError(
+            f"checkpoint {path!r} has a different params structure than "
+            "the serving model — rebuild the model with the config the "
+            "checkpoint was trained with"
+        )
+    model.params = replicate(model.mesh, blob["params"])
+    if "net_state" in blob:
+        model.net_state = replicate(model.mesh, blob["net_state"])
+    # tp leaves move replicated → Megatron-sharded here (no-op for plain
+    # dp serving); same machinery training uses before compile_train
+    model._place_sharded_state()
+    return model.params
+
+
+def load_engine(
+    path: str,
+    config: Optional[dict] = None,
+    mesh=None,
+    n_slots: int = 4,
+    max_len: Optional[int] = None,
+    buckets=None,
+    model_cls=None,
+):
+    """One-call checkpoint → ready ``ServingEngine``.
+
+    ``config`` must describe the architecture the checkpoint was trained
+    with (d_model / n_heads / n_layers / vocab_size / seq_len); serving
+    topology (``tp``) may differ from training.  ``mesh`` defaults to
+    ``model_cls.build_mesh(config)`` — the same mesh builder training
+    rules use, so serving engages tp meshes from config alone."""
+    from theanompi_tpu.serving.engine import ServingEngine
+
+    if model_cls is None:
+        from theanompi_tpu.models.transformer import TransformerLM
+
+        model_cls = TransformerLM
+    cfg = dict(config or {})
+    # serving never touches the training data pipeline beyond the tiny
+    # synthetic defaults a model constructor builds; keep it minimal
+    cfg.setdefault("n_synth_train", 2)
+    cfg.setdefault("n_synth_val", 1)
+    cfg.setdefault("comm_probe", False)
+    model = (
+        model_cls(config=cfg, mesh=mesh)
+        if mesh is not None
+        else model_cls(config=cfg)
+    )
+    restore_params_for_serving(model, path)
+    return ServingEngine(
+        model, n_slots=n_slots, max_len=max_len, buckets=buckets
+    )

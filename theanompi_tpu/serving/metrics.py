@@ -1,0 +1,119 @@
+"""Serving observability: per-request TTFT / TPOT / throughput.
+
+Serving shares the training observability pipeline: every completed
+request is a ``Recorder.log_event('serve_request', ...)`` row and the
+aggregate a ``'serve_summary'`` row, so serving metrics land in the
+same JSONL record (and optional TensorBoard mirror) as train/val rows —
+one offline-plotting contract for both halves of the system.
+
+Definitions (industry-standard):
+
+- **TTFT** — time to first token: admission → first generated token
+  (queue wait + prefill).
+- **TPOT** — time per output token: mean inter-token gap AFTER the
+  first token (pure decode cadence).
+- **throughput** — generated tokens / wall seconds over the window.
+
+The clock is injectable so tests and the offline bench can drive a
+simulated timeline deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+def percentile(values: List[float], pct: float) -> float:
+    """Nearest-rank percentile (numpy-free at call sites that feed the
+    JSON line; deterministic on small samples)."""
+    if not values:
+        return float("nan")
+    v = sorted(values)
+    k = max(0, min(len(v) - 1, int(round(pct / 100.0 * (len(v) - 1)))))
+    return float(v[k])
+
+
+class ServingMetrics:
+    """Collects per-request latency rows; emits through a Recorder."""
+
+    def __init__(self, recorder=None, clock=time.perf_counter):
+        self.recorder = recorder
+        self.clock = clock
+        self._open: Dict[str, dict] = {}
+        self.rows: List[dict] = []
+
+    # ---- request lifecycle (scheduler hooks) -------------------------
+    def admitted(self, rid: str, n_prompt: int, t: Optional[float] = None):
+        self._open[rid] = {
+            "id": rid,
+            "n_prompt": int(n_prompt),
+            "t_admit": self.clock() if t is None else t,
+            "t_first": None,
+        }
+
+    def first_token(self, rid: str, t: Optional[float] = None):
+        row = self._open.get(rid)
+        if row is not None:
+            row["t_first"] = self.clock() if t is None else t
+
+    def finished(self, rid: str, n_out: int, t: Optional[float] = None):
+        row = self._open.pop(rid, None)
+        if row is None:
+            return
+        t = self.clock() if t is None else t
+        t_first = row["t_first"] if row["t_first"] is not None else t
+        ttft = t_first - row["t_admit"]
+        # inter-token cadence after the first token; single-token
+        # requests have no decode gap — report 0, not a 0/0
+        tpot = (t - t_first) / (n_out - 1) if n_out > 1 else 0.0
+        done = {
+            "id": row["id"],
+            "n_prompt": row["n_prompt"],
+            "n_out": int(n_out),
+            "ttft_s": float(ttft),
+            "tpot_s": float(tpot),
+            "t_admit": row["t_admit"],
+            "t_done": t,
+        }
+        self.rows.append(done)
+        if self.recorder is not None:
+            self.recorder.log_event(
+                "serve_request",
+                id=done["id"],
+                n_prompt=done["n_prompt"],
+                n_out=done["n_out"],
+                ttft_s=round(done["ttft_s"], 6),
+                tpot_s=round(done["tpot_s"], 6),
+            )
+
+    # ---- aggregate ---------------------------------------------------
+    def summary(self) -> dict:
+        """Window aggregate: request count, token throughput, TTFT/TPOT
+        p50/p99.  Logged as one ``serve_summary`` event."""
+        ttfts = [r["ttft_s"] for r in self.rows]
+        tpots = [r["tpot_s"] for r in self.rows if r["n_out"] > 1]
+        tokens = sum(r["n_out"] for r in self.rows)
+        if self.rows:
+            span = max(r["t_done"] for r in self.rows) - min(
+                r["t_admit"] for r in self.rows
+            )
+        else:
+            span = 0.0
+        out = {
+            "n_requests": len(self.rows),
+            "n_tokens_out": int(tokens),
+            "window_s": float(span),
+            "tokens_per_sec": (tokens / span) if span > 0 else 0.0,
+            "ttft_p50_s": percentile(ttfts, 50),
+            "ttft_p99_s": percentile(ttfts, 99),
+            "tpot_p50_s": percentile(tpots, 50),
+            "tpot_p99_s": percentile(tpots, 99),
+        }
+        if self.recorder is not None and self.rows:
+            self.recorder.log_event(
+                "serve_summary",
+                **{k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in out.items()},
+            )
+        return out

@@ -1,0 +1,175 @@
+"""Continuous batching over a fixed set of decode slots.
+
+The classic serving problem: requests arrive at arbitrary times with
+arbitrary prompt/output lengths, but the efficient decode program is one
+fixed-shape step over ``n_slots`` sequences.  Static batching would wait
+for a full batch and hold every finished sequence hostage until the
+longest one ends; continuous batching instead treats each slot as an
+independent lane — a request joins the moment a slot is free (its
+prefill runs between decode ticks) and leaves the moment it finishes,
+returning the slot to the pool.  The decode step never changes shape,
+so admission/retirement cause ZERO recompilation.
+
+Determinism contract (tested): every per-slot computation in the engine
+is independent across the slot axis, so a request's output under any
+interleaving equals its output under serial execution — continuous
+batching changes latency, never results.
+
+Greedy (argmax) sampling only, deliberately: the parity tests and the
+bench both need bit-reproducible outputs; stochastic sampling belongs in
+a later PR on top of the same logits.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class Request:
+    """One generation request."""
+
+    id: str
+    prompt: List[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    # filled by the scheduler
+    output: List[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        if len(self.prompt) < 1:
+            raise ValueError(f"request {self.id!r}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.id!r}: max_new_tokens must be >= 1"
+            )
+
+
+class _Slot:
+    __slots__ = ("request", "produced")
+
+    def __init__(self):
+        self.request: Optional[Request] = None
+        self.produced = 0  # tokens generated so far for the request
+
+
+class ContinuousBatchingScheduler:
+    """Admission queue + slot table driving one ``ServingEngine``.
+
+    ``step()`` is one serving tick: admit queued requests into free
+    slots (one prefill each), then one batched decode step for every
+    active slot.  ``run()`` loops until drained.  Completed requests
+    land in ``finished`` (id → token list) and are reported to
+    ``metrics`` when one is attached.
+    """
+
+    def __init__(self, engine, metrics=None, params=None,
+                 clock=time.perf_counter):
+        self.engine = engine
+        self.metrics = metrics
+        self.params = params if params is not None else engine.model.params
+        self.clock = clock
+        self.cache = engine.init_cache()
+        self.slots = [_Slot() for _ in range(engine.n_slots)]
+        self.queue: List[Request] = []
+        self.finished: Dict[str, List[int]] = {}
+        self._tokens = np.zeros((engine.n_slots,), np.int32)
+        self._active = np.zeros((engine.n_slots,), bool)
+
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> None:
+        total = len(request.prompt) + request.max_new_tokens
+        if total > self.engine.max_len:
+            raise ValueError(
+                f"request {request.id!r} needs {total} cache rows > "
+                f"max_len={self.engine.max_len}"
+            )
+        if self.metrics is not None:
+            self.metrics.admitted(request.id, len(request.prompt),
+                                  t=self.clock())
+        self.queue.append(request)
+
+    @property
+    def n_active(self) -> int:
+        return int(self._active.sum())
+
+    def _finish(self, i: int) -> None:
+        slot, req = self.slots[i], self.slots[i].request
+        self.finished[req.id] = req.output
+        if self.metrics is not None:
+            self.metrics.finished(req.id, len(req.output), t=self.clock())
+        slot.request = None
+        slot.produced = 0
+        self._active[i] = False
+
+    def _emit(self, i: int, token: int) -> bool:
+        """Append one generated token to slot i's request; True when the
+        request just finished (eos or budget)."""
+        slot = self.slots[i]
+        req = slot.request
+        req.output.append(token)
+        slot.produced += 1
+        if self.metrics is not None and slot.produced == 1:
+            self.metrics.first_token(req.id, t=self.clock())
+        return (
+            slot.produced >= req.max_new_tokens
+            or (req.eos_id is not None and token == req.eos_id)
+        )
+
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """One tick: admissions, then one decode step.  Returns the
+        number of tokens generated this tick."""
+        import jax.numpy as jnp
+
+        produced = 0
+        # 1) join-on-finish admission: every free slot takes the oldest
+        # queued request; its prefill yields the request's FIRST token
+        for i, slot in enumerate(self.slots):
+            if slot.request is not None or not self.queue:
+                continue
+            req = self.queue.pop(0)
+            slot.request = req
+            self.cache, logits = self.engine.prefill(
+                self.params, self.cache, i, req.prompt
+            )
+            self._active[i] = True
+            produced += 1
+            if self._emit(i, int(jnp.argmax(logits))):
+                self._finish(i)
+        # 2) one fixed-shape decode tick over the active slots
+        if self._active.any():
+            for i, slot in enumerate(self.slots):
+                # the token entering each active slot = its last output
+                self._tokens[i] = (
+                    slot.request.output[-1] if self._active[i] else 0
+                )
+            was_active = self._active.copy()
+            self.cache, logits = self.engine.decode_step(
+                self.params, self.cache, self._tokens, self._active
+            )
+            arg = np.asarray(jnp.argmax(logits, axis=-1))
+            for i in range(len(self.slots)):
+                if not was_active[i]:
+                    continue
+                produced += 1
+                if self._emit(i, int(arg[i])):
+                    self._finish(i)
+        return produced
+
+    def run(self, max_ticks: int = 100_000) -> Dict[str, List[int]]:
+        """Drive ``step()`` until queue and slots drain.  Returns
+        ``finished`` (id → generated tokens)."""
+        ticks = 0
+        while self.queue or self._active.any():
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"scheduler did not drain within {max_ticks} ticks"
+                )
+            self.step()
+        return self.finished

@@ -166,8 +166,9 @@ def comm_fraction_probe(
     """Exchange-cost measurement on an already-built model.
 
     The BSP worker runs this at train start — and, with
-    ``comm_probe_every`` (config, default 1), again at every epoch
-    boundary — so BSP records carry a calc-vs-exchange split over the
+    ``comm_probe_every`` (config, default 5), again at epoch
+    boundaries (with a scaled-down ``n_steps``) — so BSP records carry
+    a calc-vs-exchange split over the
     whole run, matching the reference recorder's per-window ``comm``
     column (upstream ``lib/recorder.py``; SURVEY.md §3.7) which a
     fused-XLA step otherwise hides; on a pod the comm fraction drifts
