@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Emit CI annotations from a graftlint run.
+
+``python -m theanompi_tpu.analysis --format json`` is the machine
+interface; this wrapper turns it into the ``::error file=…,line=…::``
+/ ``::warning`` workflow-command lines GitHub-style CI runners render
+as inline PR annotations, and exits with the analyzer's exit code so
+the job fails on new findings.
+
+Usage::
+
+    python scripts/graftlint_annotate.py            # analyze + annotate
+    python -m theanompi_tpu.analysis --format json | \
+        python scripts/graftlint_annotate.py --stdin   # annotate a saved run
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _load(argv):
+    if "--stdin" in argv:
+        return json.load(sys.stdin), 0
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import contextlib
+    import io
+
+    from theanompi_tpu.analysis.__main__ import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["--format", "json"])
+    return json.loads(buf.getvalue()), rc
+
+
+def _annotation(f: dict) -> str:
+    level = "error" if f.get("severity") == "error" else "warning"
+    # workflow-command syntax: properties already exclude newlines; the
+    # message must escape % CR LF per the spec
+    msg = f"[{f['rule']}] {f['message']}"
+    for raw, esc in (("%", "%25"), ("\r", "%0D"), ("\n", "%0A")):
+        msg = msg.replace(raw, esc)
+    return (
+        f"::{level} file={f['file']},line={f['line']},"
+        f"title=graftlint {f['rule']}::{msg}"
+    )
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    doc, rc = _load(argv)
+    for f in doc.get("findings", []):  # new findings only — baselined
+        print(_annotation(f))  # entries don't re-annotate every PR
+    for s in doc.get("unparseable_files", []):
+        print(f"::warning file={s}::graftlint could not parse this file")
+    c = doc.get("counts", {})
+    print(
+        f"graftlint: {c.get('new', '?')} new / {c.get('baselined', '?')} "
+        f"baselined finding(s), {c.get('stale_baseline_entries', '?')} "
+        "stale baseline entr(y/ies)",
+        file=sys.stderr,
+    )
+    return rc if not argv or "--stdin" not in argv else (
+        1 if doc.get("counts", {}).get("new") else 0
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+# graftlint fixture: seeded collective-order hazards (GL-C*).  Parsed
+# only, never executed.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def divergent_cond(x, pred):
+    # GL-C001: true branch psums, false branch does not — workers
+    # taking different branches deadlock
+    def yes(v):
+        return lax.psum(v, "dp")
+
+    def no(v):
+        return v
+
+    return lax.cond(pred, yes, no, x)
+
+
+def balanced_cond(x, pred):
+    # NOT a finding: both branches issue the same collective sequence
+    def yes(v):
+        return lax.psum(v * 2.0, "dp")
+
+    def no(v):
+        return lax.psum(v, "dp")
+
+    return lax.cond(pred, yes, no, x)
+
+
+def divergent_python_branch(x, use_comm):
+    # GL-C002: the arms issue different collective sequences and the
+    # test reads a parameter
+    if use_comm:
+        x = lax.psum(x, "dp")
+        x = lax.all_gather(x, "dp")
+    else:
+        x = x * 2.0
+    return x
+
+
+def reordered_python_branch(x, flip):
+    # GL-C002: same collectives, DIFFERENT order — still a deadlock
+    if flip:
+        x = lax.psum(x, "dp")
+        g = lax.all_gather(x, "dp")
+    else:
+        g = lax.all_gather(x, "dp")
+        x = lax.psum(x, "dp")
+    return x, g
+
+
+def collective_under_while(x):
+    # GL-C003: trip count is data-dependent; workers disagreeing on it
+    # issue different collective counts
+    def cond(carry):
+        return jnp.max(carry) > 1.0
+
+    def body(carry):
+        return lax.psum(carry, "dp") * 0.5
+
+    return lax.while_loop(cond, body, x)
+
+
+def static_config_branch_ok(x, *, _unused=None):
+    # NOT a finding: the test reads a module-level constant, not a
+    # parameter — trace-time constant, identical on every worker
+    if _AXIS is not None:
+        x = lax.psum(x, _AXIS)
+    return x
+
+
+_AXIS = "dp"

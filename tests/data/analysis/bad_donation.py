@@ -1,0 +1,64 @@
+# graftlint fixture: seeded donation hazards (GL-D*).  Parsed only,
+# never executed.
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _step(params, batch):
+    return jax.tree.map(lambda p: p - 0.1, params)
+
+
+_train = jax.jit(_step, donate_argnums=(0,))
+
+
+def read_after_donation(params, batch):
+    new_params = _train(params, batch)
+    # GL-D001: `params` was donated on the line above — this read may
+    # see reused memory
+    norm = jnp.sum(params["w"])
+    return new_params, norm
+
+
+def sanctioned_rebind(params, batch):
+    # NOT a finding: the donated binding is rebound by the call result
+    params = _train(params, batch)
+    return jnp.sum(params["w"])
+
+
+def aliased_donation(params, batch):
+    # GL-D002: one binding at two positions, one donated
+    return _train(params, params)
+
+
+def donated_to_thread(params, batch, q: "queue.Queue"):
+    # GL-D003: the writer thread reads `params` after the donating step
+    # below has invalidated it
+    q.put(params)
+    new_params = _train(params, batch)
+    return new_params
+
+
+def safe_snapshot_to_thread(params, batch, q: "queue.Queue"):
+    # NOT a finding: host copy (np.array) before handing off
+    q.put(jax.tree.map(np.array, params))
+    return _train(params, batch)
+
+
+def stale_view_snapshot(params):
+    # GL-D004: tree-mapped asarray is a zero-copy view on CPU
+    return jax.tree.map(np.asarray, params)
+
+
+def stale_view_snapshot_lambda(params):
+    # GL-D004: same hazard spelled as a lambda
+    return jax.tree.map(lambda x: np.asarray(x), params)
+
+
+def consumed_asarray_ok(params, w):
+    # NOT a finding: the view is consumed immediately by the multiply,
+    # which materializes a fresh array
+    return jax.tree.map(lambda x: np.asarray(x) * w, params)

@@ -1,0 +1,44 @@
+# graftlint fixture: seeded lock-order hazards (GL-L*).  Parsed only,
+# never executed.
+import threading
+
+
+class Exchanger:
+    def __init__(self):
+        self.state_lock = threading.Lock()
+        self.queue_lock = threading.Lock()
+        self.bus = Bus()
+
+    def push(self, item):
+        # state_lock -> queue_lock
+        with self.state_lock:
+            with self.queue_lock:
+                return item
+
+    def pull(self):
+        # GL-L001 with push(): queue_lock -> state_lock closes the cycle
+        with self.queue_lock:
+            with self.state_lock:
+                return None
+
+    def reenter(self):
+        # GL-L002: non-reentrant Lock acquired while already held
+        with self.state_lock:
+            with self.state_lock:
+                return None
+
+    def indirect(self):
+        # GL-L002 through the one-level call graph: deliver() acquires
+        # bus_lock, and Bus.deliver is resolvable because self.bus was
+        # constructed from a package class above
+        with self.bus.bus_lock:
+            self.bus.deliver(None)
+
+
+class Bus:
+    def __init__(self):
+        self.bus_lock = threading.Lock()
+
+    def deliver(self, item):
+        with self.bus_lock:
+            return item

@@ -1,0 +1,55 @@
+# graftlint fixture: seeded recompile hazards (GL-J*).  This file is
+# PARSED by tests/test_analysis.py, never imported or executed — each
+# construct below must trigger exactly the rule named in its comment.
+import jax
+import jax.numpy as jnp
+
+
+def rewrap_lambda_in_loop(xs):
+    out = []
+    for x in xs:
+        # GL-J001 (error): fresh lambda => fresh function object => a
+        # guaranteed recompile every iteration
+        f = jax.jit(lambda a: a * 2.0)
+        out.append(f(x))
+    return out
+
+
+def rewrap_named_in_loop(xs):
+    out = []
+    while xs:
+        # GL-J001 (warning): module-level fn re-wrapped per iteration
+        g = jax.jit(_double)
+        out.append(g(xs.pop()))
+    return out
+
+
+def _double(a):
+    return a * 2.0
+
+
+_sized = jax.jit(_double, static_argnums=(1,), static_argnames=("mode",))
+
+
+def call_with_unhashable_static(x):
+    # GL-J002: list display at a static_argnums position
+    y = _sized(x, [1, 2, 3])
+    # GL-J002: dict display for a static_argname
+    z = _sized(x, 4, mode={"fast": True})
+    return y, z
+
+
+@jax.jit
+def branch_on_shape(x):
+    # GL-J003: every distinct x.shape compiles a new executable
+    if x.shape[0] > 4:
+        return x[:4]
+    return x
+
+
+@jax.jit
+def branch_on_value(x, n):
+    # GL-J004: Python branch on a traced value
+    if n > 0:
+        return x * n
+    return x

@@ -1,0 +1,55 @@
+"""Tier-1 gate: graftlint must run clean on the shipped code.
+
+A non-baselined finding in ``theanompi_tpu/``, ``scripts/`` or the
+top-level entrypoints fails this test — the same contract as
+``python -m theanompi_tpu.analysis`` exiting non-zero.  Accepted
+findings live in ``.graftlint_baseline.json`` (regenerate with
+``--write-baseline`` after review); per-line opt-outs use
+``# graftlint: disable=GL-XXXX``.  The gate also keeps the baseline
+honest: stale entries (whose finding no longer occurs) fail too, so
+fixes retire their baseline entries in the same PR.
+"""
+
+import json
+
+from theanompi_tpu.analysis import (
+    analyze,
+    load_baseline,
+    split_by_baseline,
+)
+from theanompi_tpu.analysis.__main__ import main as cli_main
+
+
+def _fmt(findings):
+    return "\n".join(f.format_human() for f in findings)
+
+
+def test_repo_has_no_new_findings():
+    findings, skipped = analyze()
+    assert skipped == [], f"unparseable shipped files: {skipped}"
+    new, _matched, _stale = split_by_baseline(findings, load_baseline())
+    assert new == [], (
+        "graftlint found new hazards (fix them, suppress with "
+        "'# graftlint: disable=<rule>', or accept via "
+        "python -m theanompi_tpu.analysis --write-baseline):\n"
+        + _fmt(new)
+    )
+
+
+def test_baseline_has_no_stale_entries():
+    findings, _ = analyze()
+    _new, _matched, stale = split_by_baseline(findings, load_baseline())
+    assert stale == [], (
+        "baseline entries whose finding no longer occurs — regenerate "
+        "with python -m theanompi_tpu.analysis --write-baseline: "
+        + ", ".join(e.get("fingerprint", "?") for e in stale)
+    )
+
+
+def test_cli_json_runs_clean(capsys):
+    """The acceptance-criteria invocation: --format json, exit 0."""
+    rc = cli_main(["--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["counts"]["new"] == 0
+    assert doc["tool"] == "graftlint"

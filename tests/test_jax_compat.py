@@ -1,0 +1,143 @@
+"""Direct unit tests for runtime/jax_compat.py.
+
+Until now the shim was only exercised implicitly — through conftest's
+install() call and the legacy skip-guards.  These tests pin its three
+contracts directly, against BOTH module shapes (fake modern and fake
+legacy jax modules built in-test), so a modern-image migration that
+deletes the shim sees exactly what breaks:
+
+- on a legacy module (no ``jax.shard_map``), install() aliases the
+  experimental spelling onto ``jax`` and translates ``check_vma=`` to
+  ``check_rep=``;
+- on a modern module it is a no-op;
+- it is idempotent (a second call must not re-wrap);
+- and on the REAL interpreter, ``jax.shard_map(..., check_vma=False)``
+  works end-to-end whichever jaxlib is installed.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.runtime import jax_compat
+
+
+def _fake_jax_modules(modern: bool):
+    """A minimal jax module tree: `modern` controls whether
+    jax.shard_map already exists."""
+    jax_mod = types.ModuleType("jax")
+    exp_mod = types.ModuleType("jax.experimental")
+    sm_mod = types.ModuleType("jax.experimental.shard_map")
+    seen = {}
+
+    def legacy_shard_map(f, **kwargs):
+        seen["kwargs"] = dict(kwargs)
+
+        def call(*a, **k):
+            return ("legacy", f(*a, **k))
+
+        return call
+
+    sm_mod.shard_map = legacy_shard_map
+    exp_mod.shard_map = sm_mod
+    jax_mod.experimental = exp_mod
+    if modern:
+        def modern_shard_map(f, **kwargs):
+            seen["kwargs"] = dict(kwargs)
+            return lambda *a, **k: ("modern", f(*a, **k))
+
+        jax_mod.shard_map = modern_shard_map
+    return jax_mod, seen
+
+
+@pytest.fixture
+def fake_env(monkeypatch):
+    """Install fake jax modules into sys.modules and restore the
+    LEGACY_JAX global afterwards (the real container is legacy; other
+    tests read the flag)."""
+
+    def setup(modern: bool):
+        jax_mod, seen = _fake_jax_modules(modern)
+        monkeypatch.setitem(sys.modules, "jax", jax_mod)
+        monkeypatch.setitem(sys.modules, "jax.experimental", jax_mod.experimental)
+        monkeypatch.setitem(
+            sys.modules, "jax.experimental.shard_map",
+            jax_mod.experimental.shard_map,
+        )
+        monkeypatch.setattr(jax_compat, "LEGACY_JAX", jax_compat.LEGACY_JAX)
+        return jax_mod, seen
+
+    return setup
+
+
+def test_install_aliases_and_translates_on_legacy(fake_env):
+    jax_mod, seen = fake_env(modern=False)
+    jax_compat.install()
+    assert jax_compat.LEGACY_JAX is True
+    assert hasattr(jax_mod, "shard_map")
+    wrapped = jax_mod.shard_map(
+        lambda x: x + 1, mesh="m", in_specs=("i",), out_specs="o",
+        check_vma=False,
+    )
+    # modern kwarg renamed to the old API's spelling, others untouched
+    assert seen["kwargs"] == {
+        "mesh": "m", "in_specs": ("i",), "out_specs": "o",
+        "check_rep": False,
+    }
+    assert "check_vma" not in seen["kwargs"]
+    assert wrapped(41) == ("legacy", 42)
+
+
+def test_install_is_noop_on_modern(fake_env):
+    jax_mod, seen = fake_env(modern=True)
+    # fresh-import state (the fixture's monkeypatch restores the real
+    # container's flag afterwards)
+    jax_compat.LEGACY_JAX = False
+    before = jax_mod.shard_map
+    jax_compat.install()
+    assert jax_mod.shard_map is before  # untouched, not wrapped
+    assert jax_compat.LEGACY_JAX is False
+    jax_mod.shard_map(lambda x: x, mesh="m", check_vma=True)
+    # modern jax receives check_vma verbatim — no translation layer
+    assert seen["kwargs"]["check_vma"] is True
+
+
+def test_install_is_idempotent_on_legacy(fake_env):
+    jax_mod, _seen = fake_env(modern=False)
+    jax_compat.install()
+    shim = jax_mod.shard_map
+    jax_compat.install()  # second call must see shard_map and bail
+    assert jax_mod.shard_map is shim
+
+
+def test_real_interpreter_has_shard_map_installed():
+    """conftest imports runtime.jax_compat before any test runs, so the
+    modern spelling must exist whichever jaxlib is installed."""
+    import jax
+
+    assert hasattr(jax, "shard_map")
+    if jax_compat.LEGACY_JAX:
+        # on legacy rigs the attribute is the shim defined in install()
+        assert jax.shard_map.__module__ == "theanompi_tpu.runtime.jax_compat"
+
+
+def test_shard_map_check_vma_end_to_end():
+    """The call-site contract every framework module relies on:
+    jax.shard_map(..., check_vma=False) runs on this interpreter —
+    translation on legacy, passthrough on modern."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    f = jax.shard_map(
+        lambda x: x * 2.0,
+        mesh=mesh,
+        in_specs=(P(),),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = f(jnp.arange(4.0, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.arange(4.0) * 2.0)

@@ -113,6 +113,41 @@ def test_engine_rejects_unservable_configs():
         ServingEngine(_model(), max_len=128)  # > trained seq_len
 
 
+def test_bucket_validation_rejects_non_int_and_duplicates():
+    """Prefill buckets are compile-time shapes: construction must
+    refuse anything that isn't a sorted set of positive ints with a
+    clear error, instead of recompiling (or crashing) per request."""
+    from theanompi_tpu.serving.engine import _validate_buckets
+
+    # normalization: sorted tuple of ints, numpy ints accepted
+    assert _validate_buckets([64, 8, 16], 64) == (8, 16, 64)
+    assert _validate_buckets([np.int64(8), 16], 64) == (8, 16)
+    with pytest.raises(TypeError, match="recompile per request"):
+        _validate_buckets([8, 16.5], 64)
+    with pytest.raises(TypeError, match="bool"):
+        _validate_buckets([8, True], 64)
+    with pytest.raises(TypeError, match="iterable of ints"):
+        _validate_buckets(32, 64)
+    with pytest.raises(ValueError, match="duplicate"):
+        _validate_buckets([8, 8, 16], 64)
+    with pytest.raises(ValueError, match=">= 1"):
+        _validate_buckets([0, 8], 64)
+    with pytest.raises(ValueError, match="at least one"):
+        _validate_buckets([], 64)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        _validate_buckets([8, 128], 64)
+
+
+def test_engine_construction_rejects_bad_buckets():
+    with pytest.raises(TypeError, match="recompile per request"):
+        ServingEngine(_model(), n_slots=1, max_len=64, buckets=(8.0, 64))
+    with pytest.raises(ValueError, match="duplicate"):
+        ServingEngine(_model(), n_slots=1, max_len=64, buckets=(8, 8, 64))
+    # unsorted input is normalized, not refused
+    eng = ServingEngine(_model(), n_slots=1, max_len=64, buckets=(64, 8))
+    assert eng.buckets == (8, 64)
+
+
 def test_prompt_longer_than_buckets_is_refused():
     eng = ServingEngine(_model(), n_slots=1, max_len=64, buckets=(8,))
     with pytest.raises(ValueError, match="bucket"):

@@ -1,0 +1,63 @@
+"""graftlint — JAX-hazard static analysis for this codebase.
+
+Theano-MPI's correctness contract is that every worker issues the same
+exchange sequence in the same order (arXiv:1605.08325); embedding
+collectives in a compiled DAG turns a mis-ordered or conditionally
+skipped collective into a silent hang rather than an error
+(arXiv:1802.06949).  The JAX port inherits that failure class and adds
+its own: buffer-donation reuse, jit recompile storms, and cross-thread
+lock inversions in the host-level async transport.  None of these need
+hardware to detect — they are visible in the AST — so this package
+checks them at review time, on CPU, in CI.
+
+Four passes, each pure-stdlib (no jax import — the CLI must start fast
+and run on machines with no accelerator stack):
+
+- ``recompile``   (GL-J*): jit wrappers rebuilt per loop iteration,
+  unhashable values at static-arg positions, Python branches on traced
+  values or shapes inside traced code.
+- ``donation``    (GL-D*): reads of a donated binding after the
+  donating call, donation aliasing, donated buffers escaping to
+  background threads/queues without a host copy.
+- ``collectives`` (GL-C*): per-function collective sequences under
+  ``shard_map``/``jit`` that diverge across ``lax.cond`` branches or
+  data-dependent Python branches, and collectives under a
+  data-dependent ``lax.while_loop`` trip count.
+- ``lockorder``   (GL-L*): a whole-package lock-acquisition-graph
+  cycle detector (plus non-reentrant double-acquire) over the
+  ``threading.Lock``/``RLock``/``Condition`` population.
+
+Findings carry severity + ``file:line`` and are matched against a
+checked-in baseline (``.graftlint_baseline.json`` at the repo root) so
+pre-existing accepted findings don't block CI; new findings do.
+Inline suppression: ``# graftlint: disable=GL-XXXX`` (or a bare
+``# graftlint: disable``) on the flagged line or the line above.
+
+CLI::
+
+    python -m theanompi_tpu.analysis [--format json|human]
+    python -m theanompi_tpu.analysis --write-baseline   # accept current
+
+See ``docs/static_analysis.md`` for the workflow.
+"""
+
+from theanompi_tpu.analysis.findings import Finding, SEVERITIES
+from theanompi_tpu.analysis.engine import (
+    analyze,
+    default_targets,
+    load_baseline,
+    repo_root,
+    split_by_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "SEVERITIES",
+    "analyze",
+    "default_targets",
+    "load_baseline",
+    "repo_root",
+    "split_by_baseline",
+    "write_baseline",
+]

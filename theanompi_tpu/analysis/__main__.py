@@ -1,0 +1,115 @@
+"""CLI: ``python -m theanompi_tpu.analysis``.
+
+Exit codes: 0 = no non-baselined findings, 1 = new findings, 2 = usage
+or I/O error.  ``--format json`` emits one machine-readable document on
+stdout (the tier-1 gate and any CI annotate step consume this);
+``--format human`` (default) prints one line per finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from theanompi_tpu.analysis import engine
+from theanompi_tpu.analysis.findings import Finding
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m theanompi_tpu.analysis",
+        description=(
+            "graftlint: JAX-hazard static analysis (recompile, donation, "
+            "collective-order, lock-order)"
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to analyze (default: the shipped code — "
+        "theanompi_tpu/, scripts/, top-level *.py)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("human", "json"),
+        default="human",
+        dest="fmt",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file (default: <repo>/{engine.BASELINE_NAME})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: report every finding as new",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept the current findings: rewrite the baseline and exit 0",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        findings, skipped = engine.analyze(paths=args.paths or None)
+    except OSError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = engine.write_baseline(findings, args.baseline)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    baseline = (
+        {} if args.no_baseline else engine.load_baseline(args.baseline)
+    )
+    new, matched, stale = engine.split_by_baseline(findings, baseline)
+
+    if args.fmt == "json":
+        doc = {
+            "tool": "graftlint",
+            "version": 1,
+            "counts": {
+                "new": len(new),
+                "baselined": len(matched),
+                "stale_baseline_entries": len(stale),
+                "unparseable_files": len(skipped),
+            },
+            "findings": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in matched],
+            "stale_baseline_entries": stale,
+            "unparseable_files": skipped,
+        }
+        json.dump(doc, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print(f.format_human())
+        for f in matched:
+            print(f"{f.format_human()}  [baselined]")
+        for e in stale:
+            print(
+                f"note: stale baseline entry {e.get('rule')} "
+                f"{e.get('file')} ({e.get('fingerprint')}) — finding no "
+                "longer occurs; remove it with --write-baseline"
+            )
+        for s in skipped:
+            print(f"note: could not parse {s}")
+        print(
+            f"graftlint: {len(new)} new, {len(matched)} baselined, "
+            f"{len(stale)} stale baseline entr"
+            + ("y" if len(stale) == 1 else "ies")
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Pass 3 — collective issue-order hazards (GL-C*).
+
+Under SPMD every worker runs the same program; a collective completes
+only when *all* workers reach it in the same sequence.  The compiled
+DAG gives no error for a diverging sequence — the job hangs (the
+Theano-MPI ordering contract, arXiv:1605.08325, inherited verbatim by
+in-graph collectives, arXiv:1802.06949).  This pass extracts the
+per-function sequence of collective calls (``psum``/``ppermute``/
+``all_gather``/``all_to_all``/…) and flags the constructs that can make
+that sequence differ across workers:
+
+- GL-C001 ``cond-divergent-collectives``: ``lax.cond``/``lax.switch``
+  whose branch callables contain *different* collective sequences.  The
+  predicate is a traced value — under ``shard_map`` each worker
+  evaluates its own — so workers can take different branches and issue
+  different collectives: a silent hang.  (Identical sequences in every
+  branch are fine and common: the ring-attention ``visible``/identity
+  pair contains none.)
+- GL-C002 ``branch-divergent-collectives``: a Python ``if``/``else``
+  whose arms contain different collective sequences *and* whose test
+  reads a parameter of the enclosing function.  Trace-time config
+  branches (``if axes:`` on a closure constant) are identical on every
+  worker and do not report; a parameter-fed test is one
+  worker-dependent value away from divergence.
+- GL-C003 ``collective-under-while``: a collective inside a
+  ``lax.while_loop`` cond/body.  The trip count is data-dependent;
+  workers disagreeing on it issue different collective counts and hang.
+  (``lax.scan``/``fori_loop`` have static trip counts and are exempt.)
+
+The collective *sequence* is compared, not just the set — two branches
+that both psum then all_gather in different orders still deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import (
+    COLLECTIVES,
+    ParsedModule,
+    terminal_name,
+)
+
+PASS_ID = "collectives"
+
+
+def _is_collective_call(m: ParsedModule, node: ast.Call) -> Optional[str]:
+    term = terminal_name(node.func)
+    if term not in COLLECTIVES:
+        return None
+    resolved = m.imports.resolve(node.func)
+    if resolved is not None and not resolved.startswith("jax"):
+        # e.g. a local helper coincidentally named all_gather imported
+        # from elsewhere — only jax.lax.* (or unresolved attribute
+        # chains like `lax.psum` when lax is jax.lax) count
+        return None
+    return term
+
+
+def _sequence(m: ParsedModule, nodes) -> List[str]:
+    """Collective call names in source order under ``nodes`` (lexical —
+    a trace visits them in this order), not descending into nested
+    function definitions."""
+    out: List[tuple] = []
+
+    def walk(n):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(n, ast.Call):
+            name = _is_collective_call(m, n)
+            if name is not None:
+                out.append((n.lineno, n.col_offset, name))
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        walk(n)
+    return [name for (_, _, name) in sorted(out)]
+
+
+def _resolve_branch_body(m: ParsedModule, expr: ast.expr, at: ast.AST):
+    """AST subtree a lax.cond branch argument evaluates: a Lambda body,
+    a local def's body, else None (unresolvable → skip, don't guess).
+    Name lookup prefers the call's own enclosing function — two
+    different functions may each define a local ``visible`` (the ring
+    attention fwd/bwd pair does exactly this)."""
+    if isinstance(expr, ast.Lambda):
+        return [expr.body]
+    if isinstance(expr, ast.Name):
+        cands = [
+            fi
+            for fi in m.functions
+            if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and fi.node.name == expr.id
+        ]
+        if not cands:
+            return None
+        here = m.enclosing_function(at)
+        scope = here
+        while scope is not None:
+            local = [c for c in cands if c.parent is scope]
+            if local:
+                return local[0].node.body
+            scope = scope.parent
+        top = [c for c in cands if c.parent is None]
+        pick = top[0] if top else (cands[0] if len(cands) == 1 else None)
+        return pick.node.body if pick else None
+
+
+def _finding(m, rule, sev, node, msg) -> Finding:
+    return Finding(
+        rule=rule,
+        pass_id=PASS_ID,
+        severity=sev,
+        file=m.rel,
+        line=node.lineno,
+        symbol=m.symbol_for(node),
+        message=msg,
+        snippet=m.snippet(node.lineno),
+    )
+
+
+def _cond_divergence(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        term = terminal_name(node.func)
+        if term not in ("cond", "switch"):
+            continue
+        resolved = m.imports.resolve(node.func)
+        if resolved is not None and not resolved.startswith("jax"):
+            continue
+        # cond(pred, true_fn, false_fn, *ops) / switch(idx, branches, *ops)
+        branch_exprs: List[ast.expr] = []
+        if term == "cond":
+            branch_exprs = list(node.args[1:3])
+        else:
+            if len(node.args) >= 2 and isinstance(
+                node.args[1], (ast.List, ast.Tuple)
+            ):
+                branch_exprs = list(node.args[1].elts)
+        seqs = []
+        for b in branch_exprs:
+            body = _resolve_branch_body(m, b, node)
+            if body is None:
+                seqs = []
+                break
+            seqs.append(_sequence(m, body))
+        if len(seqs) >= 2 and any(s != seqs[0] for s in seqs[1:]):
+            pretty = " vs ".join(
+                "[" + ", ".join(s) + "]" for s in seqs
+            )
+            out.append(
+                _finding(
+                    m,
+                    "GL-C001",
+                    "error",
+                    node,
+                    f"lax.{term} branches issue different collective "
+                    f"sequences ({pretty}) — workers taking different "
+                    "branches deadlock; issue the same collectives on "
+                    "every path (mask values instead of skipping comms)",
+                )
+            )
+    return out
+
+
+def _test_reads_params(test: ast.expr, params: Set[str]) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in params:
+            return True
+    return False
+
+
+def _branch_divergence(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in m.functions:
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            continue
+        a = node.args
+        params = {
+            p.arg
+            for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            if p.arg not in ("self", "cls")
+        }
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.If):
+                continue
+            if m.enclosing_function(stmt) is not fi:
+                continue  # reported by the owning (nested) function
+            if not _test_reads_params(stmt.test, params):
+                continue
+            if_seq = _sequence(m, list(stmt.body))
+            else_seq = _sequence(m, list(stmt.orelse))
+            if if_seq != else_seq and (if_seq or else_seq):
+                out.append(
+                    _finding(
+                        m,
+                        "GL-C002",
+                        "warning",
+                        stmt,
+                        "collective sequence differs between the arms of a "
+                        f"parameter-dependent branch ([{', '.join(if_seq)}] "
+                        f"vs [{', '.join(else_seq)}]) — if the test can "
+                        "differ across workers this hangs; hoist the "
+                        "collectives out of the branch or make the test a "
+                        "trace-time constant",
+                    )
+                )
+    return out
+
+
+def _while_loop_collectives(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) != "while_loop":
+            continue
+        resolved = m.imports.resolve(node.func)
+        if resolved is not None and not resolved.startswith("jax"):
+            continue
+        for arg in node.args[:2]:  # cond_fun, body_fun
+            body = _resolve_branch_body(m, arg, node)
+            if body is None:
+                continue
+            seq = _sequence(m, body)
+            if seq:
+                out.append(
+                    _finding(
+                        m,
+                        "GL-C003",
+                        "warning",
+                        node,
+                        f"collective(s) [{', '.join(seq)}] inside a "
+                        "lax.while_loop — the trip count is data-dependent, "
+                        "so workers disagreeing on it issue different "
+                        "collective counts and hang; use a static-trip scan "
+                        "or hoist the collective out of the loop",
+                    )
+                )
+                break  # one report per while_loop
+    return out
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    out += _cond_divergence(m)
+    out += _branch_divergence(m)
+    out += _while_loop_collectives(m)
+    return out

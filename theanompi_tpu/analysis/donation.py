@@ -1,0 +1,419 @@
+"""Pass 2 — buffer-donation safety (GL-D*).
+
+``donate_argnums`` hands an input buffer to XLA for reuse: after the
+call, the Python binding still *looks* like an array but its device
+memory may already hold the output of the next step.  Reading it is not
+an error on every backend/version — it is garbage on some and
+``RuntimeError: invalid buffer`` on others, which is why this must be a
+lint and not a test.
+
+Within each module the pass collects donating wrap sites
+(``self.train_fn = jax.jit(step, donate_argnums=(0, 1, 2))`` and
+decorator forms), then scans each function's call sites through those
+bindings:
+
+- GL-D001 ``donated-read-after-call``: a binding passed at a donated
+  position is read later in the same function without being rebound in
+  between.  Rebinding through the call's own result
+  (``self.params, ... = self.train_fn(self.params, ...)``) is the
+  sanctioned pattern and does not report.
+- GL-D002 ``donation-alias``: one binding passed at two positions of
+  the same donating call, at least one donated — XLA may alias the
+  output into the donated buffer while the other position still reads
+  it.
+- GL-D003 ``donated-to-thread``: a binding that is donated somewhere in
+  the function is also handed to a background consumer
+  (``threading.Thread(args=...)``, ``queue.put``, executor
+  ``submit``) without a host copy.  The thread reads whenever the
+  scheduler lets it — i.e. *after* the donating step has reused the
+  memory (the hazard ``utils/checkpoint.py`` documents and defuses
+  with ``host_snapshot``).  References wrapped in a recognized copying
+  call (``host_snapshot``, ``np.array``, ``np.copy``,
+  ``jax.device_get``, ``copy.deepcopy``, ``_to_host``) are safe and
+  skipped.
+- GL-D004 ``asarray-snapshot``: ``jax.tree.map(np.asarray, tree)`` (or
+  a lambda that just returns ``np.asarray(leaf)``) used as a
+  "snapshot".  On CPU ``np.asarray`` of a jax array is a ZERO-COPY
+  view of the device buffer (verified on this container's jaxlib), so
+  if the source is later donated by a jitted step, the "snapshot"
+  silently reads reused memory — exactly the trap
+  ``utils/checkpoint.host_snapshot`` documents ("np.array, not
+  np.asarray").  ``np.asarray(x) * w`` and other immediately-consumed
+  forms materialize a fresh array and are not flagged.
+
+Scope is one function body with line-ordered reasoning — control flow
+inside the function is approximated by source order, and donation
+through helper methods in other modules is out of scope (documented in
+docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import (
+    JIT_NAMES,
+    ParsedModule,
+    attr_path,
+    find_jit_wraps,
+    terminal_name,
+)
+
+PASS_ID = "donation"
+
+# calls that produce a host copy — a reference inside these is safe
+_COPY_FUNCS = {
+    "host_snapshot",
+    "array",  # np.array
+    "copy",  # np.copy / copy.copy
+    "deepcopy",
+    "device_get",
+    "asnumpy",
+    "_to_host",
+}
+
+# sinks that hand a value to another thread
+_THREAD_SINKS = {"put", "put_nowait", "submit", "Thread", "start_soon"}
+
+
+def _is_copying_call(expr: ast.Call) -> bool:
+    """True for calls that materialize a host copy of their argument:
+    a direct copy function, or ``jax.tree.map(<copy-fn>, tree)`` /
+    ``tree.map(lambda x: np.array(x), tree)``."""
+    name = terminal_name(expr.func)
+    if name in _COPY_FUNCS:
+        return True
+    if name in ("map", "tree_map") and expr.args:
+        mapped = expr.args[0]
+        if terminal_name(mapped) in _COPY_FUNCS:
+            return True
+        if isinstance(mapped, ast.Lambda) and isinstance(
+            mapped.body, ast.Call
+        ):
+            return terminal_name(mapped.body.func) in _COPY_FUNCS
+    return False
+
+
+def _binding_key(expr: ast.expr) -> Optional[str]:
+    """Identity of an argument/assign target we can track: a bare name
+    (``cache``) or a short attribute path (``self.params``)."""
+    p = attr_path(expr)
+    if p is None:
+        return None
+    # subscripted/derived expressions are not trackable bindings
+    return p
+
+
+class _FnScan(ast.NodeVisitor):
+    """Collect per-function, in source order: donating calls, rebinds,
+    reads, and thread-sink references for tracked binding keys."""
+
+    def __init__(self, m: ParsedModule, donating: Dict[str, Set[int]]):
+        self.m = m
+        self.donating = donating
+        # binding -> list of (line, call_node, rebound_same_stmt)
+        self.donate_events: List[Tuple[int, str, ast.Call, bool]] = []
+        self.rebinds: Dict[str, List[int]] = {}
+        self.reads: Dict[str, List[Tuple[int, ast.AST]]] = {}
+        self.sink_refs: Dict[str, List[Tuple[int, str]]] = {}
+        self.alias_findings: List[Tuple[ast.Call, str]] = []
+        self._copy_depth = 0
+
+    # -- helpers --------------------------------------------------------
+    def _record_targets(self, target: ast.expr, line: int):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_targets(e, line)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_targets(target.value, line)
+            return
+        key = _binding_key(target)
+        if key is not None:
+            self.rebinds.setdefault(key, []).append(line)
+
+    # -- statements -----------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._record_targets(t, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_targets(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        self._record_targets(node.target, node.lineno)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_For(self, node: ast.For):
+        self._record_targets(node.target, node.lineno)
+        self.visit(node.iter)
+        for s in node.body + node.orelse:
+            self.visit(s)
+
+    def visit_withitem(self, node: ast.withitem):
+        if node.optional_vars is not None:
+            self._record_targets(node.optional_vars, node.context_expr.lineno)
+        self.visit(node.context_expr)
+
+    def visit_FunctionDef(self, node):  # nested defs: separate scope
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- expressions ----------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        name = terminal_name(node.func)
+        donated_positions = self.donating.get(name)
+        if donated_positions is not None:
+            seen: Dict[str, List[int]] = {}
+            donated_here: List[str] = []
+            for i, arg in enumerate(node.args):
+                key = _binding_key(arg)
+                if key is None:
+                    continue
+                seen.setdefault(key, []).append(i)
+                if i in donated_positions:
+                    donated_here.append(key)
+            for key, positions in seen.items():
+                if len(positions) > 1 and any(
+                    p in donated_positions for p in positions
+                ):
+                    self.alias_findings.append((node, key))
+            parent = self.m.parents.get(node)
+            rebound_same_stmt: Set[str] = set()
+            if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    parent.targets
+                    if isinstance(parent, ast.Assign)
+                    else [parent.target]
+                )
+                flat: List[str] = []
+
+                def _flat(t):
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        for e in t.elts:
+                            _flat(e)
+                    elif isinstance(t, ast.Starred):
+                        _flat(t.value)
+                    else:
+                        k = _binding_key(t)
+                        if k is not None:
+                            flat.append(k)
+
+                for t in targets:
+                    _flat(t)
+                rebound_same_stmt = set(flat)
+            for key in donated_here:
+                self.donate_events.append(
+                    (node.lineno, key, node, key in rebound_same_stmt)
+                )
+            # arguments of the donating call itself are legitimate reads
+            for arg in node.args + [k.value for k in node.keywords]:
+                self._scan_reads(arg, is_call_args=True)
+            return
+        # thread sinks
+        if name in _THREAD_SINKS:
+            refs: Set[str] = set()
+            exprs = list(node.args) + [k.value for k in node.keywords]
+            for e in exprs:
+                self._collect_refs(e, refs)
+            for key in refs:
+                self.sink_refs.setdefault(key, []).append(
+                    (node.lineno, name)
+                )
+        if _is_copying_call(node):
+            self._copy_depth += 1
+            self.generic_visit(node)
+            self._copy_depth -= 1
+            return
+        self.generic_visit(node)
+
+    def _collect_refs(self, expr: ast.expr, out: Set[str]):
+        """Binding keys referenced in ``expr``, skipping copy-wrapped
+        subtrees."""
+        if isinstance(expr, ast.Call):
+            if _is_copying_call(expr):
+                return
+            for e in list(expr.args) + [k.value for k in expr.keywords]:
+                self._collect_refs(e, out)
+            return
+        key = _binding_key(expr)
+        if key is not None:
+            out.add(key)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._collect_refs(child, out)
+
+    def _scan_reads(self, expr: ast.expr, is_call_args: bool = False):
+        pass  # reads are collected globally by visit_Name/visit_Attribute
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load) and self._copy_depth == 0:
+            key = _binding_key(node)
+            if key is not None:
+                self.reads.setdefault(key, []).append((node.lineno, node))
+                return  # don't double-count the inner Name
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load) and self._copy_depth == 0:
+            self.reads.setdefault(node.id, []).append((node.lineno, node))
+
+
+def _collect_donating_bindings(m: ParsedModule) -> Dict[str, Set[int]]:
+    """binding terminal name -> donated positional indices (call-site
+    positions; only jit-family wrappers donate)."""
+    out: Dict[str, Set[int]] = {}
+    for w in find_jit_wraps(m):
+        if w.wrapper not in JIT_NAMES:
+            continue
+        if not w.donate_argnums:
+            continue
+        if w.binding:
+            out.setdefault(w.binding, set()).update(w.donate_argnums)
+    return out
+
+
+def _finding(m, rule, sev, line, symbol, msg) -> Finding:
+    return Finding(
+        rule=rule,
+        pass_id=PASS_ID,
+        severity=sev,
+        file=m.rel,
+        line=line,
+        symbol=symbol,
+        message=msg,
+        snippet=m.snippet(line),
+    )
+
+
+_TREE_MAPS = {
+    "jax.tree.map",
+    "jax.tree_util.tree_map",
+    "jax.tree_map",
+}
+
+
+def _is_bare_asarray(m: ParsedModule, expr: ast.expr) -> bool:
+    """np.asarray itself, or a lambda whose body is exactly
+    ``np.asarray(param)`` — i.e. the view IS the mapped result."""
+    if terminal_name(expr) == "asarray":
+        resolved = m.imports.resolve(expr)
+        return resolved is None or resolved.endswith("asarray")
+    if isinstance(expr, ast.Lambda) and isinstance(expr.body, ast.Call):
+        body = expr.body
+        if terminal_name(body.func) == "asarray" and len(body.args) == 1:
+            arg = body.args[0]
+            params = {p.arg for p in expr.args.args}
+            return isinstance(arg, ast.Name) and arg.id in params
+    return False
+
+
+def _asarray_snapshots(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        resolved = m.imports.resolve(node.func)
+        path = attr_path(node.func) or ""
+        if resolved not in _TREE_MAPS and not path.endswith("tree.map"):
+            continue
+        if _is_bare_asarray(m, node.args[0]):
+            out.append(
+                _finding(
+                    m,
+                    "GL-D004",
+                    "warning",
+                    node.lineno,
+                    m.symbol_for(node),
+                    "tree-mapped np.asarray produces ZERO-COPY views of "
+                    "device buffers on CPU — if the source is later donated "
+                    "by a jitted step this 'snapshot' reads reused memory; "
+                    "use np.array (see utils/checkpoint.host_snapshot)",
+                )
+            )
+    return out
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = list(_asarray_snapshots(m))
+    donating = _collect_donating_bindings(m)
+    if not donating:
+        return out
+    for fi in m.functions:
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            continue
+        scan = _FnScan(m, donating)
+        for stmt in node.body:
+            scan.visit(stmt)
+        if not scan.donate_events and not scan.alias_findings:
+            continue
+        for call, key in scan.alias_findings:
+            out.append(
+                _finding(
+                    m,
+                    "GL-D002",
+                    "error",
+                    call.lineno,
+                    fi.qualname,
+                    f"binding {key!r} passed at multiple argument positions "
+                    "of a donating call while one of them is donated — XLA "
+                    "may reuse the buffer the other position still reads",
+                )
+            )
+        for line, key, call, rebound_same_stmt in scan.donate_events:
+            rebind_lines = sorted(scan.rebinds.get(key, []))
+            sink_hits = scan.sink_refs.get(key, [])
+            for sink_line, sink_name in sink_hits:
+                out.append(
+                    _finding(
+                        m,
+                        "GL-D003",
+                        "error",
+                        sink_line,
+                        fi.qualname,
+                        f"{key!r} is donated by a jitted call in this "
+                        f"function (line {line}) and also handed to "
+                        f"background consumer {sink_name!r} — the thread "
+                        "can read the buffer after donation invalidates "
+                        "it; snapshot to host first (host_snapshot / "
+                        "np.array)",
+                    )
+                )
+            if rebound_same_stmt:
+                continue  # out = f(x); x rebound by the same statement
+            later_reads = [
+                (l, n)
+                for (l, n) in scan.reads.get(key, [])
+                if l > line
+            ]
+            for read_line, _n in later_reads:
+                # a rebind strictly after the call and at-or-before the
+                # read makes the read safe
+                if any(line < rb <= read_line for rb in rebind_lines):
+                    continue
+                out.append(
+                    _finding(
+                        m,
+                        "GL-D001",
+                        "error",
+                        read_line,
+                        fi.qualname,
+                        f"read of {key!r} after it was donated to a jitted "
+                        f"call on line {line} with no rebind in between — "
+                        "the buffer may already be reused; rebind from the "
+                        "call's result or copy to host before the call",
+                    )
+                )
+                break  # one report per donation event is enough
+    return out

@@ -1,0 +1,181 @@
+"""graftlint driver: file discovery, pass execution, suppressions,
+baseline matching.
+
+The default target set is the shipped code — the ``theanompi_tpu``
+package, ``scripts/``, and the top-level entrypoints — NOT ``tests/``:
+the fixture corpus under ``tests/data/analysis/`` is deliberately-bad
+code every pass must fire on, and linting it would poison the gate.
+
+Suppression is per-line: ``# graftlint: disable=GL-D001`` (comma list
+allowed) or a bare ``# graftlint: disable`` on the finding's line or
+the line above.  The baseline (``.graftlint_baseline.json``) carries
+fingerprints of accepted findings; ``split_by_baseline`` partitions a
+run into (new, baselined, stale-baseline-entries) so CI fails only on
+*new* findings while stale entries stay visible for cleanup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from theanompi_tpu.analysis import collectives, donation, locks, recompile
+from theanompi_tpu.analysis.findings import Finding, sort_key
+from theanompi_tpu.analysis.source import ParsedModule, parse_module
+
+BASELINE_NAME = ".graftlint_baseline.json"
+
+_PER_MODULE_PASSES = (recompile, donation, collectives)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\-\s]+))?"
+)
+
+
+def repo_root() -> str:
+    """The repository root: parent of the ``theanompi_tpu`` package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_targets(root: Optional[str] = None) -> List[str]:
+    root = root or repo_root()
+    out: List[str] = []
+    for sub in ("theanompi_tpu", "scripts"):
+        d = os.path.join(root, sub)
+        if os.path.isdir(d):
+            out.append(d)
+    for f in sorted(os.listdir(root)):
+        if f.endswith(".py"):
+            out.append(os.path.join(root, f))
+    return out
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    seen = []
+    seen_set = set()
+    for p in paths:
+        if os.path.isfile(p):
+            cand = [p] if p.endswith(".py") else []
+        else:
+            cand = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                ]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        cand.append(os.path.join(dirpath, f))
+        for c in cand:
+            c = os.path.abspath(c)
+            if c not in seen_set:
+                seen_set.add(c)
+                seen.append(c)
+    return seen
+
+
+def _suppressed_rules(m: ParsedModule, line: int) -> Optional[set]:
+    """Rules disabled at ``line`` (this line or the one above); None
+    when nothing is suppressed, empty set meaning 'all rules'."""
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(m.lines):
+            match = _SUPPRESS_RE.search(m.lines[ln - 1])
+            if match:
+                rules = match.group("rules")
+                if rules is None:
+                    return set()
+                return {r.strip() for r in rules.split(",") if r.strip()}
+    return None
+
+
+def analyze(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+) -> Tuple[List[Finding], List[str]]:
+    """Run all four passes.  Returns (findings, unparseable-files)."""
+    root = root or repo_root()
+    files = _iter_py_files(paths if paths else default_targets(root))
+    modules: List[ParsedModule] = []
+    skipped: List[str] = []
+    for f in files:
+        m = parse_module(f, root)
+        if m is None:
+            skipped.append(os.path.relpath(f, root).replace(os.sep, "/"))
+        else:
+            modules.append(m)
+    findings: List[Finding] = []
+    by_rel = {m.rel: m for m in modules}
+    for m in modules:
+        for p in _PER_MODULE_PASSES:
+            findings.extend(p.run(m))
+    findings.extend(locks.run_project(modules))
+
+    kept: List[Finding] = []
+    for f in findings:
+        m = by_rel.get(f.file)
+        if m is not None:
+            rules = _suppressed_rules(m, f.line)
+            if rules is not None and (not rules or f.rule in rules):
+                continue
+        kept.append(f)
+    kept.sort(key=sort_key)
+    return kept, skipped
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), BASELINE_NAME)
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    """fingerprint -> baseline entry; empty when the file is absent."""
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    return {e["fingerprint"]: e for e in doc.get("findings", [])}
+
+
+def write_baseline(
+    findings: Sequence[Finding], path: Optional[str] = None
+) -> str:
+    path = path or baseline_path()
+    doc = {
+        "tool": "graftlint",
+        "version": 1,
+        "note": (
+            "Accepted pre-existing findings. Entries match by fingerprint "
+            "(rule|file|symbol|snippet — line numbers excluded so edits "
+            "elsewhere in a file don't invalidate them). Regenerate with: "
+            "python -m theanompi_tpu.analysis --write-baseline"
+        ),
+        "findings": [f.to_json() for f in sorted(findings, key=sort_key)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def split_by_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(new, baselined, stale): stale = baseline entries whose finding
+    no longer occurs (candidates for removal, never a failure)."""
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    hit = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            matched.append(f)
+            hit.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [e for fp, e in baseline.items() if fp not in hit]
+    return new, matched, stale

@@ -1,0 +1,61 @@
+"""The graftlint findings model.
+
+A ``Finding`` is one located hazard: rule id, owning pass, severity,
+``file:line``, the enclosing symbol, a human message, and the stripped
+source line it anchors to.  The *fingerprint* deliberately excludes the
+line number — baselines must survive unrelated edits shifting code up
+and down a file — and hashes (rule, file, symbol, snippet) instead,
+which is stable until the flagged code itself changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Dict
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # e.g. "GL-D001"
+    pass_id: str  # "recompile" | "donation" | "collectives" | "lockorder"
+    severity: str  # member of SEVERITIES
+    file: str  # repo-relative posix path
+    line: int  # 1-based
+    symbol: str  # enclosing function qualname, or "<module>"
+    message: str
+    snippet: str = ""  # stripped source line (fingerprint input)
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    @property
+    def fingerprint(self) -> str:
+        blob = "|".join((self.rule, self.file, self.symbol, self.snippet))
+        return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "fingerprint": self.fingerprint,
+            "rule": self.rule,
+            "pass": self.pass_id,
+            "severity": self.severity,
+            "file": self.file,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+    def format_human(self) -> str:
+        return (
+            f"{self.file}:{self.line}: {self.severity}: "
+            f"[{self.rule}] {self.message}  (in {self.symbol})"
+        )
+
+
+def sort_key(f: Finding):
+    return (f.file, f.line, f.rule, f.symbol)

@@ -1,0 +1,464 @@
+"""Pass 4 — whole-package lock-order analysis (GL-L*).
+
+The host layer of this codebase is deliberately threaded: the async
+rules drive worker threads, the TCP transport runs listener/receiver
+threads, the async checkpointer a writer thread.  A lock-order
+inversion between any two of them is a rare-interleaving deadlock that
+no unit test reliably reproduces — but the *acquisition graph* is
+static.
+
+The pass runs over every module at once:
+
+1. **Lock population**: every ``threading.Lock/RLock/Condition/
+   Semaphore`` construction, identified by where it lives —
+   ``Class.attr`` for ``self.x = threading.Lock()``, ``module.x`` for
+   module globals, ``module.func.x`` for function locals.
+2. **Acquisition sites**: ``with <lock>`` statements (the codebase
+   idiom; bare ``.acquire()`` is not tracked).  ``self.x`` resolves
+   against the enclosing class first; ``other.x`` resolves when the
+   attribute name maps to exactly one lock-owning class in the
+   package (``conn.lock`` → ``_OutConn.lock``); ambiguous names are
+   skipped rather than guessed.
+3. **Edges**: lock A → lock B when B is acquired lexically inside a
+   ``with A`` — plus one call-graph level: a call made while holding A
+   to a package function whose body acquires B.  Callees resolve only
+   through *known receivers*: ``self.meth()`` (method of the enclosing
+   class, falling back to a package-unique method name — the receiver
+   is provably a package object), ``self.attr.meth()`` / ``var.meth()``
+   where the attr/var was assigned from a package-class constructor,
+   and bare ``fn()`` for module-level functions.  A ``.close()`` on a
+   socket therefore never counts as ``TcpMailbox.close``.
+4. **Reports**:
+   - GL-L001 ``lock-order-cycle`` (error): a cycle in the acquisition
+     graph, reported once per cycle with every contributing site.
+   - GL-L002 ``double-acquire`` (error): acquiring a non-reentrant
+     ``threading.Lock`` that is already held (directly or through the
+     one-level call graph) — self-deadlock, not just a risk.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import (
+    LOCK_FACTORIES,
+    FunctionInfo,
+    ParsedModule,
+    attr_path,
+    terminal_name,
+)
+
+PASS_ID = "lockorder"
+
+
+@dataclass(frozen=True)
+class LockDef:
+    lock_id: str  # "transport._OutConn.lock" / "mod.var" / "mod.fn.var"
+    kind: str  # "lock" | "rlock" | "condition" | "semaphore"
+    attr: Optional[str]  # attribute name when instance-attached
+    cls: Optional[str]  # owning class when instance-attached
+    module: str
+    line: int
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    file: str
+    line: int
+    via_call: Optional[str]  # callee qualname when interprocedural
+
+
+def _module_tag(m: ParsedModule) -> str:
+    base = m.rel.rsplit("/", 1)[-1]
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _collect_locks(modules: Sequence[ParsedModule]) -> List[LockDef]:
+    defs: List[LockDef] = []
+    for m in modules:
+        tag = _module_tag(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            resolved = m.imports.resolve(node.value.func)
+            if resolved not in LOCK_FACTORIES:
+                continue
+            kind = LOCK_FACTORIES[resolved]
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    cls = m.enclosing_class(node)
+                    if cls is None:
+                        continue
+                    defs.append(
+                        LockDef(
+                            lock_id=f"{tag}.{cls}.{target.attr}",
+                            kind=kind,
+                            attr=target.attr,
+                            cls=cls,
+                            module=tag,
+                            line=node.lineno,
+                        )
+                    )
+                elif isinstance(target, ast.Name):
+                    fi = m.enclosing_function(node)
+                    scope = f"{tag}.{fi.qualname}" if fi else tag
+                    defs.append(
+                        LockDef(
+                            lock_id=f"{scope}.{target.id}",
+                            kind=kind,
+                            attr=None,
+                            cls=None,
+                            module=tag,
+                            line=node.lineno,
+                        )
+                    )
+    return defs
+
+
+class _Resolver:
+    """Map a `with <expr>` context expression to a LockDef id."""
+
+    def __init__(self, defs: List[LockDef]):
+        self.defs = defs
+        self.by_attr: Dict[str, List[LockDef]] = {}
+        self.by_class_attr: Dict[Tuple[str, str], LockDef] = {}
+        self.by_scoped_name: Dict[str, LockDef] = {}
+        for d in defs:
+            if d.attr is not None:
+                self.by_attr.setdefault(d.attr, []).append(d)
+                self.by_class_attr[(d.cls, d.attr)] = d
+            else:
+                self.by_scoped_name[d.lock_id] = d
+
+    def resolve(
+        self,
+        m: ParsedModule,
+        expr: ast.expr,
+        enclosing: Optional[FunctionInfo],
+    ) -> Optional[LockDef]:
+        path = attr_path(expr)
+        if path is None:
+            return None
+        parts = path.split(".")
+        tag = _module_tag(m)
+        if len(parts) == 1:
+            # bare name: function-local (walk enclosing scopes), then
+            # module-global
+            fi = enclosing
+            while fi is not None:
+                d = self.by_scoped_name.get(f"{tag}.{fi.qualname}.{parts[0]}")
+                if d is not None:
+                    return d
+                fi = fi.parent
+            return self.by_scoped_name.get(f"{tag}.{parts[0]}")
+        attr = parts[-1]
+        if parts[0] == "self" and len(parts) == 2 and enclosing is not None:
+            cls = enclosing.class_name
+            if cls is not None:
+                d = self.by_class_attr.get((cls, attr))
+                if d is not None:
+                    return d
+        # other.attr / self.server._lock: unique attribute name across
+        # the package resolves; ambiguity skips (never guess)
+        cands = self.by_attr.get(attr, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+
+class _TypeMap:
+    """Receiver-type heuristics for one-level call resolution.
+
+    Tracks ``self.attr = PackageClass(...)`` per class and
+    ``var = PackageClass(...)`` per function, so a method call is only
+    attributed to a package function when the receiver is *known* to be
+    a package object — never by method-name coincidence with sockets,
+    files, queues, etc.
+    """
+
+    def __init__(self, modules: Sequence[ParsedModule]):
+        # class name -> {method name -> _FnLockUse-able FunctionInfo}
+        self.methods: Dict[str, Dict[str, Tuple[ParsedModule, FunctionInfo]]] = {}
+        self.module_fns: Dict[Tuple[str, str], Tuple[ParsedModule, FunctionInfo]] = {}
+        for m in modules:
+            for fi in m.functions:
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                name = fi.node.name
+                if fi.class_name is not None:
+                    self.methods.setdefault(fi.class_name, {})[name] = (m, fi)
+                elif "." not in fi.qualname:
+                    self.module_fns[(_module_tag(m), name)] = (m, fi)
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        self.local_types: Dict[int, Dict[str, str]] = {}
+        for m in modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call
+                ):
+                    continue
+                cls_name = terminal_name(node.value.func)
+                if cls_name not in self.methods:
+                    continue
+                for target in node.targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        owner = m.enclosing_class(node)
+                        if owner is not None:
+                            self.attr_types[(owner, target.attr)] = cls_name
+                    elif isinstance(target, ast.Name):
+                        fi = m.enclosing_function(node)
+                        if fi is not None:
+                            self.local_types.setdefault(id(fi.node), {})[
+                                target.id
+                            ] = cls_name
+
+    def _method(self, cls: Optional[str], name: str):
+        if cls is None:
+            return None
+        return self.methods.get(cls, {}).get(name)
+
+    def resolve_callee(
+        self, m: ParsedModule, fi: FunctionInfo, call: ast.Call
+    ) -> Optional[Tuple[ParsedModule, FunctionInfo]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.module_fns.get((_module_tag(m), func.id))
+        path = attr_path(func)
+        if path is None:
+            return None
+        parts = path.split(".")
+        if len(parts) == 2:
+            base, meth = parts
+            if base == "self":
+                hit = self._method(fi.class_name, meth)
+                if hit is not None:
+                    return hit
+                # inherited/base-class method: the receiver is still a
+                # package object, so a package-unique method name is safe
+                cands = [
+                    use
+                    for cls_methods in self.methods.values()
+                    for name, use in cls_methods.items()
+                    if name == meth
+                ]
+                return cands[0] if len(cands) == 1 else None
+            var_t = self.local_types.get(id(fi.node), {}).get(base)
+            return self._method(var_t, meth)
+        if len(parts) == 3 and parts[0] == "self":
+            attr_t = self.attr_types.get((fi.class_name, parts[1]))
+            return self._method(attr_t, parts[2])
+        return None
+
+
+def _with_lock_items(
+    m: ParsedModule, node: ast.With, resolver, enclosing
+) -> List[LockDef]:
+    out = []
+    for item in node.items:
+        d = resolver.resolve(m, item.context_expr, enclosing)
+        if d is not None:
+            out.append(d)
+    return out
+
+
+def _walk_function(
+    m: ParsedModule,
+    fi: FunctionInfo,
+    resolver: _Resolver,
+    types: _TypeMap,
+    acquired_by: Dict[int, Set[str]],  # id(FunctionInfo.node) -> lock ids
+    edges: List[Edge],
+    findings: List[Finding],
+    lock_kind: Dict[str, str],
+):
+    """Collect edges/double-acquires for one function body.  Nested
+    defs are walked as part of their own FunctionInfo (they execute on
+    their own thread/closure schedule, not under the current holds)."""
+
+    def visit(node: ast.AST, held: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.With):
+            locks = _with_lock_items(m, node, resolver, fi)
+            new_held = held
+            for d in locks:
+                if d.lock_id in new_held and lock_kind.get(d.lock_id) == "lock":
+                    findings.append(
+                        Finding(
+                            rule="GL-L002",
+                            pass_id=PASS_ID,
+                            severity="error",
+                            file=m.rel,
+                            line=node.lineno,
+                            symbol=fi.qualname,
+                            message=(
+                                f"non-reentrant lock {d.lock_id!r} acquired "
+                                "while already held — self-deadlock"
+                            ),
+                            snippet=m.snippet(node.lineno),
+                        )
+                    )
+                for h in new_held:
+                    if h != d.lock_id:
+                        edges.append(
+                            Edge(
+                                src=h,
+                                dst=d.lock_id,
+                                file=m.rel,
+                                line=node.lineno,
+                                via_call=None,
+                            )
+                        )
+                new_held = new_held + (d.lock_id,)
+            for child in node.body:
+                visit(child, new_held)
+            return
+        if isinstance(node, ast.Call) and held:
+            hit = types.resolve_callee(m, fi, node)
+            if hit is not None:
+                _callee_m, callee_fi = hit
+                for dst in sorted(acquired_by.get(id(callee_fi.node), ())):
+                    if dst in held and lock_kind.get(dst) == "lock":
+                        findings.append(
+                            Finding(
+                                rule="GL-L002",
+                                pass_id=PASS_ID,
+                                severity="error",
+                                file=m.rel,
+                                line=node.lineno,
+                                symbol=fi.qualname,
+                                message=(
+                                    f"call to {callee_fi.qualname!r} acquires "
+                                    f"{dst!r}, already held here — "
+                                    "self-deadlock"
+                                ),
+                                snippet=m.snippet(node.lineno),
+                            )
+                        )
+                    elif dst not in held:
+                        for h in held:
+                            edges.append(
+                                Edge(
+                                    src=h,
+                                    dst=dst,
+                                    file=m.rel,
+                                    line=node.lineno,
+                                    via_call=callee_fi.qualname,
+                                )
+                            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    node = fi.node
+    if isinstance(node, ast.Lambda):
+        return
+    for stmt in node.body:
+        visit(stmt, ())
+
+
+def _find_cycles(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS from each node, deduped by canonical
+    rotation (lock graphs here are tiny — no need for Johnson's)."""
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], seen: Set[str]):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                # path begins at the cycle's smallest node (enforced
+                # below), so the path itself is the canonical rotation
+                cycles.add(tuple(path))
+            elif nxt not in seen and nxt > start:
+                # only explore nodes > start: each cycle is enumerated
+                # exactly once, from its smallest node
+                seen.add(nxt)
+                dfs(start, nxt, path + [nxt], seen)
+                seen.discard(nxt)
+
+    for n in sorted(adj):
+        dfs(n, n, [n], {n})
+    return [list(c) for c in sorted(cycles)]
+
+
+def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
+    defs = _collect_locks(modules)
+    if not defs:
+        return []
+    lock_kind = {d.lock_id: d.kind for d in defs}
+    resolver = _Resolver(defs)
+
+    # per-function direct acquisitions (for the one-level call graph)
+    types = _TypeMap(modules)
+    acquired_by: Dict[int, Set[str]] = {}
+    for m in modules:
+        for fi in m.functions:
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            acquired: Set[str] = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.With):
+                    if m.enclosing_function(node) is not fi:
+                        continue
+                    for d in _with_lock_items(m, node, resolver, fi):
+                        acquired.add(d.lock_id)
+            if acquired:
+                acquired_by[id(fi.node)] = acquired
+
+    edges: List[Edge] = []
+    findings: List[Finding] = []
+    for m in modules:
+        for fi in m.functions:
+            _walk_function(
+                m, fi, resolver, types, acquired_by, edges, findings,
+                lock_kind,
+            )
+
+    adj: Dict[str, Set[str]] = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+    for cycle in _find_cycles(adj):
+        ring = cycle + [cycle[0]]
+        sites = []
+        for a, b in zip(ring, ring[1:]):
+            for e in edges:
+                if e.src == a and e.dst == b:
+                    via = f" via {e.via_call}()" if e.via_call else ""
+                    sites.append(f"{a}→{b} at {e.file}:{e.line}{via}")
+                    break
+        anchor = next(
+            (e for e in edges if e.src == cycle[0] and e.dst == ring[1]), None
+        )
+        findings.append(
+            Finding(
+                rule="GL-L001",
+                pass_id=PASS_ID,
+                severity="error",
+                file=anchor.file if anchor else modules[0].rel,
+                line=anchor.line if anchor else 1,
+                symbol="<package>",
+                message=(
+                    "lock acquisition cycle "
+                    + " → ".join(ring)
+                    + " — a rare interleaving deadlocks; pick one global "
+                    "order and acquire in it everywhere ("
+                    + "; ".join(sites)
+                    + ")"
+                ),
+                snippet="",
+            )
+        )
+    return findings

@@ -1,0 +1,282 @@
+"""Pass 1 — recompile hazards (GL-J*).
+
+A jit cache hit needs three things: the same function object, hashable
+static arguments with the same values, and the same input avals.  Each
+rule targets one way this codebase could silently lose all three:
+
+- GL-J001 ``jit-in-loop``: a ``jax.jit(...)`` wrap evaluated inside a
+  for/while body builds a fresh wrapper per iteration.  When the
+  wrapped callable is a lambda or a nested def (a new function object
+  each time), every iteration recompiles — a guaranteed storm, so
+  severity *error*; a module-level function re-wrapped in a loop still
+  churns wrapper/dispatch caches and reports as *warning*.
+- GL-J002 ``unhashable-static-arg``: a call through a known jitted
+  binding passing a list/dict/set display (or comprehension) at a
+  ``static_argnums`` position / ``static_argnames`` keyword.  Static
+  args are hashed for cache lookup; unhashables raise at best and
+  defeat the cache at worst.
+- GL-J003 ``shape-branch-in-trace``: a Python ``if``/``while`` inside
+  traced code whose test reads a traced parameter's
+  ``.shape``/``.ndim``/``.size`` (or ``len(param)``).  Legal, but every
+  distinct shape specializes a whole new executable — the branch is a
+  recompile axis and should be a bucketing decision outside jit
+  (exactly the serving engine's prefill-bucket contract).
+- GL-J004 ``value-branch-in-trace``: the test reads the traced value
+  itself — ``TracerBoolConversionError`` at trace time, or, reached
+  through ``shard_map``, per-worker divergence.  ``is None`` /
+  ``is not None`` tests are exempt: None-ness is part of the trace
+  signature and cannot flip at run time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import (
+    JIT_NAMES,
+    ParsedModule,
+    find_jit_wraps,
+    terminal_name,
+    traced_params,
+)
+
+PASS_ID = "recompile"
+
+_UNHASHABLE = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+    ast.GeneratorExp,
+)
+
+
+def _finding(m: ParsedModule, rule, sev, node, symbol, msg) -> Finding:
+    return Finding(
+        rule=rule,
+        pass_id=PASS_ID,
+        severity=sev,
+        file=m.rel,
+        line=node.lineno,
+        symbol=symbol,
+        message=msg,
+        snippet=m.snippet(node.lineno),
+    )
+
+
+def _jit_in_loop(m: ParsedModule, wraps) -> List[Finding]:
+    out: List[Finding] = []
+    for w in wraps:
+        if w.wrapper not in JIT_NAMES:
+            continue
+        if not m.in_loop(w.call):
+            continue
+        symbol = m.symbol_for(w.call)
+        arg = w.call.args[0] if w.call.args else None
+        fresh_fn = isinstance(arg, ast.Lambda) or (
+            w.func_node is not None
+            and m.enclosing_function(w.func_node) is not None
+        )
+        if fresh_fn:
+            out.append(
+                _finding(
+                    m,
+                    "GL-J001",
+                    "error",
+                    w.call,
+                    symbol,
+                    "jax.jit of a lambda/nested function inside a loop: a "
+                    "new function object per iteration recompiles every "
+                    "time — hoist the wrap out of the loop",
+                )
+            )
+        else:
+            out.append(
+                _finding(
+                    m,
+                    "GL-J001",
+                    "warning",
+                    w.call,
+                    symbol,
+                    "jax.jit evaluated inside a loop rebuilds the wrapper "
+                    "each iteration (dispatch-cache churn) — wrap once "
+                    "outside the loop",
+                )
+            )
+    return out
+
+
+def _unhashable_static_args(m: ParsedModule, wraps) -> List[Finding]:
+    # binding (terminal identifier) -> wrap with static positions
+    by_binding = {}
+    for w in wraps:
+        if w.binding and (w.static_argnums or w.static_argnames):
+            by_binding[w.binding] = w
+    if not by_binding:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        w = by_binding.get(name)
+        if w is None or node is w.call:
+            continue
+        symbol = m.symbol_for(node)
+        for i, arg in enumerate(node.args):
+            if i in w.static_argnums and isinstance(arg, _UNHASHABLE):
+                out.append(
+                    _finding(
+                        m,
+                        "GL-J002",
+                        "error",
+                        arg,
+                        symbol,
+                        f"unhashable {type(arg).__name__.lower()} passed at "
+                        f"static_argnums position {i} of jitted "
+                        f"{name!r} — static args are dict keys of the "
+                        "compile cache; pass a tuple (hashable) instead",
+                    )
+                )
+        for kw in node.keywords:
+            if kw.arg in w.static_argnames and isinstance(kw.value, _UNHASHABLE):
+                out.append(
+                    _finding(
+                        m,
+                        "GL-J002",
+                        "error",
+                        kw.value,
+                        symbol,
+                        f"unhashable {type(kw.value).__name__.lower()} passed "
+                        f"for static_argname {kw.arg!r} of jitted "
+                        f"{name!r} — pass a tuple (hashable) instead",
+                    )
+                )
+    return out
+
+
+def _is_none_test(test: ast.expr) -> bool:
+    """`x is None` / `x is not None` (possibly inside bool ops) — trace-
+    signature stable, never a runtime branch on traced data."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_test(test.operand)
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            consts = [test.left] + list(test.comparators)
+            return any(
+                isinstance(c, ast.Constant) and c.value is None for c in consts
+            )
+    return False
+
+
+_SHAPE_ATTRS = {"shape", "ndim", "size"}
+_STATIC_ATTRS = {"dtype", "weak_type", "sharding", "aval"}
+
+
+def _classify_param_refs(test: ast.expr, params: Set[str]):
+    """(shape_refs, value_refs): parameter names reached via shape-like
+    attributes vs. reached as values, within one branch test."""
+    shape_refs: Set[str] = set()
+    value_refs: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, node: ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in params
+            ):
+                if node.attr in _SHAPE_ATTRS:
+                    shape_refs.add(node.value.id)
+                    return  # consumed — not a value read
+                if node.attr in _STATIC_ATTRS:
+                    return  # trace-time constant — fine
+            self.generic_visit(node)
+
+        def visit_Call(self, node: ast.Call):
+            # len(param) is a shape read
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "len"
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                shape_refs.add(node.args[0].id)
+                return
+            self.generic_visit(node)
+
+        def visit_Name(self, node: ast.Name):
+            if node.id in params:
+                value_refs.add(node.id)
+
+    V().visit(test)
+    return shape_refs, value_refs
+
+
+def _branches_in_traced(m: ParsedModule, wraps) -> List[Finding]:
+    out: List[Finding] = []
+    seen_nodes = set()  # a fn wrapped twice reports once
+    for w in wraps:
+        fn = w.func_node
+        if fn is None or fn in seen_nodes or isinstance(fn, ast.Lambda):
+            continue
+        seen_nodes.add(fn)
+        params = set(traced_params(w))
+        if not params:
+            continue
+        symbol = m.symbol_for(fn) if m.parents.get(fn) else getattr(
+            fn, "name", "<lambda>"
+        )
+        qual = next(
+            (f.qualname for f in m.functions if f.node is fn), symbol
+        )
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if _is_none_test(node.test):
+                continue
+            shape_refs, value_refs = _classify_param_refs(node.test, params)
+            if value_refs:
+                out.append(
+                    _finding(
+                        m,
+                        "GL-J004",
+                        "error",
+                        node,
+                        qual,
+                        "Python branch on traced value(s) "
+                        f"{sorted(value_refs)} inside traced code — "
+                        "TracerBoolConversionError at trace time; use "
+                        "lax.cond / jnp.where, or mark the argument static",
+                    )
+                )
+            elif shape_refs:
+                out.append(
+                    _finding(
+                        m,
+                        "GL-J003",
+                        "warning",
+                        node,
+                        qual,
+                        "shape-dependent Python branch on "
+                        f"{sorted(shape_refs)} inside traced code — every "
+                        "distinct shape compiles a new executable; bucket "
+                        "shapes outside jit instead",
+                    )
+                )
+    return out
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    wraps = find_jit_wraps(m)
+    out: List[Finding] = []
+    out += _jit_in_loop(m, wraps)
+    out += _unhashable_static_args(m, wraps)
+    out += _branches_in_traced(m, wraps)
+    return out

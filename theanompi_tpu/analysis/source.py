@@ -1,0 +1,447 @@
+"""Shared AST plumbing for the graftlint passes.
+
+One parse per file; every pass reads the same ``ParsedModule``.  The
+helpers here answer the questions all four passes keep asking:
+
+- what does this call expression *refer to*, module-qualified
+  (``resolve_call`` → ``"jax.jit"``, ``"threading.Lock"``, …), given
+  the module's import aliases;
+- what functions exist and what is each node's enclosing
+  function/class (``FunctionInfo`` table, built with parent links);
+- which callables are *traced* (wrapped by jit / shard_map / pjit /
+  vmap, directly or through ``functools.partial`` decorators) and with
+  which static/donated argument positions (``JitWrap`` table).
+
+Everything is a heuristic over one module's AST — no imports are
+executed and no cross-module type inference is attempted.  Passes are
+expected to prefer missing a hazard over inventing one, and the
+baseline workflow absorbs accepted findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# dotted names that create a traced scope when a function is passed in.
+# (grad/value_and_grad trace too, but they re-enter jit in this codebase
+# and would double-report; jit/shard_map/pjit/vmap are the entry points.)
+TRACING_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.vmap",
+}
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+}
+
+# collective primitives whose cross-worker issue order must match
+COLLECTIVES = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "pshuffle",
+    "all_gather",
+    "all_to_all",
+    "psum_scatter",
+    "pbroadcast",
+}
+
+
+class ImportMap:
+    """Best-effort local-name → dotted-name resolution for one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.names: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.names[a.asname] = a.name
+                    else:
+                        # `import jax.numpy` binds `jax`
+                        head = a.name.split(".", 1)[0]
+                        self.names.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: best-effort package-less tag
+                    base = ("." * node.level) + base
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    self.names[local] = f"{base}.{a.name}" if base else a.name
+
+    def resolve(self, expr: ast.expr) -> Optional[str]:
+        """Dotted name of ``expr`` if its base is an imported name.
+
+        ``jnp.zeros`` → ``jax.numpy.zeros``; ``lax.psum`` →
+        ``jax.lax.psum`` (via ``from jax import lax``); a bare name
+        bound by ``from x import y`` resolves to ``x.y``.  Locals and
+        attribute chains on non-imported bases return None.
+        """
+        parts: List[str] = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.names.get(node.id)
+        if base is None:
+            return None
+        return ".".join([base] + list(reversed(parts)))
+
+
+def attr_path(expr: ast.expr) -> Optional[str]:
+    """Raw dotted path of a Name/Attribute chain (``self._out_lock``,
+    ``conn.lock``) — no import resolution.  None for anything else."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def terminal_name(expr: ast.expr) -> Optional[str]:
+    """Last identifier of a Name/Attribute chain (``self.f`` → ``f``)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "Class.method", "outer.inner", or "f"
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    class_name: Optional[str]  # nearest enclosing class
+    parent: Optional["FunctionInfo"]  # nearest enclosing function
+
+
+@dataclass
+class JitWrap:
+    """One jit/tracing wrap site resolved as far as the module allows."""
+
+    call: ast.Call  # the jax.jit(...) / shard_map(...) call (or a
+    # synthetic one for bare decorators)
+    wrapper: str  # resolved dotted wrapper name
+    binding: Optional[str]  # terminal identifier the wrapped callable is
+    # bound to ("train_fn" for self.train_fn = jax.jit(...)), if any
+    func_node: Optional[ast.AST]  # the traced FunctionDef/Lambda, if
+    # resolvable within this module
+    static_argnums: Set[int] = field(default_factory=set)
+    static_argnames: Set[str] = field(default_factory=set)
+    donate_argnums: Set[int] = field(default_factory=set)
+    donate_argnames: Set[str] = field(default_factory=set)
+    line: int = 0
+
+
+@dataclass
+class ParsedModule:
+    path: str  # absolute
+    rel: str  # repo-relative, posix separators
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    imports: ImportMap
+    functions: List[FunctionInfo]
+    parents: Dict[ast.AST, ast.AST]  # child node -> parent node
+
+    # -- navigation -----------------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
+        by_node = {f.node: f for f in self.functions}
+        cur = self.parents.get(node)
+        while cur is not None:
+            if cur in by_node:
+                return by_node[cur]
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[str]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.parents.get(cur)
+        return None
+
+    def symbol_for(self, node: ast.AST) -> str:
+        fi = self.enclosing_function(node)
+        if fi is not None:
+            return fi.qualname
+        cls = self.enclosing_class(node)
+        return cls if cls is not None else "<module>"
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """True when ``node`` sits inside a for/while body (not merely
+        inside a function that is itself defined under a loop header's
+        expression)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a def inside a loop re-creates its body's jit calls
+                # each iteration only when the DEF itself re-executes;
+                # keep walking so that case still reports
+                pass
+            cur = self.parents.get(cur)
+        return False
+
+
+def parse_module(path: str, root: str) -> Optional[ParsedModule]:
+    """Parse one file; None when unreadable/unparseable (the engine
+    reports those separately rather than crashing the run)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    m = ParsedModule(
+        path=path,
+        rel=rel,
+        source=source,
+        lines=source.splitlines(),
+        tree=tree,
+        imports=ImportMap(tree),
+        functions=[],
+        parents=parents,
+    )
+    m.functions = _build_function_table(m)
+    return m
+
+
+def _build_function_table(m: ParsedModule) -> List[FunctionInfo]:
+    infos: List[FunctionInfo] = []
+    by_node: Dict[ast.AST, FunctionInfo] = {}
+
+    def qual(node) -> Tuple[str, Optional[str], Optional[FunctionInfo]]:
+        names: List[str] = []
+        cls: Optional[str] = None
+        parent_fn: Optional[FunctionInfo] = None
+        cur = m.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.append(cur.name)
+                if parent_fn is None:
+                    parent_fn = by_node.get(cur)
+            elif isinstance(cur, ast.ClassDef):
+                names.append(cur.name)
+                if cls is None:
+                    cls = cur.name
+            cur = m.parents.get(cur)
+        return ".".join(reversed(names)), cls, parent_fn
+
+    for node in ast.walk(m.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            prefix, cls, parent_fn = qual(node)
+            own = node.name if hasattr(node, "name") else "<lambda>"
+            qualname = f"{prefix}.{own}" if prefix else own
+            fi = FunctionInfo(
+                qualname=qualname, node=node, class_name=cls, parent=parent_fn
+            )
+            by_node[node] = fi
+            infos.append(fi)
+    return infos
+
+
+# ---------------------------------------------------------------------------
+# jit-wrap extraction
+# ---------------------------------------------------------------------------
+
+def _literal_ints(node: Optional[ast.expr]) -> Set[int]:
+    out: Set[int] = set()
+    if node is None:
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def _literal_strs(node: Optional[ast.expr]) -> Set[str]:
+    out: Set[str] = set()
+    if node is None:
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    return out
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _local_function(m: ParsedModule, name: str) -> Optional[ast.AST]:
+    for fi in m.functions:
+        if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if fi.node.name == name:
+                return fi.node
+    return None
+
+
+def _unwrap_traced_func(m: ParsedModule, expr: ast.expr) -> Optional[ast.AST]:
+    """Chase the first argument of a tracing wrapper down to a local
+    FunctionDef/Lambda when possible (handles shard_map(f, ...) nested
+    inside jit, and f referenced by name)."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Name):
+        return _local_function(m, expr.id)
+    if isinstance(expr, ast.Call):
+        resolved = m.imports.resolve(expr.func)
+        if resolved in TRACING_WRAPPERS or (
+            terminal_name(expr.func) in ("shard_map", "pjit", "jit", "vmap")
+        ):
+            inner = None
+            if expr.args:
+                inner = expr.args[0]
+            else:
+                inner = _kw(expr, "f") or _kw(expr, "fun")
+            if inner is not None:
+                return _unwrap_traced_func(m, inner)
+    return None
+
+
+def is_tracing_wrapper(m: ParsedModule, call: ast.Call) -> Optional[str]:
+    """Resolved wrapper name when ``call`` applies a tracing transform."""
+    resolved = m.imports.resolve(call.func)
+    if resolved in TRACING_WRAPPERS:
+        return resolved
+    # tolerate `from jax import jit` style partial resolution failures:
+    # a bare terminal name that matches and resolves under jax.*
+    term = terminal_name(call.func)
+    if term in ("jit", "pjit", "shard_map", "vmap") and resolved is None:
+        # only when the name was from-imported from a jax module
+        src = m.imports.names.get(term, "")
+        if src.startswith("jax"):
+            return src
+    return None
+
+
+def find_jit_wraps(m: ParsedModule) -> List[JitWrap]:
+    """Every tracing-wrap site in the module: explicit ``jax.jit(...)``
+    calls (with their binding when assigned), ``@jax.jit`` decorators,
+    and ``@partial(jax.jit, ...)`` decorators."""
+    wraps: List[JitWrap] = []
+
+    def spec_from_call(call: ast.Call, wrapper: str) -> JitWrap:
+        w = JitWrap(
+            call=call,
+            wrapper=wrapper,
+            binding=None,
+            func_node=None,
+            line=call.lineno,
+        )
+        w.static_argnums = _literal_ints(_kw(call, "static_argnums"))
+        w.static_argnames = _literal_strs(_kw(call, "static_argnames"))
+        w.donate_argnums = _literal_ints(_kw(call, "donate_argnums"))
+        w.donate_argnames = _literal_strs(_kw(call, "donate_argnames"))
+        if call.args:
+            w.func_node = _unwrap_traced_func(m, call.args[0])
+        return w
+
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Call):
+            wrapper = is_tracing_wrapper(m, node)
+            if wrapper is None:
+                continue
+            w = spec_from_call(node, wrapper)
+            parent = m.parents.get(node)
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                w.binding = terminal_name(parent.targets[0])
+            elif isinstance(parent, ast.AnnAssign):
+                w.binding = terminal_name(parent.target)
+            wraps.append(w)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    resolved = m.imports.resolve(dec.func)
+                    if resolved in TRACING_WRAPPERS:
+                        w = spec_from_call(dec, resolved)
+                        w.binding = node.name
+                        w.func_node = node
+                        wraps.append(w)
+                    elif resolved in ("functools.partial", "partial") or (
+                        terminal_name(dec.func) == "partial"
+                    ):
+                        if dec.args:
+                            inner = m.imports.resolve(dec.args[0])
+                            if inner in TRACING_WRAPPERS:
+                                w = spec_from_call(dec, inner)
+                                w.binding = node.name
+                                w.func_node = node
+                                wraps.append(w)
+                else:
+                    resolved = m.imports.resolve(dec)
+                    if resolved in TRACING_WRAPPERS:
+                        w = JitWrap(
+                            call=ast.Call(func=dec, args=[], keywords=[]),
+                            wrapper=resolved,
+                            binding=node.name,
+                            func_node=node,
+                            line=node.lineno,
+                        )
+                        wraps.append(w)
+    return wraps
+
+
+def traced_params(w: JitWrap) -> List[str]:
+    """Parameter names of the wrapped function that are traced (i.e.
+    not static by position or name).  Empty when the function node is
+    unknown."""
+    fn = w.func_node
+    if fn is None or not hasattr(fn, "args"):
+        return []
+    a = fn.args
+    names = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    out = []
+    for i, name in enumerate(names):
+        if name in ("self", "cls") and i == 0:
+            continue
+        if i in w.static_argnums or name in w.static_argnames:
+            continue
+        out.append(name)
+    out += [p.arg for p in a.kwonlyargs if p.arg not in w.static_argnames]
+    return out

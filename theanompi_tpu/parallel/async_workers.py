@@ -41,7 +41,19 @@ Pytree = Any
 
 
 def _to_host(tree: Pytree) -> Pytree:
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+    """Device→host COPY of every leaf.
+
+    ``np.array``, not ``np.asarray``: on CPU ``asarray`` of a jax array
+    is a zero-copy VIEW of the device buffer (graftlint GL-D004).  The
+    trees this produces cross threads — GOSGD pushes them through the
+    in-process Mailbox to peers, EASGD seeds the server's center and
+    the epoch-boundary ``host_net_state`` from them — and they are read
+    there long after this worker's next jitted step has DONATED (and
+    XLA reused) the underlying buffers.  A view would silently read
+    reused memory; a copy is immutable history (same contract as
+    ``utils.checkpoint.host_snapshot``).
+    """
+    return jax.tree.map(lambda x: np.array(x), tree)
 
 
 def _split_devices(devices, n_workers: int):

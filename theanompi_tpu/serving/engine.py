@@ -61,6 +61,57 @@ def default_buckets(max_len: int, lo: int = 16) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def _validate_buckets(buckets, max_len: int) -> Tuple[int, ...]:
+    """Normalize prefill bucket lengths to a sorted tuple of distinct
+    positive ints — the compile-time contract of the prefill path.
+
+    Each bucket is a padded prompt SHAPE: the engine compiles exactly
+    ``len(buckets)`` prefill programs, and ``pick_bucket`` keys on exact
+    integer lengths.  Anything looser recompiles per request instead of
+    erroring here: a float bucket (16.5) silently truncates to a shape
+    no prompt maps back to, a bool coerces to 0/1, a duplicate is a
+    wasted compile, and an unhashable container would defeat the jit
+    cache outright.  Validate once at construction, with the offending
+    value in the message.
+    """
+    import numpy as np
+
+    try:
+        items = list(buckets)
+    except TypeError:
+        raise TypeError(
+            f"buckets must be an iterable of ints, got "
+            f"{type(buckets).__name__}"
+        )
+    if not items:
+        raise ValueError("buckets must contain at least one length")
+    out = []
+    for b in items:
+        # bool is an int subclass — reject it explicitly, True/False
+        # are config mistakes, not prompt lengths
+        if isinstance(b, bool) or not isinstance(b, (int, np.integer)):
+            raise TypeError(
+                f"bucket lengths must be ints (prefill shapes are "
+                f"compile-time constants), got {b!r} of type "
+                f"{type(b).__name__} — a non-int bucket means a "
+                "recompile per request instead of a cache hit"
+            )
+        b = int(b)
+        if b < 1:
+            raise ValueError(f"bucket lengths must be >= 1, got {b}")
+        out.append(b)
+    if len(set(out)) != len(out):
+        dupes = sorted({b for b in out if out.count(b) > 1})
+        raise ValueError(
+            f"duplicate bucket length(s) {dupes}: each bucket compiles "
+            "one prefill program — duplicates waste compiles"
+        )
+    out = tuple(sorted(out))
+    if out[-1] > max_len:
+        raise ValueError(f"bucket {out[-1]} exceeds max_len={max_len}")
+    return out
+
+
 class ServingEngine:
     """Prefill + continuous-decode executor over a ``TransformerLM``.
 
@@ -108,13 +159,10 @@ class ServingEngine:
                 f"max_len={self.max_len} exceeds the learned positional "
                 f"table ({train_len} rows, config seq_len)"
             )
-        self.buckets = tuple(sorted(
-            int(b) for b in (buckets or default_buckets(self.max_len))
-        ))
-        if self.buckets[-1] > self.max_len:
-            raise ValueError(
-                f"bucket {self.buckets[-1]} exceeds max_len={self.max_len}"
-            )
+        self.buckets = _validate_buckets(
+            buckets if buckets is not None else default_buckets(self.max_len),
+            self.max_len,
+        )
         # cache layout on the model's mesh: slots over dp when it
         # divides, heads over the Megatron tp shards that produce them
         slot_ax = (

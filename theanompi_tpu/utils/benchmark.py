@@ -188,8 +188,13 @@ def comm_fraction_probe(
     if n_dp <= 1:
         return {"comm_fraction": 0.0, "comm_s": 0.0, "n_dp": 1}
 
+    # np.array (copy), NOT np.asarray: asarray yields zero-copy views
+    # of the live buffers on CPU (graftlint GL-D004), and the probe
+    # steps below DONATE exactly those buffers — _restore() would then
+    # re-place the model from reused memory, silently corrupting the
+    # training state the probe promises to leave untouched
     snap = jax.tree.map(
-        np.asarray, (model.params, model.net_state, model.opt_state)
+        np.array, (model.params, model.net_state, model.opt_state)
     )
     # the probe pulls train_batches(), which on the aug paths draws from
     # the provider's RNG — save/restore it so a diagnostics toggle
