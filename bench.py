@@ -388,6 +388,12 @@ def main():
         bench_serve.main()
         return
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
+    # span tracing for the whole bench (bounded buffer): the emitted
+    # JSON carries the export paths + a metrics snapshot, so perf
+    # rounds ship comm/compute attribution, not just wall clocks
+    from theanompi_tpu import observability as observability
+
+    observability.enable_tracing()
     if CPU_REHEARSAL:
         print(
             f"[bench] CPU rehearsal: {jax.device_count()} fake devices, "
@@ -584,6 +590,20 @@ def main():
         detail["efficiency"] = _efficiency_curve(n_chips, per_chip, knobs)
     except Exception as e:
         detail["efficiency"] = f"failed: {type(e).__name__}: {e}"
+    try:
+        # comm/compute attribution rides the BENCH line: trace export
+        # paths (open trace.json in chrome://tracing / Perfetto) + the
+        # atomic metrics snapshot (exchanger wire bytes, step windows)
+        paths = observability.dump_all(prefix="bench_")
+        detail["observability"] = {
+            "trace_chrome": paths["trace_chrome"],
+            "trace_raw": paths["trace_raw"],
+            "metrics": observability.get_registry().snapshot(),
+        }
+    except OSError as e:  # export must never discard the measurement
+        print(f"[bench] observability export failed: {e}",
+              file=sys.stderr, flush=True)
+        detail["observability"] = f"failed: {type(e).__name__}: {e}"
     if not CPU_REHEARSAL and jax.default_backend() == "tpu":
         # bank REAL chip numbers only — a rehearsal value must never be
         # re-emittable as if it were hardware

@@ -88,6 +88,12 @@ def main():
     import numpy as np
 
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
+    # same attribution contract as bench.py: the BENCH_serve line
+    # carries trace-export paths + a metrics snapshot (TTFT/TPOT
+    # histograms, slot/queue gauges, prefill-bucket counters)
+    from theanompi_tpu import observability as observability
+
+    observability.enable_tracing()
     if not CPU_REHEARSAL and jax.default_backend() not in ("tpu",):
         # same guard shape as bench.py: a dead tunnel silently falling
         # back to 1 CPU device must not masquerade as a TPU number
@@ -177,6 +183,17 @@ def main():
         "tpot_p99_s": round(summary["tpot_p99_s"], 4),
         "cpu_rehearsal": CPU_REHEARSAL,
     }
+    try:
+        paths = observability.dump_all(prefix="bench_serve_")
+        detail["observability"] = {
+            "trace_chrome": paths["trace_chrome"],
+            "trace_raw": paths["trace_raw"],
+            "metrics": observability.get_registry().snapshot(),
+        }
+    except OSError as e:  # export must never discard the measurement
+        print(f"[bench_serve] observability export failed: {e}",
+              file=sys.stderr, flush=True)
+        detail["observability"] = f"failed: {type(e).__name__}: {e}"
     emit(n_tokens / dt, detail, measured_now=True)
 
 

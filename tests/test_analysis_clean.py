@@ -1,4 +1,4 @@
-"""Tier-1 gate: graftlint must run clean on the shipped code.
+"""Tier-1 gate: graftlint must run clean on the shipped code AND tests.
 
 A non-baselined finding in ``theanompi_tpu/``, ``scripts/`` or the
 top-level entrypoints fails this test — the same contract as
@@ -8,9 +8,19 @@ findings live in ``.graftlint_baseline.json`` (regenerate with
 ``# graftlint: disable=GL-XXXX``.  The gate also keeps the baseline
 honest: stale entries (whose finding no longer occurs) fail too, so
 fixes retire their baseline entries in the same PR.
+
+``tests/`` gets the same treatment against its OWN baseline
+(``.graftlint_baseline_tests.json``, currently empty — the three
+GL-D004 zero-copy snapshots it found were fixed on landing), with the
+deliberately-bad fixture corpus under ``tests/data/`` excluded from
+the walk.  Regenerate with::
+
+    python -m theanompi_tpu.analysis tests --exclude data \
+        --baseline .graftlint_baseline_tests.json --write-baseline
 """
 
 import json
+import os
 
 from theanompi_tpu.analysis import (
     analyze,
@@ -18,6 +28,7 @@ from theanompi_tpu.analysis import (
     split_by_baseline,
 )
 from theanompi_tpu.analysis.__main__ import main as cli_main
+from theanompi_tpu.analysis.engine import repo_root
 
 
 def _fmt(findings):
@@ -53,3 +64,60 @@ def test_cli_json_runs_clean(capsys):
     assert rc == 0
     assert doc["counts"]["new"] == 0
     assert doc["tool"] == "graftlint"
+
+
+# ---------------------------------------------------------------------------
+# tests/ under its own baseline (fixture corpus excluded)
+# ---------------------------------------------------------------------------
+
+_TESTS_BASELINE = os.path.join(repo_root(), ".graftlint_baseline_tests.json")
+
+
+def _analyze_tests():
+    return analyze(
+        paths=[os.path.join(repo_root(), "tests")], exclude_dirs=("data",)
+    )
+
+
+def test_tests_dir_has_no_new_findings():
+    findings, skipped = _analyze_tests()
+    assert skipped == [], f"unparseable test files: {skipped}"
+    new, _matched, _stale = split_by_baseline(
+        findings, load_baseline(_TESTS_BASELINE)
+    )
+    assert new == [], (
+        "graftlint found new hazards in tests/ (fix them, suppress "
+        "with '# graftlint: disable=<rule>', or accept via "
+        "python -m theanompi_tpu.analysis tests --exclude data "
+        "--baseline .graftlint_baseline_tests.json --write-baseline):\n"
+        + _fmt(new)
+    )
+
+
+def test_tests_baseline_has_no_stale_entries():
+    findings, _ = _analyze_tests()
+    _new, _matched, stale = split_by_baseline(
+        findings, load_baseline(_TESTS_BASELINE)
+    )
+    assert stale == [], (
+        "stale tests-baseline entries — regenerate "
+        ".graftlint_baseline_tests.json: "
+        + ", ".join(e.get("fingerprint", "?") for e in stale)
+    )
+
+
+def test_tests_baseline_file_exists():
+    """The gate must fail loudly if the second baseline file vanishes
+    (an absent file reads as an empty baseline, which would silently
+    re-accept nothing — but the contract is that the file is tracked)."""
+    assert os.path.exists(_TESTS_BASELINE), _TESTS_BASELINE
+
+
+def test_fixture_corpus_is_excluded():
+    """The deliberately-bad corpus must never leak into the gate: the
+    same walk WITHOUT the exclusion sees its findings."""
+    with_corpus, _ = analyze(paths=[os.path.join(repo_root(), "tests")])
+    corpus = [f for f in with_corpus if f.file.startswith("tests/data/")]
+    assert corpus, "fixture corpus produced no findings — corpus moved?"
+    clean, _ = _analyze_tests()
+    assert not any(f.file.startswith("tests/data/") for f in clean)

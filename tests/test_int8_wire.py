@@ -755,7 +755,7 @@ def test_error_feedback_checkpoint_resume_happy_path(tmp_path):
         exch_strategy="int8", error_feedback=True,
     )
     path = model.save_model(str(tmp_path / "ckpt_0001.npz"))
-    saved_ef = jax.tree.map(np.asarray, model.opt_state["ef_wire"])
+    saved_ef = jax.tree.map(np.array, model.opt_state["ef_wire"])
 
     fresh = Cifar10_model(
         config=dict(TINY, batch_size=8, exch_strategy="int8",

@@ -182,7 +182,7 @@ def _unstack_pp_params(pp_model, pp):
     """[emb, pos, PipelineStages, ln, head] → [emb, pos, blocks…, ln,
     head]: stage s of the stacked stage params expands to blocks
     s·per_stage … (s+1)·per_stage−1 of the unpipelined layout."""
-    pp_params = jax.tree.map(np.asarray, pp_model.params)
+    pp_params = jax.tree.map(np.array, pp_model.params)
     stage_list = pp_params[2]  # list over per-stage blocks, leaves (S, ...)
     dense = [pp_params[0], pp_params[1]]
     for s in range(pp):

@@ -165,7 +165,7 @@ def test_zero1_sr_wire_runs_and_needs_rng():
     assert all(np.isfinite(l) for l in losses)
     with pytest.raises(ValueError, match="randomness"):
         model._zero.update_shard(
-            jax.tree.map(np.asarray, model.params),
+            jax.tree.map(np.array, model.params),
             jax.tree.map(np.zeros_like, model.params),
             model.opt_state,
         )

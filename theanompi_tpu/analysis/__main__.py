@@ -43,6 +43,14 @@ def _build_parser() -> argparse.ArgumentParser:
         help=f"baseline file (default: <repo>/{engine.BASELINE_NAME})",
     )
     p.add_argument(
+        "--exclude",
+        action="append",
+        default=[],
+        metavar="DIRNAME",
+        help="directory name to prune while walking (repeatable; "
+        "e.g. --exclude data for the tests/ fixture corpus)",
+    )
+    p.add_argument(
         "--no-baseline",
         action="store_true",
         help="ignore the baseline: report every finding as new",
@@ -58,7 +66,9 @@ def _build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     try:
-        findings, skipped = engine.analyze(paths=args.paths or None)
+        findings, skipped = engine.analyze(
+            paths=args.paths or None, exclude_dirs=tuple(args.exclude)
+        )
     except OSError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

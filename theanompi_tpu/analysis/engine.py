@@ -53,7 +53,10 @@ def default_targets(root: Optional[str] = None) -> List[str]:
     return out
 
 
-def _iter_py_files(paths: Iterable[str]) -> List[str]:
+def _iter_py_files(
+    paths: Iterable[str], exclude_dirs: Sequence[str] = ()
+) -> List[str]:
+    skip_dirs = {"__pycache__", ".git", *exclude_dirs}
     seen = []
     seen_set = set()
     for p in paths:
@@ -63,7 +66,7 @@ def _iter_py_files(paths: Iterable[str]) -> List[str]:
             cand = []
             for dirpath, dirnames, filenames in os.walk(p):
                 dirnames[:] = [
-                    d for d in dirnames if d not in ("__pycache__", ".git")
+                    d for d in dirnames if d not in skip_dirs
                 ]
                 for f in sorted(filenames):
                     if f.endswith(".py"):
@@ -93,10 +96,18 @@ def _suppressed_rules(m: ParsedModule, line: int) -> Optional[set]:
 def analyze(
     paths: Optional[Sequence[str]] = None,
     root: Optional[str] = None,
+    exclude_dirs: Sequence[str] = (),
 ) -> Tuple[List[Finding], List[str]]:
-    """Run all four passes.  Returns (findings, unparseable-files)."""
+    """Run all four passes.  Returns (findings, unparseable-files).
+
+    ``exclude_dirs``: directory NAMES pruned during the walk (beyond
+    the built-in ``__pycache__``/``.git``) — the tests/ run excludes
+    ``data`` so the deliberately-bad fixture corpus under
+    ``tests/data/analysis/`` can't poison the gate."""
     root = root or repo_root()
-    files = _iter_py_files(paths if paths else default_targets(root))
+    files = _iter_py_files(
+        paths if paths else default_targets(root), exclude_dirs
+    )
     modules: List[ParsedModule] = []
     skipped: List[str] = []
     for f in files:

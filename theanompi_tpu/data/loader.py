@@ -28,7 +28,16 @@ from typing import Callable, Iterator, Optional
 
 import jax
 
+from theanompi_tpu import observability as obs
 from theanompi_tpu.runtime import jax_compat
+
+_REG = obs.get_registry()
+_BATCHES = _REG.counter(
+    "data_batches_placed_total", "host batches placed onto the mesh"
+)
+_DEPTH = _REG.gauge(
+    "data_prefetch_depth", "device batches queued ahead of the consumer"
+)
 
 
 class PrefetchLoader:
@@ -65,7 +74,11 @@ class PrefetchLoader:
     def _run(self, it):
         try:
             for batch in it:
-                self._q.put(self._place(batch))
+                with obs.span("data_load_place"):
+                    placed = self._place(batch)
+                self._q.put(placed)
+                _BATCHES.inc(mode="prefetch")
+                _DEPTH.set(self._q.qsize())
         except BaseException as e:  # surfaced to consumer
             self._err = e
         finally:
@@ -76,8 +89,17 @@ class PrefetchLoader:
 
     def __next__(self):
         if self._sync_it is not None:
-            return self._place(next(self._sync_it))
-        item = self._q.get()
+            # sync degrade: load+place in-line, attributed as the
+            # consumer's 'load' time (there is no hidden pipeline)
+            with obs.span("data_load_place"):
+                placed = self._place(next(self._sync_it))
+            _BATCHES.inc(mode="sync")
+            return placed
+        # 'data_wait' is the consumer-visible stall: ~0 while the
+        # prefetch pipeline keeps up, one load-time wide when it starves
+        with obs.span("data_wait"):
+            item = self._q.get()
+        _DEPTH.set(self._q.qsize())
         if item is self._SENTINEL:
             if self._err is not None:
                 raise self._err

@@ -1,0 +1,138 @@
+"""theanompi_tpu.observability — unified tracing, metrics, flight recorder.
+
+The ONE observability subsystem for both halves of the framework: the
+training stack (BSP/EASGD/GOSGD workers, exchangers, loaders) and the
+serving stack (admission/prefill/decode) instrument through the same
+three primitives:
+
+- ``trace``   — thread-safe span tracer with Chrome-trace/Perfetto
+  export (``with span("prefill", slot=i): ...``); no-op when disabled,
+  so instrumentation lives in hot loops permanently.
+- ``metrics`` — a registry of labeled counters / gauges / fixed-bucket
+  histograms with atomic snapshot, JSON and Prometheus-text exposition.
+- ``flight``  — per-thread ring buffers of recent spans/events, dumped
+  to a post-mortem JSON file on unhandled exception or explicit
+  ``dump()``.
+
+plus ``export`` (file dumps + an opt-in localhost HTTP endpoint) and a
+CLI (``python -m theanompi_tpu.observability dump --format chrome``).
+
+**Event bus**: ``publish_event(kind, fields)`` fans one structured
+event out to every surface (instant trace event, flight ring, the
+``events_total`` counter, registered subscribers).
+``runtime.recorder.Recorder.log_event`` forwards here, so every
+existing ``log_event`` call site — comm-fraction probes, serve
+summaries, memory snapshots, restarts — feeds the bus unchanged.
+
+Pure stdlib: importable without jax on the path (like ``analysis/``) —
+the post-mortem machinery must work when the accelerator stack is the
+thing that died.  Tracing enables via ``enable_tracing()`` or env
+``THEANOMPI_OBS_TRACE=1``; metrics and flight recording are always on
+(bounded, cheap).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List
+
+from theanompi_tpu.observability.flight import (
+    FlightRecorder,
+    get_flight_recorder,
+)
+from theanompi_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    percentile,
+)
+from theanompi_tpu.observability.trace import (
+    Tracer,
+    add_span,
+    get_tracer,
+    instant,
+    raw_to_chrome,
+    span,
+    traced,
+)
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "add_span",
+    "disable_tracing",
+    "dump_all",
+    "enable_tracing",
+    "get_flight_recorder",
+    "get_registry",
+    "get_tracer",
+    "instant",
+    "percentile",
+    "publish_event",
+    "raw_to_chrome",
+    "set_process",
+    "span",
+    "subscribe",
+    "traced",
+]
+
+_EVENTS = get_registry().counter(
+    "events_total", "structured events through the observability bus"
+)
+
+_subscribers: List[Callable[[str, dict], None]] = []
+
+
+def subscribe(fn: Callable[[str, dict], None]) -> None:
+    """Register a bus subscriber: ``fn(kind, fields)`` per event."""
+    _subscribers.append(fn)
+
+
+def publish_event(kind: str, fields: dict) -> None:
+    """Fan one structured event out to every observability surface.
+
+    ``fields`` is read, never mutated or retained mutably — callers
+    (``Recorder.log_event``) keep ownership of their row dicts."""
+    _EVENTS.inc(kind=kind)
+    get_flight_recorder().record(kind, **fields)
+    tracer = get_tracer()
+    if tracer.enabled:
+        tracer.instant(kind, dict(fields) if fields else None)
+    for fn in _subscribers:
+        fn(kind, fields)
+
+
+def enable_tracing(buffer=None) -> Tracer:
+    """Turn span collection on (bounded buffer) and feed finished spans
+    into the flight recorder's rings."""
+    tracer = get_tracer()
+    fr = get_flight_recorder()
+    if fr.record_span not in tracer.span_sinks:
+        tracer.span_sinks.append(fr.record_span)
+    tracer.enable(buffer=buffer)
+    return tracer
+
+
+def disable_tracing() -> None:
+    get_tracer().disable()
+
+
+def set_process(pid: int, name=None) -> None:
+    """Label this process's trace track (e.g. the SPMD process index)."""
+    get_tracer().set_process(pid, name)
+
+
+def dump_all(directory=None, prefix: str = ""):
+    from theanompi_tpu.observability.export import dump_all as _impl
+
+    return _impl(directory, prefix)
+
+
+if os.environ.get("THEANOMPI_OBS_TRACE") == "1":
+    enable_tracing()

@@ -1,0 +1,139 @@
+"""CLI: ``python -m theanompi_tpu.observability``.
+
+Offline companion to the in-process exporters: a run (bench, training,
+serving) writes raw artifacts into its observability directory
+(``THEANOMPI_OBS_DIR``, default ``./.observability``); this CLI turns
+them into viewer-ready output.
+
+Commands:
+
+- ``dump --format chrome``      convert the newest (or given) raw trace
+  JSONL to Chrome trace JSON — open the result in chrome://tracing or
+  https://ui.perfetto.dev.  ``--out`` writes a file, default stdout.
+- ``dump --format raw``         print the raw trace JSONL as-is.
+- ``dump --format prometheus``  print the newest metrics .prom snapshot.
+- ``dump --format json``        print the newest metrics .json snapshot.
+- ``serve --port N``            serve /metrics, /trace, /flight from the
+  current (empty, unless something enabled tracing in-process) state —
+  mainly a smoke surface; real deployments call
+  ``export.ObservabilityServer`` from inside the run.
+
+Exit codes: 0 ok, 2 usage/missing-input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+from theanompi_tpu.observability.trace import raw_to_chrome
+
+
+def _newest(pattern: str, directory: str) -> Optional[str]:
+    hits = glob.glob(os.path.join(directory, pattern))
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def _resolve_dir(args) -> str:
+    return (
+        args.dir
+        or os.environ.get("THEANOMPI_OBS_DIR")
+        or os.path.join(os.getcwd(), ".observability")
+    )
+
+
+def _write_out(text: str, out: Optional[str]) -> None:
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+
+
+def _cmd_dump(args) -> int:
+    d = _resolve_dir(args)
+    if args.format in ("chrome", "raw"):
+        path = args.input or _newest("*trace_raw.jsonl", d)
+        if not path or not os.path.exists(path):
+            print(
+                f"no raw trace found (looked for *trace_raw.jsonl in {d}; "
+                "run with tracing enabled — THEANOMPI_OBS_TRACE=1 — or "
+                "pass a file)",
+                file=sys.stderr,
+            )
+            return 2
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+        if args.format == "raw":
+            _write_out("".join(lines), args.out)
+        else:
+            _write_out(
+                json.dumps(raw_to_chrome(lines)) + "\n", args.out
+            )
+        return 0
+    # metrics snapshots
+    suffix = "metrics.prom" if args.format == "prometheus" else "metrics.json"
+    path = args.input or _newest(f"*{suffix}", d)
+    if not path or not os.path.exists(path):
+        print(f"no *{suffix} snapshot found in {d}", file=sys.stderr)
+        return 2
+    with open(path, "r", encoding="utf-8") as f:
+        _write_out(f.read(), args.out)
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from theanompi_tpu.observability.export import ObservabilityServer
+
+    srv = ObservabilityServer(port=args.port, host=args.host).start()
+    print(
+        f"serving /metrics /metrics.json /trace /flight on "
+        f"http://{args.host}:{srv.port} (Ctrl-C to stop)",
+        file=sys.stderr,
+    )
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+    return 0
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m theanompi_tpu.observability",
+        description="trace/metrics export tooling",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("dump", help="convert/print exported artifacts")
+    d.add_argument("input", nargs="?", help="artifact file (default: newest)")
+    d.add_argument(
+        "--format",
+        choices=("chrome", "raw", "prometheus", "json"),
+        default="chrome",
+        dest="format",
+    )
+    d.add_argument("--dir", default=None, help="observability directory")
+    d.add_argument("--out", default=None, help="write here instead of stdout")
+    d.set_defaults(fn=_cmd_dump)
+    s = sub.add_parser("serve", help="local HTTP endpoint (opt-in)")
+    s.add_argument("--port", type=int, default=9100)
+    s.add_argument("--host", default="127.0.0.1")
+    s.set_defaults(fn=_cmd_serve)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Export surfaces: files on disk + an opt-in local HTTP endpoint.
+
+File export (``dump_all``) writes the tracer's raw JSONL + Chrome JSON,
+the metrics registry's Prometheus text + JSON snapshot, and the flight
+rings into one directory (``THEANOMPI_OBS_DIR``, default
+``./.observability``) — the directory ``python -m
+theanompi_tpu.observability dump`` reads offline.
+
+The HTTP endpoint is **off by default** and binds ``127.0.0.1`` unless
+told otherwise: it exposes internal timings and event payloads, so
+putting it on a routable interface is an explicit operator decision
+(see docs/observability.md "Endpoint security").  Routes:
+
+- ``/metrics``      — Prometheus text exposition (scrape target)
+- ``/metrics.json`` — the registry snapshot as JSON
+- ``/trace``        — Chrome trace JSON of the current buffer
+- ``/flight``       — the flight rings as JSON
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from theanompi_tpu.observability.flight import get_flight_recorder
+from theanompi_tpu.observability.metrics import get_registry
+from theanompi_tpu.observability.trace import get_tracer
+
+
+def obs_dir(path: Optional[str] = None) -> str:
+    d = path or os.environ.get("THEANOMPI_OBS_DIR") or os.path.join(
+        os.getcwd(), ".observability"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def dump_all(
+    directory: Optional[str] = None, prefix: str = ""
+) -> Dict[str, str]:
+    """Write every export artifact; returns name -> path written."""
+    d = obs_dir(directory)
+    tracer = get_tracer()
+    reg = get_registry()
+    out = {
+        "trace_raw": tracer.save_raw(
+            os.path.join(d, f"{prefix}trace_raw.jsonl")
+        ),
+        "trace_chrome": tracer.export_chrome(
+            os.path.join(d, f"{prefix}trace.json")
+        ),
+        "metrics_prom": os.path.join(d, f"{prefix}metrics.prom"),
+        "metrics_json": os.path.join(d, f"{prefix}metrics.json"),
+        "flight": os.path.join(d, f"{prefix}flight_rings.json"),
+    }
+    with open(out["metrics_prom"], "w", encoding="utf-8") as f:
+        f.write(reg.to_prometheus())
+    with open(out["metrics_json"], "w", encoding="utf-8") as f:
+        f.write(reg.to_json())
+        f.write("\n")
+    with open(out["flight"], "w", encoding="utf-8") as f:
+        json.dump(get_flight_recorder().snapshot(), f, default=str)
+        f.write("\n")
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the serving hot path must never block on a slow scraper's print
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, body: bytes, ctype: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(
+                    get_registry().to_prometheus().encode("utf-8"),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/metrics.json":
+                self._send(
+                    get_registry().to_json().encode("utf-8"),
+                    "application/json",
+                )
+            elif path == "/trace":
+                body = json.dumps(
+                    get_tracer().chrome_trace(), default=str
+                ).encode("utf-8")
+                self._send(body, "application/json")
+            elif path == "/flight":
+                body = json.dumps(
+                    get_flight_recorder().snapshot(), default=str
+                ).encode("utf-8")
+                self._send(body, "application/json")
+            else:
+                self._send(b"not found\n", "text/plain", 404)
+        except Exception as e:  # a scrape error must not kill the server
+            self._send(
+                f"export error: {type(e).__name__}: {e}\n".encode("utf-8"),
+                "text/plain",
+                500,
+            )
+
+
+class ObservabilityServer:
+    """Opt-in stdlib HTTP endpoint on a daemon thread.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``
+    after ``start()`` — tests do).  Never started implicitly.
+    """
+
+    def __init__(self, port: int = 9100, host: str = "127.0.0.1"):
+        self.host = host
+        self.requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "ObservabilityServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.requested_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="ObservabilityServer",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+                self._thread = None

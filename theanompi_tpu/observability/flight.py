@@ -1,0 +1,212 @@
+"""Flight recorder — post-mortem evidence for crashed workers.
+
+The async rules run worker threads for hours; when one dies, a bare
+traceback says where it stopped but nothing about what the worker was
+*doing* in the seconds before — which exchange, which slot, how deep
+the inbox was.  The flight recorder keeps a small per-thread ring
+buffer of the most recent spans and events (fed by the tracer's span
+sink and by ``publish_event``), and on an unhandled exception — or an
+explicit ``dump()`` — writes one JSON post-mortem file carrying:
+
+- the exception (type, message, traceback),
+- every thread's recent event ring,
+- a live stack snapshot of every thread (``sys._current_frames``),
+
+so a crashed async worker leaves evidence instead of a traceback.
+
+Recording is cheap (one bounded ``deque.append`` under a lock) and ON
+by default; the rings only ever hold the last ``capacity`` events per
+thread.  Pure stdlib — the dump path must work precisely when the jax
+stack is the thing that died.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Dict, Optional
+
+DEFAULT_CAPACITY = 256
+
+
+def _default_dir() -> str:
+    return os.environ.get("THEANOMPI_OBS_DIR") or os.path.join(
+        os.getcwd(), ".observability"
+    )
+
+
+class FlightRecorder:
+    """Per-thread ring of recent events + crash dump machinery."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=time.time):
+        self.enabled = True
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # thread ident -> (thread name, deque of event dicts)
+        self._rings: Dict[int, tuple] = {}
+        self.dump_dir: Optional[str] = None  # None = _default_dir()
+        self._installed = False
+        self._prev_threading_hook = None
+        self._prev_sys_hook = None
+        self.last_dump_path: Optional[str] = None
+
+    # ---- recording -----------------------------------------------------
+    def _ring_locked(self) -> deque:
+        th = threading.current_thread()
+        entry = self._rings.get(th.ident)
+        if entry is None:
+            entry = (th.name, deque(maxlen=self.capacity))
+            self._rings[th.ident] = entry
+        return entry[1]
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event to the calling thread's ring."""
+        if not self.enabled:
+            return
+        ev = {"t": self.clock(), "kind": kind}
+        if fields:
+            ev.update(fields)
+        with self._lock:
+            self._ring_locked().append(ev)
+
+    def record_span(self, ev: dict) -> None:
+        """Tracer span-sink hook: keep finished spans in the ring too
+        (the tracer passes its own event dict; stored by reference —
+        the tracer never mutates finished events)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring_locked().append(ev)
+
+    def snapshot(self) -> Dict[str, list]:
+        """thread name -> recent events (oldest first)."""
+        with self._lock:
+            out = {}
+            for ident, (name, ring) in self._rings.items():
+                # distinct threads can share a name; key stays unique
+                key = name if name not in out else f"{name}#{ident}"
+                out[key] = list(ring)
+            return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings.clear()
+
+    # ---- dumping -------------------------------------------------------
+    def _thread_stacks(self) -> Dict[str, list]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for ident, frame in sys._current_frames().items():
+            name = names.get(ident, f"thread-{ident}")
+            key = name if name not in out else f"{name}#{ident}"
+            out[key] = [
+                line.rstrip("\n")
+                for line in traceback.format_stack(frame)
+            ]
+        return out
+
+    def dump(
+        self,
+        path: Optional[str] = None,
+        reason: str = "explicit",
+        exc: Optional[BaseException] = None,
+        thread_name: Optional[str] = None,
+    ) -> str:
+        """Write the post-mortem JSON; returns the path written.
+
+        Never raises on serialization trouble (``default=str``) — the
+        dump path runs inside exception handlers where a secondary
+        error would mask the crash being recorded."""
+        if path is None:
+            d = self.dump_dir or _default_dir()
+            os.makedirs(d, exist_ok=True)
+            stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+            path = os.path.join(
+                d, f"flight_{stamp}_{os.getpid()}_{id(self) & 0xffff}.json"
+            )
+        doc = {
+            "tool": "theanompi_tpu.observability.flight",
+            "version": 1,
+            "reason": reason,
+            "time_unix": self.clock(),
+            "pid": os.getpid(),
+            "thread": thread_name or threading.current_thread().name,
+            "exception": (
+                {
+                    "type": type(exc).__name__,
+                    "message": str(exc),
+                    "traceback": traceback.format_exception(
+                        type(exc), exc, exc.__traceback__
+                    ),
+                }
+                if exc is not None
+                else None
+            ),
+            "threads": self.snapshot(),
+            "stacks": self._thread_stacks(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+        self.last_dump_path = path
+        print(
+            f"[flight] post-mortem written to {path} ({reason})",
+            file=sys.stderr,
+            flush=True,
+        )
+        return path
+
+    # ---- unhandled-exception hooks ------------------------------------
+    def install(self) -> None:
+        """Hook ``threading.excepthook`` and ``sys.excepthook`` so ANY
+        unhandled exception dumps before the default handler prints.
+        Idempotent; previous hooks are chained, not replaced."""
+        if self._installed:
+            return
+        self._installed = True
+        self._prev_threading_hook = threading.excepthook
+        self._prev_sys_hook = sys.excepthook
+
+        def _thread_hook(args):
+            try:
+                self.dump(
+                    reason="unhandled exception in thread "
+                    f"{getattr(args.thread, 'name', '?')}",
+                    exc=args.exc_value,
+                    thread_name=getattr(args.thread, "name", None),
+                )
+            except Exception:
+                pass  # never mask the original crash
+            self._prev_threading_hook(args)
+
+        def _sys_hook(tp, val, tb):
+            try:
+                self.dump(reason="unhandled exception", exc=val)
+            except Exception:
+                pass
+            self._prev_sys_hook(tp, val, tb)
+
+        threading.excepthook = _thread_hook
+        sys.excepthook = _sys_hook
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.excepthook = self._prev_threading_hook
+        sys.excepthook = self._prev_sys_hook
+        self._installed = False
+
+
+_FLIGHT = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _FLIGHT

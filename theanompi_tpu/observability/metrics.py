@@ -1,0 +1,366 @@
+"""Metrics registry — labeled counters, gauges, fixed-bucket histograms.
+
+One process-wide registry replaces the ad-hoc aggregation previously
+split across ``runtime/recorder.py`` (per-phase accumulators),
+``serving/metrics.py`` (private percentile math) and
+``utils/benchmark.py`` (one-shot probe dicts): any layer registers an
+instrument once and increments it from hot paths; consumers take one
+atomic ``snapshot()`` or scrape the Prometheus text exposition.
+
+Design constraints:
+
+- **Pure stdlib**, importable without jax.
+- **Cheap writes** — ``inc``/``set``/``observe`` are one lock acquire +
+  a dict update; safe to leave in per-iteration loops.
+- **Atomic snapshot** — every instrument shares the registry's single
+  lock, so a snapshot is one acquisition and internally consistent
+  (no torn histogram where ``_count`` disagrees with the buckets).
+- **Fixed buckets** — histograms are Prometheus-style cumulative-on-
+  exposition fixed upper bounds; no reservoirs, no unbounded storage.
+
+The exact nearest-rank ``percentile`` helper lives here (moved from
+``serving/metrics.py``, which now imports it) — one definition of the
+percentile math for the whole codebase.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# latency-shaped default: 1ms .. 10s (seconds)
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+def percentile(values: Sequence[float], pct: float) -> float:
+    """Nearest-rank percentile (numpy-free, deterministic on small
+    samples).  NaN on empty input."""
+    if not values:
+        return float("nan")
+    v = sorted(values)
+    k = max(0, min(len(v) - 1, int(round(pct / 100.0 * (len(v) - 1)))))
+    return float(v[k])
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    """Base: a named metric with one value slot per label combination.
+
+    The lock is the OWNING REGISTRY's lock (shared), so a registry
+    snapshot is atomic across every instrument with one acquisition.
+    """
+
+    kind = "abstract"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._series: Dict[tuple, object] = {}
+
+    def _series_snapshot_locked(self) -> List[dict]:
+        raise NotImplementedError
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (negative increments rejected)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name}: negative increment {amount}"
+            )
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def _series_snapshot_locked(self) -> List[dict]:
+        return [
+            {"labels": dict(k), "value": v}
+            for k, v in sorted(self._series.items())
+        ]
+
+
+class Gauge(_Instrument):
+    """Point-in-time value (queue depth, slot occupancy, bytes in use)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def _series_snapshot_locked(self) -> List[dict]:
+        return [
+            {"labels": dict(k), "value": v}
+            for k, v in sorted(self._series.items())
+        ]
+
+
+class Histogram(_Instrument):
+    """Fixed-upper-bound bucket histogram (+Inf implicit).
+
+    Stored per-bucket NON-cumulative; the Prometheus exposition emits
+    the standard cumulative ``_bucket{le=...}`` rows plus ``_sum`` and
+    ``_count``.  ``quantile`` interpolates within the winning bucket —
+    an estimate bounded by the bucket width (exact row-level
+    percentiles stay available via ``percentile`` on raw samples,
+    which ``serving.metrics`` keeps for its per-request rows).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help, lock, buckets: Sequence[float]):
+        super().__init__(name, help, lock)
+        bs = tuple(float(b) for b in buckets)
+        if not bs or list(bs) != sorted(set(bs)):
+            raise ValueError(
+                f"histogram {name}: buckets must be sorted distinct "
+                f"upper bounds, got {buckets!r}"
+            )
+        self.buckets = bs
+
+    def _slot_locked(self, key) -> dict:
+        s = self._series.get(key)
+        if s is None:
+            s = {
+                "counts": [0] * (len(self.buckets) + 1),  # +1 = +Inf
+                "sum": 0.0,
+                "count": 0,
+            }
+            self._series[key] = s
+        return s
+
+    def observe(self, value: float, **labels) -> None:
+        value = float(value)
+        # bisect over the fixed bounds: first bucket whose bound >= value
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                break
+        else:
+            i = len(self.buckets)  # +Inf
+        with self._lock:
+            s = self._slot_locked(_label_key(labels))
+            s["counts"][i] += 1
+            s["sum"] += value
+            s["count"] += 1
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated q-quantile (q in [0,1]) by linear interpolation
+        inside the winning bucket; NaN with no observations; the last
+        finite bound when the rank lands in +Inf."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s["count"] == 0:
+                return float("nan")
+            counts = list(s["counts"])
+            total = s["count"]
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank and c > 0:
+                if i >= len(self.buckets):
+                    return float(self.buckets[-1])
+                lo = 0.0 if i == 0 else self.buckets[i - 1]
+                hi = self.buckets[i]
+                frac = (rank - prev_cum) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        return float(self.buckets[-1])
+
+    def _series_snapshot_locked(self) -> List[dict]:
+        out = []
+        for k, s in sorted(self._series.items()):
+            out.append(
+                {
+                    "labels": dict(k),
+                    "buckets": {
+                        ("+Inf" if i == len(self.buckets)
+                         else repr(self.buckets[i])): c
+                        for i, c in enumerate(s["counts"])
+                    },
+                    "sum": s["sum"],
+                    "count": s["count"],
+                }
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Name → instrument map with atomic snapshot and two expositions.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: the first
+    call registers, later calls return the same object (re-registering
+    under a different kind or different buckets is an error — silent
+    redefinition would split a series across shapes).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, self._lock, **kw)
+                self._metrics[name] = m
+                return m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"not {cls.kind}"
+            )
+        if kw.get("buckets") is not None and tuple(
+            float(b) for b in kw["buckets"]
+        ) != m.buckets:
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{m.buckets}; cannot redefine"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def reset(self) -> None:
+        """Clear every series (instrument objects stay registered, so
+        module-level handles keep working) — test isolation hook."""
+        with self._lock:
+            for m in self._metrics.values():
+                m._series.clear()
+
+    # ---- exposition ----------------------------------------------------
+    def snapshot(self) -> dict:
+        """Atomic, JSON-serializable view of every instrument."""
+        with self._lock:
+            return {
+                name: {
+                    "kind": m.kind,
+                    "help": m.help,
+                    **(
+                        {"bucket_bounds": list(m.buckets)}
+                        if isinstance(m, Histogram)
+                        else {}
+                    ),
+                    "series": m._series_snapshot_locked(),
+                }
+                for name, m in sorted(self._metrics.items())
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), indent=2, default=str)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        snap = self.snapshot()
+        lines: List[str] = []
+        for name, doc in snap.items():
+            if doc["help"]:
+                lines.append(f"# HELP {name} {_esc_help(doc['help'])}")
+            lines.append(f"# TYPE {name} {doc['kind']}")
+            for row in doc["series"]:
+                labels = row["labels"]
+                if doc["kind"] in ("counter", "gauge"):
+                    lines.append(
+                        f"{name}{_fmt_labels(labels)} {_fmt_val(row['value'])}"
+                    )
+                else:
+                    cum = 0
+                    bounds = doc["bucket_bounds"]
+                    counts = row["buckets"]
+                    for i, b in enumerate(bounds):
+                        cum += counts[repr(b)]
+                        le = {**labels, "le": _fmt_val(b)}
+                        lines.append(
+                            f"{name}_bucket{_fmt_labels(le)} {cum}"
+                        )
+                    cum += counts["+Inf"]
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels({**labels, 'le': '+Inf'})} {cum}"
+                    )
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(labels)} "
+                        f"{_fmt_val(row['sum'])}"
+                    )
+                    lines.append(
+                        f"{name}_count{_fmt_labels(labels)} {row['count']}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+def _esc_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _esc_label(s: str) -> str:
+    return (
+        s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_esc_label(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_val(v: float) -> str:
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY

@@ -1,0 +1,372 @@
+"""Span tracer — thread-safe, bounded, Chrome-trace/Perfetto exportable.
+
+The reference's ``Recorder`` timed calc/comm/wait per iteration with
+wall clocks (upstream ``lib/recorder.py``; SURVEY.md §3.7) — a table,
+not a timeline.  This tracer keeps the timeline: every instrumented
+region becomes a *span* (name, start, duration, thread track, args)
+in a bounded in-memory buffer, exportable as Chrome trace-event JSON
+that loads directly in ``chrome://tracing`` or https://ui.perfetto.dev.
+
+Contracts:
+
+- **Pure stdlib** — importable with no jax on the path (like
+  ``analysis/``): the crashed-worker post-mortem path must never
+  depend on the library that crashed.
+- **Disabled is a no-op** — ``span()`` with tracing off returns a
+  shared singleton whose enter/exit do nothing, so instrumentation
+  stays in hot loops permanently (tier-1 guards the per-span cost;
+  tests/test_observability.py::test_disabled_span_overhead).
+- **Monotonic clocks** — timestamps come from ``time.perf_counter``
+  (never wall clock), relative to the tracer's epoch, so spans across
+  threads order correctly and NTP steps can't fold a trace.
+- **Bounded buffer** — a ``deque(maxlen=...)`` of finished spans; a
+  week-long run keeps the newest window instead of OOMing the host.
+- **Track ids** — ``pid`` is the worker/process track (defaults to
+  ``os.getpid()``; SPMD launchers override it with the process index
+  via ``set_process`` so merged traces line ranks up), ``tid`` is a
+  small per-thread id assigned in first-span order and named after the
+  thread (``EASGD_Worker-0`` etc. — the driver names its threads).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from functools import wraps
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_BUFFER = 100_000
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-tracer fast path allocates
+    nothing and touches no lock."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def set(self, **args) -> None:
+        """Attach result fields discovered inside the span (e.g. bytes
+        actually sent)."""
+        self._args.update(args)
+
+    def __enter__(self):
+        self._t0 = self._tracer.clock()
+        return self
+
+    def __exit__(self, *exc):
+        t = self._tracer
+        t.add_span(self._name, self._t0, t.clock(), self._args or None)
+        return False
+
+
+class Tracer:
+    """Thread-safe span collector with Chrome-trace export.
+
+    ``clock`` is injectable (tests drive a fake timeline for the golden
+    file); it must be monotonic and return seconds.  ``pid`` overrides
+    the process track id (SPMD rank); ``buffer`` bounds the number of
+    retained events (oldest dropped first).
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        pid: Optional[int] = None,
+        buffer: int = DEFAULT_BUFFER,
+        process_name: Optional[str] = None,
+    ):
+        import os
+
+        self.enabled = False
+        self.clock = clock
+        self.pid = os.getpid() if pid is None else int(pid)
+        self.process_name = process_name
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=int(buffer))
+        self._epoch = clock()
+        # thread ident -> (small tid, thread name at registration)
+        self._tracks: Dict[int, tuple] = {}
+        self.dropped = 0  # events evicted by the bound (visible, not silent)
+        # called with each finished span dict (flight recorder feed);
+        # invoked outside the buffer lock
+        self.span_sinks: List[Callable[[dict], None]] = []
+
+    # ---- lifecycle -----------------------------------------------------
+    def enable(self, buffer: Optional[int] = None) -> None:
+        with self._lock:
+            if buffer is not None and buffer != self._buf.maxlen:
+                self._buf = deque(self._buf, maxlen=int(buffer))
+            self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._tracks.clear()
+            self.dropped = 0
+            self._epoch = self.clock()
+
+    def set_process(self, pid: int, name: Optional[str] = None) -> None:
+        """Re-label this tracer's process track (e.g. the SPMD process
+        index) so multi-rank traces merge onto distinct named rows."""
+        self.pid = int(pid)
+        if name is not None:
+            self.process_name = name
+
+    # ---- recording -----------------------------------------------------
+    def _track_locked(self) -> int:
+        th = threading.current_thread()
+        entry = self._tracks.get(th.ident)
+        if entry is None:
+            entry = (len(self._tracks), th.name)
+            self._tracks[th.ident] = entry
+        return entry[0]
+
+    def _push_locked(self, ev: dict) -> None:
+        if len(self._buf) == self._buf.maxlen:
+            self.dropped += 1
+        self._buf.append(ev)
+
+    def _us(self, t: float) -> float:
+        return round((t - self._epoch) * 1e6, 3)
+
+    def add_span(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """Record a completed span from explicit ``clock()`` timestamps
+        — the path ``Recorder.end`` uses (it already holds t0/dt)."""
+        if not self.enabled:
+            return
+        ev = {
+            "ph": "X",
+            "name": name,
+            "ts": self._us(start),
+            "dur": round(max(0.0, end - start) * 1e6, 3),
+            "pid": self.pid,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            ev["tid"] = self._track_locked()
+            self._push_locked(ev)
+        for sink in self.span_sinks:
+            sink(ev)
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """One point-in-time event (Chrome 'instant', thread-scoped)."""
+        if not self.enabled:
+            return
+        ev = {
+            "ph": "i",
+            "name": name,
+            "ts": self._us(self.clock()),
+            "s": "t",
+            "pid": self.pid,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            ev["tid"] = self._track_locked()
+            self._push_locked(ev)
+
+    def span(self, name: str, **args):
+        """Context manager measuring a region; no-op when disabled."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name, args)
+
+    # ---- export --------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._buf)
+
+    def _meta_events(self) -> List[dict]:
+        out = []
+        if self.process_name:
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "process_name",
+                    "pid": self.pid,
+                    "tid": 0,
+                    "args": {"name": self.process_name},
+                }
+            )
+        with self._lock:
+            tracks = list(self._tracks.values())
+        for tid, name in sorted(tracks):
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": self.pid,
+                    "tid": tid,
+                    "args": {"name": name},
+                }
+            )
+        return out
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event document (JSON Object Format):
+        metadata rows naming the tracks, then every buffered event.
+        Loads as-is in chrome://tracing and ui.perfetto.dev."""
+        return {
+            "traceEvents": self._meta_events() + self.snapshot(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "theanompi_tpu.observability",
+                "dropped_events": self.dropped,
+            },
+        }
+
+    def export_chrome(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f, default=str)
+            f.write("\n")
+        return path
+
+    def save_raw(self, path: str) -> str:
+        """JSONL dump: one header line (track names), then one event per
+        line — the offline format ``python -m theanompi_tpu.observability
+        dump`` converts to Chrome JSON."""
+        with self._lock:
+            tracks = list(self._tracks.values())
+        header = {
+            "kind": "header",
+            "pid": self.pid,
+            "process_name": self.process_name,
+            "tracks": {str(tid): name for tid, name in tracks},
+            "dropped": self.dropped,
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(header, default=str) + "\n")
+            for ev in self.snapshot():
+                f.write(json.dumps(ev, default=str) + "\n")
+        return path
+
+
+def raw_to_chrome(lines) -> dict:
+    """Rebuild the Chrome trace document from ``save_raw`` JSONL lines
+    (string iterable).  Unknown lines are skipped, not fatal — a raw
+    file truncated by a crash should still open in Perfetto."""
+    meta: List[dict] = []
+    events: List[dict] = []
+    dropped = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("kind") == "header":
+            pid = doc.get("pid", 0)
+            dropped = int(doc.get("dropped", 0) or 0)
+            if doc.get("process_name"):
+                meta.append(
+                    {
+                        "ph": "M",
+                        "name": "process_name",
+                        "pid": pid,
+                        "tid": 0,
+                        "args": {"name": doc["process_name"]},
+                    }
+                )
+            for tid, name in sorted((doc.get("tracks") or {}).items()):
+                meta.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": pid,
+                        "tid": int(tid),
+                        "args": {"name": name},
+                    }
+                )
+        elif "ph" in doc:
+            events.append(doc)
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "theanompi_tpu.observability",
+            "dropped_events": dropped,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# module-level singleton + convenience API (what call sites import)
+# ---------------------------------------------------------------------------
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, **args):
+    """``with span("prefill", slot=i): ...`` — the one-line hot-path
+    instrumentation idiom.  Returns the shared no-op when disabled."""
+    t = _TRACER
+    if not t.enabled:
+        return _NOOP
+    return _Span(t, name, args)
+
+
+def instant(name: str, args: Optional[dict] = None) -> None:
+    _TRACER.instant(name, args)
+
+
+def add_span(name: str, start: float, end: float, args=None) -> None:
+    _TRACER.add_span(name, start, end, args)
+
+
+def traced(name: Optional[str] = None):
+    """Decorator form: ``@traced()`` (or ``@traced("label")``) wraps the
+    function body in a span."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @wraps(fn)
+        def wrapper(*a, **kw):
+            t = _TRACER
+            if not t.enabled:
+                return fn(*a, **kw)
+            with _Span(t, label, {}):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco

@@ -33,11 +33,22 @@ from typing import Any, List, Optional
 import jax
 import numpy as np
 
+from theanompi_tpu import observability as obs
 from theanompi_tpu.parallel.transport import Mailbox
 from theanompi_tpu.runtime.mesh import make_mesh, replicate
 from theanompi_tpu.runtime.recorder import Recorder
 
 Pytree = Any
+
+_REG = obs.get_registry()
+_EXCHANGES = _REG.counter(
+    "easgd_exchanges_total", "elastic worker<->center exchanges"
+)
+_PUSHES = _REG.counter("gosgd_pushes_total", "gossip pushes sent")
+_MERGES = _REG.counter("gosgd_merges_total", "gossip messages merged in")
+_WEIGHT = _REG.gauge(
+    "gosgd_consensus_weight", "per-worker gossip consensus weight"
+)
 
 
 def _to_host(tree: Pytree) -> Pytree:
@@ -94,6 +105,7 @@ class EASGD_Server:
                 lambda c, d: c + a * d, self.center, diff
             )
             self.n_exchanges += 1
+            _EXCHANGES.inc()
             return jax.tree.map(lambda w, d: w - a * d, worker_params, diff)
 
 
@@ -173,6 +185,23 @@ class _AsyncWorkerBase:
             self._run()
         except BaseException as e:  # joined + re-raised by the driver
             self.error = e
+            # the driver re-raises this LATER, after every thread
+            # joins — by then this thread's live state is gone, so the
+            # flight recorder dumps the post-mortem NOW (recent spans/
+            # events per thread + all-thread stacks); diagnostics must
+            # never mask the original failure
+            try:
+                obs.get_flight_recorder().dump(
+                    reason=f"{type(self).__name__} rank {self.rank} "
+                    "raised",
+                    exc=e,
+                )
+            except Exception as de:
+                print(
+                    f"flight dump failed for worker {self.rank}: "
+                    f"{type(de).__name__}: {de}",
+                    flush=True,
+                )
         finally:
             if self.on_exit is not None:
                 self.on_exit(self.rank)
@@ -255,6 +284,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
         self.weight = a_i
         self.set_params(w_i)
         self.n_merges += len(msgs)
+        _MERGES.inc(len(msgs), rank=str(self.rank))
+        _WEIGHT.set(self.weight, rank=str(self.rank))
         self.recorder.end("comm")
 
     def _maybe_push(self):
@@ -267,6 +298,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
         try:
             self.mailbox.send(dst, (self.get_params(), self.weight))
             self.n_pushes += 1
+            _PUSHES.inc(rank=str(self.rank))
+            _WEIGHT.set(self.weight, rank=str(self.rank))
         except (ConnectionError, OSError):
             # peer unreachable (cross-process: exited/crashed) — undo
             # the halving so the consensus weight mass isn't lost, and

@@ -283,6 +283,80 @@ class BSP_Exchanger:
         avg's parameter averaging."""
         return self._tree_wire_map(self._reduce_leaf_mean, tree, specs, rng)
 
+    # -- wire-byte attribution --------------------------------------------
+    def _leaf_wire_bytes_est(self, g, axes: tuple) -> int:
+        """Estimated one-way collective payload bytes for one leaf, per
+        step, under this strategy — mirrors ``_leg1_pack``'s fallback
+        arithmetic without running kernels.  An attribution number for
+        the metrics registry (shapes are static at trace time), not the
+        exact post-optimization wire: ``utils.benchmark.
+        collective_wire_bytes`` stays the HLO-parsed ground truth."""
+        from theanompi_tpu.parallel import quantize as Q
+
+        n = int(g.size)
+        total = 0
+        for a in axes:
+            # ar/cast exchangers may be built without a mesh; their
+            # payload size doesn't depend on world, so assume the axis
+            # is live (world 2) rather than silently reporting zero
+            world = (
+                int(self._axis_sizes[a]) if self._axis_sizes else 2
+            )
+            if world == 1:
+                continue
+            if self.strategy == "ar":
+                total += 4 * n
+            elif self.strategy in ("bf16", "fp16"):
+                total += 2 * n
+            else:  # block strategies: quantized payload + fp32 scales
+                pallas = self.strategy.startswith("pallas_")
+                chunk = world * Q.BLOCK * (32 if pallas else 1)
+                pb = 2 if self.strategy in _FP16S_STRATEGIES else 1
+                if 4 * n < chunk * pb:
+                    total += 4 * n  # rides the fp32-psum fallback
+                else:
+                    padded = n + ((-n) % chunk)
+                    total += padded * pb + (padded // Q.BLOCK) * 4
+        return total
+
+    def _record_wire_estimate(
+        self, tree: Pytree, specs: Optional[Pytree], op: str
+    ) -> None:
+        """Publish the per-step wire estimate as a gauge.  Runs at
+        TRACE time (this method executes while XLA traces the step), so
+        the cost is one host-side walk per compile, zero per step —
+        exactly the cadence a per-step-constant deserves."""
+        from theanompi_tpu.observability import get_registry
+
+        total = [0]
+        if specs is None:
+            jax.tree.map(
+                lambda g: total.__setitem__(
+                    0,
+                    total[0] + self._leaf_wire_bytes_est(
+                        g, self._axes_tuple()
+                    ),
+                ),
+                tree,
+            )
+        else:
+            jax.tree.map(
+                lambda g, s: total.__setitem__(
+                    0,
+                    total[0] + self._leaf_wire_bytes_est(
+                        g, self._leaf_axes(s)
+                    ),
+                ),
+                tree,
+                specs,
+            )
+        get_registry().gauge(
+            "exchanger_wire_bytes_per_step",
+            "estimated one-way collective payload bytes per step "
+            "(trace-time static estimate; see collective_wire_bytes "
+            "for the HLO-parsed exact number)",
+        ).set(total[0], strategy=self.strategy, op=op)
+
     # -- error-feedback support -------------------------------------------
     @staticmethod
     def _img_from_packed(packed, g):
@@ -419,6 +493,7 @@ class BSP_Exchanger:
         """``(reduce_grads(grads), local_roundtrip(grads))`` computed
         with a single leg-1 quantization per leaf — what compile_train's
         error-feedback branch uses."""
+        self._record_wire_estimate(grads, specs, "reduce_grads")
         rts = []
 
         def leaf(g, axes, k):
@@ -450,6 +525,7 @@ class BSP_Exchanger:
         models; ``None`` means fully replicated params (plain DP).
         ``rng``: per-step key, required by (and only used for) the
         ``int8_sr`` stochastic-rounding wire."""
+        self._record_wire_estimate(grads, specs, "reduce_grads")
         return self._tree_mean(grads, specs, rng)
 
     def sum_grads(self, grads: Pytree) -> Pytree:
@@ -468,6 +544,7 @@ class BSP_Exchanger:
         modes; SURVEY.md §3.3), and a configured compressed strategy
         silently falling back to an fp32 pmean misrepresented the one
         thing this layer is about (VERDICT r3 weak #4)."""
+        self._record_wire_estimate(params, specs, "average_params")
         return self._tree_mean(params, specs, rng)
 
     def __repr__(self):

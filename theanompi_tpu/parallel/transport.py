@@ -31,6 +31,20 @@ import struct
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from theanompi_tpu import observability as obs
+
+_REG = obs.get_registry()
+_BYTES_SENT = _REG.counter(
+    "transport_bytes_sent_total", "wire-encoded payload bytes sent"
+)
+_BYTES_RECV = _REG.counter(
+    "transport_bytes_received_total", "wire-encoded payload bytes decoded"
+)
+_FRAMES_SENT = _REG.counter("transport_frames_sent_total", "frames sent")
+_INBOX_DEPTH = _REG.gauge(
+    "transport_inbox_depth", "messages queued awaiting drain/recv"
+)
+
 
 class Mailbox:
     """N addressable inboxes with nonblocking drain (MPI iprobe analog)."""
@@ -41,6 +55,10 @@ class Mailbox:
 
     def send(self, dst: int, msg: Any) -> None:
         self._queues[dst].put(msg)
+        _FRAMES_SENT.inc(transport="mailbox")
+        _INBOX_DEPTH.set(
+            self._queues[dst].qsize(), transport="mailbox", rank=str(dst)
+        )
 
     def drain(self, rank: int) -> List[Any]:
         """All currently-queued messages for ``rank`` (nonblocking)."""
@@ -50,6 +68,9 @@ class Mailbox:
             try:
                 out.append(q.get_nowait())
             except queue.Empty:
+                _INBOX_DEPTH.set(
+                    q.qsize(), transport="mailbox", rank=str(rank)
+                )
                 return out
 
     def recv(self, rank: int, timeout: Optional[float] = None) -> Any:
@@ -170,7 +191,13 @@ class TcpMailbox:
         try:
             with conn:
                 while True:
-                    self._q.put(self._wire.decode(recv_frame(conn)))
+                    payload = recv_frame(conn)
+                    self._q.put(self._wire.decode(payload))
+                    _BYTES_RECV.inc(len(payload), transport="tcp")
+                    _INBOX_DEPTH.set(
+                        self._q.qsize(), transport="tcp",
+                        rank=str(self.rank),
+                    )
         except (ConnectionError, OSError):
             pass  # clean EOF between frames lands here too
         except Exception:
@@ -193,31 +220,38 @@ class TcpMailbox:
             if conn is None:
                 conn = self._out[dst] = _OutConn()
         payload = self._wire.encode(msg)
-        with conn.lock:
-            for attempt in (0, 1):
-                if conn.sock is None:
-                    host, port = self.addresses[dst]
-                    fresh = socket.create_connection((host, port), timeout=60)
-                    # commit under _out_lock: a close() racing this send
-                    # must not leak a socket it already iterated past
-                    with self._out_lock:
-                        if self._closed:
-                            fresh.close()
-                            raise OSError("TcpMailbox is closed")
-                        conn.sock = fresh
+        # comm-time attribution: the span covers connect+write (the
+        # host-side cost a sender pays), the counters carry bytes moved
+        with obs.span("tcp_send", dst=dst, bytes=len(payload)), conn.lock:
+            self._send_locked(conn, dst, payload)
+        _BYTES_SENT.inc(len(payload), transport="tcp")
+        _FRAMES_SENT.inc(transport="tcp")
+
+    def _send_locked(self, conn: "_OutConn", dst: int, payload: bytes) -> None:
+        for attempt in (0, 1):
+            if conn.sock is None:
+                host, port = self.addresses[dst]
+                fresh = socket.create_connection((host, port), timeout=60)
+                # commit under _out_lock: a close() racing this send
+                # must not leak a socket it already iterated past
+                with self._out_lock:
+                    if self._closed:
+                        fresh.close()
+                        raise OSError("TcpMailbox is closed")
+                    conn.sock = fresh
+            try:
+                send_frame(conn.sock, payload)
+                return
+            except OSError:
+                # stale connection (receiver restarted): retry once
+                # on a fresh socket, then propagate
                 try:
-                    send_frame(conn.sock, payload)
-                    return
+                    conn.sock.close()
                 except OSError:
-                    # stale connection (receiver restarted): retry once
-                    # on a fresh socket, then propagate
-                    try:
-                        conn.sock.close()
-                    except OSError:
-                        pass
-                    conn.sock = None
-                    if attempt:
-                        raise
+                    pass
+                conn.sock = None
+                if attempt:
+                    raise
 
     def drain(self, rank: Optional[int] = None) -> List[Any]:
         """All queued messages (``rank`` accepted for Mailbox interface
@@ -227,6 +261,9 @@ class TcpMailbox:
             try:
                 out.append(self._q.get_nowait())
             except queue.Empty:
+                _INBOX_DEPTH.set(
+                    self._q.qsize(), transport="tcp", rank=str(self.rank)
+                )
                 return out
 
     def recv(self, rank: Optional[int] = None, timeout: Optional[float] = None) -> Any:

@@ -17,7 +17,18 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from theanompi_tpu import observability as obs
 from theanompi_tpu.runtime.recorder import Recorder
+
+_REG = obs.get_registry()
+_ITERS = _REG.counter(
+    "train_iterations_total", "completed training iterations"
+)
+_EPOCHS = _REG.counter("train_epochs_total", "completed training epochs")
+_MEM_GAUGE = _REG.gauge(
+    "device_memory_bytes", "device-memory snapshot (stat label: in_use/"
+    "peak/limit) from jax memory_stats"
+)
 
 
 class BSP_Worker:
@@ -56,6 +67,9 @@ class BSP_Worker:
         import jax
 
         self.process_index = jax.process_index()
+        # trace track = SPMD rank, so merged multi-process traces line
+        # ranks up on named rows instead of colliding on host pids
+        obs.set_process(self.process_index, f"rank{self.process_index}")
         self.model = model
         if recorder is not None and tensorboard_dir is not None:
             raise ValueError(
@@ -118,6 +132,11 @@ class BSP_Worker:
             peak_bytes_in_use=int(stats.get("peak_bytes_in_use", 0)),
             bytes_limit=int(stats.get("bytes_limit", 0)),
         )
+        _MEM_GAUGE.set(int(stats.get("bytes_in_use", 0)), stat="in_use")
+        _MEM_GAUGE.set(
+            int(stats.get("peak_bytes_in_use", 0)), stat="peak"
+        )
+        _MEM_GAUGE.set(int(stats.get("bytes_limit", 0)), stat="limit")
 
     def _prune_checkpoints(self) -> None:
         """Retention: rank 0 trims the checkpoint dir to ``keep_last``
@@ -249,7 +268,9 @@ class BSP_Worker:
                 model.reset_train_iter(epoch)
                 for _ in range(model.data.n_batch_train):
                     count += 1
-                    model.train_iter(count, rec)
+                    with obs.span("train_iter", iter=count):
+                        model.train_iter(count, rec)
+                    _ITERS.inc(rule="bsp")
                     rec.print_train_info(count)
                     if self._watchdog is not None:
                         self._watchdog.tick()
@@ -262,6 +283,7 @@ class BSP_Worker:
                     else:
                         model.run_validation(count, rec)
                 rec.end_epoch(count, epoch)
+                _EPOCHS.inc(rule="bsp")
                 self._log_memory(rec, f"epoch_{epoch + 1}")
                 # comm re-probe every comm_probe_every epochs (default
                 # 5 — per-epoch probing cost ~8 extra compiled steps and

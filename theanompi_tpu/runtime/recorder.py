@@ -24,6 +24,8 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from theanompi_tpu import observability as _obs
+
 PHASES = ("calc", "comm", "wait", "load")
 
 
@@ -81,8 +83,12 @@ class Recorder:
         t0 = self._t0.pop(what, None)
         if t0 is None:
             return 0.0
-        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        dt = now - t0
         self._acc[what] = self._acc.get(what, 0.0) + dt
+        # every start/end pair is also a trace span (no-op when tracing
+        # is off) — the phase columns become a timeline for free
+        _obs.add_span(what, t0, now)
         return dt
 
     # ---- epoch ----------------------------------------------------------
@@ -90,11 +96,10 @@ class Recorder:
         self.epoch_start = time.perf_counter()
 
     def end_epoch(self, count: int, epoch: int) -> float:
-        dt = (
-            time.perf_counter() - self.epoch_start
-            if self.epoch_start is not None
-            else 0.0
-        )
+        now = time.perf_counter()
+        dt = now - self.epoch_start if self.epoch_start is not None else 0.0
+        if self.epoch_start is not None:
+            _obs.add_span("epoch", self.epoch_start, now, {"epoch": epoch})
         if self.verbose and self.rank == 0:
             print(f"epoch {epoch} took {dt:.2f}s", flush=True)
         if self._tb is not None:
@@ -163,6 +168,13 @@ class Recorder:
         SURVEY.md §3.7)."""
         row = {"kind": kind, **fields}
         self.events.append(row)
+        # thin forwarder into the observability bus (instant trace
+        # event + flight ring + events_total counter + subscribers):
+        # every existing log_event call site gains tracing for free.
+        # The recorder's own row above stays the JSONL contract — the
+        # bus reads `fields`, never mutates it (regression-tested:
+        # tests/test_observability.py::test_log_event_bus_roundtrip).
+        _obs.publish_event(kind, fields)
         if self._tb is not None:
             self._tb.add_text(f"event/{kind}", json.dumps(fields))
         if self.verbose and self.rank == 0:

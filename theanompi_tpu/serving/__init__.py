@@ -14,8 +14,13 @@ serving side with the same sharded-parameter machinery:
   (``utils/checkpoint.restore``) and re-lay the params into inference
   sharding (reusing ``TransformerLM._build_param_specs``).
 - ``metrics``   — per-request TTFT / TPOT / throughput counters emitted
-  through ``runtime.recorder.Recorder.log_event`` so serving shares the
-  training observability pipeline.
+  through ``runtime.recorder.Recorder.log_event`` (and, via the
+  observability bus, into the process-wide metrics registry /
+  trace timeline) so serving shares the training observability
+  pipeline.
+- ``sampling``  — temperature / top-k stochastic sampling on the decode
+  path: seeded per-request PRNG keys, ``temperature=0`` preserved as
+  exact greedy, zero recompiles across sampling-config changes.
 
 Bench entry point: ``bench_serve.py`` at the repo root (hooked from
 ``bench.py`` via ``THEANOMPI_BENCH_SERVE=1``) produces the
@@ -25,12 +30,14 @@ Bench entry point: ``bench_serve.py`` at the repo root (hooked from
 from theanompi_tpu.serving.engine import ServingEngine
 from theanompi_tpu.serving.loader import load_engine, restore_params_for_serving
 from theanompi_tpu.serving.metrics import ServingMetrics
+from theanompi_tpu.serving.sampling import Sampler
 from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
 
 __all__ = [
     "ServingEngine",
     "ContinuousBatchingScheduler",
     "Request",
+    "Sampler",
     "ServingMetrics",
     "load_engine",
     "restore_params_for_serving",

@@ -44,9 +44,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from theanompi_tpu import observability as obs
 from theanompi_tpu.runtime.mesh import DATA_AXIS, TP_AXIS
 
 _NEG_INF = -1e30  # same finite mask value as parallel.ring_attention
+
+_PREFILLS = obs.get_registry().counter(
+    "serve_prefills_total",
+    "prefill dispatches by padded bucket length (compile-cache "
+    "visibility: one distinct bucket label per compiled program)",
+)
 
 
 def default_buckets(max_len: int, lo: int = 16) -> Tuple[int, ...]:
@@ -330,10 +337,12 @@ class ServingEngine:
         b = self.pick_bucket(n)
         padded = np.zeros((b,), np.int32)
         padded[:n] = toks
-        return self._prefill_jit(
-            params, cache, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(n),
-        )
+        _PREFILLS.inc(bucket=str(b))
+        with obs.span("prefill_dispatch", bucket=b, true_len=n):
+            return self._prefill_jit(
+                params, cache, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(n),
+            )
 
     # ------------------------------------------------------------------
     # decode: one token for every slot

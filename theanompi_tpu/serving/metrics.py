@@ -23,15 +23,26 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+from theanompi_tpu import observability as obs
+# the ONE percentile definition (nearest-rank) now lives in the
+# observability subsystem; re-exported here for existing importers
+from theanompi_tpu.observability.metrics import percentile  # noqa: F401
 
-def percentile(values: List[float], pct: float) -> float:
-    """Nearest-rank percentile (numpy-free at call sites that feed the
-    JSON line; deterministic on small samples)."""
-    if not values:
-        return float("nan")
-    v = sorted(values)
-    k = max(0, min(len(v) - 1, int(round(pct / 100.0 * (len(v) - 1)))))
-    return float(v[k])
+_REG = obs.get_registry()
+# sub-ms .. 30s: TTFT spans queue wait + a whole prefill, TPOT one
+# decode tick — both fit this latency-shaped range on CPU rigs and TPU
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+_TTFT = _REG.histogram(
+    "serve_ttft_seconds", "time to first token (admission -> first token)",
+    buckets=_LATENCY_BUCKETS,
+)
+_TPOT = _REG.histogram(
+    "serve_tpot_seconds", "time per output token after the first",
+    buckets=_LATENCY_BUCKETS,
+)
 
 
 class ServingMetrics:
@@ -77,6 +88,13 @@ class ServingMetrics:
             "t_done": t,
         }
         self.rows.append(done)
+        # registry histograms alongside the exact per-request rows: the
+        # rows keep powering the exact nearest-rank summary(); the
+        # histograms power /metrics scrapes and cross-subsystem
+        # snapshots without retaining unbounded row lists
+        _TTFT.observe(done["ttft_s"])
+        if done["n_out"] > 1:
+            _TPOT.observe(done["tpot_s"])
         if self.recorder is not None:
             self.recorder.log_event(
                 "serve_request",

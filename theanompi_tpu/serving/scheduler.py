@@ -13,11 +13,16 @@ so admission/retirement cause ZERO recompilation.
 Determinism contract (tested): every per-slot computation in the engine
 is independent across the slot axis, so a request's output under any
 interleaving equals its output under serial execution — continuous
-batching changes latency, never results.
+batching changes latency, never results.  Sampling requests keep the
+same property: each draw is keyed by the request's seed folded with its
+token index (``serving.sampling.request_key``), never by batch
+position or tick number.
 
-Greedy (argmax) sampling only, deliberately: the parity tests and the
-bench both need bit-reproducible outputs; stochastic sampling belongs in
-a later PR on top of the same logits.
+Sampling: ``temperature=0`` (the default) is the greedy argmax path,
+bit-identical to the parity-tested decode; ``temperature>0`` samples
+from the temperature-scaled, optionally top-k-filtered logits through
+one shared jitted sampler — sampling-config changes cause ZERO
+recompiles (see ``serving/sampling.py``).
 """
 
 from __future__ import annotations
@@ -28,15 +33,35 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from theanompi_tpu import observability as obs
+
+_REG = obs.get_registry()
+_TOKENS = _REG.counter(
+    "serve_tokens_generated_total", "tokens generated across requests"
+)
+_ADMITTED = _REG.counter("serve_requests_admitted_total", "requests admitted")
+_FINISHED = _REG.counter("serve_requests_finished_total", "requests finished")
+_SLOTS = _REG.gauge("serve_slots_active", "decode slots currently occupied")
+_QUEUE = _REG.gauge("serve_queue_depth", "requests waiting for a slot")
+
 
 @dataclass
 class Request:
-    """One generation request."""
+    """One generation request.
+
+    ``temperature=0`` = greedy (exact argmax — the default and the
+    parity-tested path); ``temperature>0`` samples, optionally through
+    a ``top_k`` filter, deterministically per ``seed`` (unseeded
+    requests derive a stable seed from their id).
+    """
 
     id: str
     prompt: List[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: Optional[int] = None
     # filled by the scheduler
     output: List[int] = field(default_factory=list)
 
@@ -46,6 +71,16 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.id!r}: max_new_tokens must be >= 1"
+            )
+        if self.temperature < 0:
+            raise ValueError(
+                f"request {self.id!r}: temperature must be >= 0 "
+                f"(0 = greedy), got {self.temperature}"
+            )
+        if self.top_k < 0:
+            raise ValueError(
+                f"request {self.id!r}: top_k must be >= 0 "
+                f"(0 = disabled), got {self.top_k}"
             )
 
 
@@ -79,6 +114,7 @@ class ContinuousBatchingScheduler:
         self.finished: Dict[str, List[int]] = {}
         self._tokens = np.zeros((engine.n_slots,), np.int32)
         self._active = np.zeros((engine.n_slots,), bool)
+        self._sampler = None  # built lazily on the first sampling request
 
     # ------------------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -92,6 +128,8 @@ class ContinuousBatchingScheduler:
             self.metrics.admitted(request.id, len(request.prompt),
                                   t=self.clock())
         self.queue.append(request)
+        _ADMITTED.inc()
+        _QUEUE.set(len(self.queue))
 
     @property
     def n_active(self) -> int:
@@ -105,6 +143,28 @@ class ContinuousBatchingScheduler:
         slot.request = None
         slot.produced = 0
         self._active[i] = False
+        _FINISHED.inc()
+        _SLOTS.set(self.n_active)
+
+    def _pick_token(self, req: Request, logits) -> int:
+        """Next token for ``req`` from its logits (V,): exact host
+        argmax for greedy requests (unchanged path), the shared jitted
+        sampler otherwise, keyed by seed + token index so interleaving
+        can never perturb a request's stream."""
+        import jax.numpy as jnp
+
+        if req.temperature == 0.0:
+            return int(jnp.argmax(logits))
+        if self._sampler is None:
+            from theanompi_tpu.serving.sampling import Sampler
+
+            self._sampler = Sampler()
+        from theanompi_tpu.serving.sampling import request_key
+
+        key = request_key(req.seed, req.id, len(req.output))
+        return self._sampler.sample(
+            logits, key, req.temperature, req.top_k
+        )
 
     def _emit(self, i: int, token: int) -> bool:
         """Append one generated token to slot i's request; True when the
@@ -134,12 +194,16 @@ class ContinuousBatchingScheduler:
                 continue
             req = self.queue.pop(0)
             slot.request = req
-            self.cache, logits = self.engine.prefill(
-                self.params, self.cache, i, req.prompt
-            )
+            with obs.span("prefill", slot=i, rid=req.id,
+                          n_prompt=len(req.prompt)):
+                self.cache, logits = self.engine.prefill(
+                    self.params, self.cache, i, req.prompt
+                )
             self._active[i] = True
+            _SLOTS.set(self.n_active)
+            _QUEUE.set(len(self.queue))
             produced += 1
-            if self._emit(i, int(jnp.argmax(logits))):
+            if self._emit(i, self._pick_token(req, logits)):
                 self._finish(i)
         # 2) one fixed-shape decode tick over the active slots
         if self._active.any():
@@ -149,16 +213,26 @@ class ContinuousBatchingScheduler:
                     slot.request.output[-1] if self._active[i] else 0
                 )
             was_active = self._active.copy()
-            self.cache, logits = self.engine.decode_step(
-                self.params, self.cache, self._tokens, self._active
-            )
+            with obs.span("decode_step", active=int(was_active.sum())):
+                self.cache, logits = self.engine.decode_step(
+                    self.params, self.cache, self._tokens, self._active
+                )
+            # greedy slots keep the one batched argmax (unchanged hot
+            # path); sampling slots draw per-slot from their own row
             arg = np.asarray(jnp.argmax(logits, axis=-1))
             for i in range(len(self.slots)):
                 if not was_active[i]:
                     continue
+                req = self.slots[i].request
                 produced += 1
-                if self._emit(i, int(arg[i])):
+                tok = (
+                    int(arg[i])
+                    if req.temperature == 0.0
+                    else self._pick_token(req, logits[i])
+                )
+                if self._emit(i, tok):
                     self._finish(i)
+        _TOKENS.inc(produced)
         return produced
 
     def run(self, max_ticks: int = 100_000) -> Dict[str, List[int]]:

@@ -16,7 +16,14 @@ from typing import Dict, List, Optional, Sequence
 
 import jax
 
+from theanompi_tpu import observability as obs
 from theanompi_tpu.runtime.mesh import make_mesh, shard_batch
+
+_COMM_FRACTION = obs.get_registry().gauge(
+    "comm_fraction",
+    "measured exchange share of step time (step-with vs step-without "
+    "exchange, differenced)",
+)
 
 
 # THE perf-knob config registry (docs/perf/NOTES.md) — the single
@@ -87,11 +94,12 @@ def measure_step_time(
         x, y = batches[i % len(batches)]
         p, s, o, loss, _ = fn(p, s, o, x, y, keys[i])
     jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        x, y = batches[i % len(batches)]
-        p, s, o, loss, _ = fn(p, s, o, x, y, keys[warmup + i])
-    jax.block_until_ready(loss)
+    with obs.span("measure_step_time", n_steps=n_steps):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            x, y = batches[i % len(batches)]
+            p, s, o, loss, _ = fn(p, s, o, x, y, keys[warmup + i])
+        jax.block_until_ready(loss)
     return (time.perf_counter() - t0) / n_steps
 
 
@@ -234,12 +242,14 @@ def comm_fraction_probe(
             data_rng.set_state(rng_state)
         if rebuilt:
             model.compile_train()
+    frac = max(0.0, 1.0 - t_without / t_with)
+    _COMM_FRACTION.set(frac, probe="differenced")
     return {
         "n_dp": n_dp,
         "step_with_exchange_s": t_with,
         "step_without_exchange_s": t_without,
         "comm_s": max(0.0, t_with - t_without),
-        "comm_fraction": max(0.0, 1.0 - t_without / t_with),
+        "comm_fraction": frac,
     }
 
 
