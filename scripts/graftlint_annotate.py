@@ -43,6 +43,8 @@ def _annotation(f: dict) -> str:
     # workflow-command syntax: properties already exclude newlines; the
     # message must escape % CR LF per the spec
     msg = f"[{f['rule']}] {f['message']}"
+    if f.get("fixable"):
+        msg += "  (auto-fixable: python -m theanompi_tpu.analysis --fix)"
     for raw, esc in (("%", "%25"), ("\r", "%0D"), ("\n", "%0A")):
         msg = msg.replace(raw, esc)
     return (

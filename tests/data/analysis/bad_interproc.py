@@ -1,0 +1,60 @@
+# graftlint fixture: seeded interprocedural donation hazards
+# (GL-D005 ``donation-through-call``).  Parsed only, never executed.
+#
+# The per-module donation pass (GL-D001) only sees calls through the
+# donating jit binding itself; every hazard below hides the donation
+# behind a helper — one level, two levels, and (corpus-run only)
+# behind an import from interproc_helper.py.
+import jax
+import jax.numpy as jnp
+
+from tests.data.analysis.interproc_helper import push_update
+
+
+def _step(params, batch):
+    return jax.tree.map(lambda p: p - 0.1, params)
+
+
+_train = jax.jit(_step, donate_argnums=(0,))
+
+
+def _forward(params, batch):
+    # helper: forwards `params` into the donating jit
+    return _train(params, batch)
+
+
+def _forward_deep(params, batch):
+    # two-level chain — the call-graph fixpoint must see through it
+    return _forward(params, batch)
+
+
+def forward_then_read(params, batch):
+    new = _forward(params, batch)
+    # GL-D005: `params` was donated inside the helper on the line above
+    norm = jnp.sum(params["w"])
+    return new, norm
+
+
+def deep_forward_then_read(params, batch):
+    new = _forward_deep(params, batch)
+    # GL-D005: donated two calls deep
+    return new, params["w"]
+
+
+def cross_module_forward_then_read(params, grads):
+    new = push_update(params, grads)
+    # GL-D005 (cross-module): interproc_helper.push_update donates
+    # `params` — visible only when the corpus is analyzed together
+    return new, jnp.sum(params["w"])
+
+
+def forward_then_rebind_ok(params, batch):
+    # NOT a finding: rebound from the helper's result
+    params = _forward(params, batch)
+    return jnp.sum(params["w"])
+
+
+def read_before_forward_ok(params, batch):
+    # NOT a finding: the read happens before the donation
+    norm = jnp.sum(params["w"])
+    return _forward(params, batch), norm

@@ -1,0 +1,69 @@
+# graftlint fixture: seeded whole-step collective-trace divergence
+# (GL-C004 ``step-divergent-collectives``).  Parsed only, never
+# executed.
+#
+# Every hazard here is INVISIBLE to the per-function collectives pass:
+# the branch arms are lexically collective-free (the psum hides inside
+# a helper), so GL-C001/GL-C002 stay silent and only the inlined
+# whole-step comparison can see the divergence.
+import jax
+from jax import lax
+
+from tests.data.analysis.steptrace_helper import allreduce, no_comm
+
+
+def _sync(v):
+    return lax.psum(v, "dp")
+
+
+def _local(v):
+    return v * 2.0
+
+
+def hidden_branch_divergence(x, flag):
+    # GL-C004 (warning): both arms look collective-free per-function,
+    # but inlined they trace [psum] vs [] — a static arg is a host
+    # Python value that CAN differ across worker processes
+    if flag:
+        x = _sync(x)
+    else:
+        x = _local(x)
+    return x
+
+
+step_hidden = jax.jit(hidden_branch_divergence, static_argnums=(1,))
+
+
+def balanced_hidden_branch(x, flag):
+    # NOT a finding: both arms inline to the same [psum] trace
+    if flag:
+        x = _sync(x)
+    else:
+        x = _sync(x * 2.0)
+    return x
+
+
+step_balanced = jax.jit(balanced_hidden_branch, static_argnums=(1,))
+
+
+def cond_hidden_divergence(x, pred):
+    # GL-C004 (error, corpus-run only): the branch callables are
+    # imported, so the per-function pass cannot resolve them; inlined
+    # through the call graph they trace [psum] vs []
+    return lax.cond(pred, allreduce, no_comm, x)
+
+
+step_cond = jax.jit(cond_hidden_divergence)
+
+
+_USE_COMM = True
+
+
+def config_branch_ok(x, flag=None):
+    # NOT a finding: the test reads a module constant, not a parameter
+    if _USE_COMM:
+        x = _sync(x)
+    return x
+
+
+step_config = jax.jit(config_branch_ok)

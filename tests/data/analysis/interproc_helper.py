@@ -1,0 +1,20 @@
+# graftlint fixture: the CLEAN half of the cross-module forwarding
+# pair.  ``push_update`` forwards its ``params`` argument into a
+# donating jit — harmless here (nothing reads after), but callers in
+# bad_interproc.py that keep reading their binding after calling it
+# must be flagged by GL-D005 when the corpus is analyzed as one
+# package.  Parsed only, never executed.
+import jax
+
+
+def _center_step(params, grads):
+    return jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+
+_apply_update = jax.jit(_center_step, donate_argnums=(0,))
+
+
+def push_update(params, grads):
+    # forwards `params` into the donating jit: the caller's buffer is
+    # gone by the time this returns
+    return _apply_update(params, grads)

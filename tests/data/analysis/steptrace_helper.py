@@ -1,0 +1,13 @@
+# graftlint fixture: the CLEAN half of the cross-module step-trace
+# pair.  These helpers emit (or don't emit) collectives; on their own
+# they are hazard-free — bad_steptrace.py hides a divergence behind
+# them.  Parsed only, never executed.
+from jax import lax
+
+
+def allreduce(v):
+    return lax.psum(v, "dp")
+
+
+def no_comm(v):
+    return v * 1.0

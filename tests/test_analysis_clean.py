@@ -113,6 +113,26 @@ def test_tests_baseline_file_exists():
     assert os.path.exists(_TESTS_BASELINE), _TESTS_BASELINE
 
 
+def test_shipped_baseline_is_empty_forever():
+    """PR 4 burned the shipped-code baseline down to zero (the
+    grad_accum shape branch moved host-side; BatchNorm's train flag
+    became a validated trace-time static).  From now on the baseline
+    STAYS empty: a new finding is fixed or suppressed inline with a
+    justification — never accumulated."""
+    assert load_baseline() == {}, (
+        "the shipped-code baseline must stay empty — fix the finding "
+        "or suppress it inline with '# graftlint: disable=<rule>' + a "
+        "justifying comment"
+    )
+
+
+def test_tests_baseline_is_empty_forever():
+    assert load_baseline(_TESTS_BASELINE) == {}, (
+        "the tests/ baseline must stay empty — fix the finding or "
+        "suppress it inline"
+    )
+
+
 def test_fixture_corpus_is_excluded():
     """The deliberately-bad corpus must never leak into the gate: the
     same walk WITHOUT the exclusion sees its findings."""

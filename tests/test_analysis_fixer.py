@@ -1,0 +1,154 @@
+"""Autofixer (``--fix``/``--diff``) tests.
+
+The contract under test, per the acceptance criteria: on the
+GL-D004/GL-J002 corpus the fixer's output must (1) re-lint clean of
+the fixable rules, (2) still parse, and (3) be stable — a second
+``--fix`` run is a byte-identical no-op.  Fixtures are copied to
+tmp_path first; the checked-in corpus is never modified.
+"""
+
+import ast
+import os
+import shutil
+
+import pytest
+
+from theanompi_tpu.analysis import analyze
+from theanompi_tpu.analysis.__main__ import main as cli_main
+from theanompi_tpu.analysis.fixer import fix_files, fix_module
+from theanompi_tpu.analysis.source import parse_module
+
+CORPUS = os.path.join(os.path.dirname(__file__), "data", "analysis")
+FIXABLE_FIXTURES = ("bad_donation.py", "bad_recompile.py")
+
+
+@pytest.fixture
+def corpus_copy(tmp_path):
+    paths = []
+    for name in FIXABLE_FIXTURES:
+        dst = tmp_path / name
+        shutil.copy(os.path.join(CORPUS, name), dst)
+        paths.append(str(dst))
+    return tmp_path, paths
+
+
+def _fixable(findings):
+    return [f for f in findings if f.fixable]
+
+
+def test_fix_output_relints_clean_and_parses(corpus_copy):
+    tmp_path, paths = corpus_copy
+    before, _ = analyze(paths=paths, root=str(tmp_path))
+    assert len(_fixable(before)) == 4  # 2x GL-D004 + 2x GL-J002
+    reports = fix_files(paths, str(tmp_path), write=True)
+    assert sum(len(r.applied) for r in reports) == 4
+    assert not any(r.error for r in reports)
+    after, skipped = analyze(paths=paths, root=str(tmp_path))
+    assert skipped == []  # both files still parse
+    assert _fixable(after) == []  # fixable rules are gone
+    # the fixer must not eat the rest of the seeded corpus: the
+    # non-mechanical findings survive the rewrite untouched
+    assert {f.rule for f in after} >= {"GL-D001", "GL-D003", "GL-J001"}
+
+
+def test_fix_is_idempotent_and_byte_identical(corpus_copy):
+    tmp_path, paths = corpus_copy
+    fix_files(paths, str(tmp_path), write=True)
+    first = {p: open(p).read() for p in paths}
+    reports = fix_files(paths, str(tmp_path), write=True)
+    assert sum(len(r.applied) for r in reports) == 0
+    assert {p: open(p).read() for p in paths} == first
+
+
+def test_fixed_sources_get_the_canonical_rewrites(corpus_copy):
+    tmp_path, paths = corpus_copy
+    fix_files(paths, str(tmp_path), write=True)
+    donation = (tmp_path / "bad_donation.py").read_text()
+    assert "jax.tree.map(np.array, params)" in donation
+    assert "lambda x: np.array(x)" in donation
+    assert "np.asarray, params)" not in donation
+    recompile = (tmp_path / "bad_recompile.py").read_text()
+    assert "(1, 2, 3)" in recompile  # list display → tuple
+    assert '(("fast", True),)' in recompile  # dict display → item pairs
+
+
+def test_diff_mode_writes_nothing(corpus_copy):
+    tmp_path, paths = corpus_copy
+    orig = {p: open(p).read() for p in paths}
+    reports = fix_files(paths, str(tmp_path), write=False)
+    assert sum(len(r.applied) for r in reports) == 4
+    assert any("np.array" in r.diff for r in reports)
+    assert not any(r.wrote for r in reports)
+    assert {p: open(p).read() for p in paths} == orig
+
+
+def test_bare_name_asarray_is_skipped_not_mangled(tmp_path):
+    """``from numpy import asarray`` would need import surgery — the
+    fixer must refuse (with a note), never half-rewrite."""
+    src = (
+        "import jax\n"
+        "from numpy import asarray\n"
+        "\n"
+        "\n"
+        "def snap(tree):\n"
+        "    return jax.tree.map(asarray, tree)\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    m = parse_module(str(p), str(tmp_path))
+    new_source, report = fix_module(m)
+    assert new_source == src and not report.applied
+    assert report.skipped and report.skipped[0].rule == "GL-D004"
+    # the finding itself still reports — skipped, not suppressed
+    findings, _ = analyze(paths=[str(p)], root=str(tmp_path))
+    assert [f.rule for f in findings] == ["GL-D004"]
+
+
+def test_single_element_list_becomes_a_real_tuple(tmp_path):
+    src = (
+        "import jax\n"
+        "\n"
+        "\n"
+        "def f(a, k):\n"
+        "    return a\n"
+        "\n"
+        "\n"
+        "g = jax.jit(f, static_argnums=(1,))\n"
+        "\n"
+        "\n"
+        "def call(x):\n"
+        "    return g(x, [5])\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    m = parse_module(str(p), str(tmp_path))
+    new_source, report = fix_module(m)
+    assert len(report.applied) == 1
+    assert "g(x, (5,))" in new_source  # (5) would be a parenthesized int
+    tree = ast.parse(new_source)
+    call = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call) and getattr(n.func, "id", "") == "g"
+    )
+    assert isinstance(call.args[1], ast.Tuple)
+
+
+def test_cli_diff_then_fix_roundtrip(tmp_path, capsys):
+    dst = tmp_path / "bad_donation.py"
+    shutil.copy(os.path.join(CORPUS, "bad_donation.py"), dst)
+    rc = cli_main([str(dst), "--diff"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "would fix 2 site(s) in 1 file(s)" in out
+    assert "+    return jax.tree.map(np.array, params)" in out
+    assert "np.asarray, params)" in dst.read_text()  # dry run: unchanged
+    rc = cli_main([str(dst), "--fix"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fixed 2 site(s) in 1 file(s)" in out
+    assert "np.asarray, params)" not in dst.read_text()
+    # third invocation: nothing left to do
+    rc = cli_main([str(dst), "--fix"])
+    assert rc == 0
+    assert "fixed 0 site(s) in 0 file(s)" in capsys.readouterr().out

@@ -153,6 +153,22 @@ def test_grad_accum_bad_divisibility():
         _run_steps(make_mesh(), per_shard_bs=8, n_steps=1, grad_accum=3)
 
 
+def test_grad_accum_check_is_host_side():
+    """Baseline burn-down regression (graftlint GL-J003): the
+    divisibility guard moved out of the traced shard_step — it now
+    runs on the host, before any dispatch, so it needs no compiled
+    step and adds no shape-branch recompile axis inside jit."""
+    model = Cifar10_model(
+        config=dict(TINY, batch_size=8, grad_accum=3), mesh=make_mesh()
+    )
+    assert model.train_fn is None  # nothing compiled yet
+    with pytest.raises(ValueError, match="not divisible"):
+        model._check_grad_accum(8 * model.n_workers)
+    # divisible per-shard batch passes silently
+    model._check_grad_accum(9 * model.n_workers)
+    assert model.train_fn is None  # the check never touched the trace
+
+
 def test_worker_engages_linear_lr_scaling():
     """The BSP worker linearly scales lr by n_workers (the reference's
     scale_lr heritage), unless lr_linear_scaling=False."""

@@ -525,3 +525,152 @@ def test_importable_without_jax():
     )
     assert out.returncode == 0, out.stderr
     assert "OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# multi-rank trace merge (`python -m theanompi_tpu.observability merge`)
+# ---------------------------------------------------------------------------
+
+def _rank_trace_lines(pid, name, spans):
+    """Raw-JSONL lines of a small per-rank trace via the real writer."""
+    clock = iter(range(0, 1000))
+    t = Tracer(clock=lambda: next(clock) / 1000.0, pid=pid,
+               process_name=name)
+    t.enable()
+    for s in spans:
+        with t.span(s):
+            pass
+    # the exact save_raw format, rebuilt from its components (save_raw
+    # itself wants a filesystem path)
+    header = {
+        "kind": "header",
+        "pid": t.pid,
+        "process_name": t.process_name,
+        "tracks": {"0": threading.current_thread().name},
+        "dropped": t.dropped,
+    }
+    lines = [json.dumps(header)]
+    lines += [json.dumps(ev) for ev in t.snapshot()]
+    return [l + "\n" for l in lines]
+
+
+def test_merge_raw_traces_distinct_named_tracks():
+    from theanompi_tpu.observability.trace import merge_raw_traces
+
+    doc = merge_raw_traces(
+        [
+            ("rank0", _rank_trace_lines(0, "rank0", ["train_iter"])),
+            ("rank1", _rank_trace_lines(1, "rank1", ["train_iter"])),
+        ]
+    )
+    names = {
+        (e["pid"], e["args"]["name"])
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert names == {(0, "rank0"), (1, "rank1")}
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert sorted(e["pid"] for e in spans) == [0, 1]
+    assert doc["otherData"]["merged_inputs"] == 2
+
+
+def test_merge_remaps_colliding_pids():
+    """Two hosts that both defaulted pid to os.getpid() can collide —
+    the merge must keep their tracks apart, not interleave them."""
+    from theanompi_tpu.observability.trace import merge_raw_traces
+
+    doc = merge_raw_traces(
+        [
+            ("a", _rank_trace_lines(4242, "worker_a", ["step"])),
+            ("b", _rank_trace_lines(4242, "worker_b", ["step"])),
+        ]
+    )
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len({e["pid"] for e in spans}) == 2
+    # a truncated/corrupt line never sinks the merge
+    broken = ["{not json\n", ""]
+    doc2 = merge_raw_traces([("ok", _rank_trace_lines(1, "r", ["s"])),
+                             ("bad", broken)])
+    assert doc2["otherData"]["merged_inputs"] == 2
+
+
+def test_cli_merge_writes_single_chrome_doc(tmp_path, capsys):
+    from theanompi_tpu.observability.__main__ import main as obs_main
+
+    files = []
+    for rank in (0, 1):
+        p = tmp_path / f"rank{rank}_trace_raw.jsonl"
+        p.write_text(
+            "".join(_rank_trace_lines(rank, f"rank{rank}", ["train_iter"]))
+        )
+        files.append(str(p))
+    out = tmp_path / "merged.json"
+    rc = obs_main(["merge", *files, "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
+    # default discovery path: no args, --dir
+    rc = obs_main(["merge", "--dir", str(tmp_path)])
+    merged = json.loads(capsys.readouterr().out)
+    assert rc == 0 and merged["otherData"]["merged_inputs"] == 2
+
+
+def test_cli_merge_without_inputs_is_loud(tmp_path, capsys):
+    from theanompi_tpu.observability.__main__ import main as obs_main
+
+    rc = obs_main(["merge", "--dir", str(tmp_path)])
+    assert rc == 2
+    assert "no raw traces" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# per-epoch counter deltas in the JSONL record
+# ---------------------------------------------------------------------------
+
+def test_end_epoch_attaches_counter_deltas(tmp_path):
+    from theanompi_tpu.runtime.recorder import Recorder
+
+    ctr = obs.get_registry().counter(
+        "test_epoch_delta_total", "test counter"
+    )
+    rec = Recorder(verbose=False, save_dir=str(tmp_path))
+    rec.start_epoch()
+    ctr.inc(3, rank="7")
+    rec.end_epoch(10, epoch=0)
+    rec.start_epoch()
+    ctr.inc(2, rank="7")
+    rec.end_epoch(20, epoch=1)
+    rec.start_epoch()
+    rec.end_epoch(30, epoch=2)  # nothing moved
+    rows = [e for e in rec.events if e["kind"] == "epoch"]
+    assert [r["epoch"] for r in rows] == [0, 1, 2]
+    key = 'test_epoch_delta_total{rank="7"}'
+    assert rows[0]["counters"][key] == 3.0
+    assert rows[1]["counters"][key] == 2.0  # delta, not cumulative
+    assert key not in rows[2]["counters"]
+    assert all(r["seconds"] >= 0 for r in rows)
+    # and the rows land in the saved JSONL record
+    path = rec.save()
+    saved = [
+        r for r in Recorder.load(path) if r.get("kind") == "epoch"
+    ]
+    assert [r["epoch"] for r in saved] == [0, 1, 2]
+    assert saved[1]["counters"][key] == 2.0
+
+
+def test_epoch_counter_base_excludes_startup_counts(tmp_path):
+    """Counts incremented BEFORE the first start_epoch (compile,
+    probes) must not be billed to epoch 0."""
+    from theanompi_tpu.runtime.recorder import Recorder
+
+    ctr = obs.get_registry().counter(
+        "test_epoch_startup_total", "test counter"
+    )
+    ctr.inc(99)
+    rec = Recorder(verbose=False)
+    rec.start_epoch()
+    ctr.inc(1)
+    rec.end_epoch(1, epoch=0)
+    row = next(e for e in rec.events if e["kind"] == "epoch")
+    assert row["counters"]["test_epoch_startup_total"] == 1.0

@@ -119,6 +119,45 @@ def test_batchnorm_train_and_eval():
     assert s2 is s1
 
 
+def test_batchnorm_train_flag_is_trace_time_static():
+    """Baseline burn-down regression (graftlint GL-C002): BatchNorm's
+    train/eval branch changes the collective sequence (sync-BN pmean
+    pair), so the flag is now validated as a trace-time static.
+    Concrete truthy values behave exactly as before; a traced flag
+    fails fast with a targeted TypeError."""
+    bn = L.BatchNorm(momentum=0.5)
+    p, s, _ = bn.init(KEY, (4,))
+    x = jax.random.normal(KEY, (16, 4)) * 2.0 + 0.5
+    y_bool, s_bool = bn.apply(p, s, x, train=True)
+    # numpy bools / ints coerce like they always did
+    y_np, s_np = bn.apply(p, s, x, train=np.bool_(True))
+    np.testing.assert_array_equal(np.asarray(y_bool), np.asarray(y_np))
+    for k in s_bool:
+        np.testing.assert_array_equal(
+            np.asarray(s_bool[k]), np.asarray(s_np[k])
+        )
+    y_eval0, _ = bn.apply(p, s_bool, x, train=0)
+    y_evalF, _ = bn.apply(p, s_bool, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval0), np.asarray(y_evalF))
+    # a TRACED flag is rejected at trace time, naming the flag —
+    # before this fix it died as TracerBoolConversionError (or, through
+    # shard_map, a per-worker divergent pmean: a hang)
+    with pytest.raises(TypeError, match="trace-time-static"):
+        jax.jit(lambda t: bn.apply(p, s, x, train=t))(jnp.asarray(True))
+    # under jit with the flag baked in, output is unchanged
+    f = jax.jit(lambda xx: bn.apply(p, s, xx, train=True)[0])
+    np.testing.assert_allclose(
+        np.asarray(f(x)), np.asarray(y_bool), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_static_bool_helper():
+    assert L.static_bool(np.bool_(False)) is False
+    assert L.static_bool(1) is True
+    with pytest.raises(TypeError, match="my_flag"):
+        jax.jit(lambda t: L.static_bool(t, "my_flag"))(jnp.asarray(True))
+
+
 def test_dropout():
     d = L.Dropout(0.5)
     x = jnp.ones((1000,))

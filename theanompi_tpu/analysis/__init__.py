@@ -10,7 +10,7 @@ lock inversions in the host-level async transport.  None of these need
 hardware to detect — they are visible in the AST — so this package
 checks them at review time, on CPU, in CI.
 
-Four passes, each pure-stdlib (no jax import — the CLI must start fast
+Five passes, each pure-stdlib (no jax import — the CLI must start fast
 and run on machines with no accelerator stack):
 
 - ``recompile``   (GL-J*): jit wrappers rebuilt per loop iteration,
@@ -18,46 +18,71 @@ and run on machines with no accelerator stack):
   values or shapes inside traced code.
 - ``donation``    (GL-D*): reads of a donated binding after the
   donating call, donation aliasing, donated buffers escaping to
-  background threads/queues without a host copy.
+  background threads/queues without a host copy — and, through the
+  whole-package call graph (``analysis/callgraph.py``), GL-D005:
+  bindings forwarded into a *helper* whose parameter flows into a
+  donated jit position, then read afterwards.
 - ``collectives`` (GL-C*): per-function collective sequences under
   ``shard_map``/``jit`` that diverge across ``lax.cond`` branches or
   data-dependent Python branches, and collectives under a
   data-dependent ``lax.while_loop`` trip count.
+- ``steptrace``   (GL-C004): the interprocedural complement — inline
+  the call graph from the worker-step entrypoints and every
+  jit/shard_map root, and flag branches whose *flattened* collective
+  traces diverge even though each function looks balanced on its own.
 - ``lockorder``   (GL-L*): a whole-package lock-acquisition-graph
   cycle detector (plus non-reentrant double-acquire) over the
   ``threading.Lock``/``RLock``/``Condition`` population.
 
 Findings carry severity + ``file:line`` and are matched against a
 checked-in baseline (``.graftlint_baseline.json`` at the repo root) so
-pre-existing accepted findings don't block CI; new findings do.
+pre-existing accepted findings don't block CI; new findings do.  Both
+baselines are EMPTY as of this PR and the tier-1 gate keeps them that
+way — fix new findings or suppress them inline with a justification.
 Inline suppression: ``# graftlint: disable=GL-XXXX`` (or a bare
 ``# graftlint: disable``) on the flagged line or the line above.
+
+The mechanical rules (GL-D004, GL-J002) have an autofixer
+(``analysis/fixer.py``): span-anchored rewrites, verified idempotent
+and re-linted clean before a file is touched.
 
 CLI::
 
     python -m theanompi_tpu.analysis [--format json|human]
     python -m theanompi_tpu.analysis --write-baseline   # accept current
+    python -m theanompi_tpu.analysis --diff             # dry-run fixes
+    python -m theanompi_tpu.analysis --fix              # apply fixes
+    python -m theanompi_tpu.analysis --step-trace       # whole-step traces
 
 See ``docs/static_analysis.md`` for the workflow.
 """
 
-from theanompi_tpu.analysis.findings import Finding, SEVERITIES
+from theanompi_tpu.analysis.findings import (
+    FIXABLE_RULES,
+    Finding,
+    SEVERITIES,
+)
 from theanompi_tpu.analysis.engine import (
     analyze,
     default_targets,
     load_baseline,
+    parse_targets,
     repo_root,
     split_by_baseline,
+    step_trace_report,
     write_baseline,
 )
 
 __all__ = [
+    "FIXABLE_RULES",
     "Finding",
     "SEVERITIES",
     "analyze",
     "default_targets",
     "load_baseline",
+    "parse_targets",
     "repo_root",
     "split_by_baseline",
+    "step_trace_report",
     "write_baseline",
 ]

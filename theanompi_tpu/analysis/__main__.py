@@ -4,6 +4,17 @@ Exit codes: 0 = no non-baselined findings, 1 = new findings, 2 = usage
 or I/O error.  ``--format json`` emits one machine-readable document on
 stdout (the tier-1 gate and any CI annotate step consume this);
 ``--format human`` (default) prints one line per finding.
+
+Autofixer: ``--fix`` rewrites the mechanically-repairable findings
+(GL-D004 asarray snapshots → ``np.array``; GL-J002 unhashable static
+displays → their hashable forms) in place, then re-runs the passes
+over the same targets to prove the fixed sites re-lint clean; the
+rewrite is verified idempotent per file before anything is written.
+``--diff`` is the dry run: print the unified diffs, write nothing.
+
+``--step-trace`` prints the flattened whole-step collective trace per
+entrypoint (worker loops + every jit/shard_map root) — the sequence
+all workers must agree on, and the substrate GL-C004 compares.
 """
 
 from __future__ import annotations
@@ -60,11 +71,103 @@ def _build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="accept the current findings: rewrite the baseline and exit 0",
     )
+    p.add_argument(
+        "--fix",
+        action="store_true",
+        help="rewrite fixable findings (GL-D004/GL-J002) in place, then "
+        "re-lint the targets to verify the fixed sites are gone",
+    )
+    p.add_argument(
+        "--diff",
+        action="store_true",
+        help="dry-run --fix: print the unified diffs, write nothing",
+    )
+    p.add_argument(
+        "--step-trace",
+        action="store_true",
+        dest="step_trace",
+        help="print the flattened whole-step collective trace per "
+        "entrypoint instead of linting",
+    )
     return p
+
+
+def _run_fixer(args) -> int:
+    from theanompi_tpu.analysis import fixer
+
+    modules, skipped, root = engine.parse_targets(
+        paths=args.paths or None, exclude_dirs=tuple(args.exclude)
+    )
+    reports = fixer.fix_files(
+        [m.path for m in modules], root, write=args.fix
+    )
+    n_fixed = sum(len(r.applied) for r in reports)
+    n_files = sum(1 for r in reports if r.changed)
+    for r in reports:
+        if args.diff and r.diff:
+            sys.stdout.write(r.diff)
+        for s in r.skipped:
+            print(
+                f"note: {r.rel}:{s.line}: [{s.rule}] not auto-fixable — "
+                f"{s.reason}"
+            )
+        if r.error:
+            print(f"error: {r.rel}: {r.error}", file=sys.stderr)
+    for s in skipped:
+        print(f"note: could not parse {s}")
+    verb = "would fix" if args.diff else "fixed"
+    print(
+        f"graftlint --fix: {verb} {n_fixed} site(s) in {n_files} file(s)"
+    )
+    if any(r.error for r in reports):
+        return 2
+    if args.fix and n_fixed:
+        # prove the rewrite: the fixable rules must no longer fire on
+        # the same targets (unfixable shapes were reported above)
+        findings, _ = engine.analyze(
+            paths=args.paths or None, exclude_dirs=tuple(args.exclude)
+        )
+        residual = [
+            f
+            for f in findings
+            if f.fixable
+            and any(f.file == r.rel and r.changed for r in reports)
+        ]
+        if residual:
+            for f in residual:
+                print(f.format_human(), file=sys.stderr)
+            print(
+                "graftlint --fix: rewritten files still report fixable "
+                "findings (bug — please report)",
+                file=sys.stderr,
+            )
+            return 2
+    return 0
+
+
+def _run_step_trace(args) -> int:
+    traces = engine.step_trace_report(
+        paths=args.paths or None, exclude_dirs=tuple(args.exclude)
+    )
+    if args.fmt == "json":
+        json.dump(
+            {ep: list(tr) for ep, tr in sorted(traces.items())},
+            sys.stdout,
+            indent=2,
+        )
+        sys.stdout.write("\n")
+    else:
+        for ep, tr in sorted(traces.items()):
+            print(f"{ep}: [{', '.join(tr)}]")
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.step_trace:
+        return _run_step_trace(args)
+    if args.fix or args.diff:
+        return _run_fixer(args)
     try:
         findings, skipped = engine.analyze(
             paths=args.paths or None, exclude_dirs=tuple(args.exclude)

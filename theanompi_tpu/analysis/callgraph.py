@@ -1,0 +1,477 @@
+"""Interprocedural layer: a whole-package call graph with per-function
+summaries.
+
+The per-module passes (PR 2) reason about one function body at a time;
+the two gaps the ROADMAP called out — donation flowing through helper
+calls, and collective sequences compared per *step* rather than per
+function — both need the same substrate: given every ``ParsedModule``
+of a run, which call expression resolves to which package function,
+and what does each function do with its parameters.
+
+Built once per ``analyze()`` run, the graph provides:
+
+- **Resolution** (``CallGraph.resolve``): best-effort mapping of a call
+  expression to a package function's fully-qualified id
+  (``"<module_tag>.<qualname>"``, e.g. ``workers.BSP_Worker.run``).
+  Resolvable shapes: bare names (enclosing-scope nested defs, then
+  module top-level, then ``from pkg.mod import f``), dotted names
+  through the import map (``mod.f`` where ``mod`` is a package
+  module), ``self.meth()`` (enclosing class, then package-unique
+  method name), and ``obj.meth()`` / ``self.attr.meth()`` where the
+  receiver was assigned from a package-class constructor — the same
+  known-receiver discipline the lockorder pass uses, with a
+  package-unique method-name fallback.  Names that resolve OUTSIDE the
+  analyzed set (``jax.*``, ``numpy.*``) are never guessed at.
+- **Donating bindings, package-wide** (``CallGraph.donating``):
+  terminal binding name → donated positional indices, merged across
+  every module — so a helper in ``utils/`` calling ``model.train_fn``
+  (bound in ``models/base.py``) is recognized as a donating call.
+  ``CallGraph.jit_targets`` additionally maps a binding to the FQ of
+  the function it wraps when that is resolvable, which lets the step
+  tracer walk *through* ``self.train_fn(...)`` into ``shard_step``.
+- **Summaries** (``FunctionSummary``): per function, its parameter
+  list, every call site (with the argument→parameter mapping), its
+  lexical collective sequence, and — via a fixpoint over the graph —
+  ``donated_params``: the parameters that flow, through any depth of
+  forwarding, into a donated jit argument position.  This is the fact
+  GL-D005 (``donation-through-call``) reports on.
+
+Everything here is still a syntactic heuristic: no imports are
+executed, unresolved calls contribute nothing, and passes built on the
+graph are expected to prefer missing a hazard over inventing one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from theanompi_tpu.analysis.source import (
+    COLLECTIVES,
+    JIT_NAMES,
+    FunctionInfo,
+    ParsedModule,
+    attr_path,
+    find_jit_wraps,
+    terminal_name,
+)
+
+# forwarding chains deeper than this are cut (cycle/blow-up guard; the
+# real code tops out at depth 3: run -> train_iter -> train_fn)
+MAX_DEPTH = 24
+
+
+def module_tag(m: ParsedModule) -> str:
+    base = m.rel.rsplit("/", 1)[-1]
+    return base[:-3] if base.endswith(".py") else base
+
+
+def assign_tags(modules: Sequence[ParsedModule]) -> Dict[str, str]:
+    """rel-path → unique module tag.  The short basename tag
+    (``workers``) is used when unique across the analyzed set; modules
+    whose basenames collide (``analysis/engine.py`` vs
+    ``serving/engine.py``, every ``__init__.py``) get their full
+    dotted path instead — a collision merging two modules' function
+    namespaces would silently mis-attribute donations and collectives."""
+    counts: Dict[str, int] = {}
+    for m in modules:
+        t = module_tag(m)
+        counts[t] = counts.get(t, 0) + 1
+    return {
+        m.rel: (module_tag(m) if counts[module_tag(m)] == 1 else _dotted_of(m))
+        for m in modules
+    }
+
+
+def _dotted_of(m: ParsedModule) -> str:
+    """Import-style dotted path of a module (``theanompi_tpu/parallel/
+    workers.py`` → ``theanompi_tpu.parallel.workers``)."""
+    rel = m.rel[:-3] if m.rel.endswith(".py") else m.rel
+    return rel.replace("/", ".")
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    line: int
+    callee: Optional[str]  # FQ of a resolved package function, else None
+    donating_binding: Optional[str] = None  # terminal name when the call
+    # goes through a package-wide donating jit binding
+    donated_positions: Set[int] = field(default_factory=set)
+
+
+@dataclass
+class FunctionSummary:
+    fq: str  # "<module_tag>.<qualname>"
+    module: ParsedModule
+    info: FunctionInfo
+    params: List[str]  # positional params, self/cls stripped
+    kwonly: List[str]
+    calls: List[CallSite] = field(default_factory=list)
+    collectives: List[str] = field(default_factory=list)  # lexical seq
+    # parameters that flow into a donated jit argument position —
+    # directly or through any resolved forwarding chain (fixpoint)
+    donated_params: Set[str] = field(default_factory=set)
+    # (line, param) of the DIRECT donation sites inside this function
+    direct_donations: List[Tuple[int, str]] = field(default_factory=list)
+
+
+class CallGraph:
+    def __init__(self, modules: Sequence[ParsedModule]):
+        self.modules = list(modules)
+        self.functions: Dict[str, FunctionSummary] = {}
+        # terminal binding name -> donated positional indices (union
+        # across modules, jit-family wrappers only)
+        self.donating: Dict[str, Set[int]] = {}
+        # binding name -> FQ of the wrapped function, when resolvable
+        self.jit_targets: Dict[str, str] = {}
+        # indexes
+        self._by_module: Dict[str, ParsedModule] = {}
+        self._dotted: Dict[str, str] = {}  # dotted module path -> tag
+        self._top_level: Dict[Tuple[str, str], str] = {}  # (tag, name) -> fq
+        self._methods: Dict[Tuple[str, str, str], str] = {}  # (tag, cls, meth)
+        self._method_name: Dict[str, List[str]] = {}  # meth -> [fq, ...]
+        self._class_modules: Dict[str, List[str]] = {}  # cls -> [tag, ...]
+        # (tag, scope_cls_or_None, receiver_path) -> class name, from
+        # `self.x = Cls(...)` / `x = Cls(...)` constructor assignments
+        self._receiver_types: Dict[Tuple[str, Optional[str], str], str] = {}
+        self._build()
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def _build(self) -> None:
+        self._tags = assign_tags(self.modules)
+        for m in self.modules:
+            tag = self.tag_of(m)
+            self._by_module[tag] = m
+            self._dotted[_dotted_of(m)] = tag
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._class_modules.setdefault(node.name, []).append(tag)
+        for m in self.modules:
+            tag = self.tag_of(m)
+            for fi in m.functions:
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                fq = f"{tag}.{fi.qualname}"
+                a = fi.node.args
+                names = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+                if names and names[0] in ("self", "cls"):
+                    names = names[1:]
+                summ = FunctionSummary(
+                    fq=fq,
+                    module=m,
+                    info=fi,
+                    params=names,
+                    kwonly=[p.arg for p in a.kwonlyargs],
+                )
+                self.functions[fq] = summ
+                if fi.parent is None:  # not nested
+                    if fi.class_name is None:
+                        self._top_level[(tag, fi.node.name)] = fq
+                    elif fi.qualname == f"{fi.class_name}.{fi.node.name}":
+                        self._methods[
+                            (tag, fi.class_name, fi.node.name)
+                        ] = fq
+                        self._method_name.setdefault(
+                            fi.node.name, []
+                        ).append(fq)
+            # tracing-wrap bindings + what they wrap.  Chained wraps
+            # resolve through their intermediate bindings:
+            #   mapped = jax.shard_map(shard_step, ...)
+            #   self.train_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+            # makes `train_fn` a donating binding whose target is
+            # `shard_step`.
+            wraps = find_jit_wraps(m)
+            by_binding = {w.binding: w for w in wraps if w.binding}
+            for w in wraps:
+                if not w.binding:
+                    continue
+                if w.func_node is None:
+                    arg0 = w.call.args[0] if w.call.args else None
+                    if isinstance(arg0, ast.Name):
+                        inner = by_binding.get(arg0.id)
+                        if inner is not None and inner is not w:
+                            w.func_node = inner.func_node
+                if w.wrapper in JIT_NAMES and w.donate_argnums:
+                    self.donating.setdefault(w.binding, set()).update(
+                        w.donate_argnums
+                    )
+                if w.func_node is not None:
+                    target = next(
+                        (
+                            f"{tag}.{fi.qualname}"
+                            for fi in m.functions
+                            if fi.node is w.func_node
+                        ),
+                        None,
+                    )
+                    if target is not None:
+                        self.jit_targets.setdefault(w.binding, target)
+            self._collect_receiver_types(m, tag)
+        for m in self.modules:
+            self._scan_module(m)
+        self._donation_fixpoint()
+
+    def tag_of(self, m: ParsedModule) -> str:
+        return self._tags.get(m.rel) or module_tag(m)
+
+    def _collect_receiver_types(self, m: ParsedModule, tag: str) -> None:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            cls_name = terminal_name(node.value.func)
+            if cls_name not in self._class_modules:
+                continue
+            target = node.targets[0]
+            path = attr_path(target)
+            if path is None:
+                continue
+            scope_cls = m.enclosing_class(node)
+            self._receiver_types[(tag, scope_cls, path)] = cls_name
+            # `self.x = Cls()` in one method types `self.x` for the
+            # whole class, whichever method reads it
+            if path.startswith("self."):
+                self._receiver_types[(tag, scope_cls, path)] = cls_name
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+    def _fq_from_dotted(self, dotted: str) -> Optional[str]:
+        """``theanompi_tpu.parallel.workers.foo`` → ``workers.foo`` when
+        the module is in the analyzed set and defines ``foo``."""
+        mod, _, name = dotted.rpartition(".")
+        if not mod or not name:
+            return None
+        tag = self._dotted.get(mod)
+        if tag is None:
+            return None
+        return self._top_level.get((tag, name))
+
+    def _resolve_bare(
+        self, m: ParsedModule, at: ast.AST, name: str
+    ) -> Optional[str]:
+        tag = self.tag_of(m)
+        # nearest enclosing scope first (local nested defs), mirroring
+        # collectives._resolve_branch_body
+        here = m.enclosing_function(at)
+        cands = [
+            fi
+            for fi in m.functions
+            if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and fi.node.name == name
+        ]
+        scope = here
+        while scope is not None:
+            local = [c for c in cands if c.parent is scope]
+            if local:
+                return f"{tag}.{local[0].qualname}"
+            scope = scope.parent
+        fq = self._top_level.get((tag, name))
+        if fq is not None:
+            return fq
+        # from pkg.mod import f
+        src = m.imports.names.get(name)
+        if src:
+            return self._fq_from_dotted(src)
+        return None
+
+    def _resolve_method(
+        self, m: ParsedModule, at: ast.AST, recv: str, meth: str
+    ) -> Optional[str]:
+        tag = self.tag_of(m)
+        if recv == "self":
+            cls = m.enclosing_class(at)
+            if cls is not None:
+                fq = self._methods.get((tag, cls, meth))
+                if fq is not None:
+                    return fq
+        else:
+            scope_cls = m.enclosing_class(at)
+            rtype = self._receiver_types.get(
+                (tag, scope_cls, recv)
+            ) or self._receiver_types.get((tag, None, recv))
+            if rtype is not None:
+                for rtag in self._class_modules.get(rtype, ()):
+                    fq = self._methods.get((rtag, rtype, meth))
+                    if fq is not None:
+                        return fq
+        # package-unique method name (the lockorder discipline): the
+        # receiver is untyped, but only one class anywhere defines the
+        # method, so a hit is unambiguous — a miss stays unresolved
+        hits = self._method_name.get(meth, ())
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def resolve(self, m: ParsedModule, call: ast.Call) -> Optional[str]:
+        """FQ of the package function ``call`` invokes, or None."""
+        func = call.func
+        resolved = m.imports.resolve(func)
+        if resolved is not None:
+            if resolved.split(".", 1)[0] in ("jax", "numpy", "np"):
+                return None
+            fq = self._fq_from_dotted(resolved)
+            if fq is not None:
+                return fq
+        if isinstance(func, ast.Name):
+            return self._resolve_bare(m, call, func.id)
+        if isinstance(func, ast.Attribute):
+            path = attr_path(func)
+            if path is None:
+                return None
+            # imported module attribute that didn't resolve above is a
+            # foreign call, not a package method
+            head = path.split(".", 1)[0]
+            if head in m.imports.names:
+                return None
+            recv, _, meth = path.rpartition(".")
+            if recv:
+                return self._resolve_method(m, call, recv, meth)
+        return None
+
+    # ------------------------------------------------------------------
+    # per-function scan
+    # ------------------------------------------------------------------
+    def _scan_module(self, m: ParsedModule) -> None:
+        tag = self.tag_of(m)
+        by_node = {
+            fi.node: self.functions.get(f"{tag}.{fi.qualname}")
+            for fi in m.functions
+        }
+        for fi in m.functions:
+            summ = by_node.get(fi.node)
+            if summ is None:
+                continue
+            owner = fi.node
+
+            def walk(n):
+                if n is not owner and isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    return  # nested defs summarize separately
+                if isinstance(n, ast.Call):
+                    name = terminal_name(n.func)
+                    if name in COLLECTIVES and _is_jax_collective(m, n):
+                        summ.collectives.append(name)
+                    site = CallSite(
+                        node=n, line=n.lineno, callee=self.resolve(m, n)
+                    )
+                    if name in self.donating:
+                        site.donating_binding = name
+                        site.donated_positions = set(self.donating[name])
+                    if site.callee is not None or site.donating_binding:
+                        summ.calls.append(site)
+                for child in ast.iter_child_nodes(n):
+                    walk(child)
+
+            for stmt in getattr(owner, "body", []):
+                walk(stmt)
+
+    # ------------------------------------------------------------------
+    # donated-parameter fixpoint
+    # ------------------------------------------------------------------
+    def _donation_fixpoint(self) -> None:
+        # seed: parameters passed directly at a donated position of a
+        # donating jit binding call
+        for summ in self.functions.values():
+            pset = set(summ.params) | set(summ.kwonly)
+            for site in summ.calls:
+                if not site.donating_binding:
+                    continue
+                for i, arg in enumerate(site.node.args):
+                    if (
+                        i in site.donated_positions
+                        and isinstance(arg, ast.Name)
+                        and arg.id in pset
+                    ):
+                        summ.donated_params.add(arg.id)
+                        summ.direct_donations.append((site.line, arg.id))
+        # propagate through resolved forwarding calls until stable
+        changed = True
+        rounds = 0
+        while changed and rounds < MAX_DEPTH:
+            changed = False
+            rounds += 1
+            for summ in self.functions.values():
+                pset = set(summ.params) | set(summ.kwonly)
+                for site in summ.calls:
+                    callee = (
+                        self.functions.get(site.callee)
+                        if site.callee
+                        else None
+                    )
+                    if callee is None or not callee.donated_params:
+                        continue
+                    for name, arg in _arg_bindings(site.node, callee):
+                        if (
+                            name in callee.donated_params
+                            and isinstance(arg, ast.Name)
+                            and arg.id in pset
+                            and arg.id not in summ.donated_params
+                        ):
+                            summ.donated_params.add(arg.id)
+                            changed = True
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+    def summary_for(
+        self, m: ParsedModule, fi: FunctionInfo
+    ) -> Optional[FunctionSummary]:
+        return self.functions.get(f"{self.tag_of(m)}.{fi.qualname}")
+
+    def forwarded_donations(
+        self, summ: FunctionSummary
+    ) -> List[Tuple[CallSite, "FunctionSummary", Dict[str, ast.expr]]]:
+        """Call sites of ``summ`` that hand an argument to a callee
+        parameter which (transitively) reaches a donated jit position:
+        ``[(site, callee_summary, {donated_callee_param: arg_expr})]``.
+        Direct donating-binding calls are excluded — those are the
+        per-module donation pass's territory."""
+        out = []
+        for site in summ.calls:
+            if site.donating_binding:
+                continue
+            callee = self.functions.get(site.callee) if site.callee else None
+            if callee is None or not callee.donated_params:
+                continue
+            hit: Dict[str, ast.expr] = {}
+            for name, arg in _arg_bindings(site.node, callee):
+                if name in callee.donated_params:
+                    hit[name] = arg
+            if hit:
+                out.append((site, callee, hit))
+        return out
+
+
+def _is_jax_collective(m: ParsedModule, node: ast.Call) -> bool:
+    resolved = m.imports.resolve(node.func)
+    return resolved is None or resolved.startswith("jax")
+
+
+def _arg_bindings(
+    call: ast.Call, callee: FunctionSummary
+):
+    """Yield ``(callee_param_name, arg_expr)`` for a call site, mapping
+    positionals in order (the callee's ``self``/``cls`` is already
+    stripped from its param list) and keywords by name.  ``*args`` /
+    ``**kwargs`` at the call site end positional certainty and are
+    skipped from that point on."""
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(callee.params):
+            yield callee.params[i], arg
+    names = set(callee.params) | set(callee.kwonly)
+    for kw in call.keywords:
+        if kw.arg is not None and kw.arg in names:
+            yield kw.arg, kw.value
+
+
+def build(modules: Sequence[ParsedModule]) -> CallGraph:
+    return CallGraph(modules)

@@ -41,10 +41,19 @@ bindings:
   np.asarray").  ``np.asarray(x) * w`` and other immediately-consumed
   forms materialize a fresh array and are not flagged.
 
-Scope is one function body with line-ordered reasoning — control flow
-inside the function is approximated by source order, and donation
-through helper methods in other modules is out of scope (documented in
-docs/static_analysis.md).
+- GL-D005 ``donation-through-call`` (project-wide, via
+  ``analysis/callgraph.py``): a binding passed to a *helper* whose
+  parameter flows — through any depth of resolved forwarding — into a
+  donated jit argument position, then read afterwards without a
+  rebind.  This is the cross-module blind spot PR 2 documented: the
+  helper looks like an ordinary call, but by the time it returns the
+  caller's buffer has been donated exactly as if the caller had called
+  the jit itself.  Same rebind/same-statement exemptions as GL-D001.
+
+GL-D001..4 reason over one function body with line-ordered source
+approximation of control flow; GL-D005 extends the *donation* fact
+across the package call graph while keeping the same per-caller read
+analysis (see docs/static_analysis.md).
 """
 
 from __future__ import annotations
@@ -318,8 +327,11 @@ def _is_bare_asarray(m: ParsedModule, expr: ast.expr) -> bool:
     return False
 
 
-def _asarray_snapshots(m: ParsedModule) -> List[Finding]:
-    out: List[Finding] = []
+def iter_asarray_snapshot_sites(m: ParsedModule):
+    """Yield ``(tree_map_call, mapped_expr)`` for every GL-D004 site —
+    shared by the reporting pass below and the ``--fix`` rewriter
+    (``analysis/fixer.py``), so the two can never disagree about what
+    the rule matches."""
     for node in ast.walk(m.tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -328,19 +340,105 @@ def _asarray_snapshots(m: ParsedModule) -> List[Finding]:
         if resolved not in _TREE_MAPS and not path.endswith("tree.map"):
             continue
         if _is_bare_asarray(m, node.args[0]):
-            out.append(
-                _finding(
-                    m,
-                    "GL-D004",
-                    "warning",
-                    node.lineno,
-                    m.symbol_for(node),
-                    "tree-mapped np.asarray produces ZERO-COPY views of "
-                    "device buffers on CPU — if the source is later donated "
-                    "by a jitted step this 'snapshot' reads reused memory; "
-                    "use np.array (see utils/checkpoint.host_snapshot)",
+            yield node, node.args[0]
+
+
+def _asarray_snapshots(m: ParsedModule) -> List[Finding]:
+    return [
+        _finding(
+            m,
+            "GL-D004",
+            "warning",
+            node.lineno,
+            m.symbol_for(node),
+            "tree-mapped np.asarray produces ZERO-COPY views of "
+            "device buffers on CPU — if the source is later donated "
+            "by a jitted step this 'snapshot' reads reused memory; "
+            "use np.array (see utils/checkpoint.host_snapshot)",
+        )
+        for node, _mapped in iter_asarray_snapshot_sites(m)
+    ]
+
+
+def run_project(modules, cg) -> List[Finding]:
+    """GL-D005: forwarding a binding into a helper that donates it.
+
+    ``cg`` is the run's ``analysis.callgraph.CallGraph``; the per-
+    module ``run`` below stays unchanged — this pass only adds the
+    interprocedural donation fact, then reuses the same read/rebind
+    reasoning GL-D001 applies to direct donating calls."""
+    import ast as _ast
+
+    out: List[Finding] = []
+    for summ in cg.functions.values():
+        forwarded = cg.forwarded_donations(summ)
+        if not forwarded:
+            continue
+        m = summ.module
+        fi = summ.info
+        scan = _FnScan(m, {})
+        for stmt in fi.node.body:
+            scan.visit(stmt)
+        for site, callee, hits in forwarded:
+            # x = helper(x): rebound by the forwarding statement itself
+            rebound_same_stmt: set = set()
+            parent = m.parents.get(site.node)
+            if isinstance(parent, (_ast.Assign, _ast.AnnAssign)):
+                targets = (
+                    parent.targets
+                    if isinstance(parent, _ast.Assign)
+                    else [parent.target]
                 )
-            )
+
+                def _flat(t):
+                    if isinstance(t, (_ast.Tuple, _ast.List)):
+                        for e in t.elts:
+                            _flat(e)
+                    elif isinstance(t, _ast.Starred):
+                        _flat(t.value)
+                    else:
+                        k = _binding_key(t)
+                        if k is not None:
+                            rebound_same_stmt.add(k)
+
+                for t in targets:
+                    _flat(t)
+            reported: set = set()
+            for callee_param, arg in hits.items():
+                key = _binding_key(arg)
+                if key is None or key in rebound_same_stmt:
+                    continue
+                if key in reported:
+                    continue
+                rebind_lines = sorted(scan.rebinds.get(key, []))
+                later_reads = [
+                    (l, n)
+                    for (l, n) in scan.reads.get(key, [])
+                    if l > site.line
+                ]
+                for read_line, _n in later_reads:
+                    if any(
+                        site.line < rb <= read_line for rb in rebind_lines
+                    ):
+                        continue
+                    reported.add(key)
+                    out.append(
+                        _finding(
+                            m,
+                            "GL-D005",
+                            "error",
+                            read_line,
+                            fi.qualname,
+                            f"read of {key!r} after it was forwarded into "
+                            f"a donating jit through {callee.fq}() on line "
+                            f"{site.line} — parameter {callee_param!r} of "
+                            "the helper flows to a donated argument "
+                            "position, so the buffer may already be "
+                            "reused; rebind from the call's result or "
+                            "copy to host before forwarding",
+                        )
+                    )
+                    break  # one report per forwarding event is enough
     return out
 
 

@@ -21,7 +21,14 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from theanompi_tpu.analysis import collectives, donation, locks, recompile
+from theanompi_tpu.analysis import (
+    callgraph,
+    collectives,
+    donation,
+    locks,
+    recompile,
+    step_trace,
+)
 from theanompi_tpu.analysis.findings import Finding, sort_key
 from theanompi_tpu.analysis.source import ParsedModule, parse_module
 
@@ -98,12 +105,48 @@ def analyze(
     root: Optional[str] = None,
     exclude_dirs: Sequence[str] = (),
 ) -> Tuple[List[Finding], List[str]]:
-    """Run all four passes.  Returns (findings, unparseable-files).
+    """Run every pass — the four per-module/package passes plus the
+    call-graph layer (GL-D005/GL-C004).  Returns (findings,
+    unparseable-files).
 
     ``exclude_dirs``: directory NAMES pruned during the walk (beyond
     the built-in ``__pycache__``/``.git``) — the tests/ run excludes
     ``data`` so the deliberately-bad fixture corpus under
     ``tests/data/analysis/`` can't poison the gate."""
+    modules, skipped, root = parse_targets(paths, root, exclude_dirs)
+    findings: List[Finding] = []
+    by_rel = {m.rel: m for m in modules}
+    for m in modules:
+        for p in _PER_MODULE_PASSES:
+            findings.extend(p.run(m))
+    findings.extend(locks.run_project(modules))
+    # interprocedural layer: one call graph per run feeds both the
+    # cross-module donation rule (GL-D005) and the whole-step
+    # collective trace rule (GL-C004)
+    cg = callgraph.build(modules)
+    findings.extend(donation.run_project(modules, cg))
+    findings.extend(step_trace.run_project(modules, cg))
+
+    kept: List[Finding] = []
+    for f in findings:
+        m = by_rel.get(f.file)
+        if m is not None:
+            rules = _suppressed_rules(m, f.line)
+            if rules is not None and (not rules or f.rule in rules):
+                continue
+        kept.append(f)
+    kept.sort(key=sort_key)
+    return kept, skipped
+
+
+def parse_targets(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    exclude_dirs: Sequence[str] = (),
+) -> Tuple[List[ParsedModule], List[str], str]:
+    """(modules, unparseable, root) for a target set — the shared
+    front half of ``analyze``; the ``--fix`` and ``--step-trace`` CLI
+    paths reuse it so every mode sees the identical file walk."""
     root = root or repo_root()
     files = _iter_py_files(
         paths if paths else default_targets(root), exclude_dirs
@@ -116,23 +159,19 @@ def analyze(
             skipped.append(os.path.relpath(f, root).replace(os.sep, "/"))
         else:
             modules.append(m)
-    findings: List[Finding] = []
-    by_rel = {m.rel: m for m in modules}
-    for m in modules:
-        for p in _PER_MODULE_PASSES:
-            findings.extend(p.run(m))
-    findings.extend(locks.run_project(modules))
+    return modules, skipped, root
 
-    kept: List[Finding] = []
-    for f in findings:
-        m = by_rel.get(f.file)
-        if m is not None:
-            rules = _suppressed_rules(m, f.line)
-            if rules is not None and (not rules or f.rule in rules):
-                continue
-        kept.append(f)
-    kept.sort(key=sort_key)
-    return kept, skipped
+
+def step_trace_report(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    exclude_dirs: Sequence[str] = (),
+) -> Dict[str, tuple]:
+    """Flattened whole-step collective trace per entrypoint (the
+    ``--step-trace`` CLI surface)."""
+    modules, _skipped, _root = parse_targets(paths, root, exclude_dirs)
+    cg = callgraph.build(modules)
+    return step_trace.step_traces(modules, cg)
 
 
 # ---------------------------------------------------------------------------

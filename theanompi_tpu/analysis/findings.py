@@ -6,6 +6,13 @@ source line it anchors to.  The *fingerprint* deliberately excludes the
 line number — baselines must survive unrelated edits shifting code up
 and down a file — and hashes (rule, file, symbol, snippet) instead,
 which is stable until the flagged code itself changes.
+
+Pass ids: ``recompile`` | ``donation`` | ``collectives`` |
+``lockorder`` | ``steptrace`` (the interprocedural whole-step pass).
+``FIXABLE_RULES`` names the rules the ``--fix`` rewriter
+(``analysis/fixer.py``) can repair mechanically; ``Finding.fixable``
+surfaces that in both expositions so a human (or CI annotate step)
+can tell "run --fix" apart from "think".
 """
 
 from __future__ import annotations
@@ -15,6 +22,9 @@ from dataclasses import dataclass
 from typing import Any, Dict
 
 SEVERITIES = ("error", "warning")
+
+# kept in sync with analysis/fixer.py (the fixer imports this)
+FIXABLE_RULES = frozenset({"GL-D004", "GL-J002"})
 
 
 @dataclass(frozen=True)
@@ -37,6 +47,10 @@ class Finding:
         blob = "|".join((self.rule, self.file, self.symbol, self.snippet))
         return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
 
+    @property
+    def fixable(self) -> bool:
+        return self.rule in FIXABLE_RULES
+
     def to_json(self) -> Dict[str, Any]:
         return {
             "fingerprint": self.fingerprint,
@@ -48,12 +62,14 @@ class Finding:
             "symbol": self.symbol,
             "message": self.message,
             "snippet": self.snippet,
+            "fixable": self.fixable,
         }
 
     def format_human(self) -> str:
+        tail = "  [--fix]" if self.fixable else ""
         return (
             f"{self.file}:{self.line}: {self.severity}: "
-            f"[{self.rule}] {self.message}  (in {self.symbol})"
+            f"[{self.rule}] {self.message}  (in {self.symbol}){tail}"
         )
 
 

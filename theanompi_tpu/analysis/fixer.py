@@ -1,0 +1,314 @@
+"""The graftlint autofixer: span-anchored source rewriting for the
+mechanical rules, behind ``python -m theanompi_tpu.analysis --fix``
+(``--diff`` = dry-run).
+
+Only rules whose repair is a *local, semantics-preserving* text edit
+are fixable — everything else stays a report:
+
+- **GL-D004** ``asarray-snapshot``: the mapped callable of a
+  ``jax.tree.map(np.asarray, tree)`` (or the ``np.asarray`` inside the
+  equivalent lambda) is rewritten to ``np.array`` — the exact repair
+  both real PR 2 findings received by hand.  Only attribute forms
+  (``np.asarray`` / ``numpy.asarray``) are rewritten; a bare
+  ``asarray`` bound by ``from numpy import asarray`` would need import
+  surgery and is skipped with a note.
+- **GL-J002** ``unhashable-static-arg``: the display at the static
+  position becomes its canonical hashable stand-in — ``[a, b]`` →
+  ``(a, b)`` (``[a]`` → ``(a,)``), ``{"k": v}`` → ``(("k", v),)``
+  (source-ordered item pairs), ``{a, b}`` → ``frozenset((a, b))``,
+  and a list/generator comprehension is wrapped in ``tuple(...)``.
+  Dict/set *comprehensions* are skipped (no mechanical tuple form).
+
+Mechanics: detection is shared with the reporting passes
+(``donation.iter_asarray_snapshot_sites`` /
+``recompile.iter_unhashable_static_sites``) so fixer and linter cannot
+drift; each fix is anchored to the AST node's exact character span
+(``lineno``/``col_offset`` .. ``end_lineno``/``end_col_offset``) and
+edits are applied back-to-front so earlier spans stay valid.  Before a
+file is written the rewritten source must (1) re-parse, and (2) plan
+zero further fixes — i.e. ``--fix`` is verified idempotent and its
+output re-lints clean of the fixable sites, per file, every run.  A
+second ``--fix`` is a byte-identical no-op.
+
+Pure stdlib, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from theanompi_tpu.analysis.donation import iter_asarray_snapshot_sites
+from theanompi_tpu.analysis.recompile import iter_unhashable_static_sites
+from theanompi_tpu.analysis.source import (
+    ParsedModule,
+    find_jit_wraps,
+    parse_source,
+)
+
+FIXABLE_RULES = ("GL-D004", "GL-J002")
+
+
+@dataclass(frozen=True)
+class Fix:
+    rule: str
+    line: int
+    start: int  # char offset into the source
+    end: int
+    replacement: str
+    note: str
+
+
+@dataclass(frozen=True)
+class Skip:
+    rule: str
+    line: int
+    reason: str
+
+
+@dataclass
+class FileReport:
+    path: str
+    rel: str
+    applied: List[Fix] = field(default_factory=list)
+    skipped: List[Skip] = field(default_factory=list)
+    diff: str = ""
+    wrote: bool = False
+    error: Optional[str] = None
+
+    @property
+    def changed(self) -> bool:
+        return bool(self.applied)
+
+
+# ---------------------------------------------------------------------------
+# span plumbing
+# ---------------------------------------------------------------------------
+
+def _line_starts(source: str) -> List[int]:
+    starts = [0]
+    for i, ch in enumerate(source):
+        if ch == "\n":
+            starts.append(i + 1)
+    return starts
+
+def _span(starts: List[int], node: ast.AST) -> Optional[Tuple[int, int]]:
+    if getattr(node, "end_lineno", None) is None:
+        return None
+    a = starts[node.lineno - 1] + node.col_offset
+    b = starts[node.end_lineno - 1] + node.end_col_offset
+    return (a, b) if a <= b else None
+
+
+def _segment(source: str, starts, node: ast.AST) -> Optional[str]:
+    sp = _span(starts, node)
+    return None if sp is None else source[sp[0] : sp[1]]
+
+
+# ---------------------------------------------------------------------------
+# per-rule planners
+# ---------------------------------------------------------------------------
+
+def _plan_d004(m: ParsedModule, starts) -> Tuple[List[Fix], List[Skip]]:
+    fixes: List[Fix] = []
+    skips: List[Skip] = []
+    for _call, mapped in iter_asarray_snapshot_sites(m):
+        target = mapped
+        if isinstance(mapped, ast.Lambda) and isinstance(
+            mapped.body, ast.Call
+        ):
+            target = mapped.body.func
+        if isinstance(target, ast.Attribute) and target.attr == "asarray":
+            # rewrite just the ``.asarray`` tail so the base expression
+            # (np / numpy / an aliased import) survives verbatim
+            base_span = _span(starts, target.value)
+            full_span = _span(starts, target)
+            if base_span is None or full_span is None:
+                skips.append(
+                    Skip("GL-D004", mapped.lineno, "no span info")
+                )
+                continue
+            fixes.append(
+                Fix(
+                    rule="GL-D004",
+                    line=target.lineno,
+                    start=base_span[1],
+                    end=full_span[1],
+                    replacement=".array",
+                    note="asarray → array (host copy, not a view)",
+                )
+            )
+        else:
+            skips.append(
+                Skip(
+                    "GL-D004",
+                    mapped.lineno,
+                    "bare-name asarray needs an import edit — rewrite "
+                    "by hand (np.array / host_snapshot)",
+                )
+            )
+    return fixes, skips
+
+
+def _plan_j002(m: ParsedModule, starts) -> Tuple[List[Fix], List[Skip]]:
+    fixes: List[Fix] = []
+    skips: List[Skip] = []
+    source = m.source
+    wraps = find_jit_wraps(m)
+    for node, _where, _name in iter_unhashable_static_sites(m, wraps):
+        sp = _span(starts, node)
+        seg = _segment(source, starts, node)
+        if sp is None or seg is None:
+            skips.append(Skip("GL-J002", node.lineno, "no span info"))
+            continue
+        rep: Optional[str] = None
+        note = ""
+        if isinstance(node, ast.List):
+            inner = seg[1:-1]
+            if len(node.elts) == 1 and not inner.rstrip().endswith(","):
+                inner += ","
+            rep, note = f"({inner})", "list display → tuple"
+        elif isinstance(node, ast.Dict):
+            if any(k is None for k in node.keys):  # {**other}
+                skips.append(
+                    Skip(
+                        "GL-J002",
+                        node.lineno,
+                        "dict display with ** unpacking — rewrite by hand",
+                    )
+                )
+                continue
+            pairs = []
+            ok = True
+            for k, v in zip(node.keys, node.values):
+                ks = _segment(source, starts, k)
+                vs = _segment(source, starts, v)
+                if ks is None or vs is None:
+                    ok = False
+                    break
+                pairs.append(f"({ks}, {vs})")
+            if not ok:
+                skips.append(Skip("GL-J002", node.lineno, "no span info"))
+                continue
+            body = ", ".join(pairs) + ("," if len(pairs) == 1 else "")
+            rep = f"({body})"
+            note = "dict display → tuple of item pairs"
+        elif isinstance(node, ast.Set):
+            rep = f"frozenset(({seg[1:-1]},))" if len(
+                node.elts
+            ) == 1 else f"frozenset(({seg[1:-1]}))"
+            note = "set display → frozenset"
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            inner = (
+                seg[1:-1]
+                if isinstance(node, ast.ListComp)
+                else (seg[1:-1] if seg.startswith("(") else seg)
+            )
+            rep, note = f"tuple({inner})", "comprehension → tuple(...)"
+        if rep is None:
+            skips.append(
+                Skip(
+                    "GL-J002",
+                    node.lineno,
+                    f"{type(node).__name__} has no mechanical hashable "
+                    "form — rewrite by hand",
+                )
+            )
+            continue
+        fixes.append(
+            Fix(
+                rule="GL-J002",
+                line=node.lineno,
+                start=sp[0],
+                end=sp[1],
+                replacement=rep,
+                note=note,
+            )
+        )
+    return fixes, skips
+
+
+def plan_fixes(m: ParsedModule) -> Tuple[List[Fix], List[Skip]]:
+    starts = _line_starts(m.source)
+    f1, s1 = _plan_d004(m, starts)
+    f2, s2 = _plan_j002(m, starts)
+    return sorted(f1 + f2, key=lambda f: f.start), s1 + s2
+
+
+# ---------------------------------------------------------------------------
+# application + verification
+# ---------------------------------------------------------------------------
+
+def apply_fixes(source: str, fixes: Sequence[Fix]) -> str:
+    """Splice replacements back-to-front; overlapping spans abort (a
+    planner bug must never half-rewrite a file)."""
+    ordered = sorted(fixes, key=lambda f: f.start)
+    for a, b in zip(ordered, ordered[1:]):
+        if a.end > b.start:
+            raise ValueError(
+                f"overlapping fixes at offsets {a.start}..{a.end} and "
+                f"{b.start}..{b.end}"
+            )
+    out = source
+    for f in reversed(ordered):
+        out = out[: f.start] + f.replacement + out[f.end :]
+    return out
+
+
+def fix_module(m: ParsedModule) -> Tuple[str, FileReport]:
+    """(rewritten_source, report) for one parsed module.  The rewrite
+    is verified before being returned: it must re-parse, and planning
+    on the result must find nothing further to fix (idempotency)."""
+    report = FileReport(path=m.path, rel=m.rel)
+    fixes, skips = plan_fixes(m)
+    report.skipped = skips
+    if not fixes:
+        return m.source, report
+    new_source = apply_fixes(m.source, fixes)
+    m2 = parse_source(new_source, m.path, os.path.dirname(m.path))
+    if m2 is None:
+        report.error = "rewritten source failed to parse; file left alone"
+        return m.source, report
+    residual, _ = plan_fixes(m2)
+    if residual:
+        report.error = (
+            f"rewrite not idempotent ({len(residual)} site(s) still "
+            "fixable after one pass); file left alone"
+        )
+        return m.source, report
+    report.applied = fixes
+    report.diff = "".join(
+        difflib.unified_diff(
+            m.source.splitlines(keepends=True),
+            new_source.splitlines(keepends=True),
+            fromfile=m.rel,
+            tofile=m.rel,
+        )
+    )
+    return new_source, report
+
+
+def fix_files(
+    files: Sequence[str], root: str, write: bool = False
+) -> List[FileReport]:
+    """Plan (and with ``write=True`` apply) fixes over ``files``.
+    Files with nothing to fix produce no report entry."""
+    from theanompi_tpu.analysis.source import parse_module
+
+    reports: List[FileReport] = []
+    for path in files:
+        m = parse_module(path, root)
+        if m is None:
+            continue
+        new_source, report = fix_module(m)
+        if not report.changed and not report.skipped and not report.error:
+            continue
+        if write and report.changed:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(new_source)
+            report.wrote = True
+        reports.append(report)
+    return reports

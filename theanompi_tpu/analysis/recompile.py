@@ -110,15 +110,17 @@ def _jit_in_loop(m: ParsedModule, wraps) -> List[Finding]:
     return out
 
 
-def _unhashable_static_args(m: ParsedModule, wraps) -> List[Finding]:
-    # binding (terminal identifier) -> wrap with static positions
+def iter_unhashable_static_sites(m: ParsedModule, wraps):
+    """Yield ``(display_node, where, jitted_name)`` for every GL-J002
+    site — ``where`` is ``("pos", i)`` or ``("kw", name)``.  Shared by
+    the reporting pass below and the ``--fix`` rewriter
+    (``analysis/fixer.py``) so detection and repair cannot drift."""
     by_binding = {}
     for w in wraps:
         if w.binding and (w.static_argnums or w.static_argnames):
             by_binding[w.binding] = w
     if not by_binding:
-        return []
-    out: List[Finding] = []
+        return
     for node in ast.walk(m.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -126,36 +128,32 @@ def _unhashable_static_args(m: ParsedModule, wraps) -> List[Finding]:
         w = by_binding.get(name)
         if w is None or node is w.call:
             continue
-        symbol = m.symbol_for(node)
         for i, arg in enumerate(node.args):
             if i in w.static_argnums and isinstance(arg, _UNHASHABLE):
-                out.append(
-                    _finding(
-                        m,
-                        "GL-J002",
-                        "error",
-                        arg,
-                        symbol,
-                        f"unhashable {type(arg).__name__.lower()} passed at "
-                        f"static_argnums position {i} of jitted "
-                        f"{name!r} — static args are dict keys of the "
-                        "compile cache; pass a tuple (hashable) instead",
-                    )
-                )
+                yield arg, ("pos", i), name
         for kw in node.keywords:
             if kw.arg in w.static_argnames and isinstance(kw.value, _UNHASHABLE):
-                out.append(
-                    _finding(
-                        m,
-                        "GL-J002",
-                        "error",
-                        kw.value,
-                        symbol,
-                        f"unhashable {type(kw.value).__name__.lower()} passed "
-                        f"for static_argname {kw.arg!r} of jitted "
-                        f"{name!r} — pass a tuple (hashable) instead",
-                    )
-                )
+                yield kw.value, ("kw", kw.arg), name
+
+
+def _unhashable_static_args(m: ParsedModule, wraps) -> List[Finding]:
+    out: List[Finding] = []
+    for arg, where, name in iter_unhashable_static_sites(m, wraps):
+        symbol = m.symbol_for(arg)
+        if where[0] == "pos":
+            msg = (
+                f"unhashable {type(arg).__name__.lower()} passed at "
+                f"static_argnums position {where[1]} of jitted "
+                f"{name!r} — static args are dict keys of the "
+                "compile cache; pass a tuple (hashable) instead"
+            )
+        else:
+            msg = (
+                f"unhashable {type(arg).__name__.lower()} passed "
+                f"for static_argname {where[1]!r} of jitted "
+                f"{name!r} — pass a tuple (hashable) instead"
+            )
+        out.append(_finding(m, "GL-J002", "error", arg, symbol, msg))
     return out
 
 

@@ -220,8 +220,19 @@ def parse_module(path: str, root: str) -> Optional[ParsedModule]:
     try:
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
+    except OSError:
+        return None
+    return parse_source(source, path, root)
+
+
+def parse_source(
+    source: str, path: str, root: str
+) -> Optional[ParsedModule]:
+    """Parse from an in-memory string (the ``--fix`` rewriter verifies
+    its output this way before touching the file on disk)."""
+    try:
         tree = ast.parse(source, filename=path)
-    except (OSError, SyntaxError, ValueError):
+    except (SyntaxError, ValueError):
         return None
     rel = os.path.relpath(path, root).replace(os.sep, "/")
     parents: Dict[ast.AST, ast.AST] = {}

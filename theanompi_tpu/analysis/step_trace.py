@@ -1,0 +1,383 @@
+"""Pass 5 — whole-step collective-trace divergence (GL-C004).
+
+The collectives pass (GL-C001..3) compares sequences one function at a
+time, so a collective hidden behind a helper call — the documented
+blind spot — is invisible: ``if flag: x = allreduce(x)`` looks
+collective-free even though ``allreduce`` psums.  Under SPMD the thing
+that must agree across workers is the collective trace of the *whole
+step* (the MXNet-DAG lesson, arXiv:1802.06949: ordering is a property
+of the step graph, not of any one function), so this pass symbolically
+inlines the call graph and compares *flattened* traces.
+
+Roots are the worker-step entrypoints (``BSP_Worker.run``,
+``EASGD_Worker._run``, ``GOSGD_Worker._run`` — present when
+``parallel/workers.py`` / ``async_workers.py`` are in the analyzed
+set) plus every jit/shard_map-wrapped function: the traced step
+functions themselves.  From each root the pass walks the resolved call
+graph (``analysis/callgraph.py``), inlining callees — including
+*through* a donating jit binding like ``self.train_fn`` into the
+``shard_step`` it wraps — and at every branch point compares the
+inlined collective traces of the arms:
+
+- a Python ``if``/``else`` whose test reads a parameter of the
+  enclosing function, whose arms' *lexical* sequences are equal (so
+  GL-C002 stays silent) but whose *inlined* traces differ → GL-C004
+  (warning — same confidence as GL-C002's parameter heuristic);
+- a ``lax.cond``/``lax.switch`` whose branch callables GL-C001 could
+  not resolve or saw as lexically equal, but whose inlined traces
+  differ → GL-C004 (error — the predicate is traced, the deadlock is
+  real).
+
+GL-C004 therefore reports exactly the divergences the per-function
+pass cannot see; a site GL-C001/GL-C002 already reports is never
+double-reported.  Unresolved calls contribute nothing (prefer missing
+a hazard over inventing one), recursion is cycle-cut, and inlining is
+memoized per function.
+
+``step_traces()`` additionally exposes the flattened per-entrypoint
+traces (``python -m theanompi_tpu.analysis --step-trace`` prints
+them) — the reviewable artifact: one line per worker strategy, the
+whole-step collective sequence every worker must agree on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from theanompi_tpu.analysis import collectives as _coll
+from theanompi_tpu.analysis.callgraph import CallGraph
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.recompile import _is_none_test
+from theanompi_tpu.analysis.source import (
+    COLLECTIVES,
+    TRACING_WRAPPERS,
+    ParsedModule,
+    find_jit_wraps,
+    terminal_name,
+)
+
+PASS_ID = "steptrace"
+
+# the host-level worker step loops (ISSUE: the strategies whose whole
+# step must agree) — matched exactly against "<module_tag>.<qualname>"
+WORKER_ENTRYPOINTS = (
+    "workers.BSP_Worker.run",
+    "async_workers.EASGD_Worker._run",
+    "async_workers.GOSGD_Worker._run",
+)
+
+_MAX_DEPTH = 24
+
+
+class _Inliner:
+    """Flattened-collective-trace computation over the call graph."""
+
+    def __init__(self, cg: CallGraph):
+        self.cg = cg
+        self._memo: Dict[str, Tuple[str, ...]] = {}
+
+    # -- function-level ----------------------------------------------------
+    def flat(self, fq: str, stack: Tuple[str, ...] = ()) -> Tuple[str, ...]:
+        if fq in self._memo:
+            return self._memo[fq]
+        if fq in stack or len(stack) >= _MAX_DEPTH:
+            return ()
+        summ = self.cg.functions.get(fq)
+        if summ is None:
+            return ()
+        body = getattr(summ.info.node, "body", [])
+        out = self.flat_nodes(summ.module, body, stack + (fq,))
+        if fq not in stack:
+            self._memo[fq] = out
+        return out
+
+    # -- node-level --------------------------------------------------------
+    def flat_nodes(
+        self,
+        m: ParsedModule,
+        nodes: Sequence[ast.AST],
+        stack: Tuple[str, ...],
+    ) -> Tuple[str, ...]:
+        out: List[str] = []
+
+        def walk(n):
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return  # a nested def runs when called, not where defined
+            if isinstance(n, ast.Call):
+                # arguments evaluate before the call dispatches
+                for child in ast.iter_child_nodes(n):
+                    walk(child)
+                name = terminal_name(n.func)
+                if name in COLLECTIVES:
+                    if _coll._is_collective_call(m, n) is not None:
+                        out.append(name)
+                    return
+                out.extend(self._inline_call(m, n, stack))
+                return
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        for n in nodes:
+            walk(n)
+        return tuple(out)
+
+    def _inline_call(
+        self, m: ParsedModule, call: ast.Call, stack: Tuple[str, ...]
+    ) -> Tuple[str, ...]:
+        callee = self.cg.resolve(m, call)
+        if callee is not None:
+            return self.flat(callee, stack)
+        # a call through a jit/shard_map binding (self.train_fn(...))
+        # traces the function it wraps
+        name = terminal_name(call.func)
+        if name is not None:
+            target = self.cg.jit_targets.get(name)
+            if target is not None:
+                return self.flat(target, stack)
+        return ()
+
+    # -- cond/switch branch callables --------------------------------------
+    def resolve_branch(
+        self, m: ParsedModule, expr: ast.expr, at: ast.AST
+    ) -> Optional[str]:
+        """FQ of a ``lax.cond`` branch callable (Name/attribute), via
+        the call graph — wider than the per-module resolver (imports,
+        typed receivers)."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            probe = ast.Call(func=expr, args=[], keywords=[])
+            ast.copy_location(probe, at)
+            # scope lookups (enclosing function/class) walk parent
+            # links — give the synthetic probe the cond call's own
+            m.parents[probe] = m.parents.get(at, at)
+            return self.cg.resolve(m, probe)
+        return None
+
+    def flat_branch(
+        self, m: ParsedModule, expr: ast.expr, at: ast.AST
+    ) -> Optional[Tuple[str, ...]]:
+        """Inlined trace of one branch callable; None = unresolvable."""
+        if isinstance(expr, ast.Lambda):
+            return self.flat_nodes(m, [expr.body], ())
+        fq = self.resolve_branch(m, expr, at)
+        if fq is not None:
+            return self.flat(fq)
+        return None
+
+
+def _entrypoints(modules: Sequence[ParsedModule], cg: CallGraph) -> List[str]:
+    eps: List[str] = [fq for fq in WORKER_ENTRYPOINTS if fq in cg.functions]
+    for m in modules:
+        tag = cg.tag_of(m)
+        for w in find_jit_wraps(m):
+            if w.wrapper not in TRACING_WRAPPERS or w.func_node is None:
+                continue
+            fq = next(
+                (
+                    f"{tag}.{fi.qualname}"
+                    for fi in m.functions
+                    if fi.node is w.func_node
+                ),
+                None,
+            )
+            if fq is not None and fq not in eps:
+                eps.append(fq)
+    return eps
+
+
+def _callees_of(cg: CallGraph, fq: str) -> List[str]:
+    summ = cg.functions.get(fq)
+    if summ is None:
+        return []
+    out: List[str] = []
+    for site in summ.calls:
+        if site.callee:
+            out.append(site.callee)
+        if site.donating_binding:
+            target = cg.jit_targets.get(site.donating_binding)
+            if target:
+                out.append(target)
+    # cond/switch branch callables are edges too (they run inside the
+    # step even though they are arguments, not calls)
+    inliner = _Inliner(cg)
+    m = summ.module
+    for node in ast.walk(summ.info.node):
+        if isinstance(node, ast.Call):
+            term = terminal_name(node.func)
+            if term in ("cond", "switch", "while_loop"):
+                for b in _branch_exprs(node, term):
+                    bfq = inliner.resolve_branch(m, b, node)
+                    if bfq:
+                        out.append(bfq)
+            else:
+                target = cg.jit_targets.get(term or "")
+                if target:
+                    out.append(target)
+    return out
+
+
+def _reachable(modules, cg: CallGraph) -> List[str]:
+    seen: Set[str] = set()
+    order: List[str] = []
+    frontier = list(_entrypoints(modules, cg))
+    while frontier:
+        fq = frontier.pop()
+        if fq in seen or fq not in cg.functions:
+            continue
+        seen.add(fq)
+        order.append(fq)
+        frontier.extend(_callees_of(cg, fq))
+    return order
+
+
+def _branch_exprs(node: ast.Call, term: str) -> List[ast.expr]:
+    if term == "cond":
+        return list(node.args[1:3])
+    if term == "switch":
+        if len(node.args) >= 2 and isinstance(
+            node.args[1], (ast.List, ast.Tuple)
+        ):
+            return list(node.args[1].elts)
+        return []
+    return list(node.args[:2])  # while_loop: cond_fun, body_fun
+
+
+def _finding(m: ParsedModule, sev: str, node: ast.AST, msg: str) -> Finding:
+    return Finding(
+        rule="GL-C004",
+        pass_id=PASS_ID,
+        severity=sev,
+        file=m.rel,
+        line=node.lineno,
+        symbol=m.symbol_for(node),
+        message=msg,
+        snippet=m.snippet(node.lineno),
+    )
+
+
+def _pretty(seqs: Sequence[Tuple[str, ...]]) -> str:
+    return " vs ".join("[" + ", ".join(s) + "]" for s in seqs)
+
+
+def _python_branch_findings(
+    inliner: _Inliner, summ, out: List[Finding], seen: Set[Tuple[str, int]]
+) -> None:
+    m = summ.module
+    fn = summ.info.node
+    params = set(summ.params) | set(summ.kwonly)
+    if not params:
+        return
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        if m.enclosing_function(node) is not summ.info:
+            continue  # nested defs report through their own summaries
+        if _is_none_test(node.test):
+            continue
+        if not _coll._test_reads_params(node.test, params):
+            continue
+        lex_if = _coll._sequence(m, list(node.body))
+        lex_else = _coll._sequence(m, list(node.orelse))
+        if lex_if != lex_else:
+            continue  # GL-C002 already reports this shape
+        inl_if = inliner.flat_nodes(m, list(node.body), ())
+        inl_else = inliner.flat_nodes(m, list(node.orelse), ())
+        if inl_if == inl_else:
+            continue
+        key = (m.rel, node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(
+            _finding(
+                m,
+                "warning",
+                node,
+                "inlined step trace diverges between the arms of a "
+                f"parameter-dependent branch ({_pretty([inl_if, inl_else])}) "
+                "— the collectives are hidden behind calls, so the "
+                "per-function pass cannot see this; if the test can differ "
+                "across workers the step deadlocks (hoist the collectives "
+                "or make the test a trace-time constant)",
+            )
+        )
+
+
+def _cond_findings(
+    inliner: _Inliner, summ, out: List[Finding], seen: Set[Tuple[str, int]]
+) -> None:
+    m = summ.module
+    for node in ast.walk(summ.info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if m.enclosing_function(node) is not summ.info:
+            continue
+        term = terminal_name(node.func)
+        if term not in ("cond", "switch"):
+            continue
+        resolved = m.imports.resolve(node.func)
+        if resolved is not None and not resolved.startswith("jax"):
+            continue
+        branches = _branch_exprs(node, term)
+        if len(branches) < 2:
+            continue
+        # what could the per-function pass see?  If it resolved every
+        # branch, GL-C001 owns the site (silent here even on equal
+        # sequences — equal lexical + divergent inlined falls to us).
+        lex: List[Optional[list]] = []
+        for b in branches:
+            body = _coll._resolve_branch_body(m, b, node)
+            lex.append(None if body is None else _coll._sequence(m, body))
+        c001_visible = all(s is not None for s in lex) and any(
+            s != lex[0] for s in lex[1:]
+        )
+        if c001_visible:
+            continue
+        inl = []
+        for b in branches:
+            t = inliner.flat_branch(m, b, node)
+            if t is None:
+                inl = []
+                break
+            inl.append(t)
+        if len(inl) < 2 or all(t == inl[0] for t in inl[1:]):
+            continue
+        key = (m.rel, node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(
+            _finding(
+                m,
+                "error",
+                node,
+                f"lax.{term} branches flatten to different inlined "
+                f"collective traces ({_pretty(inl)}) — the collectives are "
+                "hidden behind helper calls the per-function pass cannot "
+                "resolve; workers taking different branches deadlock",
+            )
+        )
+
+
+def run_project(
+    modules: Sequence[ParsedModule], cg: CallGraph
+) -> List[Finding]:
+    inliner = _Inliner(cg)
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for fq in _reachable(modules, cg):
+        summ = cg.functions[fq]
+        _python_branch_findings(inliner, summ, out, seen)
+        _cond_findings(inliner, summ, out, seen)
+    return out
+
+
+def step_traces(
+    modules: Sequence[ParsedModule], cg: CallGraph
+) -> Dict[str, Tuple[str, ...]]:
+    """Flattened whole-step collective trace per entrypoint — one row
+    per worker strategy / traced step root."""
+    inliner = _Inliner(cg)
+    return {fq: inliner.flat(fq) for fq in _entrypoints(modules, cg)}

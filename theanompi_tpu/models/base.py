@@ -513,11 +513,11 @@ class TpuModel:
                 # mean-of-means == the full local-batch mean; BN stats
                 # thread sequentially (per-microbatch stats, as K
                 # smaller steps would see).
-                if x.shape[0] % accum:
-                    raise ValueError(
-                        f"per-shard batch {x.shape[0]} not divisible by "
-                        f"grad_accum={accum}"
-                    )
+                # Divisibility is validated HOST-SIDE (_check_grad_accum
+                # via train_iter) — a shape branch inside traced code is
+                # a recompile axis (graftlint GL-J003); an indivisible
+                # batch reaching this reshape directly still fails at
+                # trace time, just with a terser message.
                 xs = x.reshape(accum, -1, *x.shape[1:])
                 ys = y.reshape(accum, -1, *y.shape[1:])
                 all_keys = jax.random.split(rng, accum + 1)
@@ -666,6 +666,23 @@ class TpuModel:
             self.data.val_batches(), self.mesh, depth=1, spec=self.batch_spec
         )
 
+    def _check_grad_accum(self, global_batch: int) -> None:
+        """Host-side grad_accum divisibility guard (moved out of the
+        traced ``shard_step`` — graftlint GL-J003: a shape-dependent
+        branch in traced code is a recompile axis).  ``global_batch``
+        is the leading dim of the un-sharded batch; each of the
+        ``n_workers`` batch shards must split into ``grad_accum`` equal
+        microbatches."""
+        accum = int(self.config.get("grad_accum", 1) or 1)
+        if accum <= 1:
+            return
+        per_shard = global_batch // max(1, self.n_workers)
+        if per_shard % accum:
+            raise ValueError(
+                f"per-shard batch {per_shard} not divisible by "
+                f"grad_accum={accum}"
+            )
+
     def train_iter(self, count: int, recorder) -> Tuple[float, float]:
         if self.train_fn is None:
             self.compile_train()
@@ -674,6 +691,7 @@ class TpuModel:
         recorder.start("wait")
         x, y = next(self._train_it)
         recorder.end("wait")
+        self._check_grad_accum(int(x.shape[0]))
         recorder.start("calc")
         self.rng, step_key = jax.random.split(self.rng)
         out = self.train_fn(

@@ -45,6 +45,8 @@ from theanompi_tpu.observability.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    counter_deltas,
+    flatten_counters,
     get_registry,
     percentile,
 )
@@ -53,6 +55,7 @@ from theanompi_tpu.observability.trace import (
     add_span,
     get_tracer,
     instant,
+    merge_raw_traces,
     raw_to_chrome,
     span,
     traced,
@@ -66,13 +69,17 @@ __all__ = [
     "MetricsRegistry",
     "Tracer",
     "add_span",
+    "counter_deltas",
+    "counter_values",
     "disable_tracing",
     "dump_all",
     "enable_tracing",
+    "flatten_counters",
     "get_flight_recorder",
     "get_registry",
     "get_tracer",
     "instant",
+    "merge_raw_traces",
     "percentile",
     "publish_event",
     "raw_to_chrome",
@@ -106,6 +113,13 @@ def publish_event(kind: str, fields: dict) -> None:
         tracer.instant(kind, dict(fields) if fields else None)
     for fn in _subscribers:
         fn(kind, fields)
+
+
+def counter_values() -> dict:
+    """Flattened ``name{labels} -> value`` view of every counter in
+    the process registry — snapshot it at a boundary, snapshot again
+    later, and ``counter_deltas`` tells you exactly what moved."""
+    return flatten_counters(get_registry().snapshot())
 
 
 def enable_tracing(buffer=None) -> Tracer:

@@ -13,6 +13,10 @@ Commands:
 - ``dump --format raw``         print the raw trace JSONL as-is.
 - ``dump --format prometheus``  print the newest metrics .prom snapshot.
 - ``dump --format json``        print the newest metrics .json snapshot.
+- ``merge [files...]``          merge several per-rank raw trace JSONL
+  files (default: every ``*trace_raw.jsonl`` in the directory) into ONE
+  Chrome trace with a distinct, named process track per rank — open a
+  multi-worker run as a single Perfetto timeline.
 - ``serve --port N``            serve /metrics, /trace, /flight from the
   current (empty, unless something enabled tracing in-process) state —
   mainly a smoke surface; real deployments call
@@ -30,7 +34,7 @@ import os
 import sys
 from typing import List, Optional
 
-from theanompi_tpu.observability.trace import raw_to_chrome
+from theanompi_tpu.observability.trace import merge_raw_traces, raw_to_chrome
 
 
 def _newest(pattern: str, directory: str) -> Optional[str]:
@@ -87,6 +91,41 @@ def _cmd_dump(args) -> int:
     return 0
 
 
+def _cmd_merge(args) -> int:
+    d = _resolve_dir(args)
+    paths: List[str] = list(args.inputs or [])
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(d, "*trace_raw.jsonl")))
+    if not paths:
+        print(
+            f"no raw traces to merge (looked for *trace_raw.jsonl in {d}; "
+            "pass files explicitly or point --dir at a run's "
+            "observability directory)",
+            file=sys.stderr,
+        )
+        return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such trace file(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+    named = []
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+        label = os.path.basename(p)
+        if label.endswith("_trace_raw.jsonl"):
+            label = label[: -len("_trace_raw.jsonl")]
+        named.append((label, lines))
+    doc = merge_raw_traces(named)
+    _write_out(json.dumps(doc) + "\n", args.out)
+    print(
+        f"merged {len(named)} trace(s), "
+        f"{len(doc['traceEvents'])} event rows",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def _cmd_serve(args) -> int:
     from theanompi_tpu.observability.export import ObservabilityServer
 
@@ -123,6 +162,19 @@ def _build_parser() -> argparse.ArgumentParser:
     d.add_argument("--dir", default=None, help="observability directory")
     d.add_argument("--out", default=None, help="write here instead of stdout")
     d.set_defaults(fn=_cmd_dump)
+    g = sub.add_parser(
+        "merge",
+        help="merge per-rank raw traces into one multi-track Chrome JSON",
+    )
+    g.add_argument(
+        "inputs",
+        nargs="*",
+        help="raw trace files (default: every *trace_raw.jsonl in the "
+        "observability directory)",
+    )
+    g.add_argument("--dir", default=None, help="observability directory")
+    g.add_argument("--out", default=None, help="write here instead of stdout")
+    g.set_defaults(fn=_cmd_merge)
     s = sub.add_parser("serve", help="local HTTP endpoint (opt-in)")
     s.add_argument("--port", type=int, default=9100)
     s.add_argument("--host", default="127.0.0.1")

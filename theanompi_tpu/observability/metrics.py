@@ -359,6 +359,34 @@ def _fmt_val(v: float) -> str:
     return repr(v)
 
 
+def flatten_counters(snapshot: dict) -> Dict[str, float]:
+    """Counter series of a registry ``snapshot()`` flattened to
+    ``name{label="v",...} -> value`` (Prometheus-style keys).  The
+    substrate for *delta* reporting: diff two flattenings and you get
+    exactly what moved between them — the per-epoch record row
+    (``runtime.recorder.Recorder.end_epoch``) does precisely this."""
+    out: Dict[str, float] = {}
+    for name, doc in snapshot.items():
+        if doc.get("kind") != "counter":
+            continue
+        for row in doc["series"]:
+            out[f"{name}{_fmt_labels(row['labels'])}"] = float(row["value"])
+    return out
+
+
+def counter_deltas(
+    current: Dict[str, float], base: Dict[str, float]
+) -> Dict[str, float]:
+    """Series that moved between two ``flatten_counters`` snapshots
+    (new series appear with their full value; counters are monotonic,
+    so a vanished key — registry reset — is dropped, not negated)."""
+    return {
+        k: round(v - base.get(k, 0.0), 9)
+        for k, v in current.items()
+        if v != base.get(k, 0.0)
+    }
+
+
 _REGISTRY = MetricsRegistry()
 
 

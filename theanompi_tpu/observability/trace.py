@@ -324,6 +324,89 @@ def raw_to_chrome(lines) -> dict:
     }
 
 
+def merge_raw_traces(named_traces) -> dict:
+    """Merge several ``save_raw`` JSONL files into ONE Chrome trace
+    document with a distinct, named process track per input — so
+    Perfetto opens a multi-worker run as one timeline instead of one
+    tab per rank (``python -m theanompi_tpu.observability merge``).
+
+    ``named_traces``: iterable of ``(label, lines)`` where ``label``
+    names the input (usually the filename stem) and ``lines`` is the
+    raw JSONL line iterable.  Each file keeps its own header pid (the
+    SPMD rank when the run used ``set_process``); files that COLLIDE on
+    a pid — e.g. two single-process runs that both defaulted to
+    ``os.getpid()`` — are remapped to the first free pid so their
+    tracks never interleave.  Process tracks are named from the header
+    ``process_name``, falling back to the label.  Unknown/corrupt lines
+    are skipped (a crash-truncated rank must not sink the merge); the
+    summed per-file drop counts are surfaced in ``otherData``.
+    """
+    meta: List[dict] = []
+    events: List[dict] = []
+    used_pids: set = set()
+    total_dropped = 0
+    for label, lines in named_traces:
+        header: Optional[dict] = None
+        file_events: List[dict] = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("kind") == "header" and header is None:
+                header = doc
+            elif "ph" in doc:
+                file_events.append(doc)
+        src_pid = int(
+            (header or {}).get(
+                "pid",
+                file_events[0].get("pid", 0) if file_events else 0,
+            )
+            or 0
+        )
+        pid = src_pid
+        while pid in used_pids:
+            pid += 1
+        used_pids.add(pid)
+        name = (header or {}).get("process_name") or label
+        total_dropped += int((header or {}).get("dropped", 0) or 0)
+        meta.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        )
+        for tid, tname in sorted(((header or {}).get("tracks") or {}).items()):
+            meta.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": int(tid),
+                    "args": {"name": tname},
+                }
+            )
+        for ev in file_events:
+            if pid != src_pid or "pid" not in ev:
+                ev = {**ev, "pid": pid}
+            events.append(ev)
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "theanompi_tpu.observability",
+            "merged_inputs": len(used_pids),
+            "dropped_events": total_dropped,
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # module-level singleton + convenience API (what call sites import)
 # ---------------------------------------------------------------------------

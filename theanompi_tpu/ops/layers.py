@@ -42,6 +42,26 @@ State = Any
 Shape = Tuple[int, ...]
 
 
+def static_bool(flag, what: str = "flag") -> bool:
+    """Coerce a mode flag to a trace-time-static Python bool.
+
+    Layers whose train/eval branch changes the COLLECTIVE sequence
+    (sync-BatchNorm's pmean pair) must take the branch identically on
+    every worker, which is only guaranteed when the flag is a concrete
+    host value baked into the trace.  A traced value gets a targeted
+    TypeError here — at the call site, naming the flag — instead of a
+    TracerBoolConversionError from somewhere inside the layer (or, if
+    it ever reached ``shard_map`` per-worker, a silent hang).
+    """
+    if isinstance(flag, jax.core.Tracer):
+        raise TypeError(
+            f"{what} must be a trace-time-static Python bool, got a "
+            f"traced value ({type(flag).__name__}) — pass a concrete "
+            "True/False (mark the argument static under jit)"
+        )
+    return bool(flag)
+
+
 # ---------------------------------------------------------------------------
 # initializers (the reference's `Weight` init modes)
 # ---------------------------------------------------------------------------
@@ -637,9 +657,17 @@ class BatchNorm(Layer):
         return params, state, in_shape
 
     def apply(self, params, state, x, train=False, rng=None):
+        # The branch below changes the COLLECTIVE sequence (sync-BN
+        # issues a pmean pair in train mode only), so the flag must be
+        # a trace-time constant, identical on every worker — never a
+        # traced value that could steer workers into different arms
+        # (graftlint GL-C002).  static_bool proves that: it rejects
+        # tracers with a targeted TypeError instead of letting jit's
+        # TracerBoolConversionError surface from deep inside the step.
+        training = static_bool(train, "BatchNorm 'train'")
         reduce_axes = tuple(range(x.ndim - 1))
         xf = x.astype(jnp.float32)  # fp32 moments even for bf16 activations
-        if train:
+        if training:
             mean = jnp.mean(xf, axis=reduce_axes)
             var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
             if self.axis_name is not None:

@@ -282,8 +282,11 @@ class BSP_Worker:
                             model.run_validation(count, rec)
                     else:
                         model.run_validation(count, rec)
-                rec.end_epoch(count, epoch)
+                # count the completed epoch BEFORE the boundary row is
+                # cut — end_epoch bills counter deltas to the epoch
+                # that just finished, and this increment belongs to it
                 _EPOCHS.inc(rule="bsp")
+                rec.end_epoch(count, epoch)
                 self._log_memory(rec, f"epoch_{epoch + 1}")
                 # comm re-probe every comm_probe_every epochs (default
                 # 5 — per-epoch probing cost ~8 extra compiled steps and

@@ -70,6 +70,10 @@ class Recorder:
         self._train_err = 0.0
         self._train_n = 0
         self.epoch_start: Optional[float] = None
+        # counter baseline for per-epoch deltas: captured at the first
+        # start_epoch (so compile/startup counts never pollute epoch 0)
+        # and rolled forward at every end_epoch
+        self._counter_base: Optional[Dict[str, float]] = None
         self.val_history: List[dict] = []
         # one-off structured events (comm-fraction probe, restarts, …);
         # saved to the record file with their own `kind`
@@ -94,6 +98,8 @@ class Recorder:
     # ---- epoch ----------------------------------------------------------
     def start_epoch(self) -> None:
         self.epoch_start = time.perf_counter()
+        if self._counter_base is None:
+            self._counter_base = _obs.counter_values()
 
     def end_epoch(self, count: int, epoch: int) -> float:
         now = time.perf_counter()
@@ -104,6 +110,22 @@ class Recorder:
             print(f"epoch {epoch} took {dt:.2f}s", flush=True)
         if self._tb is not None:
             self._tb.add_scalar("epoch/seconds", dt, epoch)
+        # per-epoch JSONL row with the metric-counter DELTAS since the
+        # previous boundary (ROADMAP observability open item): the
+        # record becomes self-contained — iterations, gossip pushes,
+        # bytes on the wire per epoch — without scraping /metrics
+        cur = _obs.counter_values()
+        deltas = _obs.counter_deltas(cur, self._counter_base or {})
+        self._counter_base = cur
+        self.events.append(
+            {
+                "kind": "epoch",
+                "epoch": epoch,
+                "iter": count,
+                "seconds": round(dt, 6),
+                "counters": deltas,
+            }
+        )
         self.epoch_start = None
         return dt
 
