@@ -600,6 +600,20 @@ def main():
             "trace_raw": paths["trace_raw"],
             "metrics": observability.get_registry().snapshot(),
         }
+        if "doctor" in paths:
+            # the doctor's self-diagnosis rides the BENCH line too:
+            # comm/compute/idle fractions and overlap are MECHANIZED
+            # (observability/analysis.py), so a perf round's claims
+            # carry their own evidence — and scripts/bench_compare.py
+            # can gate on the next round's deltas
+            detail["observability"]["doctor"] = paths["doctor"]
+            with open(paths["doctor"]) as f:
+                report = json.load(f)
+            detail["observability"]["fractions"] = {
+                label: rank.get("fractions")
+                for label, rank in report.get("ranks", {}).items()
+                if not rank.get("empty")
+            }
     except OSError as e:  # export must never discard the measurement
         print(f"[bench] observability export failed: {e}",
               file=sys.stderr, flush=True)

@@ -181,6 +181,11 @@ def main():
         "ttft_p99_s": round(summary["ttft_p99_s"], 4),
         "tpot_p50_s": round(summary["tpot_p50_s"], 4),
         "tpot_p99_s": round(summary["tpot_p99_s"], 4),
+        # which estimator produced each percentile pair: "exact"
+        # nearest-rank over the per-request rows, or "histogram"
+        # bucket interpolation once the row window overflowed — a
+        # JSON consumer must never mistake one for the other
+        "percentile_estimators": summary["estimators"],
         "cpu_rehearsal": CPU_REHEARSAL,
     }
     try:
@@ -190,6 +195,8 @@ def main():
             "trace_raw": paths["trace_raw"],
             "metrics": observability.get_registry().snapshot(),
         }
+        if "doctor" in paths:
+            detail["observability"]["doctor"] = paths["doctor"]
     except OSError as e:  # export must never discard the measurement
         print(f"[bench_serve] observability export failed: {e}",
               file=sys.stderr, flush=True)

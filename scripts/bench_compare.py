@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Diff two ``BENCH_*`` JSON artifacts; exit nonzero on regression.
+
+The train bench (``bench.py``) and serve bench (``bench_serve.py``)
+each emit ONE JSON line: ``{"metric", "value", "detail": {...}}``.
+This comparator turns two of those into a verdict a CI gate can act
+on — per-metric deltas, with a configurable relative tolerance —
+so "the new round is slower" is a failing exit code, not a thing
+someone has to notice while scrolling.
+
+Accepted inputs, per file:
+
+- a bare BENCH JSON object (what ``THEANOMPI_BENCH_SERVE_OUT`` writes),
+- a file whose LAST parseable JSON line is the BENCH object (raw
+  bench stdout), or
+- the driver's wrapper (``BENCH_r{N}.json``: ``{"cmd", "rc", "tail"}``)
+  — the BENCH line is recovered from ``tail``.
+
+Compared metrics:
+
+- ``value`` (named by the ``metric`` field) — higher is better.
+- ``detail`` latency keys (``*_p50_s``, ``*_p99_s``, ``wall_s``) —
+  lower is better.
+
+Only keys present in BOTH files compare; a metric that disappeared is
+reported (loudly) but does not fail the gate — schema growth is not a
+regression.  A baseline value of 0 (a failed round) skips that metric
+with a note, because a ratio against a dead run means nothing.
+
+Exit codes: 0 ok, 1 regression beyond tolerance, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+LOWER_BETTER_SUFFIXES = ("_p50_s", "_p99_s")
+LOWER_BETTER_KEYS = ("wall_s",)
+
+
+def extract_bench(text: str) -> Optional[dict]:
+    """The BENCH object from any of the accepted file shapes."""
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        if "metric" in doc and "value" in doc:
+            return doc
+        if "tail" in doc:  # driver wrapper: recover from captured stdout
+            text = str(doc.get("tail", ""))
+        else:
+            return None
+    # scan lines bottom-up: the BENCH line is the run's last word
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand and "value" in cand:
+            return cand
+    return None
+
+
+def comparable_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
+    """``name -> (value, direction)`` with direction 'higher'/'lower'."""
+    out: Dict[str, Tuple[float, str]] = {
+        str(doc.get("metric", "value")): (float(doc["value"]), "higher")
+    }
+    detail = doc.get("detail") or {}
+    for key, val in detail.items():
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        if key.endswith(LOWER_BETTER_SUFFIXES) or key in LOWER_BETTER_KEYS:
+            out[key] = (float(val), "lower")
+    return out
+
+
+def compare(
+    base: dict, new: dict, tolerance: float
+) -> Tuple[List[dict], List[str]]:
+    """``(rows, notes)``; a row is one metric's verdict."""
+    b = comparable_metrics(base)
+    n = comparable_metrics(new)
+    rows: List[dict] = []
+    notes: List[str] = []
+    for key in sorted(set(b) | set(n)):
+        if key not in n:
+            notes.append(f"{key}: present in baseline only (dropped?)")
+            continue
+        if key not in b:
+            notes.append(f"{key}: new metric (no baseline)")
+            continue
+        old_v, direction = b[key]
+        new_v, _ = n[key]
+        if old_v == 0:
+            notes.append(
+                f"{key}: baseline is 0 (failed round?) — skipped"
+            )
+            continue
+        delta = (new_v - old_v) / abs(old_v)
+        worse = -delta if direction == "higher" else delta
+        rows.append(
+            {
+                "metric": key,
+                "direction": direction,
+                "baseline": old_v,
+                "new": new_v,
+                "delta_pct": 100.0 * delta,
+                "regression": worse > tolerance,
+            }
+        )
+    return rows, notes
+
+
+def render(rows: List[dict], notes: List[str], tolerance: float) -> str:
+    lines = [
+        f"{'metric':<40} {'baseline':>12} {'new':>12} {'delta':>8}  verdict"
+    ]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        verdict = (
+            f"REGRESSION (>{tolerance * 100:.0f}% worse)"
+            if r["regression"]
+            else "ok"
+        )
+        lines.append(
+            f"{r['metric']:<40} {r['baseline']:>12.4f} {r['new']:>12.4f} "
+            f"{r['delta_pct']:>+7.1f}%  {verdict}"
+        )
+    for note in notes:
+        lines.append(f"note: {note}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff two BENCH_* JSON files; exit 1 on regression"
+    )
+    p.add_argument("baseline", help="older BENCH json (the reference)")
+    p.add_argument("candidate", help="newer BENCH json (under test)")
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.05,
+        help="relative worsening allowed before failing (default 0.05)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    docs = []
+    for path in (args.baseline, args.candidate):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        doc = extract_bench(text)
+        if doc is None:
+            print(
+                f"{path}: no BENCH JSON object found (need a line with "
+                "'metric' and 'value')",
+                file=sys.stderr,
+            )
+            return 2
+        docs.append(doc)
+    base, new = docs
+    if base.get("metric") != new.get("metric"):
+        print(
+            f"warning: comparing different benches "
+            f"({base.get('metric')} vs {new.get('metric')}) — only "
+            "shared detail keys align",
+            file=sys.stderr,
+        )
+    rows, notes = compare(base, new, args.tolerance)
+    regressions = [r for r in rows if r["regression"]]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "tolerance": args.tolerance,
+                    "rows": rows,
+                    "notes": notes,
+                    "regressions": [r["metric"] for r in regressions],
+                },
+                indent=2,
+            )
+        )
+    else:
+        sys.stdout.write(render(rows, notes, args.tolerance))
+    for r in regressions:
+        print(
+            f"REGRESSION: {r['metric']} {r['delta_pct']:+.1f}% "
+            f"({'drop' if r['direction'] == 'higher' else 'rise'} beyond "
+            f"{args.tolerance * 100:.0f}% tolerance)",
+            file=sys.stderr,
+        )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

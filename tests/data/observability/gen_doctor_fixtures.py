@@ -1,0 +1,104 @@
+"""Regenerate the doctor's golden multi-rank raw-JSONL fixtures.
+
+Run from the repo root::
+
+    python tests/data/observability/gen_doctor_fixtures.py
+    python -m theanompi_tpu.observability doctor \
+        tests/data/observability/doctor_rank*_trace_raw.jsonl \
+        --json --out tests/data/observability/doctor_report_golden.json
+
+Planted facts the pinned report must recover (asserted by name in
+tests/test_observability_doctor.py, so a regen cannot silently absorb
+a behavior change):
+
+- rank2 is the straggler: 15ms steps every 16ms vs 9ms steps every
+  10ms on rank0/rank1 → final lag 30ms, index 30/49 ≈ 0.6122.
+- rank1 has an inbox stall: depth rises to 3 at t=25ms, peaks at 5,
+  drains to 0 at t=40ms (a 15ms window) with a 2ms inbox_wait overlap.
+- rank0 sends mid-step (comm/compute overlap = 1.0); rank1's comm
+  partially overlaps (send in the gap, recvs inside steps).
+- flows: rank0 begins tcp:0:0..4, rank1 ends only 0..3 (tcp:0:4 is
+  the planted never-drained frame); rank1 begins tcp:1:0, rank0 ends
+  it → 5 matched of 6 begun.
+"""
+
+import json
+import os
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+
+
+def w(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def header(pid, name):
+    return {"kind": "header", "pid": pid, "process_name": name,
+            "tracks": {"0": "MAIN"}, "dropped": 0}
+
+
+def step(pid, k, ts, dur):
+    return {"ph": "X", "name": "train_iter", "ts": float(ts),
+            "dur": float(dur), "pid": pid, "tid": 0,
+            "args": {"iter": k + 1}}
+
+
+def send(pid, ts, dur, dst, fid):
+    return [
+        {"ph": "X", "name": "tcp_send", "ts": float(ts), "dur": float(dur),
+         "pid": pid, "tid": 0, "args": {"dst": dst, "bytes": 4096}},
+        {"ph": "s", "cat": "flow", "name": "tcp_msg", "id": fid,
+         "ts": float(ts + dur / 2), "pid": pid, "tid": 0,
+         "args": {"dst": dst}},
+    ]
+
+
+def recv(pid, ts, dur, src, fid):
+    return [
+        {"ph": "X", "name": "tcp_recv", "ts": float(ts), "dur": float(dur),
+         "pid": pid, "tid": 1, "args": {"bytes": 4096, "src": src}},
+        {"ph": "f", "bp": "e", "cat": "flow", "name": "tcp_msg",
+         "id": fid, "ts": float(ts + dur / 2), "pid": pid, "tid": 1},
+    ]
+
+
+def depth(pid, ts, v):
+    return {"ph": "C", "name": "inbox_depth", "ts": float(ts), "pid": pid,
+            "tid": 1, "args": {"rank": pid, "value": float(v)}}
+
+
+def main():
+    # rank0: 5 x 9ms steps every 10ms; sends INSIDE compute
+    r0 = [header(0, "rank0")]
+    for k in range(5):
+        r0.append(step(0, k, k * 10_000, 9_000))
+        r0 += send(0, k * 10_000 + 5_000, 500, 1, f"tcp:0:{k}")
+    r0 += recv(0, 41_000, 400, 1, "tcp:1:0")
+
+    # rank1: same cadence; the stall lives here
+    r1 = [header(1, "rank1")]
+    for k in range(5):
+        r1.append(step(1, k, k * 10_000, 9_000))
+    r1 += send(1, 9_000, 500, 0, "tcp:1:0")
+    for k in range(4):  # drains 4 of rank0's 5 frames
+        r1 += recv(1, 20_000 + k * 1_000, 300, 0, f"tcp:0:{k}")
+    r1 += [depth(1, 25_000, 3), depth(1, 30_000, 5), depth(1, 40_000, 0)]
+    r1.append({"ph": "X", "name": "inbox_wait", "ts": 26_000.0,
+               "dur": 2_000.0, "pid": 1, "tid": 1, "args": {"rank": 1}})
+
+    # rank2: the straggler — 15ms steps every 16ms, no comm at all
+    r2 = [header(2, "rank2")]
+    for k in range(5):
+        r2.append(step(2, k, k * 16_000, 15_000))
+
+    w(os.path.join(OUT, "doctor_rank0_trace_raw.jsonl"), r0)
+    w(os.path.join(OUT, "doctor_rank1_trace_raw.jsonl"), r1)
+    w(os.path.join(OUT, "doctor_rank2_trace_raw.jsonl"), r2)
+    print("fixtures written; re-pin the golden with the doctor CLI "
+          "(see module docstring)")
+
+
+if __name__ == "__main__":
+    main()

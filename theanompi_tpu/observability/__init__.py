@@ -45,6 +45,7 @@ from theanompi_tpu.observability.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    bucket_quantile,
     counter_deltas,
     flatten_counters,
     get_registry,
@@ -53,6 +54,9 @@ from theanompi_tpu.observability.metrics import (
 from theanompi_tpu.observability.trace import (
     Tracer,
     add_span,
+    counter_event,
+    flow_begin,
+    flow_end,
     get_tracer,
     instant,
     merge_raw_traces,
@@ -69,12 +73,16 @@ __all__ = [
     "MetricsRegistry",
     "Tracer",
     "add_span",
+    "bucket_quantile",
     "counter_deltas",
+    "counter_event",
     "counter_values",
     "disable_tracing",
     "dump_all",
     "enable_tracing",
     "flatten_counters",
+    "flow_begin",
+    "flow_end",
     "get_flight_recorder",
     "get_registry",
     "get_tracer",
@@ -122,14 +130,22 @@ def counter_values() -> dict:
     return flatten_counters(get_registry().snapshot())
 
 
-def enable_tracing(buffer=None) -> Tracer:
+def enable_tracing(buffer=None, sample=None) -> Tracer:
     """Turn span collection on (bounded buffer) and feed finished spans
-    into the flight recorder's rings."""
+    into the flight recorder's rings.  ``sample=N`` keeps 1-in-N spans
+    per thread track (deterministic; instants/flows/counters always
+    kept) for sustained production tracing; defaults to the
+    ``THEANOMPI_OBS_SAMPLE`` env var, else keep-everything."""
     tracer = get_tracer()
     fr = get_flight_recorder()
     if fr.record_span not in tracer.span_sinks:
         tracer.span_sinks.append(fr.record_span)
-    tracer.enable(buffer=buffer)
+    if sample is None:
+        try:
+            sample = int(os.environ.get("THEANOMPI_OBS_SAMPLE", "") or 1)
+        except ValueError:
+            sample = 1
+    tracer.enable(buffer=buffer, sample=sample)
     return tracer
 
 

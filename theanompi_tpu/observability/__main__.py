@@ -17,12 +17,21 @@ Commands:
   files (default: every ``*trace_raw.jsonl`` in the directory) into ONE
   Chrome trace with a distinct, named process track per rank — open a
   multi-worker run as a single Perfetto timeline.
+- ``doctor [files...]``         analyze per-rank raw traces (same
+  default discovery as ``merge``): per-step wall time, comm/compute/
+  idle fractions, comm-under-compute overlap, straggler index, inbox
+  stalls, flow accounting; ``--metrics FILE`` adds serving TTFT/TPOT
+  percentiles from a registry snapshot's histogram buckets.  Human
+  table by default, ``--json`` for machines.  Threshold flags
+  (``--max-straggler``, ``--min-overlap``, ``--max-stall-s``,
+  ``--max-ttft-p99-s``, ``--max-tpot-p99-s``) exit 1 on violation —
+  the CI perf-regression gate.
 - ``serve --port N``            serve /metrics, /trace, /flight from the
   current (empty, unless something enabled tracing in-process) state —
   mainly a smoke surface; real deployments call
   ``export.ObservabilityServer`` from inside the run.
 
-Exit codes: 0 ok, 2 usage/missing-input.
+Exit codes: 0 ok, 1 doctor threshold violation, 2 usage/missing-input.
 """
 
 from __future__ import annotations
@@ -91,23 +100,26 @@ def _cmd_dump(args) -> int:
     return 0
 
 
-def _cmd_merge(args) -> int:
+def _load_named(args, verb: str):
+    """Shared input discovery for merge/doctor: explicit files or every
+    ``*trace_raw.jsonl`` in the observability dir.  Returns ``(named,
+    rc)`` — named is None when rc != 0."""
     d = _resolve_dir(args)
     paths: List[str] = list(args.inputs or [])
     if not paths:
         paths = sorted(glob.glob(os.path.join(d, "*trace_raw.jsonl")))
     if not paths:
         print(
-            f"no raw traces to merge (looked for *trace_raw.jsonl in {d}; "
+            f"no raw traces to {verb} (looked for *trace_raw.jsonl in {d}; "
             "pass files explicitly or point --dir at a run's "
             "observability directory)",
             file=sys.stderr,
         )
-        return 2
+        return None, 2
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         print(f"no such trace file(s): {', '.join(missing)}", file=sys.stderr)
-        return 2
+        return None, 2
     named = []
     for p in paths:
         with open(p, "r", encoding="utf-8") as f:
@@ -116,14 +128,61 @@ def _cmd_merge(args) -> int:
         if label.endswith("_trace_raw.jsonl"):
             label = label[: -len("_trace_raw.jsonl")]
         named.append((label, lines))
+    return named, 0
+
+
+def _cmd_merge(args) -> int:
+    named, rc = _load_named(args, "merge")
+    if rc:
+        return rc
     doc = merge_raw_traces(named)
     _write_out(json.dumps(doc) + "\n", args.out)
+    for label in doc["otherData"].get("empty_inputs", []):
+        print(
+            f"warning: {label} contributed no events (dead worker?) — "
+            "kept as an empty named track",
+            file=sys.stderr,
+        )
     print(
         f"merged {len(named)} trace(s), "
         f"{len(doc['traceEvents'])} event rows",
         file=sys.stderr,
     )
     return 0
+
+
+def _cmd_doctor(args) -> int:
+    from theanompi_tpu.observability import analysis
+
+    named, rc = _load_named(args, "diagnose")
+    if rc:
+        return rc
+    snapshot = None
+    if args.metrics:
+        if not os.path.exists(args.metrics):
+            print(f"no such metrics snapshot: {args.metrics}",
+                  file=sys.stderr)
+            return 2
+        with open(args.metrics, "r", encoding="utf-8") as f:
+            snapshot = json.load(f)
+    report = analysis.analyze(
+        named, metrics_snapshot=snapshot, stall_min_s=args.stall_min_s
+    )
+    if args.json:
+        _write_out(json.dumps(report, indent=2) + "\n", args.out)
+    else:
+        _write_out(analysis.render_report(report), args.out)
+    violations = analysis.check_thresholds(
+        report,
+        max_straggler=args.max_straggler,
+        min_overlap=args.min_overlap,
+        max_stall_s=args.max_stall_s,
+        max_ttft_p99_s=args.max_ttft_p99_s,
+        max_tpot_p99_s=args.max_tpot_p99_s,
+    )
+    for violation in violations:
+        print(f"THRESHOLD VIOLATION: {violation}", file=sys.stderr)
+    return 1 if violations else 0
 
 
 def _cmd_serve(args) -> int:
@@ -175,6 +234,64 @@ def _build_parser() -> argparse.ArgumentParser:
     g.add_argument("--dir", default=None, help="observability directory")
     g.add_argument("--out", default=None, help="write here instead of stdout")
     g.set_defaults(fn=_cmd_merge)
+    doc = sub.add_parser(
+        "doctor",
+        help="analyze per-rank raw traces: fractions, stragglers, "
+        "stalls, flows; threshold flags gate CI",
+    )
+    doc.add_argument(
+        "inputs",
+        nargs="*",
+        help="raw trace files (default: every *trace_raw.jsonl in the "
+        "observability directory)",
+    )
+    doc.add_argument("--dir", default=None, help="observability directory")
+    doc.add_argument("--out", default=None, help="write here instead of stdout")
+    doc.add_argument(
+        "--metrics",
+        default=None,
+        help="registry snapshot (*metrics.json) for serving percentiles",
+    )
+    doc.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    doc.add_argument(
+        "--stall-min-s",
+        type=float,
+        default=0.0,
+        help="ignore inbox-depth windows shorter than this (seconds)",
+    )
+    doc.add_argument(
+        "--max-straggler",
+        type=float,
+        default=None,
+        help="fail (exit 1) when any rank's straggler index exceeds this",
+    )
+    doc.add_argument(
+        "--min-overlap",
+        type=float,
+        default=None,
+        help="fail when any rank's comm/compute overlap falls below this",
+    )
+    doc.add_argument(
+        "--max-stall-s",
+        type=float,
+        default=None,
+        help="fail when any inbox stall outlasts this many seconds",
+    )
+    doc.add_argument(
+        "--max-ttft-p99-s",
+        type=float,
+        default=None,
+        help="fail when serving TTFT p99 exceeds this (needs --metrics)",
+    )
+    doc.add_argument(
+        "--max-tpot-p99-s",
+        type=float,
+        default=None,
+        help="fail when serving TPOT p99 exceeds this (needs --metrics)",
+    )
+    doc.set_defaults(fn=_cmd_doctor)
     s = sub.add_parser("serve", help="local HTTP endpoint (opt-in)")
     s.add_argument("--port", type=int, default=9100)
     s.add_argument("--host", default="127.0.0.1")

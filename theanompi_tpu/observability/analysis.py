@@ -1,0 +1,604 @@
+"""Trace analytics — the offline "doctor".
+
+The tracer (``trace.py``) records what happened; this module answers
+whether it was any good.  It consumes the raw JSONL the tracer writes
+(``save_raw``; one file per rank) and reconstructs the run the way the
+Theano-MPI paper accounts for it (arXiv:1605.08325 §per-step time
+accounting) and the CUDA-Aware-MPI characterization study argues
+scaling claims must be made (arXiv:1810.11112): mechanized comm /
+compute fractions, per-rank stragglers, queue stalls — numbers, not
+eyeballed timelines.
+
+What it computes, per rank (= per input raw file):
+
+- **Step reconstruction** — every ``train_iter`` span is one step:
+  count, total/mean/p50/max wall time.
+- **Time fractions** — compute (``train_iter``), comm (transport +
+  exchange spans), input wait (``data_wait``/``inbox_wait``) and idle,
+  as overlap-aware interval unions over the rank's trace window (two
+  threads both sending concurrently count the wall time once).
+- **Comm/compute overlap** — the fraction of comm wall time hidden
+  under compute: THE number behind the framework's whole value
+  proposition (keep the math busy while the exchanger moves weights).
+- **Straggler index** — cumulative time to each step boundary measured
+  from the rank's OWN first step (clock-offset-free: per-rank raw
+  traces have unsynchronized epochs), compared against the fastest
+  rank at every common boundary.
+- **Queue stalls** — windows where the ``inbox_depth`` counter events
+  (``Tracer.counter_event``) sat above zero, correlated with
+  ``inbox_wait`` spans, so a backed-up mailbox has a start, an end and
+  a depth instead of being a vibe.
+- **Flow accounting** — every ``flow_begin`` must meet its
+  ``flow_end`` across the rank set; unmatched arrows mean frames that
+  were sent and never drained (lost, or a dead receiver).
+
+plus serving TTFT/TPOT percentiles from a metrics-registry snapshot's
+histogram buckets (``bucket_quantile`` — the estimator
+``BENCH_serve`` falls back to when its exact-row window overflows).
+
+Pure stdlib, pure functions over parsed dicts: ``analyze`` never
+touches the live tracer, so it can run against a week-old artifact
+directory on a laptop.  The CLI wrapper is
+``python -m theanompi_tpu.observability doctor`` (human table or
+``--json``; ``--max-straggler`` / ``--min-overlap`` / ``--max-stall-s``
+/ ``--max-ttft-p99-s`` turn verdicts into nonzero exit codes, which is
+how CI gates on them).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# span-name → category tables.  One definition: the instrumentation
+# sites (workers/transport/async_workers/loader) and this file must
+# agree on names, and here is where the agreement lives.
+COMPUTE_SPANS = ("train_iter",)
+COMM_SPANS = (
+    "tcp_send",
+    "tcp_recv",
+    "tcp_request",
+    "tcp_serve",
+    "mbox_send",
+    "comm",
+    "easgd_exchange",
+    "gosgd_push",
+    "gosgd_merge",
+)
+WAIT_SPANS = ("data_wait", "inbox_wait")
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+def parse_raw(label: str, lines: Iterable[str]) -> dict:
+    """One rank's raw JSONL → a plain dict of its events, corrupt lines
+    skipped (same tolerance as ``raw_to_chrome``: a crash-truncated
+    rank must still be diagnosable)."""
+    header: Optional[dict] = None
+    spans: List[dict] = []
+    counters: List[dict] = []
+    flow_begin: Dict[str, float] = {}
+    flow_end: Dict[str, float] = {}
+    n_events = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("kind") == "header" and header is None:
+            header = doc
+            continue
+        ph = doc.get("ph")
+        if ph is None:
+            continue
+        n_events += 1
+        if ph == "X":
+            spans.append(doc)
+        elif ph == "C":
+            counters.append(doc)
+        elif ph == "s":
+            flow_begin[str(doc.get("id"))] = float(doc.get("ts", 0.0))
+        elif ph == "f":
+            flow_end[str(doc.get("id"))] = float(doc.get("ts", 0.0))
+    h = header or {}
+    return {
+        "label": label,
+        "pid": h.get("pid"),
+        "process_name": h.get("process_name") or label,
+        "dropped": int(h.get("dropped", 0) or 0),
+        "sample_rate": int(h.get("sample_rate", 1) or 1),
+        "sampled_out": int(h.get("sampled_out", 0) or 0),
+        "empty": header is None and n_events == 0,
+        "spans": spans,
+        "counters": counters,
+        "flow_begin": flow_begin,
+        "flow_end": flow_end,
+    }
+
+
+# ---------------------------------------------------------------------------
+# interval math (µs in, µs out; callers convert to seconds at the edge)
+# ---------------------------------------------------------------------------
+
+def merge_intervals(
+    intervals: List[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """Sorted union of half-open intervals — overlapping spans (e.g.
+    two sender threads in flight at once) count wall time ONCE."""
+    out: List[Tuple[float, float]] = []
+    for a, b in sorted(intervals):
+        if b <= a:
+            continue
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def total(intervals: List[Tuple[float, float]]) -> float:
+    return sum(b - a for a, b in intervals)
+
+
+def intersect_total(
+    a: List[Tuple[float, float]], b: List[Tuple[float, float]]
+) -> float:
+    """Total overlap between two MERGED interval lists (linear scan)."""
+    i = j = 0
+    out = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _spans_named(rank: dict, names: Tuple[str, ...]) -> List[dict]:
+    wanted = set(names)
+    return [s for s in rank["spans"] if s.get("name") in wanted]
+
+
+def _intervals(spans: List[dict]) -> List[Tuple[float, float]]:
+    return merge_intervals(
+        [(float(s["ts"]), float(s["ts"]) + float(s.get("dur", 0.0)))
+         for s in spans]
+    )
+
+
+def _nearest_rank(sorted_vals: List[float], pct: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    k = max(
+        0,
+        min(
+            len(sorted_vals) - 1,
+            int(round(pct / 100.0 * (len(sorted_vals) - 1))),
+        ),
+    )
+    return sorted_vals[k]
+
+
+# ---------------------------------------------------------------------------
+# per-rank reconstruction
+# ---------------------------------------------------------------------------
+
+def _analyze_rank(rank: dict, stall_min_s: float) -> dict:
+    spans = rank["spans"]
+    if not spans:
+        return {
+            "empty": True,
+            "pid": rank["pid"],
+            "n_spans": 0,
+            "dropped": rank["dropped"],
+            "sample_rate": rank["sample_rate"],
+            "sampled_out": rank["sampled_out"],
+        }
+    t0 = min(float(s["ts"]) for s in spans)
+    t1 = max(float(s["ts"]) + float(s.get("dur", 0.0)) for s in spans)
+    window = max(0.0, t1 - t0)
+
+    steps = sorted(
+        _spans_named(rank, COMPUTE_SPANS), key=lambda s: float(s["ts"])
+    )
+    durs = sorted(float(s.get("dur", 0.0)) / 1e6 for s in steps)
+    compute = _intervals(_spans_named(rank, COMPUTE_SPANS))
+    comm = _intervals(_spans_named(rank, COMM_SPANS))
+    wait = _intervals(_spans_named(rank, WAIT_SPANS))
+    busy = merge_intervals(compute + comm + wait)
+    overlap_us = intersect_total(comm, compute)
+
+    out = {
+        "empty": False,
+        "pid": rank["pid"],
+        "n_spans": len(spans),
+        "window_s": window / 1e6,
+        "steps": {
+            "n": len(steps),
+            "total_s": sum(durs),
+            "mean_s": (sum(durs) / len(durs)) if durs else float("nan"),
+            "p50_s": _nearest_rank(durs, 50),
+            "max_s": durs[-1] if durs else float("nan"),
+        },
+        "fractions": {
+            "compute": total(compute) / window if window else 0.0,
+            "comm": total(comm) / window if window else 0.0,
+            "input_wait": total(wait) / window if window else 0.0,
+            "idle": (window - total(busy)) / window if window else 0.0,
+        },
+        # fraction of comm wall time hidden under compute — the overlap
+        # the framework exists to create; None when the rank did no comm
+        "comm_compute_overlap": (
+            overlap_us / total(comm) if total(comm) > 0 else None
+        ),
+        "dropped": rank["dropped"],
+        "sample_rate": rank["sample_rate"],
+        "sampled_out": rank["sampled_out"],
+    }
+    out["stalls"] = _find_stalls(rank, wait, stall_min_s)
+    return out
+
+
+def _step_boundaries(rank: dict) -> List[float]:
+    """Cumulative seconds from this rank's FIRST step start to each
+    step's end — per-rank-relative, so unsynchronized tracer epochs
+    across processes cancel out."""
+    steps = sorted(
+        _spans_named(rank, COMPUTE_SPANS), key=lambda s: float(s["ts"])
+    )
+    if not steps:
+        return []
+    base = float(steps[0]["ts"])
+    return [
+        (float(s["ts"]) + float(s.get("dur", 0.0)) - base) / 1e6
+        for s in steps
+    ]
+
+
+def _find_stalls(
+    rank: dict,
+    wait_intervals: List[Tuple[float, float]],
+    stall_min_s: float,
+) -> List[dict]:
+    """Windows where an inbox-depth counter sat above zero.  Each
+    window carries its max depth and its overlap with blocked-recv
+    (``inbox_wait``) spans: depth>0 while nobody is in recv means the
+    consumer was busy elsewhere (a scheduling stall); depth>0 inside
+    recv means the drain itself is the bottleneck."""
+    series: Dict[Any, List[Tuple[float, float]]] = {}
+    for ev in rank["counters"]:
+        if ev.get("name") != "inbox_depth":
+            continue
+        args = ev.get("args") or {}
+        key = args.get("rank")
+        series.setdefault(key, []).append(
+            (float(ev.get("ts", 0.0)), float(args.get("value", 0.0)))
+        )
+    stalls: List[dict] = []
+    for key, samples in sorted(
+        series.items(), key=lambda kv: str(kv[0])
+    ):
+        samples.sort()
+        start = None
+        max_depth = 0.0
+        for ts, val in samples:
+            if val > 0 and start is None:
+                start, max_depth = ts, val
+            elif val > 0:
+                max_depth = max(max_depth, val)
+            elif start is not None:
+                stalls.append((key, start, ts, max_depth))
+                start = None
+        if start is not None:  # never drained back to zero: open window
+            stalls.append((key, start, samples[-1][0], max_depth))
+    out = []
+    for key, a, b, depth in stalls:
+        dur = (b - a) / 1e6
+        if dur < stall_min_s:
+            continue
+        out.append(
+            {
+                "inbox_rank": key,
+                "start_s": a / 1e6,
+                "end_s": b / 1e6,
+                "duration_s": dur,
+                "max_depth": depth,
+                "recv_wait_overlap_s": intersect_total(
+                    [(a, b)], wait_intervals
+                ) / 1e6,
+            }
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serving percentiles from a metrics snapshot
+# ---------------------------------------------------------------------------
+
+def serving_percentiles(snapshot: dict) -> dict:
+    """TTFT/TPOT p50/p99 estimated from the registry snapshot's
+    histogram buckets (``bucket_quantile``), label series summed.  The
+    offline mirror of ``ServingMetrics.summary``'s overflow fallback —
+    and the honest label says so (``estimator: histogram``)."""
+    from theanompi_tpu.observability.metrics import bucket_quantile
+
+    out = {}
+    for metric, key in (
+        ("serve_ttft_seconds", "ttft"),
+        ("serve_tpot_seconds", "tpot"),
+    ):
+        doc = snapshot.get(metric)
+        if not doc or doc.get("kind") != "histogram":
+            continue
+        bounds = [float(b) for b in doc.get("bucket_bounds") or []]
+        agg = [0] * (len(bounds) + 1)
+        count = 0
+        for row in doc.get("series", []):
+            buckets = row.get("buckets") or {}
+            for i, b in enumerate(bounds):
+                agg[i] += int(buckets.get(repr(b), 0))
+            agg[-1] += int(buckets.get("+Inf", 0))
+            count += int(row.get("count", 0))
+        if count == 0:
+            continue
+        out[key] = {
+            "count": count,
+            "p50_s": bucket_quantile(bounds, agg, 0.50),
+            "p99_s": bucket_quantile(bounds, agg, 0.99),
+            "estimator": "histogram",
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------------
+
+def analyze(
+    named_traces: Iterable[Tuple[str, Iterable[str]]],
+    metrics_snapshot: Optional[dict] = None,
+    stall_min_s: float = 0.0,
+) -> dict:
+    """The doctor's whole diagnosis as one JSON-serializable dict.
+
+    ``named_traces``: ``(label, raw JSONL lines)`` per rank — the same
+    shape ``merge_raw_traces`` takes.  ``metrics_snapshot``: an
+    optional registry ``snapshot()`` dict (the ``*metrics.json``
+    artifact) for the serving section.  ``stall_min_s`` filters queue
+    stalls shorter than the threshold.
+    """
+    ranks = [parse_raw(label, lines) for label, lines in named_traces]
+    report: dict = {"ranks": {}, "warnings": []}
+    boundaries: Dict[str, List[float]] = {}
+    for r in ranks:
+        ra = _analyze_rank(r, stall_min_s)
+        report["ranks"][r["label"]] = ra
+        if ra["empty"]:
+            report["warnings"].append(
+                f"{r['label']}: empty trace — dead worker or truncated "
+                "file (rank kept visible, not dropped)"
+            )
+            continue
+        if ra["dropped"]:
+            report["warnings"].append(
+                f"{r['label']}: {ra['dropped']} events evicted by the "
+                "buffer bound — fractions undercount the evicted window"
+            )
+        b = _step_boundaries(r)
+        if b:
+            boundaries[r["label"]] = b
+
+    # ---- stragglers: lag behind the fastest rank at each common step
+    # boundary, measured per-rank-relative (clock-offset-free)
+    straggler: dict = {
+        "n_common_steps": 0,
+        "per_rank": {},
+        "straggler_rank": None,
+        "max_straggler_index": 0.0,
+    }
+    if len(boundaries) >= 2:
+        n_common = min(len(b) for b in boundaries.values())
+        straggler["n_common_steps"] = n_common
+        fastest = [
+            min(b[k] for b in boundaries.values()) for k in range(n_common)
+        ]
+        worst = (None, 0.0)
+        for label, b in sorted(boundaries.items()):
+            lags = [b[k] - fastest[k] for k in range(n_common)]
+            final = lags[-1] if lags else 0.0
+            idx = (
+                final / fastest[-1]
+                if n_common and fastest[-1] > 0
+                else 0.0
+            )
+            straggler["per_rank"][label] = {
+                "final_lag_s": final,
+                "mean_lag_s": sum(lags) / len(lags) if lags else 0.0,
+                "straggler_index": idx,
+            }
+            if idx > worst[1]:
+                worst = (label, idx)
+        straggler["straggler_rank"] = worst[0]
+        straggler["max_straggler_index"] = worst[1]
+    report["stragglers"] = straggler
+
+    # ---- cross-rank flow accounting: arrows must close
+    begun: Dict[str, str] = {}
+    ended: Dict[str, str] = {}
+    for r in ranks:
+        for fid in r["flow_begin"]:
+            begun[fid] = r["label"]
+        for fid in r["flow_end"]:
+            ended[fid] = r["label"]
+    matched = set(begun) & set(ended)
+    report["flows"] = {
+        "begun": len(begun),
+        "ended": len(ended),
+        "matched": len(matched),
+        "unmatched_begin": sorted(set(begun) - matched),
+        "unmatched_end": sorted(set(ended) - matched),
+    }
+    if report["flows"]["unmatched_begin"]:
+        report["warnings"].append(
+            f"{len(report['flows']['unmatched_begin'])} flow(s) begun "
+            "but never drained — frames in flight at dump time, lost, "
+            "or the receiver's trace is missing"
+        )
+
+    stalls = [
+        {"rank": label, **s}
+        for label, ra in sorted(report["ranks"].items())
+        for s in ra.get("stalls", [])
+    ]
+    report["stalls"] = stalls
+
+    if metrics_snapshot:
+        serving = serving_percentiles(metrics_snapshot)
+        if serving:
+            report["serving"] = serving
+    return _round_floats(report)
+
+
+def _round_floats(doc: Any, ndigits: int = 9) -> Any:
+    """Stable report floats (the golden fixture pins the whole dict)."""
+    if isinstance(doc, float):
+        return round(doc, ndigits)
+    if isinstance(doc, dict):
+        return {k: _round_floats(v, ndigits) for k, v in doc.items()}
+    if isinstance(doc, list):
+        return [_round_floats(v, ndigits) for v in doc]
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# verdicts
+# ---------------------------------------------------------------------------
+
+def check_thresholds(
+    report: dict,
+    max_straggler: Optional[float] = None,
+    min_overlap: Optional[float] = None,
+    max_stall_s: Optional[float] = None,
+    max_ttft_p99_s: Optional[float] = None,
+    max_tpot_p99_s: Optional[float] = None,
+) -> List[str]:
+    """Violations as human strings (empty = healthy).  The CLI exits
+    nonzero when any fire — the perf-regression gate."""
+    v: List[str] = []
+    idx = report.get("stragglers", {}).get("max_straggler_index", 0.0)
+    if max_straggler is not None and idx > max_straggler:
+        who = report["stragglers"].get("straggler_rank")
+        v.append(
+            f"straggler index {idx:.4f} > {max_straggler} (rank {who})"
+        )
+    if min_overlap is not None:
+        for label, ra in sorted(report.get("ranks", {}).items()):
+            ov = ra.get("comm_compute_overlap")
+            if ov is not None and ov < min_overlap:
+                v.append(
+                    f"{label}: comm/compute overlap {ov:.4f} < "
+                    f"{min_overlap}"
+                )
+    if max_stall_s is not None:
+        for s in report.get("stalls", []):
+            if s["duration_s"] > max_stall_s:
+                v.append(
+                    f"{s['rank']}: inbox stall {s['duration_s']:.4f}s > "
+                    f"{max_stall_s}s (depth {s['max_depth']:.0f})"
+                )
+    serving = report.get("serving", {})
+    for key, bound in (
+        ("ttft", max_ttft_p99_s),
+        ("tpot", max_tpot_p99_s),
+    ):
+        if bound is not None and key in serving:
+            p99 = serving[key]["p99_s"]
+            if p99 > bound:
+                v.append(f"{key} p99 {p99:.4f}s > {bound}s")
+    return v
+
+
+# ---------------------------------------------------------------------------
+# human rendering
+# ---------------------------------------------------------------------------
+
+def _pct(x) -> str:
+    return "-" if x is None else f"{100.0 * x:5.1f}%"
+
+
+def render_report(report: dict) -> str:
+    lines: List[str] = []
+    hdr = (
+        f"{'rank':<14} {'steps':>6} {'mean ms':>8} {'compute':>8} "
+        f"{'comm':>7} {'wait':>7} {'idle':>7} {'overlap':>8}"
+    )
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for label, ra in sorted(report.get("ranks", {}).items()):
+        if ra.get("empty"):
+            lines.append(f"{label:<14} EMPTY TRACE (dead worker?)")
+            continue
+        st, fr = ra["steps"], ra["fractions"]
+        mean_ms = (
+            f"{st['mean_s'] * 1e3:8.2f}" if st["n"] else f"{'-':>8}"
+        )
+        lines.append(
+            f"{label:<14} {st['n']:>6} {mean_ms} "
+            f"{_pct(fr['compute']):>8} {_pct(fr['comm']):>7} "
+            f"{_pct(fr['input_wait']):>7} {_pct(fr['idle']):>7} "
+            f"{_pct(ra['comm_compute_overlap']):>8}"
+        )
+    sg = report.get("stragglers", {})
+    if sg.get("per_rank"):
+        lines.append("")
+        lines.append(
+            f"stragglers (over {sg['n_common_steps']} common steps; "
+            "lag vs fastest rank at each boundary):"
+        )
+        for label, row in sorted(sg["per_rank"].items()):
+            mark = "  <-- STRAGGLER" if label == sg["straggler_rank"] and \
+                sg["max_straggler_index"] > 0 else ""
+            lines.append(
+                f"  {label:<12} final lag {row['final_lag_s'] * 1e3:8.2f} ms"
+                f"  index {row['straggler_index']:.4f}{mark}"
+            )
+    if report.get("stalls"):
+        lines.append("")
+        lines.append("inbox stalls (depth > 0 windows):")
+        for s in report["stalls"]:
+            lines.append(
+                f"  {s['rank']:<12} [{s['start_s']:.4f}s .. "
+                f"{s['end_s']:.4f}s] depth<= {s['max_depth']:.0f}  "
+                f"in-recv {s['recv_wait_overlap_s'] * 1e3:.2f} ms"
+            )
+    fl = report.get("flows", {})
+    if fl.get("begun") or fl.get("ended"):
+        lines.append("")
+        lines.append(
+            f"flows: {fl['matched']}/{fl['begun']} matched"
+            + (
+                f", {len(fl['unmatched_begin'])} never drained"
+                if fl.get("unmatched_begin")
+                else ""
+            )
+        )
+    if report.get("serving"):
+        lines.append("")
+        for key, row in sorted(report["serving"].items()):
+            lines.append(
+                f"serving {key}: p50 {row['p50_s'] * 1e3:.2f} ms  "
+                f"p99 {row['p99_s'] * 1e3:.2f} ms  "
+                f"({row['count']} obs, {row['estimator']} estimator)"
+            )
+    for w in report.get("warnings", []):
+        lines.append(f"WARNING: {w}")
+    return "\n".join(lines) + "\n"

@@ -64,6 +64,31 @@ def dump_all(
     with open(out["flight"], "w", encoding="utf-8") as f:
         json.dump(get_flight_recorder().snapshot(), f, default=str)
         f.write("\n")
+    # self-diagnosis rides every export: the doctor's report over this
+    # process's own raw trace + metrics snapshot, so a bench/crash
+    # artifact dir answers "was the run healthy" without another tool
+    # invocation.  Diagnostics must never sink the dump itself.
+    try:
+        from theanompi_tpu.observability import analysis
+
+        with open(out["trace_raw"], "r", encoding="utf-8") as f:
+            report = analysis.analyze(
+                [(prefix.rstrip("_") or "self", f.readlines())],
+                metrics_snapshot=reg.snapshot(),
+            )
+        doctor_path = os.path.join(d, f"{prefix}doctor.json")
+        with open(doctor_path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        out["doctor"] = doctor_path
+    except Exception as e:  # pragma: no cover - defensive
+        import sys
+
+        print(
+            f"[observability] doctor self-report failed: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
     return out
 
 

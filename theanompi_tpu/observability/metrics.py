@@ -49,6 +49,42 @@ def percentile(values: Sequence[float], pct: float) -> float:
     return float(v[k])
 
 
+def bucket_quantile(
+    bounds: Sequence[float], counts: Sequence[int], q: float
+) -> float:
+    """q-quantile (q in [0,1]) estimated from fixed-bucket histogram
+    counts by linear interpolation inside the winning bucket — the ONE
+    definition shared by live ``Histogram.quantile`` and the offline
+    consumers (the trace doctor, the serve-bench percentile fallback)
+    that work from snapshot/bucket data.  ``counts`` has one entry per
+    bound plus a trailing +Inf bucket.  NaN with no observations; the
+    last finite bound when the rank lands in +Inf (a floor, stated
+    rather than extrapolated)."""
+    bounds = tuple(float(b) for b in bounds)
+    counts = list(counts)
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"need len(bounds)+1 counts (+Inf last): "
+            f"{len(bounds)} bounds, {len(counts)} counts"
+        )
+    total = sum(counts)
+    if total == 0:
+        return float("nan")
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev_cum = cum
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(bounds):
+                return float(bounds[-1])
+            lo = 0.0 if i == 0 else bounds[i - 1]
+            hi = bounds[i]
+            frac = (rank - prev_cum) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+    return float(bounds[-1])
+
+
 def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
@@ -175,28 +211,14 @@ class Histogram(_Instrument):
             s["count"] += 1
 
     def quantile(self, q: float, **labels) -> float:
-        """Estimated q-quantile (q in [0,1]) by linear interpolation
-        inside the winning bucket; NaN with no observations; the last
-        finite bound when the rank lands in +Inf."""
+        """Estimated q-quantile (q in [0,1]) via ``bucket_quantile``
+        over this series' counts; NaN with no observations."""
         with self._lock:
             s = self._series.get(_label_key(labels))
             if s is None or s["count"] == 0:
                 return float("nan")
             counts = list(s["counts"])
-            total = s["count"]
-        rank = q * total
-        cum = 0.0
-        for i, c in enumerate(counts):
-            prev_cum = cum
-            cum += c
-            if cum >= rank and c > 0:
-                if i >= len(self.buckets):
-                    return float(self.buckets[-1])
-                lo = 0.0 if i == 0 else self.buckets[i - 1]
-                hi = self.buckets[i]
-                frac = (rank - prev_cum) / c
-                return lo + (hi - lo) * min(1.0, max(0.0, frac))
-        return float(self.buckets[-1])
+        return bucket_quantile(self.buckets, counts, q)
 
     def _series_snapshot_locked(self) -> List[dict]:
         out = []

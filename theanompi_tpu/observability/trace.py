@@ -26,6 +26,21 @@ Contracts:
   via ``set_process`` so merged traces line ranks up), ``tid`` is a
   small per-thread id assigned in first-span order and named after the
   thread (``EASGD_Worker-0`` etc. — the driver names its threads).
+- **Causal flow events** — ``flow_begin``/``flow_end`` emit Chrome
+  flow-event pairs (``ph: s``/``f``) sharing an id, so a message sent
+  on one rank and drained on another renders as an ARROW between the
+  two process tracks in Perfetto instead of two unrelated boxes
+  (``transport.TcpMailbox`` stamps every frame with a ``(src_rank,
+  seq)`` flow id).  ``counter_event`` emits Chrome counter samples
+  (``ph: C``) — the trace-side record of gauge motion (inbox depth)
+  the offline doctor correlates with spans.
+- **Sampling** — ``sample_rate=N`` keeps 1-in-N spans per thread track
+  (deterministic per-track counters: the kept set depends only on each
+  track's span sequence, never on wall time), so sustained production
+  runs can trace for hours without unbounded buffers.  Instant, flow
+  and counter events are never sampled — pairing and gauge crossings
+  must survive sampling.  Sampled-out spans are counted
+  (``sampled_out``), never silent.
 """
 
 from __future__ import annotations
@@ -97,6 +112,7 @@ class Tracer:
         pid: Optional[int] = None,
         buffer: int = DEFAULT_BUFFER,
         process_name: Optional[str] = None,
+        sample_rate: int = 1,
     ):
         import os
 
@@ -110,15 +126,24 @@ class Tracer:
         # thread ident -> (small tid, thread name at registration)
         self._tracks: Dict[int, tuple] = {}
         self.dropped = 0  # events evicted by the bound (visible, not silent)
+        # 1-in-N span sampling (1 = keep everything); per-track span
+        # sequence counters make the kept set deterministic
+        self.sample_rate = max(1, int(sample_rate))
+        self.sampled_out = 0
+        self._span_seq: Dict[int, int] = {}  # tid -> spans seen
         # called with each finished span dict (flight recorder feed);
         # invoked outside the buffer lock
         self.span_sinks: List[Callable[[dict], None]] = []
 
     # ---- lifecycle -----------------------------------------------------
-    def enable(self, buffer: Optional[int] = None) -> None:
+    def enable(
+        self, buffer: Optional[int] = None, sample: Optional[int] = None
+    ) -> None:
         with self._lock:
             if buffer is not None and buffer != self._buf.maxlen:
                 self._buf = deque(self._buf, maxlen=int(buffer))
+            if sample is not None:
+                self.sample_rate = max(1, int(sample))
             self.enabled = True
 
     def disable(self) -> None:
@@ -129,6 +154,8 @@ class Tracer:
             self._buf.clear()
             self._tracks.clear()
             self.dropped = 0
+            self.sampled_out = 0
+            self._span_seq.clear()
             self._epoch = self.clock()
 
     def set_process(self, pid: int, name: Optional[str] = None) -> None:
@@ -176,7 +203,16 @@ class Tracer:
         if args:
             ev["args"] = args
         with self._lock:
-            ev["tid"] = self._track_locked()
+            tid = ev["tid"] = self._track_locked()
+            if self.sample_rate > 1:
+                seq = self._span_seq.get(tid, 0)
+                self._span_seq[tid] = seq + 1
+                if seq % self.sample_rate:
+                    # deterministically sampled out: every Nth span per
+                    # track is kept (the first always survives, so short
+                    # traces are never empty); accounted, never silent
+                    self.sampled_out += 1
+                    return
             self._push_locked(ev)
         for sink in self.span_sinks:
             sink(ev)
@@ -197,6 +233,74 @@ class Tracer:
         with self._lock:
             ev["tid"] = self._track_locked()
             self._push_locked(ev)
+
+    def _point_event(self, ev: dict, args: Optional[dict]) -> None:
+        if args:
+            ev["args"] = args
+        with self._lock:
+            ev["tid"] = self._track_locked()
+            self._push_locked(ev)
+
+    def flow_begin(
+        self, name: str, flow_id: str, args: Optional[dict] = None
+    ) -> None:
+        """Start half of a causal arrow (Chrome flow event ``ph: s``).
+        Emit INSIDE the producing span (the send) so viewers bind the
+        arrow tail to that slice; the matching ``flow_end`` with the
+        same ``(name, flow_id)`` — typically on another rank — is the
+        arrow head.  Never sampled: a one-sided arrow is worse than no
+        arrow."""
+        if not self.enabled:
+            return
+        self._point_event(
+            {
+                "ph": "s",
+                "cat": "flow",
+                "name": name,
+                "id": str(flow_id),
+                "ts": self._us(self.clock()),
+                "pid": self.pid,
+            },
+            args,
+        )
+
+    def flow_end(
+        self, name: str, flow_id: str, args: Optional[dict] = None
+    ) -> None:
+        """Finish half of a causal arrow (``ph: f``, binding to the
+        enclosing slice — emit inside the consuming span)."""
+        if not self.enabled:
+            return
+        self._point_event(
+            {
+                "ph": "f",
+                "bp": "e",
+                "cat": "flow",
+                "name": name,
+                "id": str(flow_id),
+                "ts": self._us(self.clock()),
+                "pid": self.pid,
+            },
+            args,
+        )
+
+    def counter_event(
+        self, name: str, value: float, **series
+    ) -> None:
+        """One Chrome counter sample (``ph: C``) — the trace-timeline
+        record of a gauge (inbox depth): unlike the metrics registry,
+        each sample keeps its timestamp, so the offline doctor can find
+        CROSSINGS (when the queue backed up, for how long).  ``series``
+        labels the sample (e.g. ``rank="1"``)."""
+        if not self.enabled:
+            return
+        ev = {
+            "ph": "C",
+            "name": name,
+            "ts": self._us(self.clock()),
+            "pid": self.pid,
+        }
+        self._point_event(ev, {**series, "value": float(value)})
 
     def span(self, name: str, **args):
         """Context manager measuring a region; no-op when disabled."""
@@ -239,13 +343,17 @@ class Tracer:
         """The Chrome trace-event document (JSON Object Format):
         metadata rows naming the tracks, then every buffered event.
         Loads as-is in chrome://tracing and ui.perfetto.dev."""
+        other = {
+            "producer": "theanompi_tpu.observability",
+            "dropped_events": self.dropped,
+        }
+        if self.sample_rate > 1:
+            other["sample_rate"] = self.sample_rate
+            other["sampled_out"] = self.sampled_out
         return {
             "traceEvents": self._meta_events() + self.snapshot(),
             "displayTimeUnit": "ms",
-            "otherData": {
-                "producer": "theanompi_tpu.observability",
-                "dropped_events": self.dropped,
-            },
+            "otherData": other,
         }
 
     def export_chrome(self, path: str) -> str:
@@ -267,6 +375,9 @@ class Tracer:
             "tracks": {str(tid): name for tid, name in tracks},
             "dropped": self.dropped,
         }
+        if self.sample_rate > 1:
+            header["sample_rate"] = self.sample_rate
+            header["sampled_out"] = self.sampled_out
         with open(path, "w", encoding="utf-8") as f:
             f.write(json.dumps(header, default=str) + "\n")
             for ev in self.snapshot():
@@ -345,6 +456,7 @@ def merge_raw_traces(named_traces) -> dict:
     events: List[dict] = []
     used_pids: set = set()
     total_dropped = 0
+    empty_inputs: List[str] = []
     for label, lines in named_traces:
         header: Optional[dict] = None
         file_events: List[dict] = []
@@ -392,18 +504,44 @@ def merge_raw_traces(named_traces) -> dict:
                     "args": {"name": tname},
                 }
             )
+        if header is None and not file_events:
+            # dead/empty rank: a worker that died before its first flush
+            # used to vanish from the merged doc entirely — keep its
+            # named process track and plant a visible warning row so the
+            # absence IS the signal, not silence
+            empty_inputs.append(label)
+            events.append(
+                {
+                    "ph": "i",
+                    "name": "empty_trace",
+                    "s": "p",  # process-scoped marker
+                    "ts": 0,
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {
+                        "label": label,
+                        "warning": "no header and no events in this "
+                        "rank's raw trace (worker dead before first "
+                        "flush, or truncated to nothing)",
+                    },
+                }
+            )
+            continue
         for ev in file_events:
             if pid != src_pid or "pid" not in ev:
                 ev = {**ev, "pid": pid}
             events.append(ev)
+    other = {
+        "producer": "theanompi_tpu.observability",
+        "merged_inputs": len(used_pids),
+        "dropped_events": total_dropped,
+    }
+    if empty_inputs:
+        other["empty_inputs"] = empty_inputs
     return {
         "traceEvents": meta + events,
         "displayTimeUnit": "ms",
-        "otherData": {
-            "producer": "theanompi_tpu.observability",
-            "merged_inputs": len(used_pids),
-            "dropped_events": total_dropped,
-        },
+        "otherData": other,
     }
 
 
@@ -429,6 +567,18 @@ def span(name: str, **args):
 
 def instant(name: str, args: Optional[dict] = None) -> None:
     _TRACER.instant(name, args)
+
+
+def flow_begin(name: str, flow_id: str, args: Optional[dict] = None) -> None:
+    _TRACER.flow_begin(name, flow_id, args)
+
+
+def flow_end(name: str, flow_id: str, args: Optional[dict] = None) -> None:
+    _TRACER.flow_end(name, flow_id, args)
+
+
+def counter_event(name: str, value: float, **series) -> None:
+    _TRACER.counter_event(name, value, **series)
 
 
 def add_span(name: str, start: float, end: float, args=None) -> None:

@@ -241,10 +241,17 @@ class EASGD_Worker(_AsyncWorkerBase):
                 since_exchange += 1
                 if since_exchange >= self.tau:
                     since_exchange = 0
-                    rec.start("comm")
-                    new_w = self.server.exchange(self.get_params())
-                    self.set_params(new_w)
-                    rec.end("comm")
+                    # step-tagged exchange leg: the span carries the
+                    # iteration count, so one parameter exchange is
+                    # traceable end-to-end (this span ⊃ the transport's
+                    # tcp_request/tcp_send spans ⊃ the flow arrow) and
+                    # the trace doctor can attribute comm time to steps
+                    with obs.span("easgd_exchange", step=count,
+                                  tau=self.tau):
+                        rec.start("comm")
+                        new_w = self.server.exchange(self.get_params())
+                        self.set_params(new_w)
+                        rec.end("comm")
             self._epoch_end(epoch)
 
 
@@ -258,7 +265,7 @@ class GOSGD_Worker(_AsyncWorkerBase):
         self.n_pushes = 0  # observability: tests/operators can assert
         self.n_merges = 0  # gossip actually happened
 
-    def _merge_inbox(self):
+    def _merge_inbox(self, step: Optional[int] = None):
         msgs = self.mailbox.drain(self.rank)
         # cross-process transports expose reclaim_expired (app-level ack
         # protocol, distributed_async._GossipAdapter): weight whose push
@@ -272,23 +279,27 @@ class GOSGD_Worker(_AsyncWorkerBase):
                 self.weight += restored
         if not msgs:
             return
-        self.recorder.start("comm")
-        w_i = self.get_params()
-        a_i = self.weight
-        for (w_j, a_j) in msgs:
-            tot = a_i + a_j
-            w_i = jax.tree.map(
-                lambda wi, wj: (a_i * wi + a_j * wj) / tot, w_i, w_j
-            )
-            a_i = tot
-        self.weight = a_i
-        self.set_params(w_i)
-        self.n_merges += len(msgs)
-        _MERGES.inc(len(msgs), rank=str(self.rank))
-        _WEIGHT.set(self.weight, rank=str(self.rank))
-        self.recorder.end("comm")
+        # step-tagged merge leg (see easgd_exchange): the step number
+        # connects a merged gossip frame's flow arrow to the iteration
+        # that consumed it (None on the post-training settle drains)
+        with obs.span("gosgd_merge", step=step, n_msgs=len(msgs)):
+            self.recorder.start("comm")
+            w_i = self.get_params()
+            a_i = self.weight
+            for (w_j, a_j) in msgs:
+                tot = a_i + a_j
+                w_i = jax.tree.map(
+                    lambda wi, wj: (a_i * wi + a_j * wj) / tot, w_i, w_j
+                )
+                a_i = tot
+            self.weight = a_i
+            self.set_params(w_i)
+            self.n_merges += len(msgs)
+            _MERGES.inc(len(msgs), rank=str(self.rank))
+            _WEIGHT.set(self.weight, rank=str(self.rank))
+            self.recorder.end("comm")
 
-    def _maybe_push(self):
+    def _maybe_push(self, step: Optional[int] = None):
         if self._np_rng.rand() >= self.p_push or self.mailbox.n_ranks < 2:
             return
         peers = [r for r in range(self.mailbox.n_ranks) if r != self.rank]
@@ -296,7 +307,11 @@ class GOSGD_Worker(_AsyncWorkerBase):
         self.recorder.start("comm")
         self.weight /= 2.0
         try:
-            self.mailbox.send(dst, (self.get_params(), self.weight))
+            # step-tagged push leg: this span ⊃ the mailbox's send span
+            # ⊃ the flow-begin, so the arrow's tail is attributable to
+            # the iteration that pushed
+            with obs.span("gosgd_push", step=step, dst=dst):
+                self.mailbox.send(dst, (self.get_params(), self.weight))
             self.n_pushes += 1
             _PUSHES.inc(rank=str(self.rank))
             _WEIGHT.set(self.weight, rank=str(self.rank))
@@ -323,8 +338,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
                 rec.print_train_info(count)
                 if self.watchdog is not None:
                     self.watchdog.tick()
-                self._merge_inbox()
-                self._maybe_push()
+                self._merge_inbox(step=count)
+                self._maybe_push(step=count)
             self._epoch_end(epoch)
         # final drain so in-flight pushes aren't lost at shutdown
         self._merge_inbox()

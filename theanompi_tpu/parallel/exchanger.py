@@ -322,11 +322,15 @@ class BSP_Exchanger:
     def _record_wire_estimate(
         self, tree: Pytree, specs: Optional[Pytree], op: str
     ) -> None:
-        """Publish the per-step wire estimate as a gauge.  Runs at
-        TRACE time (this method executes while XLA traces the step), so
-        the cost is one host-side walk per compile, zero per step —
-        exactly the cadence a per-step-constant deserves."""
-        from theanompi_tpu.observability import get_registry
+        """Publish the per-step wire estimate as a gauge AND a trace
+        instant.  Runs at TRACE time (this method executes while XLA
+        traces the step), so the cost is one host-side walk per
+        compile, zero per step — exactly the cadence a
+        per-step-constant deserves.  The instant marks WHEN on the
+        timeline the step (re)compiled and with what wire recipe, so
+        the trace doctor can attribute comm bytes to the in-graph
+        exchange legs the host-side spans cannot see."""
+        from theanompi_tpu.observability import get_registry, instant
 
         total = [0]
         if specs is None:
@@ -356,6 +360,11 @@ class BSP_Exchanger:
             "(trace-time static estimate; see collective_wire_bytes "
             "for the HLO-parsed exact number)",
         ).set(total[0], strategy=self.strategy, op=op)
+        instant(
+            "exchanger_wire_estimate",
+            {"strategy": self.strategy, "op": op,
+             "bytes_per_step": total[0]},
+        )
 
     # -- error-feedback support -------------------------------------------
     @staticmethod

@@ -25,10 +25,12 @@ worker↔server exchange needs (the reference's paired MPI send+recv).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from theanompi_tpu import observability as obs
@@ -44,6 +46,40 @@ _FRAMES_SENT = _REG.counter("transport_frames_sent_total", "frames sent")
 _INBOX_DEPTH = _REG.gauge(
     "transport_inbox_depth", "messages queued awaiting drain/recv"
 )
+_REQUESTS = _REG.counter(
+    "transport_requests_total", "request/reply exchanges served"
+)
+_REQ_ERRORS = _REG.counter(
+    "transport_request_errors_total",
+    "request/reply failures (stage label: io/handler)",
+)
+_HANDLER_LAT = _REG.histogram(
+    "transport_handler_seconds",
+    "TcpServerChannel handler latency (decode excluded)",
+)
+
+# ---------------------------------------------------------------------------
+# causal flow ids: every transported message gets a (src, seq) identity so
+# the send on one rank and the drain on another render as ONE Chrome flow
+# arrow across process tracks (trace.flow_begin/flow_end).  TCP frames
+# carry the id inside the frame (a wire-encodable envelope tuple); the
+# in-process Mailbox wraps messages in a private holder.  Envelopes are
+# only added while tracing is enabled, and receivers ALWAYS unwrap — a
+# message sent while tracing was on must decode cleanly after it's off.
+# ---------------------------------------------------------------------------
+
+_FLOW_TAG = "__tmpi_flow__"
+_MBOX_SEQ = itertools.count()  # in-process flow ids (one trace, one space)
+
+
+class _FlowMsg:
+    """In-process Mailbox envelope: (flow id, payload)."""
+
+    __slots__ = ("fid", "msg")
+
+    def __init__(self, fid: str, msg: Any):
+        self.fid = fid
+        self.msg = msg
 
 
 class Mailbox:
@@ -54,11 +90,26 @@ class Mailbox:
         self._queues: List[queue.Queue] = [queue.Queue() for _ in range(n_ranks)]
 
     def send(self, dst: int, msg: Any) -> None:
-        self._queues[dst].put(msg)
+        if obs.get_tracer().enabled:
+            # the in-process analog of the TCP frame envelope: one flow
+            # id per message so send and drain pair up as an arrow
+            fid = f"mbox:{next(_MBOX_SEQ)}"
+            with obs.span("mbox_send", dst=dst):
+                obs.flow_begin("mbox_msg", fid, {"dst": dst})
+                self._queues[dst].put(_FlowMsg(fid, msg))
+        else:
+            self._queues[dst].put(msg)
         _FRAMES_SENT.inc(transport="mailbox")
-        _INBOX_DEPTH.set(
-            self._queues[dst].qsize(), transport="mailbox", rank=str(dst)
-        )
+        depth = self._queues[dst].qsize()
+        _INBOX_DEPTH.set(depth, transport="mailbox", rank=str(dst))
+        obs.counter_event("inbox_depth", depth, rank=int(dst))
+
+    @staticmethod
+    def _unwrap(m: Any) -> Any:
+        if isinstance(m, _FlowMsg):
+            obs.flow_end("mbox_msg", m.fid)
+            return m.msg
+        return m
 
     def drain(self, rank: int) -> List[Any]:
         """All currently-queued messages for ``rank`` (nonblocking)."""
@@ -66,16 +117,20 @@ class Mailbox:
         q = self._queues[rank]
         while True:
             try:
-                out.append(q.get_nowait())
+                out.append(self._unwrap(q.get_nowait()))
             except queue.Empty:
+                depth = q.qsize()
                 _INBOX_DEPTH.set(
-                    q.qsize(), transport="mailbox", rank=str(rank)
+                    depth, transport="mailbox", rank=str(rank)
                 )
+                if out:
+                    obs.counter_event("inbox_depth", depth, rank=int(rank))
                 return out
 
     def recv(self, rank: int, timeout: Optional[float] = None) -> Any:
         """Blocking receive (MPI recv analog). Raises queue.Empty on timeout."""
-        return self._queues[rank].get(timeout=timeout)
+        with obs.span("inbox_wait", rank=rank):
+            return self._unwrap(self._queues[rank].get(timeout=timeout))
 
 
 # ---------------------------------------------------------------------------
@@ -164,6 +219,7 @@ class TcpMailbox:
         self._listener.bind(("0.0.0.0", self.addresses[self.rank][1]))
         self._listener.listen(64)
         self._closed = False
+        self._flow_seq = itertools.count()  # (src_rank, seq) flow ids
         # persistent sender connections, one mutated-in-place holder per
         # destination — send() works on the holder so close() clearing
         # the dict can never yield a send-side KeyError
@@ -192,11 +248,29 @@ class TcpMailbox:
             with conn:
                 while True:
                     payload = recv_frame(conn)
-                    self._q.put(self._wire.decode(payload))
+                    with obs.span("tcp_recv", bytes=len(payload)) as sp:
+                        msg = self._wire.decode(payload)
+                        if (
+                            isinstance(msg, tuple)
+                            and len(msg) == 4
+                            and msg[0] == _FLOW_TAG
+                        ):
+                            # frame carries its (src_rank, seq) flow id:
+                            # close the arrow the sender's tcp_send
+                            # opened, then hand the bare message on
+                            _, src, seq, msg = msg
+                            sp.set(src=int(src))
+                            obs.flow_end(
+                                "tcp_msg", f"tcp:{int(src)}:{int(seq)}"
+                            )
+                        self._q.put(msg)
                     _BYTES_RECV.inc(len(payload), transport="tcp")
+                    depth = self._q.qsize()
                     _INBOX_DEPTH.set(
-                        self._q.qsize(), transport="tcp",
-                        rank=str(self.rank),
+                        depth, transport="tcp", rank=str(self.rank)
+                    )
+                    obs.counter_event(
+                        "inbox_depth", depth, rank=int(self.rank)
                     )
         except (ConnectionError, OSError):
             pass  # clean EOF between frames lands here too
@@ -219,11 +293,25 @@ class TcpMailbox:
             conn = self._out.get(dst)
             if conn is None:
                 conn = self._out[dst] = _OutConn()
+        fid = None
+        if obs.get_tracer().enabled:
+            # stamp the frame with this rank's next (src, seq) flow id —
+            # carried INSIDE the frame so the receiver (another process)
+            # can close the same arrow in ITS trace; the merged doc then
+            # draws sender→receiver across process tracks
+            seq = next(self._flow_seq)
+            fid = f"tcp:{self.rank}:{seq}"
+            msg = (_FLOW_TAG, self.rank, seq, msg)
         payload = self._wire.encode(msg)
         # comm-time attribution: the span covers connect+write (the
         # host-side cost a sender pays), the counters carry bytes moved
         with obs.span("tcp_send", dst=dst, bytes=len(payload)), conn.lock:
             self._send_locked(conn, dst, payload)
+            # arrow tail AFTER the write lands (still inside the span,
+            # so viewers bind it to this slice): a send that raised
+            # must not leave a dangling one-sided arrow
+            if fid is not None:
+                obs.flow_begin("tcp_msg", fid, {"dst": dst})
         _BYTES_SENT.inc(len(payload), transport="tcp")
         _FRAMES_SENT.inc(transport="tcp")
 
@@ -261,13 +349,19 @@ class TcpMailbox:
             try:
                 out.append(self._q.get_nowait())
             except queue.Empty:
+                depth = self._q.qsize()
                 _INBOX_DEPTH.set(
-                    self._q.qsize(), transport="tcp", rank=str(self.rank)
+                    depth, transport="tcp", rank=str(self.rank)
                 )
+                if out:
+                    obs.counter_event(
+                        "inbox_depth", depth, rank=int(self.rank)
+                    )
                 return out
 
     def recv(self, rank: Optional[int] = None, timeout: Optional[float] = None) -> Any:
-        return self._q.get(timeout=timeout)
+        with obs.span("inbox_wait", rank=self.rank):
+            return self._q.get(timeout=timeout)
 
     def close(self) -> None:
         self._closed = True
@@ -322,10 +416,25 @@ class TcpServerChannel:
             except OSError:
                 return
             try:
-                with conn:
-                    msg = self._wire.decode(recv_frame(conn))
-                    send_frame(conn, self._wire.encode(self._handler(msg)))
+                with conn, obs.span("tcp_serve") as sp:
+                    req = recv_frame(conn)
+                    _BYTES_RECV.inc(len(req), transport="server")
+                    msg = self._wire.decode(req)
+                    # handler latency separated from the I/O legs: the
+                    # histogram answers "is the server math slow" while
+                    # the span answers "is the exchange slow"
+                    t0 = time.perf_counter()
+                    try:
+                        reply = self._handler(msg)
+                    finally:
+                        _HANDLER_LAT.observe(time.perf_counter() - t0)
+                    out = self._wire.encode(reply)
+                    sp.set(bytes_in=len(req), bytes_out=len(out))
+                    send_frame(conn, out)
+                    _BYTES_SENT.inc(len(out), transport="server")
+                    _REQUESTS.inc(transport="server")
             except (ConnectionError, OSError):
+                _REQ_ERRORS.inc(transport="server", stage="io")
                 continue
             except Exception:
                 # a handler bug must not kill the serve thread (the
@@ -334,6 +443,7 @@ class TcpServerChannel:
                 # the unreplied client sees a fast connection error
                 import traceback
 
+                _REQ_ERRORS.inc(transport="server", stage="handler")
                 traceback.print_exc()
                 continue
 
@@ -349,7 +459,21 @@ def request(address: Tuple[str, int], msg: Any, timeout: float = 600.0) -> Any:
     """Client half of TcpServerChannel: one framed request, one reply."""
     from theanompi_tpu.parallel import wire
 
-    with socket.create_connection(tuple(address), timeout=timeout) as s:
-        send_frame(s, wire.encode(msg))
-        return wire.decode(recv_frame(s))
+    # the span covers the whole round trip (connect + request + the
+    # server's turnaround + reply decode) — the client-visible cost of
+    # one EASGD exchange leg; errors are counted before they propagate
+    with obs.span("tcp_request") as sp:
+        try:
+            payload = wire.encode(msg)
+            with socket.create_connection(tuple(address), timeout=timeout) as s:
+                send_frame(s, payload)
+                _BYTES_SENT.inc(len(payload), transport="request")
+                reply = recv_frame(s)
+        except (ConnectionError, OSError, socket.timeout):
+            _REQ_ERRORS.inc(transport="request", stage="io")
+            raise
+        _BYTES_RECV.inc(len(reply), transport="request")
+        _REQUESTS.inc(transport="request")
+        sp.set(bytes_out=len(payload), bytes_in=len(reply))
+        return wire.decode(reply)
 

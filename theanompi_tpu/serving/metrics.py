@@ -16,17 +16,30 @@ Definitions (industry-standard):
 
 The clock is injectable so tests and the offline bench can drive a
 simulated timeline deterministically.
+
+Percentile estimators: per-request rows power EXACT nearest-rank
+percentiles while the window holds them all; past ``max_rows``
+completed requests the rows become a bounded deque (newest window
+retained) and ``summary()`` switches to the fixed-bucket histogram
+estimate (``bucket_quantile`` — error bounded by the bucket width).
+The summary says which estimator produced each number
+(``estimators``), so a JSON consumer can never mistake an estimate
+for an exact rank.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, Optional
 
 from theanompi_tpu import observability as obs
 # the ONE percentile definition (nearest-rank) now lives in the
 # observability subsystem; re-exported here for existing importers
-from theanompi_tpu.observability.metrics import percentile  # noqa: F401
+from theanompi_tpu.observability.metrics import (  # noqa: F401
+    bucket_quantile,
+    percentile,
+)
 
 _REG = obs.get_registry()
 # sub-ms .. 30s: TTFT spans queue wait + a whole prefill, TPOT one
@@ -46,13 +59,41 @@ _TPOT = _REG.histogram(
 
 
 class ServingMetrics:
-    """Collects per-request latency rows; emits through a Recorder."""
+    """Collects per-request latency rows; emits through a Recorder.
 
-    def __init__(self, recorder=None, clock=time.perf_counter):
+    ``max_rows`` bounds the exact-row window: a sustained serving run
+    keeps the newest ``max_rows`` per-request rows (a deque) plus O(1)
+    running aggregates and per-instance histogram bucket counts, so
+    memory stays flat while ``summary()`` stays correct — it just
+    switches percentile estimator once the window overflows."""
+
+    def __init__(
+        self, recorder=None, clock=time.perf_counter, max_rows: int = 4096
+    ):
         self.recorder = recorder
         self.clock = clock
         self._open: Dict[str, dict] = {}
-        self.rows: List[dict] = []
+        self.max_rows = int(max_rows)
+        self.rows: deque = deque(maxlen=self.max_rows)
+        # running aggregates survive row eviction (summary() must never
+        # undercount a long run just because the window slid)
+        self.n_finished = 0
+        self._n_tokens = 0
+        self._t_min_admit: Optional[float] = None
+        self._t_max_done: Optional[float] = None
+        # per-INSTANCE bucket counts (the registry histograms are
+        # process-global — a second ServingMetrics or a warmup pass
+        # would pollute this instance's fallback percentiles)
+        self._ttft_counts = [0] * (len(_LATENCY_BUCKETS) + 1)
+        self._tpot_counts = [0] * (len(_LATENCY_BUCKETS) + 1)
+
+    @staticmethod
+    def _bucket_observe(counts, value: float) -> None:
+        for i, b in enumerate(_LATENCY_BUCKETS):
+            if value <= b:
+                counts[i] += 1
+                return
+        counts[-1] += 1  # +Inf
 
     # ---- request lifecycle (scheduler hooks) -------------------------
     def admitted(self, rid: str, n_prompt: int, t: Optional[float] = None):
@@ -88,13 +129,27 @@ class ServingMetrics:
             "t_done": t,
         }
         self.rows.append(done)
+        self.n_finished += 1
+        self._n_tokens += done["n_out"]
+        self._t_min_admit = (
+            done["t_admit"]
+            if self._t_min_admit is None
+            else min(self._t_min_admit, done["t_admit"])
+        )
+        self._t_max_done = (
+            done["t_done"]
+            if self._t_max_done is None
+            else max(self._t_max_done, done["t_done"])
+        )
         # registry histograms alongside the exact per-request rows: the
         # rows keep powering the exact nearest-rank summary(); the
         # histograms power /metrics scrapes and cross-subsystem
         # snapshots without retaining unbounded row lists
         _TTFT.observe(done["ttft_s"])
+        self._bucket_observe(self._ttft_counts, done["ttft_s"])
         if done["n_out"] > 1:
             _TPOT.observe(done["tpot_s"])
+            self._bucket_observe(self._tpot_counts, done["tpot_s"])
         if self.recorder is not None:
             self.recorder.log_event(
                 "serve_request",
@@ -107,26 +162,56 @@ class ServingMetrics:
 
     # ---- aggregate ---------------------------------------------------
     def summary(self) -> dict:
-        """Window aggregate: request count, token throughput, TTFT/TPOT
-        p50/p99.  Logged as one ``serve_summary`` event."""
-        ttfts = [r["ttft_s"] for r in self.rows]
-        tpots = [r["tpot_s"] for r in self.rows if r["n_out"] > 1]
-        tokens = sum(r["n_out"] for r in self.rows)
-        if self.rows:
-            span = max(r["t_done"] for r in self.rows) - min(
-                r["t_admit"] for r in self.rows
-            )
+        """Run aggregate: request count, token throughput, TTFT/TPOT
+        p50/p99.  Logged as one ``serve_summary`` event.
+
+        Percentiles are EXACT nearest-rank over the per-request rows
+        while every finished request is still in the window; once the
+        row deque has overflowed (``n_finished > max_rows``) exact
+        ranks are unrecoverable, so they come from this instance's
+        histogram buckets instead — ``estimators`` records which path
+        produced each pair (ROADMAP open item: histogram-backed
+        percentiles once windows outgrow exact rows)."""
+        tokens = self._n_tokens
+        if self.n_finished and self._t_max_done is not None:
+            span = self._t_max_done - self._t_min_admit
         else:
             span = 0.0
+        overflowed = self.n_finished > self.max_rows
+        if overflowed:
+            ttft = {
+                50: bucket_quantile(
+                    _LATENCY_BUCKETS, self._ttft_counts, 0.50
+                ),
+                99: bucket_quantile(
+                    _LATENCY_BUCKETS, self._ttft_counts, 0.99
+                ),
+            }
+            tpot = {
+                50: bucket_quantile(
+                    _LATENCY_BUCKETS, self._tpot_counts, 0.50
+                ),
+                99: bucket_quantile(
+                    _LATENCY_BUCKETS, self._tpot_counts, 0.99
+                ),
+            }
+            estimator = "histogram"
+        else:
+            ttfts = [r["ttft_s"] for r in self.rows]
+            tpots = [r["tpot_s"] for r in self.rows if r["n_out"] > 1]
+            ttft = {p: percentile(ttfts, p) for p in (50, 99)}
+            tpot = {p: percentile(tpots, p) for p in (50, 99)}
+            estimator = "exact"
         out = {
-            "n_requests": len(self.rows),
+            "n_requests": self.n_finished,
             "n_tokens_out": int(tokens),
             "window_s": float(span),
             "tokens_per_sec": (tokens / span) if span > 0 else 0.0,
-            "ttft_p50_s": percentile(ttfts, 50),
-            "ttft_p99_s": percentile(ttfts, 99),
-            "tpot_p50_s": percentile(tpots, 50),
-            "tpot_p99_s": percentile(tpots, 99),
+            "ttft_p50_s": ttft[50],
+            "ttft_p99_s": ttft[99],
+            "tpot_p50_s": tpot[50],
+            "tpot_p99_s": tpot[99],
+            "estimators": {"ttft": estimator, "tpot": estimator},
         }
         if self.recorder is not None and self.rows:
             self.recorder.log_event(
