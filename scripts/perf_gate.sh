@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# perf_gate.sh — the round-over-round perf gate, mechanized.
+#
+# Runs the bench, diffs its JSON against the previous round's BENCH
+# artifact with scripts/bench_compare.py, then runs the observability
+# doctor on the trace the bench dumped with --min-overlap — exiting
+# nonzero on EITHER a throughput/latency regression or an overlap
+# verdict below threshold.  This is the CI hook the ISSUE-6 exchanger
+# work is gated by: "did the bucketed wire actually overlap" is a
+# failing exit code, not prose in a round report.
+#
+# Env knobs (all optional; defaults run the CPU-rehearsal bench against
+# the newest BENCH_r*.json in the repo root):
+#   PERF_GATE_BENCH_CMD     command producing the BENCH JSON on stdout
+#                           (default: THEANOMPI_BENCH_CPU=1 python bench.py)
+#   PERF_GATE_BENCH_JSON    pre-produced bench output file (skips running)
+#   PERF_GATE_BASELINE      baseline BENCH_*.json (default: newest BENCH_r*.json)
+#   PERF_GATE_TOLERANCE     bench_compare relative tolerance (default 0.10)
+#   PERF_GATE_MIN_OVERLAP   doctor --min-overlap threshold (default 0.0 =
+#                           machinery exercised, no verdict enforced; perf
+#                           rounds on real chips raise it)
+#   PERF_GATE_TRACE         trace file for the doctor (default: extracted
+#                           from the bench JSON's detail.observability)
+#
+# Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+TOLERANCE="${PERF_GATE_TOLERANCE:-0.10}"
+MIN_OVERLAP="${PERF_GATE_MIN_OVERLAP:-0.0}"
+WORKDIR="$(mktemp -d "${TMPDIR:-/tmp}/perf_gate.XXXXXX")"
+trap 'rm -rf "$WORKDIR"' EXIT
+
+# ---- 1. the bench -----------------------------------------------------------
+NEW_JSON="${PERF_GATE_BENCH_JSON:-}"
+if [ -z "$NEW_JSON" ]; then
+    NEW_JSON="$WORKDIR/bench_new.json"
+    BENCH_CMD="${PERF_GATE_BENCH_CMD:-env THEANOMPI_BENCH_CPU=1 python bench.py}"
+    echo "[perf_gate] running: $BENCH_CMD" >&2
+    if ! sh -c "$BENCH_CMD" > "$NEW_JSON"; then
+        echo "[perf_gate] bench command failed" >&2
+        exit 1
+    fi
+fi
+if [ ! -s "$NEW_JSON" ]; then
+    echo "[perf_gate] no bench output at $NEW_JSON" >&2
+    exit 2
+fi
+
+# ---- 2. regression diff vs the previous round -------------------------------
+BASELINE="${PERF_GATE_BASELINE:-}"
+if [ -z "$BASELINE" ]; then
+    BASELINE="$(ls -1 BENCH_r*.json 2>/dev/null | sort | tail -n 1 || true)"
+fi
+if [ -z "$BASELINE" ] || [ ! -f "$BASELINE" ]; then
+    echo "[perf_gate] no baseline BENCH_*.json found — set PERF_GATE_BASELINE" >&2
+    exit 2
+fi
+echo "[perf_gate] bench_compare: $BASELINE -> $NEW_JSON (tolerance $TOLERANCE)" >&2
+python scripts/bench_compare.py "$BASELINE" "$NEW_JSON" --tolerance "$TOLERANCE"
+
+# ---- 3. doctor on the dumped trace ------------------------------------------
+TRACE="${PERF_GATE_TRACE:-}"
+if [ -z "$TRACE" ]; then
+    TRACE="$(python - "$NEW_JSON" <<'PY'
+import json, sys
+sys.path.insert(0, "scripts")
+from bench_compare import extract_bench
+doc = extract_bench(open(sys.argv[1]).read()) or {}
+obs = (doc.get("detail") or {}).get("observability") or {}
+print(obs.get("trace_raw", "") if isinstance(obs, dict) else "")
+PY
+)"
+fi
+if [ -z "$TRACE" ] || [ ! -f "$TRACE" ]; then
+    echo "[perf_gate] no trace to diagnose (bench ran without observability?)" >&2
+    exit 1
+fi
+echo "[perf_gate] doctor: $TRACE (--min-overlap $MIN_OVERLAP)" >&2
+python -m theanompi_tpu.observability doctor "$TRACE" --min-overlap "$MIN_OVERLAP"
+echo "[perf_gate] green" >&2

@@ -222,6 +222,65 @@ def test_step_trace_reaches_shard_step_from_worker_run():
     assert "pmean" in step
 
 
+def test_step_trace_sees_bucketed_collective_sequence():
+    """ISSUE 6: the bucketed exchanger routes reduce_grads through
+    ``_bucketed_map`` → ``_reduce_leaf_mean`` → the block wire; the
+    inliner must surface that chain's all_to_all/all_gather legs in the
+    whole-step trace, not lose them behind the new indirection."""
+    from theanompi_tpu.analysis import step_trace_report
+
+    traces = step_trace_report()
+    step = traces.get("base.TpuModel.compile_train.shard_step", ())
+    assert "all_to_all" in step and "all_gather" in step
+
+
+def test_step_trace_roots_include_custom_vjp_halves():
+    """In-DAG issue points live inside defvjp-registered backwards
+    (bucketing.GradSyncGroup) — those functions must be step-trace
+    roots so the divergence check walks the new issue order.  Ring
+    attention's custom-vjp bwd doubles as the positive case: its
+    registered backward really collects ppermute hops."""
+    from theanompi_tpu.analysis import step_trace_report
+
+    traces = step_trace_report()
+    assert "bucketing.GradSyncGroup.apply.bwd" in traces
+    assert "bucketing._gsp_bwd" in traces
+    assert traces.get("ring_attention._ring_flash_bwd") == (
+        "ppermute", "ppermute",
+    )
+
+
+def test_static_str_dispatch_tests_are_not_divergence():
+    """`mode == "mean"` / `strategy in ("int8", ...)` branches are
+    host-side config dispatch — trace-time static under SPMD — and
+    must not fire GL-C004 even when the arms' inlined collective
+    traces differ (the bucketed exchanger dispatches exactly so)."""
+    import ast
+
+    from theanompi_tpu.analysis.collectives import _is_static_str_test
+
+    def t(src):
+        return _is_static_str_test(ast.parse(src, mode="eval").body)
+
+    assert t('mode == "mean"')
+    assert t('mode != "mean"')
+    assert t('strategy in ("int8", "fp16s")')
+    assert t('not (mode == "rt")')
+    assert t('mode == "a" or other is None')
+    assert not t("flag")
+    assert not t("x > 3")
+    assert not t("a == b")
+    # the real exchanger must stay clean under the analyzer
+    import theanompi_tpu
+
+    pkg = os.path.dirname(theanompi_tpu.__file__)
+    findings, _ = analyze(paths=[
+        os.path.join(pkg, "parallel", "exchanger.py"),
+        os.path.join(pkg, "parallel", "bucketing.py"),
+    ])
+    assert not [f for f in findings if f.rule == "GL-C004"], findings
+
+
 def test_fixable_flag_in_expositions():
     findings = _findings("bad_donation.py")
     by_rule = {f.rule: f for f in findings}

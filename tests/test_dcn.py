@@ -95,6 +95,95 @@ def test_hybrid_compressed_strategies_track_flat_ar(strategy):
     np.testing.assert_allclose(hybrid, flat_ar, rtol=5e-2)
 
 
+def test_hierarchical_wire_moves_only_shard_bytes_across_dcn():
+    """ISSUE 6 tentpole (3): on the dp_dcn×dp mesh a block strategy
+    must lower to intra-slice reduce-scatter (full payload over ICI) +
+    cross-slice exchange of only the scattered shard + intra-slice
+    all-gather — pinned in the compiled HLO: the largest s8 collective
+    is the full padded payload (ICI legs) and the DCN legs carry
+    exactly 1/dp and 1/world of it; no payload-sized fp32 anywhere."""
+    import re
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel import quantize as Q
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+    mesh = make_mesh(dcn_shape=2)
+    axes = (DCN_AXIS, DATA_AXIS)
+    ex = BSP_Exchanger(strategy="int8", axis=axes, mesh=mesh)
+    n = 8 * Q.BLOCK * 4  # whole hierarchical chunks, no padding noise
+
+    def step(t):
+        return ex.reduce_grads({"g": t})["g"]
+
+    hlo = (
+        jax.jit(
+            jax.shard_map(
+                step, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
+                check_vma=False,
+            )
+        )
+        .lower(jax.ShapeDtypeStruct((8, n), jnp.float32))
+        .compile()
+        .as_text()
+    )
+    # only lines whose RESULT op is the collective (a dequant fusion
+    # naming %all-gather.N as an operand is compute, not wire)
+    coll = re.compile(r"= (s8|f32)\[([\d,]*)\][^=]* all-(?:to-all|gather)\(")
+    sizes, f32_sizes = set(), set()
+    for l in hlo.splitlines():
+        m = coll.search(l)
+        if not m:
+            continue
+        sz = int(np.prod([int(d) for d in m.group(2).split(",") if d]))
+        (sizes if m.group(1) == "s8" else f32_sizes).add(sz)
+    assert sizes, "hierarchical path lost its quantized collectives"
+    # ICI legs move the full payload; every DCN-leg RESULT is exactly
+    # the 1/dp reduce-scattered shard (the 1/world subshard exists only
+    # as the DCN all-gather's operand) — nothing in between, so no
+    # full-payload collective can be crossing DCN
+    assert sizes == {n, n // 4}, sizes
+    # fp32 may ride the wire only as per-block scales, never payloads
+    assert all(sz <= n // Q.BLOCK for sz in f32_sizes), f32_sizes
+
+
+def test_hierarchical_wire_bytes_estimate_models_dcn_shard():
+    """The wire-bytes gauge must model the hierarchical decomposition:
+    on the two-level mesh the estimate is strictly below the sequential
+    two-axis accounting (which charged the FULL payload to DCN too)."""
+    from theanompi_tpu.parallel import quantize as Q
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+    mesh = make_mesh(dcn_shape=2)
+    ex = BSP_Exchanger(
+        strategy="int8", axis=(DCN_AXIS, DATA_AXIS), mesh=mesh
+    )
+    n = 8 * Q.BLOCK * 32
+    est = ex._wire_bytes_for_size(n, (DCN_AXIS, DATA_AXIS))
+    ici_leg = n * 1 + (n // Q.BLOCK) * 4
+    dcn_leg = (n // 4) * 1 + (n // 4 // Q.BLOCK) * 4
+    assert est == ici_leg + dcn_leg
+    # the sequential (pre-hierarchical) accounting charged 2 full legs
+    assert est < 2 * ici_leg
+
+
+def test_hybrid_bucketed_ef_trains(tmp_path):
+    """Bucketing × hierarchy × EF compose: the default bucketed wire
+    with int8+EF on the two-level mesh tracks the flat fp32 run."""
+    from tests.test_bsp import _run_steps
+    from theanompi_tpu.runtime.mesh import make_mesh as _mm
+
+    losses_ar, _ = _run_steps(make_mesh(), per_shard_bs=8, n_steps=4)
+    losses, model = _run_steps(
+        _mm(dcn_shape=2), per_shard_bs=8, n_steps=4, dcn_shape=2,
+        exch_strategy="int8", error_feedback=True,
+    )
+    np.testing.assert_allclose(losses, losses_ar, rtol=2e-2)
+    assert model.exchanger.bucket_bytes is not None
+
+
 def test_dcn_engaged_on_direct_construction():
     """dcn_shape in CONFIG alone must build the two-level mesh — direct
     construction (no rule.init, no explicit mesh) included."""

@@ -744,6 +744,111 @@ def test_error_feedback_composes_with_grad_accum_and_clip():
     assert any(float(jnp.max(jnp.abs(l))) > 0 for l in ef_leaves)
 
 
+def test_bucketed_local_roundtrip_mirrors_bucket_leg1():
+    """Under bucketing the EF residual must be computed against the
+    BUCKETED leg-1 image: local_roundtrip of a multi-leaf tree equals
+    the quantize→dequantize image of the concatenated flat payload,
+    sliced back per leaf (byte-identical with the wire's leg 1)."""
+    mesh = make_mesh()
+    world = len(mesh.devices.reshape(-1))
+    ex = BSP_Exchanger(
+        strategy="int8", axis=DATA_AXIS, mesh=mesh, bucket_bytes=4 << 20
+    )
+    rng = np.random.RandomState(11)
+    # deliberately block-UNALIGNED sizes: the concat shifts quant-block
+    # boundaries across the leaf seam, which per-leaf rt cannot mirror
+    tree = {
+        "a": jnp.asarray(rng.randn(300).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(700).astype(np.float32)),
+    }
+    rt = jax.tree.map(np.array, ex.local_roundtrip(tree))
+    flat = np.concatenate(
+        [np.asarray(tree["a"]), np.asarray(tree["b"])]
+    )
+    chunk = world * Q.BLOCK
+    pad = (-flat.size) % chunk
+    x = np.pad(flat, (0, pad)).reshape(world, -1, Q.BLOCK)
+    q, s = Q.quantize_blocks(x)
+    oracle = np.asarray(Q.dequantize_blocks(q, s)).reshape(-1)
+    np.testing.assert_array_equal(rt["a"], oracle[:300])
+    np.testing.assert_array_equal(rt["b"], oracle[300:1000])
+
+
+def test_error_feedback_recovers_floored_gradients_bucketed():
+    """The EF recurrence through the BUCKETED wire: floored components
+    of a multi-leaf tree still accumulate and cross the wire — the
+    residual rides the bucket image, so the recurrence bound is the
+    same one quantization step as per-leaf."""
+    mesh = make_mesh()
+    world = len(mesh.devices.reshape(-1))
+    ex = BSP_Exchanger(
+        strategy="int8", axis=DATA_AXIS, mesh=mesh, bucket_bytes=4 << 20
+    )
+    n = world * Q.BLOCK
+    g_host = np.full(n, 1e-4, np.float32)
+    g_host[:: Q.BLOCK] = 1.0  # pins every block's int8 scale at ~1/127
+    # two leaves whose concat is the flat pattern above (seam at a
+    # non-block boundary exercises cross-leaf blocks)
+    split = 3 * Q.BLOCK + 17
+    tree = {"a": g_host[:split], "b": g_host[split:]}
+
+    def reduce_with_ef(t, e):
+        send = jax.tree.map(lambda g, r: g + r[0], t, e)
+        red, rt = ex.reduce_with_residual(send)
+        new_e = jax.tree.map(lambda s_, r_: (s_ - r_)[None], send, rt)
+        return red, new_e
+
+    mapped = jax.jit(
+        jax.shard_map(
+            reduce_with_ef, mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS)), out_specs=(P(), P(DATA_AXIS)),
+            check_vma=False,
+        )
+    )
+    t = jax.tree.map(jnp.array, tree)
+    e = jax.tree.map(
+        lambda v: jnp.zeros((world, v.size), jnp.float32), tree
+    )
+    K = 60
+    total = np.zeros(n, np.float64)
+    for _ in range(K):
+        red, e = mapped(t, e)
+        total += np.concatenate(
+            [np.asarray(red["a"]), np.asarray(red["b"])]
+        ).astype(np.float64)
+    tiny = total[1]
+    lsb = 1.0 / 127.0
+    assert tiny > 0.0
+    assert abs(tiny - K * 1e-4) <= 1.1 * lsb, tiny
+    # control: no EF, same bucketed wire — the component never moves
+    red0 = jax.jit(jax.shard_map(
+        lambda t_: ex.reduce_grads(t_), mesh=mesh,
+        in_specs=P(), out_specs=P(), check_vma=False,
+    ))(t)
+    assert np.asarray(red0["a"])[1] == 0.0
+
+
+def test_error_feedback_bucketed_training_matches_per_leaf_class():
+    """Model path with the default bucketed wire: int8+EF still tracks
+    the fp32 run (the test_error_feedback_recovers_floored_gradients-
+    class acceptance), and flipping to per-leaf trains equivalently."""
+    from tests.test_bsp import _run_steps
+
+    losses_ar, _ = _run_steps(make_mesh(), per_shard_bs=8, n_steps=4)
+    losses_bucket, model = _run_steps(
+        make_mesh(), per_shard_bs=8, n_steps=4,
+        exch_strategy="int8", error_feedback=True,
+    )
+    losses_leaf, _ = _run_steps(
+        make_mesh(), per_shard_bs=8, n_steps=4,
+        exch_strategy="int8", error_feedback=True,
+        exchange_overlap="leaf",
+    )
+    np.testing.assert_allclose(losses_bucket, losses_ar, rtol=2e-2)
+    np.testing.assert_allclose(losses_leaf, losses_ar, rtol=2e-2)
+    assert model.exchanger.bucket_bytes is not None  # default = bucketed
+
+
 def test_error_feedback_checkpoint_resume_happy_path(tmp_path):
     """EF residuals survive save -> fresh model -> load -> continue:
     restored sharded over dp (not replicated), training proceeds, and

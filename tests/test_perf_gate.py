@@ -1,0 +1,110 @@
+"""scripts/perf_gate.sh — the CI perf gate (ISSUE 6 satellite).
+
+Smoke-tested end-to-end with fixture BENCH JSONs and the committed
+3-rank doctor trace: green run exits 0, a throughput regression exits
+nonzero through bench_compare, and an unmet ``--min-overlap`` exits
+nonzero through the doctor.  The gate script is pure bash+stdlib, so
+this is cheap enough for tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "scripts", "perf_gate.sh")
+TRACE = os.path.join(
+    REPO, "tests", "data", "observability", "doctor_rank0_trace_raw.jsonl"
+)
+
+
+def _bench_json(path, value, trace=None):
+    detail = {"wall_s": 2.0}
+    if trace:
+        detail["observability"] = {"trace_raw": trace}
+    doc = {
+        "metric": "alexnet128_bsp_images_per_sec_per_chip",
+        "value": value,
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+        "measured_now": True,
+        "detail": detail,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def _run_gate(env_extra):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        ["bash", GATE], capture_output=True, text=True, env=env,
+        cwd=REPO, timeout=300,
+    )
+
+
+@pytest.fixture()
+def fixtures(tmp_path):
+    base = _bench_json(tmp_path / "base.json", 100.0)
+    good = _bench_json(tmp_path / "good.json", 101.0, trace=TRACE)
+    slow = _bench_json(tmp_path / "slow.json", 80.0, trace=TRACE)
+    return base, good, slow
+
+
+def test_gate_green(fixtures):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+    })
+    assert r.returncode == 0, r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_fails_on_regression(fixtures):
+    base, _, slow = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": slow,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_TOLERANCE": "0.05",
+    })
+    assert r.returncode != 0
+    assert "REGRESSION" in (r.stdout + r.stderr)
+
+
+def test_gate_fails_on_overlap_threshold(fixtures):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_MIN_OVERLAP": "1.1",  # unreachable: always violated
+    })
+    assert r.returncode != 0
+    assert "THRESHOLD VIOLATION" in (r.stdout + r.stderr)
+
+
+def test_gate_loud_without_baseline(fixtures, tmp_path):
+    _, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": str(tmp_path / "missing.json"),
+    })
+    assert r.returncode == 2
+    assert "baseline" in r.stderr
+
+
+def test_gate_extracts_trace_from_bench_json(fixtures, tmp_path):
+    """Without PERF_GATE_TRACE the gate finds the trace path inside the
+    bench JSON's detail.observability — the wiring bench.py emits."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_MIN_OVERLAP": "0.0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "doctor:" in r.stderr and "doctor_rank0" in r.stderr

@@ -1,0 +1,199 @@
+"""int8+scales compression for the async TCP legs (ISSUE 6 satellite).
+
+The EASGD/GOSGD host-mediated exchanges shipped fp32 parameter pytrees
+per frame; ``wire.q8_pack`` applies the exchanger's block recipe on the
+host side — pinned here: (a) math parity with ``quantize.
+quantize_blocks`` (one recipe, two implementations); (b) ~4× frame
+shrink through the real ``wire.encode`` framing; (c) the EF residual
+recurrence on the push leg; (d) transparent pass-through of non-f32 /
+sub-block leaves and protocol tuples; (e) the compressed-mailbox and
+remote-server integration points.
+"""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import wire
+
+
+def test_q8_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    a = rng.randn(10_000).astype(np.float32) * 3.0
+    packed, _ = wire.q8_pack({"w": a})
+    back = wire.q8_unpack(packed)["w"]
+    assert back.dtype == np.float32 and back.shape == a.shape
+    # per-block max-abs scaling bounds RN error at scale/2 per element
+    pad = (-a.size) % wire.Q8_BLOCK
+    x = np.pad(a, (0, pad)).reshape(-1, wire.Q8_BLOCK)
+    bound = (np.abs(x).max(axis=1) / 127.0) * 0.5 + 1e-6
+    err = np.abs(np.pad(back, (0, pad)).reshape(-1, wire.Q8_BLOCK) - x)
+    assert (err <= bound[:, None]).all()
+
+
+def test_q8_parity_with_quantize_blocks():
+    """ONE recipe: the host-side numpy quantizer must match the
+    in-graph XLA kernel bit-for-bit on aligned payloads."""
+    jax = pytest.importorskip("jax")
+    from theanompi_tpu.parallel import quantize as Q
+
+    assert wire.Q8_BLOCK == Q.BLOCK
+    rng = np.random.RandomState(1)
+    x = rng.randn(4 * Q.BLOCK).astype(np.float32)
+    packed, _ = wire.q8_pack({"x": x})
+    qj, sj = Q.quantize_blocks(x.reshape(-1, Q.BLOCK))
+    np.testing.assert_array_equal(packed["x"]["q"], np.asarray(qj))
+    np.testing.assert_allclose(packed["x"]["s"], np.asarray(sj), rtol=1e-6)
+
+
+def test_q8_frame_bytes_shrink_4x():
+    rng = np.random.RandomState(2)
+    params = {"w": rng.randn(100_000).astype(np.float32)}
+    full = len(wire.encode(params))
+    packed, _ = wire.q8_pack(params)
+    q8 = len(wire.encode(packed))
+    # int8 payload + fp32 scales (1/64 of elements) + header ≈ 0.27×
+    assert q8 < 0.3 * full
+    back = wire.q8_unpack(wire.decode(wire.encode(packed)))
+    amax = np.abs(params["w"]).max()
+    np.testing.assert_allclose(back["w"], params["w"], atol=amax / 127)
+
+
+def test_q8_passthrough_small_and_nonf32_leaves():
+    t = {
+        "tiny": np.arange(10, dtype=np.float32),  # < one block
+        "ints": np.arange(1000, dtype=np.int32),
+        "flag": True,
+        "name": "x",
+    }
+    packed, res = wire.q8_pack(t)
+    np.testing.assert_array_equal(packed["tiny"], t["tiny"])
+    np.testing.assert_array_equal(packed["ints"], t["ints"])
+    back = wire.q8_unpack(packed)
+    np.testing.assert_array_equal(back["tiny"], t["tiny"])
+    assert back["flag"] is True and back["name"] == "x"
+
+
+def test_q8_protocol_tuples_and_namedtuples_survive():
+    from collections import namedtuple
+
+    NT = namedtuple("NT", "a b")
+    rng = np.random.RandomState(3)
+    frame = ("push", 1, 7, NT(rng.randn(600).astype(np.float32), 0.5), 0.25)
+    packed, _ = wire.q8_pack(frame)
+    assert packed[0] == "push" and packed[2] == 7
+    back = wire.q8_unpack(packed)
+    assert isinstance(back[3], NT)
+    np.testing.assert_allclose(
+        back[3].a, frame[3].a, atol=np.abs(frame[3].a).max() / 127
+    )
+
+
+def test_q8_ef_residual_recurrence_recovers_floored_mass():
+    """THE push-leg EF property: a component below the block's
+    quantization step vanishes from every individual frame, but with
+    the residual recurrence the long-run average of what crosses the
+    wire equals the true value."""
+    base = np.zeros(512, np.float32)
+    base[0] = 1.0  # pins block scale ≈ 1/127 » 1e-4
+    base[1:] = 1e-4
+    t = {"w": base}
+    # control: without EF the component NEVER crosses
+    packed, _ = wire.q8_pack(t)
+    assert wire.q8_unpack(packed)["w"][5] == 0.0
+    res = None
+    acc = np.zeros_like(base)
+    K = 50
+    for _ in range(K):
+        packed, res = wire.q8_pack(t, res)
+        acc += wire.q8_unpack(packed)["w"]
+    assert abs(acc[5] / K - 1e-4) < 2.0 / 127 / K
+
+
+def test_q8_mismatched_residual_is_ignored():
+    rng = np.random.RandomState(4)
+    t = {"w": rng.randn(600).astype(np.float32)}
+    plain, _ = wire.q8_pack(t)
+    bad_res = {"w": np.ones(9999, np.float32)}  # wrong shape
+    packed, _ = wire.q8_pack(t, bad_res)
+    np.testing.assert_array_equal(packed["w"]["q"], plain["w"]["q"])
+
+
+def test_q8_fingerprint_keys_quantizable_shapes():
+    rng = np.random.RandomState(5)
+    params = {"w": rng.randn(600).astype(np.float32)}
+    fp1 = wire.q8_fingerprint(("push", 0, 1, params, 0.5))
+    fp2 = wire.q8_fingerprint(("push", 0, 2, params, 0.25))
+    assert fp1 == fp2 and fp1  # same payload shape, same key
+    assert wire.q8_fingerprint(("ack", 3)) == ()  # nothing to quantize
+
+
+def test_wire_dtype_seen():
+    rng = np.random.RandomState(6)
+    t = {"w": rng.randn(600).astype(np.float32)}
+    assert wire.wire_dtype_seen(t) == "float32"
+    assert wire.wire_dtype_seen(wire.q8_pack(t)[0]) == "int8+scales"
+    assert (
+        wire.wire_dtype_seen({"w": t["w"].astype(np.float16)}) == "float16"
+    )
+
+
+def test_compressed_mailbox_q8_roundtrip_and_residual_keying():
+    """The GOSGD integration point: a q8 _CompressedMailbox quantizes
+    params pushes (EF residual keyed by payload shape so interleaved
+    ack frames don't clobber it) and receivers reconstruct fp32."""
+    from theanompi_tpu.parallel.distributed_async import _CompressedMailbox
+
+    class _FakeInner:
+        n_ranks = 2
+
+        def __init__(self):
+            self.sent = []
+
+        def send(self, dst, msg):
+            self.sent.append(wire.decode(wire.encode(msg)))
+
+        def drain(self, rank=None):
+            out, self.sent = self.sent, []
+            return out
+
+        def close(self):
+            pass
+
+    inner = _FakeInner()
+    box = _CompressedMailbox(inner, "q8")
+    rng = np.random.RandomState(7)
+    params = {"w": rng.randn(4096).astype(np.float32)}
+    box.send(1, ("push", 0, 1, params, 0.5))
+    box.send(1, ("ack", 42))  # different structure: residual untouched
+    box.send(1, ("push", 0, 2, params, 0.25))
+    got = box.drain()
+    assert got[1] == ("ack", 42)
+    k1, k2 = got[0][3]["w"], got[2][3]["w"]
+    amax = np.abs(params["w"]).max()
+    np.testing.assert_allclose(k1, params["w"], atol=amax / 127)
+    # the second push carried the FIRST push's residual (EF): frames
+    # differ even though the input params were identical
+    assert (k1 != k2).any()
+    assert len(box._residuals) == 1  # keyed by payload fingerprint
+
+
+def test_remote_server_q8_push_leg_keeps_residual():
+    from theanompi_tpu.parallel.distributed_async import (
+        _RemoteServer, _pack_wire, _unpack_wire,
+    )
+
+    rng = np.random.RandomState(8)
+    params = {"w": rng.randn(2048).astype(np.float32)}
+    packed, res = _pack_wire(params, "q8")
+    assert wire.wire_dtype_seen(packed) == "int8+scales"
+    back = _unpack_wire(packed)
+    np.testing.assert_allclose(
+        back["w"], params["w"], atol=np.abs(params["w"]).max() / 127
+    )
+    assert res is not None
+    # fp16 mode still round-trips through the same unpack
+    p16, none_res = _pack_wire(params, np.float16)
+    assert none_res is None
+    assert _unpack_wire(p16)["w"].dtype == np.float32
+    srv = _RemoteServer(("127.0.0.1", 1), wire_dtype="q8")
+    assert srv._residual is None  # EF state starts empty

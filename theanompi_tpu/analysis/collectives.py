@@ -175,6 +175,39 @@ def _test_reads_params(test: ast.expr, params: Set[str]) -> bool:
     return False
 
 
+def _is_str_const(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return bool(node.elts) and all(_is_str_const(e) for e in node.elts)
+    return False
+
+
+def _is_static_str_test(test: ast.expr) -> bool:
+    """``x == "mean"`` / ``strategy in ("int8", ...)`` (possibly inside
+    bool ops / ``not``) — equality dispatch against string literals.
+    Strings never come off a traced array, so such a test is a
+    trace-time host constant identical on every SPMD worker (the
+    exchanger's wire-mode/strategy dispatch) — the same
+    never-a-runtime-branch class as ``_is_none_test``."""
+    if isinstance(test, ast.BoolOp):
+        from theanompi_tpu.analysis.recompile import _is_none_test
+
+        return all(
+            _is_static_str_test(v) or _is_none_test(v) for v in test.values
+        )
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_static_str_test(test.operand)
+    if isinstance(test, ast.Compare):
+        if all(
+            isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn))
+            for op in test.ops
+        ):
+            consts = [test.left] + list(test.comparators)
+            return any(_is_str_const(c) for c in consts)
+    return False
+
+
 def _branch_divergence(m: ParsedModule) -> List[Finding]:
     out: List[Finding] = []
     for fi in m.functions:

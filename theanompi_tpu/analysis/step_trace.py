@@ -167,6 +167,33 @@ class _Inliner:
         return None
 
 
+def _defvjp_roots(
+    modules: Sequence[ParsedModule], cg: CallGraph
+) -> List[str]:
+    """FQs of functions registered through ``<f>.defvjp(fwd, bwd)`` —
+    the custom-vjp halves.  The *bwd* bodies are where in-DAG exchange
+    issue points live (``bucketing.GradSyncGroup``: the group's
+    reduction runs inside the registered backward), so they must be
+    step-trace roots or the divergence check would never walk the new
+    issue order."""
+    out: List[str] = []
+    inliner = _Inliner(cg)
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute) and fn.attr == "defvjp"
+            ):
+                continue
+            for arg in node.args[:2]:  # (fwd, bwd)
+                fq = inliner.resolve_branch(m, arg, node)
+                if fq is not None and fq not in out:
+                    out.append(fq)
+    return out
+
+
 def _entrypoints(modules: Sequence[ParsedModule], cg: CallGraph) -> List[str]:
     eps: List[str] = [fq for fq in WORKER_ENTRYPOINTS if fq in cg.functions]
     for m in modules:
@@ -184,6 +211,9 @@ def _entrypoints(modules: Sequence[ParsedModule], cg: CallGraph) -> List[str]:
             )
             if fq is not None and fq not in eps:
                 eps.append(fq)
+    for fq in _defvjp_roots(modules, cg):
+        if fq not in eps:
+            eps.append(fq)
     return eps
 
 
@@ -275,6 +305,11 @@ def _python_branch_findings(
         if m.enclosing_function(node) is not summ.info:
             continue  # nested defs report through their own summaries
         if _is_none_test(node.test):
+            continue
+        if _coll._is_static_str_test(node.test):
+            # string-literal equality dispatch (wire mode / strategy
+            # strings) is a trace-time host constant — every worker
+            # takes the same arm by construction
             continue
         if not _coll._test_reads_params(node.test, params):
             continue

@@ -113,10 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(default: all localhost)",
     )
     p.add_argument(
-        "--wire-dtype", choices=["float32", "float16"], default="float32",
+        "--wire-dtype", choices=["float32", "float16", "q8"],
+        default="float32",
         help="async-exchange payload dtype: float16 halves EASGD/GOSGD "
         "parameter bytes on the wire (the reference's fp16 exchange "
-        "story); math always runs fp32",
+        "story); q8 = int8 + per-block scales, ~4x fewer bytes with an "
+        "EF residual on the push leg; math always runs fp32",
     )
     return p
 
@@ -142,7 +144,11 @@ def _async_distributed_main(args) -> int:
         model_config=model_config,
         n_epochs=None,
         checkpoint_dir=args.checkpoint_dir,
-        wire_dtype=_np.float16 if args.wire_dtype == "float16" else None,
+        wire_dtype=(
+            "q8"
+            if args.wire_dtype == "q8"
+            else _np.float16 if args.wire_dtype == "float16" else None
+        ),
     )
     if args.rule == "EASGD":
         if size < 2:

@@ -94,6 +94,18 @@ COMMON_DEFAULTS = dict(
     grad_accum=1,  # microbatches per step (lax.scan): grads accumulate
     # across K sequential fwd+bwd passes before ONE exchange+update —
     # K× the effective batch at 1/K the activation HBM
+    exchange_overlap="bucket",  # how the BSP gradient exchange is issued:
+    # 'leaf'   = PR-0 shape, one collective per gradient leaf after the
+    #            full backward (legacy escape hatch);
+    # 'bucket' = fuse leaves into ~exchange_bucket_mb flat buckets
+    #            (parallel.bucketing): one pack/pad/collective per
+    #            bucket, sub-chunk leaves quantize as part of a bucket;
+    # 'indag'  = bucketed AND issued inside the backward DAG at the
+    #            model's grad-sync points (bucketing.GradSyncGroup —
+    #            TransformerLM blocks, ResNet50 stages), so reduction
+    #            overlaps backprop (arXiv:1802.06949). Models without
+    #            sync groups reject it loudly.
+    exchange_bucket_mb=4.0,  # bucket size for 'bucket'/'indag'
     dcn_shape=None,  # N = two-level ('dp_dcn', dp...) mesh: intra-slice
     # collectives ride ICI, only the outer reduction crosses DCN
     # (make_mesh(dcn_shape=...)); honored by the DP build_mesh so
@@ -420,8 +432,21 @@ class TpuModel:
                 k: v for k, v in self.opt_state.items() if k != "ef_wire"
             }
         self._place_sharded_state()
+        overlap = str(cfg.get("exchange_overlap", "bucket"))
+        if overlap not in ("leaf", "bucket", "indag"):
+            raise ValueError(
+                f"exchange_overlap must be leaf|bucket|indag, got {overlap!r}"
+            )
+        bucket_bytes = (
+            None
+            if overlap == "leaf"
+            else int(float(cfg.get("exchange_bucket_mb", 4.0)) * (1 << 20))
+        )
         exchanger = exchanger or BSP_Exchanger(
-            strategy=cfg.exch_strategy, axis=self.exchange_axes, mesh=self.mesh
+            strategy=cfg.exch_strategy,
+            axis=self.exchange_axes,
+            mesh=self.mesh,
+            bucket_bytes=bucket_bytes,
         )
         axis = exchanger.axis
         opt = self.optimizer
@@ -481,6 +506,51 @@ class TpuModel:
         aug_mirror = bool(cfg.get("mirror", True))
         accum = int(cfg.get("grad_accum", 1) or 1)
 
+        indag_mask = None
+        if overlap == "indag":
+            from theanompi_tpu.parallel import bucketing as _bucketing
+
+            # in-DAG issue: each GradSyncGroup's backward reduces its
+            # own gradients the moment they are complete. Scope (same
+            # style as ef/zero1 above): plain cdd over replicated
+            # params, no residual recurrence, no microbatch scan (the
+            # scan body would issue K reductions per group per step).
+            unsupported = {
+                "sync_mode != 'cdd'": sync_mode != "cdd",
+                "error_feedback": ef,
+                "zero1": zero is not None,
+                "grad_accum > 1": accum > 1,
+                "sharded params (tp/pp/ep)": self.param_specs is not None,
+            }
+            bad = [k for k, v in unsupported.items() if v]
+            if bad:
+                raise ValueError(
+                    f"exchange_overlap='indag' does not support: "
+                    f"{', '.join(bad)}"
+                )
+            if not _bucketing.has_sync_groups(self.net):
+                raise ValueError(
+                    "exchange_overlap='indag' needs grad-sync groups, "
+                    "and this model's build_net wired none — models opt "
+                    "in by wrapping layer groups in "
+                    "bucketing.GradSyncGroup when the config asks for "
+                    "'indag' (TransformerLM blocks, ResNet50 stages do)"
+                )
+            indag_mask = _bucketing.sync_group_mask(self.net, self.params)
+
+            def _make_group_reducer(ex_key):
+                def reduce_group(gid, gtree):
+                    k = (
+                        jax.random.fold_in(ex_key, 1_000_000 + int(gid))
+                        if ex_key is not None
+                        else None
+                    )
+                    return exchanger.reduce_grads(
+                        gtree, rng=k, tag=f"g{int(gid)}"
+                    )
+
+                return reduce_group
+
         def micro_grads(params, net_state, x, y, rng):
             """fwd+bwd on one microbatch (augment inside, so each
             microbatch draws fresh crops)."""
@@ -503,9 +573,22 @@ class TpuModel:
             # collide: accum microbatch keys + the exchange (int8_sr) key
             if accum == 1:
                 k_micro, ex_key = jax.random.split(rng)
-                (loss, (err, _, new_state)), grads = micro_grads(
-                    params, net_state, x, y, k_micro
-                )
+                if indag_mask is not None:
+                    from theanompi_tpu.parallel import bucketing as _B
+
+                    # trace-time scope: while value_and_grad traces the
+                    # backward, each GradSyncGroup's custom-vjp bwd
+                    # finds this reducer and issues its bucket's
+                    # reduction in place — the exchange is embedded in
+                    # the backward DAG, not appended after it
+                    with _B.issue_scope(_make_group_reducer(ex_key)):
+                        (loss, (err, _, new_state)), grads = micro_grads(
+                            params, net_state, x, y, k_micro
+                        )
+                else:
+                    (loss, (err, _, new_state)), grads = micro_grads(
+                        params, net_state, x, y, k_micro
+                    )
             else:
                 # gradient accumulation: scan over K microbatches, only
                 # 1/K of the activations live at once — big effective
@@ -566,8 +649,15 @@ class TpuModel:
                     )
                     grads = maybe_clip(reduced)
                 else:
+                    # with in-DAG issue the sync-grouped leaves arrive
+                    # already reduced; done_mask passes them through and
+                    # this call sweeps up only the leftovers (stem,
+                    # embeddings, head, norms)
                     grads = maybe_clip(
-                        exchanger.reduce_grads(grads, param_specs, rng=ex_key)
+                        exchanger.reduce_grads(
+                            grads, param_specs, rng=ex_key,
+                            done_mask=indag_mask,
+                        )
                     )
                 params, opt_state = opt.update(params, grads, opt_state)
                 if ef:

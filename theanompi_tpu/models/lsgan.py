@@ -131,8 +131,20 @@ class LSGAN(TpuModel):
         bad = [k for k, v in unsupported.items() if v]
         if bad:
             raise ValueError(f"LSGAN does not support: {', '.join(bad)}")
+        # the GAN rides the bucketed wire like every TpuModel ('indag'
+        # needs grad-sync groups the GAN nets don't define — reject in
+        # the same loud style as the knobs above)
+        overlap = str(cfg.get("exchange_overlap", "bucket"))
+        if overlap == "indag":
+            raise ValueError("LSGAN does not support: exchange_overlap='indag'")
         exchanger = exchanger or BSP_Exchanger(
-            strategy=cfg.exch_strategy, mesh=self.mesh
+            strategy=cfg.exch_strategy,
+            mesh=self.mesh,
+            bucket_bytes=(
+                None
+                if overlap == "leaf"
+                else int(float(cfg.get("exchange_bucket_mb", 4.0)) * (1 << 20))
+            ),
         )
         axis = exchanger.axis
         G, D = self.generator, self.discriminator

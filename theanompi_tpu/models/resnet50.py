@@ -91,13 +91,31 @@ class ResNet50(TpuModel):
             L.Relu(),
             L.MaxPool(3, stride=2, padding="SAME"),
         ]
+        indag = str(cfg.get("exchange_overlap", "")) == "indag"
         cin = 64
-        for n_blocks, cmid, cout, stride in stages:
+        for si, (n_blocks, cmid, cout, stride) in enumerate(stages):
+            blocks = []
             for b in range(n_blocks):
-                seq.append(
+                blocks.append(
                     _bottleneck(cin, cmid, cout, stride if b == 0 else 1, bn_axis, dt)
                 )
                 cin = cout
+            if indag:
+                # in-DAG exchange issue points: each residual stage is
+                # one grad-sync group — its backward reduces the
+                # stage's gradients while earlier stages still
+                # differentiate (parallel.bucketing). NOTE: grouping
+                # nests the stage's blocks one list level deeper, so
+                # indag checkpoints are mode-specific.
+                from theanompi_tpu.parallel.bucketing import GradSyncGroup
+
+                seq.append(
+                    GradSyncGroup(
+                        L.Sequential(blocks), gid=si, name=f"stage{si + 1}"
+                    )
+                )
+            else:
+                seq.extend(blocks)
         seq += [L.GlobalAvgPool(), L.Dense(int(cfg.n_classes), compute_dtype=dt, output_dtype=jnp.float32)]
         self.lr_schedule = optim.step_decay(
             float(cfg.lr), list(cfg.lr_boundaries), 0.1

@@ -331,6 +331,18 @@ class TransformerLM(TpuModel):
             )]
         else:
             body = [make_block() for _ in range(int(cfg.n_layers))]
+            if str(cfg.get("exchange_overlap", "")) == "indag":
+                # in-DAG exchange issue points: every transformer block
+                # is one grad-sync group whose backward reduces the
+                # block's gradients the moment they are complete
+                # (parallel.bucketing; delegating wrapper — the params
+                # tree structure is unchanged)
+                from theanompi_tpu.parallel.bucketing import GradSyncGroup
+
+                body = [
+                    GradSyncGroup(b, gid=i, name=f"block{i}")
+                    for i, b in enumerate(body)
+                ]
         net = L.Sequential(
             [
                 A.Embedding(int(cfg.vocab_size), d, compute_dtype=dt),

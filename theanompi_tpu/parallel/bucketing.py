@@ -1,0 +1,331 @@
+"""Gradient bucketing and in-DAG exchange issue points.
+
+The PR-0 exchange shape — one collective per gradient leaf, issued
+after the whole backward — leaves two kinds of money on the table that
+the reference era already understood (SURVEY.md §3.3) and the modern
+literature quantifies:
+
+- **Fused buckets** (this module's planner): a model's gradient pytree
+  is dozens-to-hundreds of leaves, most far below the quantized wire's
+  crossover, so they silently ride the lossless fp32-psum fallback
+  (``exchanger._leg1_pack``) and each paying leaf pads up to a whole
+  chunk on its own.  Concatenating leaves into ~4 MB buckets makes the
+  wire see ONE flat payload per bucket: one ``_leg1_pack``, one pad,
+  one ``all_to_all``/``all_gather`` — small leaves get quantized as
+  part of their bucket and padding amortizes across the bucket.
+- **In-DAG issue points** (``grad_sync_point`` / ``GradSyncGroup``):
+  arXiv:1802.06949 embeds the reduction collectives in the compute DAG
+  so they overlap backprop.  The JAX rendering: a ``custom_vjp``
+  wrapper around a layer group whose *backward* calls the exchanger on
+  that group's gradients the moment they are complete, instead of the
+  host assembling the full pytree first.  XLA's scheduler can then run
+  bucket k's collective while blocks k-1.. are still differentiating.
+
+Bucket plans are deterministic (flatten order, greedy fill, leaves
+grouped by their reduction-axes tuple so tensor-parallel leaves never
+fuse with replicated ones) and cached per
+``(treedef, shapes/dtypes, axes, strategy, bucket_bytes)`` — bucket
+assignment is a trace-time decision and must be bit-stable across
+retraces or the compiled collective layout would shift under a running
+job.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+from jax import lax
+
+from theanompi_tpu.ops.layers import Layer
+
+Pytree = Any
+
+# ~4 MB of fp32 gradient payload per bucket: big enough that per-bucket
+# padding and scale overhead are noise, small enough that the first
+# bucket's collective can issue long before the backward finishes (the
+# DDP-era sweet spot; docs/perf/NOTES.md "Bucket size").
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+class Bucket:
+    """One fused wire unit: contiguous (in flatten order) leaves that
+    reduce over the same mesh axes. ``offsets[i]``/``sizes[i]`` locate
+    leaf ``idx[i]`` inside the concatenated flat payload."""
+
+    __slots__ = ("axes", "idx", "offsets", "sizes")
+
+    def __init__(self, axes: Tuple, idx: Tuple[int, ...],
+                 offsets: Tuple[int, ...], sizes: Tuple[int, ...]):
+        self.axes = tuple(axes)
+        self.idx = tuple(idx)
+        self.offsets = tuple(offsets)
+        self.sizes = tuple(sizes)
+
+    @property
+    def n(self) -> int:
+        return sum(self.sizes)
+
+    def __repr__(self):
+        return (
+            f"Bucket(axes={self.axes}, leaves={len(self.idx)}, "
+            f"n={self.n})"
+        )
+
+
+class BucketPlan:
+    """Deterministic partition of a gradient pytree into wire buckets."""
+
+    __slots__ = ("buckets", "n_leaves")
+
+    def __init__(self, buckets: Sequence[Bucket], n_leaves: int):
+        self.buckets = tuple(buckets)
+        self.n_leaves = int(n_leaves)
+
+    def __repr__(self):
+        return f"BucketPlan({len(self.buckets)} buckets, {self.n_leaves} leaves)"
+
+
+def plan_buckets(
+    sizes: Sequence[int],
+    axes_list: Sequence[Tuple],
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+) -> BucketPlan:
+    """Greedy deterministic bucket assignment.
+
+    Walk leaves in flatten order; each distinct reduction-axes tuple
+    keeps one OPEN bucket that closes when its fp32 payload would pass
+    ``bucket_bytes`` (a single oversized leaf still gets its own
+    bucket).  Leaves with no live reduction axes (already-reduced
+    in-DAG groups, fully sharded tensor-parallel leaves) collect into
+    passthrough buckets (``axes == ()``).
+    """
+    bucket_bytes = int(bucket_bytes)
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    open_by_axes = {}
+    order: List[Bucket] = []
+
+    def close(key):
+        b = open_by_axes.pop(key, None)
+        if b:
+            offs, total = [], 0
+            for s in b["sizes"]:
+                offs.append(total)
+                total += s
+            order[b["slot"]] = Bucket(
+                b["axes"], b["idx"], tuple(offs), tuple(b["sizes"])
+            )
+
+    for i, (n, axes) in enumerate(zip(sizes, axes_list)):
+        key = tuple(axes)
+        b = open_by_axes.get(key)
+        if b is not None and key and 4 * (sum(b["sizes"]) + int(n)) > bucket_bytes:
+            close(key)
+            b = None
+        if b is None:
+            b = open_by_axes[key] = {
+                "axes": key, "idx": [], "sizes": [], "slot": len(order)
+            }
+            order.append(None)  # placeholder keeps first-leaf order
+        b["idx"].append(i)
+        b["sizes"].append(int(n))
+    for key in list(open_by_axes):
+        close(key)
+    return BucketPlan([b for b in order if b is not None], len(sizes))
+
+
+# plan cache: bucket assignment is pure in (structure, shapes, axes,
+# strategy, bucket size) and consulted on every trace — memoize so
+# retraces reuse the SAME plan object (determinism is pinned by test)
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_MAX = 256
+_PLAN_LOCK = threading.Lock()
+
+
+def cached_plan(
+    treedef,
+    shapes_dtypes: Tuple,
+    axes_list: Tuple[Tuple, ...],
+    strategy: str,
+    bucket_bytes: int,
+) -> BucketPlan:
+    """Memoized :func:`plan_buckets` keyed on everything assignment can
+    depend on.  ``strategy`` rides the key (the ISSUE contract) even
+    though assignment is currently strategy-independent — a future
+    per-strategy crossover must not serve a stale plan."""
+    key = (treedef, shapes_dtypes, axes_list, str(strategy), int(bucket_bytes))
+    with _PLAN_LOCK:
+        plan = _PLAN_CACHE.get(key)
+        if plan is not None:
+            return plan
+    sizes = []
+    for shape, _dtype in shapes_dtypes:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        sizes.append(n)
+    plan = plan_buckets(sizes, axes_list, bucket_bytes)
+    with _PLAN_LOCK:
+        if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+            _PLAN_CACHE.clear()  # bounded; plans are cheap to rebuild
+        _PLAN_CACHE.setdefault(key, plan)
+        return _PLAN_CACHE[key]
+
+
+def plan_cache_info() -> int:
+    """Number of cached plans (test/debug surface)."""
+    with _PLAN_LOCK:
+        return len(_PLAN_CACHE)
+
+
+# ---------------------------------------------------------------------------
+# in-DAG issue points
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _grad_sync(tag: str, x):
+    return x
+
+
+def _gsp_fwd(tag, x):
+    from theanompi_tpu.observability import instant
+
+    # trace-time breadcrumb (zero per-step cost): where on the timeline
+    # the step (re)compiled with this issue point in its DAG
+    instant("grad_sync_point", {"tag": str(tag)})
+    return x, None
+
+
+def _gsp_bwd(tag, _res, ct):
+    return (lax.optimization_barrier(ct),)
+
+
+_grad_sync.defvjp(_gsp_fwd, _gsp_bwd)
+
+
+def grad_sync_point(x, tag: str):
+    """Identity barrier marking a gradient-exchange issue point.
+
+    Forward is the identity.  The backward passes the cotangent through
+    ``lax.optimization_barrier``, anchoring a named position in the
+    backward DAG between layer groups: the reductions a
+    :class:`GradSyncGroup` issues upstream of this point cannot be
+    CSE-merged or hoisted across it, so the per-group issue ORDER the
+    model declared survives XLA's scheduler (the arXiv:1802.06949
+    embedding, done the JAX way — the custom_vjp keeps the non-diff tag
+    LEADING, as jax requires)."""
+    return _grad_sync(str(tag), x)
+
+
+# thread-local active reducer: compile_train installs it (at trace
+# time) around the value_and_grad call, GradSyncGroup.apply reads it.
+# Thread-local because the async drivers trace per-worker steps from
+# concurrent threads.
+_TLS = threading.local()
+
+
+def active_reducer() -> Optional[Callable]:
+    return getattr(_TLS, "reducer", None)
+
+
+@contextlib.contextmanager
+def issue_scope(reducer: Optional[Callable]):
+    """Install ``reducer(gid, grads_subtree) -> reduced_subtree`` as the
+    active in-DAG reducer for the duration of a (trace-time) ``with``
+    block.  ``None`` is a no-op scope, so call sites need no branch."""
+    prev = getattr(_TLS, "reducer", None)
+    _TLS.reducer = reducer
+    try:
+        yield
+    finally:
+        _TLS.reducer = prev
+
+
+class GradSyncGroup(Layer):
+    """Layer-group wrapper whose BACKWARD issues this group's gradient
+    reduction at the point the group's gradients are complete.
+
+    Outside an :func:`issue_scope` (eval, ``exchange_overlap !=
+    'indag'``) it is a transparent delegate — ``init``/``apply`` and the
+    params/state trees are exactly the inner layer's.  Inside a scope,
+    ``apply`` routes through a ``custom_vjp`` whose backward hands the
+    group's parameter cotangents to the active reducer (the exchanger's
+    bucketed ``reduce_grads``) before returning them, then tags the
+    activation cotangent with :func:`grad_sync_point` so the issue
+    order is anchored in the DAG."""
+
+    def __init__(self, inner: Layer, gid: int, name: Optional[str] = None):
+        self.inner = inner
+        self.gid = int(gid)
+        self.name = name or f"group{gid}"
+
+    def init(self, key, in_shape):
+        return self.inner.init(key, in_shape)
+
+    def apply(self, params, state, x, train: bool = False, rng=None):
+        reduce_fn = active_reducer()
+        if reduce_fn is None:
+            return self.inner.apply(params, state, x, train=train, rng=rng)
+        inner, gid = self.inner, self.gid
+
+        def fn(p, xx):
+            return inner.apply(p, state, xx, train=train, rng=rng)
+
+        @jax.custom_vjp
+        def synced(p, xx):
+            return fn(p, xx)
+
+        def fwd(p, xx):
+            out, vjp = jax.vjp(fn, p, xx)
+            return out, vjp
+
+        def bwd(vjp, ct):
+            dp, dx = vjp(ct)
+            # THE issue point: this group's reduction enters the program
+            # here, data-dependent only on this group's backward — XLA
+            # can run it while earlier blocks still differentiate
+            dp = reduce_fn(gid, dp)
+            return dp, dx
+
+        synced.defvjp(fwd, bwd)
+        y, new_state = synced(params, x)
+        return grad_sync_point(y, self.name), new_state
+
+
+def sync_group_mask(layer: Layer, params: Pytree) -> Pytree:
+    """Bool pytree matching ``params``: True for every leaf owned by a
+    :class:`GradSyncGroup` (reduced in-DAG — the end-of-step exchange
+    must skip it).  Walks ``Sequential``-shaped combinators (anything
+    with ``.layers``) and single-child wrappers (``.inner``: Remat,
+    GradSyncGroup itself is matched first)."""
+    if isinstance(layer, GradSyncGroup):
+        return jax.tree.map(lambda _: True, params)
+    inner = getattr(layer, "inner", None)
+    if isinstance(inner, Layer):
+        return sync_group_mask(inner, params)
+    subs = getattr(layer, "layers", None)
+    if (
+        subs is not None
+        and isinstance(params, (list, tuple))
+        and len(subs) == len(params)
+    ):
+        out = [sync_group_mask(l, p) for l, p in zip(subs, params)]
+        return type(params)(out) if isinstance(params, tuple) else out
+    return jax.tree.map(lambda _: False, params)
+
+
+def has_sync_groups(layer: Layer) -> bool:
+    """Whether any :class:`GradSyncGroup` exists under ``layer``."""
+    if isinstance(layer, GradSyncGroup):
+        return True
+    inner = getattr(layer, "inner", None)
+    if isinstance(inner, Layer) and has_sync_groups(inner):
+        return True
+    for sub in getattr(layer, "layers", None) or ():
+        if has_sync_groups(sub):
+            return True
+    return False

@@ -83,44 +83,95 @@ def _uncast_wire(tree: Any) -> Any:
     return jax.tree.map(leaf, tree)
 
 
+def _pack_wire(tree: Any, mode, residual: Any = None):
+    """One compressed-wire entry point for every async TCP leg:
+    ``mode`` is ``None`` (fp32), a numpy dtype (the cast wire above),
+    or ``'q8'`` — int8 + per-block fp32 scales via ``wire.q8_pack``
+    (~4× fewer frame bytes than fp32, the same block recipe as the
+    BSP exchanger's in-graph wire).  Returns ``(packed,
+    new_residual)``; only the q8 wire produces a residual (EF on the
+    push leg — pass it back in on the next send of the same payload)."""
+    if mode is None:
+        return tree, None
+    if mode == "q8":
+        from theanompi_tpu.parallel import wire
+
+        return wire.q8_pack(tree, residual)
+    return _cast_wire(tree, mode), None
+
+
+def _unpack_wire(tree: Any) -> Any:
+    """Receiver side, mode-agnostic by design: undo q8 packing AND the
+    fp16 cast (both self-describing), so a mixed fleet — or a sender
+    whose compression config differs — still decodes correctly."""
+    from theanompi_tpu.parallel import wire
+
+    return _uncast_wire(wire.q8_unpack(tree))
+
+
 class _RemoteServer:
     """Client proxy with the in-process EASGD_Server's exchange surface.
 
-    ``wire_dtype`` (e.g. ``np.float16``) compresses the parameter
-    payload both ways; elastic math always runs fp32 at the server."""
+    ``wire_dtype`` (``np.float16`` or ``'q8'``) compresses the
+    parameter payload both ways; elastic math always runs fp32 at the
+    server.  The q8 wire additionally keeps the EF residual on the
+    PUSH leg: what one exchange's quantization dropped is re-sent with
+    the next, so the center integrates the true worker trajectory (the
+    reply leg carries the center — server-side state per worker would
+    be needed to EF it, and asynchrony already tolerates that noise)."""
 
     def __init__(self, address: Address, wire_dtype=None):
         self.address = address
         self.wire_dtype = wire_dtype
+        self._residual = None  # q8 push-leg EF state
 
     def exchange(self, worker_params):
-        w = (
-            _cast_wire(worker_params, self.wire_dtype)
-            if self.wire_dtype
-            else worker_params
+        w, self._residual = _pack_wire(
+            worker_params, self.wire_dtype, self._residual
         )
         reply = request(self.address, {"kind": "exchange", "params": w})
-        return _uncast_wire(reply["params"])
+        return _unpack_wire(reply["params"])
 
 
 class _CompressedMailbox:
     """Mailbox decorator: fp32 leaves ride the TCP frames in
-    ``wire_dtype``; receives upcast back to fp32. The GOSGD analog of
-    the EASGD proxy's compressed exchange."""
+    ``wire_dtype`` (fp16 cast or ``'q8'`` int8+scales); receives
+    reconstruct fp32. The GOSGD analog of the EASGD proxy's compressed
+    exchange.
+
+    q8 push-leg EF: the residual is keyed by the payload's shape
+    fingerprint (``wire.q8_fingerprint``) because one mailbox
+    interleaves params pushes with acks/finals — a residual must only
+    roll into the NEXT frame of the same payload shape, whichever peer
+    it goes to (the EF recurrence is about this sender's quantization
+    error, not about any one destination)."""
 
     def __init__(self, inner, wire_dtype):
         self._inner = inner
         self._dt = wire_dtype
+        self._residuals: dict = {}
         self.n_ranks = inner.n_ranks
 
     def send(self, dst: int, msg: Any) -> None:
+        if self._dt == "q8":
+            from theanompi_tpu.parallel import wire
+
+            fp = wire.q8_fingerprint(msg)
+            if fp:
+                packed, res = _pack_wire(msg, "q8", self._residuals.get(fp))
+                self._residuals[fp] = res
+                self._inner.send(dst, packed)
+                return
+            # no quantizable leaves (ack frames): ship as-is
+            self._inner.send(dst, msg)
+            return
         self._inner.send(dst, _cast_wire(msg, self._dt))
 
     def drain(self, rank=None):
-        return [_uncast_wire(m) for m in self._inner.drain(rank)]
+        return [_unpack_wire(m) for m in self._inner.drain(rank)]
 
     def recv(self, rank=None, timeout=None):
-        return _uncast_wire(self._inner.recv(rank, timeout))
+        return _unpack_wire(self._inner.recv(rank, timeout))
 
     def close(self) -> None:
         self._inner.close()
@@ -195,11 +246,13 @@ def run_easgd_server(
             if kind == "exchange":
                 if "wire_seen" not in state:
                     # observability: what dtype ACTUALLY rode the wire —
-                    # the e2e fp16 test asserts this, so a refactor that
-                    # silently drops the compression cannot stay green
-                    leaves = jax.tree.leaves(msg["params"])
-                    state["wire_seen"] = str(leaves[0].dtype) if leaves else "?"
-                w = _uncast_wire(msg["params"])  # math always fp32
+                    # the e2e compression tests assert this, so a
+                    # refactor that silently drops the compression
+                    # cannot stay green ('int8+scales' for q8 frames)
+                    from theanompi_tpu.parallel import wire as _w
+
+                    state["wire_seen"] = _w.wire_dtype_seen(msg["params"])
+                w = _unpack_wire(msg["params"])  # math always fp32
                 c = state["center"]
                 diff = jax.tree.map(lambda a, b: a - b, w, c)
                 state["center"] = jax.tree.map(
@@ -208,7 +261,9 @@ def run_easgd_server(
                 state["n_exchanges"] += 1
                 out = jax.tree.map(lambda a, d: a - alpha * d, w, diff)
                 if wire_dtype:
-                    out = _cast_wire(out, wire_dtype)
+                    # reply leg: plain RN compression (see _RemoteServer
+                    # — EF state per worker would live server-side)
+                    out = _pack_wire(out, wire_dtype)[0]
                 return {"params": out}
             if kind == "epoch":
                 e = int(msg["epoch"])

@@ -57,6 +57,24 @@ Because the exchange executes inside the step function, XLA overlaps it
 with backprop where the schedule allows — the fusion the reference could
 only approximate by hiding MPI behind CUDA streams.
 
+ISSUE 6 reshaped HOW the wire is issued (``docs/exchanger.md``):
+
+- ``bucket_bytes`` (set by the models' ``exchange_overlap='bucket'``
+  default) fuses gradient leaves into ~4 MB flat payloads per
+  reduction-axes group (``parallel.bucketing``): one ``_leg1_pack`` /
+  pad / collective set per BUCKET, so sub-chunk leaves quantize as part
+  of a bucket instead of riding the fp32-psum fallback, and the EF
+  residual is computed against the bucketed leg-1 image.
+- on two-level ``dp_dcn×dp`` meshes the block strategies lower
+  hierarchically (``_hier_chain``): quantized reduce-scatter over ICI,
+  cross-slice exchange of only the scattered 1/dp shard over DCN, then
+  all-gathers back — replacing the sequential full-payload per-axis
+  folds (arXiv:2112.01075's decomposition).
+- ``exchange_overlap='indag'`` additionally issues each layer group's
+  bucketed reduction inside the backward DAG
+  (``bucketing.GradSyncGroup``; arXiv:1802.06949) via
+  ``reduce_grads(..., done_mask=...)`` sweeping only the leftovers.
+
 BSP sync semantics (SURVEY.md §3.3): ``cdd`` = reduce *gradients* before
 the optimizer step; ``avg`` = local step then *parameter* averaging.
 Both are exposed; EASGD/GOSGD exchangers live in
@@ -73,7 +91,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from theanompi_tpu.runtime.mesh import DATA_AXIS
+from theanompi_tpu.runtime.mesh import DATA_AXIS, DCN_AXIS
 
 Pytree = Any
 
@@ -136,11 +154,18 @@ class BSP_Exchanger:
         strategy: str = "ar",
         axis: str = DATA_AXIS,
         mesh=None,
+        bucket_bytes: Optional[int] = None,
     ):
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
         self.strategy = strategy
         self.axis = axis
+        # bucket_bytes != None: fuse gradient leaves into ~bucket_bytes
+        # flat payloads before the wire (parallel.bucketing) — one
+        # _leg1_pack / pad / collective pair per BUCKET instead of per
+        # leaf, so sub-chunk leaves quantize as part of a bucket instead
+        # of riding the fp32-psum fallback. None = legacy per-leaf wire.
+        self.bucket_bytes = int(bucket_bytes) if bucket_bytes else None
         # axis sizes must be STATIC for the int8 reduce-scatter reshape;
         # compile_train passes its mesh, direct users of int8 must too
         self._axis_sizes = dict(mesh.shape) if mesh is not None else None
@@ -256,11 +281,129 @@ class BSP_Exchanger:
             return lax.psum(g, axis)
         return self._wire_from_packed(packed, axis, g)
 
+    # -- hierarchical two-level ICI→DCN wire -------------------------------
+    def _hier_split(self, axes: tuple):
+        """``(outer, inner)`` when the two-level wire engages: a block
+        strategy whose live reduction axes are exactly the cross-slice
+        DCN axis plus one intra-slice axis.  The sequential per-axis
+        fold would move the FULL payload across DCN; the hierarchical
+        wire moves only the 1/inner-world scattered shard there
+        (arXiv:2112.01075's decomposition)."""
+        if self._axis_sizes is None or self.strategy not in _BLOCK_STRATEGIES:
+            return None
+        live = [a for a in axes if int(self._axis_sizes[a]) > 1]
+        if len(live) == 2 and live[0] == DCN_AXIS:
+            return live[0], live[1]
+        return None
+
+    def _hier_chain(self, g, split: tuple, rng=None, collect: bool = False):
+        """Sum ``g`` over (outer=DCN, inner=ICI) moving only the
+        scattered shard across DCN:
+
+        1. quantized reduce-scatter over ``inner`` (ICI) — each device
+           ends with the fp32 intra-slice sum of its 1/w_i shard;
+        2. quantized reduce-scatter of that shard over ``outer`` (DCN)
+           — only shard-sized payloads cross DCN;
+        3. quantized all-gather of the fully-summed subshard back over
+           ``outer`` (DCN, shard-sized again);
+        4. quantized all-gather over ``inner`` (ICI) to full size.
+
+        Returns ``(sum, roundtrip)`` in fp32, ``g``-shaped; ``roundtrip``
+        (``collect=True``) is the per-device EF image: legs 1 and 2 —
+        the quantizations of per-device / per-slice CONTRIBUTIONS —
+        are compensated (leg 2's loss lives uniquely on this device's
+        shard, so it scatters back at the shard offset with no group
+        scaling), while legs 3/4 re-quantize the cross-slice SUM, the
+        shared error no per-device residual can represent (same
+        philosophy as the flat wire's uncompensated second leg)."""
+        from theanompi_tpu.parallel import quantize as Q
+
+        outer, inner = split
+        w_o = int(self._axis_sizes[outer])
+        w_i = int(self._axis_sizes[inner])
+        pallas = self.strategy.startswith("pallas_")
+        keys = [None] * 4
+        if self.strategy in _SR_STRATEGIES:
+            if rng is None:
+                raise ValueError(
+                    f"strategy '{self.strategy}' needs per-step randomness: "
+                    "call reduce_grads(grads, specs, rng=key)"
+                )
+            keys = list(jax.random.split(rng, 4))
+        quant, _, dequant = block_wire_kernels(self.strategy)
+
+        flat = g.astype(jnp.float32).reshape(-1)
+        n = flat.size
+        # every leg's reshape must see whole (32-row-aligned for pallas)
+        # quant blocks, down to the 1/(w_i*w_o) subshard of leg 2's sum
+        chunk = w_i * w_o * Q.BLOCK * (32 if pallas else 1)
+        payload_bytes = 2 if self.strategy in _FP16S_STRATEGIES else 1
+        if 4 * n < chunk * payload_bytes:
+            # below the shard wire's crossover: lossless fp32 psum over
+            # both axes (XLA still lowers it hierarchically), no loss
+            return lax.psum(g.astype(jnp.float32), (outer, inner)), (
+                g.astype(jnp.float32)
+            )
+        pad = (-n) % chunk
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        big = flat.size
+        shard = big // w_i
+
+        # leg 1: quantized reduce-scatter over ICI
+        x1 = flat.reshape(w_i, shard // Q.BLOCK, Q.BLOCK)
+        q1, s1 = quant(x1, keys[0])
+        q1t = lax.all_to_all(q1, inner, split_axis=0, concat_axis=0, tiled=True)
+        s1t = lax.all_to_all(s1, inner, split_axis=0, concat_axis=0, tiled=True)
+        mine = jnp.sum(dequant(q1t, s1t), axis=0)  # (shard//B, B) fp32
+
+        # leg 2: quantized reduce-scatter of the shard over DCN
+        sub = shard // w_o
+        x2 = mine.reshape(w_o, sub // Q.BLOCK, Q.BLOCK)
+        q2, s2 = quant(x2, keys[1])
+        q2t = lax.all_to_all(q2, outer, split_axis=0, concat_axis=0, tiled=True)
+        s2t = lax.all_to_all(s2, outer, split_axis=0, concat_axis=0, tiled=True)
+        total_sub = jnp.sum(dequant(q2t, s2t), axis=0)  # (sub//B, B) fp32
+
+        # leg 3: all-gather the fully-summed subshard back across DCN
+        q3, s3 = quant(total_sub, keys[2])
+        q3a = lax.all_gather(q3, outer, axis=0)
+        s3a = lax.all_gather(s3, outer, axis=0)
+        full_shard = dequant(q3a, s3a).reshape(shard // Q.BLOCK, Q.BLOCK)
+
+        # leg 4: all-gather across ICI to full size
+        q4, s4 = quant(full_shard, keys[3])
+        q4a = lax.all_gather(q4, inner, axis=0)
+        s4a = lax.all_gather(s4, inner, axis=0)
+        out = dequant(q4a, s4a).reshape(-1)[:n].reshape(g.shape)
+
+        if not collect:
+            return out, None
+        # EF roundtrip: g − leg-1 loss − (this shard's leg-2 loss,
+        # scattered at the shard offset). Both losses live uniquely on
+        # this device, so residual sums over the full mesh re-present
+        # each fold's dropped mass exactly once.
+        l1 = flat - dequant(q1, s1).reshape(-1)
+        l2 = mine.reshape(-1) - dequant(q2, s2).reshape(-1)
+        r_in = lax.axis_index(inner)
+        scat = lax.dynamic_update_slice(
+            jnp.zeros((big,), jnp.float32), l2, (r_in * shard,)
+        )
+        rt = (flat - l1 - scat)[:n].reshape(g.shape)
+        return out, rt
+
     def _block_reduce_mean(self, g, axes: tuple, rng=None):
+        hier = self._hier_split(axes)
+        if hier is not None:
+            s, _ = self._hier_chain(g, hier, rng)
+            world = int(self._axis_sizes[hier[0]]) * int(
+                self._axis_sizes[hier[1]]
+            )
+            return (s / world).astype(g.dtype)
         total = 1
         for i, a in enumerate(axes):
             sub = jax.random.fold_in(rng, i) if rng is not None else None
-            g = self._block_sum_one_axis(g, a, sub)  # hierarchical: ICI, DCN
+            g = self._block_sum_one_axis(g, a, sub)  # sequential folds
             total *= int(self._axis_sizes[a])
         return (g / total).astype(g.dtype)
 
@@ -277,23 +420,124 @@ class BSP_Exchanger:
         return (r / lax.psum(1, axes)).astype(g.dtype)
 
     # -- in-graph collectives (call inside shard_map) ---------------------
-    def _tree_mean(self, tree: Pytree, specs: Optional[Pytree], rng) -> Pytree:
-        """Per-leaf mean over the exchange axes through the configured
-        wire recipe — the shared body of cdd's gradient reduction and
-        avg's parameter averaging."""
-        return self._tree_wire_map(self._reduce_leaf_mean, tree, specs, rng)
+    def _flatten_with_axes(self, tree, specs, done_mask=None):
+        """``(leaves, treedef, per-leaf reduction axes)`` — the one
+        flattening every tree-level entry point shares.  ``done_mask``
+        (bool pytree) empties the axes of leaves some in-DAG issue
+        point already reduced, turning them into passthroughs."""
+        leaves, treedef = jax.tree.flatten(tree)
+        if specs is None:
+            axes_list = [self._axes_tuple()] * len(leaves)
+        else:
+            spec_leaves = treedef.flatten_up_to(specs)
+            axes_list = [self._leaf_axes(s) for s in spec_leaves]
+        if done_mask is not None:
+            done = treedef.flatten_up_to(done_mask)
+            axes_list = [
+                () if d else a for a, d in zip(axes_list, done)
+            ]
+        return leaves, treedef, axes_list
+
+    def _bucket_plan(self, leaves, treedef, axes_list):
+        from theanompi_tpu.parallel import bucketing as B
+
+        return B.cached_plan(
+            treedef,
+            tuple(
+                (tuple(l.shape), jnp.dtype(l.dtype).name) for l in leaves
+            ),
+            tuple(tuple(a) for a in axes_list),
+            self.strategy,
+            self.bucket_bytes,
+        )
+
+    def _bucketed_map(self, tree, specs, rng, mode, done_mask=None):
+        """Run the wire per BUCKET: concat each bucket's leaves into one
+        flat fp32 payload, apply the per-leaf recipe to it (one
+        ``_leg1_pack``/pad/collective set per bucket), split the result
+        back per leaf.  ``mode``: ``'mean'`` (reduction only),
+        ``'mean_rt'`` (reduction + EF roundtrip, one leg-1 pack),
+        ``'rt'`` (roundtrip only).  Returns ``(out_tree, rt_tree)`` with
+        the unused half ``None``."""
+        leaves, treedef, axes_list = self._flatten_with_axes(
+            tree, specs, done_mask
+        )
+        plan = self._bucket_plan(leaves, treedef, axes_list)
+        outs: list = [None] * len(leaves)
+        rts: list = [None] * len(leaves)
+        for bi, b in enumerate(plan.buckets):
+            if not b.axes:
+                for i in b.idx:
+                    outs[i] = leaves[i]
+                    rts[i] = leaves[i]
+                continue
+            parts = [leaves[i].astype(jnp.float32).reshape(-1) for i in b.idx]
+            flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            key = jax.random.fold_in(rng, bi) if rng is not None else None
+            red = rt = None
+            if mode == "mean":
+                red = self._reduce_leaf_mean(flat, b.axes, key)
+            elif mode == "mean_rt":
+                red, rt = self._leaf_mean_with_rt(flat, b.axes, key)
+            else:
+                rt = self._leaf_roundtrip(flat, b.axes, key)
+            for i, off, sz in zip(b.idx, b.offsets, b.sizes):
+                g = leaves[i]
+                if red is not None:
+                    outs[i] = (
+                        red[off:off + sz].reshape(g.shape).astype(g.dtype)
+                    )
+                if rt is not None:
+                    rts[i] = (
+                        rt[off:off + sz].reshape(g.shape).astype(g.dtype)
+                    )
+        out_tree = treedef.unflatten(outs) if mode != "rt" else None
+        rt_tree = treedef.unflatten(rts) if mode != "mean" else None
+        return out_tree, rt_tree
+
+    def _tree_mean(
+        self, tree: Pytree, specs: Optional[Pytree], rng, done_mask=None
+    ) -> Pytree:
+        """Per-leaf (or per-bucket) mean over the exchange axes through
+        the configured wire recipe — the shared body of cdd's gradient
+        reduction and avg's parameter averaging."""
+        if self.bucket_bytes is not None:
+            out, _ = self._bucketed_map(tree, specs, rng, "mean", done_mask)
+            return out
+        return self._tree_wire_map(
+            self._reduce_leaf_mean, tree, specs, rng, done_mask
+        )
 
     # -- wire-byte attribution --------------------------------------------
-    def _leaf_wire_bytes_est(self, g, axes: tuple) -> int:
-        """Estimated one-way collective payload bytes for one leaf, per
-        step, under this strategy — mirrors ``_leg1_pack``'s fallback
-        arithmetic without running kernels.  An attribution number for
-        the metrics registry (shapes are static at trace time), not the
-        exact post-optimization wire: ``utils.benchmark.
-        collective_wire_bytes`` stays the HLO-parsed ground truth."""
+    def _wire_bytes_for_size(self, n: int, axes: tuple) -> int:
+        """Estimated one-way collective payload bytes for one flat
+        payload of ``n`` fp32 elements, per step — mirrors the wire's
+        fallback/padding arithmetic (``_leg1_pack`` per axis; the
+        hierarchical ``_hier_chain`` chunking and 1/inner-world DCN
+        shard on two-level meshes) without running kernels.  An
+        attribution number for the metrics registry (shapes are static
+        at trace time), not the exact post-optimization wire:
+        ``utils.benchmark.collective_wire_bytes`` stays the HLO-parsed
+        ground truth."""
         from theanompi_tpu.parallel import quantize as Q
 
-        n = int(g.size)
+        n = int(n)
+        pallas = self.strategy.startswith("pallas_")
+        pb = 2 if self.strategy in _FP16S_STRATEGIES else 1
+        hier = self._hier_split(axes)
+        if hier is not None:
+            outer, inner = hier
+            w_o = int(self._axis_sizes[outer])
+            w_i = int(self._axis_sizes[inner])
+            chunk = w_i * w_o * Q.BLOCK * (32 if pallas else 1)
+            if 4 * n < chunk * pb:
+                return 2 * 4 * n  # fp32 psum fallback, both axes
+            padded = n + ((-n) % chunk)
+            shard = padded // w_i  # the only payload that crosses DCN
+            return (
+                padded * pb + (padded // Q.BLOCK) * 4  # ICI legs
+                + shard * pb + (shard // Q.BLOCK) * 4  # DCN legs
+            )
         total = 0
         for a in axes:
             # ar/cast exchangers may be built without a mesh; their
@@ -309,9 +553,7 @@ class BSP_Exchanger:
             elif self.strategy in ("bf16", "fp16"):
                 total += 2 * n
             else:  # block strategies: quantized payload + fp32 scales
-                pallas = self.strategy.startswith("pallas_")
                 chunk = world * Q.BLOCK * (32 if pallas else 1)
-                pb = 2 if self.strategy in _FP16S_STRATEGIES else 1
                 if 4 * n < chunk * pb:
                     total += 4 * n  # rides the fp32-psum fallback
                 else:
@@ -319,8 +561,17 @@ class BSP_Exchanger:
                     total += padded * pb + (padded // Q.BLOCK) * 4
         return total
 
+    def _leaf_wire_bytes_est(self, g, axes: tuple) -> int:
+        """Per-leaf wrapper kept for callers thinking in leaves."""
+        return self._wire_bytes_for_size(int(g.size), axes)
+
     def _record_wire_estimate(
-        self, tree: Pytree, specs: Optional[Pytree], op: str
+        self,
+        tree: Pytree,
+        specs: Optional[Pytree],
+        op: str,
+        done_mask=None,
+        tag: Optional[str] = None,
     ) -> None:
         """Publish the per-step wire estimate as a gauge AND a trace
         instant.  Runs at TRACE time (this method executes while XLA
@@ -329,42 +580,52 @@ class BSP_Exchanger:
         per-step-constant deserves.  The instant marks WHEN on the
         timeline the step (re)compiled and with what wire recipe, so
         the trace doctor can attribute comm bytes to the in-graph
-        exchange legs the host-side spans cannot see."""
+        exchange legs the host-side spans cannot see.
+
+        Under bucketing the gauge is labeled PER BUCKET (the estimate
+        models per-bucket padding and the hierarchical DCN shard bytes,
+        not the per-leaf fiction), plus a ``bucket="total"`` roll-up;
+        in-DAG issue points prefix their group tag so group buckets
+        don't collide."""
         from theanompi_tpu.observability import get_registry, instant
 
-        total = [0]
-        if specs is None:
-            jax.tree.map(
-                lambda g: total.__setitem__(
-                    0,
-                    total[0] + self._leaf_wire_bytes_est(
-                        g, self._axes_tuple()
-                    ),
-                ),
-                tree,
-            )
-        else:
-            jax.tree.map(
-                lambda g, s: total.__setitem__(
-                    0,
-                    total[0] + self._leaf_wire_bytes_est(
-                        g, self._leaf_axes(s)
-                    ),
-                ),
-                tree,
-                specs,
-            )
-        get_registry().gauge(
+        leaves, treedef, axes_list = self._flatten_with_axes(
+            tree, specs, done_mask
+        )
+        gauge = get_registry().gauge(
             "exchanger_wire_bytes_per_step",
             "estimated one-way collective payload bytes per step "
             "(trace-time static estimate; see collective_wire_bytes "
             "for the HLO-parsed exact number)",
-        ).set(total[0], strategy=self.strategy, op=op)
-        instant(
-            "exchanger_wire_estimate",
-            {"strategy": self.strategy, "op": op,
-             "bytes_per_step": total[0]},
         )
+        prefix = f"{tag}:" if tag else ""
+        total = 0
+        n_buckets = 0
+        if self.bucket_bytes is not None:
+            plan = self._bucket_plan(leaves, treedef, axes_list)
+            for bi, b in enumerate(plan.buckets):
+                if not b.axes:
+                    continue
+                est = self._wire_bytes_for_size(b.n, b.axes)
+                gauge.set(
+                    est, strategy=self.strategy, op=op,
+                    bucket=f"{prefix}{bi}",
+                )
+                total += est
+                n_buckets += 1
+        else:
+            for g, axes in zip(leaves, axes_list):
+                total += self._wire_bytes_for_size(int(g.size), axes)
+        gauge.set(
+            total, strategy=self.strategy, op=op, bucket=f"{prefix}total"
+        )
+        payload = {
+            "strategy": self.strategy, "op": op, "bytes_per_step": total,
+            "buckets": n_buckets,
+        }
+        if tag:
+            payload["tag"] = tag
+        instant("exchanger_wire_estimate", payload)
 
     # -- error-feedback support -------------------------------------------
     @staticmethod
@@ -437,7 +698,18 @@ class BSP_Exchanger:
         — divide by it. Returns ``(mean, roundtrip)`` with
         ``g - roundtrip`` = the total per-device EF residual; summing
         residuals over the full mesh re-presents each fold's dropped
-        mass exactly once at the fold where it was dropped."""
+        mass exactly once at the fold where it was dropped.
+
+        On the two-level DCN mesh the hierarchical wire supersedes the
+        sequential folds (``_hier_chain`` computes both values with the
+        SAME legs the reduction runs — they cannot drift)."""
+        hier = self._hier_split(axes)
+        if hier is not None:
+            s, rt = self._hier_chain(g, hier, rng, collect=True)
+            world = int(self._axis_sizes[hier[0]]) * int(
+                self._axis_sizes[hier[1]]
+            )
+            return (s / world).astype(g.dtype), rt.astype(g.dtype)
         s = g
         total = 1
         losses = []
@@ -460,28 +732,20 @@ class BSP_Exchanger:
             rt = rt - loss
         return mean, rt.astype(g.dtype)
 
-    def _tree_wire_map(self, leaf_fn, tree, specs, rng):
+    def _tree_wire_map(self, leaf_fn, tree, specs, rng, done_mask=None):
         """Map a per-leaf wire function with reduce_grads' EXACT rng fold
-        sequence (each leaf folds its index), so stochastic-rounding
-        dither matches between the reduction and the EF roundtrip."""
-        leaves_seen = [0]
-
-        def leaf_rng():
-            if rng is None:
-                return None
-            k = jax.random.fold_in(rng, leaves_seen[0])
-            leaves_seen[0] += 1
-            return k
-
-        if specs is None:
-            return jax.tree.map(
-                lambda g: leaf_fn(g, self._axes_tuple(), leaf_rng()), tree
-            )
-        return jax.tree.map(
-            lambda g, s: leaf_fn(g, self._leaf_axes(s), leaf_rng()),
-            tree,
-            specs,
+        sequence (each leaf folds its flatten index), so stochastic-
+        rounding dither matches between the reduction and the EF
+        roundtrip.  ``done_mask`` leaves pass through untouched (their
+        axes empty — leaf_fn's no-axes identity path)."""
+        leaves, treedef, axes_list = self._flatten_with_axes(
+            tree, specs, done_mask
         )
+        outs = []
+        for i, (g, axes) in enumerate(zip(leaves, axes_list)):
+            k = jax.random.fold_in(rng, i) if rng is not None else None
+            outs.append(leaf_fn(g, axes, k))
+        return treedef.unflatten(outs)
 
     def _leaf_mean_with_rt(self, g, axes: tuple, rng=None):
         """(mean-reduced leaf, roundtrip image) with ONE leg-1
@@ -490,9 +754,10 @@ class BSP_Exchanger:
         custom calls is not assured). Handles the two-level dp_dcn×dp
         mesh by chaining the per-axis folds (``_chain_with_rt``)."""
         self._require_ef_capable()
-        if not axes or self.strategy == "ar":
+        if self.strategy == "ar":  # lossless wire: the image IS the input
             return self._reduce_leaf_mean(g, axes, rng), g
-        if not self._live_axes(axes):
+        live = self._live_axes(axes)
+        if not live:
             return g, g
         return self._chain_with_rt(g, axes, rng)
 
@@ -500,9 +765,14 @@ class BSP_Exchanger:
         self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
     ):
         """``(reduce_grads(grads), local_roundtrip(grads))`` computed
-        with a single leg-1 quantization per leaf — what compile_train's
+        with a single leg-1 quantization per leaf (per BUCKET when
+        bucketing is on — the residual is then computed against the
+        bucketed leg-1 image, so the EF recurrence stays byte-identical
+        with the wire that actually ran) — what compile_train's
         error-feedback branch uses."""
         self._record_wire_estimate(grads, specs, "reduce_grads")
+        if self.bucket_bytes is not None:
+            return self._bucketed_map(grads, specs, rng, "mean_rt")
         rts = []
 
         def leaf(g, axes, k):
@@ -516,16 +786,25 @@ class BSP_Exchanger:
     def local_roundtrip(
         self, tree: Pytree, specs: Optional[Pytree] = None, rng=None
     ) -> Pytree:
-        """Per-leaf lossy image of THIS device's wire contribution, for
-        error feedback: ``residual = tree - local_roundtrip(tree)`` is
-        exactly the information the first quantization leg drops (the
-        second leg re-quantizes the cross-device SUM, a shared error no
-        per-device residual can represent — EF compensates leg 1, which
-        is where per-device drift lives)."""
+        """Per-leaf (per-bucket when bucketing) lossy image of THIS
+        device's wire contribution, for error feedback: ``residual =
+        tree - local_roundtrip(tree)`` is exactly the information the
+        first quantization leg drops (the second leg re-quantizes the
+        cross-device SUM, a shared error no per-device residual can
+        represent — EF compensates leg 1, which is where per-device
+        drift lives)."""
+        if self.bucket_bytes is not None:
+            _, rt = self._bucketed_map(tree, specs, rng, "rt")
+            return rt
         return self._tree_wire_map(self._leaf_roundtrip, tree, specs, rng)
 
     def reduce_grads(
-        self, grads: Pytree, specs: Optional[Pytree] = None, rng=None
+        self,
+        grads: Pytree,
+        specs: Optional[Pytree] = None,
+        rng=None,
+        done_mask=None,
+        tag: Optional[str] = None,
     ) -> Pytree:
         """Mean-reduce gradients across the exchange axes (cdd mode).
 
@@ -533,9 +812,15 @@ class BSP_Exchanger:
         ``grads`` — per-leaf parameter shardings for tensor-parallel
         models; ``None`` means fully replicated params (plain DP).
         ``rng``: per-step key, required by (and only used for) the
-        ``int8_sr`` stochastic-rounding wire."""
-        self._record_wire_estimate(grads, specs, "reduce_grads")
-        return self._tree_mean(grads, specs, rng)
+        ``int8_sr`` stochastic-rounding wire.
+        ``done_mask`` (optional bool pytree): leaves already reduced by
+        an in-DAG issue point — passed through untouched.
+        ``tag``: label prefix for the per-bucket wire gauge (in-DAG
+        groups stamp their group id)."""
+        self._record_wire_estimate(
+            grads, specs, "reduce_grads", done_mask=done_mask, tag=tag
+        )
+        return self._tree_mean(grads, specs, rng, done_mask=done_mask)
 
     def sum_grads(self, grads: Pytree) -> Pytree:
         """Sum-reduce (the reference's cdd summed; workers then scaled lr)."""
@@ -557,4 +842,12 @@ class BSP_Exchanger:
         return self._tree_mean(params, specs, rng)
 
     def __repr__(self):
-        return f"BSP_Exchanger(strategy={self.strategy!r}, axis={self.axis!r})"
+        extra = (
+            f", bucket_bytes={self.bucket_bytes}"
+            if self.bucket_bytes is not None
+            else ""
+        )
+        return (
+            f"BSP_Exchanger(strategy={self.strategy!r}, "
+            f"axis={self.axis!r}{extra})"
+        )

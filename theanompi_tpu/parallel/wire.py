@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Any, List
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -45,6 +45,164 @@ def encode(tree: Any) -> bytes:
     parts = [struct.pack("<I", len(header)), header]
     parts.extend(a.tobytes() for a in leaves)
     return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# int8 block-quantized payload compression for the async TCP legs
+# ---------------------------------------------------------------------------
+#
+# The EASGD worker<->server exchange and the GOSGD gossip pushes ship
+# whole parameter pytrees per frame; fp32 leaves are ~4x more bytes
+# than the int8 + per-block-scale wire the BSP exchanger already runs
+# in-graph (parallel/quantize.py: block_wire_kernels).  These helpers
+# apply the SAME recipe on the host side — numpy only, so this module
+# stays importable without jax (the math parity with
+# quantize.quantize_blocks round-to-nearest is pinned by test) — and
+# support the EF residual recurrence on the push leg: the quantization
+# error of one send is added to the next, so the long-run average of
+# what crosses the wire equals the true parameter trajectory.
+
+Q8_BLOCK = 256  # elements per scale block == parallel.quantize.BLOCK
+_Q8_TAG = "__tmpi_q8__"  # marker key of a packed leaf dict
+
+
+def _q8_encode_array(a: np.ndarray, res: Optional[np.ndarray]):
+    """fp32 array -> (packed dict, new flat residual)."""
+    flat = np.asarray(a, dtype=np.float32).ravel()
+    if res is not None and res.shape == flat.shape:
+        flat = flat + res
+    n = flat.size
+    pad = (-n) % Q8_BLOCK
+    x = np.pad(flat, (0, pad)).reshape(-1, Q8_BLOCK)
+    scale = np.abs(x).max(axis=1) / 127.0
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.rint(x / safe[:, None]), -127, 127).astype(np.int8)
+    back = (q.astype(np.float32) * scale[:, None]).ravel()[:n]
+    packed = {
+        _Q8_TAG: 1,
+        "q": q,
+        "s": scale.astype(np.float32),
+        "n": int(n),
+        "shape": list(a.shape),
+    }
+    return packed, flat - back
+
+
+def _q8_decode_array(d: dict) -> np.ndarray:
+    n = int(d["n"])
+    flat = (d["q"].astype(np.float32) * np.asarray(d["s"])[:, None]).ravel()
+    return flat[:n].reshape(tuple(int(x) for x in d["shape"]))
+
+
+def _q8_quantizable(node: Any) -> bool:
+    return (
+        isinstance(node, np.ndarray)
+        and node.dtype == np.float32
+        and node.size >= Q8_BLOCK  # below one block the scale overhead wins
+    )
+
+
+def q8_fingerprint(tree: Any):
+    """Hashable shape signature of the quantizable leaves — the key an
+    EF residual is valid for (gossip mailboxes interleave params pushes
+    with acks/finals of other structures; a residual must only apply to
+    the SAME payload shape it was produced by)."""
+    out: List[Tuple[int, ...]] = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k in node:
+                walk(node[k])
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+        elif _q8_quantizable(node):
+            out.append(tuple(node.shape))
+
+    walk(tree)
+    return tuple(out)
+
+
+def q8_pack(tree: Any, residual: Any = None):
+    """fp32 array leaves -> int8 + per-block fp32 scales (~4x fewer
+    frame bytes); everything else passes through.  Returns ``(packed,
+    new_residual)`` — feed ``new_residual`` to the NEXT ``q8_pack`` of
+    the same payload for the EF recurrence, or drop it for plain
+    round-to-nearest.  ``residual`` with a mismatched structure is
+    ignored (treated as zero)."""
+
+    def walk(node, res):
+        if isinstance(node, dict):
+            res = res if isinstance(res, dict) else {}
+            packed, new_res = {}, {}
+            for k in node:
+                packed[k], new_res[k] = walk(node[k], res.get(k))
+            return packed, new_res
+        if isinstance(node, tuple):
+            res = res if isinstance(res, (list, tuple)) else [None] * len(node)
+            if len(res) != len(node):
+                res = [None] * len(node)
+            pairs = [walk(v, r) for v, r in zip(node, res)]
+            vals = [p[0] for p in pairs]
+            cls = type(node)
+            rebuilt = cls(*vals) if hasattr(node, "_fields") else cls(vals)
+            return rebuilt, [p[1] for p in pairs]
+        if isinstance(node, list):
+            res = res if isinstance(res, (list, tuple)) else [None] * len(node)
+            if len(res) != len(node):
+                res = [None] * len(node)
+            pairs = [walk(v, r) for v, r in zip(node, res)]
+            return [p[0] for p in pairs], [p[1] for p in pairs]
+        if _q8_quantizable(node):
+            return _q8_encode_array(
+                node, res if isinstance(res, np.ndarray) else None
+            )
+        return node, None
+
+    return walk(tree, residual)
+
+
+def q8_unpack(tree: Any) -> Any:
+    """Inverse of :func:`q8_pack` (residual-agnostic): packed leaf
+    dicts back to fp32 arrays, everything else untouched.  Receivers
+    can call it unconditionally — a frame without packed leaves comes
+    back unchanged."""
+    if isinstance(tree, dict):
+        if tree.get(_Q8_TAG) == 1 and "q" in tree and "s" in tree:
+            return _q8_decode_array(tree)
+        return {k: q8_unpack(v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        vals = [q8_unpack(v) for v in tree]
+        return type(tree)(*vals) if hasattr(tree, "_fields") else type(tree)(vals)
+    if isinstance(tree, list):
+        return [q8_unpack(v) for v in tree]
+    return tree
+
+
+def wire_dtype_seen(tree: Any) -> str:
+    """What dtype actually rides the frame — 'int8+scales' when any
+    packed q8 leaf is present, else the first array leaf's dtype (the
+    e2e compression tests assert on this, so a refactor that silently
+    drops the compression cannot stay green)."""
+    found: List[str] = []
+
+    def walk(node):
+        if found:
+            return
+        if isinstance(node, dict):
+            if node.get(_Q8_TAG) == 1 and "q" in node:
+                found.append("int8+scales")
+                return
+            for k in node:
+                walk(node[k])
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+        elif isinstance(node, np.ndarray):
+            found.append(str(node.dtype))
+
+    walk(tree)
+    return found[0] if found else "?"
 
 
 def decode(buf: bytes) -> Any:
