@@ -392,8 +392,13 @@ def main():
     # JSON carries the export paths + a metrics snapshot, so perf
     # rounds ship comm/compute attribution, not just wall clocks
     from theanompi_tpu import observability as observability
+    from theanompi_tpu.observability import live as obs_live
 
     observability.enable_tracing()
+    # live plane (THEANOMPI_LIVE=1): aggregator + watchdog ride the
+    # bench — detail.observability.live carries windows/alerts, and the
+    # perf gate's watchdog leg asserts the green path stayed silent
+    telemetry = obs_live.maybe_start_from_env("rank0")
     if CPU_REHEARSAL:
         print(
             f"[bench] CPU rehearsal: {jax.device_count()} fake devices, "
@@ -535,7 +540,11 @@ def main():
     )
     t0 = time.perf_counter()
     for i in range(n_steps):
-        params, net_state, opt_state, loss, err = step(params, net_state, opt_state, i)
+        # the span makes the measured window legible to the doctor and
+        # the live watchdog (steps, fractions, straggler accounting);
+        # ~1µs against ms-scale steps, identical across rounds
+        with observability.span("train_iter", iter=i):
+            params, net_state, opt_state, loss, err = step(params, net_state, opt_state, i)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     assert jnp.isfinite(loss), f"bench diverged: loss={loss}"
@@ -590,6 +599,12 @@ def main():
         detail["efficiency"] = _efficiency_curve(n_chips, per_chip, knobs)
     except Exception as e:
         detail["efficiency"] = f"failed: {type(e).__name__}: {e}"
+    live_summary = None
+    if telemetry is not None:
+        try:
+            live_summary = telemetry.stop()
+        except Exception as e:  # the monitor must never cost the number
+            live_summary = f"failed: {type(e).__name__}: {e}"
     try:
         # comm/compute attribution rides the BENCH line: trace export
         # paths (open trace.json in chrome://tracing / Perfetto) + the
@@ -600,6 +615,10 @@ def main():
             "trace_raw": paths["trace_raw"],
             "metrics": observability.get_registry().snapshot(),
         }
+        if live_summary is not None:
+            # windows + watchdog alerts from the in-bench live plane;
+            # the perf gate fails a round whose green path alerted
+            detail["observability"]["live"] = live_summary
         if "doctor" in paths:
             # the doctor's self-diagnosis rides the BENCH line too:
             # comm/compute/idle fractions and overlap are MECHANIZED

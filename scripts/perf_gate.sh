@@ -21,6 +21,16 @@
 #                           rounds on real chips raise it)
 #   PERF_GATE_TRACE         trace file for the doctor (default: extracted
 #                           from the bench JSON's detail.observability)
+#   PERF_GATE_WATCHDOG      1 (default) = run the live-plane watchdog leg:
+#                           replay the bench trace through `observability
+#                           watch` (zero alerts required on the green
+#                           path, and any in-bench live alerts fail the
+#                           gate), then replay the committed
+#                           planted-straggler fixture and REQUIRE a
+#                           nonzero exit — a watchdog that cannot fire
+#                           is itself a gate failure.  0 = skip.
+#   PERF_GATE_STRAGGLER_MAX watch --max-straggler for the planted-straggler
+#                           self-test (default 0.25; fixture index ~0.61)
 #
 # Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
 set -euo pipefail
@@ -80,4 +90,42 @@ if [ -z "$TRACE" ] || [ ! -f "$TRACE" ]; then
 fi
 echo "[perf_gate] doctor: $TRACE (--min-overlap $MIN_OVERLAP)" >&2
 python -m theanompi_tpu.observability doctor "$TRACE" --min-overlap "$MIN_OVERLAP"
+
+# ---- 4. watchdog smoke: the live plane itself -------------------------------
+if [ "${PERF_GATE_WATCHDOG:-1}" = "1" ]; then
+    # green path: the bench's own trace replayed through the ONLINE
+    # doctor must raise zero alerts at the same overlap threshold
+    echo "[perf_gate] watchdog replay (green): $TRACE" >&2
+    if ! python -m theanompi_tpu.observability watch --replay "$TRACE" \
+            --min-overlap "$MIN_OVERLAP" > /dev/null; then
+        echo "[perf_gate] live watchdog ALERTED on the green path" >&2
+        exit 1
+    fi
+    # and any alerts the in-bench live plane raised while the bench ran
+    # (THEANOMPI_LIVE=1) fail the round too
+    LIVE_ALERTS="$(python - "$NEW_JSON" <<'PY'
+import json, sys
+sys.path.insert(0, "scripts")
+from bench_compare import extract_bench
+doc = extract_bench(open(sys.argv[1]).read()) or {}
+obs = (doc.get("detail") or {}).get("observability") or {}
+live = obs.get("live") if isinstance(obs, dict) else None
+print(live.get("alerts_total", 0) if isinstance(live, dict) else 0)
+PY
+)"
+    if [ "$LIVE_ALERTS" != "0" ]; then
+        echo "[perf_gate] bench ran with $LIVE_ALERTS live watchdog alert(s)" >&2
+        exit 1
+    fi
+    # self-test: the committed planted-straggler fixture MUST fire —
+    # a watchdog that cannot alert is a broken gate, not a green one
+    STRAGGLER_MAX="${PERF_GATE_STRAGGLER_MAX:-0.25}"
+    FIXTURES="$(ls tests/data/observability/doctor_rank*_trace_raw.jsonl)"
+    echo "[perf_gate] watchdog replay (planted straggler, --max-straggler $STRAGGLER_MAX)" >&2
+    if python -m theanompi_tpu.observability watch --replay $FIXTURES \
+            --max-straggler "$STRAGGLER_MAX" > /dev/null 2>&1; then
+        echo "[perf_gate] live watchdog did NOT fire on the planted straggler" >&2
+        exit 1
+    fi
+fi
 echo "[perf_gate] green" >&2

@@ -1,0 +1,794 @@
+"""The live telemetry plane (ISSUE 7).
+
+Acceptance: the online doctor's windowed verdicts over the committed
+3-rank golden fixture (replayed as a stream) agree with the
+post-mortem doctor report; the watchdog fires exactly once per window
+on the planted straggler and exits nonzero through the `watch` CLI; a
+dead rank becomes a heartbeat alert, never an exception; merged traces
+align a planted ±50ms clock offset to <5ms via flow pairs; sampled
+doctor fractions carry error bars that the threshold flags respect;
+and request/reply RPCs draw cross-process flow arrows.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.observability import analysis, live
+from theanompi_tpu.observability.metrics import MetricsRegistry
+from theanompi_tpu.observability.trace import Tracer, merge_raw_traces
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "data", "observability")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FIXTURES = [
+    os.path.join(GOLDEN_DIR, f"doctor_rank{r}_trace_raw.jsonl")
+    for r in range(3)
+]
+
+
+@pytest.fixture
+def global_tracing():
+    was_enabled = obs.get_tracer().enabled
+    tracer = obs.enable_tracing()
+    tracer.clear()
+    try:
+        yield tracer
+    finally:
+        if not was_enabled:
+            obs.disable_tracing()
+        tracer.clear()
+
+
+def _fixture_streams():
+    """(label, events sorted by completion, sample_rate) per rank."""
+    out = []
+    for path in FIXTURES:
+        label = os.path.basename(path)[: -len("_trace_raw.jsonl")]
+        events = []
+        with open(path) as f:
+            for line in f:
+                doc = json.loads(line)
+                if doc.get("ph") in ("X", "C", "s", "f"):
+                    events.append(doc)
+        events.sort(
+            key=lambda e: float(e.get("ts", 0.0))
+            + float(e.get("dur", 0.0))
+        )
+        out.append((label, events))
+    return out
+
+
+def _postmortem_report():
+    named = []
+    for path in FIXTURES:
+        with open(path) as f:
+            named.append(
+                (os.path.basename(path)[: -len("_trace_raw.jsonl")],
+                 f.readlines())
+            )
+    return analysis.analyze(named)
+
+
+def _replay(n_windows, thresholds=None, stall_min_s=0.0):
+    """The golden fixture through StreamingDoctor + Watchdog, exactly
+    like `watch --replay`; returns (verdicts, doctor, watchdog)."""
+    doctor = analysis.StreamingDoctor(stall_min_s=stall_min_s)
+    watchdog = live.Watchdog(thresholds, log=lambda line: None)
+    streams = _fixture_streams()
+    verdicts = []
+    for k in range(n_windows):
+        for label, events in streams:
+            lo = (k * len(events)) // n_windows
+            hi = ((k + 1) * len(events)) // n_windows
+            doctor.feed(label, events[lo:hi])
+        v = doctor.close_window()
+        v["alerts"] = watchdog.evaluate(v)
+        verdicts.append(v)
+    return verdicts, doctor, watchdog
+
+
+# ---------------------------------------------------------------------------
+# online doctor vs the post-mortem doctor (THE acceptance shape)
+# ---------------------------------------------------------------------------
+
+def test_streamed_windows_match_postmortem_verdict():
+    """The committed 3-rank fixture replayed as a 4-window stream:
+    the cumulative online verdict must agree with the offline doctor
+    — fractions, overlap, straggler, stalls, flows."""
+    exact = _postmortem_report()
+    verdicts, doctor, _ = _replay(4)
+    assert len(verdicts) == 4
+    cum = doctor.cumulative()
+    for label, ra in exact["ranks"].items():
+        ca = cum["ranks"][label]
+        for cat, frac in ra["fractions"].items():
+            assert ca["fractions"][cat] == pytest.approx(frac, abs=1e-9)
+        if ra["comm_compute_overlap"] is None:
+            assert ca["comm_compute_overlap"] is None
+        else:
+            assert ca["comm_compute_overlap"] == pytest.approx(
+                ra["comm_compute_overlap"], abs=1e-9
+            )
+        assert ca["steps"]["n"] == ra["steps"]["n"]
+        assert ca["steps"]["mean_s"] == pytest.approx(
+            ra["steps"]["mean_s"], abs=1e-9
+        )
+        assert ca["window_s"] == pytest.approx(ra["window_s"], abs=1e-9)
+    assert cum["stragglers"] == exact["stragglers"]
+    assert cum["stalls"] == exact["stalls"]
+    assert cum["flows"]["matched"] == exact["flows"]["matched"]
+    assert (
+        cum["flows"]["unmatched_begin"]
+        == exact["flows"]["unmatched_begin"]
+    )
+
+
+def test_streamed_final_window_straggler_matches_offline():
+    """Stragglers are cumulative: by the last window the online index
+    equals the post-mortem one exactly."""
+    exact = _postmortem_report()
+    verdicts, _, _ = _replay(4)
+    sg = verdicts[-1]["stragglers"]
+    assert sg["straggler_rank"] == "doctor_rank2"
+    assert sg["max_straggler_index"] == pytest.approx(
+        exact["stragglers"]["max_straggler_index"], abs=1e-9
+    )
+
+
+def test_watchdog_fires_exactly_once_per_window_on_straggler():
+    verdicts, _, watchdog = _replay(4, {"max_straggler": 0.25})
+    for v in verdicts:
+        straggler_alerts = [
+            a for a in v["alerts"] if a["rule"] == "max_straggler"
+        ]
+        assert len(straggler_alerts) == 1
+        assert straggler_alerts[0]["rank"] == "doctor_rank2"
+        assert straggler_alerts[0]["window"] == v["window"]
+    assert watchdog.alerts_total == 4
+    # loose threshold: silence
+    _, _, quiet = _replay(4, {"max_straggler": 1.0})
+    assert quiet.alerts_total == 0
+
+
+def test_streaming_freeze_preserves_totals():
+    """The bounded-memory freeze path: totals survive interval detail
+    being collapsed (MAX_LIVE_INTERVALS forced tiny)."""
+    exact = _postmortem_report()
+    doctor = analysis.StreamingDoctor()
+    doctor.MAX_LIVE_INTERVALS = 2  # force freezing every window
+    streams = _fixture_streams()
+    for k in range(8):
+        for label, events in streams:
+            lo = (k * len(events)) // 8
+            hi = ((k + 1) * len(events)) // 8
+            doctor.feed(label, events[lo:hi])
+        doctor.close_window()
+    cum = doctor.cumulative()
+    for label, ra in exact["ranks"].items():
+        for cat, frac in ra["fractions"].items():
+            assert cum["ranks"][label]["fractions"][cat] == pytest.approx(
+                frac, abs=1e-6
+            )
+
+
+def test_watchdog_rejects_unknown_rule():
+    with pytest.raises(ValueError, match="max_stragler"):
+        live.Watchdog({"max_stragler": 0.5})
+
+
+def test_thresholds_from_env():
+    env = {"THEANOMPI_LIVE_RULES": "max_straggler=0.5, min_overlap=0.1"}
+    assert live.thresholds_from_env(env) == {
+        "max_straggler": 0.5, "min_overlap": 0.1,
+    }
+    assert live.thresholds_from_env({}) == {}
+    with pytest.raises(ValueError, match="cannot parse"):
+        live.thresholds_from_env({"THEANOMPI_LIVE_RULES": "overlap=x"})
+
+
+# ---------------------------------------------------------------------------
+# shipper -> aggregator
+# ---------------------------------------------------------------------------
+
+def test_inprocess_shipper_aggregator_roundtrip(global_tracing):
+    agg = live.Aggregator(period_s=0.05, log=lambda line: None)
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=agg, period_s=999
+    ).start()
+    try:
+        for i in range(4):
+            with obs.span("train_iter", iter=i):
+                time.sleep(0.001)
+        obs.counter_event("inbox_depth", 2, rank=0)
+        obs.counter_event("inbox_depth", 0, rank=0)
+        assert shipper.flush()
+        v = agg.close_window()
+        ra = v["ranks"]["rank0"]
+        assert ra["steps"]["n"] == 4
+        assert ra["fractions"]["compute"] > 0
+        assert agg.view["rank0"].frames == 1
+        # an EMPTY beat is still a heartbeat
+        assert shipper.flush()
+        assert agg.view["rank0"].frames == 2
+    finally:
+        shipper.stop()
+    assert agg.health()["status"] == "ok"
+
+
+def test_frame_counter_deltas_accumulate_in_view(global_tracing):
+    reg = MetricsRegistry()
+    ctr = reg.counter("test_live_ticks_total")
+    agg = live.Aggregator(log=lambda line: None)
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=agg, period_s=999, registry=reg
+    ).start()
+    try:
+        ctr.inc(3)
+        shipper.flush()
+        ctr.inc(2)
+        shipper.flush()
+    finally:
+        shipper.stop()
+    assert agg.view["rank0"].counters["test_live_ticks_total"] == 5.0
+
+
+def test_serving_slo_deltas_become_window_percentiles(global_tracing):
+    """The serving SLO feed: TTFT histogram deltas per frame turn into
+    per-window p50/p99 on the aggregator — windowed, not lifetime."""
+    reg = MetricsRegistry()
+    ttft = reg.histogram(
+        "serve_ttft_seconds", buckets=(0.01, 0.1, 1.0)
+    )
+    # the p99 estimate lands at the top of the winning bucket
+    # ((0.01, 0.1] here -> ~0.099), so the SLO bound sits above that
+    agg = live.Aggregator(
+        thresholds={"max_ttft_p99_s": 0.15}, log=lambda line: None
+    )
+    shipper = live.TelemetryShipper(
+        "serve", aggregator=agg, period_s=999, registry=reg
+    ).start()
+    try:
+        for v in (0.02, 0.03, 0.02):
+            ttft.observe(v)
+        shipper.flush()
+        w1 = agg.close_window()
+        assert w1["serving"]["ttft"]["count"] == 3
+        assert w1["serving"]["ttft"]["estimator"] == "histogram"
+        assert w1["serving"]["ttft"]["p99_s"] < 0.15
+        assert not w1["alerts"]  # under the SLO
+        # next window: only the NEW (slow) observations count
+        for v in (0.5, 0.6):
+            ttft.observe(v)
+        shipper.flush()
+        w2 = agg.close_window()
+        assert w2["serving"]["ttft"]["count"] == 2
+        assert [a["rule"] for a in w2["alerts"]] == ["max_ttft_p99_s"]
+    finally:
+        shipper.stop()
+
+
+def test_pre_start_histogram_counts_not_billed_to_first_window(
+    global_tracing,
+):
+    """BOTH delta sources baseline at start(): warmup requests observed
+    before the shipper exists must not inflate window 1's SLO counts."""
+    reg = MetricsRegistry()
+    ttft = reg.histogram("serve_ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    ttft.observe(0.02)
+    ttft.observe(0.03)  # pre-start warmup
+    agg = live.Aggregator(log=lambda line: None)
+    shipper = live.TelemetryShipper(
+        "serve", aggregator=agg, period_s=999, registry=reg
+    ).start()
+    try:
+        ttft.observe(0.05)  # the only in-window observation
+        shipper.flush()
+        v = agg.close_window()
+        assert v["serving"]["ttft"]["count"] == 1
+    finally:
+        shipper.stop()
+
+
+def test_tcp_shipper_roundtrip(global_tracing):
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    agg = live.Aggregator(log=lambda line: None)
+    port = find_free_port()
+    channel = agg.serve(port)
+    shipper = live.TelemetryShipper(
+        "rank3", address=("127.0.0.1", port), period_s=999
+    ).start()
+    try:
+        with obs.span("train_iter", iter=1):
+            time.sleep(0.001)
+        assert shipper.flush()
+        v = agg.close_window()
+        assert v["ranks"]["rank3"]["steps"]["n"] == 1
+    finally:
+        shipper.stop()
+        channel.close()
+
+
+def test_ship_failure_is_counted_not_raised(global_tracing):
+    """An unreachable aggregator drops the frame and keeps going —
+    telemetry must never take the training loop down."""
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    shipper = live.TelemetryShipper(
+        "rank0", address=("127.0.0.1", find_free_port()), period_s=999
+    ).start()
+    try:
+        assert shipper.flush() is False
+        stats_failed = shipper.failed
+    finally:
+        stats = shipper.stop()
+    assert stats_failed >= 1
+    assert stats["failed"] >= 1
+
+
+def test_dead_rank_heartbeat_alert_not_exception(global_tracing):
+    """A rank missing heartbeat_miss × period_s of frames becomes a
+    heartbeat alert (once per window while silent) and flips /health —
+    and a resumed rank clears without ceremony."""
+    clock = {"now": 0.0}
+    agg = live.Aggregator(
+        period_s=1.0, heartbeat_miss=3, log=lambda line: None,
+        clock=lambda: clock["now"],
+    )
+    shipper = live.TelemetryShipper(
+        "rank1", aggregator=agg, period_s=999
+    ).start()
+    try:
+        shipper.flush()
+        v = agg.close_window()
+        assert not v["alerts"]
+        clock["now"] = 10.0  # > 3 heartbeats of silence
+        v = agg.close_window()
+        assert [a["rule"] for a in v["alerts"]] == ["heartbeat"]
+        assert v["dead_ranks"] == ["rank1"]
+        assert agg.health()["status"] == "alert"
+        assert agg.health()["ranks"]["rank1"]["alive"] is False
+        # resume: frames flow again, alert clears
+        shipper.flush()
+        v = agg.close_window()
+        assert not v["alerts"]
+        assert agg.health()["ranks"]["rank1"]["alive"] is True
+    finally:
+        shipper.stop()
+
+
+def test_expected_rank_that_never_joined_alerts(global_tracing):
+    clock = {"now": 0.0}
+    agg = live.Aggregator(
+        period_s=1.0, heartbeat_miss=2, expect_ranks=["rank0", "rank9"],
+        log=lambda line: None, clock=lambda: clock["now"],
+    )
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=agg, period_s=999
+    ).start()
+    try:
+        clock["now"] = 5.0
+        shipper.flush()  # rank0 alive at t=5; rank9 never showed up
+        v = agg.close_window()
+        assert [a["rank"] for a in v["alerts"]] == ["rank9"]
+    finally:
+        shipper.stop()
+
+
+def test_aggregator_refuses_malformed_frame_without_dying():
+    agg = live.Aggregator(log=lambda line: None)
+    ack = agg.ingest({"not": "a frame"})
+    assert ack["ok"] is False
+    ack = agg.ingest(["junk"])
+    assert ack["ok"] is False
+
+
+def test_shipper_restores_disabled_span_cost(global_tracing):
+    """The <20µs disabled-instrumentation guard holds after a live
+    plane ran: sinks are deregistered on stop, so the disabled fast
+    path is exactly as cheap as before."""
+    agg = live.Aggregator(log=lambda line: None)
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=agg, period_s=999
+    ).start()
+    with obs.span("train_iter"):
+        pass
+    shipper.stop()
+    tracer = obs.get_tracer()
+    assert shipper._span_sink not in tracer.span_sinks
+    assert shipper._point_sink not in tracer.point_sinks
+    tracer.disable()
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        with obs.span("hot_loop", iter=i):
+            pass
+    per_span = (time.perf_counter() - t0) / n
+    assert per_span < 20e-6, f"disabled span costs {per_span * 1e6:.2f}µs"
+
+
+# ---------------------------------------------------------------------------
+# /health endpoint
+# ---------------------------------------------------------------------------
+
+def test_health_endpoint_codes(global_tracing):
+    from theanompi_tpu.observability import export
+    from theanompi_tpu.observability.export import ObservabilityServer
+
+    clock = {"now": 0.0}
+    agg = live.Aggregator(
+        period_s=1.0, heartbeat_miss=2, log=lambda line: None,
+        clock=lambda: clock["now"],
+    )
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=agg, period_s=999
+    ).start()
+    export.set_health_provider(agg.health)
+    srv = ObservabilityServer(port=0).start()
+    try:
+        shipper.flush()
+        agg.close_window()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            doc = json.loads(resp.read())
+        assert doc["status"] == "ok"
+        assert doc["ranks"]["rank0"]["alive"] is True
+        # dead rank -> 503 so a plain HTTP probe IS the SLO check
+        clock["now"] = 10.0
+        agg.close_window()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=30
+            )
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == "alert"
+    finally:
+        shipper.stop()
+        srv.close()
+        export.set_health_provider(None)
+
+
+def test_health_endpoint_without_provider():
+    from theanompi_tpu.observability.export import ObservabilityServer
+
+    srv = ObservabilityServer(port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "unknown"
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# watch CLI
+# ---------------------------------------------------------------------------
+
+def test_watch_cli_replay_green_and_straggler(capsys):
+    from theanompi_tpu.observability.__main__ import main as cli_main
+
+    rc = cli_main(["watch", "--replay", *FIXTURES, "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    verdicts = [json.loads(l) for l in captured.out.splitlines()]
+    assert len(verdicts) == 4
+    assert all(v["alerts"] == [] for v in verdicts)
+    rc = cli_main(
+        ["watch", "--replay", *FIXTURES, "--max-straggler", "0.25"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "ALERT" in captured.err
+    assert "max_straggler" in captured.err
+
+
+def test_watch_cli_replay_missing_input(capsys):
+    from theanompi_tpu.observability.__main__ import main as cli_main
+
+    rc = cli_main(["watch", "--replay", "/nonexistent/trace.jsonl"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_watch_cli_subprocess_smoke(tmp_path):
+    """Tier-1 smoke of the actual CLI entry (the ISSUE asks for the
+    watch CLI to be wired in so it can't rot)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "theanompi_tpu.observability", "watch",
+         "--replay", *FIXTURES, "--max-straggler", "0.25", "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1
+    verdicts = [json.loads(l) for l in proc.stdout.splitlines()]
+    assert len(verdicts) == 4
+    assert all(
+        a["rule"] == "max_straggler"
+        for v in verdicts for a in v["alerts"]
+    )
+
+
+def test_live_monitor_end_to_end(global_tracing):
+    """LiveMonitor (what bench/THEANOMPI_LIVE=1 runs): spans flow
+    through shipper -> aggregator -> windows, and stop() returns the
+    summary bench attaches to its JSON."""
+    mon = live.LiveMonitor(
+        "rank0", period_s=0.05, window_s=0.15, log=lambda line: None
+    )
+    try:
+        for i in range(10):
+            with obs.span("train_iter", iter=i):
+                time.sleep(0.002)
+        deadline = time.time() + 30
+        while mon.aggregator.n_windows < 1 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        summary = mon.stop()
+    assert summary["windows"] >= 1
+    assert summary["alerts_total"] == 0
+    assert summary["shipper"]["shipped"] >= 1
+    assert summary["cumulative"]["ranks"]["rank0"]["steps"]["n"] == 10
+
+
+def test_maybe_start_from_env_inert_by_default():
+    assert live.maybe_start_from_env("rank0", env={}) is None
+
+
+# ---------------------------------------------------------------------------
+# clock alignment (satellite: merge under misaligned clocks)
+# ---------------------------------------------------------------------------
+
+def _rank_raw(label, pid, shift_us, flows_out=(), flows_in=()):
+    rows = [{"kind": "header", "pid": pid, "process_name": label,
+             "tracks": {"0": "MAIN"}, "dropped": 0}]
+    for k in range(5):
+        rows.append({"ph": "X", "name": "train_iter",
+                     "ts": k * 10_000 + shift_us, "dur": 9_000.0,
+                     "pid": pid, "tid": 0})
+    for fid, ts in flows_out:
+        rows.append({"ph": "s", "cat": "flow", "name": "tcp_msg",
+                     "id": fid, "ts": ts + shift_us, "pid": pid,
+                     "tid": 0})
+    for fid, ts in flows_in:
+        rows.append({"ph": "f", "bp": "e", "cat": "flow",
+                     "name": "tcp_msg", "id": fid, "ts": ts + shift_us,
+                     "pid": pid, "tid": 0})
+    return [json.dumps(r) + "\n" for r in rows]
+
+
+def _two_skewed_ranks(skew_us=50_000, delay_us=300):
+    """rank1's clock reads +skew for the same true instants; flows in
+    both directions with a symmetric link delay."""
+    r0 = _rank_raw(
+        "rank0", 0, 0,
+        flows_out=[(f"tcp:0:{k}", 5_000 + k * 10_000) for k in range(5)],
+        flows_in=[("tcp:1:0", 9_000 + delay_us)],
+    )
+    r1 = _rank_raw(
+        "rank1", 1, skew_us,
+        # true times — the helper shifts them onto rank1's skewed clock
+        flows_out=[("tcp:1:0", 9_000)],
+        flows_in=[
+            (f"tcp:0:{k}", 5_000 + k * 10_000 + delay_us)
+            for k in range(5)
+        ],
+    )
+    return r0, r1
+
+
+def test_merge_aligns_planted_50ms_offset_to_under_5ms():
+    """The golden alignment claim: two ranks with a planted ±50ms
+    clock offset land within 5ms of each other after flow-pair
+    correction (symmetric delays cancel exactly here)."""
+    r0, r1 = _two_skewed_ranks()
+    doc = merge_raw_traces([("rank0", r0), ("rank1", r1)])
+    offs = doc["otherData"]["clock_offsets_us"]
+    assert offs["rank0"] == 0.0
+    assert offs["rank1"] == pytest.approx(50_000.0, abs=5_000.0)
+    steps = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    r0_ts = sorted(e["ts"] for e in steps if e["pid"] == 0)
+    r1_ts = sorted(e["ts"] for e in steps if e["pid"] == 1)
+    for a, b in zip(r0_ts, r1_ts):
+        assert abs(a - b) < 5_000.0
+    # causality preserved: every arrow head still follows its tail
+    begins = {e["id"]: e["ts"] for e in doc["traceEvents"]
+              if e.get("ph") == "s"}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "f":
+            assert e["ts"] >= begins[e["id"]] - 1e-6
+
+
+def test_merge_keeps_unalignable_rank_with_warning():
+    """A rank with no flows cannot be aligned: kept, flagged — never
+    silently skewed."""
+    r0, r1 = _two_skewed_ranks()
+    r2 = _rank_raw("rank2", 2, 99_000)
+    doc = merge_raw_traces(
+        [("rank0", r0), ("rank1", r1), ("rank2", r2)]
+    )
+    assert doc["otherData"]["clock_unaligned"] == ["rank2"]
+    warns = [e for e in doc["traceEvents"]
+             if e.get("ph") == "i" and e["name"] == "unaligned_clock"]
+    assert len(warns) == 1 and warns[0]["args"]["label"] == "rank2"
+    # rank2's events untouched (raw clock kept)
+    r2_ts = sorted(e["ts"] for e in doc["traceEvents"]
+                   if e.get("ph") == "X" and e["pid"] == 2)
+    assert r2_ts[0] == 99_000.0
+
+
+def test_merge_without_flows_is_unchanged():
+    r0 = _rank_raw("rank0", 0, 0)
+    r1 = _rank_raw("rank1", 1, 12_345)
+    aligned = merge_raw_traces([("rank0", r0), ("rank1", r1)])
+    raw = merge_raw_traces(
+        [("rank0", r0), ("rank1", r1)], align_clocks=False
+    )
+    assert aligned == raw
+    assert "clock_offsets_us" not in aligned["otherData"]
+
+
+def test_estimate_clock_offsets_one_directional_bias_is_late():
+    """With only one flow direction the link's floor delay cannot
+    cancel — the estimate errs toward shifting the receiver EARLIER by
+    at most that delay, never moving an effect before its cause."""
+    ranks = [
+        {"label": "a", "flow_begin": {"x1": 100.0, "x2": 200.0},
+         "flow_end": {}},
+        {"label": "b", "flow_begin": {},
+         "flow_end": {"x1": 5_100.0, "x2": 5_250.0}},
+    ]
+    offsets, unaligned = analysis.estimate_clock_offsets(ranks)
+    assert unaligned == []
+    # min delay edge = 5000us: skew estimate includes the floor delay
+    assert offsets["b"] == pytest.approx(5_000.0)
+    # corrected receive ts for x1: 5100 - 5000 = 100 >= begin ts 100
+    assert 5_100.0 - offsets["b"] >= 100.0
+
+
+def test_aggregator_reports_clock_offsets(global_tracing):
+    """The aggregator closes the 'offset tracks' carryover online: flow
+    watermarks shipped in frames become per-rank offsets in the window
+    verdict."""
+    agg = live.Aggregator(log=lambda line: None)
+    skew = 50_000.0
+    agg.ingest({
+        "kind": live.FRAME_KIND, "v": 1, "rank": "rank0", "seq": 1,
+        "t_wall": 0.0, "sample_rate": 1, "dropped": 0,
+        "flows": {"b_id": ["tcp:0:0"], "b_ts": [1_000.0],
+                  "f_id": ["tcp:1:0"], "f_ts": [2_000.0 + 200.0]},
+    })
+    agg.ingest({
+        "kind": live.FRAME_KIND, "v": 1, "rank": "rank1", "seq": 1,
+        "t_wall": 0.0, "sample_rate": 1, "dropped": 0,
+        "flows": {"b_id": ["tcp:1:0"], "b_ts": [2_000.0 + skew],
+                  "f_id": ["tcp:0:0"], "f_ts": [1_000.0 + 200.0 + skew]},
+    })
+    v = agg.close_window()
+    assert v["clock_offsets_us"]["rank1"] == pytest.approx(skew, abs=1.0)
+
+
+# ---------------------------------------------------------------------------
+# error bars on sampled-doctor fractions (satellite)
+# ---------------------------------------------------------------------------
+
+def _sampled_rank_lines(rate=4, n=40):
+    t = Tracer(pid=0, process_name="sampled", sample_rate=rate)
+    t.enable()
+    clock = {"now": 0.0}
+    t.clock = lambda: clock["now"]
+    t._epoch = 0.0
+    for i in range(n):
+        start = i * 0.01
+        t.add_span("train_iter", start, start + 0.009, {"iter": i})
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "r", suffix=".jsonl", delete=False
+    ) as f:
+        path = f.name
+    t.save_raw(path)
+    with open(path) as f:
+        lines = f.readlines()
+    os.unlink(path)
+    return lines
+
+
+def test_sampled_fractions_carry_ci95():
+    report = analysis.analyze([("sampled", _sampled_rank_lines())])
+    ra = report["ranks"]["sampled"]
+    assert ra["sample_rate"] == 4
+    assert ra["sampled_out"] == 30  # 40 spans, 1-in-4 kept
+    ci = ra["fractions_ci95"]
+    assert 0 < ci["compute"] <= 1.0
+    assert ci["comm"] == 0.0  # no comm spans -> no comm uncertainty
+    # rendered table carries the bars
+    assert "±" in analysis.render_report(report)
+    # the golden (unsampled) fixture keeps its exact shape: no ci keys
+    unsampled = _postmortem_report()
+    assert "fractions_ci95" not in unsampled["ranks"]["doctor_rank0"]
+
+
+def test_min_overlap_gate_respects_ci():
+    """Threshold flags compare against the conservative bound: a
+    sampled overlap only fails the gate when the violation survives
+    the sampling uncertainty."""
+    report = {
+        "ranks": {
+            "r0": {"comm_compute_overlap": 0.4,
+                   "comm_compute_overlap_ci95": 0.2},
+        },
+    }
+    # 0.4 + 0.2 >= 0.5: within the error bars -> no violation
+    assert analysis.check_thresholds(report, min_overlap=0.5) == []
+    # 0.4 + 0.2 < 0.7: confidently below -> violation (ci noted)
+    v = analysis.check_thresholds(report, min_overlap=0.7)
+    assert len(v) == 1 and "ci95" in v[0]
+    # without ci the comparison is exact (unchanged behavior)
+    report["ranks"]["r0"].pop("comm_compute_overlap_ci95")
+    assert len(analysis.check_thresholds(report, min_overlap=0.5)) == 1
+
+
+# ---------------------------------------------------------------------------
+# rpc flow ids on the request/reply channel (satellite)
+# ---------------------------------------------------------------------------
+
+def test_request_reply_flow_arrows(global_tracing):
+    from theanompi_tpu.parallel.transport import (
+        TcpServerChannel, request,
+    )
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    port = find_free_port()
+    ch = TcpServerChannel(port, lambda msg: {"echo": msg["x"]})
+    try:
+        for x in range(3):
+            assert request(
+                ("127.0.0.1", port), {"x": x}, timeout=30
+            )["echo"] == x
+    finally:
+        ch.close()
+    evs = global_tracing.snapshot()
+    begins = {e["id"] for e in evs
+              if e.get("ph") == "s" and e["name"] == "rpc_msg"}
+    ends = {e["id"] for e in evs
+            if e.get("ph") == "f" and e["name"] == "rpc_msg"}
+    assert len(begins) == 3
+    assert begins == ends  # every request's arrow closed at the server
+    # the doctor counts rpc flows like any other
+    pid = obs.get_tracer().pid
+    assert all(fid.startswith(f"rpc:{pid}:") for fid in begins)
+
+
+def test_request_reply_survives_tracing_toggle():
+    """A frame sent while tracing was ON decodes cleanly on a server
+    after tracing turns OFF (and vice versa) — the envelope is always
+    stripped."""
+    from theanompi_tpu.parallel.transport import (
+        TcpServerChannel, request,
+    )
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    tracer = obs.enable_tracing()
+    tracer.clear()
+    port = find_free_port()
+    ch = TcpServerChannel(port, lambda msg: {"ok": msg["y"]})
+    try:
+        assert request(("127.0.0.1", port), {"y": 1}, timeout=30)["ok"] == 1
+        obs.disable_tracing()
+        assert request(("127.0.0.1", port), {"y": 2}, timeout=30)["ok"] == 2
+    finally:
+        ch.close()
+        obs.disable_tracing()
+        tracer.clear()

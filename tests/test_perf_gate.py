@@ -21,10 +21,16 @@ TRACE = os.path.join(
 )
 
 
-def _bench_json(path, value, trace=None):
+def _bench_json(path, value, trace=None, live_alerts=None):
     detail = {"wall_s": 2.0}
     if trace:
         detail["observability"] = {"trace_raw": trace}
+    if live_alerts is not None:
+        detail.setdefault("observability", {})["live"] = {
+            "windows": 3,
+            "alerts_total": live_alerts,
+            "alerts": [],
+        }
     doc = {
         "metric": "alexnet128_bsp_images_per_sec_per_chip",
         "value": value,
@@ -95,6 +101,51 @@ def test_gate_loud_without_baseline(fixtures, tmp_path):
     })
     assert r.returncode == 2
     assert "baseline" in r.stderr
+
+
+def test_gate_fails_when_bench_live_plane_alerted(fixtures, tmp_path):
+    """A bench that ran with THEANOMPI_LIVE=1 and raised watchdog
+    alerts fails the gate even when throughput and overlap pass."""
+    base, _, _ = fixtures
+    alerted = _bench_json(
+        tmp_path / "alerted.json", 101.0, trace=TRACE, live_alerts=2
+    )
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": alerted,
+        "PERF_GATE_BASELINE": base,
+    })
+    assert r.returncode != 0
+    assert "live watchdog alert" in r.stderr
+
+
+def test_gate_watchdog_leg_requires_straggler_to_fire(fixtures, tmp_path):
+    """The planted-straggler self-test: an unreachable --max-straggler
+    means the fixture cannot fire, and the gate must call the live
+    plane broken instead of passing green."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_STRAGGLER_MAX": "10.0",  # fixture index ~0.61
+    })
+    assert r.returncode != 0
+    assert "did NOT fire" in r.stderr
+
+
+def test_gate_watchdog_leg_skippable(fixtures, tmp_path):
+    """PERF_GATE_WATCHDOG=0 restores the pre-live gate behavior —
+    alerts in the bench JSON are not inspected."""
+    base, _, _ = fixtures
+    alerted = _bench_json(
+        tmp_path / "alerted.json", 101.0, trace=TRACE, live_alerts=2
+    )
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": alerted,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "green" in r.stderr
 
 
 def test_gate_extracts_trace_from_bench_json(fixtures, tmp_path):

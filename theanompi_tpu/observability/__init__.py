@@ -14,8 +14,12 @@ three primitives:
   to a post-mortem JSON file on unhandled exception or explicit
   ``dump()``.
 
-plus ``export`` (file dumps + an opt-in localhost HTTP endpoint) and a
-CLI (``python -m theanompi_tpu.observability dump --format chrome``).
+plus ``export`` (file dumps + an opt-in localhost HTTP endpoint incl.
+``/health``), ``live`` (the live telemetry plane: per-rank frame
+shipping, the rank-0 aggregator with the streaming doctor, the SLO
+watchdog — import as a submodule, ``from theanompi_tpu.observability
+import live``), and a CLI (``python -m theanompi_tpu.observability
+dump --format chrome`` / ``watch`` / ``doctor`` / ``merge``).
 
 **Event bus**: ``publish_event(kind, fields)`` fans one structured
 event out to every surface (instant trace event, flight ring, the

@@ -26,12 +26,23 @@ Commands:
   (``--max-straggler``, ``--min-overlap``, ``--max-stall-s``,
   ``--max-ttft-p99-s``, ``--max-tpot-p99-s``) exit 1 on violation —
   the CI perf-regression gate.
+- ``watch``                     the LIVE doctor: run the telemetry
+  aggregator (``--port``; workers ship into it with
+  ``THEANOMPI_LIVE_AGG=host:port``), close a verdict window every
+  ``--window-s``, print per-window verdict lines, and evaluate the
+  SAME threshold flags the doctor gates CI with — violations become
+  watchdog alerts (log + ``watchdog_alerts_total{rule}`` + ``/health``
+  via ``--health-port``).  ``--replay FILE...`` feeds recorded raw
+  traces through the identical streaming path instead of sockets —
+  the CI-able smoke of the live plane (``--replay-windows`` chunks).
+  Exits 1 when any alert fired.
 - ``serve --port N``            serve /metrics, /trace, /flight from the
   current (empty, unless something enabled tracing in-process) state —
   mainly a smoke surface; real deployments call
   ``export.ObservabilityServer`` from inside the run.
 
-Exit codes: 0 ok, 1 doctor threshold violation, 2 usage/missing-input.
+Exit codes: 0 ok, 1 doctor threshold violation / watchdog alert,
+2 usage/missing-input.
 """
 
 from __future__ import annotations
@@ -185,6 +196,169 @@ def _cmd_doctor(args) -> int:
     return 1 if violations else 0
 
 
+def _watch_thresholds(args) -> dict:
+    return {
+        "max_straggler": args.max_straggler,
+        "min_overlap": args.min_overlap,
+        "max_stall_s": args.max_stall_s,
+        "max_ttft_p99_s": args.max_ttft_p99_s,
+        "max_tpot_p99_s": args.max_tpot_p99_s,
+    }
+
+
+def _window_line(v: dict) -> str:
+    """One human line per closed window."""
+    n_steps = sum(
+        r.get("steps", {}).get("n", 0) for r in v.get("ranks", {}).values()
+    )
+    sg = v.get("stragglers", {})
+    parts = [
+        f"window {v.get('window')}",
+        f"ranks {len(v.get('ranks', {}))}",
+        f"steps {n_steps}",
+    ]
+    if sg.get("per_rank"):
+        parts.append(
+            f"straggler {sg['max_straggler_index']:.3f} "
+            f"({sg.get('straggler_rank')})"
+        )
+    overlaps = [
+        r["comm_compute_overlap"]
+        for r in v.get("ranks", {}).values()
+        if r.get("comm_compute_overlap") is not None
+    ]
+    if overlaps:
+        parts.append(f"overlap {min(overlaps):.3f}")
+    if v.get("stalls"):
+        parts.append(f"stalls {len(v['stalls'])}")
+    if v.get("serving", {}).get("ttft"):
+        parts.append(
+            f"ttft_p99 {v['serving']['ttft']['p99_s'] * 1e3:.1f}ms"
+        )
+    if v.get("dead_ranks"):
+        parts.append(f"DEAD {','.join(v['dead_ranks'])}")
+    n_alerts = len(v.get("alerts", []))
+    parts.append(f"alerts {n_alerts}" + (" <<<" if n_alerts else ""))
+    return " | ".join(parts)
+
+
+def _emit_window(v: dict, as_json: bool) -> None:
+    if as_json:
+        sys.stdout.write(json.dumps(v) + "\n")
+    else:
+        print(_window_line(v), flush=True)
+
+
+def _cmd_watch(args) -> int:
+    from theanompi_tpu.observability import live
+
+    if args.replay:
+        return _watch_replay(args)
+    agg = live.Aggregator(
+        thresholds=_watch_thresholds(args),
+        period_s=args.period_s,
+        heartbeat_miss=args.heartbeat_miss,
+        stall_min_s=args.stall_min_s,
+        expect_ranks=args.expect_rank or None,
+        log=lambda line: print(line, file=sys.stderr, flush=True),
+    )
+    channel = agg.serve(args.port)
+    health = None
+    if args.health_port is not None:
+        from theanompi_tpu.observability import export
+
+        export.set_health_provider(agg.health)
+        health = export.ObservabilityServer(port=args.health_port).start()
+        print(
+            f"[watch] /health on http://127.0.0.1:{health.port}",
+            file=sys.stderr,
+        )
+    print(
+        f"[watch] aggregator on port {args.port} — ship frames with "
+        f"THEANOMPI_LIVE_AGG=127.0.0.1:{args.port}; window "
+        f"{args.window_s}s (Ctrl-C to stop)",
+        file=sys.stderr,
+    )
+    import time as _time
+
+    closed = 0
+    try:
+        while args.windows is None or closed < args.windows:
+            _time.sleep(args.window_s)
+            _emit_window(agg.close_window(), args.json)
+            closed += 1
+    except KeyboardInterrupt:
+        pass
+    finally:
+        channel.close()
+        if health is not None:
+            health.close()
+            from theanompi_tpu.observability import export
+
+            export.set_health_provider(None)
+    return 1 if agg.watchdog.alerts_total else 0
+
+
+def _watch_replay(args) -> int:
+    """Recorded raw traces through the IDENTICAL streaming path the
+    live aggregator runs — each rank's events in completion order,
+    sliced into ``--replay-windows`` equal chunks."""
+    from theanompi_tpu.observability import analysis, live
+
+    named, rc = _load_named(args, "replay")
+    if rc:
+        return rc
+    per_rank = []
+    for label, lines in named:
+        events = []
+        sample_rate, dropped = 1, 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("kind") == "header":
+                sample_rate = int(doc.get("sample_rate", 1) or 1)
+                dropped = int(doc.get("dropped", 0) or 0)
+            elif doc.get("ph") in ("X", "C", "s", "f"):
+                events.append(doc)
+        # stream order = completion order: spans land when they END
+        events.sort(
+            key=lambda e: float(e.get("ts", 0.0))
+            + float(e.get("dur", 0.0))
+        )
+        per_rank.append((label, events, sample_rate, dropped))
+    doctor = analysis.StreamingDoctor(stall_min_s=args.stall_min_s)
+    watchdog = live.Watchdog(
+        _watch_thresholds(args),
+        log=lambda line: print(line, file=sys.stderr, flush=True),
+    )
+    n_win = max(1, args.replay_windows)
+    for k in range(n_win):
+        for label, events, sample_rate, dropped in per_rank:
+            lo = (k * len(events)) // n_win
+            hi = ((k + 1) * len(events)) // n_win
+            doctor.feed(
+                label,
+                events[lo:hi],
+                sample_rate=sample_rate,
+                dropped=dropped if k == 0 else 0,
+            )
+        v = doctor.close_window()
+        v["alerts"] = watchdog.evaluate(v)
+        _emit_window(v, args.json)
+    if not args.json:
+        print(
+            f"[watch] replayed {len(per_rank)} rank(s) over {n_win} "
+            f"windows — {watchdog.alerts_total} alert(s)",
+            file=sys.stderr,
+        )
+    return 1 if watchdog.alerts_total else 0
+
+
 def _cmd_serve(args) -> int:
     from theanompi_tpu.observability.export import ObservabilityServer
 
@@ -292,6 +466,71 @@ def _build_parser() -> argparse.ArgumentParser:
         help="fail when serving TPOT p99 exceeds this (needs --metrics)",
     )
     doc.set_defaults(fn=_cmd_doctor)
+    w = sub.add_parser(
+        "watch",
+        help="live doctor: telemetry aggregator + per-window verdicts "
+        "+ watchdog alerts (or --replay over recorded traces)",
+    )
+    w.add_argument(
+        "inputs",
+        nargs="*",
+        help="raw trace files for --replay (default: every "
+        "*trace_raw.jsonl in the observability directory)",
+    )
+    w.add_argument(
+        "--replay",
+        action="store_true",
+        help="replay recorded raw traces as a stream instead of "
+        "listening for live frames",
+    )
+    w.add_argument(
+        "--replay-windows",
+        type=int,
+        default=4,
+        help="number of stream chunks per rank in --replay (default 4)",
+    )
+    w.add_argument("--dir", default=None, help="observability directory")
+    w.add_argument(
+        "--port", type=int, default=9411,
+        help="aggregator listen port (live mode; workers set "
+        "THEANOMPI_LIVE_AGG=host:port)",
+    )
+    w.add_argument(
+        "--health-port", type=int, default=None,
+        help="also serve /health (+ /metrics etc.) on this port",
+    )
+    w.add_argument(
+        "--window-s", type=float, default=5.0,
+        help="verdict window length in seconds (live mode)",
+    )
+    w.add_argument(
+        "--period-s", type=float, default=1.0,
+        help="expected worker heartbeat period (live mode)",
+    )
+    w.add_argument(
+        "--heartbeat-miss", type=int, default=3,
+        help="missed heartbeats before a rank is declared dead",
+    )
+    w.add_argument(
+        "--windows", type=int, default=None,
+        help="exit after this many windows (default: run until Ctrl-C)",
+    )
+    w.add_argument(
+        "--expect-rank", action="append", default=None,
+        help="rank label that must heartbeat from the start (repeat "
+        "per rank); silence becomes an alert even if it never joined",
+    )
+    w.add_argument(
+        "--json", action="store_true",
+        help="one JSON verdict per line instead of the human line",
+    )
+    w.add_argument("--stall-min-s", type=float, default=0.0)
+    w.add_argument("--max-straggler", type=float, default=None)
+    w.add_argument("--min-overlap", type=float, default=None)
+    w.add_argument("--max-stall-s", type=float, default=None)
+    w.add_argument("--max-ttft-p99-s", type=float, default=None)
+    w.add_argument("--max-tpot-p99-s", type=float, default=None)
+    w.set_defaults(fn=_cmd_watch)
     s = sub.add_parser("serve", help="local HTTP endpoint (opt-in)")
     s.add_argument("--port", type=int, default=9100)
     s.add_argument("--host", default="127.0.0.1")

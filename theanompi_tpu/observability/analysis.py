@@ -43,6 +43,18 @@ directory on a laptop.  The CLI wrapper is
 ``--json``; ``--max-straggler`` / ``--min-overlap`` / ``--max-stall-s``
 / ``--max-ttft-p99-s`` turn verdicts into nonzero exit codes, which is
 how CI gates on them).
+
+The same math also runs ONLINE: ``StreamingDoctor`` is ``analyze``
+restated as an incremental, windowed accumulator (shared pure helpers
+— ``merge_intervals``/``intersect_total``/``straggler_summary``/
+``StallTracker``), the verdict engine under the live telemetry plane
+(``observability/live.py``) and the ``watch`` CLI.  Fractions from
+1-in-N sampled traces carry 95% error bars (``fractions_ci95``), and
+threshold checks compare against the conservative end of the interval
+so a sampled trace cannot flake a CI gate.  ``estimate_clock_offsets``
+recovers per-rank clock skew from the min one-way delay of matched
+flow send/recv pairs — ``merge_raw_traces`` applies it so merged
+timelines line up across hosts.
 """
 
 from __future__ import annotations
@@ -175,6 +187,25 @@ def _intervals(spans: List[dict]) -> List[Tuple[float, float]]:
     )
 
 
+def sampled_ci95(frac: float, n_kept: int, rate: int) -> float:
+    """95% half-width on a time fraction computed from a 1-in-``rate``
+    sampled trace that kept ``n_kept`` spans of the category.
+
+    The kept set is deterministic, not random, so this is a modeling
+    approximation, not an exact CI: treat the kept spans as a 1/rate
+    thinning of the span stream, giving the scaled duration total a
+    relative standard error of ~sqrt((rate-1)/n_kept) (Poisson-style
+    count noise; duration dispersion is absorbed into the same
+    factor).  rate=1 means every span was kept — the fraction is
+    exact and the half-width is 0.  Clamped to [0, 1]: a fraction is
+    never uncertain past the whole window."""
+    if rate <= 1 or n_kept <= 0 or frac <= 0:
+        return 0.0
+    import math
+
+    return min(1.0, 1.96 * frac * math.sqrt((rate - 1) / n_kept))
+
+
 def _nearest_rank(sorted_vals: List[float], pct: float) -> float:
     if not sorted_vals:
         return float("nan")
@@ -244,6 +275,37 @@ def _analyze_rank(rank: dict, stall_min_s: float) -> dict:
         "sample_rate": rank["sample_rate"],
         "sampled_out": rank["sampled_out"],
     }
+    rate = rank["sample_rate"]
+    if rate > 1:
+        # error bars on fractions computed from a sampled trace: the
+        # 1-in-N keep rate and the per-category kept-span counts bound
+        # how much duration the dropped spans could have carried.
+        # Present ONLY for sampled traces — rate-1 reports (and the
+        # golden fixture) keep their exact shape.
+        n_c = len(_spans_named(rank, COMPUTE_SPANS))
+        n_m = len(_spans_named(rank, COMM_SPANS))
+        n_w = len(_spans_named(rank, WAIT_SPANS))
+        fr = out["fractions"]
+        ci = {
+            "compute": sampled_ci95(fr["compute"], n_c, rate),
+            "comm": sampled_ci95(fr["comm"], n_m, rate),
+            "input_wait": sampled_ci95(fr["input_wait"], n_w, rate),
+        }
+        # idle is derived from the busy union of all three — its
+        # uncertainty compounds theirs (root-sum-square)
+        ci["idle"] = min(
+            1.0,
+            (ci["compute"] ** 2 + ci["comm"] ** 2
+             + ci["input_wait"] ** 2) ** 0.5,
+        )
+        out["fractions_ci95"] = ci
+        if out["comm_compute_overlap"] is not None:
+            # absolute half-width on the [0,1] ratio (scale-free — an
+            # observed overlap of 0 from a sparse sample is still
+            # uncertain); the scarcer category's count dominates
+            out["comm_compute_overlap_ci95"] = sampled_ci95(
+                1.0, min(n_c, n_m), rate
+            )
     out["stalls"] = _find_stalls(rank, wait, stall_min_s)
     return out
 
@@ -264,16 +326,77 @@ def _step_boundaries(rank: dict) -> List[float]:
     ]
 
 
+class StallTracker:
+    """Streaming depth>0 window detector for ONE counter series.
+
+    ``feed`` takes timestamped samples in order and returns a closed
+    ``(start, end, max_depth)`` window (µs) whenever the depth drains
+    back to zero; ``flush`` closes a still-open window at the last
+    sample seen.  The offline ``_find_stalls`` and the live plane's
+    online doctor run the SAME instance logic, so a stall means one
+    thing whether it was found post-mortem or mid-run."""
+
+    __slots__ = ("start", "max_depth", "last_ts")
+
+    def __init__(self):
+        self.start: Optional[float] = None
+        self.max_depth = 0.0
+        self.last_ts: Optional[float] = None
+
+    def feed(self, ts: float, val: float):
+        self.last_ts = ts
+        if val > 0:
+            if self.start is None:
+                self.start, self.max_depth = ts, val
+            else:
+                self.max_depth = max(self.max_depth, val)
+            return None
+        if self.start is None:
+            return None
+        out = (self.start, ts, self.max_depth)
+        self.start = None
+        return out
+
+    def flush(self):
+        """Close a never-drained window at the last sample (a backed-up
+        mailbox at dump/window time is a stall, not invisible)."""
+        if self.start is None or self.last_ts is None:
+            return None
+        out = (self.start, self.last_ts, self.max_depth)
+        self.start = None
+        return out
+
+
+def stall_row(
+    key: Any,
+    window: Tuple[float, float, float],
+    wait_intervals: List[Tuple[float, float]],
+) -> dict:
+    """One report row from a closed StallTracker window: duration plus
+    its overlap with blocked-recv (``inbox_wait``) spans — depth>0
+    while nobody is in recv means the consumer was busy elsewhere (a
+    scheduling stall); depth>0 inside recv means the drain itself is
+    the bottleneck."""
+    a, b, depth = window
+    return {
+        "inbox_rank": key,
+        "start_s": a / 1e6,
+        "end_s": b / 1e6,
+        "duration_s": (b - a) / 1e6,
+        "max_depth": depth,
+        "recv_wait_overlap_s": intersect_total(
+            [(a, b)], wait_intervals
+        ) / 1e6,
+    }
+
+
 def _find_stalls(
     rank: dict,
     wait_intervals: List[Tuple[float, float]],
     stall_min_s: float,
 ) -> List[dict]:
-    """Windows where an inbox-depth counter sat above zero.  Each
-    window carries its max depth and its overlap with blocked-recv
-    (``inbox_wait``) spans: depth>0 while nobody is in recv means the
-    consumer was busy elsewhere (a scheduling stall); depth>0 inside
-    recv means the drain itself is the bottleneck."""
+    """Windows where an inbox-depth counter sat above zero (one
+    StallTracker per labeled series)."""
     series: Dict[Any, List[Tuple[float, float]]] = {}
     for ev in rank["counters"]:
         if ev.get("name") != "inbox_depth":
@@ -283,80 +406,644 @@ def _find_stalls(
         series.setdefault(key, []).append(
             (float(ev.get("ts", 0.0)), float(args.get("value", 0.0)))
         )
-    stalls: List[dict] = []
+    out = []
     for key, samples in sorted(
         series.items(), key=lambda kv: str(kv[0])
     ):
         samples.sort()
-        start = None
-        max_depth = 0.0
-        for ts, val in samples:
-            if val > 0 and start is None:
-                start, max_depth = ts, val
-            elif val > 0:
-                max_depth = max(max_depth, val)
-            elif start is not None:
-                stalls.append((key, start, ts, max_depth))
-                start = None
-        if start is not None:  # never drained back to zero: open window
-            stalls.append((key, start, samples[-1][0], max_depth))
-    out = []
-    for key, a, b, depth in stalls:
-        dur = (b - a) / 1e6
-        if dur < stall_min_s:
-            continue
-        out.append(
-            {
-                "inbox_rank": key,
-                "start_s": a / 1e6,
-                "end_s": b / 1e6,
-                "duration_s": dur,
-                "max_depth": depth,
-                "recv_wait_overlap_s": intersect_total(
-                    [(a, b)], wait_intervals
-                ) / 1e6,
-            }
-        )
+        tracker = StallTracker()
+        windows = [w for ts, val in samples
+                   if (w := tracker.feed(ts, val)) is not None]
+        tail = tracker.flush()
+        if tail is not None:
+            windows.append(tail)
+        for w in windows:
+            if (w[1] - w[0]) / 1e6 < stall_min_s:
+                continue
+            out.append(stall_row(key, w, wait_intervals))
     return out
+
+
+def straggler_summary(boundaries: Dict[str, List[float]]) -> dict:
+    """Stragglers: lag behind the fastest rank at each common step
+    boundary, measured per-rank-relative (clock-offset-free).
+
+    ``boundaries[label]`` is the cumulative seconds from that rank's
+    first step start to each step end (``_step_boundaries``).  Pure —
+    the offline ``analyze`` calls it over whole traces, the streaming
+    doctor over its growing per-rank boundary lists."""
+    straggler: dict = {
+        "n_common_steps": 0,
+        "per_rank": {},
+        "straggler_rank": None,
+        "max_straggler_index": 0.0,
+    }
+    if len(boundaries) >= 2:
+        n_common = min(len(b) for b in boundaries.values())
+        straggler["n_common_steps"] = n_common
+        fastest = [
+            min(b[k] for b in boundaries.values()) for k in range(n_common)
+        ]
+        worst = (None, 0.0)
+        for label, b in sorted(boundaries.items()):
+            lags = [b[k] - fastest[k] for k in range(n_common)]
+            final = lags[-1] if lags else 0.0
+            idx = (
+                final / fastest[-1]
+                if n_common and fastest[-1] > 0
+                else 0.0
+            )
+            straggler["per_rank"][label] = {
+                "final_lag_s": final,
+                "mean_lag_s": sum(lags) / len(lags) if lags else 0.0,
+                "straggler_index": idx,
+            }
+            if idx > worst[1]:
+                worst = (label, idx)
+        straggler["straggler_rank"] = worst[0]
+        straggler["max_straggler_index"] = worst[1]
+    return straggler
+
+
+# ---------------------------------------------------------------------------
+# cross-rank clock alignment from flow send/recv pairs
+# ---------------------------------------------------------------------------
+
+def flow_delay_edges(
+    ranks: List[dict],
+) -> Dict[Tuple[str, str], float]:
+    """Minimum observed one-way delay (µs, receiver clock minus sender
+    clock) per directed ``(sender_label, receiver_label)`` pair, from
+    every flow id that BEGINS in one rank's trace and ENDS in
+    another's.  Each observation is ``true_delay + epoch(sender) −
+    epoch(receiver)``; the minimum over many frames approaches the
+    epoch skew plus the link's floor latency — the NTP/PTP trick,
+    applied to flow arrows the transport already stamps."""
+    begun: Dict[str, Tuple[str, float]] = {}
+    for r in ranks:
+        for fid, ts in r["flow_begin"].items():
+            begun[fid] = (r["label"], ts)
+    edges: Dict[Tuple[str, str], float] = {}
+    for r in ranks:
+        for fid, ts in r["flow_end"].items():
+            src = begun.get(fid)
+            if src is None or src[0] == r["label"]:
+                continue  # unmatched, or an in-process round trip
+            key = (src[0], r["label"])
+            d = ts - src[1]
+            if key not in edges or d < edges[key]:
+                edges[key] = d
+    return edges
+
+
+def estimate_clock_offsets(
+    ranks: List[dict],
+) -> Tuple[Dict[str, float], List[str]]:
+    """Per-rank clock offsets (µs) from flow-pair min delays, plus the
+    labels that could not be aligned — the offline entrypoint
+    (``merge_raw_traces``).  The live aggregator maintains its delay
+    edges incrementally and calls ``offsets_from_edges`` directly."""
+    labels = [r["label"] for r in ranks]
+    return offsets_from_edges(flow_delay_edges(ranks), labels)
+
+
+def offsets_from_edges(
+    edges: Dict[Tuple[str, str], float], labels: Iterable[str]
+) -> Tuple[Dict[str, float], List[str]]:
+    """Solve ``flow_delay_edges`` output into per-rank offsets.
+
+    Subtracting ``offsets[label]`` from a rank's timestamps maps them
+    onto the anchor rank's clock.  Where BOTH directions between two
+    ranks carry flows, the symmetric floor latency cancels
+    (``(d_ab − d_ba) / 2``); a one-directional pair uses the raw min
+    delay — biased late by the link's floor latency, which is the
+    conservative direction (never moves an effect before its cause).
+    Ranks are aligned breadth-first from each connected component's
+    label-sorted first member (offset 0); ranks with no cross-rank
+    flows at all come back in ``unaligned`` so callers can WARN
+    instead of silently rendering skewed tracks."""
+    labels = list(labels)
+    adj: Dict[str, set] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    offsets: Dict[str, float] = {}
+    for label in sorted(labels):
+        if label in offsets or label not in adj:
+            continue
+        offsets[label] = 0.0  # component anchor
+        frontier = [label]
+        while frontier:
+            a = frontier.pop()
+            for b in sorted(adj[a]):
+                if b in offsets:
+                    continue
+                d_ab = edges.get((a, b))
+                d_ba = edges.get((b, a))
+                if d_ab is not None and d_ba is not None:
+                    skew = (d_ab - d_ba) / 2.0
+                elif d_ab is not None:
+                    skew = d_ab
+                else:
+                    skew = -d_ba
+                # skew ≈ epoch(a) − epoch(b), i.e. how much LATER b's
+                # clock reads than a's for the same instant; offset
+                # maps b onto the anchor clock (subtract it from b's
+                # timestamps): offset(b) = offset(a) + skew
+                offsets[b] = offsets[a] + skew
+                frontier.append(b)
+    unaligned = [l for l in sorted(labels) if l not in offsets]
+    return offsets, unaligned
 
 
 # ---------------------------------------------------------------------------
 # serving percentiles from a metrics snapshot
 # ---------------------------------------------------------------------------
 
+# the two serving-latency SLO metrics and their report keys — one
+# definition shared by the offline doctor and the live plane's
+# per-window SLO feed
+SLO_HISTOGRAMS = (
+    ("serve_ttft_seconds", "ttft"),
+    ("serve_tpot_seconds", "tpot"),
+)
+
+
+def percentiles_from_buckets(bounds, counts, count) -> dict:
+    """One serving-percentile row (p50/p99 + honest estimator label)
+    from an aggregated histogram — shared by the snapshot path below
+    and the live plane's per-window bucket deltas."""
+    from theanompi_tpu.observability.metrics import bucket_quantile
+
+    return {
+        "count": int(count),
+        "p50_s": bucket_quantile(bounds, counts, 0.50),
+        "p99_s": bucket_quantile(bounds, counts, 0.99),
+        "estimator": "histogram",
+    }
+
+
 def serving_percentiles(snapshot: dict) -> dict:
     """TTFT/TPOT p50/p99 estimated from the registry snapshot's
     histogram buckets (``bucket_quantile``), label series summed.  The
     offline mirror of ``ServingMetrics.summary``'s overflow fallback —
     and the honest label says so (``estimator: histogram``)."""
-    from theanompi_tpu.observability.metrics import bucket_quantile
+    from theanompi_tpu.observability.metrics import sum_histogram_buckets
 
     out = {}
-    for metric, key in (
-        ("serve_ttft_seconds", "ttft"),
-        ("serve_tpot_seconds", "tpot"),
-    ):
-        doc = snapshot.get(metric)
-        if not doc or doc.get("kind") != "histogram":
+    for metric, key in SLO_HISTOGRAMS:
+        agg = sum_histogram_buckets(snapshot.get(metric))
+        if agg is None:
             continue
-        bounds = [float(b) for b in doc.get("bucket_bounds") or []]
-        agg = [0] * (len(bounds) + 1)
-        count = 0
-        for row in doc.get("series", []):
-            buckets = row.get("buckets") or {}
-            for i, b in enumerate(bounds):
-                agg[i] += int(buckets.get(repr(b), 0))
-            agg[-1] += int(buckets.get("+Inf", 0))
-            count += int(row.get("count", 0))
-        if count == 0:
-            continue
-        out[key] = {
-            "count": count,
-            "p50_s": bucket_quantile(bounds, agg, 0.50),
-            "p99_s": bucket_quantile(bounds, agg, 0.99),
-            "estimator": "histogram",
-        }
+        bounds, counts, count = agg
+        out[key] = percentiles_from_buckets(bounds, counts, count)
     return out
+
+
+# ---------------------------------------------------------------------------
+# the streaming doctor: analyze(), restated incrementally
+# ---------------------------------------------------------------------------
+
+def split_intervals(
+    intervals: List[Tuple[float, float]], t: float
+) -> Tuple[List[Tuple[float, float]], List[Tuple[float, float]]]:
+    """Partition MERGED intervals at ``t`` (an interval straddling the
+    cut is split) — the freeze primitive that keeps the streaming
+    accumulator's live state bounded without losing totals."""
+    before: List[Tuple[float, float]] = []
+    after: List[Tuple[float, float]] = []
+    for a, b in intervals:
+        if b <= t:
+            before.append((a, b))
+        elif a >= t:
+            after.append((a, b))
+        else:
+            before.append((a, t))
+            after.append((t, b))
+    return before, after
+
+
+def _category(name) -> Optional[str]:
+    if name in COMPUTE_SPANS:
+        return "compute"
+    if name in COMM_SPANS:
+        return "comm"
+    if name in WAIT_SPANS:
+        return "wait"
+    return None
+
+
+_CATS = ("compute", "comm", "wait")
+
+
+class _RankAcc:
+    """One rank's streaming state: current-window buffers + bounded
+    cumulative interval algebra (live merged lists, frozen totals)."""
+
+    __slots__ = (
+        "live", "frozen", "frozen_overlap", "frozen_busy", "t_frozen",
+        "t_min", "t_max", "max_dur", "counts", "n_spans", "sample_rate",
+        "dropped", "step_base", "boundaries", "step_durs",
+        "steps_capped", "trackers", "stalls", "win", "win_steps",
+        "win_counters",
+    )
+
+    def __init__(self):
+        self.live = {c: [] for c in _CATS}
+        self.frozen = {c: 0.0 for c in _CATS}
+        self.frozen_overlap = 0.0
+        self.frozen_busy = 0.0
+        self.t_frozen: Optional[float] = None
+        self.t_min: Optional[float] = None
+        self.t_max: Optional[float] = None
+        self.max_dur = 0.0
+        self.counts = {c: 0 for c in _CATS}
+        self.n_spans = 0
+        self.sample_rate = 1
+        self.dropped = 0
+        self.step_base: Optional[float] = None
+        self.boundaries: List[float] = []
+        self.step_durs: List[float] = []
+        self.steps_capped = False
+        self.trackers: Dict[Any, StallTracker] = {}
+        self.stalls: List[dict] = []
+        self.win: Dict[str, List[Tuple[float, float]]] = {
+            c: [] for c in _CATS
+        }
+        self.win_steps: List[Tuple[float, float]] = []
+        self.win_counters: List[Tuple[float, Any, float]] = []
+
+
+class StreamingDoctor:
+    """``analyze()`` restated as an incremental, windowed accumulator —
+    the online doctor under the live telemetry plane.
+
+    Feed each rank's raw trace events as they arrive
+    (``feed(label, events)``); ``close_window()`` emits a verdict over
+    everything fed since the previous close, shaped like the offline
+    report (``ranks`` with fractions/overlap, cumulative
+    ``stragglers``, ``stalls``, optional ``serving``) so
+    ``check_thresholds`` gates a WINDOW exactly the way it gates a
+    finished run.  ``cumulative()`` is the whole-stream report: the
+    same interval-union math as ``analyze`` (the pure helpers are
+    shared), kept bounded by freezing interval detail older than the
+    stream's tail into plain totals — a week of monitoring holds a
+    bounded working set while its lifetime fractions stay exact up to
+    the freeze additivity (windows partition time, so union and
+    intersection totals add across the freeze cut).
+
+    Clock honesty: every rank's math runs on ITS OWN timestamps
+    (per-rank fractions, per-rank-relative step boundaries), exactly
+    like the offline doctor — no cross-rank timestamp comparison, so
+    unsynchronized tracer epochs cannot skew verdicts.
+    """
+
+    # live merged-interval lists longer than this freeze their old end
+    # into totals; spans can start at most 2×max_dur before the newest
+    # end seen, so the cut never amputates a span yet to arrive
+    MAX_LIVE_INTERVALS = 4096
+    MAX_STEPS = 1_000_000  # boundary/dur caps: ~8 MB/rank worst case
+    MAX_OPEN_FLOWS = 100_000  # unmatched arrow halves retained
+
+    @classmethod
+    def _cap_flows(cls, half: Dict[str, str]) -> None:
+        while len(half) > cls.MAX_OPEN_FLOWS:
+            del half[next(iter(half))]  # oldest first (insertion order)
+
+    def __init__(self, stall_min_s: float = 0.0):
+        self.stall_min_s = float(stall_min_s)
+        self.ranks: Dict[str, _RankAcc] = {}
+        self.n_windows = 0
+        # cross-rank flow accounting (ids are globally unique)
+        self._flow_begun: Dict[str, str] = {}
+        self._flow_ended: Dict[str, str] = {}
+        self._flows_matched = 0
+
+    # ---- ingest --------------------------------------------------------
+    def feed(
+        self,
+        label: str,
+        events: Iterable[dict],
+        sample_rate: int = 1,
+        dropped: int = 0,
+    ) -> None:
+        """Absorb raw trace-event dicts (``ph`` X/C/s/f, µs timestamps
+        on the rank's own clock) into the current window."""
+        acc = self.ranks.get(label)
+        if acc is None:
+            acc = self.ranks[label] = _RankAcc()
+        acc.sample_rate = max(acc.sample_rate, int(sample_rate))
+        acc.dropped += int(dropped)
+        for ev in events:
+            ph = ev.get("ph")
+            if ph == "X":
+                ts = float(ev.get("ts", 0.0))
+                dur = float(ev.get("dur", 0.0))
+                acc.n_spans += 1
+                acc.t_min = ts if acc.t_min is None else min(acc.t_min, ts)
+                end = ts + dur
+                acc.t_max = (
+                    end if acc.t_max is None else max(acc.t_max, end)
+                )
+                acc.max_dur = max(acc.max_dur, dur)
+                cat = _category(ev.get("name"))
+                if cat is None:
+                    continue
+                acc.counts[cat] += 1
+                acc.win[cat].append((ts, end))
+                if cat == "compute":
+                    acc.win_steps.append((ts, dur))
+            elif ph == "C":
+                if ev.get("name") != "inbox_depth":
+                    continue
+                args = ev.get("args") or {}
+                acc.win_counters.append(
+                    (
+                        float(ev.get("ts", 0.0)),
+                        args.get("rank"),
+                        float(args.get("value", 0.0)),
+                    )
+                )
+            elif ph == "s":
+                fid = str(ev.get("id"))
+                # frames interleave across ranks, so either half of an
+                # arrow can arrive first — match symmetrically, retain
+                # only the unmatched half (bounded)
+                if self._flow_ended.pop(fid, None) is not None:
+                    self._flows_matched += 1
+                else:
+                    self._flow_begun[fid] = label
+                    self._cap_flows(self._flow_begun)
+            elif ph == "f":
+                fid = str(ev.get("id"))
+                if self._flow_begun.pop(fid, None) is not None:
+                    self._flows_matched += 1
+                else:
+                    self._flow_ended[fid] = label
+                    self._cap_flows(self._flow_ended)
+
+    # ---- windowing -----------------------------------------------------
+    def close_window(self) -> dict:
+        """Verdict over everything fed since the last close, report-
+        shaped so ``check_thresholds`` applies verbatim.  Stragglers
+        are cumulative (lag is a property of the whole run so far);
+        fractions/stalls are this window's."""
+        self.n_windows += 1
+        out: dict = {"window": self.n_windows, "ranks": {},
+                     "stalls": [], "warnings": []}
+        boundaries: Dict[str, List[float]] = {}
+        for label, acc in sorted(self.ranks.items()):
+            row = self._close_rank_window(acc)
+            if row is not None:
+                out["ranks"][label] = row
+                for s in row.pop("_stall_rows"):
+                    out["stalls"].append({"rank": label, **s})
+            if acc.boundaries:
+                boundaries[label] = acc.boundaries
+        out["stragglers"] = straggler_summary(boundaries)
+        return _round_floats(out)
+
+    def _close_rank_window(self, acc: _RankAcc) -> Optional[dict]:
+        win_int = {c: merge_intervals(acc.win[c]) for c in _CATS}
+        steps = sorted(acc.win_steps)
+        counters = sorted(acc.win_counters, key=lambda s: s[0])
+        acc.win = {c: [] for c in _CATS}
+        acc.win_steps = []
+        acc.win_counters = []
+
+        # stall trackers run on the stream even when the window is
+        # otherwise idle; overlap is measured against the rank's
+        # retained wait intervals (live + this window)
+        wait_ivs = merge_intervals(acc.live["wait"] + win_int["wait"])
+        stall_rows: List[dict] = []
+        for ts, key, val in counters:
+            tr = acc.trackers.get(key)
+            if tr is None:
+                tr = acc.trackers[key] = StallTracker()
+            w = tr.feed(ts, val)
+            if w is not None and (w[1] - w[0]) / 1e6 >= self.stall_min_s:
+                row = stall_row(key, w, wait_ivs)
+                stall_rows.append(row)
+                acc.stalls.append(row)
+        # a still-open stall alerts NOW, not when it finally drains
+        for key, tr in sorted(acc.trackers.items(),
+                              key=lambda kv: str(kv[0])):
+            if tr.start is not None and tr.last_ts is not None:
+                w = (tr.start, tr.last_ts, tr.max_depth)
+                if (w[1] - w[0]) / 1e6 >= self.stall_min_s:
+                    stall_rows.append(
+                        {**stall_row(key, w, wait_ivs), "ongoing": True}
+                    )
+
+        has_spans = any(win_int.values()) or steps
+        row: Optional[dict] = None
+        if has_spans:
+            all_iv = [iv for c in _CATS for iv in win_int[c]]
+            t0 = min(a for a, _ in all_iv)
+            t1 = max(b for _, b in all_iv)
+            window = max(t1 - t0, 1e-9)
+            busy = merge_intervals(
+                win_int["compute"] + win_int["comm"] + win_int["wait"]
+            )
+            comm_total = total(win_int["comm"])
+            overlap = intersect_total(win_int["comm"], win_int["compute"])
+            durs = sorted(d / 1e6 for _, d in steps)
+            row = {
+                "window_s": window / 1e6,
+                "steps": {
+                    "n": len(durs),
+                    "mean_s": (
+                        sum(durs) / len(durs) if durs else float("nan")
+                    ),
+                    "max_s": durs[-1] if durs else float("nan"),
+                },
+                "fractions": {
+                    "compute": total(win_int["compute"]) / window,
+                    "comm": comm_total / window,
+                    "input_wait": total(win_int["wait"]) / window,
+                    "idle": max(0.0, (window - total(busy)) / window),
+                },
+                "comm_compute_overlap": (
+                    overlap / comm_total if comm_total > 0 else None
+                ),
+            }
+        elif stall_rows:
+            row = {"window_s": 0.0, "steps": {"n": 0}}
+        if row is not None:
+            row["_stall_rows"] = stall_rows
+
+        # fold the window into the cumulative structures
+        for c in _CATS:
+            if win_int[c]:
+                acc.live[c] = merge_intervals(acc.live[c] + win_int[c])
+        self._maybe_freeze(acc)
+        for ts, dur in steps:
+            if acc.step_base is None:
+                acc.step_base = ts
+            if len(acc.boundaries) < self.MAX_STEPS:
+                acc.boundaries.append((ts + dur - acc.step_base) / 1e6)
+                acc.step_durs.append(dur / 1e6)
+            else:
+                acc.steps_capped = True
+        return row
+
+    def _maybe_freeze(self, acc: _RankAcc) -> None:
+        if all(
+            len(acc.live[c]) <= self.MAX_LIVE_INTERVALS for c in _CATS
+        ):
+            return
+        cut = (acc.t_max or 0.0) - 2.0 * max(acc.max_dur, 1.0)
+        if acc.t_frozen is not None and cut <= acc.t_frozen:
+            return
+        before = {}
+        after = {}
+        for c in _CATS:
+            before[c], after[c] = split_intervals(acc.live[c], cut)
+        acc.frozen_overlap += intersect_total(
+            before["comm"], before["compute"]
+        )
+        acc.frozen_busy += total(
+            merge_intervals(
+                before["compute"] + before["comm"] + before["wait"]
+            )
+        )
+        for c in _CATS:
+            acc.frozen[c] += total(before[c])
+            acc.live[c] = after[c]
+        acc.t_frozen = cut
+
+    # ---- whole-stream report ------------------------------------------
+    def cumulative(self) -> dict:
+        """The stream so far as ONE report, shaped like ``analyze()``'s
+        (the replay of a finished run reproduces the post-mortem
+        verdict — golden-tested)."""
+        report: dict = {"ranks": {}, "warnings": []}
+        boundaries: Dict[str, List[float]] = {}
+        for label, acc in sorted(self.ranks.items()):
+            report["ranks"][label] = self._cumulative_rank(acc)
+            if acc.n_spans == 0:
+                report["warnings"].append(
+                    f"{label}: empty stream — no spans received from "
+                    "this rank yet"
+                )
+            if acc.dropped:
+                report["warnings"].append(
+                    f"{label}: {acc.dropped} events dropped before "
+                    "shipping — fractions undercount the dropped window"
+                )
+            if acc.steps_capped:
+                report["warnings"].append(
+                    f"{label}: step history capped at {self.MAX_STEPS} "
+                    "boundaries — straggler lag reflects the capped "
+                    "prefix"
+                )
+            if acc.boundaries:
+                boundaries[label] = acc.boundaries
+        report["stragglers"] = straggler_summary(boundaries)
+        unmatched_begin = sorted(self._flow_begun)
+        report["flows"] = {
+            "begun": self._flows_matched + len(self._flow_begun),
+            "ended": self._flows_matched + len(self._flow_ended),
+            "matched": self._flows_matched,
+            "unmatched_begin": unmatched_begin,
+            "unmatched_end": sorted(self._flow_ended),
+        }
+        if unmatched_begin:
+            report["warnings"].append(
+                f"{len(unmatched_begin)} flow(s) begun but never "
+                "drained — frames in flight, lost, or the receiver's "
+                "stream is behind"
+            )
+        stalls = []
+        for label, acc in sorted(self.ranks.items()):
+            for s in acc.stalls:
+                stalls.append({"rank": label, **s})
+            # ongoing stalls are visible in the lifetime report too
+            wait_ivs = acc.live["wait"]
+            for key, tr in sorted(acc.trackers.items(),
+                                  key=lambda kv: str(kv[0])):
+                if tr.start is not None and tr.last_ts is not None:
+                    w = (tr.start, tr.last_ts, tr.max_depth)
+                    if (w[1] - w[0]) / 1e6 >= self.stall_min_s:
+                        stalls.append(
+                            {"rank": label,
+                             **stall_row(key, w, wait_ivs)}
+                        )
+        report["stalls"] = stalls
+        return _round_floats(report)
+
+    def _cumulative_rank(self, acc: _RankAcc) -> dict:
+        if acc.n_spans == 0:
+            return {
+                "empty": True,
+                "n_spans": 0,
+                "sample_rate": acc.sample_rate,
+                "dropped": acc.dropped,
+            }
+        window = max((acc.t_max or 0.0) - (acc.t_min or 0.0), 1e-9)
+        totals = {
+            c: acc.frozen[c] + total(acc.live[c]) for c in _CATS
+        }
+        busy = acc.frozen_busy + total(
+            merge_intervals(
+                acc.live["compute"] + acc.live["comm"] + acc.live["wait"]
+            )
+        )
+        overlap = acc.frozen_overlap + intersect_total(
+            acc.live["comm"], acc.live["compute"]
+        )
+        durs = sorted(acc.step_durs)
+        out = {
+            "empty": False,
+            "n_spans": acc.n_spans,
+            "window_s": window / 1e6,
+            "steps": {
+                "n": len(durs),
+                "total_s": sum(durs),
+                "mean_s": (
+                    sum(durs) / len(durs) if durs else float("nan")
+                ),
+                "p50_s": _nearest_rank(durs, 50),
+                "max_s": durs[-1] if durs else float("nan"),
+            },
+            "fractions": {
+                "compute": totals["compute"] / window,
+                "comm": totals["comm"] / window,
+                "input_wait": totals["wait"] / window,
+                "idle": max(0.0, (window - busy) / window),
+            },
+            "comm_compute_overlap": (
+                overlap / totals["comm"] if totals["comm"] > 0 else None
+            ),
+            "sample_rate": acc.sample_rate,
+            "dropped": acc.dropped,
+        }
+        if acc.sample_rate > 1:
+            fr = out["fractions"]
+            ci = {
+                "compute": sampled_ci95(
+                    fr["compute"], acc.counts["compute"], acc.sample_rate
+                ),
+                "comm": sampled_ci95(
+                    fr["comm"], acc.counts["comm"], acc.sample_rate
+                ),
+                "input_wait": sampled_ci95(
+                    fr["input_wait"], acc.counts["wait"], acc.sample_rate
+                ),
+            }
+            ci["idle"] = min(
+                1.0,
+                (ci["compute"] ** 2 + ci["comm"] ** 2
+                 + ci["input_wait"] ** 2) ** 0.5,
+            )
+            out["fractions_ci95"] = ci
+            if out["comm_compute_overlap"] is not None:
+                out["comm_compute_overlap_ci95"] = sampled_ci95(
+                    1.0,
+                    min(acc.counts["compute"], acc.counts["comm"]),
+                    acc.sample_rate,
+                )
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -397,39 +1084,7 @@ def analyze(
         if b:
             boundaries[r["label"]] = b
 
-    # ---- stragglers: lag behind the fastest rank at each common step
-    # boundary, measured per-rank-relative (clock-offset-free)
-    straggler: dict = {
-        "n_common_steps": 0,
-        "per_rank": {},
-        "straggler_rank": None,
-        "max_straggler_index": 0.0,
-    }
-    if len(boundaries) >= 2:
-        n_common = min(len(b) for b in boundaries.values())
-        straggler["n_common_steps"] = n_common
-        fastest = [
-            min(b[k] for b in boundaries.values()) for k in range(n_common)
-        ]
-        worst = (None, 0.0)
-        for label, b in sorted(boundaries.items()):
-            lags = [b[k] - fastest[k] for k in range(n_common)]
-            final = lags[-1] if lags else 0.0
-            idx = (
-                final / fastest[-1]
-                if n_common and fastest[-1] > 0
-                else 0.0
-            )
-            straggler["per_rank"][label] = {
-                "final_lag_s": final,
-                "mean_lag_s": sum(lags) / len(lags) if lags else 0.0,
-                "straggler_index": idx,
-            }
-            if idx > worst[1]:
-                worst = (label, idx)
-        straggler["straggler_rank"] = worst[0]
-        straggler["max_straggler_index"] = worst[1]
-    report["stragglers"] = straggler
+    report["stragglers"] = straggler_summary(boundaries)
 
     # ---- cross-rank flow accounting: arrows must close
     begun: Dict[str, str] = {}
@@ -483,38 +1138,62 @@ def _round_floats(doc: Any, ndigits: int = 9) -> Any:
 # verdicts
 # ---------------------------------------------------------------------------
 
-def check_thresholds(
+def check_thresholds_structured(
     report: dict,
     max_straggler: Optional[float] = None,
     min_overlap: Optional[float] = None,
     max_stall_s: Optional[float] = None,
     max_ttft_p99_s: Optional[float] = None,
     max_tpot_p99_s: Optional[float] = None,
-) -> List[str]:
-    """Violations as human strings (empty = healthy).  The CLI exits
-    nonzero when any fire — the perf-regression gate."""
-    v: List[str] = []
+) -> List[dict]:
+    """Violations as structured rows (``rule``/``rank``/``value``/
+    ``threshold``/``message``) — what the live watchdog turns into
+    alerts and the CLI renders as strings.  Empty = healthy.
+
+    Fractions from a SAMPLED trace carry error bars
+    (``*_ci95``); threshold comparisons use the conservative end of
+    the interval — the gate only fires when the violation survives
+    the sampling uncertainty, so a 1-in-N trace cannot flake CI."""
+    v: List[dict] = []
     idx = report.get("stragglers", {}).get("max_straggler_index", 0.0)
     if max_straggler is not None and idx > max_straggler:
         who = report["stragglers"].get("straggler_rank")
-        v.append(
-            f"straggler index {idx:.4f} > {max_straggler} (rank {who})"
-        )
+        v.append({
+            "rule": "max_straggler", "rank": who, "value": idx,
+            "threshold": max_straggler,
+            "message": (
+                f"straggler index {idx:.4f} > {max_straggler} "
+                f"(rank {who})"
+            ),
+        })
     if min_overlap is not None:
         for label, ra in sorted(report.get("ranks", {}).items()):
             ov = ra.get("comm_compute_overlap")
-            if ov is not None and ov < min_overlap:
-                v.append(
-                    f"{label}: comm/compute overlap {ov:.4f} < "
-                    f"{min_overlap}"
-                )
+            if ov is None:
+                continue
+            ci = float(ra.get("comm_compute_overlap_ci95") or 0.0)
+            if ov + ci < min_overlap:
+                note = f" (+{ci:.4f} ci95)" if ci else ""
+                v.append({
+                    "rule": "min_overlap", "rank": label, "value": ov,
+                    "threshold": min_overlap,
+                    "message": (
+                        f"{label}: comm/compute overlap {ov:.4f}"
+                        f"{note} < {min_overlap}"
+                    ),
+                })
     if max_stall_s is not None:
         for s in report.get("stalls", []):
             if s["duration_s"] > max_stall_s:
-                v.append(
-                    f"{s['rank']}: inbox stall {s['duration_s']:.4f}s > "
-                    f"{max_stall_s}s (depth {s['max_depth']:.0f})"
-                )
+                v.append({
+                    "rule": "max_stall_s", "rank": s.get("rank"),
+                    "value": s["duration_s"], "threshold": max_stall_s,
+                    "message": (
+                        f"{s['rank']}: inbox stall "
+                        f"{s['duration_s']:.4f}s > {max_stall_s}s "
+                        f"(depth {s['max_depth']:.0f})"
+                    ),
+                })
     serving = report.get("serving", {})
     for key, bound in (
         ("ttft", max_ttft_p99_s),
@@ -523,8 +1202,21 @@ def check_thresholds(
         if bound is not None and key in serving:
             p99 = serving[key]["p99_s"]
             if p99 > bound:
-                v.append(f"{key} p99 {p99:.4f}s > {bound}s")
+                v.append({
+                    "rule": f"max_{key}_p99_s", "rank": None,
+                    "value": p99, "threshold": bound,
+                    "message": f"{key} p99 {p99:.4f}s > {bound}s",
+                })
     return v
+
+
+def check_thresholds(report: dict, **thresholds) -> List[str]:
+    """Violations as human strings (empty = healthy).  The CLI exits
+    nonzero when any fire — the perf-regression gate."""
+    return [
+        row["message"]
+        for row in check_thresholds_structured(report, **thresholds)
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -557,6 +1249,19 @@ def render_report(report: dict) -> str:
             f"{_pct(fr['input_wait']):>7} {_pct(fr['idle']):>7} "
             f"{_pct(ra['comm_compute_overlap']):>8}"
         )
+        ci = ra.get("fractions_ci95")
+        if ci:
+            ov_ci = ra.get("comm_compute_overlap_ci95")
+            lines.append(
+                f"{'':<14} sampled 1/{ra.get('sample_rate', '?')}: "
+                f"±{100 * ci['compute']:.1f}% compute, "
+                f"±{100 * ci['comm']:.1f}% comm"
+                + (
+                    f", ±{100 * ov_ci:.1f}% overlap (95% ci)"
+                    if ov_ci is not None
+                    else " (95% ci)"
+                )
+            )
     sg = report.get("stragglers", {})
     if sg.get("per_rank"):
         lines.append("")

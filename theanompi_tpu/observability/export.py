@@ -15,6 +15,10 @@ putting it on a routable interface is an explicit operator decision
 - ``/metrics.json`` — the registry snapshot as JSON
 - ``/trace``        — Chrome trace JSON of the current buffer
 - ``/flight``       — the flight rings as JSON
+- ``/health``       — the live watchdog's verdict (JSON; HTTP 200 when
+  ``status`` is ok, 503 on alert — so a plain HTTP probe IS the SLO
+  check).  Backed by whatever ``set_health_provider`` registered (the
+  live aggregator); without one it reports ``{"status": "unknown"}``.
 """
 
 from __future__ import annotations
@@ -28,6 +32,16 @@ from typing import Dict, Optional
 from theanompi_tpu.observability.flight import get_flight_recorder
 from theanompi_tpu.observability.metrics import get_registry
 from theanompi_tpu.observability.trace import get_tracer
+
+# the /health document source — the live aggregator registers its
+# Aggregator.health here (observability/live.py); None = no live plane
+_health_provider = None
+
+
+def set_health_provider(fn) -> None:
+    """Register (or clear, with None) the callable behind ``/health``."""
+    global _health_provider
+    _health_provider = fn
 
 
 def obs_dir(path: Optional[str] = None) -> str:
@@ -127,6 +141,21 @@ class _Handler(BaseHTTPRequestHandler):
                     get_flight_recorder().snapshot(), default=str
                 ).encode("utf-8")
                 self._send(body, "application/json")
+            elif path == "/health":
+                doc = (
+                    _health_provider()
+                    if _health_provider is not None
+                    else {"status": "unknown",
+                          "note": "no live aggregator in this process"}
+                )
+                # the HTTP code carries the verdict: a load balancer or
+                # uptime probe needs no JSON parsing to act on it
+                code = 503 if doc.get("status") == "alert" else 200
+                self._send(
+                    json.dumps(doc, default=str).encode("utf-8"),
+                    "application/json",
+                    code,
+                )
             else:
                 self._send(b"not found\n", "text/plain", 404)
         except Exception as e:  # a scrape error must not kill the server

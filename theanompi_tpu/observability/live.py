@@ -1,0 +1,854 @@
+"""Live telemetry plane — streaming cross-rank aggregation + watchdog.
+
+Everything before this module was post-mortem: spans buffer in
+process, ``dump_all`` writes files at exit, the doctor reads them
+afterwards.  The async rules' whole value claim (workers stay
+productive despite stragglers — arXiv:1605.08325) and the comm/compute
+balance that decides scaling (arXiv:1810.11112) are only observable
+*during* the run, so this module turns the doctor from an autopsy into
+a monitor:
+
+- **TelemetryShipper** — each rank periodically builds a compact
+  telemetry frame (metrics-snapshot counter deltas, recent span
+  digests, inbox-depth samples, flow watermarks, SLO histogram bucket
+  deltas) and ships it to the rank-0 aggregator: in-process by direct
+  call, or cross-process over the existing
+  ``parallel/transport.py`` request/reply channel.  An EMPTY frame is
+  still a heartbeat — silence is the signal the aggregator watches
+  for.
+- **Aggregator** — rank 0's rolling cluster view: per-rank liveness
+  (seq watermarks, last-heartbeat age), an online doctor
+  (``analysis.StreamingDoctor`` — the offline fraction/straggler/stall
+  math restated incrementally), per-window serving SLO percentiles
+  from shipped histogram deltas, and cross-rank clock offsets
+  estimated from the min one-way delay of flow send/recv pairs.
+- **Watchdog** — evaluates the SAME threshold flags the offline doctor
+  gates CI with (``--max-straggler``/``--min-overlap``/
+  ``--max-stall-s``/TTFT/TPOT SLOs) against every window and raises
+  structured alerts: a log line, a ``watchdog_alerts_total{rule}``
+  counter, a bounded alert history, and the ``/health`` endpoint on
+  the existing localhost server.  A rank missing N heartbeats becomes
+  a ``heartbeat`` alert — never a crash: dead ranks degrade the
+  verdict, they do not take the monitor down with them.
+
+``LiveMonitor`` wires the three together in one process (the threaded
+async drivers, bench), and ``maybe_start_from_env`` is the one-line
+hook the worker loops call — inert (returns ``None``, registers
+nothing) unless ``THEANOMPI_LIVE=1`` or ``THEANOMPI_LIVE_AGG`` is set,
+so the hot paths stay instrumentation-free by default.
+
+The CLI face is ``python -m theanompi_tpu.observability watch``
+(live aggregator or ``--replay`` over recorded raw traces).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from theanompi_tpu.observability import analysis
+from theanompi_tpu.observability.metrics import (
+    counter_deltas,
+    flatten_counters,
+    get_registry,
+    sum_histogram_buckets,
+)
+from theanompi_tpu.observability.trace import get_tracer
+
+FRAME_KIND = "tmpi_telemetry"
+FRAME_VERSION = 1
+
+_REG = get_registry()
+_ALERTS = _REG.counter(
+    "watchdog_alerts_total", "live watchdog alerts raised (rule label)"
+)
+_FRAMES = _REG.counter(
+    "telemetry_frames_total",
+    "telemetry frames (direction label: shipped/ingested/failed)",
+)
+
+# the doctor threshold flags the watchdog understands — one spelling
+# shared with analysis.check_thresholds_structured and the CLI
+WATCHDOG_RULES = (
+    "max_straggler",
+    "min_overlap",
+    "max_stall_s",
+    "max_ttft_p99_s",
+    "max_tpot_p99_s",
+)
+
+
+def _seq_f64(vals):
+    """Pack a float list for the wire: ONE numpy leaf instead of one
+    header record per scalar (frames stay a few KB).  Falls back to the
+    plain list when numpy is unavailable — the in-process path never
+    needs it."""
+    try:
+        import numpy as np
+
+        return np.asarray(vals, dtype=np.float64)
+    except ImportError:  # pragma: no cover - numpy is baked in here
+        return list(vals)
+
+
+def _floats(vals) -> List[float]:
+    return [float(v) for v in vals]
+
+
+# ---------------------------------------------------------------------------
+# sender side
+# ---------------------------------------------------------------------------
+
+class TelemetryShipper:
+    """One rank's telemetry sender.
+
+    Registers bounded sinks on the tracer (span digests + inbox-depth
+    samples + flow watermarks — only touched while tracing is enabled,
+    so the disabled-span fast path is unchanged), snapshots the metrics
+    registry each beat for counter deltas and SLO histogram deltas, and
+    ships one frame per ``period_s`` to the aggregator: ``aggregator``
+    (direct in-process ``ingest``) or ``address`` (the transport's
+    request/reply channel).  Ship failures are counted and retried next
+    beat — telemetry must never take the training loop down.
+    """
+
+    MAX_SPANS = 8192   # per-frame digest bounds; overflow is counted,
+    MAX_POINTS = 4096  # never silent (the doctor warns on drops)
+
+    def __init__(
+        self,
+        rank_label: str,
+        aggregator: Optional["Aggregator"] = None,
+        address: Optional[Tuple[str, int]] = None,
+        period_s: float = 1.0,
+        registry=None,
+        tracer=None,
+    ):
+        if (aggregator is None) == (address is None):
+            raise ValueError(
+                "pass exactly one of aggregator= (in-process) or "
+                "address= (TCP)"
+            )
+        self.rank_label = str(rank_label)
+        self.aggregator = aggregator
+        self.address = tuple(address) if address else None
+        self.period_s = float(period_s)
+        self.registry = registry or get_registry()
+        self.tracer = tracer or get_tracer()
+        self.seq = 0
+        self.shipped = 0
+        self.failed = 0
+        self._lock = threading.Lock()
+        self._spans: List[Tuple[str, float, float]] = []
+        self._points: List[tuple] = []
+        self._digest_dropped = 0
+        self._base_counters: Dict[str, float] = {}
+        self._base_hist: Dict[str, List[int]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- tracer sinks (called per event while tracing is enabled) ----
+    def _span_sink(self, ev: dict) -> None:
+        if threading.current_thread() is self._thread:
+            return  # shipping cost must not pollute the shipped view
+        with self._lock:
+            if len(self._spans) >= self.MAX_SPANS:
+                self._digest_dropped += 1
+                return
+            self._spans.append(
+                (ev.get("name", ""), float(ev.get("ts", 0.0)),
+                 float(ev.get("dur", 0.0)))
+            )
+
+    def _point_sink(self, ev: dict) -> None:
+        if threading.current_thread() is self._thread:
+            return
+        ph = ev.get("ph")
+        if ph == "C":
+            if ev.get("name") != "inbox_depth":
+                return
+            args = ev.get("args") or {}
+            row = ("C", float(ev.get("ts", 0.0)),
+                   str(args.get("rank")), float(args.get("value", 0.0)))
+        elif ph in ("s", "f"):
+            row = (ph, float(ev.get("ts", 0.0)), str(ev.get("id")), 0.0)
+        else:
+            return
+        with self._lock:
+            if len(self._points) >= self.MAX_POINTS:
+                self._digest_dropped += 1
+                return
+            self._points.append(row)
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> "TelemetryShipper":
+        if self._thread is not None:
+            return self
+        if self._span_sink not in self.tracer.span_sinks:
+            self.tracer.span_sinks.append(self._span_sink)
+        if self._point_sink not in self.tracer.point_sinks:
+            self.tracer.point_sinks.append(self._point_sink)
+        # baseline BOTH delta sources at start: without this the first
+        # frame would ship lifetime totals (warmup requests, earlier
+        # runs in-process) as if they happened in the first window
+        snap = self.registry.snapshot()
+        self._base_counters = flatten_counters(snap)
+        for metric, _key in analysis.SLO_HISTOGRAMS:
+            agg = sum_histogram_buckets(snap.get(metric))
+            if agg is not None:
+                self._base_hist[metric] = agg[1]
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"TelemetryShipper-{self.rank_label}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        """Final flush + sink deregistration; returns ship stats."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(10.0, 4 * self.period_s))
+            self._thread = None
+        for sinks, fn in (
+            (self.tracer.span_sinks, self._span_sink),
+            (self.tracer.point_sinks, self._point_sink),
+        ):
+            try:
+                sinks.remove(fn)
+            except ValueError:
+                pass
+        self.flush()  # whatever accumulated after the last beat
+        return {"shipped": self.shipped, "failed": self.failed,
+                "seq": self.seq}
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.flush()
+
+    # ---- frame building ----------------------------------------------
+    def flush(self) -> bool:
+        """Build and ship one frame NOW (the periodic thread's body;
+        tests drive it directly)."""
+        frame = self.build_frame()
+        try:
+            if self.aggregator is not None:
+                self.aggregator.ingest(frame)
+            else:
+                from theanompi_tpu.parallel.transport import request
+
+                request(self.address, frame, timeout=30.0)
+            self.shipped += 1
+            _FRAMES.inc(direction="shipped")
+            return True
+        except Exception as e:
+            # aggregator down/unreachable: drop the frame, keep
+            # training — the aggregator sees the gap as missed
+            # heartbeats, which is exactly the signal it exists for
+            self.failed += 1
+            _FRAMES.inc(direction="failed")
+            if self.failed in (1, 10, 100):  # log decimated, not never
+                print(
+                    f"[telemetry] ship failed (x{self.failed}): "
+                    f"{type(e).__name__}: {e}",
+                    flush=True,
+                )
+            return False
+
+    def build_frame(self) -> dict:
+        with self._lock:
+            spans, self._spans = self._spans, []
+            points, self._points = self._points, []
+            dropped, self._digest_dropped = self._digest_dropped, 0
+        names: List[str] = []
+        name_idx: Dict[str, int] = {}
+        idx, ts, dur = [], [], []
+        for n, t0, d in spans:
+            i = name_idx.get(n)
+            if i is None:
+                i = name_idx[n] = len(names)
+                names.append(n)
+            idx.append(float(i))
+            ts.append(t0)
+            dur.append(d)
+        ctr_ts, ctr_key, ctr_val = [], [], []
+        fb_id, fb_ts, fe_id, fe_ts = [], [], [], []
+        for row in points:
+            kind, t0, key, val = row
+            if kind == "C":
+                ctr_ts.append(t0)
+                ctr_key.append(key)
+                ctr_val.append(val)
+            elif kind == "s":
+                fb_id.append(key)
+                fb_ts.append(t0)
+            else:
+                fe_id.append(key)
+                fe_ts.append(t0)
+        snap = self.registry.snapshot()
+        flat = flatten_counters(snap)
+        deltas = counter_deltas(flat, self._base_counters)
+        self._base_counters = flat
+        hist: Dict[str, dict] = {}
+        for metric, _key in analysis.SLO_HISTOGRAMS:
+            agg = sum_histogram_buckets(snap.get(metric))
+            if agg is None:
+                continue
+            bounds, counts, _count = agg
+            base = self._base_hist.get(metric) or [0] * len(counts)
+            delta = [c - b for c, b in zip(counts, base)]
+            self._base_hist[metric] = counts
+            if any(d > 0 for d in delta):
+                hist[metric] = {
+                    "bounds": _seq_f64(bounds),
+                    "counts": _seq_f64(delta),
+                }
+        self.seq += 1
+        return {
+            "kind": FRAME_KIND,
+            "v": FRAME_VERSION,
+            "rank": self.rank_label,
+            "seq": self.seq,
+            "t_wall": time.time(),
+            "sample_rate": int(getattr(self.tracer, "sample_rate", 1)),
+            "dropped": dropped,
+            "spans": {
+                "names": names,
+                "idx": _seq_f64(idx),
+                "ts": _seq_f64(ts),
+                "dur": _seq_f64(dur),
+            },
+            "ctrs": {
+                "ts": _seq_f64(ctr_ts),
+                "key": ctr_key,
+                "val": _seq_f64(ctr_val),
+            },
+            "flows": {
+                "b_id": fb_id,
+                "b_ts": _seq_f64(fb_ts),
+                "f_id": fe_id,
+                "f_ts": _seq_f64(fe_ts),
+            },
+            "counters": deltas,
+            "hist": hist,
+        }
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Per-window SLO evaluation → structured alerts.
+
+    ``thresholds`` uses the doctor's flag spellings (``max_straggler``,
+    ``min_overlap``, ``max_stall_s``, ``max_ttft_p99_s``,
+    ``max_tpot_p99_s``); unknown keys are rejected loudly — a typoed
+    rule that silently never fires is the worst failure mode a
+    watchdog can have.  Each alert is logged, counted in
+    ``watchdog_alerts_total{rule}``, and retained in a bounded history
+    for ``/health``.
+    """
+
+    def __init__(
+        self,
+        thresholds: Optional[dict] = None,
+        log=None,
+        history: int = 256,
+    ):
+        thresholds = {
+            k: v for k, v in (thresholds or {}).items() if v is not None
+        }
+        unknown = set(thresholds) - set(WATCHDOG_RULES)
+        if unknown:
+            raise ValueError(
+                f"unknown watchdog rule(s) {sorted(unknown)}; known: "
+                f"{list(WATCHDOG_RULES)}"
+            )
+        self.thresholds = thresholds
+        self.alerts_total = 0
+        self.history: deque = deque(maxlen=int(history))
+        self._log = log if log is not None else (
+            lambda line: print(line, flush=True)
+        )
+
+    def evaluate(
+        self, window_report: dict, dead_ranks: Tuple[str, ...] = ()
+    ) -> List[dict]:
+        """One window's verdict in, structured alerts out (and logged/
+        counted).  ``dead_ranks`` become ``heartbeat`` alerts — the one
+        rule the report itself cannot carry, because a dead rank ships
+        nothing."""
+        rows = analysis.check_thresholds_structured(
+            window_report, **self.thresholds
+        )
+        for label in dead_ranks:
+            rows.append({
+                "rule": "heartbeat",
+                "rank": label,
+                "value": None,
+                "threshold": None,
+                "message": (
+                    f"{label}: no telemetry frame within the heartbeat "
+                    "timeout — rank dead, wedged, or partitioned"
+                ),
+            })
+        window = window_report.get("window")
+        t_wall = window_report.get("t_wall") or time.time()
+        for row in rows:
+            row["window"] = window
+            row["t_wall"] = round(float(t_wall), 3)
+            _ALERTS.inc(rule=row["rule"])
+            self._log(
+                f"[watchdog] ALERT window={window} rule={row['rule']} "
+                f"rank={row['rank']} :: {row['message']}"
+            )
+        self.alerts_total += len(rows)
+        self.history.extend(rows)
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# aggregator (rank 0)
+# ---------------------------------------------------------------------------
+
+class _RankView:
+    __slots__ = ("seq", "frames", "last_wall", "last_seen_mono",
+                 "lost_frames", "counters")
+
+    def __init__(self):
+        self.seq = 0
+        self.frames = 0
+        self.last_wall = 0.0
+        self.last_seen_mono = 0.0
+        self.lost_frames = 0  # seq gaps: frames built but never landed
+        self.counters: Dict[str, float] = {}
+
+
+class Aggregator:
+    """The rolling cluster view + online doctor + watchdog host.
+
+    ``ingest`` absorbs one telemetry frame (thread-safe — the TCP
+    server channel and an in-process shipper may both call it);
+    ``close_window`` emits the per-window verdict and runs the
+    watchdog.  Missing ranks never raise: a rank is declared dead when
+    its last frame is older than ``heartbeat_miss × period_s`` and
+    comes back silently when frames resume.
+    """
+
+    def __init__(
+        self,
+        thresholds: Optional[dict] = None,
+        period_s: float = 1.0,
+        heartbeat_miss: int = 3,
+        stall_min_s: float = 0.0,
+        expect_ranks: Optional[List[str]] = None,
+        log=None,
+        clock=time.monotonic,
+    ):
+        self.period_s = float(period_s)
+        self.heartbeat_miss = int(heartbeat_miss)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.doctor = analysis.StreamingDoctor(stall_min_s=stall_min_s)
+        self.watchdog = Watchdog(thresholds, log=log)
+        self.view: Dict[str, _RankView] = {}
+        self._started_mono = clock()
+        for label in expect_ranks or ():
+            self.view[str(label)] = _RankView()
+        # per-window SLO histogram sums (metric -> (bounds, counts))
+        self._win_hist: Dict[str, Tuple[List[float], List[int]]] = {}
+        # clock skew: min one-way delay per (src_label, dst_label) from
+        # flow halves; either half can arrive first (frames interleave
+        # across ranks), so both await their counterpart symmetrically
+        self._edges: Dict[Tuple[str, str], float] = {}
+        self._open_begins: Dict[str, Tuple[str, float]] = {}
+        self._open_ends: Dict[str, Tuple[str, float]] = {}
+        self.windows: List[dict] = []
+        self.max_windows_kept = 64
+        self.n_windows = 0
+
+    # ---- ingest ------------------------------------------------------
+    def ingest(self, frame: dict) -> dict:
+        """One frame in, one ack out.  Malformed frames are refused in
+        the reply, never raised — a bad frame must not kill the
+        serve thread under every OTHER rank."""
+        if not isinstance(frame, dict) or frame.get("kind") != FRAME_KIND:
+            _FRAMES.inc(direction="refused")
+            return {"ok": False, "err": "not a telemetry frame"}
+        label = str(frame.get("rank"))
+        with self._lock:
+            rv = self.view.get(label)
+            if rv is None:
+                rv = self.view[label] = _RankView()
+            seq = int(frame.get("seq", 0))
+            if rv.seq and seq > rv.seq + 1:
+                rv.lost_frames += seq - rv.seq - 1
+            rv.seq = max(rv.seq, seq)
+            rv.frames += 1
+            rv.last_wall = float(frame.get("t_wall", 0.0))
+            rv.last_seen_mono = self.clock()
+            for k, v in (frame.get("counters") or {}).items():
+                rv.counters[k] = rv.counters.get(k, 0.0) + float(v)
+            self._ingest_events(label, frame)
+            self._ingest_hist(frame)
+        _FRAMES.inc(direction="ingested")
+        return {"ok": True, "seq": seq}
+
+    def _ingest_events(self, label: str, frame: dict) -> None:
+        events: List[dict] = []
+        sp = frame.get("spans") or {}
+        names = list(sp.get("names") or [])
+        for i, t0, d in zip(
+            _floats(sp.get("idx", ())),
+            _floats(sp.get("ts", ())),
+            _floats(sp.get("dur", ())),
+        ):
+            ni = int(i)
+            if 0 <= ni < len(names):
+                events.append(
+                    {"ph": "X", "name": names[ni], "ts": t0, "dur": d}
+                )
+        ct = frame.get("ctrs") or {}
+        for t0, key, val in zip(
+            _floats(ct.get("ts", ())),
+            list(ct.get("key") or []),
+            _floats(ct.get("val", ())),
+        ):
+            events.append({
+                "ph": "C", "name": "inbox_depth", "ts": t0,
+                "args": {"rank": key, "value": val},
+            })
+        fl = frame.get("flows") or {}
+        for fid, t0 in zip(list(fl.get("b_id") or []),
+                           _floats(fl.get("b_ts", ()))):
+            events.append({"ph": "s", "id": fid, "ts": t0})
+            end = self._open_ends.pop(str(fid), None)
+            if end is not None:
+                self._flow_edge(label, t0, end[0], end[1])
+            else:
+                self._open_begins[str(fid)] = (label, t0)
+                self._cap_open(self._open_begins)
+        for fid, t0 in zip(list(fl.get("f_id") or []),
+                           _floats(fl.get("f_ts", ()))):
+            events.append({"ph": "f", "id": fid, "ts": t0})
+            src = self._open_begins.pop(str(fid), None)
+            if src is not None:
+                self._flow_edge(src[0], src[1], label, t0)
+            else:
+                self._open_ends[str(fid)] = (label, t0)
+                self._cap_open(self._open_ends)
+        self.doctor.feed(
+            label,
+            events,
+            sample_rate=int(frame.get("sample_rate", 1) or 1),
+            dropped=int(frame.get("dropped", 0) or 0),
+        )
+
+    @staticmethod
+    def _cap_open(half: Dict[str, Tuple[str, float]]) -> None:
+        while len(half) > 100_000:
+            del half[next(iter(half))]
+
+    def _flow_edge(
+        self, src: str, ts_begin: float, dst: str, ts_end: float
+    ) -> None:
+        if src == dst:
+            return  # an in-process round trip says nothing about skew
+        key = (src, dst)
+        d = ts_end - ts_begin
+        if key not in self._edges or d < self._edges[key]:
+            self._edges[key] = d
+
+    def _ingest_hist(self, frame: dict) -> None:
+        for metric, doc in (frame.get("hist") or {}).items():
+            bounds = _floats(doc.get("bounds", ()))
+            counts = [int(c) for c in _floats(doc.get("counts", ()))]
+            cur = self._win_hist.get(metric)
+            if cur is None or cur[0] != bounds:
+                self._win_hist[metric] = (bounds, counts)
+            else:
+                self._win_hist[metric] = (
+                    bounds, [a + b for a, b in zip(cur[1], counts)]
+                )
+
+    # ---- windowing ---------------------------------------------------
+    def dead_ranks(self, now: Optional[float] = None) -> List[str]:
+        now = self.clock() if now is None else now
+        timeout = self.heartbeat_miss * self.period_s
+        out = []
+        for label, rv in sorted(self.view.items()):
+            ref = rv.last_seen_mono or self._started_mono
+            if now - ref > timeout:
+                out.append(label)
+        return out
+
+    def close_window(self, now: Optional[float] = None) -> dict:
+        """Close the current observation window: per-window doctor
+        verdict + serving SLO percentiles + clock offsets + watchdog
+        alerts.  Returns the verdict (also retained in ``windows``)."""
+        with self._lock:
+            verdict = self.doctor.close_window()
+            verdict["t_wall"] = round(time.time(), 3)
+            serving = {}
+            for metric, key in analysis.SLO_HISTOGRAMS:
+                agg = self._win_hist.get(metric)
+                if not agg:
+                    continue
+                bounds, counts = agg
+                count = sum(counts)
+                if count > 0:
+                    serving[key] = analysis.percentiles_from_buckets(
+                        bounds, counts, count
+                    )
+            self._win_hist = {}
+            if serving:
+                verdict["serving"] = serving
+            if self._edges:
+                offsets, unaligned = analysis.offsets_from_edges(
+                    self._edges, list(self.view)
+                )
+                verdict["clock_offsets_us"] = {
+                    k: round(v, 3) for k, v in sorted(offsets.items())
+                }
+                if unaligned:
+                    verdict["clock_unaligned"] = unaligned
+            dead = self.dead_ranks(now)
+            if dead:
+                verdict["dead_ranks"] = dead
+        # watchdog outside the ingest lock: its log hook is arbitrary
+        # user code and must not stall frame ingestion
+        verdict["alerts"] = self.watchdog.evaluate(
+            verdict, dead_ranks=tuple(dead if dead else ())
+        )
+        with self._lock:
+            self.n_windows = verdict["window"]
+            self.windows.append(verdict)
+            del self.windows[: -self.max_windows_kept]
+        return verdict
+
+    # ---- surfaces ----------------------------------------------------
+    def health(self) -> dict:
+        """The ``/health`` document: liveness per rank, last-window
+        verdict state, recent alerts — what an operator (or a probe)
+        polls instead of tailing logs."""
+        with self._lock:
+            now = self.clock()
+            dead = set(self.dead_ranks(now))
+            ranks = {
+                label: {
+                    "seq": rv.seq,
+                    "frames": rv.frames,
+                    "lost_frames": rv.lost_frames,
+                    "age_s": round(
+                        now - (rv.last_seen_mono or self._started_mono), 3
+                    ),
+                    "alive": label not in dead,
+                }
+                for label, rv in sorted(self.view.items())
+            }
+            last = self.windows[-1] if self.windows else None
+            recent = list(self.watchdog.history)[-20:]
+            status = "no-data"
+            if last is not None:
+                status = "alert" if (last["alerts"] or dead) else "ok"
+            elif dead:
+                status = "alert"
+            doc = {
+                "status": status,
+                "windows": self.n_windows,
+                "alerts_total": self.watchdog.alerts_total,
+                "thresholds": dict(self.watchdog.thresholds),
+                "ranks": ranks,
+                "recent_alerts": recent,
+            }
+            if last is not None:
+                doc["last_window"] = last
+            return doc
+
+    def summary(self) -> dict:
+        """End-of-run roll-up (what bench attaches to its JSON)."""
+        with self._lock:
+            return {
+                "windows": self.n_windows,
+                "alerts_total": self.watchdog.alerts_total,
+                "alerts": list(self.watchdog.history)[-20:],
+                "ranks": {
+                    label: {"frames": rv.frames, "seq": rv.seq,
+                            "lost_frames": rv.lost_frames}
+                    for label, rv in sorted(self.view.items())
+                },
+                "cumulative": self.doctor.cumulative(),
+            }
+
+    def serve(self, port: int):
+        """Expose ``ingest`` on the transport's request/reply channel
+        (the cross-process wiring; returns the TcpServerChannel)."""
+        from theanompi_tpu.parallel.transport import TcpServerChannel
+
+        return TcpServerChannel(port, self.ingest)
+
+
+# ---------------------------------------------------------------------------
+# one-process convenience + worker hook
+# ---------------------------------------------------------------------------
+
+class LiveMonitor:
+    """Aggregator + local shipper + window timer in one process —
+    what the threaded drivers and bench run.  Optionally serves the
+    aggregator on a TCP port (other processes ship into it) and
+    ``/health`` via the observability HTTP server."""
+
+    def __init__(
+        self,
+        rank_label: str = "rank0",
+        thresholds: Optional[dict] = None,
+        period_s: float = 1.0,
+        window_s: float = 5.0,
+        heartbeat_miss: int = 3,
+        port: Optional[int] = None,
+        health_port: Optional[int] = None,
+        log=None,
+    ):
+        from theanompi_tpu import observability as obs
+
+        obs.enable_tracing()  # the frames are span digests — need spans
+        self.window_s = float(window_s)
+        self.aggregator = Aggregator(
+            thresholds=thresholds,
+            period_s=period_s,
+            heartbeat_miss=heartbeat_miss,
+            log=log,
+        )
+        self.shipper = TelemetryShipper(
+            rank_label, aggregator=self.aggregator, period_s=period_s
+        )
+        self._channel = (
+            self.aggregator.serve(port) if port is not None else None
+        )
+        self._health_server = None
+        if health_port is not None:
+            from theanompi_tpu.observability import export
+
+            export.set_health_provider(self.aggregator.health)
+            self._health_server = export.ObservabilityServer(
+                port=health_port
+            ).start()
+        self._stop = threading.Event()
+        self._timer = threading.Thread(
+            target=self._run_windows, name="LiveMonitor-windows",
+            daemon=True,
+        )
+        self.shipper.start()
+        self._timer.start()
+
+    def _run_windows(self) -> None:
+        while not self._stop.wait(self.window_s):
+            try:
+                self.aggregator.close_window()
+            except Exception as e:  # the monitor must never kill a run
+                print(
+                    f"[live] window close failed: "
+                    f"{type(e).__name__}: {e}",
+                    flush=True,
+                )
+
+    def stop(self) -> dict:
+        """Final beat + final window; returns the run summary."""
+        self._stop.set()
+        self._timer.join(timeout=max(10.0, 2 * self.window_s))
+        ship_stats = self.shipper.stop()
+        self.aggregator.close_window()
+        if self._channel is not None:
+            self._channel.close()
+        if self._health_server is not None:
+            self._health_server.close()
+            from theanompi_tpu.observability import export
+
+            export.set_health_provider(None)
+        out = self.aggregator.summary()
+        out["shipper"] = ship_stats
+        return out
+
+
+class _RemoteShipperHandle:
+    """The worker-side handle when the aggregator lives elsewhere."""
+
+    def __init__(self, shipper: TelemetryShipper):
+        from theanompi_tpu import observability as obs
+
+        obs.enable_tracing()
+        self.shipper = shipper.start()
+
+    def stop(self) -> dict:
+        return {"shipper": self.shipper.stop()}
+
+
+def thresholds_from_env(env=os.environ) -> dict:
+    """``THEANOMPI_LIVE_RULES="max_straggler=0.5,min_overlap=0.1"`` →
+    a watchdog thresholds dict (unknown rules rejected by Watchdog)."""
+    raw = (env.get("THEANOMPI_LIVE_RULES") or "").strip()
+    out: dict = {}
+    if not raw:
+        return out
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        try:
+            out[key.strip()] = float(val)
+        except ValueError:
+            raise ValueError(
+                f"THEANOMPI_LIVE_RULES: cannot parse {part!r} "
+                "(want rule=float)"
+            )
+    return out
+
+
+def maybe_start_from_env(rank_label: str, env=os.environ):
+    """The one-line worker hook.  Inert unless configured:
+
+    - ``THEANOMPI_LIVE=1`` — run the whole plane in this process
+      (aggregator + shipper + watchdog); optional
+      ``THEANOMPI_LIVE_PORT`` serves the aggregator for other
+      processes and ``THEANOMPI_LIVE_HEALTH_PORT`` serves ``/health``.
+    - ``THEANOMPI_LIVE_AGG=host:port`` — ship this process's frames to
+      an aggregator elsewhere (a ``watch`` CLI, or rank 0 running with
+      ``THEANOMPI_LIVE=1 THEANOMPI_LIVE_PORT=...``).
+
+    Cadence via ``THEANOMPI_LIVE_PERIOD_S`` (heartbeat, default 1.0)
+    and ``THEANOMPI_LIVE_WINDOW_S`` (verdict window, default 5.0);
+    thresholds via ``THEANOMPI_LIVE_RULES``.  Returns an object with
+    ``.stop() -> summary`` or ``None``.
+    """
+    agg_addr = (env.get("THEANOMPI_LIVE_AGG") or "").strip()
+    live = env.get("THEANOMPI_LIVE") == "1"
+    if not live and not agg_addr:
+        return None
+    period = float(env.get("THEANOMPI_LIVE_PERIOD_S") or 1.0)
+    if agg_addr:
+        host, _, port = agg_addr.rpartition(":")
+        return _RemoteShipperHandle(
+            TelemetryShipper(
+                rank_label,
+                address=(host or "127.0.0.1", int(port)),
+                period_s=period,
+            )
+        )
+    window = float(env.get("THEANOMPI_LIVE_WINDOW_S") or 5.0)
+    port = env.get("THEANOMPI_LIVE_PORT")
+    health_port = env.get("THEANOMPI_LIVE_HEALTH_PORT")
+    return LiveMonitor(
+        rank_label,
+        thresholds=thresholds_from_env(env),
+        period_s=period,
+        window_s=window,
+        port=int(port) if port else None,
+        health_port=int(health_port) if health_port else None,
+    )

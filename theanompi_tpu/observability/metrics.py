@@ -381,6 +381,29 @@ def _fmt_val(v: float) -> str:
     return repr(v)
 
 
+def sum_histogram_buckets(doc: Optional[dict]):
+    """Sum a snapshot histogram doc's label series into ONE
+    ``(bounds, counts, count)`` aggregation (counts has the trailing
+    +Inf slot) — the shared reduction under every consumer that works
+    from snapshot/bucket data: the offline doctor's serving
+    percentiles and the live plane's per-window SLO deltas.  ``None``
+    when the doc is missing, not a histogram, or empty."""
+    if not doc or doc.get("kind") != "histogram":
+        return None
+    bounds = [float(b) for b in doc.get("bucket_bounds") or []]
+    agg = [0] * (len(bounds) + 1)
+    count = 0
+    for row in doc.get("series", []):
+        buckets = row.get("buckets") or {}
+        for i, b in enumerate(bounds):
+            agg[i] += int(buckets.get(repr(b), 0))
+        agg[-1] += int(buckets.get("+Inf", 0))
+        count += int(row.get("count", 0))
+    if count == 0:
+        return None
+    return bounds, agg, count
+
+
 def flatten_counters(snapshot: dict) -> Dict[str, float]:
     """Counter series of a registry ``snapshot()`` flattened to
     ``name{label="v",...} -> value`` (Prometheus-style keys).  The

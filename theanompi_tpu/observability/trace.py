@@ -134,6 +134,10 @@ class Tracer:
         # called with each finished span dict (flight recorder feed);
         # invoked outside the buffer lock
         self.span_sinks: List[Callable[[dict], None]] = []
+        # called with each point event (flow begin/end, counter sample)
+        # — the live telemetry shipper's feed; same outside-the-lock
+        # contract as span_sinks
+        self.point_sinks: List[Callable[[dict], None]] = []
 
     # ---- lifecycle -----------------------------------------------------
     def enable(
@@ -240,6 +244,8 @@ class Tracer:
         with self._lock:
             ev["tid"] = self._track_locked()
             self._push_locked(ev)
+        for sink in self.point_sinks:
+            sink(ev)
 
     def flow_begin(
         self, name: str, flow_id: str, args: Optional[dict] = None
@@ -435,7 +441,7 @@ def raw_to_chrome(lines) -> dict:
     }
 
 
-def merge_raw_traces(named_traces) -> dict:
+def merge_raw_traces(named_traces, align_clocks: bool = True) -> dict:
     """Merge several ``save_raw`` JSONL files into ONE Chrome trace
     document with a distinct, named process track per input — so
     Perfetto opens a multi-worker run as one timeline instead of one
@@ -451,12 +457,21 @@ def merge_raw_traces(named_traces) -> dict:
     ``process_name``, falling back to the label.  Unknown/corrupt lines
     are skipped (a crash-truncated rank must not sink the merge); the
     summed per-file drop counts are surfaced in ``otherData``.
+
+    **Clock alignment** (``align_clocks=True``): per-rank tracer
+    epochs are unsynchronized, so naively merged tracks render with an
+    arbitrary horizontal skew.  When the inputs share matched flow
+    send/recv pairs, the per-rank offsets recovered from their minimum
+    one-way delays (``analysis.estimate_clock_offsets``) are
+    subtracted from each file's timestamps, lining the tracks up on
+    the anchor rank's clock; the applied offsets land in
+    ``otherData["clock_offsets_us"]``.  A rank that shares NO flows
+    with the rest cannot be aligned — it keeps its raw clock and gets
+    a visible ``unaligned_clock`` warning row instead of a silently
+    skewed track.  With no cross-file flows at all the merge is
+    byte-identical to the unaligned one.
     """
-    meta: List[dict] = []
-    events: List[dict] = []
-    used_pids: set = set()
-    total_dropped = 0
-    empty_inputs: List[str] = []
+    parsed: List[tuple] = []
     for label, lines in named_traces:
         header: Optional[dict] = None
         file_events: List[dict] = []
@@ -472,6 +487,37 @@ def merge_raw_traces(named_traces) -> dict:
                 header = doc
             elif "ph" in doc:
                 file_events.append(doc)
+        parsed.append((label, header, file_events))
+
+    offsets: dict = {}
+    unaligned: List[str] = []
+    if align_clocks and len(parsed) > 1:
+        from theanompi_tpu.observability import analysis
+
+        flow_views = []
+        for label, _header, file_events in parsed:
+            fb: dict = {}
+            fe: dict = {}
+            for ev in file_events:
+                ph = ev.get("ph")
+                if ph == "s":
+                    fb[str(ev.get("id"))] = float(ev.get("ts", 0.0))
+                elif ph == "f":
+                    fe[str(ev.get("id"))] = float(ev.get("ts", 0.0))
+            flow_views.append(
+                {"label": label, "flow_begin": fb, "flow_end": fe}
+            )
+        if analysis.flow_delay_edges(flow_views):
+            offsets, unaligned = analysis.estimate_clock_offsets(
+                flow_views
+            )
+
+    meta: List[dict] = []
+    events: List[dict] = []
+    used_pids: set = set()
+    total_dropped = 0
+    empty_inputs: List[str] = []
+    for label, header, file_events in parsed:
         src_pid = int(
             (header or {}).get(
                 "pid",
@@ -527,7 +573,31 @@ def merge_raw_traces(named_traces) -> dict:
                 }
             )
             continue
+        off = offsets.get(label, 0.0)
+        if offsets and label in unaligned:
+            # alignment happened for the others but this rank shares no
+            # flows with them: its track keeps the raw clock — make the
+            # skew VISIBLE instead of letting the viewer imply ordering
+            events.append(
+                {
+                    "ph": "i",
+                    "name": "unaligned_clock",
+                    "s": "p",
+                    "ts": 0,
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {
+                        "label": label,
+                        "warning": "no flow pairs connect this rank to "
+                        "the aligned set — its timestamps keep the raw "
+                        "per-process clock and may be skewed vs the "
+                        "other tracks",
+                    },
+                }
+            )
         for ev in file_events:
+            if off:
+                ev = {**ev, "ts": round(float(ev.get("ts", 0.0)) - off, 3)}
             if pid != src_pid or "pid" not in ev:
                 ev = {**ev, "pid": pid}
             events.append(ev)
@@ -538,6 +608,12 @@ def merge_raw_traces(named_traces) -> dict:
     }
     if empty_inputs:
         other["empty_inputs"] = empty_inputs
+    if offsets:
+        other["clock_offsets_us"] = {
+            label: round(off, 3) for label, off in sorted(offsets.items())
+        }
+        if unaligned:
+            other["clock_unaligned"] = unaligned
     return {
         "traceEvents": meta + events,
         "displayTimeUnit": "ms",

@@ -431,6 +431,7 @@ class _AsyncDriverBase:
             else None
         )
         self._wd = None
+        self._telemetry = None
         self.workers: List[_AsyncWorkerBase] = []
         self.result_model = None
 
@@ -457,6 +458,15 @@ class _AsyncDriverBase:
         """Hook: join background duties after workers exit."""
 
     def run(self):
+        # live telemetry (observability/live.py): the threaded drivers
+        # are one process sharing one tracer, so ONE shipper covers
+        # every worker thread (per-thread tracks ride the span digests).
+        # Inert unless THEANOMPI_LIVE=1 / THEANOMPI_LIVE_AGG is set.
+        from theanompi_tpu.observability import live as obs_live
+
+        self._telemetry = obs_live.maybe_start_from_env(
+            f"{type(self).__name__.replace('_Driver', '').lower()}_driver"
+        )
         self._build_workers()
         if self._watchdog_cfg is not None:
             from theanompi_tpu.runtime.fault import Watchdog
@@ -506,6 +516,22 @@ class _AsyncDriverBase:
             srv_rec = getattr(self, "server_recorder", None)
             if srv_rec is not None:
                 srv_rec.close()
+            if self._telemetry is not None:
+                try:
+                    summary = self._telemetry.stop()
+                    alerts = summary.get("alerts_total")
+                    if alerts is not None and self.verbose:
+                        print(
+                            f"[live] {summary.get('windows', 0)} "
+                            f"window(s), {alerts} watchdog alert(s)",
+                            flush=True,
+                        )
+                except Exception as te:  # telemetry never masks the run
+                    print(
+                        f"telemetry stop failed: "
+                        f"{type(te).__name__}: {te}",
+                        flush=True,
+                    )
 
 
 class EASGD_Driver(_AsyncDriverBase):

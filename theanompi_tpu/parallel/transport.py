@@ -70,6 +70,34 @@ _HANDLER_LAT = _REG.histogram(
 
 _FLOW_TAG = "__tmpi_flow__"
 _MBOX_SEQ = itertools.count()  # in-process flow ids (one trace, one space)
+# request/reply flow ids: one counter per client process; the source
+# identity is the tracer's process track (the SPMD rank under
+# set_process), so a merged trace draws client→server arrows for
+# serving RPCs and EASGD exchange legs just like mailbox frames
+_RPC_SEQ = itertools.count()
+
+
+def _flow_wrap(kind_seq, src: int, msg: Any):
+    """(flow id, wrapped msg) when tracing is on, else (None, msg)."""
+    if not obs.get_tracer().enabled:
+        return None, msg
+    seq = next(kind_seq)
+    return f"rpc:{src}:{seq}", (_FLOW_TAG, src, seq, msg)
+
+
+def _flow_unwrap(msg: Any, prefix: str = "rpc"):
+    """Strip a flow envelope (ALWAYS — a frame sent while the peer was
+    tracing must decode cleanly here even with tracing off), closing
+    the sender's arrow when one was carried.  Returns (src, msg)."""
+    if (
+        isinstance(msg, tuple)
+        and len(msg) == 4
+        and msg[0] == _FLOW_TAG
+    ):
+        _, src, seq, msg = msg
+        obs.flow_end(f"{prefix}_msg", f"{prefix}:{int(src)}:{int(seq)}")
+        return int(src), msg
+    return None, msg
 
 
 class _FlowMsg:
@@ -420,6 +448,12 @@ class TcpServerChannel:
                     req = recv_frame(conn)
                     _BYTES_RECV.inc(len(req), transport="server")
                     msg = self._wire.decode(req)
+                    # close the client's rpc flow arrow (carried inside
+                    # the frame, like TcpMailbox's) — ALWAYS unwrapped,
+                    # traced or not, so mixed fleets decode cleanly
+                    src, msg = _flow_unwrap(msg)
+                    if src is not None:
+                        sp.set(src=src)
                     # handler latency separated from the I/O legs: the
                     # histogram answers "is the server math slow" while
                     # the span answers "is the exchange slow"
@@ -430,9 +464,12 @@ class TcpServerChannel:
                         _HANDLER_LAT.observe(time.perf_counter() - t0)
                     out = self._wire.encode(reply)
                     sp.set(bytes_in=len(req), bytes_out=len(out))
+                    # count BEFORE the reply write: a client that holds
+                    # the reply must observe the increment (asserting
+                    # after-write raced the client's decode)
+                    _REQUESTS.inc(transport="server")
                     send_frame(conn, out)
                     _BYTES_SENT.inc(len(out), transport="server")
-                    _REQUESTS.inc(transport="server")
             except (ConnectionError, OSError):
                 _REQ_ERRORS.inc(transport="server", stage="io")
                 continue
@@ -463,10 +500,19 @@ def request(address: Tuple[str, int], msg: Any, timeout: float = 600.0) -> Any:
     # server's turnaround + reply decode) — the client-visible cost of
     # one EASGD exchange leg; errors are counted before they propagate
     with obs.span("tcp_request") as sp:
+        # stamp the frame with a (src, seq) rpc flow id — src is the
+        # tracer's process track (the rank under set_process) — so the
+        # merged trace draws a client→server arrow into the tcp_serve
+        # slice and doctor flow accounting covers serving RPCs
+        fid, msg = _flow_wrap(_RPC_SEQ, obs.get_tracer().pid, msg)
         try:
             payload = wire.encode(msg)
             with socket.create_connection(tuple(address), timeout=timeout) as s:
                 send_frame(s, payload)
+                # arrow tail only after the write lands — a refused
+                # connection must not leave a one-sided arrow
+                if fid is not None:
+                    obs.flow_begin("rpc_msg", fid, {"dst": list(address)})
                 _BYTES_SENT.inc(len(payload), transport="request")
                 reply = recv_frame(s)
         except (ConnectionError, OSError, socket.timeout):

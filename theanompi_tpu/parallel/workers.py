@@ -221,6 +221,16 @@ class BSP_Worker:
 
     def run(self) -> None:
         model, rec = self.model, self.recorder
+        # live telemetry heartbeat (observability/live.py): inert unless
+        # THEANOMPI_LIVE=1 / THEANOMPI_LIVE_AGG is set.  Started BEFORE
+        # compile on purpose — a wedged compile then shows up on the
+        # aggregator as a rank that heartbeats but never steps, which is
+        # a different (and correctly diagnosed) failure than a dead rank
+        from theanompi_tpu.observability import live as obs_live
+
+        telemetry = obs_live.maybe_start_from_env(
+            f"rank{self.process_index}"
+        )
         if self.resume and self.checkpoint_dir:
             from theanompi_tpu.utils import checkpoint as ckpt
 
@@ -366,6 +376,22 @@ class BSP_Worker:
                     except Exception as ce:
                         print(f"async checkpoint error during crash "
                               f"drain: {type(ce).__name__}: {ce}", flush=True)
+            if telemetry is not None:
+                try:
+                    summary = telemetry.stop()
+                    alerts = summary.get("alerts_total")
+                    if alerts is not None and self.process_index == 0:
+                        print(
+                            f"[live] {summary.get('windows', 0)} "
+                            f"window(s), {alerts} watchdog alert(s)",
+                            flush=True,
+                        )
+                except Exception as te:  # telemetry never masks the run
+                    print(
+                        f"telemetry stop failed: "
+                        f"{type(te).__name__}: {te}",
+                        flush=True,
+                    )
         if self.checkpoint_dir:
             rec.save()
         model.cleanup()

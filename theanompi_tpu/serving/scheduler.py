@@ -237,13 +237,27 @@ class ContinuousBatchingScheduler:
 
     def run(self, max_ticks: int = 100_000) -> Dict[str, List[int]]:
         """Drive ``step()`` until queue and slots drain.  Returns
-        ``finished`` (id → generated tokens)."""
+        ``finished`` (id → generated tokens).
+
+        SLO feed: under ``THEANOMPI_LIVE=1``/``THEANOMPI_LIVE_AGG``
+        (observability/live.py) the run heartbeats telemetry frames —
+        the TTFT/TPOT histogram deltas this scheduler's metrics write
+        become per-window percentiles on the aggregator, so the
+        watchdog's ``max_ttft_p99_s``/``max_tpot_p99_s`` rules watch a
+        serving run the way ``max_straggler`` watches training."""
+        from theanompi_tpu.observability import live as obs_live
+
+        telemetry = obs_live.maybe_start_from_env("serve")
         ticks = 0
-        while self.queue or self._active.any():
-            ticks += 1
-            if ticks > max_ticks:
-                raise RuntimeError(
-                    f"scheduler did not drain within {max_ticks} ticks"
-                )
-            self.step()
+        try:
+            while self.queue or self._active.any():
+                ticks += 1
+                if ticks > max_ticks:
+                    raise RuntimeError(
+                        f"scheduler did not drain within {max_ticks} ticks"
+                    )
+                self.step()
+        finally:
+            if telemetry is not None:
+                telemetry.stop()
         return self.finished
