@@ -3,19 +3,39 @@
 
 The training bench (bench.py) answers "how fast does a step train";
 this answers the serving-side questions: sustained generated tokens/s
-through the continuous-batching scheduler, and request latency (TTFT /
+through the continuous-batching scheduler, request latency (TTFT /
 TPOT, p50/p99) under a synthetic open-loop Poisson arrival process —
 the standard serving-bench shape (requests arrive on their own clock;
-a backed-up server cannot slow the arrivals down).
+a backed-up server cannot slow the arrivals down) — and, since the
+paged KV cache landed, two capacity questions the contiguous engine
+could not even pose:
+
+- **long-tail concurrency** — at EQUAL cache memory, how many
+  sequences can each engine hold simultaneously under a mixed-length
+  (mostly-short, occasionally-huge) burst?  The contiguous engine
+  reserves ``max_len`` rows per slot, so its answer is its slot
+  count; the paged engine allocates blocks for what a sequence can
+  actually need.  ``detail.paged.long_tail.concurrency_ratio`` is the
+  measured paged/contiguous peak-concurrency ratio (the perf-gate
+  serve leg requires >= 2).
+- **prefix reuse** — a shared system prompt is prefilled once and its
+  immutable blocks refcounted across requests.
+  ``detail.paged.prefix`` records the measured hit rate and the
+  prefilled-token count with reuse vs. the no-reuse baseline (the
+  gate requires hit_rate > 0 and fewer prefilled tokens).
 
 Protocol:
 - ``TransformerLM`` at the flagship serve config (rehearsal shrinks it,
   same code path — the bench.py CPU-rehearsal discipline, VERDICT r3
   #2), fresh-initialized params (throughput does not depend on weight
   values; loader round-trips are covered by tests/test_serving.py).
-- Arrivals: exponential inter-arrival gaps at ``arrival_rate_rps``,
-  prompt lengths uniform over the engine's bucket range, fixed
-  ``max_new_tokens``.
+- Headline workload: exponential inter-arrival gaps at
+  ``arrival_rate_rps``, prompt lengths uniform over the engine's
+  bucket range, fixed ``max_new_tokens`` — driven through the PAGED
+  engine (``THEANOMPI_BENCH_SERVE_ENGINE=contiguous`` to flip back).
+- Long-tail workload knob: ``long_tail_frac_long`` controls the
+  fraction of near-``max_len`` prompts in the burst (default 0.25 —
+  raise it to stress block churn, lower it to stress lane count).
 - Drive loop: submit every request whose arrival time has passed, then
   one scheduler tick; repeat until drained.  Wall-clock is real (the
   engine really runs); arrival times are pre-drawn from a seeded RNG so
@@ -76,12 +96,70 @@ _KNOBS_REAL = dict(
     d_model=512, n_heads=8, n_layers=8, vocab_size=4096, seq_len=1024,
     n_slots=8, max_len=1024, n_requests=64, arrival_rate_rps=16.0,
     max_new_tokens=32, prompt_lo=16, prompt_hi=256,
+    # paged geometry: lanes beyond the contiguous slot count are the
+    # point — memory is bounded by blocks, not lanes
+    block_size=32, paged_slots=32, prefill_chunk=256,
+    # long-tail burst: mixed lengths at equal cache memory
+    long_tail_requests=48, long_tail_new_tokens=8, long_tail_frac_long=0.25,
+    # shared-system-prompt workload
+    prefix_requests=16, prefix_len=128, prefix_tail=16,
+    prefix_new_tokens=8,
 )
 _KNOBS_REHEARSAL = dict(
     d_model=32, n_heads=4, n_layers=2, vocab_size=64, seq_len=64,
     n_slots=2, max_len=64, n_requests=6, arrival_rate_rps=50.0,
     max_new_tokens=4, prompt_lo=2, prompt_hi=8,
+    block_size=8, paged_slots=8, prefill_chunk=16,
+    long_tail_requests=12, long_tail_new_tokens=2, long_tail_frac_long=0.25,
+    prefix_requests=6, prefix_len=24, prefix_tail=4,
+    prefix_new_tokens=2,
 )
+
+
+def _drive_open_loop(sched, Request, prompts, arrivals, max_new):
+    """The open-loop Poisson drive: submit what has arrived, tick."""
+    t0 = time.perf_counter()
+    n = len(prompts)
+    submitted = 0
+    while submitted < n or sched.queue or sched.n_active:
+        now = time.perf_counter() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            sched.submit(Request(
+                id=f"req{submitted}", prompt=prompts[submitted],
+                max_new_tokens=max_new,
+            ))
+            submitted += 1
+        if sched.queue or sched.n_active:
+            sched.step()
+        elif submitted < n:
+            time.sleep(min(0.005, max(0.0, arrivals[submitted] - now)))
+    return time.perf_counter() - t0
+
+
+def _drive_burst(sched, Request, prompts, max_new, tag):
+    """Everything arrives at t=0 — the concurrency probe."""
+    for j, p in enumerate(prompts):
+        sched.submit(Request(id=f"{tag}{j}", prompt=list(p),
+                             max_new_tokens=max_new))
+    sched.run()
+    return sched.stats
+
+
+def _long_tail_prompts(rng, knobs):
+    """Mixed-length burst: mostly short prompts, a long tail near
+    max_len — the workload shape that wastes contiguous slot memory."""
+    lo, n = knobs["prompt_lo"], knobs["long_tail_requests"]
+    new = knobs["long_tail_new_tokens"]
+    long_len = knobs["max_len"] - new  # as long as a lane can hold
+    short_hi = max(lo + 1, knobs["prompt_hi"] // 2)
+    out = []
+    for j in range(n):
+        if rng.rand() < knobs["long_tail_frac_long"]:
+            size = long_len
+        else:
+            size = rng.randint(lo, short_hi + 1)
+        out.append(rng.randint(0, knobs["vocab_size"], size=size).tolist())
+    return out
 
 
 def main():
@@ -90,7 +168,8 @@ def main():
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
     # same attribution contract as bench.py: the BENCH_serve line
     # carries trace-export paths + a metrics snapshot (TTFT/TPOT
-    # histograms, slot/queue gauges, prefill-bucket counters)
+    # histograms, slot/queue gauges, prefill-bucket counters,
+    # block-pool occupancy, prefix hit counters)
     from theanompi_tpu import observability as observability
 
     observability.enable_tracing()
@@ -105,7 +184,8 @@ def main():
     from theanompi_tpu.models.transformer import TransformerLM
     from theanompi_tpu.runtime.recorder import Recorder
     from theanompi_tpu.serving import (
-        ContinuousBatchingScheduler, Request, ServingEngine, ServingMetrics,
+        ContinuousBatchingScheduler, PagedServingEngine, Request,
+        ServingEngine, ServingMetrics,
     )
 
     cfg = dict(
@@ -115,9 +195,25 @@ def main():
         n_synth_val=1, comm_probe=False, print_freq=10_000,
     )
     model = TransformerLM(config=cfg)
-    engine = ServingEngine(
-        model, n_slots=knobs["n_slots"], max_len=knobs["max_len"]
+    engine_kind = (
+        os.environ.get("THEANOMPI_BENCH_SERVE_ENGINE") or "paged"
+    ).lower()
+    # contiguous reference: n_slots worst-case regions = the equal-
+    # memory budget every comparison below is pinned to
+    contiguous_blocks = knobs["n_slots"] * (
+        knobs["max_len"] // knobs["block_size"]
     )
+    if engine_kind == "contiguous":
+        engine = ServingEngine(
+            model, n_slots=knobs["n_slots"], max_len=knobs["max_len"]
+        )
+    else:
+        engine = PagedServingEngine(
+            model, n_slots=knobs["paged_slots"], max_len=knobs["max_len"],
+            block_size=knobs["block_size"],
+            n_blocks=contiguous_blocks + 1,  # +1: reserved trash block
+            prefill_chunk=knobs["prefill_chunk"],
+        )
     rec = Recorder(verbose=False)
     metrics = ServingMetrics(recorder=rec)
     sched = ContinuousBatchingScheduler(engine, metrics=metrics)
@@ -143,30 +239,110 @@ def main():
                         max_new_tokens=min(2, knobs["max_new_tokens"])))
     warm.run()
 
-    t0 = time.perf_counter()
-    submitted = 0
-    while submitted < n or sched.queue or sched.n_active:
-        now = time.perf_counter() - t0
-        while submitted < n and arrivals[submitted] <= now:
-            sched.submit(Request(
-                id=f"req{submitted}", prompt=prompts[submitted],
-                max_new_tokens=knobs["max_new_tokens"],
-            ))
-            submitted += 1
-        if sched.queue or sched.n_active:
-            sched.step()
-        elif submitted < n:
-            time.sleep(min(0.005, max(0.0, arrivals[submitted] - now)))
-    dt = time.perf_counter() - t0
+    dt = _drive_open_loop(sched, Request, prompts, arrivals,
+                          knobs["max_new_tokens"])
+
+    # ---- paged capacity probes (CPU bench acceptance evidence) -------
+    paged_detail = None
+    if engine_kind != "contiguous":
+        wl_rng = np.random.RandomState(1)
+        lt_prompts = _long_tail_prompts(wl_rng, knobs)
+        # paged at EQUAL cache memory: the accounted pool is capped to
+        # exactly the contiguous engine's row budget
+        lt_paged = ContinuousBatchingScheduler(
+            engine, pool=engine.make_pool(contiguous_blocks + 1)
+        )
+        _drive_burst(lt_paged, Request, lt_prompts,
+                     knobs["long_tail_new_tokens"], "lt")
+        # the contiguous engine on the SAME burst (its peak concurrency
+        # is structurally capped at n_slots — measured, not assumed)
+        eng_c = ServingEngine(
+            model, n_slots=knobs["n_slots"], max_len=knobs["max_len"]
+        )
+        lt_contig = ContinuousBatchingScheduler(eng_c)
+        _drive_burst(lt_contig, Request, lt_prompts,
+                     knobs["long_tail_new_tokens"], "lt")
+        ratio = (
+            lt_paged.stats["peak_concurrent"]
+            / max(1, lt_contig.stats["peak_concurrent"])
+        )
+
+        # shared-system-prompt workload: one distinct prefix, many
+        # tails; reuse ON vs OFF over the same requests
+        sys_prompt = wl_rng.randint(
+            0, knobs["vocab_size"], size=knobs["prefix_len"]
+        ).tolist()
+        pf_prompts = [
+            sys_prompt + wl_rng.randint(
+                0, knobs["vocab_size"], size=knobs["prefix_tail"]
+            ).tolist()
+            for _ in range(knobs["prefix_requests"])
+        ]
+        pf_sched = ContinuousBatchingScheduler(engine)
+        for j, p in enumerate(pf_prompts):
+            pf_sched.submit(Request(id=f"pf{j}", prompt=list(p),
+                                    max_new_tokens=knobs["prefix_new_tokens"]))
+            pf_sched.step()  # arrivals spaced a tick apart: reuse is
+            # only possible once the first prefix is resident
+        pf_out = pf_sched.run()
+        no_reuse = ContinuousBatchingScheduler(
+            engine, pool=engine.make_pool()
+        )
+        no_reuse.prefix = None  # same engine, reuse disabled
+        for j, p in enumerate(pf_prompts):
+            no_reuse.submit(Request(id=f"pf{j}", prompt=list(p),
+                                    max_new_tokens=knobs["prefix_new_tokens"]))
+            no_reuse.step()
+        nr_out = no_reuse.run()
+        if pf_out != nr_out:  # reuse must never change results
+            emit(0.0, {"error": "prefix reuse changed outputs"},
+                 measured_now=False)
+            sys.exit(1)
+        total_prompt_tokens = sum(len(p) for p in pf_prompts)
+        paged_detail = {
+            "block_size": knobs["block_size"],
+            "pool_blocks": contiguous_blocks,
+            "prefill_chunk": knobs["prefill_chunk"],
+            "paged_slots": knobs["paged_slots"],
+            "long_tail": {
+                "n_requests": knobs["long_tail_requests"],
+                "frac_long": knobs["long_tail_frac_long"],
+                "equal_memory_rows": contiguous_blocks
+                * knobs["block_size"],
+                "contiguous_slots": knobs["n_slots"],
+                "contiguous_peak_concurrent":
+                    lt_contig.stats["peak_concurrent"],
+                "paged_peak_concurrent":
+                    lt_paged.stats["peak_concurrent"],
+                "concurrency_ratio": round(ratio, 3),
+                "paged_backpressure_events":
+                    lt_paged.stats["backpressure_events"],
+                "paged_pool_peak_used_blocks": lt_paged.pool.peak_used,
+            },
+            "prefix": {
+                "n_requests": knobs["prefix_requests"],
+                "shared_prefix_len": knobs["prefix_len"],
+                "hits": pf_sched.stats["prefix_hits"],
+                "hit_tokens": pf_sched.stats["prefix_hit_tokens"],
+                "hit_rate": round(
+                    pf_sched.stats["prefix_hit_tokens"]
+                    / total_prompt_tokens, 4
+                ),
+                "prefill_tokens": pf_sched.stats["prefill_tokens"],
+                "prefill_tokens_no_reuse":
+                    no_reuse.stats["prefill_tokens"],
+            },
+        }
 
     summary = metrics.summary()
     n_tokens = summary["n_tokens_out"]
     detail = {
         "chips": jax.device_count(),
         "device_kind": jax.devices()[0].device_kind,
+        "engine": engine_kind,
         "model": {k: knobs[k] for k in
                   ("d_model", "n_heads", "n_layers", "vocab_size")},
-        "n_slots": knobs["n_slots"],
+        "n_slots": engine.n_slots,
         "max_len": knobs["max_len"],
         "buckets": list(engine.buckets),
         "workload": {
@@ -188,11 +364,16 @@ def main():
         "percentile_estimators": summary["estimators"],
         "cpu_rehearsal": CPU_REHEARSAL,
     }
+    if "engine_stats" in summary:
+        detail["engine_stats"] = summary["engine_stats"]
+    if paged_detail is not None:
+        detail["paged"] = paged_detail
     try:
         paths = observability.dump_all(prefix="bench_serve_")
         detail["observability"] = {
             "trace_chrome": paths["trace_chrome"],
             "trace_raw": paths["trace_raw"],
+            "metrics_json": paths["metrics_json"],
             "metrics": observability.get_registry().snapshot(),
         }
         if "doctor" in paths:

@@ -32,6 +32,28 @@
 #   PERF_GATE_STRAGGLER_MAX watch --max-straggler for the planted-straggler
 #                           self-test (default 0.25; fixture index ~0.61)
 #
+# Serve leg (the paged-KV serving tier gate):
+#   PERF_GATE_SERVE         1 (default) = run the serving bench, diff its
+#                           BENCH_serve JSON against the previous round,
+#                           gate the dumped trace + metrics snapshot with
+#                           the doctor's serving SLO flags, and check the
+#                           paged-cache acceptance fields (long-tail
+#                           concurrency ratio, prefix reuse).  0 = skip.
+#   PERF_GATE_SERVE_CMD     command producing the BENCH_serve JSON
+#                           (default: THEANOMPI_BENCH_CPU=1 python bench_serve.py)
+#   PERF_GATE_SERVE_JSON    pre-produced serve bench output (skips running)
+#   PERF_GATE_SERVE_BASELINE baseline (default: newest BENCH_serve_r*.json;
+#                           missing baseline = warn + skip the diff, the
+#                           SLO/acceptance checks still run)
+#   PERF_GATE_SERVE_TOLERANCE bench_compare tolerance (default 0.25 — CPU
+#                           rehearsal throughput is noisier than train)
+#   PERF_GATE_MAX_TTFT_P99  doctor --max-ttft-p99-s (default 60: machinery
+#                           exercised; perf rounds on real chips tighten)
+#   PERF_GATE_MAX_TPOT_P99  doctor --max-tpot-p99-s (default 10)
+#   PERF_GATE_SERVE_MIN_CONCURRENCY_RATIO  minimum measured paged-vs-
+#                           contiguous equal-memory concurrency ratio
+#                           under the long-tail workload (default 2.0)
+#
 # Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
 set -euo pipefail
 
@@ -127,5 +149,92 @@ PY
         echo "[perf_gate] live watchdog did NOT fire on the planted straggler" >&2
         exit 1
     fi
+fi
+
+# ---- 5. serve leg: the paged serving tier -----------------------------------
+if [ "${PERF_GATE_SERVE:-1}" = "1" ]; then
+    SERVE_JSON="${PERF_GATE_SERVE_JSON:-}"
+    if [ -z "$SERVE_JSON" ]; then
+        SERVE_JSON="$WORKDIR/bench_serve_new.json"
+        SERVE_CMD="${PERF_GATE_SERVE_CMD:-env THEANOMPI_BENCH_CPU=1 python bench_serve.py}"
+        echo "[perf_gate] running: $SERVE_CMD" >&2
+        if ! sh -c "$SERVE_CMD" > "$SERVE_JSON"; then
+            echo "[perf_gate] serve bench command failed" >&2
+            exit 1
+        fi
+    fi
+    if [ ! -s "$SERVE_JSON" ]; then
+        echo "[perf_gate] no serve bench output at $SERVE_JSON" >&2
+        exit 2
+    fi
+    # 5a. regression diff vs the previous round's BENCH_serve artifact
+    SERVE_BASELINE="${PERF_GATE_SERVE_BASELINE:-}"
+    if [ -z "$SERVE_BASELINE" ]; then
+        SERVE_BASELINE="$(ls -1 BENCH_serve_r*.json 2>/dev/null | sort | tail -n 1 || true)"
+    fi
+    if [ -n "$SERVE_BASELINE" ] && [ -f "$SERVE_BASELINE" ]; then
+        SERVE_TOL="${PERF_GATE_SERVE_TOLERANCE:-0.25}"
+        echo "[perf_gate] bench_compare (serve): $SERVE_BASELINE -> $SERVE_JSON (tolerance $SERVE_TOL)" >&2
+        python scripts/bench_compare.py "$SERVE_BASELINE" "$SERVE_JSON" --tolerance "$SERVE_TOL"
+    else
+        echo "[perf_gate] no BENCH_serve_r*.json baseline — skipping serve diff (first round?)" >&2
+    fi
+    # 5b. serving SLOs through the doctor on the dumped trace + metrics
+    SERVE_PATHS="$(python - "$SERVE_JSON" <<'PY'
+import json, sys
+sys.path.insert(0, "scripts")
+from bench_compare import extract_bench
+doc = extract_bench(open(sys.argv[1]).read()) or {}
+obs = (doc.get("detail") or {}).get("observability") or {}
+if isinstance(obs, dict):
+    print(obs.get("trace_raw", ""))
+    print(obs.get("metrics_json", ""))
+PY
+)"
+    SERVE_TRACE="$(echo "$SERVE_PATHS" | sed -n 1p)"
+    SERVE_METRICS="$(echo "$SERVE_PATHS" | sed -n 2p)"
+    if [ -z "$SERVE_TRACE" ] || [ ! -f "$SERVE_TRACE" ]; then
+        echo "[perf_gate] no serve trace to diagnose (bench ran without observability?)" >&2
+        exit 1
+    fi
+    MAX_TTFT="${PERF_GATE_MAX_TTFT_P99:-60}"
+    MAX_TPOT="${PERF_GATE_MAX_TPOT_P99:-10}"
+    METRICS_ARGS=""
+    if [ -n "$SERVE_METRICS" ] && [ -f "$SERVE_METRICS" ]; then
+        METRICS_ARGS="--metrics $SERVE_METRICS"
+    fi
+    echo "[perf_gate] doctor (serve): $SERVE_TRACE (--max-ttft-p99-s $MAX_TTFT --max-tpot-p99-s $MAX_TPOT)" >&2
+    python -m theanompi_tpu.observability doctor "$SERVE_TRACE" $METRICS_ARGS \
+        --max-ttft-p99-s "$MAX_TTFT" --max-tpot-p99-s "$MAX_TPOT" > /dev/null
+    # 5c. paged-cache acceptance: measured long-tail concurrency at equal
+    # cache memory and prefix reuse doing real work
+    MIN_RATIO="${PERF_GATE_SERVE_MIN_CONCURRENCY_RATIO:-2.0}"
+    echo "[perf_gate] paged acceptance: concurrency ratio >= $MIN_RATIO, prefix reuse > 0" >&2
+    python - "$SERVE_JSON" "$MIN_RATIO" <<'PY'
+import json, sys
+sys.path.insert(0, "scripts")
+from bench_compare import extract_bench
+doc = extract_bench(open(sys.argv[1]).read()) or {}
+min_ratio = float(sys.argv[2])
+paged = (doc.get("detail") or {}).get("paged")
+if not isinstance(paged, dict):
+    sys.exit("[perf_gate] serve bench JSON has no detail.paged section "
+             "(paged engine disabled?)")
+lt, pf = paged.get("long_tail") or {}, paged.get("prefix") or {}
+ratio = lt.get("concurrency_ratio")
+if ratio is None or ratio < min_ratio:
+    sys.exit(f"[perf_gate] PAGED VIOLATION: long-tail concurrency ratio "
+             f"{ratio} < {min_ratio} at equal cache memory")
+hit_rate = pf.get("hit_rate")
+if not hit_rate or hit_rate <= 0:
+    sys.exit(f"[perf_gate] PAGED VIOLATION: prefix hit_rate {hit_rate} "
+             "— shared prompts are not being reused")
+fed, no_reuse = pf.get("prefill_tokens"), pf.get("prefill_tokens_no_reuse")
+if fed is None or no_reuse is None or fed >= no_reuse:
+    sys.exit(f"[perf_gate] PAGED VIOLATION: prefilled tokens with reuse "
+             f"({fed}) not below the no-reuse baseline ({no_reuse})")
+print(f"[perf_gate] paged: ratio {ratio}, prefix hit_rate {hit_rate}, "
+      f"prefill {fed} vs {no_reuse} tokens", file=sys.stderr)
+PY
 fi
 echo "[perf_gate] green" >&2

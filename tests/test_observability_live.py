@@ -502,6 +502,80 @@ def test_watch_cli_replay_missing_input(capsys):
     assert rc == 2
 
 
+# ---------------------------------------------------------------------------
+# per-window verdict persistence (ISSUE 8 satellite: the in-memory ring
+# keeps 64 windows; the JSONL timeline keeps a long run's full history)
+# ---------------------------------------------------------------------------
+
+def test_aggregator_persists_every_window_beyond_memory_ring(tmp_path):
+    """More windows than the ring retains: memory keeps the newest
+    ``max_windows_kept``, the JSONL timeline keeps them ALL, and the
+    persisted rows equal what close_window returned."""
+    path = str(tmp_path / "verdicts.jsonl")
+    agg = live.Aggregator(log=lambda line: None, persist_path=path)
+    agg.max_windows_kept = 4
+    returned = [agg.close_window() for _ in range(10)]
+    with open(path) as f:
+        rows = [json.loads(l) for l in f]
+    assert len(rows) == 10
+    assert [r["window"] for r in rows] == list(range(1, 11))
+    assert len(agg.windows) == 4  # the ring forgot windows 1..6
+    assert rows == json.loads(json.dumps(returned, default=str))
+    assert agg.summary()["verdict_timeline"]["written"] == 10
+
+
+def test_verdict_log_failure_counted_not_raised(tmp_path):
+    """Persistence must never take the monitor down: an unwritable
+    path counts failures and the windows keep closing."""
+    bad = str(tmp_path / "not_a_dir_file")
+    open(bad, "w").close()
+    # a path UNDER a regular file cannot be created
+    agg = live.Aggregator(
+        log=lambda line: None,
+        persist_path=os.path.join(bad, "verdicts.jsonl"),
+    )
+    v = agg.close_window()
+    assert v["window"] == 1
+    assert agg.verdict_log.failed == 1
+    assert agg.summary()["verdict_timeline"]["failed"] == 1
+
+
+def test_watch_cli_replay_persists_timeline(tmp_path, capsys):
+    from theanompi_tpu.observability.__main__ import main as cli_main
+
+    path = str(tmp_path / "timeline.jsonl")
+    rc = cli_main(["watch", "--replay", *FIXTURES, "--json",
+                   "--persist", path])
+    captured = capsys.readouterr()
+    assert rc == 0
+    emitted = [json.loads(l) for l in captured.out.splitlines()]
+    with open(path) as f:
+        persisted = [json.loads(l) for l in f]
+    assert len(persisted) == len(emitted) == 4
+    assert [v["window"] for v in persisted] == [1, 2, 3, 4]
+
+
+def test_maybe_start_from_env_persist_knob(tmp_path, global_tracing):
+    """THEANOMPI_LIVE_PERSIST=<path> routes the live plane's verdicts
+    to the JSONL timeline."""
+    path = str(tmp_path / "live_verdicts.jsonl")
+    handle = live.maybe_start_from_env("rank0", env={
+        "THEANOMPI_LIVE": "1",
+        "THEANOMPI_LIVE_PERIOD_S": "0.05",
+        "THEANOMPI_LIVE_WINDOW_S": "0.1",
+        "THEANOMPI_LIVE_PERSIST": path,
+    })
+    assert handle is not None
+    time.sleep(0.35)
+    summary = handle.stop()
+    assert summary["windows"] >= 1
+    assert summary["verdict_timeline"]["path"] == path
+    with open(path) as f:
+        rows = [json.loads(l) for l in f]
+    assert len(rows) == summary["verdict_timeline"]["written"]
+    assert len(rows) >= 1
+
+
 def test_watch_cli_subprocess_smoke(tmp_path):
     """Tier-1 smoke of the actual CLI entry (the ISSUE asks for the
     watch CLI to be wired in so it can't rot)."""

@@ -46,11 +46,68 @@ def _bench_json(path, value, trace=None, live_alerts=None):
 
 def _run_gate(env_extra):
     env = dict(os.environ)
+    # the serve leg runs a real (CPU-rehearsal) serving bench when no
+    # pre-produced JSON is given — too slow for every smoke test here,
+    # so it is opt-in per test (mirroring PERF_GATE_BENCH_JSON)
+    env.setdefault("PERF_GATE_SERVE", "0")
     env.update(env_extra)
     return subprocess.run(
         ["bash", GATE], capture_output=True, text=True, env=env,
         cwd=REPO, timeout=300,
     )
+
+
+def _serve_json(path, value=150.0, trace=TRACE, metrics=None,
+                ratio=3.5, hit_rate=0.57, fed=72, no_reuse=168):
+    """A BENCH_serve-shaped fixture with the paged acceptance fields."""
+    obs = {"trace_raw": trace}
+    if metrics:
+        obs["metrics_json"] = metrics
+    doc = {
+        "metric": "transformer_serve_tokens_per_sec",
+        "value": value,
+        "unit": "generated tokens/sec",
+        "vs_baseline": 1.0,
+        "measured_now": True,
+        "detail": {
+            "wall_s": 0.2,
+            "ttft_p99_s": 0.02,
+            "tpot_p99_s": 0.01,
+            "observability": obs,
+            "paged": {
+                "long_tail": {"concurrency_ratio": ratio,
+                              "contiguous_slots": 2,
+                              "paged_peak_concurrent": 7},
+                "prefix": {"hit_rate": hit_rate,
+                           "prefill_tokens": fed,
+                           "prefill_tokens_no_reuse": no_reuse},
+            },
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def _metrics_json(path, ttft_s):
+    """A registry-snapshot-shaped metrics file with one TTFT
+    observation landing in the bucket covering ``ttft_s``."""
+    bounds = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0]
+    # per-bucket (non-cumulative) counts: one observation, landing in
+    # the first bucket whose bound covers it (or +Inf)
+    hit = next((str(b) for b in bounds if ttft_s <= b), "+Inf")
+    buckets = {str(b): 0 for b in bounds}
+    buckets["+Inf"] = 0
+    buckets[hit] = 1
+    doc = {"serve_ttft_seconds": {
+        "kind": "histogram", "help": "t", "bucket_bounds": bounds,
+        "series": [{"labels": {}, "buckets": buckets,
+                    "sum": ttft_s, "count": 1}],
+    }}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
 
 
 @pytest.fixture()
@@ -159,3 +216,77 @@ def test_gate_extracts_trace_from_bench_json(fixtures, tmp_path):
     })
     assert r.returncode == 0, r.stderr
     assert "doctor:" in r.stderr and "doctor_rank0" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# serve leg (ISSUE 8 satellite): BENCH_serve diff + SLO gate + paged
+# acceptance checks, smoke-tested on fixture JSONs like the bench leg
+# ---------------------------------------------------------------------------
+
+def _serve_env(fixtures, serve_json, **extra):
+    base, good, _ = fixtures
+    env = {
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_SERVE": "1",
+        "PERF_GATE_SERVE_JSON": serve_json,
+        "PERF_GATE_SERVE_BASELINE": serve_json,
+    }
+    env.update(extra)
+    return env
+
+
+def test_gate_serve_leg_green(fixtures, tmp_path):
+    serve = _serve_json(tmp_path / "serve.json",
+                        metrics=_metrics_json(tmp_path / "m.json", 0.02))
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode == 0, r.stderr
+    assert "paged: ratio 3.5" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_serve_leg_fails_on_ttft_slo(fixtures, tmp_path):
+    """The doctor's --max-ttft-p99-s flag gates the serve leg: a
+    metrics snapshot showing a 20s TTFT p99 violates a 1s SLO."""
+    serve = _serve_json(tmp_path / "serve.json",
+                        metrics=_metrics_json(tmp_path / "m.json", 20.0))
+    r = _run_gate(_serve_env(fixtures, serve,
+                             PERF_GATE_MAX_TTFT_P99="1.0"))
+    assert r.returncode != 0
+    assert "THRESHOLD VIOLATION" in (r.stdout + r.stderr)
+
+
+def test_gate_serve_leg_fails_on_concurrency_ratio(fixtures, tmp_path):
+    """A paged engine that cannot hold >= 2x the contiguous engine's
+    concurrency at equal cache memory fails the acceptance check."""
+    serve = _serve_json(tmp_path / "serve.json", ratio=1.2)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "concurrency ratio" in (r.stdout + r.stderr)
+
+
+def test_gate_serve_leg_fails_without_prefix_reuse(fixtures, tmp_path):
+    serve = _serve_json(tmp_path / "serve.json", hit_rate=0.0)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "prefix" in (r.stdout + r.stderr)
+
+
+def test_gate_serve_leg_fails_when_reuse_saves_nothing(fixtures, tmp_path):
+    serve = _serve_json(tmp_path / "serve.json", fed=168, no_reuse=168)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "no-reuse baseline" in (r.stdout + r.stderr)
+
+
+def test_gate_serve_missing_baseline_skips_diff_not_slos(fixtures, tmp_path):
+    """First round: no BENCH_serve_r*.json yet — the diff is skipped
+    loudly but the SLO and paged acceptance checks still run."""
+    serve = _serve_json(tmp_path / "serve.json")
+    r = _run_gate(_serve_env(
+        fixtures, serve,
+        PERF_GATE_SERVE_BASELINE=str(tmp_path / "missing.json"),
+    ))
+    assert r.returncode == 0, r.stderr
+    assert "skipping serve diff" in r.stderr
+    assert "paged acceptance" in r.stderr

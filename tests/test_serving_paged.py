@@ -1,0 +1,418 @@
+"""Paged KV cache, chunked multi-slot prefill, prefix reuse (ISSUE 8).
+
+Acceptance contracts under test:
+
+- **Golden equivalence**: paged-vs-contiguous greedy decode is
+  token-identical on the same prompts (whole-prompt AND chunked
+  prefill, plain dp AND tp meshes), and the metrics summary exposes
+  identical TTFT/TPOT metric names.
+- **Prefix cache correctness**: hit vs miss produce identical outputs;
+  refcounts drop to zero on finish (only the cache's own references
+  survive, and evicting them empties the pool).
+- **Backpressure**: block-pool exhaustion defers admission cleanly —
+  every request still completes, nothing crashes, and a request that
+  could NEVER fit is refused at submit with a clear error.
+- **Zero recompiles**: slot admission/retirement and table churn never
+  retrace — one decode program ever, one prefill program per chunk
+  bucket (pinned via trace counters).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.transformer import TransformerLM
+from theanompi_tpu.runtime.mesh import DATA_AXIS, make_mesh
+from theanompi_tpu.serving import (
+    ContinuousBatchingScheduler,
+    PagedServingEngine,
+    Request,
+    ServingEngine,
+    ServingMetrics,
+)
+from theanompi_tpu.serving.paging import BlockPool, PrefixCache
+
+CFG = dict(
+    seq_len=64,
+    vocab_size=32,
+    d_model=32,
+    n_heads=4,
+    n_layers=2,
+    batch_size=2,
+    n_synth_train=2,
+    n_synth_val=1,
+    comm_probe=False,
+    print_freq=10_000,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    mesh = make_mesh(devices=jax.devices()[:1])
+    return TransformerLM(config=dict(CFG), mesh=mesh)
+
+
+@pytest.fixture(scope="module")
+def contiguous(model):
+    return ServingEngine(model, n_slots=2, max_len=64, buckets=(8, 16, 64))
+
+
+@pytest.fixture(scope="module")
+def paged(model):
+    return PagedServingEngine(
+        model, n_slots=2, max_len=64, buckets=(8, 16, 64), block_size=8
+    )
+
+
+@pytest.fixture(scope="module")
+def paged_chunked(model):
+    return PagedServingEngine(
+        model, n_slots=4, max_len=64, buckets=(8, 16, 64), block_size=8,
+        prefill_chunk=16,
+    )
+
+
+# ---------------------------------------------------------------------------
+# golden equivalence paged vs contiguous
+# ---------------------------------------------------------------------------
+
+def test_paged_greedy_matches_contiguous(contiguous, paged):
+    """The headline contract: same prompts → identical greedy tokens
+    through block-table gather/scatter as through slot-major slices."""
+    for prompt, n_new in [
+        ([3, 1, 4, 1, 5], 12),          # pads into bucket 8
+        ([7, 2, 9, 4, 4, 1, 0, 30, 2, 2, 11], 8),   # bucket 16
+        (list(range(20)), 33),          # bucket 64, >=32 decode steps
+    ]:
+        want = contiguous.greedy(list(prompt), n_new)
+        got = paged.greedy(list(prompt), n_new)
+        assert got == want, f"paged diverged on prompt {prompt[:4]}..."
+
+
+def test_chunked_prefill_matches_whole_prompt(contiguous, paged_chunked):
+    """A prompt longer than prefill_chunk is fed in block-sized chunks
+    interleaved with ticks — final tokens identical to one-shot."""
+    prompt = list(np.random.RandomState(0).randint(0, 32, size=37))
+    want = contiguous.greedy(list(prompt), 10)
+    got = paged_chunked.greedy(list(prompt), 10)
+    assert got == want
+
+
+def test_paged_prefill_logits_close_to_recompute(model, paged):
+    """Beyond argmax: last-token prefill logits numerically match the
+    training forward (same tolerance as the contiguous test)."""
+    prompt = [7, 2, 9, 4, 4, 1, 0, 30, 2, 2, 11]
+    sched = ContinuousBatchingScheduler(paged)
+    sched.submit(Request(id="x", prompt=list(prompt), max_new_tokens=1))
+    sched._admit_paged()
+    state = sched.state
+    rows = [{"tokens": prompt, "p0": 0, "table": sched.slots[0].blocks}]
+    _, logits = paged.prefill_chunks(model.params, state, rows)
+
+    t = int(model.config.seq_len)
+    buf = np.zeros((1, t), np.int32)
+    buf[0, : len(prompt)] = prompt
+    full, _ = model.net.apply(
+        model.params, model.net_state, jnp.asarray(buf), train=False,
+        rng=None,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full[0, len(prompt) - 1]),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_paged_scheduler_interleaved_matches_serial(paged_chunked):
+    """The continuous-batching determinism contract holds through
+    block tables + chunked prefill: overlapped requests produce the
+    same outputs as each alone."""
+    eng = paged_chunked
+    reqs = [
+        ("a", [1, 2, 3], 7),
+        ("b", list(np.random.RandomState(7).randint(0, 32, size=30)), 5),
+        ("c", [4], 9),
+        ("d", [11, 30, 2, 2], 1),  # finishes at prefill
+        ("e", [5, 5, 5, 5, 5, 5], 4),
+    ]
+    serial = {}
+    for rid, prompt, n in reqs:
+        s = ContinuousBatchingScheduler(eng)
+        s.submit(Request(id=rid, prompt=list(prompt), max_new_tokens=n))
+        serial.update(s.run())
+    sched = ContinuousBatchingScheduler(eng)
+    for rid, prompt, n in reqs:
+        sched.submit(Request(id=rid, prompt=list(prompt), max_new_tokens=n))
+    inter = sched.run()
+    assert inter == serial
+    assert [len(inter[r]) for r, _, n in reqs] == [n for _, _, n in reqs]
+
+
+def test_paged_metric_names_identical(contiguous, paged):
+    """The serving metrics surface is engine-agnostic: a consumer of
+    BENCH_serve/ serve_summary sees the same TTFT/TPOT keys."""
+    outs = []
+    for eng in (contiguous, paged):
+        m = ServingMetrics()
+        s = ContinuousBatchingScheduler(eng, metrics=m)
+        s.submit(Request(id="r", prompt=[1, 2, 3], max_new_tokens=4))
+        s.run()
+        outs.append(m.summary())
+    contig_keys = {k for k in outs[0] if k != "engine_stats"}
+    paged_keys = {k for k in outs[1] if k != "engine_stats"}
+    assert contig_keys == paged_keys
+    for k in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s"):
+        assert k in paged_keys
+    # the paged run additionally reports its reuse/capacity stats
+    assert outs[1]["engine_stats"]["pool_blocks"] > 0
+
+
+def test_paged_on_tp_mesh_matches(model):
+    """Tensor-parallel serving through block tables: heads shard over
+    tp, decode tokens unchanged."""
+    cfg_tp = dict(CFG, tp=2)
+    mesh_tp = TransformerLM.build_mesh(config=cfg_tp)
+    tp_model = TransformerLM(config=cfg_tp, mesh=mesh_tp)
+    want = ServingEngine(tp_model, n_slots=1, max_len=64).greedy(
+        [5, 3, 2], 6
+    )
+    eng = PagedServingEngine(
+        tp_model, n_slots=1, max_len=64, block_size=8
+    )
+    assert eng.greedy([5, 3, 2], 6) == want
+
+
+def test_pool_rows_shard_over_dp():
+    """On a multi-device dp mesh with a divisible block count, the
+    pool's row axis lands sharded over dp (whole blocks per device);
+    indivisible counts fall back to replication, never crash."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh()  # all 8 fake devices on dp
+    model = TransformerLM(config=CFG, mesh=mesh)
+    eng = PagedServingEngine(
+        model, n_slots=8, max_len=64, block_size=8, n_blocks=64
+    )
+    state = eng.init_state()
+    assert eng.pool_spec == P(None, DATA_AXIS, None, None)
+    assert state["k"].sharding.spec == eng.pool_spec
+    eng2 = PagedServingEngine(
+        model, n_slots=8, max_len=64, block_size=8, n_blocks=9
+    )
+    assert eng2.pool_spec == P(None, None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache
+# ---------------------------------------------------------------------------
+
+def test_prefix_hit_outputs_identical_and_counted(contiguous, paged):
+    """A shared system prompt is prefilled once; later requests reuse
+    its blocks — and their outputs are identical to cold prefills."""
+    shared = list(np.random.RandomState(1).randint(0, 32, size=24))
+    sched = ContinuousBatchingScheduler(paged)
+    sched.submit(Request(id="a", prompt=shared + [7], max_new_tokens=6))
+    sched.step()  # a's prefill completes and inserts its full blocks
+    sched.submit(Request(id="b", prompt=shared + [9], max_new_tokens=6))
+    sched.submit(Request(id="c", prompt=shared + [9, 3], max_new_tokens=4))
+    out = sched.run()
+    base = {}
+    for rid, p, n in (("a", shared + [7], 6), ("b", shared + [9], 6),
+                      ("c", shared + [9, 3], 4)):
+        s = ContinuousBatchingScheduler(contiguous)
+        s.submit(Request(id=rid, prompt=list(p), max_new_tokens=n))
+        base.update(s.run())
+    assert out == base
+    # b and c each reused the 3 full shared blocks (24 tokens)
+    assert sched.stats["prefix_hits"] == 2
+    assert sched.stats["prefix_hit_tokens"] == 48
+    # and those tokens were never pushed through prefill again
+    total = sum(len(p) for p in (shared + [7], shared + [9],
+                                 shared + [9, 3]))
+    assert sched.stats["prefill_tokens"] == total - 48
+
+
+def test_refcounts_drop_to_zero_on_finish(model):
+    """After every request finishes, the only live references are the
+    prefix cache's own; with the cache disabled the pool is empty, and
+    evicting the cache empties it too."""
+    eng = PagedServingEngine(
+        model, n_slots=2, max_len=64, buckets=(8, 64), block_size=8,
+        prefix_cache=False,
+    )
+    sched = ContinuousBatchingScheduler(eng)
+    for i in range(3):
+        sched.submit(Request(id=f"r{i}", prompt=[i + 1, 2, 3],
+                             max_new_tokens=5))
+    sched.run()
+    assert sched.pool.n_used == 0
+    assert sched.pool.n_free == sched.pool.n_blocks - 1
+
+    eng2 = PagedServingEngine(
+        model, n_slots=2, max_len=64, buckets=(8, 64), block_size=8
+    )
+    sched2 = ContinuousBatchingScheduler(eng2)
+    sched2.submit(Request(id="a", prompt=list(range(20)),
+                          max_new_tokens=4))
+    sched2.run()
+    # 20 tokens -> 2 full blocks cached, each held ONLY by the cache
+    assert sched2.pool.n_used == len(sched2.prefix) == 2
+    for digest in list(sched2.prefix._entries):
+        assert sched2.pool.ref(sched2.prefix._entries[digest]) == 1
+    sched2.prefix.evict_unused()
+    assert sched2.pool.n_used == 0
+
+
+def test_prefix_cache_never_matches_entire_prompt():
+    """The final prompt token is always prefilled (its logits feed the
+    first decode), even when the whole prompt is cached."""
+    pool = BlockPool(n_blocks=8, block_size=4)
+    cache = PrefixCache(pool)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]  # exactly 2 full blocks
+    blocks = pool.alloc(2)
+    cache.insert(prompt, blocks)
+    hits, n = cache.match(list(prompt))
+    # cap at (len-1)//bs = 1 block: the last block is recomputed
+    assert len(hits) == 1 and n == 4
+    for b in hits:
+        pool.release(b)
+
+
+def test_block_pool_accounting_and_errors():
+    pool = BlockPool(n_blocks=4, block_size=8)  # 3 allocatable
+    assert pool.n_free == 3
+    a = pool.alloc(2)
+    assert pool.n_used == 2 and pool.ref(a[0]) == 1
+    assert pool.alloc(2) is None      # only 1 left: all-or-nothing
+    assert pool.n_used == 2           # failed alloc grants nothing
+    pool.retain(a[0])
+    pool.release(a[0])
+    assert pool.n_used == 2           # still referenced once
+    pool.release(a[0])
+    assert pool.n_used == 1
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.release(a[0])
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.retain(99)
+    with pytest.raises(ValueError, match="trash"):
+        BlockPool(n_blocks=1, block_size=8)
+
+
+# ---------------------------------------------------------------------------
+# exhaustion backpressure
+# ---------------------------------------------------------------------------
+
+def test_pool_exhaustion_is_clean_backpressure(model):
+    """More demand than blocks: admissions defer (counted), every
+    request still completes, outputs unperturbed, pool drains."""
+    eng = PagedServingEngine(
+        model, n_slots=4, max_len=64, buckets=(8, 64), block_size=8,
+        n_blocks=9, prefix_cache=False,  # 8 usable blocks = 64 rows
+    )
+    sched = ContinuousBatchingScheduler(eng)
+    reqs = [(f"r{i}", [i + 1, 2, 3], 20) for i in range(4)]  # 3 blocks ea
+    for rid, prompt, n in reqs:
+        sched.submit(Request(id=rid, prompt=list(prompt),
+                             max_new_tokens=n))
+    out = sched.run()
+    assert len(out) == 4
+    assert sched.stats["backpressure_events"] > 0
+    assert sched.pool.n_used == 0
+    # outputs match an uncontended run
+    roomy = PagedServingEngine(
+        model, n_slots=4, max_len=64, buckets=(8, 64), block_size=8,
+        prefix_cache=False,
+    )
+    s2 = ContinuousBatchingScheduler(roomy)
+    for rid, prompt, n in reqs:
+        s2.submit(Request(id=rid, prompt=list(prompt), max_new_tokens=n))
+    assert s2.run() == out
+
+
+def test_impossible_request_refused_at_submit(model):
+    eng = PagedServingEngine(
+        model, n_slots=2, max_len=64, buckets=(8, 64), block_size=8,
+        n_blocks=5,  # 4 usable blocks = 32 rows < max_len
+    )
+    sched = ContinuousBatchingScheduler(eng)
+    with pytest.raises(ValueError, match="never be admitted"):
+        sched.submit(Request(id="huge", prompt=[1] * 30,
+                             max_new_tokens=10))  # 5 blocks > 4
+
+
+def test_exhaustion_evicts_idle_prefix_blocks(model):
+    """Cached-but-idle prefix blocks yield to live sequences before
+    admission backpressures."""
+    eng = PagedServingEngine(
+        model, n_slots=2, max_len=64, buckets=(8, 64), block_size=8,
+        n_blocks=9,  # 8 usable
+    )
+    sched = ContinuousBatchingScheduler(eng)
+    sched.submit(Request(id="a", prompt=list(range(20)),
+                         max_new_tokens=4))  # 3 blocks; 2 cached after
+    sched.run()
+    assert sched.pool.n_used == 2  # the cache's references
+    # a 7-block request only fits if the cache gives its 2 blocks back
+    sched.submit(Request(id="b", prompt=list(range(7, 57)),
+                         max_new_tokens=5))
+    out = sched.run()
+    assert len(out["b"]) == 5
+    assert sched.stats["backpressure_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# zero recompiles
+# ---------------------------------------------------------------------------
+
+def test_zero_recompiles_across_admission_and_retirement(model):
+    """Block tables and lengths are DATA: any churn of admissions,
+    retirements, prefix hits and chunk boundaries retraces nothing —
+    one decode program, one prefill program per chunk bucket."""
+    eng = PagedServingEngine(
+        model, n_slots=2, max_len=64, buckets=(8, 16, 64), block_size=8,
+        prefill_chunk=16,
+    )
+    rng = np.random.RandomState(3)
+    sched = ContinuousBatchingScheduler(eng)
+    for i in range(3):
+        sched.submit(Request(
+            id=f"w{i}",
+            prompt=list(rng.randint(0, 32, size=rng.randint(2, 40))),
+            max_new_tokens=3,
+        ))
+    sched.run()
+    prefill_before = eng._n_prefill_traces
+    decode_before = eng._n_decode_traces
+    assert decode_before == 1
+    assert prefill_before <= len(eng.chunk_buckets)
+    # churn: a second wave through a FRESH scheduler (new tables, new
+    # pool, same engine programs)
+    sched2 = ContinuousBatchingScheduler(eng)
+    for i in range(4):
+        sched2.submit(Request(
+            id=f"x{i}",
+            prompt=list(rng.randint(0, 32, size=rng.randint(2, 40))),
+            max_new_tokens=4,
+        ))
+    sched2.run()
+    assert eng._n_decode_traces == decode_before
+    assert eng._n_prefill_traces <= len(eng.chunk_buckets)
+
+
+def test_engine_geometry_validation(model):
+    with pytest.raises(ValueError, match="block_size"):
+        PagedServingEngine(model, n_slots=1, max_len=64, block_size=0)
+    with pytest.raises(ValueError, match="trash block"):
+        PagedServingEngine(model, n_slots=1, max_len=64, block_size=8,
+                           n_blocks=1)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        PagedServingEngine(model, n_slots=1, max_len=64, block_size=8,
+                           prefill_chunk=0)
+    eng = PagedServingEngine(model, n_slots=2, max_len=64,
+                             buckets=(8, 16, 64), block_size=8,
+                             prefill_chunk=20)
+    # ladder = buckets at or under the cap, plus the cap itself
+    assert eng.chunk_buckets == (8, 16, 20)
+    with pytest.raises(ValueError, match="exceeds the device pool"):
+        eng.make_pool(n_blocks=eng.n_blocks + 1)

@@ -136,6 +136,62 @@ def test_request_validation():
 
 
 # ---------------------------------------------------------------------------
+# batched device-side pick (ISSUE 8 satellite: no host round trip per
+# emitted token — one fused argmax/sample dispatch per tick)
+# ---------------------------------------------------------------------------
+
+def test_pick_batch_matches_single_row_sampler():
+    """Row i of a batched pick is bit-identical to a single-row sample
+    with row i's key/temperature/top_k — batching can never perturb a
+    request's stream."""
+    s = Sampler()
+    rng = np.random.RandomState(5)
+    logits = jnp.asarray(rng.randn(6, 32), jnp.float32)
+    temps = np.asarray([0.0, 0.7, 1.3, 0.1, 2.0, 0.0], np.float32)
+    topks = np.asarray([0, 0, 5, 1, 31, 3], np.int32)
+    keys = np.stack([
+        np.asarray(jax.random.PRNGKey(100 + i)) for i in range(6)
+    ]).astype(np.uint32)
+    batch = s.pick_batch(logits, keys, temps, topks)
+    for i in range(6):
+        want = s.sample(logits[i], jnp.asarray(keys[i]),
+                        float(temps[i]), int(topks[i]))
+        assert int(batch[i]) == want, f"row {i} diverged"
+
+
+def test_pick_batch_no_recompile_across_mixes():
+    """Any mix of greedy/sampling rows runs ONE compiled batch program
+    per logits shape."""
+    s = Sampler()
+    rng = np.random.RandomState(6)
+    logits = jnp.asarray(rng.randn(4, 32), jnp.float32)
+    keys = np.zeros((4, 2), np.uint32)
+    for temps, ks in [
+        ([0.0] * 4, [0] * 4),
+        ([0.9, 0.0, 1.5, 0.0], [0, 0, 7, 2]),
+        ([2.0] * 4, [1] * 4),
+    ]:
+        out = s.pick_batch(
+            logits, keys, np.asarray(temps, np.float32),
+            np.asarray(ks, np.int32),
+        )
+        assert out.shape == (4,)
+    assert s._n_batch_traces == 1, (
+        f"batched sampler retraced {s._n_batch_traces}x"
+    )
+
+
+def test_pick_batch_all_greedy_is_exact_argmax():
+    s = Sampler()
+    logits = jnp.asarray(np.random.RandomState(8).randn(3, 32), jnp.float32)
+    out = s.pick_batch(
+        logits, np.zeros((3, 2), np.uint32),
+        np.zeros((3,), np.float32), np.zeros((3,), np.int32),
+    )
+    assert list(out) == list(np.argmax(np.asarray(logits), axis=-1))
+
+
+# ---------------------------------------------------------------------------
 # scheduler integration
 # ---------------------------------------------------------------------------
 

@@ -35,7 +35,10 @@ Commands:
   via ``--health-port``).  ``--replay FILE...`` feeds recorded raw
   traces through the identical streaming path instead of sockets —
   the CI-able smoke of the live plane (``--replay-windows`` chunks).
-  Exits 1 when any alert fired.
+  ``--persist PATH`` appends every closed window's verdict to a JSONL
+  timeline (the in-memory ring keeps only the newest 64 windows; the
+  timeline keeps a long run's full history for the self-tuning
+  driver).  Exits 1 when any alert fired.
 - ``serve --port N``            serve /metrics, /trace, /flight from the
   current (empty, unless something enabled tracing in-process) state —
   mainly a smoke surface; real deployments call
@@ -261,6 +264,7 @@ def _cmd_watch(args) -> int:
         stall_min_s=args.stall_min_s,
         expect_ranks=args.expect_rank or None,
         log=lambda line: print(line, file=sys.stderr, flush=True),
+        persist_path=args.persist,
     )
     channel = agg.serve(args.port)
     health = None
@@ -336,6 +340,7 @@ def _watch_replay(args) -> int:
         _watch_thresholds(args),
         log=lambda line: print(line, file=sys.stderr, flush=True),
     )
+    verdict_log = live.VerdictLog(args.persist) if args.persist else None
     n_win = max(1, args.replay_windows)
     for k in range(n_win):
         for label, events, sample_rate, dropped in per_rank:
@@ -349,6 +354,8 @@ def _watch_replay(args) -> int:
             )
         v = doctor.close_window()
         v["alerts"] = watchdog.evaluate(v)
+        if verdict_log is not None:
+            verdict_log.append(v)
         _emit_window(v, args.json)
     if not args.json:
         print(
@@ -523,6 +530,12 @@ def _build_parser() -> argparse.ArgumentParser:
     w.add_argument(
         "--json", action="store_true",
         help="one JSON verdict per line instead of the human line",
+    )
+    w.add_argument(
+        "--persist", default=None, metavar="PATH",
+        help="append every closed window's verdict to this JSONL "
+        "timeline (full-run history; the in-memory ring keeps only "
+        "the newest windows)",
     )
     w.add_argument("--stall-min-s", type=float, default=0.0)
     w.add_argument("--max-straggler", type=float, default=None)

@@ -98,6 +98,54 @@ def _floats(vals) -> List[float]:
     return [float(v) for v in vals]
 
 
+class VerdictLog:
+    """Append-only JSONL timeline of per-window verdicts.
+
+    The aggregator keeps only the last ``max_windows_kept`` windows in
+    memory; a long run's full verdict history (what the future
+    self-tuning driver reads round-over-round) lives here instead —
+    one JSON object per closed window, appended as it closes, so a
+    crash loses at most the open window.  Write failures are counted
+    and logged once — persistence must never take the monitor down."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.written = 0
+        self.failed = 0
+        d = os.path.dirname(self.path)
+        if d:
+            try:
+                os.makedirs(d, exist_ok=True)
+            except OSError:
+                pass  # append() will count + report the failure
+
+    def append(self, verdict: dict) -> bool:
+        import json
+
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(verdict, default=str) + "\n")
+            self.written += 1
+            return True
+        except OSError as e:
+            self.failed += 1
+            if self.failed == 1:
+                print(
+                    f"[live] verdict persistence failed ({self.path}): "
+                    f"{type(e).__name__}: {e}",
+                    flush=True,
+                )
+            return False
+
+    @staticmethod
+    def default_path(rank_label: str = "rank0") -> str:
+        from theanompi_tpu.observability import export
+
+        return os.path.join(
+            export.obs_dir(), f"{rank_label}_verdicts.jsonl"
+        )
+
+
 # ---------------------------------------------------------------------------
 # sender side
 # ---------------------------------------------------------------------------
@@ -450,10 +498,14 @@ class Aggregator:
         expect_ranks: Optional[List[str]] = None,
         log=None,
         clock=time.monotonic,
+        persist_path: Optional[str] = None,
     ):
         self.period_s = float(period_s)
         self.heartbeat_miss = int(heartbeat_miss)
         self.clock = clock
+        self.verdict_log = (
+            VerdictLog(persist_path) if persist_path else None
+        )
         self._lock = threading.Lock()
         self.doctor = analysis.StreamingDoctor(stall_min_s=stall_min_s)
         self.watchdog = Watchdog(thresholds, log=log)
@@ -630,6 +682,11 @@ class Aggregator:
             self.n_windows = verdict["window"]
             self.windows.append(verdict)
             del self.windows[: -self.max_windows_kept]
+        # the in-memory ring keeps only the newest windows; the JSONL
+        # timeline keeps them ALL (outside the lock: file IO must not
+        # stall frame ingestion)
+        if self.verdict_log is not None:
+            self.verdict_log.append(verdict)
         return verdict
 
     # ---- surfaces ----------------------------------------------------
@@ -674,7 +731,7 @@ class Aggregator:
     def summary(self) -> dict:
         """End-of-run roll-up (what bench attaches to its JSON)."""
         with self._lock:
-            return {
+            out = {
                 "windows": self.n_windows,
                 "alerts_total": self.watchdog.alerts_total,
                 "alerts": list(self.watchdog.history)[-20:],
@@ -685,6 +742,13 @@ class Aggregator:
                 },
                 "cumulative": self.doctor.cumulative(),
             }
+            if self.verdict_log is not None:
+                out["verdict_timeline"] = {
+                    "path": self.verdict_log.path,
+                    "written": self.verdict_log.written,
+                    "failed": self.verdict_log.failed,
+                }
+            return out
 
     def serve(self, port: int):
         """Expose ``ingest`` on the transport's request/reply channel
@@ -714,6 +778,7 @@ class LiveMonitor:
         port: Optional[int] = None,
         health_port: Optional[int] = None,
         log=None,
+        persist_path: Optional[str] = None,
     ):
         from theanompi_tpu import observability as obs
 
@@ -724,6 +789,7 @@ class LiveMonitor:
             period_s=period_s,
             heartbeat_miss=heartbeat_miss,
             log=log,
+            persist_path=persist_path,
         )
         self.shipper = TelemetryShipper(
             rank_label, aggregator=self.aggregator, period_s=period_s
@@ -824,8 +890,12 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
 
     Cadence via ``THEANOMPI_LIVE_PERIOD_S`` (heartbeat, default 1.0)
     and ``THEANOMPI_LIVE_WINDOW_S`` (verdict window, default 5.0);
-    thresholds via ``THEANOMPI_LIVE_RULES``.  Returns an object with
-    ``.stop() -> summary`` or ``None``.
+    thresholds via ``THEANOMPI_LIVE_RULES``.
+    ``THEANOMPI_LIVE_PERSIST=1`` appends every closed window's verdict
+    to ``<obs dir>/<rank>_verdicts.jsonl`` (any other value is taken
+    as the JSONL path) — the full-run timeline the in-memory window
+    ring cannot hold.  Returns an object with ``.stop() -> summary``
+    or ``None``.
     """
     agg_addr = (env.get("THEANOMPI_LIVE_AGG") or "").strip()
     live = env.get("THEANOMPI_LIVE") == "1"
@@ -844,6 +914,12 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
     window = float(env.get("THEANOMPI_LIVE_WINDOW_S") or 5.0)
     port = env.get("THEANOMPI_LIVE_PORT")
     health_port = env.get("THEANOMPI_LIVE_HEALTH_PORT")
+    persist = (env.get("THEANOMPI_LIVE_PERSIST") or "").strip()
+    persist_path = None
+    if persist == "1":
+        persist_path = VerdictLog.default_path(rank_label)
+    elif persist:
+        persist_path = persist
     return LiveMonitor(
         rank_label,
         thresholds=thresholds_from_env(env),
@@ -851,4 +927,5 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
         window_s=window,
         port=int(port) if port else None,
         health_port=int(health_port) if health_port else None,
+        persist_path=persist_path,
     )

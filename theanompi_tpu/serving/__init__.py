@@ -7,9 +7,13 @@ serving side with the same sharded-parameter machinery:
 - ``engine``    — jit-compiled prefill + single-token KV-cache decode for
   ``TransformerLM``, with a preallocated, length-bucketed cache laid out
   on the model's own ``build_mesh()`` mesh.
+- ``paging``    — the paged KV cache: a refcounted fixed-size block
+  pool (``BlockPool``), hash-consed prefix reuse (``PrefixCache``),
+  and ``PagedServingEngine`` — block-table gather/scatter prefill +
+  decode with batched, chunked multi-slot prefill.
 - ``scheduler`` — continuous batching: an admission queue feeding a fixed
-  set of decode slots, join-on-finish slot recycling, no recompiles as
-  requests come and go.
+  set of decode slots, join-on-finish slot recycling (paged engines
+  also reclaim their blocks), no recompiles as requests come and go.
 - ``loader``    — restore a *training* checkpoint
   (``utils/checkpoint.restore``) and re-lay the params into inference
   sharding (reusing ``TransformerLM._build_param_specs``).
@@ -30,11 +34,19 @@ Bench entry point: ``bench_serve.py`` at the repo root (hooked from
 from theanompi_tpu.serving.engine import ServingEngine
 from theanompi_tpu.serving.loader import load_engine, restore_params_for_serving
 from theanompi_tpu.serving.metrics import ServingMetrics
+from theanompi_tpu.serving.paging import (
+    BlockPool,
+    PagedServingEngine,
+    PrefixCache,
+)
 from theanompi_tpu.serving.sampling import Sampler
 from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
 
 __all__ = [
     "ServingEngine",
+    "PagedServingEngine",
+    "BlockPool",
+    "PrefixCache",
     "ContinuousBatchingScheduler",
     "Request",
     "Sampler",

@@ -185,6 +185,11 @@ class ServingEngine:
             else None
         )
         self.kv_spec = P(None, slot_ax, None, head_ax, None)
+        # trace-time counters: tests pin the zero-recompile discipline
+        # (one decode program ever; one prefill program per bucket) by
+        # counting how often these functions actually retrace
+        self._n_prefill_traces = 0
+        self._n_decode_traces = 0
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1,))
 
@@ -284,6 +289,7 @@ class ServingEngine:
         [0, B) (rows past ``true_len`` are pad garbage the decode mask
         never reads and the next decode write overwrites) and returns
         logits at the last real token."""
+        self._n_prefill_traces += 1  # runs at trace time only
         emb, pos, blocks, lnf, head = self._weights(params)
         (b,) = tokens.shape
         x = self._embed(emb, pos, tokens, jnp.arange(b))  # (B, D)
@@ -354,6 +360,7 @@ class ServingEngine:
         the written tokens.  Inactive slots compute garbage that is
         never read (their length does not advance, so the row is
         overwritten by the slot's next real token)."""
+        self._n_decode_traces += 1  # runs at trace time only
         emb, pos, blocks, lnf, head = self._weights(params)
         s_ = self.n_slots
         h = self.n_heads

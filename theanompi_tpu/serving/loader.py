@@ -58,6 +58,11 @@ def load_engine(
     max_len: Optional[int] = None,
     buckets=None,
     model_cls=None,
+    paged: bool = False,
+    block_size: int = 16,
+    n_blocks: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    prefix_cache: bool = True,
 ):
     """One-call checkpoint → ready ``ServingEngine``.
 
@@ -65,8 +70,14 @@ def load_engine(
     with (d_model / n_heads / n_layers / vocab_size / seq_len); serving
     topology (``tp``) may differ from training.  ``mesh`` defaults to
     ``model_cls.build_mesh(config)`` — the same mesh builder training
-    rules use, so serving engages tp meshes from config alone."""
+    rules use, so serving engages tp meshes from config alone.
+
+    ``paged=True`` returns a ``paging.PagedServingEngine`` instead —
+    same checkpoint, same decode outputs, KV memory in fixed-size
+    refcounted blocks (``block_size``/``n_blocks``) with prefix reuse
+    and chunked multi-slot prefill (``prefill_chunk``)."""
     from theanompi_tpu.serving.engine import ServingEngine
+    from theanompi_tpu.serving.paging import PagedServingEngine
 
     if model_cls is None:
         from theanompi_tpu.models.transformer import TransformerLM
@@ -84,6 +95,12 @@ def load_engine(
         else model_cls(config=cfg)
     )
     restore_params_for_serving(model, path)
+    if paged:
+        return PagedServingEngine(
+            model, n_slots=n_slots, max_len=max_len, buckets=buckets,
+            block_size=block_size, n_blocks=n_blocks,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+        )
     return ServingEngine(
         model, n_slots=n_slots, max_len=max_len, buckets=buckets
     )

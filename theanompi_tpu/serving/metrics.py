@@ -57,6 +57,43 @@ _TPOT = _REG.histogram(
     buckets=_LATENCY_BUCKETS,
 )
 
+# ---- paged-KV observability (serving/paging.py publishes these) ----------
+# Block-pool occupancy is THE capacity signal for the paged engine: a
+# pool near-full means admissions are about to backpressure, a pool
+# near-empty at high queue depth means slots (lanes), not memory, are
+# the bottleneck.
+BLOCKS_FREE = _REG.gauge(
+    "serve_block_pool_free_blocks", "KV blocks currently allocatable"
+)
+BLOCKS_USED = _REG.gauge(
+    "serve_block_pool_used_blocks", "KV blocks held by live sequences "
+    "or the prefix cache"
+)
+PREFIX_HITS = _REG.counter(
+    "serve_prefix_hits_total",
+    "admissions that reused >= 1 cached prefix block",
+)
+PREFIX_MISSES = _REG.counter(
+    "serve_prefix_misses_total",
+    "admissions that reused no cached prefix block",
+)
+PREFIX_HIT_TOKENS = _REG.counter(
+    "serve_prefix_hit_tokens_total",
+    "prompt tokens served from cached prefix blocks (never re-prefilled)",
+)
+PREFILL_CHUNKS = _REG.counter(
+    "serve_prefill_chunks_total",
+    "batched chunked-prefill dispatches by padded chunk bucket",
+)
+PREFILL_TOKENS = _REG.counter(
+    "serve_prefill_tokens_total",
+    "prompt tokens actually pushed through prefill (prefix hits excluded)",
+)
+ADMISSION_BACKPRESSURE = _REG.counter(
+    "serve_admission_backpressure_total",
+    "admission attempts deferred because the block pool was exhausted",
+)
+
 
 class ServingMetrics:
     """Collects per-request latency rows; emits through a Recorder.
@@ -86,6 +123,13 @@ class ServingMetrics:
         # would pollute this instance's fallback percentiles)
         self._ttft_counts = [0] * (len(_LATENCY_BUCKETS) + 1)
         self._tpot_counts = [0] * (len(_LATENCY_BUCKETS) + 1)
+        # paged-engine per-run stats (scheduler.stats) attached at run
+        # end; surfaced in summary() so one dict answers both "how
+        # fast" and "how well did the cache reuse memory"
+        self.engine_stats: Optional[dict] = None
+
+    def set_engine_stats(self, stats: dict) -> None:
+        self.engine_stats = dict(stats)
 
     @staticmethod
     def _bucket_observe(counts, value: float) -> None:
@@ -213,10 +257,12 @@ class ServingMetrics:
             "tpot_p99_s": tpot[99],
             "estimators": {"ttft": estimator, "tpot": estimator},
         }
+        if self.engine_stats is not None:
+            out["engine_stats"] = dict(self.engine_stats)
         if self.recorder is not None and self.rows:
             self.recorder.log_event(
                 "serve_summary",
                 **{k: (round(v, 6) if isinstance(v, float) else v)
-                   for k, v in out.items()},
+                   for k, v in out.items() if k != "engine_stats"},
             )
         return out

@@ -1,0 +1,568 @@
+"""Paged KV cache: fixed-size blocks, block tables, prefix reuse.
+
+The PR 1 engine preallocates a worst-case contiguous region per slot
+(``init_cache`` reserves ``max_len`` rows for every slot), so cache
+memory scales with the *longest imaginable* sequence times the slot
+count while real traffic is long-tail: most sequences are short, a few
+are huge.  This module decouples a sequence's logical positions from
+their physical placement — the same move the Theano-MPI lineage makes
+for training (preallocated exchanged buffers, arXiv:1605.08325) and
+arXiv:2112.01075 makes for redistribution: only *live* blocks occupy
+memory.
+
+Three pieces:
+
+- **BlockPool** — host-side allocator over a device-side flat row pool
+  ``k``/``v`` of shape ``(n_layers, n_blocks * block_size, heads,
+  head_dim)``.  Block 0 is reserved as the *trash block*: masked or
+  inactive lanes scatter their garbage there, so a freed (reallocated)
+  block can never be corrupted by a stale lane.  Refcounted — a block
+  shared by N sequences (prefix reuse) frees only when the last
+  reference drops.
+- **PrefixCache** — hash-consed chains of *full, immutable* blocks:
+  the digest of (parent digest, block tokens) names a block's exact
+  content and position, so two requests sharing a system prompt map
+  their shared full blocks to the SAME physical block — prefilled
+  once, refcounted across requests.  The final prompt token is never
+  served from cache (its logits must be computed), so a match is
+  capped at ``(len(prompt) - 1) // block_size`` blocks.
+- **PagedServingEngine** — the contiguous engine's forward math
+  re-expressed over block tables: prefill and decode gather/scatter
+  K/V rows by ``table[block] * block_size + offset`` instead of
+  slot-major slicing.  Tables/positions enter the jitted programs as
+  *data* (device arrays), never as shapes, so admission, retirement
+  and table growth cause ZERO recompiles — one decode program ever,
+  one prefill program per chunk bucket.  Prefill is **batched and
+  chunked**: up to ``prefill_rows`` sequences advance by up to
+  ``prefill_chunk`` tokens in ONE padded call per tick, so a burst of
+  arrivals shares a dispatch and a giant prompt cannot hide the TTFT
+  of everyone queued behind it.
+
+Correctness contract (tests/test_serving_paged.py): greedy decode
+through block tables is token-identical to the contiguous engine and
+to the no-cache recompute baseline; prefix hits change which physical
+rows are read, never the values read from them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.runtime.mesh import DATA_AXIS, TP_AXIS
+from theanompi_tpu.serving import metrics as smetrics
+from theanompi_tpu.serving.engine import _NEG_INF, ServingEngine
+
+TRASH_BLOCK = 0  # reserved physical block: masked/inactive writes land here
+
+
+class BlockPool:
+    """Host-side accounting for the device block pool.
+
+    The pool owns block *identities* (free list + refcounts); the
+    device arrays live in the engine state and are threaded through
+    the jitted programs.  One pool per scheduler — two schedulers
+    sharing an engine each run their own allocation world, exactly
+    like two schedulers each calling ``init_cache`` today.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if int(n_blocks) < 2:
+            raise ValueError(
+                f"n_blocks={n_blocks}: need at least 2 (block 0 is the "
+                "reserved trash block)"
+            )
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        # block 0 reserved; allocatable ids are 1..n_blocks-1
+        self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        self.peak_used = 0
+        self._publish()
+
+    # ---- accounting --------------------------------------------------
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def ref(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def _publish(self) -> None:
+        smetrics.BLOCKS_FREE.set(self.n_free)
+        smetrics.BLOCKS_USED.set(self.n_used)
+        self.peak_used = max(self.peak_used, self.n_used)
+
+    # ---- alloc / retain / release ------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh blocks (ref 1 each), or None — never a partial
+        grant, so a failed admission has nothing to roll back."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        with obs.span("block_alloc", n=n, free=len(self._free)):
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+        self._publish()
+        return out
+
+    def retain(self, block: int) -> None:
+        if self._ref.get(block, 0) < 1:
+            raise ValueError(f"retain of unallocated block {block}")
+        self._ref[block] += 1
+
+    def release(self, block: int) -> None:
+        r = self._ref.get(block, 0)
+        if r < 1:
+            raise ValueError(f"release of unallocated block {block}")
+        if r == 1:
+            with obs.span("block_free", block=block):
+                del self._ref[block]
+                self._free.append(block)
+            self._publish()
+        else:
+            self._ref[block] = r - 1
+
+    def release_all(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            self.release(b)
+
+
+class PrefixCache:
+    """Hash-consed chains of immutable full blocks.
+
+    A cache entry maps ``digest(parent_digest, block_tokens)`` to a
+    physical block id whose K/V rows hold exactly those tokens at
+    exactly those positions.  The cache holds one reference per entry,
+    so a cached block survives its originating request; ``evict_unused``
+    drops every entry nothing else references (the pool-exhaustion
+    pressure valve).  Digests are sha1 over token bytes — content
+    addressing must not depend on Python's salted ``hash``.
+    """
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._entries: Dict[bytes, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _digest(self, parent: bytes, tokens: Sequence[int]) -> bytes:
+        h = hashlib.sha1(parent)
+        h.update(np.asarray(tokens, dtype=np.int64).tobytes())
+        return h.digest()
+
+    def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached chain of full blocks covering a PREFIX of
+        ``prompt``; each matched block is retained for the caller.
+        Capped so at least the final prompt token is always prefilled
+        (its logits are the request's first decode input)."""
+        bs = self.block_size
+        limit = (len(prompt) - 1) // bs
+        out: List[int] = []
+        parent = b""
+        with obs.span("prefix_match", n_prompt=len(prompt)):
+            for j in range(limit):
+                parent = self._digest(parent, prompt[j * bs:(j + 1) * bs])
+                block = self._entries.get(parent)
+                if block is None:
+                    break
+                out.append(block)
+        for b in out:
+            self.pool.retain(b)
+        if out:
+            self.hits += 1
+            self.hit_tokens += len(out) * bs
+            smetrics.PREFIX_HITS.inc()
+            smetrics.PREFIX_HIT_TOKENS.inc(len(out) * bs)
+        else:
+            self.misses += 1
+            smetrics.PREFIX_MISSES.inc()
+        return out, len(out) * bs
+
+    def insert(self, prompt: Sequence[int], blocks: Sequence[int]) -> int:
+        """Register every full block of a just-prefilled prompt.  The
+        first ``k`` chain links may already exist (they were the hit);
+        new entries retain their block on behalf of the cache.  Returns
+        the number of entries added."""
+        bs = self.block_size
+        added = 0
+        parent = b""
+        for j in range(len(prompt) // bs):
+            parent = self._digest(parent, prompt[j * bs:(j + 1) * bs])
+            if parent in self._entries:
+                continue  # identical content already cached; keep it
+            self._entries[parent] = blocks[j]
+            self.pool.retain(blocks[j])
+            added += 1
+        return added
+
+    def evict_unused(self) -> int:
+        """Free every cached block whose ONLY reference is the cache
+        itself.  Called when allocation fails — cached-but-idle prefix
+        memory yields to live sequences before admission backpressures.
+        Evicting a parent strands its children unreachable; they have
+        ref 1 too, so the same sweep collects them."""
+        dropped = 0
+        with obs.span("prefix_evict", entries=len(self._entries)):
+            for digest in list(self._entries):
+                block = self._entries[digest]
+                if self.pool.ref(block) == 1:
+                    self.pool.release(block)
+                    del self._entries[digest]
+                    dropped += 1
+        return dropped
+
+
+class PagedServingEngine(ServingEngine):
+    """The serving engine over a paged KV cache.
+
+    Shares every weight-math helper with ``ServingEngine`` (identical
+    LayerNorm/projection/softmax numerics); replaces slot-major cache
+    slicing with block-table gather/scatter.
+
+    Geometry:
+
+    - ``block_size`` — KV rows per block (the allocation granule).
+    - ``n_blocks`` — pool capacity *including* the reserved trash
+      block; defaults to contiguous parity
+      (``n_slots * blocks_per_seq + 1``) so the default engine serves
+      exactly what the contiguous one could, and operators shrink it
+      (or raise ``n_slots``) to bank the long-tail savings.
+    - ``prefill_rows`` — lanes per batched prefill call (fixed shape;
+      default ``n_slots``).
+    - ``prefill_chunk`` — max prompt tokens one prefill call advances
+      a sequence by (None = whole prompt in one chunk).  Chunks pad to
+      the ``chunk_buckets`` ladder, one compiled program per bucket.
+    """
+
+    is_paged = True
+
+    def __init__(
+        self,
+        model,
+        n_slots: int = 4,
+        max_len: Optional[int] = None,
+        buckets: Optional[Sequence[int]] = None,
+        block_size: int = 16,
+        n_blocks: Optional[int] = None,
+        prefill_rows: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = True,
+    ):
+        super().__init__(model, n_slots=n_slots, max_len=max_len,
+                         buckets=buckets)
+        if int(block_size) < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        self.blocks_per_seq = math.ceil(self.max_len / self.block_size)
+        # gathered-attention width: every sequence attends over its
+        # full table image; equals max_len when block_size divides it
+        self.t_pad = self.blocks_per_seq * self.block_size
+        if n_blocks is None:
+            n_blocks = self.n_slots * self.blocks_per_seq + 1
+        self.n_blocks = int(n_blocks)
+        if self.n_blocks < 2:
+            raise ValueError(
+                f"n_blocks={self.n_blocks}: need at least one usable "
+                "block plus the reserved trash block.  A pool smaller "
+                "than max_len rows is fine — requests that could never "
+                "fit are refused at submit()"
+            )
+        self.prefill_rows = int(prefill_rows or self.n_slots)
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}"
+                )
+        self.prefill_chunk = prefill_chunk
+        cap = prefill_chunk if prefill_chunk is not None else self.buckets[-1]
+        self.chunk_buckets = tuple(sorted(
+            {b for b in self.buckets if b <= cap} | {cap}
+        ))
+        self.prefix_cache_enabled = bool(prefix_cache)
+        # pool rows shard over dp only when every per-device shard is a
+        # whole number of blocks (a split block would tear the
+        # gather/scatter row arithmetic across devices)
+        row_ax = (
+            DATA_AXIS
+            if DATA_AXIS in self.mesh.shape
+            and int(self.mesh.shape[DATA_AXIS]) > 1
+            and self.n_blocks % int(self.mesh.shape[DATA_AXIS]) == 0
+            else None
+        )
+        head_ax = (
+            TP_AXIS
+            if TP_AXIS in self.mesh.shape and int(self.mesh.shape[TP_AXIS]) > 1
+            else None
+        )
+        self.pool_spec = P(None, row_ax, head_ax, None)
+        self._paged_prefill_jit = jax.jit(
+            self._paged_prefill_fn, donate_argnums=(1, 2)
+        )
+        self._paged_decode_jit = jax.jit(
+            self._paged_decode_fn, donate_argnums=(1, 2)
+        )
+
+    # ------------------------------------------------------------------
+    # state + pool construction
+    # ------------------------------------------------------------------
+    def init_state(self):
+        """Device block pool: ``k``/``v`` of (layers, n_blocks·bs,
+        heads, head_dim), allocated already sharded.  Lengths and block
+        tables stay host-side (tiny ints shipped per call — they are
+        *data*, so shipping them can never recompile anything)."""
+        dt = self.compute_dtype or jnp.float32
+        sh = NamedSharding(self.mesh, self.pool_spec)
+        shape = (
+            self.n_layers, self.n_blocks * self.block_size,
+            self.n_heads, self.head_dim,
+        )
+        return {
+            "k": jnp.zeros(shape, dt, device=sh),
+            "v": jnp.zeros(shape, dt, device=sh),
+        }
+
+    def make_pool(self, n_blocks: Optional[int] = None) -> BlockPool:
+        """A fresh allocator over (a prefix of) the device pool.  An
+        ``n_blocks`` below the engine's capacity caps the *accounted*
+        pool — how the bench pins "equal cache memory" comparisons."""
+        n = int(n_blocks) if n_blocks is not None else self.n_blocks
+        if n > self.n_blocks:
+            raise ValueError(
+                f"pool of {n} blocks exceeds the device pool "
+                f"({self.n_blocks})"
+            )
+        return BlockPool(n, self.block_size)
+
+    def pick_chunk_bucket(self, n: int) -> int:
+        for b in self.chunk_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"chunk of {n} tokens exceeds the largest chunk bucket "
+            f"{self.chunk_buckets[-1]}"
+        )
+
+    def max_seq_blocks(self, total_tokens: int) -> int:
+        return math.ceil(total_tokens / self.block_size)
+
+    # ------------------------------------------------------------------
+    # jitted programs (tables/positions are DATA, never shapes)
+    # ------------------------------------------------------------------
+    def _gather_rows(self, tables):
+        """(N, blocks_per_seq) block ids → (N, t_pad) physical rows:
+        row j of a sequence's image is logical position j."""
+        bs = self.block_size
+        rows = tables[:, :, None] * bs + jnp.arange(bs)[None, None, :]
+        return rows.reshape(tables.shape[0], -1)
+
+    def _paged_prefill_fn(
+        self, params, pk, pv, tokens, tables, p0, true_len, active
+    ):
+        """One batched, chunked prefill: ``tokens`` (P, C) int32 —
+        chunk c of each lane, entering logical positions
+        ``p0[i] + [0, C)``; ``true_len`` (P,) real tokens per lane
+        (pad and inactive lanes scatter to the trash block).  Writes
+        each lane's chunk K/V into its table's blocks and returns
+        logits (P, V) at each lane's last real chunk token."""
+        self._n_prefill_traces += 1  # runs at trace time only
+        emb, pos, blocks, lnf, head = self._weights(params)
+        p_, c_ = tokens.shape
+        bs = self.block_size
+        h, hd = self.n_heads, self.head_dim
+        positions = p0[:, None] + jnp.arange(c_)[None, :]  # (P, C)
+        x = self._embed(
+            emb, pos, tokens, jnp.minimum(positions, self.max_len - 1)
+        )  # (P, C, D)
+        blk_idx = jnp.minimum(positions // bs, self.blocks_per_seq - 1)
+        blk = jnp.take_along_axis(tables, blk_idx, axis=1)  # (P, C)
+        valid = active[:, None] & (
+            jnp.arange(c_)[None, :] < true_len[:, None]
+        )
+        wr = jnp.where(valid, blk * bs + positions % bs, TRASH_BLOCK)
+        wr = wr.reshape(-1)  # (P·C,) — collisions only inside trash
+        gr = self._gather_rows(tables)  # (P, t_pad)
+        # causal over ABSOLUTE positions: chunk queries see the whole
+        # cached history (earlier chunks / prefix-hit blocks) plus the
+        # intra-chunk triangle, exactly like one full-prompt pass
+        mask = jnp.arange(self.t_pad)[None, None, :] <= positions[:, :, None]
+        dt = pk.dtype
+        new_k, new_v = [], []
+        for i, bp in enumerate(blocks):
+            y = self._ln(bp["ln1"], x)
+            q = self._proj(y, bp["attn"]["wq"]).reshape(p_, c_, h, hd)
+            k = self._proj(y, bp["attn"]["wk"]).reshape(p_, c_, h, hd)
+            v = self._proj(y, bp["attn"]["wv"]).reshape(p_, c_, h, hd)
+            pk_l = pk[i].at[wr].set(k.reshape(p_ * c_, h, hd).astype(dt))
+            pv_l = pv[i].at[wr].set(v.reshape(p_ * c_, h, hd).astype(dt))
+            kc = jnp.take(pk_l, gr.reshape(-1), axis=0).reshape(
+                p_, self.t_pad, h, hd
+            )
+            vc = jnp.take(pv_l, gr.reshape(-1), axis=0).reshape(
+                p_, self.t_pad, h, hd
+            )
+            s = jnp.einsum(
+                "pchd,pthd->phct", q, kc,
+                preferred_element_type=jnp.float32,
+            ) * self.scale
+            s = jnp.where(mask[:, None, :, :], s, _NEG_INF)
+            prob = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum(
+                "phct,pthd->pchd", prob.astype(vc.dtype), vc,
+                preferred_element_type=jnp.float32,
+            ).astype(y.dtype)
+            x = x + self._proj(o.reshape(p_, c_, h * hd), bp["attn"]["wo"])
+            x = x + self._mlp(bp, self._ln(bp["ln2"], x))
+            new_k.append(pk_l)
+            new_v.append(pv_l)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
+        )[:, 0]  # (P, D)
+        logits = self._head(lnf, head, last)
+        return jnp.stack(new_k), jnp.stack(new_v), logits
+
+    def _paged_decode_fn(
+        self, params, pk, pv, tokens, tables, lengths, active
+    ):
+        """One decode tick for every lane: identical math to the
+        contiguous ``_decode_fn`` with the per-slot cache image
+        gathered through the block table.  Inactive lanes scatter to
+        the trash block — a recycled block can never be corrupted by a
+        lane that no longer owns it."""
+        self._n_decode_traces += 1  # runs at trace time only
+        emb, pos, blocks, lnf, head = self._weights(params)
+        s_ = tokens.shape[0]
+        bs = self.block_size
+        h, hd = self.n_heads, self.head_dim
+        pos_idx = lengths  # (S,) position of the incoming token
+        x = self._embed(
+            emb, pos, tokens, jnp.minimum(pos_idx, self.max_len - 1)
+        )  # (S, D)
+        blk = jnp.take_along_axis(
+            tables,
+            jnp.minimum(pos_idx // bs, self.blocks_per_seq - 1)[:, None],
+            axis=1,
+        )[:, 0]
+        wr = jnp.where(active, blk * bs + pos_idx % bs, TRASH_BLOCK)
+        gr = self._gather_rows(tables)  # (S, t_pad)
+        att_mask = jnp.arange(self.t_pad)[None, :] <= pos_idx[:, None]
+        dt = pk.dtype
+        new_k, new_v = [], []
+        for i, bp in enumerate(blocks):
+            y = self._ln(bp["ln1"], x)
+            q = self._proj(y, bp["attn"]["wq"]).reshape(s_, h, hd)
+            k = self._proj(y, bp["attn"]["wk"]).reshape(s_, h, hd)
+            v = self._proj(y, bp["attn"]["wv"]).reshape(s_, h, hd)
+            pk_l = pk[i].at[wr].set(k.astype(dt))
+            pv_l = pv[i].at[wr].set(v.astype(dt))
+            kc = jnp.take(pk_l, gr.reshape(-1), axis=0).reshape(
+                s_, self.t_pad, h, hd
+            )
+            vc = jnp.take(pv_l, gr.reshape(-1), axis=0).reshape(
+                s_, self.t_pad, h, hd
+            )
+            s = jnp.einsum(
+                "shd,sthd->sht", q, kc, preferred_element_type=jnp.float32
+            ) * self.scale
+            s = jnp.where(att_mask[:, None, :], s, _NEG_INF)
+            prob = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum(
+                "sht,sthd->shd", prob.astype(vc.dtype), vc,
+                preferred_element_type=jnp.float32,
+            ).astype(y.dtype)
+            x = x + self._proj(o.reshape(s_, h * hd), bp["attn"]["wo"])
+            x = x + self._mlp(bp, self._ln(bp["ln2"], x))
+            new_k.append(pk_l)
+            new_v.append(pv_l)
+        return (
+            jnp.stack(new_k), jnp.stack(new_v),
+            self._head(lnf, head, x),
+        )
+
+    # ------------------------------------------------------------------
+    # host entries
+    # ------------------------------------------------------------------
+    def prefill_chunks(self, params, state, rows):
+        """One batched chunked-prefill dispatch.
+
+        ``rows`` is a list of up to ``prefill_rows`` dicts with keys
+        ``tokens`` (this lane's chunk, 1..prefill_chunk ints), ``p0``
+        (its absolute start position) and ``table`` (the lane's block
+        ids).  Returns ``(state, logits)`` — logits row i belongs to
+        rows[i] (meaningful only for the lane's FINAL chunk)."""
+        if not rows or len(rows) > self.prefill_rows:
+            raise ValueError(
+                f"prefill_chunks wants 1..{self.prefill_rows} rows, "
+                f"got {len(rows)}"
+            )
+        c = self.pick_chunk_bucket(max(len(r["tokens"]) for r in rows))
+        p_ = self.prefill_rows
+        tokens = np.zeros((p_, c), np.int32)
+        tables = np.zeros((p_, self.blocks_per_seq), np.int32)
+        p0 = np.zeros((p_,), np.int32)
+        true_len = np.zeros((p_,), np.int32)
+        active = np.zeros((p_,), bool)
+        for i, r in enumerate(rows):
+            n = len(r["tokens"])
+            tokens[i, :n] = r["tokens"]
+            tables[i, :len(r["table"])] = r["table"]
+            p0[i] = int(r["p0"])
+            true_len[i] = n
+            active[i] = True
+        smetrics.PREFILL_CHUNKS.inc(bucket=str(c))
+        smetrics.PREFILL_TOKENS.inc(int(true_len.sum()))
+        with obs.span("prefill_chunk_dispatch", rows=len(rows), bucket=c):
+            k, v, logits = self._paged_prefill_jit(
+                params, state["k"], state["v"],
+                jnp.asarray(tokens), jnp.asarray(tables),
+                jnp.asarray(p0), jnp.asarray(true_len),
+                jnp.asarray(active),
+            )
+        return {"k": k, "v": v}, logits
+
+    def decode_step_paged(self, params, state, tokens, tables, lengths,
+                          active):
+        """One decode tick; host arrays in, ``(state, logits)`` out."""
+        k, v, logits = self._paged_decode_jit(
+            params, state["k"], state["v"],
+            jnp.asarray(tokens, dtype=jnp.int32),
+            jnp.asarray(tables, dtype=jnp.int32),
+            jnp.asarray(lengths, dtype=jnp.int32),
+            jnp.asarray(active, dtype=bool),
+        )
+        return {"k": k, "v": v}, logits
+
+    # ------------------------------------------------------------------
+    # convenience: single-sequence greedy decode (tests / smoke)
+    # ------------------------------------------------------------------
+    def greedy(self, prompt, n_new: int, params=None) -> List[int]:
+        """Greedy-decode through the full paged scheduler path (block
+        allocation, chunked prefill, table-threaded decode)."""
+        from theanompi_tpu.serving.scheduler import (
+            ContinuousBatchingScheduler, Request,
+        )
+
+        sched = ContinuousBatchingScheduler(self, params=params)
+        sched.submit(
+            Request(id="greedy", prompt=list(prompt), max_new_tokens=n_new)
+        )
+        return sched.run()["greedy"]

@@ -40,15 +40,30 @@ class Sampler:
 
     ``sample`` takes host scalars and returns a Python int token;
     the compiled program is cached per logits shape only.
+    ``pick_batch`` is the same draw vmapped over slot rows: one fused
+    argmax/sample dispatch per scheduler tick, one host transfer —
+    never a per-slot round trip.  Row i draws with row i's key, so a
+    batched pick is bit-identical to len(batch) single picks (tested).
     """
 
     def __init__(self):
         self._n_traces = 0  # observability: tests pin the no-recompile
         # contract by counting trace-time executions
+        self._n_batch_traces = 0
         self._fn = jax.jit(self._sample)
+        self._batch_fn = jax.jit(self._sample_batch)
 
     def _sample(self, logits, key, temperature, top_k):
         self._n_traces += 1  # runs at trace time only
+        return self._sample_core(logits, key, temperature, top_k)
+
+    def _sample_batch(self, logits, keys, temperatures, top_ks):
+        self._n_batch_traces += 1  # runs at trace time only
+        return jax.vmap(self._sample_core)(
+            logits, keys, temperatures, top_ks
+        )
+
+    def _sample_core(self, logits, key, temperature, top_k):
         v = logits.shape[-1]
         lg = logits.astype(jnp.float32)
         greedy = jnp.argmax(lg, axis=-1)
@@ -85,6 +100,23 @@ class Sampler:
             jnp.int32(top_k),
         )
         return int(out)
+
+    def pick_batch(self, logits, keys, temperatures, top_ks):
+        """One token id per row of ``logits`` (N, V) in a single
+        dispatch.  ``keys`` (N, 2) uint32 raw PRNG keys (row ignored
+        where temperature is 0), ``temperatures`` (N,) float,
+        ``top_ks`` (N,) int.  Rows with temperature 0 are exact argmax
+        — the greedy hot path rides along for free.  Returns a host
+        int array (N,)."""
+        import numpy as np
+
+        out = self._batch_fn(
+            jnp.asarray(logits),
+            jnp.asarray(keys, dtype=jnp.uint32),
+            jnp.asarray(temperatures, dtype=jnp.float32),
+            jnp.asarray(top_ks, dtype=jnp.int32),
+        )
+        return np.asarray(out)
 
 
 def request_key(seed: Optional[int], rid: str, token_index: int):

@@ -10,6 +10,23 @@ prefill runs between decode ticks) and leaves the moment it finishes,
 returning the slot to the pool.  The decode step never changes shape,
 so admission/retirement cause ZERO recompilation.
 
+Two engine families drive through the same scheduler:
+
+- **contiguous** (``ServingEngine``) — each slot owns a worst-case
+  ``max_len`` cache region; admission prefills one slot at a time.
+- **paged** (``paging.PagedServingEngine``) — slots own *block
+  tables* into a shared pool.  Admission allocates exactly the blocks
+  a request can ever need (prompt + ``max_new_tokens``), reuses
+  cached prefix blocks (refcounted, prefilled once per distinct
+  prefix), and defers — clean backpressure, never a crash — when the
+  pool is exhausted (after evicting idle cached prefixes).  Prefill
+  is **chunked and batched**: every tick, up to ``prefill_rows``
+  admitted-but-unprefilled lanes advance by up to ``prefill_chunk``
+  prompt tokens in ONE padded dispatch, interleaved with decode ticks
+  so a giant prompt cannot hide the TTFT of requests queued behind
+  it.  Finishing releases the slot's blocks back to the pool — the
+  same join-on-finish recycling, now also reclaiming memory.
+
 Determinism contract (tested): every per-slot computation in the engine
 is independent across the slot axis, so a request's output under any
 interleaving equals its output under serial execution — continuous
@@ -22,7 +39,9 @@ Sampling: ``temperature=0`` (the default) is the greedy argmax path,
 bit-identical to the parity-tested decode; ``temperature>0`` samples
 from the temperature-scaled, optionally top-k-filtered logits through
 one shared jitted sampler — sampling-config changes cause ZERO
-recompiles (see ``serving/sampling.py``).
+recompiles (see ``serving/sampling.py``).  Token picks are **batched
+device-side**: one fused argmax/sample over every active slot per
+tick, one host transfer — never a per-slot round trip.
 """
 
 from __future__ import annotations
@@ -34,6 +53,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from theanompi_tpu import observability as obs
+from theanompi_tpu.serving import metrics as smetrics
 
 _REG = obs.get_registry()
 _TOKENS = _REG.counter(
@@ -85,36 +105,77 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ("request", "produced")
+    __slots__ = ("request", "produced", "blocks", "n_fed", "decoding")
 
     def __init__(self):
         self.request: Optional[Request] = None
-        self.produced = 0  # tokens generated so far for the request
+        self.produced = 0   # tokens generated so far for the request
+        self.blocks: List[int] = []  # paged: block ids this slot holds
+        self.n_fed = 0      # paged: prompt tokens resident (hits + fed)
+        self.decoding = False  # paged: prompt fully prefilled
 
 
 class ContinuousBatchingScheduler:
-    """Admission queue + slot table driving one ``ServingEngine``.
+    """Admission queue + slot table driving one serving engine.
 
-    ``step()`` is one serving tick: admit queued requests into free
-    slots (one prefill each), then one batched decode step for every
+    ``step()`` is one serving tick: admissions, (paged) one batched
+    chunked-prefill dispatch, then one batched decode step for every
     active slot.  ``run()`` loops until drained.  Completed requests
     land in ``finished`` (id → token list) and are reported to
     ``metrics`` when one is attached.
+
+    ``pool`` (paged engines only) overrides the block allocator — the
+    bench caps it below the device pool to pin equal-cache-memory
+    comparisons against the contiguous engine.
     """
 
     def __init__(self, engine, metrics=None, params=None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, pool=None):
         self.engine = engine
         self.metrics = metrics
         self.params = params if params is not None else engine.model.params
         self.clock = clock
-        self.cache = engine.init_cache()
+        self.paged = bool(getattr(engine, "is_paged", False))
         self.slots = [_Slot() for _ in range(engine.n_slots)]
         self.queue: List[Request] = []
         self.finished: Dict[str, List[int]] = {}
         self._tokens = np.zeros((engine.n_slots,), np.int32)
         self._active = np.zeros((engine.n_slots,), bool)
         self._sampler = None  # built lazily on the first sampling request
+        # per-run reuse/capacity stats (host-side, exact — the registry
+        # counters are process-global and shared across schedulers)
+        self.stats = {
+            "peak_concurrent": 0,
+            "prefill_tokens": 0,
+            "prefill_chunks": 0,
+            "prefix_hits": 0,
+            "prefix_misses": 0,
+            "prefix_hit_tokens": 0,
+            "backpressure_events": 0,
+        }
+        if self.paged:
+            if pool is not None and pool.block_size != engine.block_size:
+                raise ValueError("pool/engine block_size mismatch")
+            self.pool = pool if pool is not None else engine.make_pool()
+            from theanompi_tpu.serving.paging import PrefixCache
+
+            self.prefix = (
+                PrefixCache(self.pool)
+                if engine.prefix_cache_enabled else None
+            )
+            self.state = engine.init_state()
+            self._tables = np.zeros(
+                (engine.n_slots, engine.blocks_per_seq), np.int32
+            )
+            self._lengths = np.zeros((engine.n_slots,), np.int32)
+        else:
+            if pool is not None:
+                raise ValueError(
+                    "pool= applies to paged engines only"
+                )
+            self.pool = None
+            self.prefix = None
+            self.cache = engine.init_cache()
 
     # ------------------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -124,6 +185,14 @@ class ContinuousBatchingScheduler:
                 f"request {request.id!r} needs {total} cache rows > "
                 f"max_len={self.engine.max_len}"
             )
+        if self.paged:
+            need = self.engine.max_seq_blocks(total)
+            if need > self.pool.n_blocks - 1:
+                raise ValueError(
+                    f"request {request.id!r} needs {need} KV blocks > "
+                    f"pool capacity {self.pool.n_blocks - 1} — it could "
+                    "never be admitted"
+                )
         if self.metrics is not None:
             self.metrics.admitted(request.id, len(request.prompt),
                                   t=self.clock())
@@ -133,19 +202,40 @@ class ContinuousBatchingScheduler:
 
     @property
     def n_active(self) -> int:
+        """Occupied slots (prefilling or decoding)."""
+        if self.paged:
+            return sum(1 for s in self.slots if s.request is not None)
         return int(self._active.sum())
+
+    def _note_concurrency(self) -> None:
+        self.stats["peak_concurrent"] = max(
+            self.stats["peak_concurrent"], self.n_active
+        )
 
     def _finish(self, i: int) -> None:
         slot, req = self.slots[i], self.slots[i].request
         self.finished[req.id] = req.output
         if self.metrics is not None:
             self.metrics.finished(req.id, len(req.output), t=self.clock())
+        if self.paged:
+            # join-on-finish recycling now also reclaims memory: every
+            # block reference this slot holds goes back to the pool
+            # (prefix-cached blocks just drop one ref and live on)
+            self.pool.release_all(slot.blocks)
+            slot.blocks = []
+            slot.n_fed = 0
+            slot.decoding = False
+            self._tables[i, :] = 0
+            self._lengths[i] = 0
         slot.request = None
         slot.produced = 0
         self._active[i] = False
         _FINISHED.inc()
         _SLOTS.set(self.n_active)
 
+    # ------------------------------------------------------------------
+    # token picking (batched, device-side)
+    # ------------------------------------------------------------------
     def _pick_token(self, req: Request, logits) -> int:
         """Next token for ``req`` from its logits (V,): exact host
         argmax for greedy requests (unchanged path), the shared jitted
@@ -166,6 +256,37 @@ class ContinuousBatchingScheduler:
             logits, key, req.temperature, req.top_k
         )
 
+    def _pick_batch(self, reqs: List[Optional[Request]], logits):
+        """Next token for every row of ``logits`` (N, V) in ONE device
+        dispatch + ONE host transfer.  ``reqs[i] is None`` marks a row
+        whose pick is discarded (inactive lane) — it rides the greedy
+        path with a dummy key.  Greedy rows are exact argmax; sampling
+        rows draw with the SAME per-request key as the single-row
+        sampler, so batching never perturbs a stream."""
+        import jax.numpy as jnp
+
+        if not any(r is not None and r.temperature > 0.0 for r in reqs):
+            return np.asarray(jnp.argmax(logits, axis=-1))
+        if self._sampler is None:
+            from theanompi_tpu.serving.sampling import Sampler
+
+            self._sampler = Sampler()
+        from theanompi_tpu.serving.sampling import request_key
+
+        n = len(reqs)
+        temps = np.zeros((n,), np.float32)
+        topks = np.zeros((n,), np.int32)
+        keys = np.zeros((n, 2), np.uint32)
+        for i, r in enumerate(reqs):
+            if r is None or r.temperature == 0.0:
+                continue
+            temps[i] = r.temperature
+            topks[i] = r.top_k
+            keys[i] = np.asarray(
+                request_key(r.seed, r.id, len(r.output))
+            )
+        return self._sampler.pick_batch(logits, keys, temps, topks)
+
     def _emit(self, i: int, token: int) -> bool:
         """Append one generated token to slot i's request; True when the
         request just finished (eos or budget)."""
@@ -181,11 +302,9 @@ class ContinuousBatchingScheduler:
         )
 
     # ------------------------------------------------------------------
-    def step(self) -> int:
-        """One tick: admissions, then one decode step.  Returns the
-        number of tokens generated this tick."""
-        import jax.numpy as jnp
-
+    # contiguous tick
+    # ------------------------------------------------------------------
+    def _step_contiguous(self) -> int:
         produced = 0
         # 1) join-on-finish admission: every free slot takes the oldest
         # queued request; its prefill yields the request's FIRST token
@@ -200,6 +319,7 @@ class ContinuousBatchingScheduler:
                     self.params, self.cache, i, req.prompt
                 )
             self._active[i] = True
+            self._note_concurrency()
             _SLOTS.set(self.n_active)
             _QUEUE.set(len(self.queue))
             produced += 1
@@ -217,21 +337,171 @@ class ContinuousBatchingScheduler:
                 self.cache, logits = self.engine.decode_step(
                     self.params, self.cache, self._tokens, self._active
                 )
-            # greedy slots keep the one batched argmax (unchanged hot
-            # path); sampling slots draw per-slot from their own row
-            arg = np.asarray(jnp.argmax(logits, axis=-1))
+            toks = self._pick_batch(
+                [s.request if was_active[i] else None
+                 for i, s in enumerate(self.slots)],
+                logits,
+            )
             for i in range(len(self.slots)):
                 if not was_active[i]:
                     continue
-                req = self.slots[i].request
                 produced += 1
-                tok = (
-                    int(arg[i])
-                    if req.temperature == 0.0
-                    else self._pick_token(req, logits[i])
-                )
-                if self._emit(i, tok):
+                if self._emit(i, int(toks[i])):
                     self._finish(i)
+        return produced
+
+    # ------------------------------------------------------------------
+    # paged tick
+    # ------------------------------------------------------------------
+    def _admit_paged(self) -> None:
+        """Free slots take queued requests FIFO; each admission reuses
+        every cached prefix block it can, then allocates exactly the
+        fresh blocks the request can ever need.  An exhausted pool
+        (after evicting idle cached prefixes) defers admission to a
+        later tick — backpressure, never a crash — and preserves FIFO
+        (nothing behind the stuck head jumps the queue)."""
+        for i, slot in enumerate(self.slots):
+            if slot.request is not None or not self.queue:
+                continue
+            req = self.queue[0]
+            need = self.engine.max_seq_blocks(
+                len(req.prompt) + req.max_new_tokens
+            )
+            hits: List[int] = []
+            hit_tokens = 0
+            if self.prefix is not None:
+                hits, hit_tokens = self.prefix.match(req.prompt)
+            fresh = self.pool.alloc(need - len(hits))
+            if fresh is None and self.prefix is not None:
+                self.prefix.evict_unused()
+                fresh = self.pool.alloc(need - len(hits))
+            if fresh is None:
+                # roll back the prefix refs; the request stays queued
+                self.pool.release_all(hits)
+                self.stats["backpressure_events"] += 1
+                smetrics.ADMISSION_BACKPRESSURE.inc()
+                break
+            self.queue.pop(0)
+            slot.request = req
+            slot.blocks = hits + fresh
+            slot.n_fed = hit_tokens
+            slot.decoding = False
+            self._tables[i, :] = 0
+            self._tables[i, :len(slot.blocks)] = slot.blocks
+            self._lengths[i] = hit_tokens
+            self.stats["prefix_hits"] += 1 if hits else 0
+            self.stats["prefix_misses"] += 0 if hits else 1
+            self.stats["prefix_hit_tokens"] += hit_tokens
+            self._note_concurrency()
+            _SLOTS.set(self.n_active)
+            _QUEUE.set(len(self.queue))
+
+    def _prefill_tick_paged(self) -> int:
+        """ONE batched, length-bucketed prefill dispatch: every lane
+        still holding unfed prompt tokens advances by one chunk (up to
+        ``prefill_rows`` lanes).  A lane whose prompt completes emits
+        its first token this tick; longer prompts resume next tick,
+        interleaved with decode."""
+        pending = [
+            i for i, s in enumerate(self.slots)
+            if s.request is not None
+            and s.n_fed < len(s.request.prompt)
+        ][: self.engine.prefill_rows]
+        if not pending:
+            return 0
+        cap = (
+            self.engine.prefill_chunk
+            if self.engine.prefill_chunk is not None
+            else self.engine.chunk_buckets[-1]
+        )
+        rows = []
+        for i in pending:
+            s = self.slots[i]
+            chunk = s.request.prompt[s.n_fed:s.n_fed + cap]
+            rows.append({
+                "tokens": chunk, "p0": s.n_fed, "table": s.blocks,
+            })
+        with obs.span("prefill", rows=len(rows),
+                      n_tokens=sum(len(r["tokens"]) for r in rows)):
+            self.state, logits = self.engine.prefill_chunks(
+                self.params, self.state, rows
+            )
+        self.stats["prefill_chunks"] += 1
+        produced = 0
+        completing: List[int] = []
+        for r_idx, i in enumerate(pending):
+            s = self.slots[i]
+            s.n_fed += len(rows[r_idx]["tokens"])
+            self._lengths[i] = s.n_fed
+            if s.n_fed >= len(s.request.prompt):
+                completing.append(r_idx)
+            self.stats["prefill_tokens"] += len(rows[r_idx]["tokens"])
+        if completing:
+            picks = self._pick_batch(
+                [
+                    self.slots[pending[r_idx]].request
+                    if r_idx in completing else None
+                    for r_idx in range(self.engine.prefill_rows)
+                ],
+                logits,
+            )
+            for r_idx in completing:
+                i = pending[r_idx]
+                s = self.slots[i]
+                if self.prefix is not None:
+                    self.prefix.insert(s.request.prompt, s.blocks)
+                s.decoding = True
+                self._active[i] = True
+                produced += 1
+                if self._emit(i, int(picks[r_idx])):
+                    self._finish(i)
+        return produced
+
+    def _decode_tick_paged(self) -> int:
+        decoding = np.array(
+            [s.decoding for s in self.slots], dtype=bool
+        )
+        if not decoding.any():
+            return 0
+        for i, slot in enumerate(self.slots):
+            self._tokens[i] = (
+                slot.request.output[-1] if decoding[i] else 0
+            )
+        with obs.span("decode_step", active=int(decoding.sum())):
+            self.state, logits = self.engine.decode_step_paged(
+                self.params, self.state, self._tokens,
+                self._tables, self._lengths, decoding,
+            )
+        # the tick wrote each active lane's token at row `length`;
+        # advance AFTER the dispatch so next tick writes the next row
+        self._lengths[decoding] += 1
+        toks = self._pick_batch(
+            [s.request if decoding[i] else None
+             for i, s in enumerate(self.slots)],
+            logits,
+        )
+        produced = 0
+        for i in range(len(self.slots)):
+            if not decoding[i]:
+                continue
+            produced += 1
+            if self._emit(i, int(toks[i])):
+                self._finish(i)
+        return produced
+
+    def _step_paged(self) -> int:
+        self._admit_paged()
+        produced = self._prefill_tick_paged()
+        produced += self._decode_tick_paged()
+        return produced
+
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """One tick: admissions, (paged) chunked prefill, then one
+        decode step.  Returns the number of tokens generated."""
+        produced = (
+            self._step_paged() if self.paged else self._step_contiguous()
+        )
         _TOKENS.inc(produced)
         return produced
 
@@ -250,7 +520,7 @@ class ContinuousBatchingScheduler:
         telemetry = obs_live.maybe_start_from_env("serve")
         ticks = 0
         try:
-            while self.queue or self._active.any():
+            while self.queue or self.n_active:
                 ticks += 1
                 if ticks > max_ticks:
                     raise RuntimeError(
@@ -260,4 +530,12 @@ class ContinuousBatchingScheduler:
         finally:
             if telemetry is not None:
                 telemetry.stop()
+        if self.metrics is not None:
+            stats = dict(self.stats)
+            if self.paged:
+                stats["pool_peak_used_blocks"] = self.pool.peak_used
+                stats["pool_blocks"] = self.pool.n_blocks - 1
+                if self.prefix is not None:
+                    stats["prefix_entries"] = len(self.prefix)
+            self.metrics.set_engine_stats(stats)
         return self.finished
