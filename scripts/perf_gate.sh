@@ -32,6 +32,21 @@
 #   PERF_GATE_STRAGGLER_MAX watch --max-straggler for the planted-straggler
 #                           self-test (default 0.25; fixture index ~0.61)
 #
+# Failover leg (the HA telemetry plane gate):
+#   PERF_GATE_FAILOVER      1 (default) = run the kill-primary drill:
+#                           replay the committed 3-rank planted-straggler
+#                           fixture through a primary+standby aggregator
+#                           pair, kill the primary mid-stream, and REQUIRE
+#                           that the standby promotes (exactly one
+#                           aggregator_failover alert) AND that the
+#                           planted-straggler alert still fires after the
+#                           takeover — a failover that loses the alert is
+#                           a monitoring blackout, not HA.  0 = skip.
+#   PERF_GATE_FAILOVER_KILL_WINDOW   windows the primary closes before the
+#                           kill (default 2)
+#   PERF_GATE_FAILOVER_PROMOTE_MISS  missed primary heartbeats before the
+#                           standby promotes (default 2)
+#
 # Serve leg (the paged-KV serving tier gate):
 #   PERF_GATE_SERVE         1 (default) = run the serving bench, diff its
 #                           BENCH_serve JSON against the previous round,
@@ -151,7 +166,54 @@ PY
     fi
 fi
 
-# ---- 5. serve leg: the paged serving tier -----------------------------------
+# ---- 5. failover drill: the HA telemetry plane itself -----------------------
+if [ "${PERF_GATE_FAILOVER:-1}" = "1" ]; then
+    STRAGGLER_MAX="${PERF_GATE_STRAGGLER_MAX:-0.25}"
+    KILL_WINDOW="${PERF_GATE_FAILOVER_KILL_WINDOW:-2}"
+    PROMOTE_MISS="${PERF_GATE_FAILOVER_PROMOTE_MISS:-2}"
+    FIXTURES="$(ls tests/data/observability/doctor_rank*_trace_raw.jsonl)"
+    DRILL_OUT="$WORKDIR/ha_drill.jsonl"
+    echo "[perf_gate] failover drill: kill primary after window $KILL_WINDOW, promote after $PROMOTE_MISS misses" >&2
+    set +e
+    python -m theanompi_tpu.observability watch --replay $FIXTURES \
+        --ha-drill --replay-windows 6 \
+        --kill-primary-after "$KILL_WINDOW" --promote-after "$PROMOTE_MISS" \
+        --max-straggler "$STRAGGLER_MAX" --json \
+        > "$DRILL_OUT" 2> "$WORKDIR/ha_drill.err"
+    DRILL_RC=$?
+    set -e
+    if [ "$DRILL_RC" = "3" ]; then
+        echo "[perf_gate] FAILOVER VIOLATION: standby never promoted — killing the primary is a monitoring blackout" >&2
+        cat "$WORKDIR/ha_drill.err" >&2
+        exit 1
+    fi
+    if [ "$DRILL_RC" != "1" ]; then
+        echo "[perf_gate] FAILOVER VIOLATION: planted-straggler alert lost across the takeover (drill exit $DRILL_RC)" >&2
+        cat "$WORKDIR/ha_drill.err" >&2
+        exit 1
+    fi
+    # structure check: exactly ONE failover announcement, and the
+    # straggler alert present in a post-takeover (standby) window
+    python - "$DRILL_OUT" "$KILL_WINDOW" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1])]
+kill = int(sys.argv[2])
+fo = [a for v in rows for a in v.get("alerts", [])
+      if a.get("rule") == "aggregator_failover"]
+if len(fo) != 1:
+    sys.exit(f"[perf_gate] FAILOVER VIOLATION: {len(fo)} "
+             "aggregator_failover alert(s), want exactly 1")
+post = [a for v in rows if v.get("aggregator") == "standby"
+        for a in v.get("alerts", []) if a.get("rule") == "max_straggler"]
+if not post:
+    sys.exit("[perf_gate] FAILOVER VIOLATION: no straggler alert from "
+             "the promoted standby")
+print(f"[perf_gate] failover: promoted at window {fo[0].get('window')}, "
+      f"{len(post)} post-takeover straggler alert(s)", file=sys.stderr)
+PY
+fi
+
+# ---- 6. serve leg: the paged serving tier -----------------------------------
 if [ "${PERF_GATE_SERVE:-1}" = "1" ]; then
     SERVE_JSON="${PERF_GATE_SERVE_JSON:-}"
     if [ -z "$SERVE_JSON" ]; then

@@ -682,3 +682,151 @@ def test_bench_compare_cli_subprocess(tmp_path):
     assert proc.returncode == 1
     doc = json.loads(proc.stdout)
     assert doc["regressions"] == ["transformer_serve_tokens_per_sec"]
+
+
+# ---------------------------------------------------------------------------
+# durable doctor state: snapshot()/restore() (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _streamed_doctor(n_windows=4):
+    """The golden fixture through a StreamingDoctor, replay-style."""
+    doctor = analysis.StreamingDoctor()
+    streams = []
+    for label, lines in _named_fixtures():
+        events = [
+            json.loads(l) for l in lines
+            if json.loads(l).get("ph") in ("X", "C", "s", "f")
+        ]
+        events.sort(
+            key=lambda e: float(e.get("ts", 0.0))
+            + float(e.get("dur", 0.0))
+        )
+        streams.append((label, events))
+    for k in range(n_windows):
+        for label, events in streams:
+            lo = (k * len(events)) // n_windows
+            hi = ((k + 1) * len(events)) // n_windows
+            doctor.feed(label, events[lo:hi])
+        doctor.close_window()
+    return doctor
+
+
+def test_doctor_snapshot_restore_reproduces_report_exactly():
+    """THE durability acceptance: restore(snapshot()) — through a full
+    JSON round-trip, as the checkpoint file does it — reproduces the
+    cumulative report EXACTLY (==, not approx) on the golden fixture,
+    and that report is the post-mortem one."""
+    doctor = _streamed_doctor()
+    snap = json.loads(json.dumps(doctor.snapshot()))
+    restored = analysis.StreamingDoctor.restore(snap)
+    assert restored.cumulative() == doctor.cumulative()
+    # and the restored doctor keeps agreeing with the OFFLINE report
+    exact = analysis.analyze(_named_fixtures())
+    cum = restored.cumulative()
+    assert cum["stragglers"] == exact["stragglers"]
+    assert cum["stalls"] == exact["stalls"]
+    assert cum["flows"]["matched"] == exact["flows"]["matched"]
+    for label, ra in exact["ranks"].items():
+        for cat, frac in ra["fractions"].items():
+            assert cum["ranks"][label]["fractions"][cat] == \
+                pytest.approx(frac, abs=1e-9)
+
+
+def test_doctor_restore_continues_the_stream():
+    """A restored doctor is not a museum piece: window numbering
+    continues and fresh feeds land on the restored cumulative state
+    exactly as they would have on the original."""
+    a = _streamed_doctor()
+    b = analysis.StreamingDoctor.restore(
+        json.loads(json.dumps(a.snapshot()))
+    )
+    extra = [
+        {"ph": "X", "name": "train_iter", "ts": 1_000_000.0,
+         "dur": 9_000.0},
+        {"ph": "X", "name": "train_iter", "ts": 1_010_000.0,
+         "dur": 9_000.0},
+    ]
+    a.feed("doctor_rank0", list(extra))
+    b.feed("doctor_rank0", list(extra))
+    va, vb = a.close_window(), b.close_window()
+    assert va == vb
+    assert vb["window"] == 5
+    assert a.cumulative() == b.cumulative()
+
+
+def test_doctor_snapshot_survives_forced_freeze():
+    """Snapshot after the bounded-memory freeze path collapsed interval
+    detail: frozen totals round-trip too."""
+    doctor = analysis.StreamingDoctor()
+    doctor.MAX_LIVE_INTERVALS = 2
+    streams = _named_fixtures()
+    for label, lines in streams:
+        events = [
+            json.loads(l) for l in lines
+            if json.loads(l).get("ph") in ("X", "C", "s", "f")
+        ]
+        doctor.feed(label, events)
+        doctor.close_window()
+    restored = analysis.StreamingDoctor.restore(
+        json.loads(json.dumps(doctor.snapshot()))
+    )
+    assert restored.cumulative() == doctor.cumulative()
+    assert any(
+        acc.t_frozen is not None for acc in restored.ranks.values()
+    )
+
+
+def test_doctor_snapshot_carries_open_stall_tracker():
+    """A stall OPEN at snapshot time (depth never drained) stays open
+    across restore: the next drain sample closes it with the original
+    start timestamp."""
+    d = analysis.StreamingDoctor()
+    d.feed("r0", [
+        {"ph": "C", "name": "inbox_depth", "ts": 1_000.0,
+         "args": {"rank": 0, "value": 3.0}},
+    ])
+    d.close_window()
+    r = analysis.StreamingDoctor.restore(
+        json.loads(json.dumps(d.snapshot()))
+    )
+    r.feed("r0", [
+        {"ph": "C", "name": "inbox_depth", "ts": 9_000.0,
+         "args": {"rank": 0, "value": 0.0}},
+    ])
+    v = r.close_window()
+    assert len(v["stalls"]) == 1
+    assert v["stalls"][0]["start_s"] == pytest.approx(0.001)
+    assert v["stalls"][0]["end_s"] == pytest.approx(0.009)
+    assert "ongoing" not in v["stalls"][0]
+
+
+def test_doctor_restore_refuses_unknown_version():
+    doctor = analysis.StreamingDoctor()
+    snap = doctor.snapshot()
+    snap["v"] = 999
+    with pytest.raises(ValueError, match="version"):
+        analysis.StreamingDoctor.restore(snap)
+    with pytest.raises(ValueError, match="not a StreamingDoctor"):
+        analysis.StreamingDoctor.restore({"kind": "junk"})
+
+
+def test_final_close_window_flushes_open_stalls():
+    """close_window(final=True) closes a still-open stall at its last
+    sample as a REAL row (offline StallTracker.flush semantics) —
+    and it lands in the cumulative stall list exactly once."""
+    d = analysis.StreamingDoctor()
+    d.feed("r0", [
+        {"ph": "C", "name": "inbox_depth", "ts": 2_000.0,
+         "args": {"rank": 0, "value": 5.0}},
+        {"ph": "C", "name": "inbox_depth", "ts": 8_000.0,
+         "args": {"rank": 0, "value": 7.0}},
+    ])
+    v = d.close_window(final=True)
+    assert len(v["stalls"]) == 1
+    row = v["stalls"][0]
+    assert "ongoing" not in row
+    assert row["start_s"] == pytest.approx(0.002)
+    assert row["end_s"] == pytest.approx(0.008)
+    assert row["max_depth"] == 7.0
+    cum = d.cumulative()
+    assert len(cum["stalls"]) == 1

@@ -1,0 +1,275 @@
+"""`observability history` — the queryable run history (ISSUE 9).
+
+Acceptance: timelines (including rotated segments) read back as one
+run; `history list/show/alerts` summarize without re-running anything;
+`history diff` exits nonzero on a planted cross-run straggler
+regression through its threshold flags.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from theanompi_tpu.observability import history, live
+from theanompi_tpu.observability.__main__ import main as cli_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _verdict(window, straggler=0.1, overlap=0.5, alerts=(),
+             stalls=(), ttft_p99=None, dead=None):
+    v = {
+        "window": window,
+        "t_wall": 1000.0 + window,
+        "ranks": {
+            "rank0": {"steps": {"n": 5, "mean_s": 0.01},
+                      "fractions": {"compute": 0.8, "comm": 0.1,
+                                    "input_wait": 0.0, "idle": 0.1},
+                      "comm_compute_overlap": overlap},
+        },
+        "stalls": list(stalls),
+        "stragglers": {"max_straggler_index": straggler,
+                       "straggler_rank": "rank1", "per_rank": {},
+                       "n_common_steps": 5},
+        "alerts": [
+            {"rule": rule, "rank": "rank1", "value": 1.0,
+             "threshold": 0.5, "message": f"{rule} fired",
+             "window": window}
+            for rule in alerts
+        ],
+    }
+    if ttft_p99 is not None:
+        v["serving"] = {"ttft": {"count": 10, "p50_s": ttft_p99 / 2,
+                                 "p99_s": ttft_p99,
+                                 "estimator": "histogram"}}
+    if dead:
+        v["dead_ranks"] = list(dead)
+    return v
+
+
+def _write_run(path, verdicts):
+    with open(path, "w") as f:
+        for v in verdicts:
+            f.write(json.dumps(v) + "\n")
+    return str(path)
+
+
+@pytest.fixture
+def runs(tmp_path):
+    a = _write_run(
+        tmp_path / "runA_verdicts.jsonl",
+        [_verdict(w, straggler=0.05 * w, ttft_p99=0.02)
+         for w in range(1, 5)],
+    )
+    b = _write_run(
+        tmp_path / "runB_verdicts.jsonl",
+        [_verdict(w, straggler=0.2 * w, overlap=0.1,
+                  alerts=("max_straggler",) if w > 2 else (),
+                  ttft_p99=0.05)
+         for w in range(1, 5)],
+    )
+    return str(tmp_path), a, b
+
+
+# ---------------------------------------------------------------------------
+# reading timelines (incl. rotation)
+# ---------------------------------------------------------------------------
+
+def test_iter_timeline_reads_across_rotated_segments(tmp_path):
+    path = str(tmp_path / "run_verdicts.jsonl")
+    log = live.VerdictLog(path, max_bytes=600, max_segments=3)
+    for w in range(1, 31):
+        log.append(_verdict(w))
+    assert log.rotations > 0
+    windows = [v["window"] for v in history.iter_timeline(path)]
+    assert windows == sorted(windows)
+    assert windows[-1] == 30
+    # every row read back from SOME segment, none duplicated
+    assert len(windows) == len(set(windows))
+
+
+def test_iter_timeline_skips_corrupt_lines(tmp_path):
+    path = tmp_path / "run_verdicts.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_verdict(1)) + "\n")
+        f.write("{truncated by a cra")
+        f.write("\n")
+        f.write(json.dumps(_verdict(2)) + "\n")
+    assert [v["window"] for v in history.iter_timeline(str(path))] == \
+        [1, 2]
+
+
+def test_discover_and_resolve_runs(runs):
+    d, a, b = runs
+    found = history.discover_runs(d)
+    assert sorted(os.path.basename(p) for p in found) == [
+        "runA_verdicts.jsonl", "runB_verdicts.jsonl"
+    ]
+    assert history.resolve_run(a, d) == a
+    assert history.resolve_run("runA", d) == a
+    assert history.resolve_run("runA_verdicts.jsonl", d) == a
+    assert history.resolve_run("nonexistent", d) is None
+
+
+# ---------------------------------------------------------------------------
+# summaries + diff
+# ---------------------------------------------------------------------------
+
+def test_summarize_run_trends(runs):
+    _, a, _ = runs
+    s = history.summarize(history.read_timeline(a))
+    assert s["windows"] == 4
+    assert s["straggler"]["final_index"] == pytest.approx(0.2)
+    assert s["straggler"]["peak_index"] == pytest.approx(0.2)
+    assert s["overlap"]["min"] == pytest.approx(0.5)
+    assert s["serving"]["ttft_p99_max_s"] == pytest.approx(0.02)
+    assert s["alerts"]["total"] == 0
+    assert s["steps_total"] == 20
+    assert s["ranks"] == ["rank0"]
+
+
+def test_diff_flags_planted_straggler_regression(runs):
+    _, a, b = runs
+    sa = history.summarize(history.read_timeline(a))
+    sb = history.summarize(history.read_timeline(b))
+    res = history.diff(sa, sb, max_straggler_increase=0.2)
+    assert len(res["violations"]) == 1
+    assert "straggler" in res["violations"][0]
+    # within tolerance: silent
+    assert history.diff(sa, sb, max_straggler_increase=2.0) == {
+        "rows": res["rows"], "violations": []
+    }
+    # other flags
+    res = history.diff(sa, sb, max_overlap_drop=0.1)
+    assert any("overlap" in v for v in res["violations"])
+    res = history.diff(sa, sb, max_new_alerts=1)
+    assert any("alerts" in v for v in res["violations"])
+    res = history.diff(sa, sb, max_ttft_p99_increase_s=0.01)
+    assert any("ttft" in v for v in res["violations"])
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_history_cli_list_and_show(runs, capsys):
+    d, a, _ = runs
+    rc = cli_main(["history", "list", "--dir", d])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "runA_verdicts.jsonl" in out and "runB_verdicts.jsonl" in out
+    rc = cli_main(["history", "show", "runA", "--dir", d])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "windows 4" in out
+    rc = cli_main(["history", "show", "runA", "--dir", d, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["windows"] == 4
+    assert len(doc["windows"]) == 4
+
+
+def test_history_cli_alerts(runs, capsys):
+    d, _, b = runs
+    rc = cli_main(["history", "alerts", "runB", "--dir", d])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "max_straggler" in out and "2 alert(s)" in out
+
+
+def test_history_cli_list_empty_dir(tmp_path, capsys):
+    rc = cli_main(["history", "list", "--dir", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_history_cli_show_missing_run(runs, capsys):
+    d, _, _ = runs
+    rc = cli_main(["history", "show", "ghost", "--dir", d])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "no such run" in err
+
+
+def test_history_cli_diff_exit_codes(runs, capsys):
+    """THE acceptance: `history diff` exits nonzero on a planted
+    cross-run straggler regression — the round-over-round verdict
+    source for perf_gate and the self-tuning driver."""
+    d, a, b = runs
+    rc = cli_main([
+        "history", "diff", "runA", "runB", "--dir", d,
+        "--max-straggler-increase", "0.2",
+    ])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "HISTORY REGRESSION" in captured.err
+    assert "straggler" in captured.err
+    # no flags: informational, exit 0
+    rc = cli_main(["history", "diff", "runA", "runB", "--dir", d])
+    capsys.readouterr()
+    assert rc == 0
+    # JSON shape
+    rc = cli_main([
+        "history", "diff", "runA", "runB", "--dir", d, "--json",
+        "--max-straggler-increase", "0.2",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["violations"]
+    assert any(
+        r["key"] == "straggler.final_index" for r in doc["rows"]
+    )
+
+
+def test_history_cli_subprocess_smoke(runs):
+    """Tier-1 smoke of the actual CLI entry over a real timeline."""
+    d, _, _ = runs
+    proc = subprocess.run(
+        [sys.executable, "-m", "theanompi_tpu.observability",
+         "history", "diff", "runA", "runB", "--dir", d,
+         "--max-straggler-increase", "0.2"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1
+    assert "HISTORY REGRESSION" in proc.stderr
+
+
+def test_history_reads_live_drill_timeline(tmp_path):
+    """End-to-end: the HA drill's persisted primary+standby timelines
+    are valid history inputs (what the gate's failover leg leaves on
+    disk is queryable afterwards)."""
+    fixtures = [
+        os.path.join(REPO_ROOT, "tests", "data", "observability",
+                     f"doctor_rank{r}_trace_raw.jsonl")
+        for r in range(3)
+    ]
+    per_rank = []
+    for p in fixtures:
+        label = os.path.basename(p)[: -len("_trace_raw.jsonl")]
+        events = [
+            json.loads(l) for l in open(p)
+            if json.loads(l).get("ph") in ("X", "C", "s", "f")
+        ]
+        events.sort(key=lambda e: float(e.get("ts", 0.0))
+                    + float(e.get("dur", 0.0)))
+        per_rank.append((label, events, 1, 0))
+    res = live.ha_replay_drill(
+        per_rank, n_windows=6, kill_after=2, promote_after=2,
+        thresholds={"max_straggler": 0.25},
+        persist_primary=str(tmp_path / "pri.jsonl"),
+        persist_standby=str(tmp_path / "stb.jsonl"),
+        log=lambda line: None,
+    )
+    assert res["promoted"]
+    sp = history.summarize(
+        history.read_timeline(str(tmp_path / "pri.jsonl"))
+    )
+    ss = history.summarize(
+        history.read_timeline(str(tmp_path / "stb.jsonl"))
+    )
+    assert sp["windows"] + ss["windows"] >= 5  # <= 1 window lost
+    assert ss["alerts"]["by_rule"].get("aggregator_failover") == 1
+    assert ss["alerts"]["by_rule"].get("max_straggler", 0) >= 1

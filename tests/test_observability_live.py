@@ -845,6 +845,491 @@ def test_request_reply_flow_arrows(global_tracing):
     assert all(fid.startswith(f"rpc:{pid}:") for fid in begins)
 
 
+# ---------------------------------------------------------------------------
+# HA: shipper endpoint failover (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _fixture_replay_streams():
+    """(label, events, sample_rate, dropped) — the drill input shape."""
+    return [(label, events, 1, 0) for label, events in _fixture_streams()]
+
+
+def test_parse_endpoints_single_and_list():
+    assert live.parse_endpoints("127.0.0.1:9411") == [("127.0.0.1", 9411)]
+    assert live.parse_endpoints("h1:1, h2:2,h3:3") == [
+        ("h1", 1), ("h2", 2), ("h3", 3)
+    ]
+    assert live.parse_endpoints(":9411") == [("127.0.0.1", 9411)]
+    with pytest.raises(ValueError, match="cannot parse"):
+        live.parse_endpoints("nope")
+    with pytest.raises(ValueError, match="no endpoints"):
+        live.parse_endpoints(" , ")
+
+
+def test_shipper_fails_over_on_tcp_refusal(global_tracing):
+    """Endpoint 0 hard-refuses (nothing listening): the drop is counted
+    against it and the SAME beat lands the frame on endpoint 1 — one
+    frame of telemetry never becomes a monitoring blackout."""
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    standby = live.Aggregator(log=lambda line: None)
+    dead_port = find_free_port()
+    live_port = find_free_port()
+    channel = standby.serve(live_port)
+    shipper = live.TelemetryShipper(
+        "rank0",
+        address=[("127.0.0.1", dead_port), ("127.0.0.1", live_port)],
+        period_s=999, ship_timeout_s=2.0,
+    ).start()
+    try:
+        with obs.span("train_iter", iter=0):
+            time.sleep(0.001)
+        assert shipper.flush() is True  # shipped, despite the refusal
+        assert shipper.endpoint_failures[0] >= 1
+        assert shipper.failovers == 1
+        assert shipper.failed == 0  # a failover is not a lost frame
+        assert standby.view["rank0"].frames == 1
+        # sticky: the next beat goes straight to the standby
+        assert shipper.flush() is True
+        assert standby.view["rank0"].frames == 2
+        assert shipper.failovers == 1
+    finally:
+        shipper.stop()
+        channel.close()
+
+
+def test_shipper_fails_over_on_slow_accept_timeout(global_tracing):
+    """Endpoint 0 accepts the connection but never replies (a wedged
+    aggregator, not a dead one): the ship TIMEOUT counts a drop and
+    fails over within one period — and never raises into the caller
+    (the training thread)."""
+    import socket
+
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    standby = live.Aggregator(log=lambda line: None)
+    live_port = find_free_port()
+    channel = standby.serve(live_port)
+    # a listener whose backlog accepts the TCP handshake but whose
+    # reply never comes
+    wedged = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    wedged.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    wedged.bind(("127.0.0.1", 0))
+    wedged.listen(8)
+    wedged_port = wedged.getsockname()[1]
+    period_s = 5.0
+    shipper = live.TelemetryShipper(
+        "rank0",
+        address=[("127.0.0.1", wedged_port), ("127.0.0.1", live_port)],
+        period_s=period_s, ship_timeout_s=0.4,
+    ).start()
+    try:
+        t0 = time.perf_counter()
+        assert shipper.flush() is True
+        elapsed = time.perf_counter() - t0
+        assert elapsed < period_s  # moved on within one period
+        assert shipper.endpoint_failures[0] >= 1
+        assert shipper.failovers == 1
+        assert standby.view["rank0"].frames == 1
+    finally:
+        shipper.stop()
+        channel.close()
+        wedged.close()
+
+
+def test_maybe_start_from_env_endpoint_ladder(global_tracing):
+    """THEANOMPI_LIVE_AGG accepts a comma-separated ladder; a single
+    host:port keeps its original meaning."""
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    p1, p2 = find_free_port(), find_free_port()
+    handle = live.maybe_start_from_env("rank7", env={
+        "THEANOMPI_LIVE_AGG": f"127.0.0.1:{p1},127.0.0.1:{p2}",
+        "THEANOMPI_LIVE_PERIOD_S": "999",
+    })
+    try:
+        assert handle.shipper.addresses == [
+            ("127.0.0.1", p1), ("127.0.0.1", p2)
+        ]
+    finally:
+        handle.stop()
+    handle = live.maybe_start_from_env("rank7", env={
+        "THEANOMPI_LIVE_AGG": f"127.0.0.1:{p1}",
+        "THEANOMPI_LIVE_PERIOD_S": "999",
+    })
+    try:
+        assert handle.shipper.addresses == [("127.0.0.1", p1)]
+    finally:
+        handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# HA: standby shadow + promotion
+# ---------------------------------------------------------------------------
+
+def test_primary_forwards_frames_to_standby_peer(global_tracing):
+    """A primary with an in-process peer shadow-feeds it every frame:
+    the standby's rank view and doctor see exactly what the primary
+    saw, so a takeover starts warm."""
+    standby = live.Aggregator(
+        role="standby", name="stb", log=lambda line: None
+    )
+    primary = live.Aggregator(
+        role="primary", name="pri", peers=[standby],
+        log=lambda line: None,
+    )
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=primary, period_s=999
+    ).start()
+    try:
+        for i in range(3):
+            with obs.span("train_iter", iter=i):
+                time.sleep(0.001)
+        shipper.flush()
+        assert primary.view["rank0"].frames == 1
+        assert standby.view["rank0"].frames == 1
+        vp = primary.close_window()  # also heartbeats the standby
+        vs = standby.close_window()
+        assert vp["ranks"]["rank0"]["steps"]["n"] == 3
+        assert vs["ranks"]["rank0"]["steps"]["n"] == 3
+        assert standby.role == "standby"  # hb seen: no promotion
+        assert standby._missed_hb == 0
+    finally:
+        shipper.stop()
+
+
+def test_primary_forwards_over_tcp_to_standby(global_tracing):
+    """Address peers ride the forwarder thread + transport: frames and
+    window heartbeats reach a standby listening on a real port."""
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    standby = live.Aggregator(
+        role="standby", name="tcp_stb", log=lambda line: None
+    )
+    port = find_free_port()
+    channel = standby.serve(port)
+    primary = live.Aggregator(
+        role="primary", name="tcp_pri", peers=[("127.0.0.1", port)],
+        log=lambda line: None,
+    )
+    shipper = live.TelemetryShipper(
+        "rank0", aggregator=primary, period_s=999
+    ).start()
+    try:
+        with obs.span("train_iter", iter=0):
+            time.sleep(0.001)
+        shipper.flush()
+        primary.close_window()  # queues the hb
+        deadline = time.time() + 30
+        while time.time() < deadline and (
+            standby.view.get("rank0") is None
+            or standby._primary_window < 1
+        ):
+            time.sleep(0.01)
+        assert standby.view["rank0"].frames == 1
+        assert standby._primary_window == 1  # hb landed
+        assert primary.forward_failures == 0
+    finally:
+        shipper.stop()
+        primary.close_forwarder()
+        channel.close()
+
+
+def test_standby_promotes_after_missed_heartbeats_once(global_tracing):
+    """promote_after heartbeat-less window closes promote the standby
+    EXACTLY once, with one structured aggregator_failover alert; a
+    heartbeat arriving in time resets the miss counter."""
+    standby = live.Aggregator(
+        role="standby", name="stb2", promote_after=2,
+        log=lambda line: None,
+    )
+    primary = live.Aggregator(
+        role="primary", name="pri2", peers=[standby],
+        log=lambda line: None,
+    )
+    primary.close_window()  # hb #1
+    v1 = standby.close_window()
+    assert standby.role == "standby" and not v1["alerts"]
+    # primary dies here: no more heartbeats
+    v2 = standby.close_window()  # miss 1
+    assert standby.role == "standby" and not v2["alerts"]
+    v3 = standby.close_window()  # miss 2 -> promote
+    assert standby.role == "primary"
+    fo = [a for a in v3["alerts"] if a["rule"] == "aggregator_failover"]
+    assert len(fo) == 1
+    assert fo[0]["threshold"] == 2
+    assert standby.promoted_at_window == v3["window"]
+    # no second announcement
+    v4 = standby.close_window()
+    assert not [
+        a for a in v4["alerts"] if a["rule"] == "aggregator_failover"
+    ]
+
+
+def test_aggregator_role_gauge_and_self_telemetry(global_tracing):
+    from theanompi_tpu.observability.metrics import get_registry
+
+    standby = live.Aggregator(
+        role="standby", name="roletest", promote_after=1,
+        log=lambda line: None,
+    )
+    reg = get_registry()
+    assert reg.gauge("aggregator_role").value(name="roletest") == 0.0
+    standby.close_window()  # miss 1 -> promote
+    assert reg.gauge("aggregator_role").value(name="roletest") == 1.0
+    h = standby.health()
+    assert h["role"] == "primary"
+    assert h["self"]["promoted_at_window"] == 1
+    assert "frames_ingested" in h["self"]
+    assert "window_close_p99_s" in h["self"]
+
+
+def test_ingest_rejects_non_aggregator_role():
+    with pytest.raises(ValueError, match="role"):
+        live.Aggregator(role="leader")
+
+
+# ---------------------------------------------------------------------------
+# HA: the kill-primary golden drill (THE ISSUE 9 acceptance shape)
+# ---------------------------------------------------------------------------
+
+def _strip_verdict(v):
+    """Comparable verdict: drop wall clocks and the failover
+    announcement (the one alert the uninterrupted run cannot have)."""
+    v = dict(v)
+    v.pop("t_wall", None)
+    v["alerts"] = [
+        {k: a.get(k) for k in ("rule", "rank", "value", "threshold")}
+        for a in v.get("alerts", [])
+        if a.get("rule") != "aggregator_failover"
+    ]
+    return v
+
+
+def test_kill_primary_loses_at_most_one_window(tmp_path, global_tracing):
+    """The failover golden test: killing the primary mid-stream yields
+    exactly one aggregator_failover alert and a combined persisted
+    verdict timeline identical to the uninterrupted run except <= 1
+    missing window — and the planted-straggler alert keeps firing
+    after the takeover."""
+    per_rank = _fixture_replay_streams()
+    thresholds = {"max_straggler": 0.25}
+    # uninterrupted reference run, persisted
+    ref_path = str(tmp_path / "uninterrupted.jsonl")
+    ref = live.Aggregator(
+        thresholds=thresholds, log=lambda line: None,
+        persist_path=ref_path, name="ref",
+    )
+    n_win = 6
+    for k in range(n_win):
+        for label, events, sample_rate, dropped in per_rank:
+            lo = (k * len(events)) // n_win
+            hi = ((k + 1) * len(events)) // n_win
+            ref.ingest(live.frames_from_events(
+                label, events[lo:hi], seq=k + 1
+            ))
+        ref.close_window(final=(k == n_win - 1))
+    res = live.ha_replay_drill(
+        per_rank, n_windows=n_win, kill_after=2,
+        thresholds=thresholds, promote_after=2,
+        persist_primary=str(tmp_path / "primary.jsonl"),
+        persist_standby=str(tmp_path / "standby.jsonl"),
+        checkpoint_path=str(tmp_path / "ckpt.json"),
+        log=lambda line: None,
+    )
+    assert res["promoted"] is True
+    assert res["failover_alerts"] == 1
+    with open(ref_path) as f:
+        reference = [json.loads(l) for l in f]
+    combined = {}
+    for name in ("primary.jsonl", "standby.jsonl"):
+        with open(tmp_path / name) as f:
+            for line in f:
+                row = json.loads(line)
+                combined[row["window"]] = row
+    missing = [
+        r["window"] for r in reference if r["window"] not in combined
+    ]
+    assert len(missing) <= 1  # <= promote_after - 1
+    for r in reference:
+        if r["window"] in combined:
+            assert _strip_verdict(combined[r["window"]]) == \
+                _strip_verdict(r)
+    # the planted straggler still pages after the takeover
+    post = [
+        a for who, v in res["verdicts"] if who == "standby"
+        for a in v["alerts"] if a["rule"] == "max_straggler"
+    ]
+    assert post, "straggler alert lost across the failover"
+    # and the standby's cumulative verdict matches the reference's
+    assert res["standby"].doctor.cumulative() == \
+        ref.doctor.cumulative()
+
+
+def test_drill_without_promotion_is_a_blackout(global_tracing):
+    res = live.ha_replay_drill(
+        _fixture_replay_streams(), n_windows=6, kill_after=2,
+        promote_after=99, log=lambda line: None,
+    )
+    assert res["promoted"] is False
+    assert res["failover_alerts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# HA: checkpoint + resume (restarted aggregator)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_and_resume_rebuild_cumulative_state(
+    tmp_path, global_tracing
+):
+    """A restarted aggregator resumes from checkpoint + timeline:
+    cumulative doctor report identical, window numbering continuing,
+    rank views restored."""
+    per_rank = _fixture_replay_streams()
+    ckpt = str(tmp_path / "agg_ckpt.json")
+    timeline = str(tmp_path / "timeline.jsonl")
+    agg = live.Aggregator(
+        log=lambda line: None, persist_path=timeline,
+        checkpoint_path=ckpt, name="ck1",
+    )
+    n_win = 4
+    for k in range(n_win):
+        for label, events, sr, dr in per_rank:
+            lo = (k * len(events)) // n_win
+            hi = ((k + 1) * len(events)) // n_win
+            agg.ingest(live.frames_from_events(
+                label, events[lo:hi], seq=k + 1
+            ))
+        agg.close_window()
+    assert agg.checkpoints_written == n_win
+    assert os.path.exists(ckpt)
+    fresh = live.Aggregator(log=lambda line: None, name="ck2")
+    info = fresh.resume(ckpt, timeline)
+    assert info["checkpoint_window"] == n_win
+    assert info["resumed_window"] == n_win
+    assert sorted(fresh.view) == sorted(agg.view)
+    assert fresh.view["doctor_rank0"].frames == \
+        agg.view["doctor_rank0"].frames
+    assert fresh.doctor.cumulative() == agg.doctor.cumulative()
+    assert len(fresh.windows) == n_win  # ring refilled from timeline
+    v = fresh.close_window()
+    assert v["window"] == n_win + 1  # numbering never collides
+
+
+def test_resume_refuses_unknown_checkpoint_version(tmp_path):
+    bad = tmp_path / "ckpt.json"
+    bad.write_text(json.dumps(
+        {"kind": live.CHECKPOINT_KIND, "v": 999, "doctor": {}}
+    ))
+    agg = live.Aggregator(log=lambda line: None)
+    with pytest.raises(ValueError, match="version"):
+        agg.resume(str(bad))
+    bad.write_text(json.dumps({"some": "junk"}))
+    with pytest.raises(ValueError, match="not an aggregator"):
+        agg.resume(str(bad))
+
+
+def test_checkpoint_write_failure_counted_not_raised(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("")
+    agg = live.Aggregator(
+        log=lambda line: None,
+        checkpoint_path=str(blocker / "ckpt.json"),
+    )
+    v = agg.close_window()  # must not raise
+    assert v["window"] == 1
+    assert agg.checkpoint_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# VerdictLog rotation (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_verdict_log_rotates_within_byte_budget(tmp_path):
+    """Size-capped segments: the active file rotates at max_bytes, at
+    most max_segments rotated files are kept (oldest dropped), and the
+    history reader walks segments oldest-first transparently."""
+    from theanompi_tpu.observability import history
+
+    path = str(tmp_path / "verdicts.jsonl")
+    log = live.VerdictLog(path, max_bytes=400, max_segments=2)
+    for w in range(1, 41):
+        assert log.append({"window": w, "pad": "x" * 60})
+    assert log.written == 40
+    assert log.rotations > 0
+    segs = live.VerdictLog.segment_paths(path)
+    assert segs[-1] == path
+    assert len(segs) <= 3  # .2, .1, base
+    for seg in segs:
+        assert os.path.getsize(seg) <= 400 + 100  # one-row slack
+    rows = list(history.iter_timeline(path))
+    windows = [r["window"] for r in rows]
+    assert windows == sorted(windows)  # oldest-first across segments
+    assert windows[-1] == 40  # newest never dropped
+    assert len(windows) < 40  # oldest segments were reclaimed
+
+
+def test_verdict_log_without_budget_never_rotates(tmp_path):
+    path = str(tmp_path / "verdicts.jsonl")
+    log = live.VerdictLog(path)
+    for w in range(50):
+        log.append({"window": w, "pad": "x" * 100})
+    assert log.rotations == 0
+    assert live.VerdictLog.segment_paths(path) == [path]
+
+
+# ---------------------------------------------------------------------------
+# replay tail-window flush (ISSUE 9 satellite fix)
+# ---------------------------------------------------------------------------
+
+def _never_draining_rank_lines():
+    """A rank whose inbox backs up and NEVER drains: the offline doctor
+    flushes the tail stall; replay must match instead of dropping it."""
+    rows = [{"kind": "header", "pid": 7, "process_name": "stuck",
+             "tracks": {"0": "MAIN"}, "dropped": 0}]
+    for k in range(4):
+        rows.append({"ph": "X", "name": "train_iter",
+                     "ts": k * 10_000.0, "dur": 9_000.0,
+                     "pid": 7, "tid": 0})
+    rows.append({"ph": "C", "name": "inbox_depth", "ts": 15_000.0,
+                 "pid": 7, "tid": 0, "args": {"rank": 7, "value": 4.0}})
+    rows.append({"ph": "C", "name": "inbox_depth", "ts": 39_000.0,
+                 "pid": 7, "tid": 0, "args": {"rank": 7, "value": 6.0}})
+    return [json.dumps(r) + "\n" for r in rows]
+
+
+def test_replay_flushes_tail_stall_window(tmp_path, capsys):
+    """`watch --replay` on a trace with a never-drained inbox emits one
+    extra FINAL window carrying the closed tail stall, so replay stall
+    counts match the offline doctor on the same trace."""
+    from theanompi_tpu.observability.__main__ import main as cli_main
+
+    trace = tmp_path / "stuck_trace_raw.jsonl"
+    trace.write_text("".join(_never_draining_rank_lines()))
+    rc = cli_main(["watch", "--replay", str(trace), "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    verdicts = [json.loads(l) for l in captured.out.splitlines()]
+    assert len(verdicts) == 5  # 4 chunks + the tail flush
+    tail = verdicts[-1]
+    assert len(tail["stalls"]) == 1
+    assert tail["stalls"][0]["end_s"] == pytest.approx(0.039)
+    assert "ongoing" not in tail["stalls"][0]
+    # offline parity: same one stall, same bounds
+    offline = analysis.analyze(
+        [("stuck", _never_draining_rank_lines())]
+    )
+    assert len(offline["stalls"]) == 1
+    assert tail["stalls"][0]["start_s"] == \
+        offline["stalls"][0]["start_s"]
+    assert tail["stalls"][0]["end_s"] == offline["stalls"][0]["end_s"]
+    # the committed (drained) fixture is unchanged: still 4 windows
+    rc = cli_main(["watch", "--replay", *FIXTURES, "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert len(captured.out.splitlines()) == 4
+
+
 def test_request_reply_survives_tracing_toggle():
     """A frame sent while tracing was ON decodes cleanly on a server
     after tracing turns OFF (and vice versa) — the envelope is always

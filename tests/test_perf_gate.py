@@ -290,3 +290,68 @@ def test_gate_serve_missing_baseline_skips_diff_not_slos(fixtures, tmp_path):
     assert r.returncode == 0, r.stderr
     assert "skipping serve diff" in r.stderr
     assert "paged acceptance" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# failover leg (ISSUE 9): the kill-primary drill — the gate must prove
+# the HA plane promotes a standby AND keeps the planted-straggler alert
+# ---------------------------------------------------------------------------
+
+def test_gate_failover_leg_green(fixtures):
+    """Default-on failover drill: the committed fixture promotes the
+    standby and the straggler alert survives the takeover."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",  # isolate the failover leg
+    })
+    assert r.returncode == 0, r.stderr
+    assert "failover: promoted at window" in r.stderr
+    assert "post-takeover straggler alert" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_failover_leg_detects_blackout(fixtures):
+    """A standby that never promotes (promotion threshold unreachable)
+    is a monitoring blackout — the gate must fail, not pass green."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER_PROMOTE_MISS": "999",
+    })
+    assert r.returncode != 0
+    assert "blackout" in r.stderr
+
+
+def test_gate_failover_leg_detects_lost_alert(fixtures):
+    """A drill that promotes but fires no straggler alert (threshold
+    unreachable) means the alert was lost across the takeover — fail."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_STRAGGLER_MAX": "10.0",  # fixture index ~0.61
+    })
+    assert r.returncode != 0
+    # the drill still exits 1 (the failover announcement itself is an
+    # alert), so the loss is caught by the structure check
+    assert "FAILOVER VIOLATION" in r.stderr
+    assert "no straggler alert" in r.stderr
+
+
+def test_gate_failover_leg_skippable(fixtures):
+    """PERF_GATE_FAILOVER=0 restores the pre-HA gate behavior."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "failover drill" not in r.stderr
+    assert "green" in r.stderr

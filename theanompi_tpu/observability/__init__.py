@@ -15,11 +15,14 @@ three primitives:
   ``dump()``.
 
 plus ``export`` (file dumps + an opt-in localhost HTTP endpoint incl.
-``/health``), ``live`` (the live telemetry plane: per-rank frame
-shipping, the rank-0 aggregator with the streaming doctor, the SLO
-watchdog — import as a submodule, ``from theanompi_tpu.observability
-import live``), and a CLI (``python -m theanompi_tpu.observability
-dump --format chrome`` / ``watch`` / ``doctor`` / ``merge``).
+``/health`` and ``/timeline``), ``live`` (the live telemetry plane:
+per-rank frame shipping with HA endpoint failover, primary/standby
+aggregators with the streaming doctor, the SLO watchdog, doctor-state
+checkpoints — import as a submodule, ``from theanompi_tpu.observability
+import live``), ``history`` (queryable run history over the persisted
+verdict timelines), and a CLI (``python -m theanompi_tpu.observability
+dump --format chrome`` / ``watch`` / ``doctor`` / ``merge`` /
+``history``).
 
 **Event bus**: ``publish_event(kind, fields)`` fans one structured
 event out to every surface (instant trace event, flight ring, the

@@ -38,14 +38,32 @@ Commands:
   ``--persist PATH`` appends every closed window's verdict to a JSONL
   timeline (the in-memory ring keeps only the newest 64 windows; the
   timeline keeps a long run's full history for the self-tuning
-  driver).  Exits 1 when any alert fired.
+  driver; ``--persist-max-mb`` rotates it into size-capped segments).
+  HA: ``--role primary --peer host:port`` forwards frames + window
+  heartbeats to a standby ``watch --role standby``, which promotes
+  itself after ``--promote-after`` missed heartbeats (one structured
+  ``aggregator_failover`` alert); ``--checkpoint PATH`` persists the
+  doctor's cumulative state and ``--resume`` restores it (+ timeline
+  tail) after a restart.  ``--ha-drill`` rehearses the failover over
+  ``--replay`` inputs (kill the primary after ``--kill-primary-after``
+  windows; exit 3 = standby never promoted).  Exits 1 when any alert
+  fired.
+- ``history list|show|alerts|diff``  query persisted verdict timelines
+  (the ``--persist`` / ``THEANOMPI_LIVE_PERSIST`` JSONL files,
+  rotation segments read transparently): list runs, one run's
+  window-over-window trend table, flattened alerts, and a cross-run
+  diff whose threshold flags (``--max-straggler-increase``,
+  ``--max-overlap-drop``, ``--max-ttft-p99-increase-s``,
+  ``--max-new-alerts``) exit 1 on regression — a round-over-round
+  verdict source that re-runs nothing.
 - ``serve --port N``            serve /metrics, /trace, /flight from the
   current (empty, unless something enabled tracing in-process) state —
   mainly a smoke surface; real deployments call
   ``export.ObservabilityServer`` from inside the run.
 
-Exit codes: 0 ok, 1 doctor threshold violation / watchdog alert,
-2 usage/missing-input.
+Exit codes: 0 ok, 1 doctor threshold violation / watchdog alert /
+history regression, 2 usage/missing-input, 3 ha-drill blackout
+(standby never promoted).
 """
 
 from __future__ import annotations
@@ -252,9 +270,20 @@ def _emit_window(v: dict, as_json: bool) -> None:
         print(_window_line(v), flush=True)
 
 
+def _parse_peers(args):
+    from theanompi_tpu.observability.live import parse_endpoints
+
+    peers = []
+    for spec in args.peer or ():
+        peers.extend(parse_endpoints(spec))
+    return peers
+
+
 def _cmd_watch(args) -> int:
     from theanompi_tpu.observability import live
 
+    if args.ha_drill:
+        return _watch_ha_drill(args)
     if args.replay:
         return _watch_replay(args)
     agg = live.Aggregator(
@@ -265,13 +294,39 @@ def _cmd_watch(args) -> int:
         expect_ranks=args.expect_rank or None,
         log=lambda line: print(line, file=sys.stderr, flush=True),
         persist_path=args.persist,
+        persist_max_bytes=int(args.persist_max_mb * 1e6),
+        role=args.role,
+        name=f"watch-{args.role}",
+        peers=_parse_peers(args) or None,
+        promote_after=args.promote_after,
+        checkpoint_path=args.checkpoint,
     )
+    if args.resume:
+        try:
+            info = agg.resume(
+                checkpoint_path=args.checkpoint,
+                timeline_path=args.persist,
+            )
+            print(
+                f"[watch] resumed from {info['checkpoint']} at window "
+                f"{info['resumed_window']} "
+                f"({info['timeline_windows_replayed']} timeline "
+                "window(s) replayed past the checkpoint)",
+                file=sys.stderr,
+            )
+        except (OSError, ValueError, KeyError) as e:
+            print(
+                f"[watch] cannot resume: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 2
     channel = agg.serve(args.port)
     health = None
     if args.health_port is not None:
         from theanompi_tpu.observability import export
 
         export.set_health_provider(agg.health)
+        export.set_timeline_provider(agg.recent_windows)
         health = export.ObservabilityServer(port=args.health_port).start()
         print(
             f"[watch] /health on http://127.0.0.1:{health.port}",
@@ -295,23 +350,28 @@ def _cmd_watch(args) -> int:
         pass
     finally:
         channel.close()
+        # the tail: frames that arrived after the last timed close used
+        # to vanish without a verdict — flush them as one final window
+        # (and close still-open stall trackers, offline-doctor style)
+        tail = agg.close_window(final=True)
+        if tail.get("ranks") or tail.get("stalls"):
+            _emit_window(tail, args.json)
+        agg.close_forwarder()
         if health is not None:
             health.close()
             from theanompi_tpu.observability import export
 
             export.set_health_provider(None)
+            export.set_timeline_provider(None)
     return 1 if agg.watchdog.alerts_total else 0
 
 
-def _watch_replay(args) -> int:
-    """Recorded raw traces through the IDENTICAL streaming path the
-    live aggregator runs — each rank's events in completion order,
-    sliced into ``--replay-windows`` equal chunks."""
-    from theanompi_tpu.observability import analysis, live
-
-    named, rc = _load_named(args, "replay")
+def _replay_streams(args, verb="replay"):
+    """Shared replay input loading: raw trace files → per-rank
+    ``(label, events-in-completion-order, sample_rate, dropped)``."""
+    named, rc = _load_named(args, verb)
     if rc:
-        return rc
+        return None, rc
     per_rank = []
     for label, lines in named:
         events = []
@@ -335,13 +395,31 @@ def _watch_replay(args) -> int:
             + float(e.get("dur", 0.0))
         )
         per_rank.append((label, events, sample_rate, dropped))
+    return per_rank, 0
+
+
+def _watch_replay(args) -> int:
+    """Recorded raw traces through the IDENTICAL streaming path the
+    live aggregator runs — each rank's events in completion order,
+    sliced into ``--replay-windows`` equal chunks."""
+    from theanompi_tpu.observability import analysis, live
+
+    per_rank, rc = _replay_streams(args)
+    if rc:
+        return rc
     doctor = analysis.StreamingDoctor(stall_min_s=args.stall_min_s)
     watchdog = live.Watchdog(
         _watch_thresholds(args),
         log=lambda line: print(line, file=sys.stderr, flush=True),
     )
-    verdict_log = live.VerdictLog(args.persist) if args.persist else None
+    verdict_log = (
+        live.VerdictLog(
+            args.persist, max_bytes=int(args.persist_max_mb * 1e6)
+        )
+        if args.persist else None
+    )
     n_win = max(1, args.replay_windows)
+    emitted = 0
     for k in range(n_win):
         for label, events, sample_rate, dropped in per_rank:
             lo = (k * len(events)) // n_win
@@ -357,13 +435,180 @@ def _watch_replay(args) -> int:
         if verdict_log is not None:
             verdict_log.append(v)
         _emit_window(v, args.json)
+        emitted += 1
+    # the tail flush: a trace whose inbox never drained (or any state
+    # still open after the last chunk) used to evaporate at exit —
+    # close it as one final window so replay verdict counts match a
+    # live run (whose stop() flushes the same way) on the same trace
+    tail = doctor.close_window(final=True)
+    if tail.get("ranks") or tail.get("stalls"):
+        tail["alerts"] = watchdog.evaluate(tail)
+        if verdict_log is not None:
+            verdict_log.append(tail)
+        _emit_window(tail, args.json)
+        emitted += 1
     if not args.json:
         print(
-            f"[watch] replayed {len(per_rank)} rank(s) over {n_win} "
+            f"[watch] replayed {len(per_rank)} rank(s) over {emitted} "
             f"windows — {watchdog.alerts_total} alert(s)",
             file=sys.stderr,
         )
     return 1 if watchdog.alerts_total else 0
+
+
+def _watch_ha_drill(args) -> int:
+    """The kill-the-primary rehearsal (perf gate failover leg): replay
+    recorded traces through a primary+standby aggregator pair, kill the
+    primary after ``--kill-primary-after`` windows, and report whether
+    the standby promoted and what it alerted.  Exit codes: 3 = the
+    standby never promoted (a monitoring blackout — the failure this
+    machinery exists to prevent), otherwise 1 if any watchdog alert
+    fired (like ``watch`` everywhere else), 0 silent."""
+    from theanompi_tpu.observability import live
+
+    per_rank, rc = _replay_streams(args, verb="drill")
+    if rc:
+        return rc
+    res = live.ha_replay_drill(
+        per_rank,
+        n_windows=max(2, args.replay_windows),
+        kill_after=args.kill_primary_after,
+        thresholds=_watch_thresholds(args),
+        promote_after=args.promote_after,
+        stall_min_s=args.stall_min_s,
+        persist_primary=args.persist,
+        persist_standby=(
+            f"{args.persist}.standby" if args.persist else None
+        ),
+        checkpoint_path=args.checkpoint,
+        log=lambda line: print(line, file=sys.stderr, flush=True),
+    )
+    for who, v in res["verdicts"]:
+        v = dict(v)
+        v["aggregator"] = who
+        _emit_window(v, args.json)
+    alerts_total = (
+        res["primary"].watchdog.alerts_total
+        + res["standby"].watchdog.alerts_total
+    )
+    print(
+        f"[watch] ha-drill: primary killed after window "
+        f"{args.kill_primary_after}; promoted="
+        f"{res['promoted']} (window {res['promoted_at_window']}), "
+        f"{res['failover_alerts']} failover alert(s), "
+        f"{alerts_total} alert(s) total",
+        file=sys.stderr,
+    )
+    if not res["promoted"]:
+        print(
+            "[watch] ha-drill: standby NEVER promoted — monitoring "
+            "blackout",
+            file=sys.stderr,
+        )
+        return 3
+    return 1 if alerts_total else 0
+
+
+def _resolve_timeline(args, spec: str) -> Optional[str]:
+    from theanompi_tpu.observability import history
+
+    d = _resolve_dir(args)
+    path = history.resolve_run(spec, d)
+    if path is None:
+        print(
+            f"no such run: {spec} (looked in {d}; `history list` shows "
+            "what exists)",
+            file=sys.stderr,
+        )
+    return path
+
+
+def _cmd_history_list(args) -> int:
+    from theanompi_tpu.observability import history
+
+    d = _resolve_dir(args)
+    runs = history.discover_runs(d)
+    if not runs:
+        print(
+            f"no verdict timelines in {d} (persist one with "
+            "`watch --persist`, THEANOMPI_LIVE_PERSIST=1, or "
+            "Aggregator(persist_path=...))",
+            file=sys.stderr,
+        )
+        return 2
+    summarized = [
+        (p, history.summarize(history.read_timeline(p))) for p in runs
+    ]
+    if args.json:
+        sys.stdout.write(json.dumps(
+            [{"path": p, **s} for p, s in summarized], indent=2
+        ) + "\n")
+    else:
+        sys.stdout.write(history.render_list(summarized))
+    return 0
+
+
+def _cmd_history_show(args) -> int:
+    from theanompi_tpu.observability import history
+
+    path = _resolve_timeline(args, args.run)
+    if path is None:
+        return 2
+    verdicts = history.read_timeline(path)
+    summary = history.summarize(verdicts)
+    if args.json:
+        sys.stdout.write(json.dumps(
+            {"path": path, "summary": summary, "windows": verdicts},
+            indent=2,
+        ) + "\n")
+    else:
+        sys.stdout.write(history.render_show(path, verdicts, summary))
+    return 0
+
+
+def _cmd_history_alerts(args) -> int:
+    from theanompi_tpu.observability import history
+
+    path = _resolve_timeline(args, args.run)
+    if path is None:
+        return 2
+    verdicts = history.read_timeline(path)
+    if args.json:
+        rows = [
+            {**a, "window": v.get("window")}
+            for v in verdicts for a in v.get("alerts") or []
+        ]
+        sys.stdout.write(json.dumps(rows, indent=2) + "\n")
+    else:
+        sys.stdout.write(history.render_alerts(verdicts))
+    return 0
+
+
+def _cmd_history_diff(args) -> int:
+    from theanompi_tpu.observability import history
+
+    path_a = _resolve_timeline(args, args.run_a)
+    path_b = _resolve_timeline(args, args.run_b)
+    if path_a is None or path_b is None:
+        return 2
+    a = history.summarize(history.read_timeline(path_a))
+    b = history.summarize(history.read_timeline(path_b))
+    result = history.diff(
+        a, b,
+        max_straggler_increase=args.max_straggler_increase,
+        max_overlap_drop=args.max_overlap_drop,
+        max_ttft_p99_increase_s=args.max_ttft_p99_increase_s,
+        max_new_alerts=args.max_new_alerts,
+    )
+    if args.json:
+        sys.stdout.write(json.dumps(
+            {"a": path_a, "b": path_b, **result}, indent=2
+        ) + "\n")
+    else:
+        sys.stdout.write(history.render_diff(path_a, path_b, result))
+    for vio in result["violations"]:
+        print(f"HISTORY REGRESSION: {vio}", file=sys.stderr)
+    return 1 if result["violations"] else 0
 
 
 def _cmd_serve(args) -> int:
@@ -537,6 +782,51 @@ def _build_parser() -> argparse.ArgumentParser:
         "timeline (full-run history; the in-memory ring keeps only "
         "the newest windows)",
     )
+    w.add_argument(
+        "--persist-max-mb", type=float, default=0.0,
+        help="rotate the --persist timeline into size-capped segments "
+        "(PATH.1, .2, ...) past this many MB per segment (0 = never "
+        "rotate)",
+    )
+    w.add_argument(
+        "--role", choices=("primary", "standby"), default="primary",
+        help="HA role: a primary persists/checkpoints and forwards "
+        "frames + heartbeats to --peer standbys; a standby shadows "
+        "the stream and promotes itself after --promote-after missed "
+        "primary heartbeats",
+    )
+    w.add_argument(
+        "--peer", action="append", default=None, metavar="HOST:PORT",
+        help="standby aggregator endpoint to forward frames and "
+        "window heartbeats to (repeat per standby; primary role only)",
+    )
+    w.add_argument(
+        "--promote-after", type=int, default=3,
+        help="standby: consecutive window closes without a primary "
+        "heartbeat before self-promotion (default 3)",
+    )
+    w.add_argument(
+        "--checkpoint", default=None, metavar="PATH",
+        help="write a versioned doctor-state checkpoint beside the "
+        "timeline every window (primary role)",
+    )
+    w.add_argument(
+        "--resume", action="store_true",
+        help="restore doctor state from --checkpoint (+ replay the "
+        "--persist timeline tail) before serving — the restarted-"
+        "aggregator path",
+    )
+    w.add_argument(
+        "--ha-drill", action="store_true",
+        help="failover rehearsal over --replay inputs: primary+standby "
+        "pair, primary killed after --kill-primary-after windows; "
+        "exit 3 if the standby never promotes (blackout)",
+    )
+    w.add_argument(
+        "--kill-primary-after", type=int, default=2,
+        help="ha-drill: windows the primary closes before it is "
+        "killed (default 2)",
+    )
     w.add_argument("--stall-min-s", type=float, default=0.0)
     w.add_argument("--max-straggler", type=float, default=None)
     w.add_argument("--min-overlap", type=float, default=None)
@@ -544,6 +834,57 @@ def _build_parser() -> argparse.ArgumentParser:
     w.add_argument("--max-ttft-p99-s", type=float, default=None)
     w.add_argument("--max-tpot-p99-s", type=float, default=None)
     w.set_defaults(fn=_cmd_watch)
+    h = sub.add_parser(
+        "history",
+        help="query persisted verdict timelines: list runs, window "
+        "trends, alert summaries, cross-run diff with threshold flags",
+    )
+    hsub = h.add_subparsers(dest="history_cmd", required=True)
+    hl = hsub.add_parser("list", help="timelines in the directory")
+    hl.add_argument("--dir", default=None, help="observability directory")
+    hl.add_argument("--json", action="store_true")
+    hl.set_defaults(fn=_cmd_history_list)
+    hs = hsub.add_parser(
+        "show", help="one run's per-window trend table"
+    )
+    hs.add_argument("run", help="timeline path or basename in --dir")
+    hs.add_argument("--dir", default=None, help="observability directory")
+    hs.add_argument("--json", action="store_true")
+    hs.set_defaults(fn=_cmd_history_show)
+    ha = hsub.add_parser("alerts", help="one run's alerts, flattened")
+    ha.add_argument("run", help="timeline path or basename in --dir")
+    ha.add_argument("--dir", default=None, help="observability directory")
+    ha.add_argument("--json", action="store_true")
+    ha.set_defaults(fn=_cmd_history_alerts)
+    hd = hsub.add_parser(
+        "diff",
+        help="compare two runs; threshold flags exit 1 on regression",
+    )
+    hd.add_argument("run_a", help="baseline timeline (path or basename)")
+    hd.add_argument("run_b", help="candidate timeline (path or basename)")
+    hd.add_argument("--dir", default=None, help="observability directory")
+    hd.add_argument("--json", action="store_true")
+    hd.add_argument(
+        "--max-straggler-increase", type=float, default=None,
+        help="fail when the final straggler index rises by more than "
+        "this (absolute)",
+    )
+    hd.add_argument(
+        "--max-overlap-drop", type=float, default=None,
+        help="fail when the comm/compute overlap floor drops by more "
+        "than this (absolute)",
+    )
+    hd.add_argument(
+        "--max-ttft-p99-increase-s", type=float, default=None,
+        help="fail when the worst per-window ttft p99 rises by more "
+        "than this many seconds",
+    )
+    hd.add_argument(
+        "--max-new-alerts", type=int, default=None,
+        help="fail when the candidate run fires more than this many "
+        "additional watchdog alerts",
+    )
+    hd.set_defaults(fn=_cmd_history_diff)
     s = sub.add_parser("serve", help="local HTTP endpoint (opt-in)")
     s.add_argument("--port", type=int, default=9100)
     s.add_argument("--host", default="127.0.0.1")

@@ -48,7 +48,10 @@ The same math also runs ONLINE: ``StreamingDoctor`` is ``analyze``
 restated as an incremental, windowed accumulator (shared pure helpers
 — ``merge_intervals``/``intersect_total``/``straggler_summary``/
 ``StallTracker``), the verdict engine under the live telemetry plane
-(``observability/live.py``) and the ``watch`` CLI.  Fractions from
+(``observability/live.py``) and the ``watch`` CLI; its whole
+accumulated state round-trips through versioned JSON
+(``snapshot()``/``restore()``), which is what the aggregator
+checkpoints so a promoted standby keeps the run's cumulative trends.  Fractions from
 1-in-N sampled traces carry 95% error bars (``fractions_ci95``), and
 threshold checks compare against the conservative end of the interval
 so a sampled trace cannot flake a CI gate.  ``estimate_clock_offsets``
@@ -674,6 +677,16 @@ class _RankAcc:
         self.win_counters: List[Tuple[float, Any, float]] = []
 
 
+# version stamp on StreamingDoctor.snapshot() documents (and therefore
+# on the aggregator checkpoints that embed them).  Policy: restore()
+# refuses a snapshot whose version it does not know — silently
+# misreading a future layout would fabricate verdicts, and a monitor
+# that lies is worse than one that restarts cold (docs/observability.md
+# "Surviving aggregator loss").
+DOCTOR_SNAPSHOT_VERSION = 1
+DOCTOR_SNAPSHOT_KIND = "tmpi_streaming_doctor"
+
+
 class StreamingDoctor:
     """``analyze()`` restated as an incremental, windowed accumulator —
     the online doctor under the live telemetry plane.
@@ -783,17 +796,23 @@ class StreamingDoctor:
                     self._cap_flows(self._flow_ended)
 
     # ---- windowing -----------------------------------------------------
-    def close_window(self) -> dict:
+    def close_window(self, final: bool = False) -> dict:
         """Verdict over everything fed since the last close, report-
         shaped so ``check_thresholds`` applies verbatim.  Stragglers
         are cumulative (lag is a property of the whole run so far);
-        fractions/stalls are this window's."""
+        fractions/stalls are this window's.
+
+        ``final=True`` is the end-of-stream flush: still-open stall
+        windows are CLOSED at their last sample (the offline doctor's
+        ``StallTracker.flush``) instead of reported as ongoing, so a
+        replayed trace's last verdict matches what ``analyze`` says
+        about the same tail."""
         self.n_windows += 1
         out: dict = {"window": self.n_windows, "ranks": {},
                      "stalls": [], "warnings": []}
         boundaries: Dict[str, List[float]] = {}
         for label, acc in sorted(self.ranks.items()):
-            row = self._close_rank_window(acc)
+            row = self._close_rank_window(acc, final=final)
             if row is not None:
                 out["ranks"][label] = row
                 for s in row.pop("_stall_rows"):
@@ -803,7 +822,9 @@ class StreamingDoctor:
         out["stragglers"] = straggler_summary(boundaries)
         return _round_floats(out)
 
-    def _close_rank_window(self, acc: _RankAcc) -> Optional[dict]:
+    def _close_rank_window(
+        self, acc: _RankAcc, final: bool = False
+    ) -> Optional[dict]:
         win_int = {c: merge_intervals(acc.win[c]) for c in _CATS}
         steps = sorted(acc.win_steps)
         counters = sorted(acc.win_counters, key=lambda s: s[0])
@@ -825,15 +846,26 @@ class StreamingDoctor:
                 row = stall_row(key, w, wait_ivs)
                 stall_rows.append(row)
                 acc.stalls.append(row)
-        # a still-open stall alerts NOW, not when it finally drains
+        # a still-open stall alerts NOW, not when it finally drains;
+        # the end-of-stream flush CLOSES it at the last sample instead
+        # (offline-doctor semantics: a backed-up mailbox at the end of
+        # the trace is a stall with an end, not a perpetual "ongoing")
         for key, tr in sorted(acc.trackers.items(),
                               key=lambda kv: str(kv[0])):
             if tr.start is not None and tr.last_ts is not None:
-                w = (tr.start, tr.last_ts, tr.max_depth)
-                if (w[1] - w[0]) / 1e6 >= self.stall_min_s:
-                    stall_rows.append(
-                        {**stall_row(key, w, wait_ivs), "ongoing": True}
-                    )
+                if final:
+                    w = tr.flush()
+                    if (w[1] - w[0]) / 1e6 >= self.stall_min_s:
+                        row_ = stall_row(key, w, wait_ivs)
+                        stall_rows.append(row_)
+                        acc.stalls.append(row_)
+                else:
+                    w = (tr.start, tr.last_ts, tr.max_depth)
+                    if (w[1] - w[0]) / 1e6 >= self.stall_min_s:
+                        stall_rows.append(
+                            {**stall_row(key, w, wait_ivs),
+                             "ongoing": True}
+                        )
 
         has_spans = any(win_int.values()) or steps
         row: Optional[dict] = None
@@ -886,6 +918,134 @@ class StreamingDoctor:
             else:
                 acc.steps_capped = True
         return row
+
+    # ---- durable state -------------------------------------------------
+    def snapshot(self) -> dict:
+        """The doctor's whole accumulated state as one versioned,
+        JSON-serializable dict: frozen-interval totals, the live
+        interval tails, step boundaries, stall trackers (including a
+        window still open mid-stall), current-window buffers and flow
+        halves.  ``restore(snapshot())`` — even through a JSON
+        round-trip — reproduces ``cumulative()`` EXACTLY, which is what
+        lets a promoted standby or restarted aggregator carry a long
+        run's trends across the takeover instead of starting at zero."""
+        ranks: Dict[str, dict] = {}
+        for label, acc in self.ranks.items():
+            ranks[label] = {
+                "live": {c: [list(iv) for iv in acc.live[c]]
+                         for c in _CATS},
+                "frozen": dict(acc.frozen),
+                "frozen_overlap": acc.frozen_overlap,
+                "frozen_busy": acc.frozen_busy,
+                "t_frozen": acc.t_frozen,
+                "t_min": acc.t_min,
+                "t_max": acc.t_max,
+                "max_dur": acc.max_dur,
+                "counts": dict(acc.counts),
+                "n_spans": acc.n_spans,
+                "sample_rate": acc.sample_rate,
+                "dropped": acc.dropped,
+                "step_base": acc.step_base,
+                "boundaries": list(acc.boundaries),
+                "step_durs": list(acc.step_durs),
+                "steps_capped": acc.steps_capped,
+                # key types matter (counter args carry int OR str rank
+                # labels) — a [key, state] pair list survives JSON, a
+                # dict would stringify int keys
+                "trackers": [
+                    [key, {"start": tr.start, "max_depth": tr.max_depth,
+                           "last_ts": tr.last_ts}]
+                    for key, tr in acc.trackers.items()
+                ],
+                "stalls": [dict(s) for s in acc.stalls],
+                "win": {c: [list(iv) for iv in acc.win[c]]
+                        for c in _CATS},
+                "win_steps": [list(t) for t in acc.win_steps],
+                "win_counters": [list(t) for t in acc.win_counters],
+            }
+        return {
+            "kind": DOCTOR_SNAPSHOT_KIND,
+            "v": DOCTOR_SNAPSHOT_VERSION,
+            "stall_min_s": self.stall_min_s,
+            "n_windows": self.n_windows,
+            "flows": {
+                "begun": dict(self._flow_begun),
+                "ended": dict(self._flow_ended),
+                "matched": self._flows_matched,
+            },
+            "ranks": ranks,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "StreamingDoctor":
+        """Rebuild a doctor from ``snapshot()`` output.  Refuses
+        anything that is not a known-version doctor snapshot — see the
+        version policy above ``DOCTOR_SNAPSHOT_VERSION``."""
+        if not isinstance(snap, dict) or snap.get("kind") != \
+                DOCTOR_SNAPSHOT_KIND:
+            raise ValueError(
+                "not a StreamingDoctor snapshot (kind="
+                f"{snap.get('kind') if isinstance(snap, dict) else type(snap).__name__!r})"
+            )
+        v = snap.get("v")
+        if v != DOCTOR_SNAPSHOT_VERSION:
+            raise ValueError(
+                f"doctor snapshot version {v!r} not supported (this "
+                f"build reads v{DOCTOR_SNAPSHOT_VERSION}); re-run the "
+                "matching build or start the monitor cold"
+            )
+        d = cls(stall_min_s=float(snap.get("stall_min_s", 0.0)))
+        d.n_windows = int(snap.get("n_windows", 0))
+        fl = snap.get("flows") or {}
+        d._flow_begun = {str(k): str(lab)
+                         for k, lab in (fl.get("begun") or {}).items()}
+        d._flow_ended = {str(k): str(lab)
+                         for k, lab in (fl.get("ended") or {}).items()}
+        d._flows_matched = int(fl.get("matched", 0))
+        for label, doc in (snap.get("ranks") or {}).items():
+            acc = d.ranks[str(label)] = _RankAcc()
+            acc.live = {
+                c: [(float(a), float(b))
+                    for a, b in (doc.get("live") or {}).get(c, [])]
+                for c in _CATS
+            }
+            acc.frozen = {c: float((doc.get("frozen") or {}).get(c, 0.0))
+                          for c in _CATS}
+            acc.frozen_overlap = float(doc.get("frozen_overlap", 0.0))
+            acc.frozen_busy = float(doc.get("frozen_busy", 0.0))
+            acc.t_frozen = doc.get("t_frozen")
+            acc.t_min = doc.get("t_min")
+            acc.t_max = doc.get("t_max")
+            acc.max_dur = float(doc.get("max_dur", 0.0))
+            acc.counts = {c: int((doc.get("counts") or {}).get(c, 0))
+                          for c in _CATS}
+            acc.n_spans = int(doc.get("n_spans", 0))
+            acc.sample_rate = int(doc.get("sample_rate", 1))
+            acc.dropped = int(doc.get("dropped", 0))
+            acc.step_base = doc.get("step_base")
+            acc.boundaries = [float(b) for b in doc.get("boundaries", [])]
+            acc.step_durs = [float(s) for s in doc.get("step_durs", [])]
+            acc.steps_capped = bool(doc.get("steps_capped", False))
+            for key, st in doc.get("trackers", []):
+                tr = StallTracker()
+                tr.start = st.get("start")
+                tr.max_depth = float(st.get("max_depth", 0.0))
+                tr.last_ts = st.get("last_ts")
+                acc.trackers[key] = tr
+            acc.stalls = [dict(s) for s in doc.get("stalls", [])]
+            acc.win = {
+                c: [(float(a), float(b))
+                    for a, b in (doc.get("win") or {}).get(c, [])]
+                for c in _CATS
+            }
+            acc.win_steps = [
+                (float(a), float(b)) for a, b in doc.get("win_steps", [])
+            ]
+            acc.win_counters = [
+                (float(ts), key, float(val))
+                for ts, key, val in doc.get("win_counters", [])
+            ]
+        return d
 
     def _maybe_freeze(self, acc: _RankAcc) -> None:
         if all(

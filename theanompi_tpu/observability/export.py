@@ -19,6 +19,10 @@ putting it on a routable interface is an explicit operator decision
   ``status`` is ok, 503 on alert — so a plain HTTP probe IS the SLO
   check).  Backed by whatever ``set_health_provider`` registered (the
   live aggregator); without one it reports ``{"status": "unknown"}``.
+- ``/timeline``     — the live aggregator's in-memory verdict ring
+  (recent windows as a JSON list; ``set_timeline_provider``).  The
+  FULL persisted history is the ``VerdictLog`` JSONL, queryable
+  offline with ``python -m theanompi_tpu.observability history``.
 """
 
 from __future__ import annotations
@@ -36,12 +40,23 @@ from theanompi_tpu.observability.trace import get_tracer
 # the /health document source — the live aggregator registers its
 # Aggregator.health here (observability/live.py); None = no live plane
 _health_provider = None
+# the /timeline document source — Aggregator.recent_windows (the
+# in-memory verdict ring; the FULL history lives in the VerdictLog
+# JSONL, queryable offline via `observability history`)
+_timeline_provider = None
 
 
 def set_health_provider(fn) -> None:
     """Register (or clear, with None) the callable behind ``/health``."""
     global _health_provider
     _health_provider = fn
+
+
+def set_timeline_provider(fn) -> None:
+    """Register (or clear, with None) the callable behind
+    ``/timeline`` — a list of recent per-window verdicts."""
+    global _timeline_provider
+    _timeline_provider = fn
 
 
 def obs_dir(path: Optional[str] = None) -> str:
@@ -155,6 +170,16 @@ class _Handler(BaseHTTPRequestHandler):
                     json.dumps(doc, default=str).encode("utf-8"),
                     "application/json",
                     code,
+                )
+            elif path == "/timeline":
+                windows = (
+                    _timeline_provider()
+                    if _timeline_provider is not None
+                    else []
+                )
+                self._send(
+                    json.dumps(windows, default=str).encode("utf-8"),
+                    "application/json",
                 )
             else:
                 self._send(b"not found\n", "text/plain", 404)

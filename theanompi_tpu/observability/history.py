@@ -1,0 +1,380 @@
+"""Queryable run history over persisted verdict timelines.
+
+The live plane's ``VerdictLog`` appends one JSON verdict per closed
+window (rotating into size-capped ``.1``/``.2``… segments on long
+runs).  This module is the read side: pure stdlib functions that turn
+those JSONL timelines into answers — which runs exist, how a run's
+straggler/overlap/SLO trends moved window over window, what alerted,
+and how two runs compare — WITHOUT re-running anything.  That last
+part is the point: ``diff`` with threshold flags exits nonzero, so
+``perf_gate.sh`` (and the planned self-tuning driver) gets a
+round-over-round verdict source that is just two files and an exit
+code.
+
+CLI face: ``python -m theanompi_tpu.observability history
+list|show|alerts|diff`` — see ``__main__.py``.
+
+Everything here tolerates corrupt/truncated lines (a crash mid-append
+must not make the history unreadable) and reads across rotation
+segments transparently (``iter_timeline``).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+
+def iter_timeline(path: str) -> Iterator[dict]:
+    """Every verdict in a (possibly rotated) timeline, oldest first —
+    ``path.N`` … ``path.1`` then ``path``.  Corrupt lines and
+    non-verdict rows are skipped, not fatal."""
+    from theanompi_tpu.observability.live import VerdictLog
+
+    for seg in VerdictLog.segment_paths(path):
+        try:
+            with open(seg, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(doc, dict) and "window" in doc:
+                        yield doc
+        except OSError:
+            continue
+
+
+def read_timeline(path: str) -> List[dict]:
+    return list(iter_timeline(path))
+
+
+def discover_runs(directory: str) -> List[str]:
+    """Timeline base files in a directory (rotated segments fold into
+    their base), sorted by mtime so the newest run lists last."""
+    out = []
+    # rotated segments are "<base>.jsonl.N" — the glob matches bases
+    # only, so each run lists once
+    for p in sorted(glob.glob(os.path.join(directory, "*.jsonl"))):
+        # a timeline must contain at least one verdict row
+        it = iter_timeline(p)
+        if next(it, None) is not None:
+            out.append(p)
+    return sorted(out, key=lambda p: os.path.getmtime(p))
+
+
+def resolve_run(spec: str, directory: str) -> Optional[str]:
+    """A run argument → a timeline path: an existing path is taken
+    verbatim; otherwise ``<dir>/<spec>`` and
+    ``<dir>/<spec>_verdicts.jsonl`` are tried."""
+    if os.path.exists(spec):
+        return spec
+    for cand in (
+        os.path.join(directory, spec),
+        os.path.join(directory, f"{spec}_verdicts.jsonl"),
+        os.path.join(directory, f"{spec}.jsonl"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _fin(vals: Iterable[float]) -> List[float]:
+    return [v for v in vals if v == v]  # drop NaNs
+
+
+def summarize(verdicts: List[dict]) -> dict:
+    """One run's timeline → a flat, diffable summary: window span,
+    alert counts by rule, straggler trend (final = cumulative by the
+    last window; peak = worst window), per-rank overlap floor, stall
+    totals, serving SLO extremes, dead-rank exposure."""
+    out: dict = {
+        "windows": len(verdicts),
+        "first_window": verdicts[0]["window"] if verdicts else None,
+        "last_window": verdicts[-1]["window"] if verdicts else None,
+        "t_start": None,
+        "t_end": None,
+        "ranks": [],
+        "alerts": {"total": 0, "by_rule": {}},
+        "straggler": {"final_index": 0.0, "peak_index": 0.0,
+                      "rank": None},
+        "overlap": {"min": None, "last": None},
+        "stalls": {"total": 0, "max_s": 0.0},
+        "serving": {},
+        "dead_rank_windows": 0,
+        "steps_total": 0,
+    }
+    if not verdicts:
+        return out
+    walls = _fin(
+        float(v["t_wall"]) for v in verdicts if v.get("t_wall")
+    )
+    if walls:
+        out["t_start"], out["t_end"] = min(walls), max(walls)
+    ranks: set = set()
+    overlaps: List[float] = []
+    ttft_p99: List[float] = []
+    tpot_p99: List[float] = []
+    last_overlaps: List[float] = []
+    for v in verdicts:
+        for label, ra in (v.get("ranks") or {}).items():
+            ranks.add(label)
+            ov = ra.get("comm_compute_overlap")
+            if ov is not None:
+                overlaps.append(float(ov))
+            st = ra.get("steps") or {}
+            out["steps_total"] += int(st.get("n", 0) or 0)
+        for a in v.get("alerts") or []:
+            out["alerts"]["total"] += 1
+            rule = a.get("rule")
+            out["alerts"]["by_rule"][rule] = (
+                out["alerts"]["by_rule"].get(rule, 0) + 1
+            )
+        sg = v.get("stragglers") or {}
+        idx = float(sg.get("max_straggler_index") or 0.0)
+        if idx >= out["straggler"]["peak_index"]:
+            out["straggler"]["peak_index"] = idx
+        for s in v.get("stalls") or []:
+            out["stalls"]["total"] += 1
+            out["stalls"]["max_s"] = max(
+                out["stalls"]["max_s"], float(s.get("duration_s", 0.0))
+            )
+        serving = v.get("serving") or {}
+        if "ttft" in serving:
+            ttft_p99.append(float(serving["ttft"].get("p99_s", 0.0)))
+        if "tpot" in serving:
+            tpot_p99.append(float(serving["tpot"].get("p99_s", 0.0)))
+        if v.get("dead_ranks"):
+            out["dead_rank_windows"] += 1
+    last_sg = verdicts[-1].get("stragglers") or {}
+    out["straggler"]["final_index"] = float(
+        last_sg.get("max_straggler_index") or 0.0
+    )
+    out["straggler"]["rank"] = last_sg.get("straggler_rank")
+    for label, ra in (verdicts[-1].get("ranks") or {}).items():
+        ov = ra.get("comm_compute_overlap")
+        if ov is not None:
+            last_overlaps.append(float(ov))
+    out["ranks"] = sorted(ranks)
+    if overlaps:
+        out["overlap"]["min"] = min(overlaps)
+    if last_overlaps:
+        out["overlap"]["last"] = min(last_overlaps)
+    if ttft_p99:
+        out["serving"]["ttft_p99_max_s"] = max(ttft_p99)
+    if tpot_p99:
+        out["serving"]["tpot_p99_max_s"] = max(tpot_p99)
+    return out
+
+
+# the rows `history diff` compares: (key path in the summary, label,
+# direction) — direction "low" means lower is better (an increase can
+# regress), "high" means higher is better (a drop can regress)
+_DIFF_ROWS: Tuple[Tuple[Tuple[str, ...], str, str], ...] = (
+    (("straggler", "final_index"), "straggler final index", "low"),
+    (("straggler", "peak_index"), "straggler peak index", "low"),
+    (("overlap", "min"), "comm/compute overlap (min)", "high"),
+    (("stalls", "total"), "inbox stalls", "low"),
+    (("stalls", "max_s"), "longest stall (s)", "low"),
+    (("alerts", "total"), "watchdog alerts", "low"),
+    (("serving", "ttft_p99_max_s"), "ttft p99 max (s)", "low"),
+    (("serving", "tpot_p99_max_s"), "tpot p99 max (s)", "low"),
+    (("dead_rank_windows",), "windows with dead ranks", "low"),
+)
+
+
+def _get(summary: dict, path: Tuple[str, ...]):
+    cur = summary
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    return cur
+
+
+def diff(
+    a: dict,
+    b: dict,
+    max_straggler_increase: Optional[float] = None,
+    max_overlap_drop: Optional[float] = None,
+    max_ttft_p99_increase_s: Optional[float] = None,
+    max_new_alerts: Optional[int] = None,
+) -> dict:
+    """Compare two run SUMMARIES (``summarize`` output), a→b.  Returns
+    ``{"rows": [...], "violations": [...]}``; each row carries the two
+    values and the delta, each violation a human message.  The
+    threshold flags mirror the doctor's spirit: absolute bounds on the
+    regression, exit-code-ready (the CLI exits 1 when any fire)."""
+    rows: List[dict] = []
+    for path, label, direction in _DIFF_ROWS:
+        va, vb = _get(a, path), _get(b, path)
+        if va is None and vb is None:
+            continue
+        delta = None
+        if va is not None and vb is not None:
+            delta = vb - va
+        rows.append({
+            "key": ".".join(path), "label": label,
+            "a": va, "b": vb, "delta": delta,
+            "direction": direction,
+        })
+    violations: List[str] = []
+    if max_straggler_increase is not None:
+        va = float(_get(a, ("straggler", "final_index")) or 0.0)
+        vb = float(_get(b, ("straggler", "final_index")) or 0.0)
+        if vb - va > max_straggler_increase:
+            violations.append(
+                f"straggler final index rose {va:.4f} -> {vb:.4f} "
+                f"(+{vb - va:.4f} > {max_straggler_increase})"
+            )
+    if max_overlap_drop is not None:
+        va, vb = _get(a, ("overlap", "min")), _get(b, ("overlap", "min"))
+        if va is not None and (
+            vb is None or float(va) - float(vb) > max_overlap_drop
+        ):
+            vb_s = "gone" if vb is None else f"{float(vb):.4f}"
+            violations.append(
+                f"comm/compute overlap floor dropped {float(va):.4f} "
+                f"-> {vb_s} (> {max_overlap_drop} allowed)"
+            )
+    if max_ttft_p99_increase_s is not None:
+        va = _get(a, ("serving", "ttft_p99_max_s"))
+        vb = _get(b, ("serving", "ttft_p99_max_s"))
+        if vb is not None and \
+                float(vb) - float(va or 0.0) > max_ttft_p99_increase_s:
+            violations.append(
+                f"ttft p99 rose {float(va or 0.0):.4f}s -> "
+                f"{float(vb):.4f}s "
+                f"(> +{max_ttft_p99_increase_s}s allowed)"
+            )
+    if max_new_alerts is not None:
+        va = int(_get(a, ("alerts", "total")) or 0)
+        vb = int(_get(b, ("alerts", "total")) or 0)
+        if vb - va > max_new_alerts:
+            violations.append(
+                f"watchdog alerts rose {va} -> {vb} "
+                f"(+{vb - va} > {max_new_alerts} allowed)"
+            )
+    return {"rows": rows, "violations": violations}
+
+
+# ---------------------------------------------------------------------------
+# human rendering
+# ---------------------------------------------------------------------------
+
+def _num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)
+
+
+def render_list(runs: List[Tuple[str, dict]]) -> str:
+    hdr = (
+        f"{'run':<32} {'windows':>7} {'steps':>7} {'alerts':>7} "
+        f"{'straggler':>9} {'overlap':>8}"
+    )
+    lines = [hdr, "-" * len(hdr)]
+    for path, s in runs:
+        name = os.path.basename(path)
+        lines.append(
+            f"{name:<32} {s['windows']:>7} {s['steps_total']:>7} "
+            f"{s['alerts']['total']:>7} "
+            f"{_num(s['straggler']['final_index']):>9} "
+            f"{_num(s['overlap']['min']):>8}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_show(path: str, verdicts: List[dict], summary: dict) -> str:
+    lines = [f"run: {path}"]
+    lines.append(
+        f"windows {summary['windows']}  ranks "
+        f"{','.join(summary['ranks']) or '-'}  steps "
+        f"{summary['steps_total']}  alerts {summary['alerts']['total']}"
+    )
+    if summary["alerts"]["by_rule"]:
+        by = ", ".join(
+            f"{rule}={n}" for rule, n in
+            sorted(summary["alerts"]["by_rule"].items())
+        )
+        lines.append(f"alerts by rule: {by}")
+    hdr = (
+        f"{'window':>6} {'steps':>6} {'straggler':>9} {'overlap':>8} "
+        f"{'stalls':>6} {'ttft p99':>9} {'alerts':>6}"
+    )
+    lines.append("")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for v in verdicts:
+        n_steps = sum(
+            (r.get("steps") or {}).get("n", 0)
+            for r in (v.get("ranks") or {}).values()
+        )
+        sg = (v.get("stragglers") or {}).get(
+            "max_straggler_index"
+        )
+        overlaps = [
+            r["comm_compute_overlap"]
+            for r in (v.get("ranks") or {}).values()
+            if r.get("comm_compute_overlap") is not None
+        ]
+        ttft = ((v.get("serving") or {}).get("ttft") or {}).get("p99_s")
+        mark = ""
+        rules = {a.get("rule") for a in v.get("alerts") or []}
+        if "aggregator_failover" in rules:
+            mark = "  <<< FAILOVER"
+        elif rules:
+            mark = "  <<<"
+        lines.append(
+            f"{v.get('window'):>6} {n_steps:>6} {_num(sg):>9} "
+            f"{_num(min(overlaps) if overlaps else None):>8} "
+            f"{len(v.get('stalls') or []):>6} {_num(ttft):>9} "
+            f"{len(v.get('alerts') or []):>6}{mark}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_alerts(verdicts: List[dict]) -> str:
+    lines = []
+    total = 0
+    for v in verdicts:
+        for a in v.get("alerts") or []:
+            total += 1
+            lines.append(
+                f"window {v.get('window'):>4}  {a.get('rule'):<20} "
+                f"rank={a.get('rank')}  {a.get('message')}"
+            )
+    lines.append(f"{total} alert(s)")
+    return "\n".join(lines) + "\n"
+
+
+def render_diff(a_path: str, b_path: str, result: dict) -> str:
+    hdr = (
+        f"{'metric':<28} {os.path.basename(a_path)[:18]:>18} "
+        f"{os.path.basename(b_path)[:18]:>18} {'delta':>10}"
+    )
+    lines = [hdr, "-" * len(hdr)]
+    for row in result["rows"]:
+        delta = row["delta"]
+        d = "-"
+        if delta is not None:
+            worse = (
+                delta > 0 if row["direction"] == "low" else delta < 0
+            )
+            d = f"{delta:+.4f}" if isinstance(delta, float) else f"{delta:+d}"
+            if worse and delta != 0:
+                d += " !"
+        lines.append(
+            f"{row['label']:<28} {_num(row['a']):>18} "
+            f"{_num(row['b']):>18} {d:>10}"
+        )
+    for vio in result["violations"]:
+        lines.append(f"REGRESSION: {vio}")
+    return "\n".join(lines) + "\n"

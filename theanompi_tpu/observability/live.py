@@ -31,6 +31,20 @@ a monitor:
   a ``heartbeat`` alert — never a crash: dead ranks degrade the
   verdict, they do not take the monitor down with them.
 
+The plane is **HA**: shippers take an ORDERED endpoint list and fail
+over down it on refusal/timeout (counted drops, never a raise); a
+``role="standby"`` aggregator shadow-ingests the frames the primary
+forwards to it and promotes itself after N missed primary heartbeats,
+announcing one structured ``aggregator_failover`` alert instead of a
+monitoring blackout.  The doctor's cumulative state checkpoints to
+versioned JSON beside the ``VerdictLog`` timeline (which rotates into
+size-capped segments), so a promoted standby or restarted aggregator
+``resume()``s the run's trends instead of starting at zero — and the
+aggregator instruments ITSELF (``aggregator_*`` metrics: frames per
+rank, seq-gap losses, window-close latency, checkpoint failures,
+current role) so the monitor is no longer the one unobserved
+component.
+
 ``LiveMonitor`` wires the three together in one process (the threaded
 async drivers, bench), and ``maybe_start_from_env`` is the one-line
 hook the worker loops call — inert (returns ``None``, registers
@@ -60,6 +74,14 @@ from theanompi_tpu.observability.trace import get_tracer
 
 FRAME_KIND = "tmpi_telemetry"
 FRAME_VERSION = 1
+# aggregator→aggregator control frame: the primary's liveness beacon.
+# A standby that misses ``promote_after`` of these promotes itself.
+HB_KIND = "tmpi_agg_hb"
+# aggregator checkpoint format.  Version policy: bump on ANY layout
+# change; readers refuse unknown versions loudly (a checkpoint embeds a
+# doctor snapshot, which carries its own version the same way).
+CHECKPOINT_KIND = "tmpi_agg_ckpt"
+CHECKPOINT_VERSION = 1
 
 _REG = get_registry()
 _ALERTS = _REG.counter(
@@ -69,6 +91,41 @@ _FRAMES = _REG.counter(
     "telemetry_frames_total",
     "telemetry frames (direction label: shipped/ingested/failed)",
 )
+
+# ---- aggregator self-telemetry: the monitor must not be the one
+# unobserved component.  All labeled by the aggregator's ``name`` so a
+# primary/standby pair in one process (tests, the replay drill) keeps
+# distinct series; served on the existing /metrics endpoint for free.
+_AGG_FRAMES = _REG.counter(
+    "aggregator_frames_total",
+    "telemetry frames received per source rank (name, rank labels)",
+)
+_AGG_LOST = _REG.counter(
+    "aggregator_frames_lost_total",
+    "frames a rank built but the aggregator never saw (seq gaps)",
+)
+_AGG_FWD_FAIL = _REG.counter(
+    "aggregator_forward_failures_total",
+    "frame/heartbeat forwards to standby peers that failed",
+)
+_AGG_CKPTS = _REG.counter(
+    "aggregator_checkpoint_writes_total",
+    "doctor-state checkpoint writes (result label: ok/failed)",
+)
+_AGG_ROLE = _REG.gauge(
+    "aggregator_role",
+    "current role of this aggregator (1 primary, 0 standby)",
+)
+
+
+def _window_close_histogram():
+    from theanompi_tpu.observability.metrics import SUBSECOND_BUCKETS
+
+    return _REG.histogram(
+        "aggregator_window_close_seconds",
+        "wall time spent closing one verdict window",
+        buckets=SUBSECOND_BUCKETS,
+    )
 
 # the doctor threshold flags the watchdog understands — one spelling
 # shared with analysis.check_thresholds_structured and the CLI
@@ -98,20 +155,70 @@ def _floats(vals) -> List[float]:
     return [float(v) for v in vals]
 
 
+def _normalize_endpoints(address) -> List[Tuple[str, int]]:
+    """One ``(host, port)`` pair or an ordered list of them → a list of
+    pairs.  Single-endpoint spellings stay byte-compatible."""
+    if isinstance(address, (list, tuple)) and address and \
+            isinstance(address[0], (list, tuple)):
+        out = [(str(h), int(p)) for h, p in address]
+    else:
+        host, port = address
+        out = [(str(host), int(port))]
+    if not out:
+        raise ValueError("empty aggregator endpoint list")
+    return out
+
+
+def parse_endpoints(spec: str) -> List[Tuple[str, int]]:
+    """``"host:port[,host:port...]"`` → ordered endpoint list — the
+    ``THEANOMPI_LIVE_AGG`` spelling (a single ``host:port`` keeps its
+    original meaning; extra entries are the standby ladder)."""
+    out: List[Tuple[str, int]] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        try:
+            out.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            raise ValueError(
+                f"cannot parse aggregator endpoint {part!r} "
+                "(want host:port[,host:port...])"
+            )
+    if not out:
+        raise ValueError(f"no endpoints in {spec!r}")
+    return out
+
+
 class VerdictLog:
     """Append-only JSONL timeline of per-window verdicts.
 
     The aggregator keeps only the last ``max_windows_kept`` windows in
-    memory; a long run's full verdict history (what the future
-    self-tuning driver reads round-over-round) lives here instead —
-    one JSON object per closed window, appended as it closes, so a
-    crash loses at most the open window.  Write failures are counted
-    and logged once — persistence must never take the monitor down."""
+    memory; a long run's full verdict history (what the ``history``
+    CLI and the future self-tuning driver read round-over-round) lives
+    here instead — one JSON object per closed window, appended as it
+    closes, so a crash loses at most the open window.  Write failures
+    are counted and logged once — persistence must never take the
+    monitor down.
 
-    def __init__(self, path: str):
+    ``max_bytes`` caps the ACTIVE segment: when an append would push
+    the file past it, the file rotates to ``path.1`` (existing ``.1``
+    shifts to ``.2`` and so on, oldest dropped past ``max_segments``),
+    so a week-long run holds at most ``max_bytes × (max_segments + 1)``
+    bytes of timeline instead of filling the dump dir.  ``history``
+    reads across segments transparently (``segment_paths``).
+    ``max_bytes=0`` (default) keeps the original single-file,
+    never-rotating behavior byte-for-byte."""
+
+    def __init__(self, path: str, max_bytes: int = 0,
+                 max_segments: int = 4):
         self.path = str(path)
+        self.max_bytes = int(max_bytes or 0)
+        self.max_segments = max(1, int(max_segments))
         self.written = 0
         self.failed = 0
+        self.rotations = 0
         d = os.path.dirname(self.path)
         if d:
             try:
@@ -119,12 +226,55 @@ class VerdictLog:
             except OSError:
                 pass  # append() will count + report the failure
 
+    @staticmethod
+    def segment_paths(path: str) -> List[str]:
+        """Every existing segment of a (possibly rotated) timeline,
+        oldest first: ``path.N`` … ``path.1`` then ``path`` itself —
+        the read order that replays the run front to back."""
+        import re
+
+        path = str(path)
+        rotated = []
+        d = os.path.dirname(path) or "."
+        base = os.path.basename(path)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            names = []
+        pat = re.compile(re.escape(base) + r"\.(\d+)$")
+        for name in names:
+            m = pat.match(name)
+            if m:
+                rotated.append((int(m.group(1)), os.path.join(d, name)))
+        out = [p for _, p in sorted(rotated, reverse=True)]
+        if os.path.exists(path):
+            out.append(path)
+        return out
+
+    def _rotate(self) -> None:
+        oldest = f"{self.path}.{self.max_segments}"
+        try:
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.max_segments - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+            self.rotations += 1
+        except OSError:
+            pass  # the append below will count + report any failure
+
     def append(self, verdict: dict) -> bool:
         import json
 
+        line = json.dumps(verdict, default=str) + "\n"
         try:
+            if self.max_bytes and os.path.exists(self.path):
+                if os.path.getsize(self.path) + len(line) > self.max_bytes:
+                    self._rotate()
             with open(self.path, "a", encoding="utf-8") as f:
-                f.write(json.dumps(verdict, default=str) + "\n")
+                f.write(line)
             self.written += 1
             return True
         except OSError as e:
@@ -161,6 +311,15 @@ class TelemetryShipper:
     (direct in-process ``ingest``) or ``address`` (the transport's
     request/reply channel).  Ship failures are counted and retried next
     beat — telemetry must never take the training loop down.
+
+    ``address`` accepts a single ``(host, port)`` pair (unchanged) or
+    an ORDERED list of them — the HA endpoint ladder.  Each beat ships
+    to the current endpoint; a refused connection or a ship timeout
+    (``ship_timeout_s``, well under one period) counts a drop against
+    that endpoint and FAILS OVER to the next in order, within the same
+    beat — so losing the primary aggregator costs at most one frame,
+    not the monitoring plane.  The successful endpoint stays current
+    until it fails in turn (sticky, round-robin on failure).
     """
 
     MAX_SPANS = 8192   # per-frame digest bounds; overflow is counted,
@@ -170,10 +329,11 @@ class TelemetryShipper:
         self,
         rank_label: str,
         aggregator: Optional["Aggregator"] = None,
-        address: Optional[Tuple[str, int]] = None,
+        address=None,
         period_s: float = 1.0,
         registry=None,
         tracer=None,
+        ship_timeout_s: float = 10.0,
     ):
         if (aggregator is None) == (address is None):
             raise ValueError(
@@ -182,7 +342,14 @@ class TelemetryShipper:
             )
         self.rank_label = str(rank_label)
         self.aggregator = aggregator
-        self.address = tuple(address) if address else None
+        self.addresses: List[Tuple[str, int]] = (
+            _normalize_endpoints(address) if address is not None else []
+        )
+        self.address = self.addresses[0] if self.addresses else None
+        self.ship_timeout_s = float(ship_timeout_s)
+        self._active = 0  # index of the current endpoint in addresses
+        self.endpoint_failures: List[int] = [0] * len(self.addresses)
+        self.failovers = 0
         self.period_s = float(period_s)
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
@@ -272,8 +439,14 @@ class TelemetryShipper:
             except ValueError:
                 pass
         self.flush()  # whatever accumulated after the last beat
-        return {"shipped": self.shipped, "failed": self.failed,
-                "seq": self.seq}
+        out = {"shipped": self.shipped, "failed": self.failed,
+               "seq": self.seq}
+        if self.addresses:
+            out["endpoints"] = [list(a) for a in self.addresses]
+            out["active_endpoint"] = self._active
+            out["endpoint_failures"] = list(self.endpoint_failures)
+            out["failovers"] = self.failovers
+        return out
 
     def _run(self) -> None:
         while not self._stop.wait(self.period_s):
@@ -282,31 +455,66 @@ class TelemetryShipper:
     # ---- frame building ----------------------------------------------
     def flush(self) -> bool:
         """Build and ship one frame NOW (the periodic thread's body;
-        tests drive it directly)."""
+        tests drive it directly).  TCP shipping walks the endpoint
+        ladder from the current target: every endpoint failure is a
+        counted drop (never a raise into the training thread), and a
+        later endpoint accepting the frame is a failover, not a loss."""
         frame = self.build_frame()
-        try:
-            if self.aggregator is not None:
+        if self.aggregator is not None:
+            try:
                 self.aggregator.ingest(frame)
-            else:
-                from theanompi_tpu.parallel.transport import request
+                self.shipped += 1
+                _FRAMES.inc(direction="shipped")
+                return True
+            except Exception as e:
+                self._count_ship_failure(e)
+                return False
+        from theanompi_tpu.parallel.transport import request
 
-                request(self.address, frame, timeout=30.0)
+        n = len(self.addresses)
+        last_err: Optional[Exception] = None
+        for k in range(n):
+            i = (self._active + k) % n
+            try:
+                request(
+                    self.addresses[i], frame,
+                    timeout=self.ship_timeout_s,
+                )
+            except Exception as e:
+                # refused OR timed out: same verdict — count the drop
+                # against this endpoint and move down the ladder
+                last_err = e
+                self.endpoint_failures[i] += 1
+                _FRAMES.inc(direction="endpoint_failed")
+                continue
+            if i != self._active:
+                self.failovers += 1
+                print(
+                    f"[telemetry] {self.rank_label}: aggregator "
+                    f"{self.addresses[self._active]} unreachable — "
+                    f"failed over to {self.addresses[i]} "
+                    f"(failover #{self.failovers})",
+                    flush=True,
+                )
+                self._active = i
             self.shipped += 1
             _FRAMES.inc(direction="shipped")
             return True
-        except Exception as e:
-            # aggregator down/unreachable: drop the frame, keep
-            # training — the aggregator sees the gap as missed
-            # heartbeats, which is exactly the signal it exists for
-            self.failed += 1
-            _FRAMES.inc(direction="failed")
-            if self.failed in (1, 10, 100):  # log decimated, not never
-                print(
-                    f"[telemetry] ship failed (x{self.failed}): "
-                    f"{type(e).__name__}: {e}",
-                    flush=True,
-                )
-            return False
+        # aggregators all down/unreachable: drop the frame, keep
+        # training — a live aggregator sees the gap as missed
+        # heartbeats, which is exactly the signal it exists for
+        self._count_ship_failure(last_err)
+        return False
+
+    def _count_ship_failure(self, e: Optional[Exception]) -> None:
+        self.failed += 1
+        _FRAMES.inc(direction="failed")
+        if self.failed in (1, 10, 100):  # log decimated, not never
+            print(
+                f"[telemetry] ship failed (x{self.failed}): "
+                f"{type(e).__name__}: {e}",
+                flush=True,
+            )
 
     def build_frame(self) -> dict:
         with self._lock:
@@ -451,14 +659,22 @@ class Watchdog:
         for row in rows:
             row["window"] = window
             row["t_wall"] = round(float(t_wall), 3)
-            _ALERTS.inc(rule=row["rule"])
-            self._log(
-                f"[watchdog] ALERT window={window} rule={row['rule']} "
-                f"rank={row['rank']} :: {row['message']}"
-            )
-        self.alerts_total += len(rows)
-        self.history.extend(rows)
+            self.raise_alert(row)
         return rows
+
+    def raise_alert(self, row: dict) -> dict:
+        """Log/count/retain ONE pre-built structured alert row — the
+        path for alerts that are not window-threshold verdicts (the
+        standby's ``aggregator_failover`` announcement)."""
+        _ALERTS.inc(rule=row["rule"])
+        self._log(
+            f"[watchdog] ALERT window={row.get('window')} "
+            f"rule={row['rule']} rank={row.get('rank')} :: "
+            f"{row['message']}"
+        )
+        self.alerts_total += 1
+        self.history.append(row)
+        return row
 
 
 # ---------------------------------------------------------------------------
@@ -487,6 +703,23 @@ class Aggregator:
     watchdog.  Missing ranks never raise: a rank is declared dead when
     its last frame is older than ``heartbeat_miss × period_s`` and
     comes back silently when frames resume.
+
+    **HA roles.**  A ``role="primary"`` aggregator (default — the
+    original behavior) persists verdicts, writes doctor-state
+    checkpoints, and, when ``peers`` are configured, forwards every
+    ingested frame to them plus one ``tmpi_agg_hb`` beacon per closed
+    window.  A ``role="standby"`` ingests those forwarded frames in
+    SHADOW: it runs the same doctor and watchdog per window (so its
+    verdicts are byte-comparable with the primary's) but persists and
+    checkpoints nothing — until it misses ``promote_after``
+    consecutive primary heartbeats at window closes, at which point it
+    promotes itself: one structured ``aggregator_failover`` alert, then
+    full primary behavior, continuing the run's cumulative trends from
+    the shadowed stream (or, cold, from ``resume()`` on the primary's
+    checkpoint + timeline).  Peers may be ``(host, port)`` endpoints
+    (forwarded over the transport on a helper thread, failures counted
+    never raised) or in-process ``Aggregator`` objects (tests, the
+    replay drill).
     """
 
     def __init__(
@@ -499,12 +732,40 @@ class Aggregator:
         log=None,
         clock=time.monotonic,
         persist_path: Optional[str] = None,
+        persist_max_bytes: int = 0,
+        role: str = "primary",
+        name: str = "agg0",
+        peers: Optional[list] = None,
+        promote_after: int = 3,
+        checkpoint_path: Optional[str] = None,
     ):
+        if role not in ("primary", "standby"):
+            raise ValueError(
+                f"role must be 'primary' or 'standby', not {role!r}"
+            )
         self.period_s = float(period_s)
         self.heartbeat_miss = int(heartbeat_miss)
         self.clock = clock
+        self.name = str(name)
+        self.role = role
+        self.promote_after = int(promote_after)
+        self.promoted_at_window: Optional[int] = None
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_failures = 0
+        self.checkpoints_written = 0
+        self.peers = list(peers or ())
+        self._fwd_queue: deque = deque(maxlen=4096)
+        self._fwd_thread: Optional[threading.Thread] = None
+        self._fwd_wake = threading.Event()
+        self._fwd_stop = False
+        self.forward_failures = 0
+        # primary-heartbeat bookkeeping (standby side)
+        self._hb_seen_since_close = False
+        self._missed_hb = 0
+        self._primary_window = 0
         self.verdict_log = (
-            VerdictLog(persist_path) if persist_path else None
+            VerdictLog(persist_path, max_bytes=persist_max_bytes)
+            if persist_path else None
         )
         self._lock = threading.Lock()
         self.doctor = analysis.StreamingDoctor(stall_min_s=stall_min_s)
@@ -524,12 +785,25 @@ class Aggregator:
         self.windows: List[dict] = []
         self.max_windows_kept = 64
         self.n_windows = 0
+        self._win_close_hist = _window_close_histogram()
+        _AGG_ROLE.set(
+            1.0 if self.role == "primary" else 0.0, name=self.name
+        )
 
     # ---- ingest ------------------------------------------------------
     def ingest(self, frame: dict) -> dict:
         """One frame in, one ack out.  Malformed frames are refused in
         the reply, never raised — a bad frame must not kill the
         serve thread under every OTHER rank."""
+        if isinstance(frame, dict) and frame.get("kind") == HB_KIND:
+            # the primary's liveness beacon (standby side)
+            with self._lock:
+                self._hb_seen_since_close = True
+                self._missed_hb = 0
+                self._primary_window = max(
+                    self._primary_window, int(frame.get("window", 0))
+                )
+            return {"ok": True, "hb": True, "role": self.role}
         if not isinstance(frame, dict) or frame.get("kind") != FRAME_KIND:
             _FRAMES.inc(direction="refused")
             return {"ok": False, "err": "not a telemetry frame"}
@@ -540,7 +814,9 @@ class Aggregator:
                 rv = self.view[label] = _RankView()
             seq = int(frame.get("seq", 0))
             if rv.seq and seq > rv.seq + 1:
-                rv.lost_frames += seq - rv.seq - 1
+                lost = seq - rv.seq - 1
+                rv.lost_frames += lost
+                _AGG_LOST.inc(lost, name=self.name, rank=label)
             rv.seq = max(rv.seq, seq)
             rv.frames += 1
             rv.last_wall = float(frame.get("t_wall", 0.0))
@@ -550,7 +826,63 @@ class Aggregator:
             self._ingest_events(label, frame)
             self._ingest_hist(frame)
         _FRAMES.inc(direction="ingested")
+        _AGG_FRAMES.inc(name=self.name, rank=label)
+        # shadow feed: the standby sees exactly what the primary saw.
+        # Outside the lock — peer IO must not stall the serve thread.
+        if self.peers and self.role == "primary":
+            self._forward(frame)
         return {"ok": True, "seq": seq}
+
+    # ---- peer forwarding (primary → standbys) ------------------------
+    def _forward(self, frame: dict) -> None:
+        for peer in self.peers:
+            if isinstance(peer, Aggregator):
+                try:
+                    peer.ingest(frame)
+                except Exception:
+                    self.forward_failures += 1
+                    _AGG_FWD_FAIL.inc(name=self.name)
+            else:
+                self._fwd_queue.append((tuple(peer), frame))
+        if any(not isinstance(p, Aggregator) for p in self.peers):
+            self._ensure_forwarder()
+            self._fwd_wake.set()
+
+    def _ensure_forwarder(self) -> None:
+        if self._fwd_thread is not None and self._fwd_thread.is_alive():
+            return
+        self._fwd_stop = False
+        self._fwd_thread = threading.Thread(
+            target=self._run_forwarder,
+            name=f"AggregatorForwarder-{self.name}", daemon=True,
+        )
+        self._fwd_thread.start()
+
+    def _run_forwarder(self) -> None:
+        from theanompi_tpu.parallel.transport import request
+
+        while not self._fwd_stop:
+            self._fwd_wake.wait(timeout=1.0)
+            self._fwd_wake.clear()
+            while self._fwd_queue:
+                try:
+                    addr, frame = self._fwd_queue.popleft()
+                except IndexError:
+                    break
+                try:
+                    request(addr, frame, timeout=10.0)
+                except Exception:
+                    # a dead standby must not wedge the primary — the
+                    # standby catches up from the shared checkpoint
+                    self.forward_failures += 1
+                    _AGG_FWD_FAIL.inc(name=self.name)
+
+    def close_forwarder(self) -> None:
+        self._fwd_stop = True
+        self._fwd_wake.set()
+        if self._fwd_thread is not None:
+            self._fwd_thread.join(timeout=10)
+            self._fwd_thread = None
 
     def _ingest_events(self, label: str, frame: dict) -> None:
         events: List[dict] = []
@@ -640,12 +972,20 @@ class Aggregator:
                 out.append(label)
         return out
 
-    def close_window(self, now: Optional[float] = None) -> dict:
+    def close_window(
+        self, now: Optional[float] = None, final: bool = False
+    ) -> dict:
         """Close the current observation window: per-window doctor
         verdict + serving SLO percentiles + clock offsets + watchdog
-        alerts.  Returns the verdict (also retained in ``windows``)."""
+        alerts.  Returns the verdict (also retained in ``windows``).
+        On a standby this is also the promotion clock: a close that
+        brings the consecutive primary-heartbeat misses to
+        ``promote_after`` promotes this aggregator mid-call, so the
+        very verdict that detected the blackout is already persisted
+        by the new primary."""
+        t_close0 = time.perf_counter()
         with self._lock:
-            verdict = self.doctor.close_window()
+            verdict = self.doctor.close_window(final=final)
             verdict["t_wall"] = round(time.time(), 3)
             serving = {}
             for metric, key in analysis.SLO_HISTOGRAMS:
@@ -670,7 +1010,10 @@ class Aggregator:
                 }
                 if unaligned:
                     verdict["clock_unaligned"] = unaligned
-            dead = self.dead_ranks(now)
+            # the final (shutdown-flush) window skips heartbeat
+            # escalation: ranks that already exited are expected
+            # silence, not a fresh page
+            dead = [] if final else self.dead_ranks(now)
             if dead:
                 verdict["dead_ranks"] = dead
         # watchdog outside the ingest lock: its log hook is arbitrary
@@ -678,16 +1021,183 @@ class Aggregator:
         verdict["alerts"] = self.watchdog.evaluate(
             verdict, dead_ranks=tuple(dead if dead else ())
         )
+        # standby promotion clock: a window close with no primary
+        # heartbeat since the last close is one miss; promote_after
+        # consecutive misses means the primary is gone — announce ONE
+        # structured alert and take over, instead of a blackout
+        if self.role == "standby":
+            with self._lock:
+                if self._hb_seen_since_close:
+                    self._hb_seen_since_close = False
+                    self._missed_hb = 0
+                else:
+                    self._missed_hb += 1
+                promote = self._missed_hb >= self.promote_after
+            if promote:
+                verdict["alerts"].append(self._promote(verdict))
         with self._lock:
             self.n_windows = verdict["window"]
             self.windows.append(verdict)
             del self.windows[: -self.max_windows_kept]
         # the in-memory ring keeps only the newest windows; the JSONL
         # timeline keeps them ALL (outside the lock: file IO must not
-        # stall frame ingestion)
-        if self.verdict_log is not None:
-            self.verdict_log.append(verdict)
+        # stall frame ingestion).  A standby persists nothing — the
+        # primary owns the timeline until the takeover.
+        if self.role == "primary":
+            if self.verdict_log is not None:
+                self.verdict_log.append(verdict)
+            if self.checkpoint_path:
+                self.checkpoint()
+            for peer in self.peers:
+                self._send_heartbeat(peer)
+        self._win_close_hist.observe(
+            time.perf_counter() - t_close0, name=self.name
+        )
         return verdict
+
+    def _send_heartbeat(self, peer) -> None:
+        hb = {"kind": HB_KIND, "v": FRAME_VERSION, "name": self.name,
+              "window": self.n_windows, "t_wall": time.time()}
+        if isinstance(peer, Aggregator):
+            try:
+                peer.ingest(hb)
+            except Exception:
+                self.forward_failures += 1
+                _AGG_FWD_FAIL.inc(name=self.name)
+        else:
+            self._fwd_queue.append((tuple(peer), hb))
+            self._ensure_forwarder()
+            self._fwd_wake.set()
+
+    def _promote(self, verdict: dict) -> dict:
+        """Standby → primary, announced as one structured alert."""
+        self.role = "primary"
+        self.promoted_at_window = int(verdict.get("window") or 0)
+        _AGG_ROLE.set(1.0, name=self.name)
+        row = {
+            "rule": "aggregator_failover",
+            "rank": None,
+            "value": self._missed_hb,
+            "threshold": self.promote_after,
+            "message": (
+                f"standby {self.name!r} promoted to primary after "
+                f"{self._missed_hb} missed primary heartbeat(s) — "
+                "verdict timeline continues from window "
+                f"{self.promoted_at_window}"
+            ),
+            "window": verdict.get("window"),
+            "t_wall": verdict.get("t_wall") or round(time.time(), 3),
+        }
+        return self.watchdog.raise_alert(row)
+
+    # ---- durable state ----------------------------------------------
+    def checkpoint(self) -> bool:
+        """Write the doctor state + rank view to ``checkpoint_path``
+        (atomic tmp+rename, versioned).  Failures are counted, never
+        raised — the checkpoint is the recovery path, not a new way to
+        die."""
+        import json
+
+        try:
+            with self._lock:
+                doc = {
+                    "kind": CHECKPOINT_KIND,
+                    "v": CHECKPOINT_VERSION,
+                    "name": self.name,
+                    "t_wall": round(time.time(), 3),
+                    "n_windows": self.n_windows,
+                    "alerts_total": self.watchdog.alerts_total,
+                    "doctor": self.doctor.snapshot(),
+                    "view": {
+                        label: {
+                            "seq": rv.seq, "frames": rv.frames,
+                            "lost_frames": rv.lost_frames,
+                            "counters": dict(rv.counters),
+                        }
+                        for label, rv in self.view.items()
+                    },
+                }
+            tmp = f"{self.checkpoint_path}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, default=str)
+                f.write("\n")
+            os.replace(tmp, self.checkpoint_path)
+            self.checkpoints_written += 1
+            _AGG_CKPTS.inc(name=self.name, result="ok")
+            return True
+        except Exception as e:
+            self.checkpoint_failures += 1
+            _AGG_CKPTS.inc(name=self.name, result="failed")
+            if self.checkpoint_failures == 1:
+                print(
+                    f"[live] checkpoint write failed "
+                    f"({self.checkpoint_path}): "
+                    f"{type(e).__name__}: {e}",
+                    flush=True,
+                )
+            return False
+
+    def resume(
+        self,
+        checkpoint_path: Optional[str] = None,
+        timeline_path: Optional[str] = None,
+    ) -> dict:
+        """Rebuild cumulative state from a checkpoint plus (optionally)
+        the persisted verdict timeline — what a RESTARTED aggregator or
+        a cold standby runs before serving.  The checkpoint restores
+        the doctor (frozen totals + tails) and rank views; the timeline
+        replay refills the in-memory window ring and advances the
+        window counter past any verdicts persisted after the restored
+        checkpoint, so numbering never collides.  Returns a summary of
+        what was recovered; raises ``ValueError`` on a checkpoint of an
+        unknown version (see the format policy in
+        docs/observability.md)."""
+        import json
+
+        path = checkpoint_path or self.checkpoint_path
+        if not path:
+            raise ValueError("resume() needs a checkpoint path")
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("kind") != CHECKPOINT_KIND:
+            raise ValueError(f"{path}: not an aggregator checkpoint")
+        if doc.get("v") != CHECKPOINT_VERSION:
+            raise ValueError(
+                f"{path}: checkpoint version {doc.get('v')!r} not "
+                f"supported (this build reads v{CHECKPOINT_VERSION})"
+            )
+        doctor = analysis.StreamingDoctor.restore(doc["doctor"])
+        replayed = 0
+        last_window = int(doc.get("n_windows", 0))
+        ring: List[dict] = []
+        if timeline_path:
+            from theanompi_tpu.observability import history
+
+            for verdict in history.iter_timeline(timeline_path):
+                ring.append(verdict)
+                w = int(verdict.get("window") or 0)
+                if w > last_window:
+                    last_window = w
+                    replayed += 1
+        with self._lock:
+            self.doctor = doctor
+            self.doctor.n_windows = last_window
+            self.n_windows = last_window
+            self.view = {}
+            for label, rv_doc in (doc.get("view") or {}).items():
+                rv = self.view[str(label)] = _RankView()
+                rv.seq = int(rv_doc.get("seq", 0))
+                rv.frames = int(rv_doc.get("frames", 0))
+                rv.lost_frames = int(rv_doc.get("lost_frames", 0))
+                rv.counters = dict(rv_doc.get("counters") or {})
+            self.windows = ring[-self.max_windows_kept:]
+        return {
+            "checkpoint": path,
+            "checkpoint_window": int(doc.get("n_windows", 0)),
+            "resumed_window": last_window,
+            "timeline_windows_replayed": replayed,
+            "ranks": sorted(self.view),
+        }
 
     # ---- surfaces ----------------------------------------------------
     def health(self) -> dict:
@@ -718,21 +1228,57 @@ class Aggregator:
                 status = "alert"
             doc = {
                 "status": status,
+                "role": self.role,
+                "name": self.name,
                 "windows": self.n_windows,
                 "alerts_total": self.watchdog.alerts_total,
                 "thresholds": dict(self.watchdog.thresholds),
                 "ranks": ranks,
                 "recent_alerts": recent,
+                "self": self._self_telemetry_locked(),
             }
             if last is not None:
                 doc["last_window"] = last
             return doc
+
+    def _self_telemetry_locked(self) -> dict:
+        """The aggregator's view of ITSELF — the monitor is no longer
+        the one unobserved component.  The same numbers live in the
+        registry (``aggregator_*`` metrics on /metrics); this inline
+        copy makes /health self-contained."""
+        out = {
+            "frames_ingested": sum(
+                rv.frames for rv in self.view.values()
+            ),
+            "frames_lost": sum(
+                rv.lost_frames for rv in self.view.values()
+            ),
+            "forward_failures": self.forward_failures,
+            "window_close_p99_s": self._win_close_hist.quantile(
+                0.99, name=self.name
+            ),
+            "promoted_at_window": self.promoted_at_window,
+        }
+        if self.checkpoint_path:
+            out["checkpoint"] = {
+                "path": self.checkpoint_path,
+                "written": self.checkpoints_written,
+                "failed": self.checkpoint_failures,
+            }
+        return out
+
+    def recent_windows(self) -> List[dict]:
+        """The in-memory verdict ring (newest last) — the /timeline
+        route's document."""
+        with self._lock:
+            return list(self.windows)
 
     def summary(self) -> dict:
         """End-of-run roll-up (what bench attaches to its JSON)."""
         with self._lock:
             out = {
                 "windows": self.n_windows,
+                "role": self.role,
                 "alerts_total": self.watchdog.alerts_total,
                 "alerts": list(self.watchdog.history)[-20:],
                 "ranks": {
@@ -741,12 +1287,16 @@ class Aggregator:
                     for label, rv in sorted(self.view.items())
                 },
                 "cumulative": self.doctor.cumulative(),
+                "self": self._self_telemetry_locked(),
             }
+            if self.promoted_at_window is not None:
+                out["promoted_at_window"] = self.promoted_at_window
             if self.verdict_log is not None:
                 out["verdict_timeline"] = {
                     "path": self.verdict_log.path,
                     "written": self.verdict_log.written,
                     "failed": self.verdict_log.failed,
+                    "rotations": self.verdict_log.rotations,
                 }
             return out
 
@@ -779,6 +1329,9 @@ class LiveMonitor:
         health_port: Optional[int] = None,
         log=None,
         persist_path: Optional[str] = None,
+        persist_max_bytes: int = 0,
+        checkpoint_path: Optional[str] = None,
+        peers: Optional[list] = None,
     ):
         from theanompi_tpu import observability as obs
 
@@ -790,6 +1343,10 @@ class LiveMonitor:
             heartbeat_miss=heartbeat_miss,
             log=log,
             persist_path=persist_path,
+            persist_max_bytes=persist_max_bytes,
+            checkpoint_path=checkpoint_path,
+            peers=peers,
+            name=rank_label,
         )
         self.shipper = TelemetryShipper(
             rank_label, aggregator=self.aggregator, period_s=period_s
@@ -802,6 +1359,7 @@ class LiveMonitor:
             from theanompi_tpu.observability import export
 
             export.set_health_provider(self.aggregator.health)
+            export.set_timeline_provider(self.aggregator.recent_windows)
             self._health_server = export.ObservabilityServer(
                 port=health_port
             ).start()
@@ -825,11 +1383,13 @@ class LiveMonitor:
                 )
 
     def stop(self) -> dict:
-        """Final beat + final window; returns the run summary."""
+        """Final beat + final window (flushed: still-open stall windows
+        close, matching the offline doctor); returns the run summary."""
         self._stop.set()
         self._timer.join(timeout=max(10.0, 2 * self.window_s))
         ship_stats = self.shipper.stop()
-        self.aggregator.close_window()
+        self.aggregator.close_window(final=True)
+        self.aggregator.close_forwarder()
         if self._channel is not None:
             self._channel.close()
         if self._health_server is not None:
@@ -837,6 +1397,7 @@ class LiveMonitor:
             from theanompi_tpu.observability import export
 
             export.set_health_provider(None)
+            export.set_timeline_provider(None)
         out = self.aggregator.summary()
         out["shipper"] = ship_stats
         return out
@@ -853,6 +1414,147 @@ class _RemoteShipperHandle:
 
     def stop(self) -> dict:
         return {"shipper": self.shipper.stop()}
+
+
+# ---------------------------------------------------------------------------
+# HA replay drill: the committed kill-the-primary rehearsal
+# ---------------------------------------------------------------------------
+
+def frames_from_events(
+    label: str, events: List[dict], seq: int,
+    sample_rate: int = 1, dropped: int = 0,
+) -> dict:
+    """Recorded raw trace events (``ph`` X/C/s/f dicts) → one REAL
+    telemetry frame, byte-shaped like ``TelemetryShipper.build_frame``
+    — so replay drills exercise ``Aggregator.ingest`` (and peer
+    forwarding) end-to-end instead of poking the doctor directly."""
+    names: List[str] = []
+    name_idx: Dict[str, int] = {}
+    idx, ts, dur = [], [], []
+    ctr_ts, ctr_key, ctr_val = [], [], []
+    fb_id, fb_ts, fe_id, fe_ts = [], [], [], []
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            n = ev.get("name", "")
+            i = name_idx.get(n)
+            if i is None:
+                i = name_idx[n] = len(names)
+                names.append(n)
+            idx.append(float(i))
+            ts.append(float(ev.get("ts", 0.0)))
+            dur.append(float(ev.get("dur", 0.0)))
+        elif ph == "C":
+            if ev.get("name") != "inbox_depth":
+                continue
+            args = ev.get("args") or {}
+            ctr_ts.append(float(ev.get("ts", 0.0)))
+            ctr_key.append(args.get("rank"))
+            ctr_val.append(float(args.get("value", 0.0)))
+        elif ph == "s":
+            fb_id.append(str(ev.get("id")))
+            fb_ts.append(float(ev.get("ts", 0.0)))
+        elif ph == "f":
+            fe_id.append(str(ev.get("id")))
+            fe_ts.append(float(ev.get("ts", 0.0)))
+    return {
+        "kind": FRAME_KIND,
+        "v": FRAME_VERSION,
+        "rank": label,
+        "seq": int(seq),
+        "t_wall": time.time(),
+        "sample_rate": int(sample_rate),
+        "dropped": int(dropped),
+        "spans": {"names": names, "idx": idx, "ts": ts, "dur": dur},
+        "ctrs": {"ts": ctr_ts, "key": ctr_key, "val": ctr_val},
+        "flows": {"b_id": fb_id, "b_ts": fb_ts,
+                  "f_id": fe_id, "f_ts": fe_ts},
+        "counters": {},
+        "hist": {},
+    }
+
+
+def ha_replay_drill(
+    per_rank: List[tuple],
+    n_windows: int = 6,
+    kill_after: int = 2,
+    thresholds: Optional[dict] = None,
+    promote_after: int = 2,
+    stall_min_s: float = 0.0,
+    persist_primary: Optional[str] = None,
+    persist_standby: Optional[str] = None,
+    checkpoint_path: Optional[str] = None,
+    log=None,
+) -> dict:
+    """Deterministic kill-the-primary rehearsal over recorded streams —
+    the machinery under ``watch --replay --ha-drill`` and the perf
+    gate's failover leg.
+
+    ``per_rank``: ``(label, events, sample_rate, dropped)`` tuples,
+    events in completion order (the replay shape).  Each window's chunk
+    of every rank's stream becomes a real telemetry frame ingested by
+    the PRIMARY, which shadow-forwards to the STANDBY (peer wiring);
+    after ``kill_after`` closed windows the primary dies mid-stream and
+    the shippers' endpoint failover lands subsequent frames on the
+    standby directly.  The standby promotes after ``promote_after``
+    heartbeat-less window closes, announcing exactly one
+    ``aggregator_failover`` alert.
+
+    Returns ``{"verdicts": [(who, verdict), ...], "promoted": bool,
+    "failover_alerts": int, "primary": Aggregator,
+    "standby": Aggregator}`` — at most ``promote_after - 1`` windows of
+    the combined persisted timeline are missing versus an uninterrupted
+    run (the shadow windows the standby closed before it started
+    persisting)."""
+    standby = Aggregator(
+        thresholds=thresholds, stall_min_s=stall_min_s,
+        role="standby", name="standby", promote_after=promote_after,
+        persist_path=persist_standby, log=log,
+    )
+    primary = Aggregator(
+        thresholds=thresholds, stall_min_s=stall_min_s,
+        role="primary", name="primary", peers=[standby],
+        persist_path=persist_primary, checkpoint_path=checkpoint_path,
+        log=log,
+    )
+    verdicts: List[Tuple[str, dict]] = []
+    alive = True
+    for k in range(n_windows):
+        for label, events, sample_rate, dropped in per_rank:
+            lo = (k * len(events)) // n_windows
+            hi = ((k + 1) * len(events)) // n_windows
+            frame = frames_from_events(
+                label, events[lo:hi], seq=k + 1,
+                sample_rate=sample_rate,
+                dropped=dropped if k == 0 else 0,
+            )
+            # the shipper's ladder: primary first, standby on failure
+            if alive:
+                primary.ingest(frame)  # forwards to the standby peer
+            else:
+                standby.ingest(frame)
+        final = k == n_windows - 1
+        if alive:
+            v = primary.close_window(final=final)  # heartbeats standby
+            standby.close_window(final=final)      # shadow verdict
+            verdicts.append(("primary", v))
+            if k + 1 == kill_after:
+                alive = False  # SIGKILL, mid-stream
+        else:
+            v = standby.close_window(final=final)
+            verdicts.append(("standby", v))
+    failover_alerts = sum(
+        1 for _, v in verdicts for a in v.get("alerts", ())
+        if a["rule"] == "aggregator_failover"
+    )
+    return {
+        "verdicts": verdicts,
+        "promoted": standby.role == "primary",
+        "promoted_at_window": standby.promoted_at_window,
+        "failover_alerts": failover_alerts,
+        "primary": primary,
+        "standby": standby,
+    }
 
 
 def thresholds_from_env(env=os.environ) -> dict:
@@ -884,9 +1586,12 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
       (aggregator + shipper + watchdog); optional
       ``THEANOMPI_LIVE_PORT`` serves the aggregator for other
       processes and ``THEANOMPI_LIVE_HEALTH_PORT`` serves ``/health``.
-    - ``THEANOMPI_LIVE_AGG=host:port`` — ship this process's frames to
-      an aggregator elsewhere (a ``watch`` CLI, or rank 0 running with
-      ``THEANOMPI_LIVE=1 THEANOMPI_LIVE_PORT=...``).
+    - ``THEANOMPI_LIVE_AGG=host:port[,host:port...]`` — ship this
+      process's frames to an aggregator elsewhere (a ``watch`` CLI, or
+      rank 0 running with ``THEANOMPI_LIVE=1 THEANOMPI_LIVE_PORT=...``).
+      Extra comma-separated entries are the HA ladder: the shipper
+      fails over down the list when the current endpoint refuses or
+      times out (a single ``host:port`` behaves exactly as before).
 
     Cadence via ``THEANOMPI_LIVE_PERIOD_S`` (heartbeat, default 1.0)
     and ``THEANOMPI_LIVE_WINDOW_S`` (verdict window, default 5.0);
@@ -894,8 +1599,13 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
     ``THEANOMPI_LIVE_PERSIST=1`` appends every closed window's verdict
     to ``<obs dir>/<rank>_verdicts.jsonl`` (any other value is taken
     as the JSONL path) — the full-run timeline the in-memory window
-    ring cannot hold.  Returns an object with ``.stop() -> summary``
-    or ``None``.
+    ring cannot hold; ``THEANOMPI_LIVE_PERSIST_MAX_MB`` rotates the
+    timeline into size-capped segments past that many megabytes.
+    ``THEANOMPI_LIVE_CKPT=1`` checkpoints the aggregator's doctor
+    state beside the timeline (``<obs dir>/<rank>_agg_ckpt.json``; any
+    other value is the path) so a restarted monitor resumes instead of
+    starting cold.  Returns an object with ``.stop() -> summary`` or
+    ``None``.
     """
     agg_addr = (env.get("THEANOMPI_LIVE_AGG") or "").strip()
     live = env.get("THEANOMPI_LIVE") == "1"
@@ -903,11 +1613,10 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
         return None
     period = float(env.get("THEANOMPI_LIVE_PERIOD_S") or 1.0)
     if agg_addr:
-        host, _, port = agg_addr.rpartition(":")
         return _RemoteShipperHandle(
             TelemetryShipper(
                 rank_label,
-                address=(host or "127.0.0.1", int(port)),
+                address=parse_endpoints(agg_addr),
                 period_s=period,
             )
         )
@@ -920,6 +1629,19 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
         persist_path = VerdictLog.default_path(rank_label)
     elif persist:
         persist_path = persist
+    persist_max_bytes = int(
+        float(env.get("THEANOMPI_LIVE_PERSIST_MAX_MB") or 0) * 1e6
+    )
+    ckpt = (env.get("THEANOMPI_LIVE_CKPT") or "").strip()
+    checkpoint_path = None
+    if ckpt == "1":
+        from theanompi_tpu.observability import export
+
+        checkpoint_path = os.path.join(
+            export.obs_dir(), f"{rank_label}_agg_ckpt.json"
+        )
+    elif ckpt:
+        checkpoint_path = ckpt
     return LiveMonitor(
         rank_label,
         thresholds=thresholds_from_env(env),
@@ -928,4 +1650,6 @@ def maybe_start_from_env(rank_label: str, env=os.environ):
         port=int(port) if port else None,
         health_port=int(health_port) if health_port else None,
         persist_path=persist_path,
+        persist_max_bytes=persist_max_bytes,
+        checkpoint_path=checkpoint_path,
     )

@@ -36,6 +36,14 @@ DEFAULT_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# microbenchmark-shaped: 100µs .. 2.5s — for in-process bookkeeping
+# costs (verdict-window closes, checkpoint writes) where the whole
+# DEFAULT_BUCKETS first bucket would swallow every observation
+SUBSECOND_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
 _KINDS = ("counter", "gauge", "histogram")
 
 

@@ -461,7 +461,9 @@ class _AsyncDriverBase:
         # live telemetry (observability/live.py): the threaded drivers
         # are one process sharing one tracer, so ONE shipper covers
         # every worker thread (per-thread tracks ride the span digests).
-        # Inert unless THEANOMPI_LIVE=1 / THEANOMPI_LIVE_AGG is set.
+        # Inert unless THEANOMPI_LIVE=1 / THEANOMPI_LIVE_AGG is set
+        # (AGG accepts "host:port,host:port" — the HA aggregator
+        # ladder; ship failover is counted, never raised into workers).
         from theanompi_tpu.observability import live as obs_live
 
         self._telemetry = obs_live.maybe_start_from_env(

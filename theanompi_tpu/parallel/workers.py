@@ -222,10 +222,14 @@ class BSP_Worker:
     def run(self) -> None:
         model, rec = self.model, self.recorder
         # live telemetry heartbeat (observability/live.py): inert unless
-        # THEANOMPI_LIVE=1 / THEANOMPI_LIVE_AGG is set.  Started BEFORE
-        # compile on purpose — a wedged compile then shows up on the
-        # aggregator as a rank that heartbeats but never steps, which is
-        # a different (and correctly diagnosed) failure than a dead rank
+        # THEANOMPI_LIVE=1 / THEANOMPI_LIVE_AGG is set (AGG takes a
+        # comma-separated endpoint ladder — the shipper fails over to
+        # the standby aggregator when the primary dies, so preempting
+        # rank 0 no longer takes the monitoring plane with it).
+        # Started BEFORE compile on purpose — a wedged compile then
+        # shows up on the aggregator as a rank that heartbeats but
+        # never steps, which is a different (and correctly diagnosed)
+        # failure than a dead rank
         from theanompi_tpu.observability import live as obs_live
 
         telemetry = obs_live.maybe_start_from_env(
