@@ -69,6 +69,26 @@
 #                           contiguous equal-memory concurrency ratio
 #                           under the long-tail workload (default 2.0)
 #
+# Chaos leg (the elastic-membership drill; docs/elasticity.md):
+#   PERF_GATE_CHAOS         1 (default) = run the kill-evict-respawn-readmit
+#                           drill: spawn the async fleet, SIGKILL one
+#                           worker mid-run via the fault injector, and
+#                           REQUIRE that it is evicted exactly once,
+#                           respawned, re-admitted checkpointlessly, and
+#                           that the final loss stays within tolerance of
+#                           an uninterrupted baseline.  An elasticity
+#                           layer that can't survive its own drill fails
+#                           the gate.  0 = skip.
+#   PERF_GATE_CHAOS_JSON    pre-produced drill verdict JSON (skips running
+#                           — the tier-1 smoke path)
+#   PERF_GATE_CHAOS_CMD     command producing the drill JSON (default:
+#                           python -m theanompi_tpu.runtime.chaos over
+#                           EASGD and GOSGD)
+#   PERF_GATE_CHAOS_KILL_ITER    iteration the injected kill fires at
+#                           (default 10)
+#   PERF_GATE_CHAOS_REJOIN_AFTER seconds before the supervisor respawns
+#                           the killed rank (default 2)
+#
 # Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
 set -euo pipefail
 
@@ -297,6 +317,50 @@ if fed is None or no_reuse is None or fed >= no_reuse:
              f"({fed}) not below the no-reuse baseline ({no_reuse})")
 print(f"[perf_gate] paged: ratio {ratio}, prefix hit_rate {hit_rate}, "
       f"prefill {fed} vs {no_reuse} tokens", file=sys.stderr)
+PY
+fi
+
+# ---- 7. chaos leg: the elastic membership drill -----------------------------
+if [ "${PERF_GATE_CHAOS:-1}" = "1" ]; then
+    CHAOS_JSON="${PERF_GATE_CHAOS_JSON:-}"
+    if [ -z "$CHAOS_JSON" ]; then
+        CHAOS_JSON="$WORKDIR/chaos.json"
+        KILL_ITER="${PERF_GATE_CHAOS_KILL_ITER:-10}"
+        REJOIN_AFTER="${PERF_GATE_CHAOS_REJOIN_AFTER:-10}"
+        CHAOS_CMD="${PERF_GATE_CHAOS_CMD:-env JAX_PLATFORMS=cpu python -m theanompi_tpu.runtime.chaos --rule EASGD --rule GOSGD --kill-iter $KILL_ITER --rejoin-after $REJOIN_AFTER --workdir $WORKDIR/chaos}"
+        echo "[perf_gate] chaos drill: $CHAOS_CMD" >&2
+        set +e
+        sh -c "$CHAOS_CMD" > "$CHAOS_JSON"
+        CHAOS_RC=$?
+        set -e
+        if [ ! -s "$CHAOS_JSON" ]; then
+            echo "[perf_gate] CHAOS VIOLATION: drill produced no verdict (exit $CHAOS_RC)" >&2
+            exit 1
+        fi
+    fi
+    # structure check: every drilled rule must have survived its kill —
+    # evicted exactly once, respawned, re-admitted, loss within tolerance
+    python - "$CHAOS_JSON" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+rules = doc.get("rules") or {}
+if not rules:
+    sys.exit("[perf_gate] CHAOS VIOLATION: drill verdict has no rules")
+for rule, v in sorted(rules.items()):
+    for viol in v.get("violations", []):
+        print(f"[perf_gate] CHAOS VIOLATION [{rule}]: {viol}",
+              file=sys.stderr)
+    if not v.get("ok"):
+        sys.exit(1)
+    kills = v.get("kills_observed", 0)
+    if kills < 1 or v.get("evictions") != kills:
+        sys.exit(f"[perf_gate] CHAOS VIOLATION [{rule}]: "
+                 f"{v.get('evictions')} eviction(s) for {kills} kill(s)")
+    print(f"[perf_gate] chaos [{rule}]: {kills} kill -> "
+          f"{v.get('evictions')} eviction, "
+          f"{v.get('rejoins', 0) + v.get('readmissions', 0)} re-admission(s), "
+          f"loss delta {v.get('loss_delta')} (tol {v.get('loss_tolerance')})",
+          file=sys.stderr)
 PY
 fi
 echo "[perf_gate] green" >&2

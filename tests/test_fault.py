@@ -229,6 +229,203 @@ def test_worker_rejects_bad_watchdog_action(tmp_path):
         BSP_Worker(m, watchdog_timeout=10, watchdog_action="exi")
 
 
+# ---------------------------------------------------------------------------
+# Watchdog API coverage (ISSUE 10 satellite: maybe/validate_action/
+# pause-around-a-slow-tick/run_with_restart exhaustion behavior)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_maybe_returns_none_for_falsy_timeouts():
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    assert Watchdog.maybe(None) is None
+    assert Watchdog.maybe(0) is None
+    assert Watchdog.maybe(0.0) is None
+
+
+def test_watchdog_maybe_arms_on_first_tick():
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    wd = Watchdog.maybe(300, "dump")
+    try:
+        assert wd is not None
+        assert wd._armed is False  # startup compiles never count
+        wd.tick()
+        assert wd._armed is True
+    finally:
+        wd.close()
+
+
+def test_watchdog_maybe_forwards_kwargs_and_validates():
+    import pytest as _pytest
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    with _pytest.raises(ValueError, match="watchdog action"):
+        Watchdog.maybe(10, "explode")
+    wd = Watchdog.maybe(10, "exit", poll_s=0.5)
+    try:
+        assert wd.action == "exit"
+        assert wd._poll_s == 0.5
+    finally:
+        wd.close()
+
+
+def test_validate_action_returns_value_and_rejects_unknown():
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    assert Watchdog.validate_action("dump") == "dump"
+    assert Watchdog.validate_action("exit") == "exit"
+    with pytest.raises(ValueError, match="'exi'"):
+        Watchdog.validate_action("exi")
+
+
+def test_watchdog_pause_rearms_fresh_on_resume():
+    """The pause/timer interaction gap: a phase longer than the
+    timeout inside pause() must not fire, AND resuming must rearm from
+    NOW — the stale pre-pause timestamp would otherwise false-fire on
+    the first poll after resume."""
+    import time as _time
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    stalls = []
+    wd = Watchdog(timeout_s=0.4, poll_s=0.05, on_stall=stalls.append)
+    try:
+        wd.tick()
+        with wd.pause():
+            _time.sleep(0.9)  # slow tick: way past the timeout
+        _time.sleep(0.25)  # resumed, within the window measured from
+        # the resume point — a stale _last would have fired here
+        assert not stalls
+        wd.tick()
+        _time.sleep(0.2)
+        assert not stalls
+    finally:
+        wd.close()
+
+
+def test_watchdog_nested_pause_stays_suspended():
+    import time as _time
+
+    from theanompi_tpu.runtime.fault import Watchdog
+
+    stalls = []
+    wd = Watchdog(timeout_s=0.2, poll_s=0.05, on_stall=stalls.append)
+    try:
+        wd.tick()
+        with wd.pause():
+            with wd.pause():
+                _time.sleep(0.3)
+            _time.sleep(0.3)  # inner exit must not unpause the outer
+        assert not stalls
+    finally:
+        wd.close()
+
+
+def test_run_with_restart_exhaustion_reports_every_failure():
+    """Exhaustion behavior: on_failure sees every attempt (including
+    the final, budget-exhausting one) with 1-based attempt numbers,
+    and the LAST error is what propagates."""
+    seen = []
+
+    def always_fails(attempt):
+        raise TrainingFault(f"boom-{attempt}")
+
+    with pytest.raises(TrainingFault, match="boom-2"):
+        run_with_restart(
+            always_fails,
+            max_restarts=2,
+            on_failure=lambda n, e: seen.append((n, str(e))),
+        )
+    assert [n for n, _ in seen] == [1, 2, 3]
+    assert seen[-1][1] == "boom-2"  # run_fn saw attempts 0, 1, 2
+
+
+def test_run_with_restart_never_restarts_operator_abort():
+    calls = []
+
+    def aborts(attempt):
+        calls.append(attempt)
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        run_with_restart(aborts, max_restarts=5)
+    assert calls == [0]
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector chaos modes (ISSUE 10: kill/hang/slow + env plans)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injector_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="fault mode"):
+        FaultInjector([(0, 1, "explode")])
+
+
+def test_fault_injector_from_env_parses_and_filters_by_rank():
+    env = {"THEANOMPI_FAULT_PLAN": "kill@1:40;slow@2:10:0.05;raise@1:5"}
+    fi = FaultInjector.from_env(rank=1, env=env)
+    assert fi is not None
+    with pytest.raises(TrainingFault):
+        fi.maybe_fail(1, 5)
+    # rank 2's entries were filtered out of this process's plan
+    assert FaultInjector.from_env(rank=3, env=env) is None
+    assert FaultInjector.from_env(env={}) is None
+    with pytest.raises(ValueError, match="cannot parse"):
+        FaultInjector.from_env(env={"THEANOMPI_FAULT_PLAN": "kill@x"})
+
+
+def test_fault_injector_slow_mode_latches():
+    import time as _time
+
+    fi = FaultInjector([(0, 3, "slow", 0.05)])
+    t0 = _time.monotonic()
+    fi.maybe_fail(0, 1)
+    assert _time.monotonic() - t0 < 0.04  # before the latch: fast
+    fi.maybe_fail(0, 3)  # latches
+    t0 = _time.monotonic()
+    fi.maybe_fail(0, 4)
+    fi.maybe_fail(0, 5)
+    assert _time.monotonic() - t0 >= 0.09  # every later iter pays
+
+
+def test_fault_injector_hang_mode_blocks_for_arg():
+    import time as _time
+
+    fi = FaultInjector([(0, 2, "hang", 0.2)])
+    t0 = _time.monotonic()
+    fi.maybe_fail(0, 2)
+    assert _time.monotonic() - t0 >= 0.19
+    t0 = _time.monotonic()
+    fi.maybe_fail(0, 2)  # fired once; now clear
+    assert _time.monotonic() - t0 < 0.1
+
+
+def test_fault_injector_kill_mode_exits_process():
+    """kill really is a process death (os._exit, no cleanup) with the
+    injector's distinct exit code — verified in a subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "from theanompi_tpu.runtime.fault import FaultInjector\n"
+        "fi = FaultInjector([(1, 7, 'kill')])\n"
+        "for it in range(1, 10):\n"
+        "    fi.maybe_fail(1, it)\n"
+        "print('survived')\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=60,
+        cwd=repo_root,
+    )
+    assert r.returncode == FaultInjector.KILL_EXIT_CODE
+    assert b"survived" not in r.stdout
+
+
 def test_faulthandler_enabled_and_dumps_on_fatal():
     """VERDICT r3 #8: a fatal crash must leave per-thread tracebacks.
     conftest enables faulthandler for the suite (asserted in-process);

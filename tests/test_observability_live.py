@@ -1351,3 +1351,103 @@ def test_request_reply_survives_tracing_toggle():
         ch.close()
         obs.disable_tracing()
         tracer.clear()
+
+
+# ---------------------------------------------------------------------------
+# multi-standby election (ISSUE 10 satellite): deterministic ladder
+# succession — a standby only promotes when EVERY earlier-ladder member
+# is heartbeat-silent
+# ---------------------------------------------------------------------------
+
+
+def _ladder_trio(promote_after=2):
+    ladder = ["p", "s1", "s2"]
+    s2 = live.Aggregator(role="standby", name="s2", ladder=ladder,
+                         promote_after=promote_after,
+                         log=lambda line: None)
+    s1 = live.Aggregator(role="standby", name="s1", ladder=ladder,
+                         promote_after=promote_after, peers=[s2],
+                         log=lambda line: None)
+    p = live.Aggregator(role="primary", name="p", peers=[s1, s2],
+                        log=lambda line: None)
+    return p, s1, s2
+
+
+def test_ladder_election_single_successor():
+    """Kill the primary: the FIRST standby promotes; the second hears
+    the first's beacons and stands down — exactly one new primary."""
+    p, s1, s2 = _ladder_trio()
+    for _ in range(2):  # healthy windows: everyone beaconed
+        p.close_window()
+        s1.close_window()
+        s2.close_window()
+    assert (s1.role, s2.role) == ("standby", "standby")
+    for _ in range(4):  # primary dead; s1 and s2 keep closing
+        s1.close_window()
+        s2.close_window()
+    assert s1.role == "primary"
+    assert s2.role == "standby"  # deterministic succession held
+    fo = [a for a in s1.watchdog.history
+          if a["rule"] == "aggregator_failover"]
+    assert len(fo) == 1
+    assert "ladder" in fo[0]["message"]
+    assert not [a for a in s2.watchdog.history
+                if a["rule"] == "aggregator_failover"]
+
+
+def test_ladder_election_second_promotes_when_first_also_dies():
+    p, s1, s2 = _ladder_trio()
+    for _ in range(2):
+        p.close_window()
+        s1.close_window()
+        s2.close_window()
+    # primary AND s1 both die: s2 must take over once BOTH are silent
+    for _ in range(3):
+        s2.close_window()
+    assert s2.role == "primary"
+    fo = [a for a in s2.watchdog.history
+          if a["rule"] == "aggregator_failover"]
+    assert len(fo) == 1
+
+
+def test_ladder_election_partition_from_primary_does_not_dual_promote():
+    """The partitioned-standbys regression this satellite closes: s2
+    loses the PRIMARY's heartbeats (partition) but still hears s1 —
+    before the ladder, s2 would promote alongside s1's own eventual
+    takeover, yielding two primaries."""
+    p, s1, s2 = _ladder_trio()
+    for _ in range(2):
+        p.close_window()
+        s1.close_window()
+        s2.close_window()
+    # s2 partitioned from the primary only: primary still heartbeats
+    # s1, s1 still beacons s2
+    p.peers = [s1]
+    for _ in range(5):
+        p.close_window()
+        s1.close_window()
+        s2.close_window()
+    assert s1.role == "standby"  # primary alive: no takeover
+    assert s2.role == "standby"  # s1 alive: s2 stands down despite
+    # hearing nothing from the primary
+
+
+def test_ladder_rejects_aggregator_not_in_its_ladder():
+    with pytest.raises(ValueError, match="not in its own ladder"):
+        live.Aggregator(role="standby", name="elsewhere",
+                        ladder=["p", "s1"])
+
+
+def test_no_ladder_single_standby_behavior_unchanged():
+    """Without a ladder the original semantics hold: ANY heartbeat
+    resets the miss counter and promote_after silent closes promote."""
+    s = live.Aggregator(role="standby", name="s", promote_after=2,
+                        log=lambda line: None)
+    p = live.Aggregator(role="primary", name="p", peers=[s],
+                        log=lambda line: None)
+    p.close_window()
+    s.close_window()
+    s.close_window()
+    assert s.role == "standby"  # one miss only
+    s.close_window()
+    assert s.role == "primary"

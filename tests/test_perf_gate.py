@@ -48,8 +48,10 @@ def _run_gate(env_extra):
     env = dict(os.environ)
     # the serve leg runs a real (CPU-rehearsal) serving bench when no
     # pre-produced JSON is given — too slow for every smoke test here,
-    # so it is opt-in per test (mirroring PERF_GATE_BENCH_JSON)
+    # so it is opt-in per test (mirroring PERF_GATE_BENCH_JSON); same
+    # for the chaos leg's multi-process drill (PERF_GATE_CHAOS_JSON)
     env.setdefault("PERF_GATE_SERVE", "0")
+    env.setdefault("PERF_GATE_CHAOS", "0")
     env.update(env_extra)
     return subprocess.run(
         ["bash", GATE], capture_output=True, text=True, env=env,
@@ -354,4 +356,105 @@ def test_gate_failover_leg_skippable(fixtures):
     })
     assert r.returncode == 0, r.stderr
     assert "failover drill" not in r.stderr
+    assert "green" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# chaos leg (ISSUE 10): the elastic-membership drill verdict gates the
+# round — smoke-tested on fixture verdicts like the other legs
+# ---------------------------------------------------------------------------
+
+def _chaos_json(path, ok=True, kills=1, evictions=1, rejoins=1,
+                loss_delta=0.01, tolerance=0.25, violations=None,
+                rules=("EASGD", "GOSGD")):
+    doc = {"rules": {}, "ok": ok}
+    for rule in rules:
+        doc["rules"][rule] = {
+            "rule": rule,
+            "ok": ok,
+            "violations": list(violations or ()),
+            "kills_observed": kills,
+            "evictions": evictions,
+            "rejoins": rejoins,
+            "readmissions": 1,
+            "restarts": {"1": 1},
+            "exit_codes": {"0": 0, "1": 77, "2": 0},
+            "baseline_loss": 1.0,
+            "chaos_loss": 1.0 + loss_delta,
+            "loss_delta": loss_delta,
+            "loss_tolerance": tolerance,
+        }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def test_gate_chaos_leg_green(fixtures, tmp_path):
+    base, good, _ = fixtures
+    chaos = _chaos_json(tmp_path / "chaos.json")
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_CHAOS": "1",
+        "PERF_GATE_CHAOS_JSON": chaos,
+    })
+    assert r.returncode == 0, r.stderr
+    assert "chaos [EASGD]: 1 kill -> 1 eviction" in r.stderr
+    assert "chaos [GOSGD]" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_chaos_leg_fails_on_violation(fixtures, tmp_path):
+    """A drill that recorded a violation (e.g. the respawn never
+    re-admitted) fails the gate with the violation surfaced."""
+    base, good, _ = fixtures
+    chaos = _chaos_json(
+        tmp_path / "chaos.json", ok=False,
+        violations=["the respawned rank never re-admitted"],
+    )
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_CHAOS": "1",
+        "PERF_GATE_CHAOS_JSON": chaos,
+    })
+    assert r.returncode != 0
+    assert "CHAOS VIOLATION" in r.stderr
+    assert "never re-admitted" in r.stderr
+
+
+def test_gate_chaos_leg_fails_on_eviction_kill_mismatch(fixtures, tmp_path):
+    """An ok-flagged verdict whose eviction count does not match the
+    kill count is still refused — the structure check is independent
+    of the drill's self-assessment."""
+    base, good, _ = fixtures
+    chaos = _chaos_json(tmp_path / "chaos.json", kills=1, evictions=2)
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_CHAOS": "1",
+        "PERF_GATE_CHAOS_JSON": chaos,
+    })
+    assert r.returncode != 0
+    assert "eviction(s) for 1 kill(s)" in (r.stdout + r.stderr)
+
+
+def test_gate_chaos_leg_skippable(fixtures):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_CHAOS": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "chaos drill" not in r.stderr
+    assert "chaos [" not in r.stderr
     assert "green" in r.stderr

@@ -402,3 +402,72 @@ def test_fp16_wire_cast_roundtrip_property(tree):
                 np.testing.assert_array_equal(b, v)
             else:
                 assert b == v or (v is None and b is None)
+
+
+# ---------------------------------------------------------------------------
+# request() bounded connect-retry (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_request_retries_connect_until_server_appears():
+    """A momentarily-absent server (restart window) is survived by the
+    connect-retry budget instead of raising on the first refusal, and
+    the retries are counted in transport_request_retries_total."""
+    import time as _time
+
+    from theanompi_tpu import observability as obs
+
+    port = find_free_port()
+    holder = {}
+
+    def late_server():
+        _time.sleep(0.4)
+        holder["ch"] = TcpServerChannel(port, lambda msg: {"echo": msg})
+
+    before = _retry_count()
+    t = threading.Thread(target=late_server, daemon=True)
+    t.start()
+    try:
+        reply = request(
+            ("127.0.0.1", port), {"x": 1}, timeout=10,
+            connect_retries=20, retry_backoff_s=0.05,
+        )
+        assert reply == {"echo": {"x": 1}}
+        assert _retry_count() > before  # at least one counted retry
+    finally:
+        t.join()
+        holder["ch"].close()
+
+
+def test_request_zero_retries_raises_immediately():
+    import time as _time
+
+    port = find_free_port()  # nothing listening
+    t0 = _time.monotonic()
+    with pytest.raises(OSError):
+        request(("127.0.0.1", port), {"x": 1}, timeout=5,
+                connect_retries=0)
+    assert _time.monotonic() - t0 < 2.0  # no backoff loop
+
+
+def test_request_retry_budget_is_bounded():
+    import time as _time
+
+    port = find_free_port()  # nothing listening, ever
+    before = _retry_count()
+    t0 = _time.monotonic()
+    with pytest.raises(OSError):
+        request(("127.0.0.1", port), {"x": 1}, timeout=5,
+                connect_retries=2, retry_backoff_s=0.01)
+    assert _time.monotonic() - t0 < 3.0
+    assert _retry_count() == before + 2  # exactly the budget
+
+
+def _retry_count() -> float:
+    from theanompi_tpu import observability as obs
+
+    snap = obs.get_registry().snapshot()
+    doc = snap.get("transport_request_retries_total")
+    if not doc:
+        return 0.0
+    return sum(float(row["value"]) for row in doc["series"])

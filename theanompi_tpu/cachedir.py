@@ -165,6 +165,24 @@ def legacy_jaxlib() -> bool:
     return parts < (0, 5)
 
 
+def disable_cache_if_legacy(jax_mod) -> bool:
+    """Force the persistent compile cache OFF on a legacy jaxlib, even
+    when ``JAX_COMPILATION_CACHE_DIR`` is set in the environment.
+
+    Spawned worker processes (``launch.py`` --dist-* children, the
+    elastic chaos drill's respawns) inherit the env var from test/CI
+    harnesses, and jax honors it natively without ever consulting
+    :func:`configure_compile_cache`'s no-op guard — so a respawned
+    rank would RELOAD an executable its predecessor cached and die of
+    the legacy segfault this module documents.  An explicit config
+    update outranks the env var.  Returns True when the cache was
+    force-disabled."""
+    if not legacy_jaxlib():
+        return False
+    jax_mod.config.update("jax_compilation_cache_dir", None)
+    return True
+
+
 def configure_compile_cache(jax_mod, use_repo_cache: bool) -> str:
     """Apply the repo's ONE persistent-compile-cache policy and return
     the chosen dir. ``use_repo_cache=True`` = the committed ``.jax_cache``

@@ -120,6 +120,35 @@ def build_parser() -> argparse.ArgumentParser:
         "story); q8 = int8 + per-block scales, ~4x fewer bytes with an "
         "EF residual on the push leg; math always runs fp32",
     )
+    # elastic membership (docs/elasticity.md) — async rules only
+    p.add_argument(
+        "--elastic-restarts", type=int, default=None, metavar="N",
+        help="with --spawn-procs + EASGD/GOSGD: supervise the fleet "
+        "elastically — a dead rank is respawned up to N times and "
+        "re-admits checkpointlessly (center pull / peer snapshot)",
+    )
+    p.add_argument(
+        "--late-join", default=None, metavar="RANK:DELAY[,RANK:DELAY]",
+        help="with --spawn-procs: start these ranks only after DELAY "
+        "seconds — workers joining an already-running fleet",
+    )
+    p.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="chaos injection for spawned children "
+        "(mode@rank:iter[:arg];... with mode kill/hang/slow/raise — "
+        "see runtime.fault.FaultInjector.from_env); drills only",
+    )
+    p.add_argument(
+        "--heartbeat-timeout", type=float, default=60.0, metavar="SECONDS",
+        help="async membership: evict a worker/peer silent past this "
+        "window (heartbeats ride the exchange/gossip traffic)",
+    )
+    p.add_argument(
+        "--adaptive-tau", type=int, choices=(0, 1), default=0,
+        help="EASGD: 1 = straggler-adaptive per-worker exchange period "
+        "(server scales each worker's tau by its relative step rate so "
+        "exchange WALL cadence is equalized)",
+    )
     return p
 
 
@@ -158,6 +187,9 @@ def _async_distributed_main(args) -> int:
                 size, addresses[0], alpha=args.alpha, resume=args.resume,
                 keep_last=args.keep_last,
                 duties_coalesce=bool(args.duties_coalesce),
+                evict_after_s=args.heartbeat_timeout,
+                adaptive_tau=bool(args.adaptive_tau),
+                tau=args.tau,
                 **common,
             )
         else:
@@ -165,6 +197,7 @@ def _async_distributed_main(args) -> int:
                 rank, size, addresses[0], tau=args.tau,
                 watchdog_timeout=args.watchdog_timeout,
                 watchdog_action=args.watchdog_action,
+                adaptive_tau=bool(args.adaptive_tau),
                 **common,
             )
     else:  # GOSGD
@@ -172,6 +205,7 @@ def _async_distributed_main(args) -> int:
             rank, size, addresses, p_push=args.p_push,
             watchdog_timeout=args.watchdog_timeout,
             watchdog_action=args.watchdog_action,
+            evict_after_s=args.heartbeat_timeout,
             **common,
         )
     return 0
@@ -209,26 +243,62 @@ def main(argv=None) -> int:
 
     if args.spawn_procs:
         # driver mode: re-exec ourselves N times as a local process group
-        from theanompi_tpu.runtime.multiprocess import spawn_local
+        from theanompi_tpu.runtime.multiprocess import spawn_elastic, spawn_local
 
         # strip both '--flag value' and '--flag=value' spellings — a
         # surviving --spawn-procs in child argv would fork recursively
+        # (the elastic/chaos flags are supervisor-side too)
+        driver_flags = (
+            "--spawn-procs", "--spawn-local-devices",
+            "--elastic-restarts", "--late-join", "--fault-plan",
+        )
         child_argv = []
         skip = False
         for a in (argv if argv is not None else sys.argv[1:]):
             if skip:
                 skip = False
                 continue
-            if a in ("--spawn-procs", "--spawn-local-devices"):
+            if a in driver_flags:
                 skip = True
                 continue
-            if a.startswith(("--spawn-procs=", "--spawn-local-devices=")):
+            if a.startswith(tuple(f + "=" for f in driver_flags)):
                 continue
             child_argv.append(a)
+        env_extra = {}
+        if args.fault_plan:
+            env_extra["THEANOMPI_FAULT_PLAN"] = args.fault_plan
+        if args.elastic_restarts is not None or args.late_join:
+            if args.rule == "BSP":
+                raise SystemExit(
+                    "--elastic-restarts/--late-join apply to the async "
+                    "rules: a BSP group shares one jax.distributed "
+                    "world and cannot lose members"
+                )
+            late = {}
+            for part in (args.late_join or "").split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                r, _, d = part.partition(":")
+                late[int(r)] = float(d or 0.0)
+            report = spawn_elastic(
+                args.spawn_procs,
+                child_argv,
+                local_device_count=args.spawn_local_devices,
+                env_extra=env_extra,
+                restarts_per_rank=(
+                    args.elastic_restarts
+                    if args.elastic_restarts is not None else 1
+                ),
+                late_join=late,
+            )
+            print(f"[elastic] run complete: {report}", flush=True)
+            return 0
         spawn_local(
             args.spawn_procs,
             child_argv,
             local_device_count=args.spawn_local_devices,
+            env_extra=env_extra or None,
         )
         return 0
 
@@ -242,6 +312,14 @@ def main(argv=None) -> int:
 
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        # a legacy jaxlib dies reloading persistently-cached
+        # executables; an inherited JAX_COMPILATION_CACHE_DIR (test
+        # harnesses set one) must not arm that path in spawned ranks —
+        # bites hardest on elastic respawns, which reload what their
+        # predecessor cached (see cachedir.disable_cache_if_legacy)
+        from theanompi_tpu.cachedir import disable_cache_if_legacy
+
+        disable_cache_if_legacy(jax)
         if args.rule == "BSP":
             # one SPMD program over the global mesh: join the group
             from theanompi_tpu.runtime.mesh import init_distributed
@@ -287,7 +365,8 @@ def main(argv=None) -> int:
                 kw["n_workers"] = args.n_workers
             if args.rule == "EASGD":
                 kw.update(tau=args.tau, alpha=args.alpha,
-                          duties_coalesce=bool(args.duties_coalesce))
+                          duties_coalesce=bool(args.duties_coalesce),
+                          adaptive_tau=bool(args.adaptive_tau))
             else:
                 kw.update(p_push=args.p_push)
         return kw

@@ -296,10 +296,14 @@ def _cmd_watch(args) -> int:
         persist_path=args.persist,
         persist_max_bytes=int(args.persist_max_mb * 1e6),
         role=args.role,
-        name=f"watch-{args.role}",
+        name=args.name or f"watch-{args.role}",
         peers=_parse_peers(args) or None,
         promote_after=args.promote_after,
         checkpoint_path=args.checkpoint,
+        ladder=(
+            [s.strip() for s in args.ladder.split(",") if s.strip()]
+            if args.ladder else None
+        ),
     )
     if args.resume:
         try:
@@ -804,6 +808,18 @@ def _build_parser() -> argparse.ArgumentParser:
         "--promote-after", type=int, default=3,
         help="standby: consecutive window closes without a primary "
         "heartbeat before self-promotion (default 3)",
+    )
+    w.add_argument(
+        "--name", default=None,
+        help="this aggregator's name in heartbeats/metrics (default "
+        "watch-<role>); REQUIRED spelling when --ladder is used",
+    )
+    w.add_argument(
+        "--ladder", default=None, metavar="NAME,NAME,...",
+        help="multi-standby succession order (primary first): a "
+        "standby only promotes once EVERY earlier-ladder member has "
+        "been silent --promote-after closes — wire each standby's "
+        "--peer list at its later-ladder successors",
     )
     w.add_argument(
         "--checkpoint", default=None, metavar="PATH",

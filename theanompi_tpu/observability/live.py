@@ -738,10 +738,25 @@ class Aggregator:
         peers: Optional[list] = None,
         promote_after: int = 3,
         checkpoint_path: Optional[str] = None,
+        ladder: Optional[list] = None,
     ):
         if role not in ("primary", "standby"):
             raise ValueError(
                 f"role must be 'primary' or 'standby', not {role!r}"
+            )
+        # multi-standby election: ``ladder`` is the DETERMINISTIC
+        # succession order (aggregator names, primary first).  A
+        # standby at position i only promotes once EVERY earlier-ladder
+        # member has been heartbeat-silent for ``promote_after`` window
+        # closes — so when the primary dies, standby #1 takes over and
+        # its own beacons keep standby #2 standing down; two standbys
+        # can no longer both promote because each lost only the
+        # primary.  Without a ladder, ANY heartbeat resets the miss
+        # counter (the single-standby behavior, unchanged).
+        self.ladder = [str(x) for x in (ladder or ())]
+        if self.ladder and str(name) not in self.ladder:
+            raise ValueError(
+                f"aggregator {name!r} not in its own ladder {self.ladder}"
             )
         self.period_s = float(period_s)
         self.heartbeat_miss = int(heartbeat_miss)
@@ -759,10 +774,17 @@ class Aggregator:
         self._fwd_wake = threading.Event()
         self._fwd_stop = False
         self.forward_failures = 0
-        # primary-heartbeat bookkeeping (standby side)
+        # primary-heartbeat bookkeeping (standby side).  With a ladder,
+        # misses are tracked PER SENDER NAME so an alive earlier
+        # standby keeps later ones standing down.
         self._hb_seen_since_close = False
+        self._hb_names_seen: set = set()
+        self._missed_by: Dict[str, int] = {}
         self._missed_hb = 0
         self._primary_window = 0
+        # training-plane membership: eviction counters already alerted
+        # on (flattened key -> cumulative count), for worker_evicted
+        self._evictions_alerted: Dict[str, float] = {}
         self.verdict_log = (
             VerdictLog(persist_path, max_bytes=persist_max_bytes)
             if persist_path else None
@@ -796,10 +818,15 @@ class Aggregator:
         the reply, never raised — a bad frame must not kill the
         serve thread under every OTHER rank."""
         if isinstance(frame, dict) and frame.get("kind") == HB_KIND:
-            # the primary's liveness beacon (standby side)
+            # a liveness beacon: from the primary, or (multi-standby
+            # ladders) from an earlier standby holding its position
             with self._lock:
                 self._hb_seen_since_close = True
                 self._missed_hb = 0
+                sender = frame.get("name")
+                if sender is not None:
+                    self._hb_names_seen.add(str(sender))
+                    self._missed_by[str(sender)] = 0
                 self._primary_window = max(
                     self._primary_window, int(frame.get("window", 0))
                 )
@@ -1021,18 +1048,61 @@ class Aggregator:
         verdict["alerts"] = self.watchdog.evaluate(
             verdict, dead_ranks=tuple(dead if dead else ())
         )
+        # training-plane membership: evictions shipped in the rank
+        # counters become worker_evicted alerts — exactly one per
+        # evicted worker (the counters are cumulative; only the unseen
+        # increment alerts, so a re-shipped total can never double-page)
+        for who, plane, n_new in self._new_evictions():
+            for _ in range(n_new):
+                verdict["alerts"].append(self.watchdog.raise_alert({
+                    "rule": "worker_evicted",
+                    "rank": who,
+                    "value": None,
+                    "threshold": None,
+                    "message": (
+                        f"training plane ({plane}) evicted rank {who} "
+                        "after missed heartbeats — respawn/rejoin "
+                        "expected, or capacity is down one worker"
+                    ),
+                    "window": verdict.get("window"),
+                    "t_wall": verdict.get("t_wall"),
+                }))
         # standby promotion clock: a window close with no primary
         # heartbeat since the last close is one miss; promote_after
         # consecutive misses means the primary is gone — announce ONE
-        # structured alert and take over, instead of a blackout
+        # structured alert and take over, instead of a blackout.  With
+        # a ladder, EVERY earlier-ladder member must be silent for
+        # promote_after closes (deterministic succession: an alive
+        # earlier standby's beacons keep this one standing down).
         if self.role == "standby":
             with self._lock:
-                if self._hb_seen_since_close:
+                seen = self._hb_names_seen
+                self._hb_names_seen = set()
+                if self.ladder:
+                    earlier = self.ladder[: self.ladder.index(self.name)]
+                    for nm in earlier:
+                        if nm in seen:
+                            self._missed_by[nm] = 0
+                        else:
+                            self._missed_by[nm] = (
+                                self._missed_by.get(nm, 0) + 1
+                            )
+                    promote = bool(earlier) and all(
+                        self._missed_by.get(nm, 0) >= self.promote_after
+                        for nm in earlier
+                    )
+                    self._missed_hb = (
+                        min(self._missed_by.get(nm, 0) for nm in earlier)
+                        if earlier else 0
+                    )
+                elif self._hb_seen_since_close:
                     self._hb_seen_since_close = False
                     self._missed_hb = 0
+                    promote = False
                 else:
                     self._missed_hb += 1
-                promote = self._missed_hb >= self.promote_after
+                    promote = self._missed_hb >= self.promote_after
+                self._hb_seen_since_close = False
             if promote:
                 verdict["alerts"].append(self._promote(verdict))
         with self._lock:
@@ -1050,10 +1120,44 @@ class Aggregator:
                 self.checkpoint()
             for peer in self.peers:
                 self._send_heartbeat(peer)
+        elif self.peers:
+            # a standby with peers beacons its OWN liveness down the
+            # ladder: later standbys hearing it stand down (multi-
+            # standby election) — losing only the primary must promote
+            # exactly one successor
+            for peer in self.peers:
+                self._send_heartbeat(peer)
         self._win_close_hist.observe(
             time.perf_counter() - t_close0, name=self.name
         )
         return verdict
+
+    def _new_evictions(self):
+        """Training-plane evictions not yet alerted on: ``(rank, plane,
+        n_new)`` rows from the ``membership_evictions_total`` counter
+        deltas the shippers forwarded."""
+        import re
+
+        totals: Dict[str, float] = {}
+        with self._lock:
+            for rv in self.view.values():
+                for k, val in rv.counters.items():
+                    if k.startswith("membership_evictions_total"):
+                        totals[k] = totals.get(k, 0.0) + float(val)
+            out = []
+            for k, val in sorted(totals.items()):
+                n_new = int(round(val - self._evictions_alerted.get(k, 0.0)))
+                if n_new <= 0:
+                    continue
+                self._evictions_alerted[k] = val
+                rank = re.search(r'rank="([^"]*)"', k)
+                plane = re.search(r'plane="([^"]*)"', k)
+                out.append((
+                    rank.group(1) if rank else "?",
+                    plane.group(1) if plane else "?",
+                    n_new,
+                ))
+        return out
 
     def _send_heartbeat(self, peer) -> None:
         hb = {"kind": HB_KIND, "v": FRAME_VERSION, "name": self.name,
@@ -1081,8 +1185,12 @@ class Aggregator:
             "threshold": self.promote_after,
             "message": (
                 f"standby {self.name!r} promoted to primary after "
-                f"{self._missed_hb} missed primary heartbeat(s) — "
-                "verdict timeline continues from window "
+                f"{self._missed_hb} missed primary heartbeat(s)"
+                + (
+                    f" (ladder {self.ladder}: every earlier member "
+                    "silent)" if self.ladder else ""
+                )
+                + " — verdict timeline continues from window "
                 f"{self.promoted_at_window}"
             ),
             "window": verdict.get("window"),

@@ -89,17 +89,29 @@ class EASGD_Server:
     object whose ``exchange`` serializes workers with a lock exactly as
     the MPI recv-loop serialized them (SURVEY.md §4.3 'serialization
     bottleneck by design').
+
+    ``roster``/``tau_ctrl`` (optional, installed by an adaptive-τ
+    driver) give the in-process server the same straggler-adaptive τ
+    hints the cross-process ``EasgdServerCore`` serves: exchanges beat
+    the roster, ``suggest_tau`` reads the controller.
     """
 
-    def __init__(self, center: Pytree, alpha: float):
+    def __init__(self, center: Pytree, alpha: float,
+                 roster=None, tau_ctrl=None):
         self.center = center
         self.alpha = alpha
         self._lock = threading.Lock()
         self.n_exchanges = 0
+        self.roster = roster
+        self.tau_ctrl = tau_ctrl
 
-    def exchange(self, worker_params: Pytree) -> Pytree:
+    def exchange(self, worker_params: Pytree, rank=None, step=None) -> Pytree:
         a = self.alpha
         with self._lock:
+            if self.roster is not None and rank is not None:
+                if not self.roster.beat(rank, step):
+                    self.roster.join(rank)
+                    self.roster.beat(rank, step)
             diff = jax.tree.map(lambda w, c: w - c, worker_params, self.center)
             self.center = jax.tree.map(
                 lambda c, d: c + a * d, self.center, diff
@@ -107,6 +119,11 @@ class EASGD_Server:
             self.n_exchanges += 1
             _EXCHANGES.inc()
             return jax.tree.map(lambda w, d: w - a * d, worker_params, diff)
+
+    def suggest_tau(self, rank=None, default=None):
+        if self.tau_ctrl is None or rank is None:
+            return default
+        return self.tau_ctrl.tau_for(rank)
 
 
 class _AsyncWorkerBase:
@@ -122,6 +139,12 @@ class _AsyncWorkerBase:
         # workers — any worker's progress ticks it, detecting whole-job
         # hangs; the per-process entrypoints assign one each)
         self.watchdog = None
+        # fault-injection slot (runtime.fault.FaultInjector) — the
+        # chaos drills' hook; ``fault_rank`` is the rank the PLAN
+        # addresses (global process rank for the distributed
+        # entrypoints, which differs from the EASGD data-shard index)
+        self.fault = None
+        self.fault_rank = rank
         cfg = dict(model_config or {})
         cls = getattr(importlib.import_module(modelfile), modelclass)
         self.model = cls(
@@ -219,10 +242,65 @@ class _AsyncWorkerBase:
 
 
 class EASGD_Worker(_AsyncWorkerBase):
-    def __init__(self, *args, server: EASGD_Server, tau: int, **kw):
+    def __init__(self, *args, server: EASGD_Server, tau: int,
+                 adaptive_tau: bool = False, **kw):
         super().__init__(*args, **kw)
         self.server = server
         self.tau = tau
+        self.adaptive_tau = adaptive_tau
+        # degraded mode (docs/elasticity.md): an unreachable server
+        # turns exchanges into counted local SGD steps — never an
+        # exception into this loop.  The proxy's bounded retry already
+        # ran by the time we count a failure here.
+        self._degraded = False
+        self.n_degraded_steps = 0
+        self.n_exchange_failures = 0
+
+    def _exchange(self, count: int) -> None:
+        """One elastic exchange, failure-isolated.  A server that is
+        down (or evicting/re-admitting us) costs a counted failure and
+        flips this worker into degraded local-SGD mode; the next τ
+        boundary retries, and a ``readmitted`` reply hands back the
+        center (the proxy resets the EF residuals) so recovery needs no
+        checkpoint."""
+        rec = self.recorder
+        try:
+            # step-tagged exchange leg: the span carries the iteration
+            # count, so one parameter exchange is traceable end-to-end
+            # (this span ⊃ the transport's tcp_request/tcp_send spans ⊃
+            # the flow arrow) and the trace doctor can attribute comm
+            # time to steps
+            with obs.span("easgd_exchange", step=count, tau=self.tau):
+                rec.start("comm")
+                try:
+                    new_w = self.server.exchange(
+                        self.get_params(), rank=self.rank, step=count
+                    )
+                finally:
+                    rec.end("comm")
+            self.set_params(new_w)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            self.n_exchange_failures += 1
+            if not self._degraded:
+                self._degraded = True
+                print(
+                    f"EASGD worker {self.rank}: exchange failed "
+                    f"({type(e).__name__}: {e}) — degrading to local "
+                    "SGD until the server returns",
+                    flush=True,
+                )
+            return
+        if self._degraded:
+            self._degraded = False
+            print(
+                f"EASGD worker {self.rank}: server reachable again — "
+                "elastic exchanges resumed",
+                flush=True,
+            )
+        if self.adaptive_tau:
+            hint = self.server.suggest_tau(self.rank, self.tau)
+            if hint:
+                self.tau = max(1, int(hint))
 
     def _run(self):
         model, rec = self.model, self.recorder
@@ -234,24 +312,21 @@ class EASGD_Worker(_AsyncWorkerBase):
             model.reset_train_iter(epoch)
             for _ in range(model.data.n_batch_train):
                 count += 1
+                if self.fault is not None:
+                    self.fault.maybe_fail(self.fault_rank, count)
                 model.train_iter(count, rec)
                 rec.print_train_info(count)
                 if self.watchdog is not None:
                     self.watchdog.tick()
+                if self._degraded:
+                    self.n_degraded_steps += 1
+                    from theanompi_tpu.parallel import membership as _ms
+
+                    _ms.count_degraded_step("easgd", self.rank)
                 since_exchange += 1
                 if since_exchange >= self.tau:
                     since_exchange = 0
-                    # step-tagged exchange leg: the span carries the
-                    # iteration count, so one parameter exchange is
-                    # traceable end-to-end (this span ⊃ the transport's
-                    # tcp_request/tcp_send spans ⊃ the flow arrow) and
-                    # the trace doctor can attribute comm time to steps
-                    with obs.span("easgd_exchange", step=count,
-                                  tau=self.tau):
-                        rec.start("comm")
-                        new_w = self.server.exchange(self.get_params())
-                        self.set_params(new_w)
-                        rec.end("comm")
+                    self._exchange(count)
             self._epoch_end(epoch)
 
 
@@ -264,9 +339,45 @@ class GOSGD_Worker(_AsyncWorkerBase):
         self._np_rng = rng
         self.n_pushes = 0  # observability: tests/operators can assert
         self.n_merges = 0  # gossip actually happened
+        self.n_push_failures = 0  # pushes rolled back (peer unreachable)
+
+    def _membership_duties(self, step: Optional[int] = None):
+        """Elastic-membership housekeeping piggybacked on the merge
+        cadence (every hook is duck-typed: the in-process Mailbox has
+        none of them and behaves exactly as before):
+
+        - ``sweep`` evicts silent peers from the push table,
+        - ``maybe_hello`` beacons our own liveness (a low-``p_push``
+          peer must not look dead between lucky pushes),
+        - queued snapshot requests from (re)joining peers are granted
+          as directed, mass-conserving pushes.
+        """
+        mb = self.mailbox
+        sweep = getattr(mb, "sweep", None)
+        if sweep is not None:
+            sweep()
+        hello = getattr(mb, "maybe_hello", None)
+        if hello is not None:
+            hello(step)
+        take = getattr(mb, "take_snapshot_requests", None)
+        if take is not None:
+            for dst in take():
+                if self.weight <= 0.0:
+                    break  # nothing to donate; another peer will grant
+                print(
+                    f"GOSGD worker {self.rank}: granting snapshot to "
+                    f"(re)joining peer {dst}",
+                    flush=True,
+                )
+                self._push_to(int(dst), step=step)
 
     def _merge_inbox(self, step: Optional[int] = None):
+        # drain BEFORE the membership sweep: beats are recorded at
+        # drain time, so judging silence first would misattribute THIS
+        # worker's own stall (compile, slow merge) to its peers and
+        # evict ranks whose frames were sitting in the queue
         msgs = self.mailbox.drain(self.rank)
+        self._membership_duties(step)
         # cross-process transports expose reclaim_expired (app-level ack
         # protocol, distributed_async._GossipAdapter): weight whose push
         # was never acked folds back into this worker so a dead receiver
@@ -299,11 +410,33 @@ class GOSGD_Worker(_AsyncWorkerBase):
             _WEIGHT.set(self.weight, rank=str(self.rank))
             self.recorder.end("comm")
 
-    def _maybe_push(self, step: Optional[int] = None):
-        if self._np_rng.rand() >= self.p_push or self.mailbox.n_ranks < 2:
-            return
-        peers = [r for r in range(self.mailbox.n_ranks) if r != self.rank]
-        dst = int(self._np_rng.choice(peers))
+    def _pick_peer(self) -> Optional[int]:
+        """Push destination: uniform over all other ranks (the
+        reference behavior) unless the mailbox keeps a live peer table
+        — then only KNOWN-LIVE peers are candidates (a dead or not-yet-
+        joined rank is never a push target, so membership churn stops
+        costing failed-send weight restores), weighted away from
+        stragglers (``peer_weights``)."""
+        live = getattr(self.mailbox, "live_peers", None)
+        if live is None:
+            peers = [r for r in range(self.mailbox.n_ranks) if r != self.rank]
+            return int(self._np_rng.choice(peers)) if peers else None
+        peers = [r for r in live() if r != self.rank]
+        if not peers:
+            return None  # nobody known-alive yet (joiner warming up)
+        weigh = getattr(self.mailbox, "peer_weights", None)
+        if weigh is None:
+            return int(self._np_rng.choice(peers))
+        w = np.asarray(weigh(peers), dtype=np.float64)
+        tot = float(w.sum())
+        if tot <= 0:
+            return int(self._np_rng.choice(peers))
+        return int(self._np_rng.choice(peers, p=w / tot))
+
+    def _push_to(self, dst: int, step: Optional[int] = None) -> None:
+        """One directed gossip push (half this worker's mass to
+        ``dst``) — the regular random push AND the snapshot grant a
+        (re)joining peer pulls its state through."""
         self.recorder.start("comm")
         self.weight /= 2.0
         try:
@@ -320,10 +453,19 @@ class GOSGD_Worker(_AsyncWorkerBase):
             # the halving so the consensus weight mass isn't lost, and
             # keep training: gossip tolerates dead peers by design
             self.weight *= 2.0
+            self.n_push_failures += 1
             print(f"GOSGD worker {self.rank}: push to {dst} failed "
                   f"(peer gone); weight restored", flush=True)
         finally:
             self.recorder.end("comm")
+
+    def _maybe_push(self, step: Optional[int] = None):
+        if self._np_rng.rand() >= self.p_push or self.mailbox.n_ranks < 2:
+            return
+        dst = self._pick_peer()
+        if dst is None:
+            return
+        self._push_to(dst, step=step)
 
     def _run(self):
         model, rec = self.model, self.recorder
@@ -334,6 +476,8 @@ class GOSGD_Worker(_AsyncWorkerBase):
             model.reset_train_iter(epoch)
             for _ in range(model.data.n_batch_train):
                 count += 1
+                if self.fault is not None:
+                    self.fault.maybe_fail(self.fault_rank, count)
                 model.train_iter(count, rec)
                 rec.print_train_info(count)
                 if self.watchdog is not None:
@@ -552,11 +696,15 @@ class EASGD_Driver(_AsyncDriverBase):
     """
 
     def __init__(self, *args, tau: int = 10, alpha: float = 0.5,
-                 resume: bool = False, duties_coalesce: bool = True, **kw):
+                 resume: bool = False, duties_coalesce: bool = True,
+                 adaptive_tau: bool = False, **kw):
         super().__init__(*args, **kw)
         self.tau = tau
         self.alpha = alpha
         self.resume = resume
+        # straggler-adaptive per-worker tau (membership.TauController):
+        # exchange wall cadence equalized across unequal device subsets
+        self.adaptive_tau = adaptive_tau
         # True (default): duties jump to the newest completed epoch when
         # validation is slower than training, so every recorded center
         # row is fresh (see _server_duties).  False: strictly one
@@ -590,6 +738,7 @@ class EASGD_Driver(_AsyncDriverBase):
                 n_workers=self.n_workers,
                 server=None,  # set below once center exists
                 tau=self.tau,
+                adaptive_tau=self.adaptive_tau,
             )
             for rank in range(self.n_workers)
         ]
@@ -606,7 +755,16 @@ class EASGD_Driver(_AsyncDriverBase):
                 self.start_epoch = int(blob["epoch"])
                 print(f"EASGD: resumed center from {path} "
                       f"at epoch {self.start_epoch}", flush=True)
-        self.server = EASGD_Server(center, self.alpha)
+        if self.adaptive_tau:
+            from theanompi_tpu.parallel import membership as _ms
+
+            roster = _ms.Roster("easgd", evict_after_s=float("inf"))
+            self.server = EASGD_Server(
+                center, self.alpha, roster=roster,
+                tau_ctrl=_ms.TauController(self.tau, roster),
+            )
+        else:
+            self.server = EASGD_Server(center, self.alpha)
         self.server_recorder = Recorder(
             print_freq=1, rank=0, verbose=self.verbose,
             save_dir=self.checkpoint_dir,

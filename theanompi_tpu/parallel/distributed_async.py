@@ -16,6 +16,22 @@ Topology (matches the reference):
   ``done`` requests serialized); ranks 1..N-1 = workers.
 - GOSGD: every rank is a peer worker; rank 0 additionally collects the
   final (params, weight) pairs and writes the consensus checkpoint.
+
+**Elastic membership** (docs/elasticity.md): both planes keep a live
+roster (``parallel/membership.py``).  EASGD workers register on
+``join``, heartbeat implicitly through every exchange/epoch frame, and
+are EVICTED after ``evict_after_s`` of silence — eviction frees the
+server's per-worker reply-leg EF residual and stops the epoch/done
+predicates waiting on the dead rank.  A (re)joining worker is
+re-admitted CHECKPOINTLESSLY: its first exchange after eviction gets
+the center back (never folded with its stale params) under a bumped
+generation, and both sides reset their compression residuals.  GOSGD
+peers gossip ``hello``/``bye`` beacons beside the mass frames; silent
+peers drop out of everyone's push tables, and a rejoining peer pulls a
+peer snapshot as directed, mass-conserving pushes.  Worker-side, every
+exchange leg runs under bounded retry with jittered backoff and
+degrades to counted local SGD steps — membership failures never raise
+into a surviving worker's train loop.
 """
 
 from __future__ import annotations
@@ -28,6 +44,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from theanompi_tpu.parallel import membership as ms
 from theanompi_tpu.parallel.async_workers import (
     EASGD_Worker,
     GOSGD_Worker,
@@ -114,23 +131,79 @@ class _RemoteServer:
 
     ``wire_dtype`` (``np.float16`` or ``'q8'``) compresses the
     parameter payload both ways; elastic math always runs fp32 at the
-    server.  The q8 wire additionally keeps the EF residual on the
-    PUSH leg: what one exchange's quantization dropped is re-sent with
-    the next, so the center integrates the true worker trajectory (the
-    reply leg carries the center — server-side state per worker would
-    be needed to EF it, and asynchrony already tolerates that noise)."""
+    server.  The q8 wire keeps the EF residual on the PUSH leg: what
+    one exchange's quantization dropped is re-sent with the next, so
+    the center integrates the true worker trajectory.  (The reply leg
+    is EF'd server-side per worker — the membership roster is exactly
+    the per-worker state that used to be missing.)
 
-    def __init__(self, address: Address, wire_dtype=None):
+    Every exchange runs under a bounded retry budget with jittered
+    backoff (``retries``/``timeout_s``); the final failure re-raises so
+    the worker can degrade to local SGD — never die.  A reply flagged
+    ``readmitted`` means the server evicted this worker's previous
+    incarnation: the proxy resets its push-leg EF residual (stale error
+    feedback must not be replayed into a fresh connection) and hands
+    the worker the CENTER to pull — checkpointless recovery."""
+
+    def __init__(self, address: Address, wire_dtype=None,
+                 rank: Optional[int] = None,
+                 retries: int = 2, timeout_s: float = 120.0):
         self.address = address
         self.wire_dtype = wire_dtype
+        self.rank = rank
+        self.retries = int(retries)
+        self.timeout_s = float(timeout_s)
         self._residual = None  # q8 push-leg EF state
+        self._last_tau: Optional[int] = None
+        self.readmissions = 0
+        self.generation: Optional[int] = None
 
-    def exchange(self, worker_params):
-        w, self._residual = _pack_wire(
+    def join(self, rank: Optional[int] = None):
+        reply = request(
+            self.address,
+            {"kind": "join", "rank": self.rank if rank is None else rank},
+            timeout=self.timeout_s,
+        )
+        self.generation = reply.get("generation", self.generation)
+        self._last_tau = reply.get("tau", self._last_tau)
+        self._residual = None  # fresh incarnation, fresh EF history
+        return reply
+
+    def exchange(self, worker_params, rank=None, step=None):
+        w, residual = _pack_wire(
             worker_params, self.wire_dtype, self._residual
         )
-        reply = request(self.address, {"kind": "exchange", "params": w})
+        msg = {"kind": "exchange", "params": w}
+        if self.rank is not None:
+            msg["rank"] = self.rank
+            if step is not None:
+                msg["step"] = int(step)
+        reply = ms.retry_with_backoff(
+            lambda: request(self.address, msg, timeout=self.timeout_s),
+            attempts=self.retries + 1,
+            counter_labels={"rule": "easgd"},
+        )
+        # commit the EF residual only after the push actually landed: a
+        # failed send's quantization error was never on the wire, so it
+        # must not be subtracted from the next attempt
+        self._residual = residual
+        self._last_tau = reply.get("tau", self._last_tau)
+        if reply.get("readmitted"):
+            self.readmissions += 1
+            self.generation = reply.get("generation", self.generation)
+            self._residual = None
+            print(
+                f"EASGD worker (rank {self.rank}): re-admitted by the "
+                f"server under generation {self.generation} — pulling "
+                "the center (checkpointless recovery)",
+                flush=True,
+            )
         return _unpack_wire(reply["params"])
+
+    def suggest_tau(self, rank=None, default: Optional[int] = None):
+        """The server's adaptive-τ hint from the latest reply (None →
+        keep the caller's static τ)."""
+        return self._last_tau if self._last_tau else default
 
 
 class _CompressedMailbox:
@@ -173,6 +246,13 @@ class _CompressedMailbox:
     def recv(self, rank=None, timeout=None):
         return _unpack_wire(self._inner.recv(rank, timeout))
 
+    def reset_residuals(self) -> None:
+        """Drop every push-leg EF residual — called on membership churn
+        (a peer evicted or re-admitted): error feedback accumulated
+        against a dead incarnation's stream must never be replayed into
+        a fresh one."""
+        self._residuals.clear()
+
     def close(self) -> None:
         self._inner.close()
 
@@ -180,6 +260,200 @@ class _CompressedMailbox:
 # ---------------------------------------------------------------------------
 # EASGD
 # ---------------------------------------------------------------------------
+
+class EasgdServerCore:
+    """The EASGD server's elastic math + membership, transport-free.
+
+    Extracted from ``run_easgd_server`` so the protocol is testable
+    with plain numpy pytrees (no model, no sockets): ``handler`` is
+    what a ``TcpServerChannel`` serves, ``cv``/predicates are what the
+    duties loop waits on.  The roster turns the old static
+    ``n_workers - failed`` accounting into LIVE membership:
+
+    - ``join`` registers (or re-admits) a rank; the reply carries the
+      center, the server's CURRENT wait epoch (a mid-run joiner starts
+      there — checkpointless), the member's generation, and the
+      adaptive-τ hint when enabled.
+    - ``exchange`` heartbeats the member.  An exchange from an
+      UNKNOWN/EVICTED rank is the re-admission path: its stale params
+      are NOT folded into the center — the reply hands back the center
+      under a fresh generation with ``readmitted: True``, and the
+      per-worker reply-leg EF residual starts from zero (the old one
+      died with the eviction).
+    - ``epoch``/``done`` update the boundary bookkeeping; ``done``
+      leaves the roster cleanly (no eviction alert).
+    - ``sweep`` evicts members silent past ``evict_after_s`` — called
+      from the duties loop's wait so a dead worker can never wedge an
+      epoch boundary.
+
+    With ``wire_dtype='q8'`` the reply leg is EF-compensated PER WORKER
+    (residual in the member's roster state — the server-side state PR 6
+    noted was missing), freed on evict and fresh on rejoin.
+    """
+
+    def __init__(
+        self,
+        center: Any,
+        alpha: float,
+        start_epoch: int = 0,
+        wire_dtype=None,
+        evict_after_s: float = 60.0,
+        base_tau: Optional[int] = None,
+        adaptive_tau: bool = False,
+        on_event=None,
+        clock=time.monotonic,
+    ):
+        self.alpha = float(alpha)
+        self.wire_dtype = wire_dtype
+        self.cv = threading.Condition()
+        self.center = center
+        self.epoch = int(start_epoch)  # the boundary duties wait on
+        self.n_exchanges = 0
+        self.epoch_counts: dict = {}
+        self.net_state = None  # latest worker BN-state snapshot
+        self.wire_seen: Optional[str] = None
+        self.done_ok: set = set()
+        self.failed: set = set()
+        self.any_joined = False
+        self.readmissions = 0
+        self._on_event = on_event
+        self.roster = ms.Roster(
+            "easgd", evict_after_s=evict_after_s,
+            on_event=self._membership_event, clock=clock,
+        )
+        self.tau_ctrl = (
+            ms.TauController(base_tau, self.roster)
+            if (adaptive_tau and base_tau) else None
+        )
+
+    def _membership_event(self, kind, member, generation) -> None:
+        print(
+            f"EASGD server: membership {kind} rank {member} "
+            f"(generation {generation})",
+            flush=True,
+        )
+        if self._on_event is not None:
+            self._on_event(kind, member, generation)
+
+    # ---- duties-loop predicates (call with ``cv`` held) --------------
+    def expected_reports(self) -> int:
+        """Ranks that must report the current boundary: live members
+        (they train toward it) plus clean finishers (they already
+        reported every epoch — the original fast-worker rationale).
+        Failed and evicted ranks are expected to report nothing."""
+        return len(self.roster.members()) + len(self.done_ok)
+
+    def boundary_ready(self, epoch: int) -> bool:
+        n = self.expected_reports()
+        return n > 0 and self.epoch_counts.get(epoch, 0) >= n
+
+    def all_gone(self) -> bool:
+        """Every rank that ever joined has left (done/failed/evicted)."""
+        return self.any_joined and not self.roster.members()
+
+    def sweep(self) -> List[Any]:
+        return self.roster.sweep()
+
+    def _tau_hint(self, reply: dict, rank) -> dict:
+        if self.tau_ctrl is not None and rank is not None:
+            reply["tau"] = self.tau_ctrl.tau_for(rank)
+        return reply
+
+    # ---- the served protocol -----------------------------------------
+    def handler(self, msg: Any) -> Any:
+        kind = msg["kind"]
+        with self.cv:
+            if kind == "join":
+                rank = msg.get("rank")
+                gen = 0
+                if rank is not None:
+                    gen = self.roster.join(rank)
+                    self.any_joined = True
+                    self.done_ok.discard(rank)
+                    self.failed.discard(rank)
+                self.cv.notify_all()
+                return self._tau_hint(
+                    {"params": self.center, "epoch": self.epoch,
+                     "generation": gen},
+                    rank,
+                )
+            if kind == "exchange":
+                if self.wire_seen is None:
+                    # observability: what dtype ACTUALLY rode the wire —
+                    # the e2e compression tests assert this, so a
+                    # refactor that silently drops the compression
+                    # cannot stay green ('int8+scales' for q8 frames)
+                    from theanompi_tpu.parallel import wire as _w
+
+                    self.wire_seen = _w.wire_dtype_seen(msg["params"])
+                rank = msg.get("rank")
+                if rank is not None and not self.roster.beat(
+                    rank, msg.get("step")
+                ):
+                    # unknown/evicted incarnation → re-admission: the
+                    # worker's params went stale while it was out of the
+                    # roster, so they must NOT move the center; hand it
+                    # the center to pull under a fresh generation
+                    gen = self.roster.join(rank)
+                    self.any_joined = True
+                    self.done_ok.discard(rank)
+                    self.failed.discard(rank)
+                    self.readmissions += 1
+                    out = jax.tree.map(np.copy, self.center)
+                    if self.wire_dtype:
+                        out = _pack_wire(out, self.wire_dtype)[0]
+                    self.cv.notify_all()
+                    return self._tau_hint(
+                        {"params": out, "readmitted": True,
+                         "generation": gen, "epoch": self.epoch},
+                        rank,
+                    )
+                w = _unpack_wire(msg["params"])  # math always fp32
+                c = self.center
+                diff = jax.tree.map(lambda a, b: a - b, w, c)
+                self.center = jax.tree.map(
+                    lambda b, d: b + self.alpha * d, c, diff
+                )
+                self.n_exchanges += 1
+                out = jax.tree.map(lambda a, d: a - self.alpha * d, w, diff)
+                if self.wire_dtype:
+                    st = (
+                        self.roster.state(rank) if rank is not None else None
+                    )
+                    if st is not None:
+                        # reply leg EF per worker: the residual lives in
+                        # the member's roster state, so eviction frees it
+                        # and a rejoin starts from zero by construction
+                        out, st["reply_ef"] = _pack_wire(
+                            out, self.wire_dtype, st.get("reply_ef")
+                        )
+                    else:
+                        # anonymous (rank-less) client: plain RN, the
+                        # pre-membership behavior
+                        out = _pack_wire(out, self.wire_dtype)[0]
+                return self._tau_hint({"params": out}, rank)
+            if kind == "epoch":
+                rank = msg.get("rank")
+                if rank is not None:
+                    self.roster.beat(rank)
+                e = int(msg["epoch"])
+                self.epoch_counts[e] = self.epoch_counts.get(e, 0) + 1
+                if msg.get("net_state") is not None:
+                    self.net_state = msg["net_state"]
+                self.cv.notify_all()
+                return {"ok": True}
+            if kind == "done":
+                rank = msg.get("rank")
+                if rank is not None:
+                    if bool(msg.get("failed", False)):
+                        self.failed.add(rank)
+                    else:
+                        self.done_ok.add(rank)
+                    self.roster.leave(rank)
+                self.cv.notify_all()
+                return {"ok": True}
+        raise ValueError(f"unknown request kind {kind!r}")
+
 
 def run_easgd_server(
     size: int,
@@ -199,12 +473,22 @@ def run_easgd_server(
     duties_coalesce: bool = True,  # jump to the newest completed epoch
     # when validation is slower than a worker epoch (same semantics and
     # rationale as EASGD_Driver.duties_coalesce, async_workers.py)
+    evict_after_s: float = 60.0,  # membership: a worker silent past
+    # this window is evicted (its exchange cadence is its heartbeat —
+    # size it well above tau * step_time)
+    adaptive_tau: bool = False,  # straggler-adaptive per-worker tau
+    # hints in every exchange/join reply (membership.TauController)
+    tau: Optional[int] = None,  # the workers' base tau (adaptive mode
+    # needs it to scale from; ignored otherwise)
 ):
     """Rank 0: the reference ``EASGD_Server.run()`` loop, TCP-served.
 
     Builds its own model instance on this process's devices (the
     reference dedicated a rank + GPU to the server) purely for center
-    init + validation; it never trains."""
+    init + validation; it never trains.  Membership lives in
+    :class:`EasgdServerCore`: dead workers are evicted instead of
+    wedging epoch boundaries, and killed-then-respawned workers
+    re-admit checkpointlessly (docs/elasticity.md)."""
     import importlib
 
     cfg = dict(model_config or {})
@@ -212,7 +496,6 @@ def run_easgd_server(
     model = cls(config=cfg, mesh=cls.build_mesh(devices=jax.local_devices(), config=cfg))
     if n_epochs is not None:
         model.n_epochs = n_epochs
-    n_workers = size - 1
     start_epoch = 0
     center = _to_host(model.params)
     if resume and checkpoint_dir:
@@ -226,91 +509,71 @@ def run_easgd_server(
             print(f"EASGD server: resumed center from {path} at epoch "
                   f"{start_epoch}", flush=True)
 
-    state = {
-        "center": center,
-        "n_exchanges": 0,
-        "epoch_counts": {},
-        "done": 0,
-        "failed": 0,
-        "net_state": None,  # latest worker BN-state snapshot
-    }
-    cv = threading.Condition()
+    # live telemetry (observability/live.py): inert unless
+    # THEANOMPI_LIVE/THEANOMPI_LIVE_AGG is set.  The server's
+    # membership_evictions_total deltas ride the frames, so the live
+    # watchdog's worker_evicted rule pages on real fleet churn.
+    from theanompi_tpu.observability import live as obs_live
+
+    telemetry = obs_live.maybe_start_from_env("easgd_server")
     rec = Recorder(print_freq=1, rank=0, verbose=verbose,
                    save_dir=checkpoint_dir)
+    core = EasgdServerCore(
+        center,
+        alpha,
+        start_epoch=start_epoch,
+        wire_dtype=wire_dtype,
+        evict_after_s=evict_after_s,
+        base_tau=tau,
+        adaptive_tau=adaptive_tau,
+        on_event=lambda kind, member, gen: rec.log_event(
+            "membership", plane="easgd", event=kind, rank=member,
+            generation=gen,
+        ),
+    )
+    cv = core.cv
 
-    def handler(msg: Any) -> Any:
-        kind = msg["kind"]
-        with cv:
-            if kind == "join":
-                return {"params": state["center"], "epoch": start_epoch}
-            if kind == "exchange":
-                if "wire_seen" not in state:
-                    # observability: what dtype ACTUALLY rode the wire —
-                    # the e2e compression tests assert this, so a
-                    # refactor that silently drops the compression
-                    # cannot stay green ('int8+scales' for q8 frames)
-                    from theanompi_tpu.parallel import wire as _w
-
-                    state["wire_seen"] = _w.wire_dtype_seen(msg["params"])
-                w = _unpack_wire(msg["params"])  # math always fp32
-                c = state["center"]
-                diff = jax.tree.map(lambda a, b: a - b, w, c)
-                state["center"] = jax.tree.map(
-                    lambda b, d: b + alpha * d, c, diff
-                )
-                state["n_exchanges"] += 1
-                out = jax.tree.map(lambda a, d: a - alpha * d, w, diff)
-                if wire_dtype:
-                    # reply leg: plain RN compression (see _RemoteServer
-                    # — EF state per worker would live server-side)
-                    out = _pack_wire(out, wire_dtype)[0]
-                return {"params": out}
-            if kind == "epoch":
-                e = int(msg["epoch"])
-                state["epoch_counts"][e] = state["epoch_counts"].get(e, 0) + 1
-                if msg.get("net_state") is not None:
-                    state["net_state"] = msg["net_state"]
-                cv.notify_all()
-                return {"ok": True}
-            if kind == "done":
-                state["done"] += 1
-                if bool(msg.get("failed", False)):
-                    state["failed"] += 1
-                cv.notify_all()
-                return {"ok": True}
-        raise ValueError(f"unknown request kind {kind!r}")
-
-    channel = TcpServerChannel(address[1], handler)
+    channel = TcpServerChannel(address[1], core.handler)
     deadline = time.monotonic() + timeout
+
+    def _wait_for(pred) -> None:
+        """cv.wait_for with eviction sweeps folded in: a dead worker
+        must unblock the predicate by being evicted, not by the job
+        timeout.  Raises TimeoutError at the overall deadline."""
+        with cv:
+            while not pred():
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"EASGD server: boundary/drain predicate unmet "
+                        f"within {timeout}s"
+                    )
+                cv.wait(timeout=min(1.0, max(0.1, evict_after_s / 4)))
+                core.sweep()
+
     try:
         epoch = start_epoch
         while epoch < model.n_epochs:
+            core.epoch = epoch
+            _wait_for(
+                lambda: core.boundary_ready(epoch) or core.all_gone()
+            )
             with cv:
-                need = lambda e: (state["epoch_counts"].get(e, 0)
-                                  >= n_workers - state["failed"])
-                ok = cv.wait_for(
-                    lambda: need(epoch) or state["done"] >= n_workers,
-                    timeout=max(1.0, deadline - time.monotonic()),
-                )
-                if not ok:
-                    raise TimeoutError(
-                        f"EASGD server: no epoch-{epoch} boundary within "
-                        f"{timeout}s"
-                    )
-                if state["epoch_counts"].get(epoch, 0) == 0:
+                if core.epoch_counts.get(epoch, 0) == 0:
                     break  # all workers gone before this boundary
                 # coalesce lagging duties to the NEWEST completed epoch
                 # so every validated row reflects a fresh center — same
                 # helper as the threaded driver (frozen-curve fix,
                 # VERDICT r3 #1)
                 newest, skipped = coalesce_duties_window(
-                    epoch, model.n_epochs, need, duties_coalesce
+                    epoch, model.n_epochs, core.boundary_ready,
+                    duties_coalesce,
                 )
-                center = jax.tree.map(np.copy, state["center"])
+                center = jax.tree.map(np.copy, core.center)
                 # snapshot with the center: the provenance must say how
                 # many exchanges produced exactly these params
-                n_ex = state["n_exchanges"]
-                net_state = state["net_state"]
+                n_ex = core.n_exchanges
+                net_state = core.net_state
+                core.epoch = newest + 1  # joiners start at the new boundary
             if checkpoint_dir:
                 from theanompi_tpu.utils import checkpoint as ckpt
 
@@ -334,19 +597,33 @@ def run_easgd_server(
                           f"{loss:.4f} err {err:.4f} (n_exchanges {n_ex})",
                           flush=True)
             epoch = newest + 1
+        # drain: every rank that ever joined must leave (done) or be
+        # evicted — the roster replaces the static done >= n_workers
+        # count, so a killed-and-never-respawned worker cannot wedge
+        # the shutdown past its eviction window
+        _wait_for(core.all_gone)
         with cv:
-            cv.wait_for(
-                lambda: state["done"] >= n_workers,
-                timeout=max(1.0, deadline - time.monotonic()),
-            )
-            center = jax.tree.map(np.copy, state["center"])
+            center = jax.tree.map(np.copy, core.center)
     finally:
         channel.close()
+        if telemetry is not None:
+            try:
+                telemetry.stop()
+            except Exception as te:  # telemetry never masks the run
+                print(f"telemetry stop failed: {type(te).__name__}: {te}",
+                      flush=True)
     model.params = replicate(model.mesh, center)
     rec.log_event(
         "async_wire",
-        dtype=state.get("wire_seen", "none"),
-        n_exchanges=state["n_exchanges"],
+        dtype=core.wire_seen or "none",
+        n_exchanges=core.n_exchanges,
+    )
+    rec.log_event(
+        "membership_summary",
+        plane="easgd",
+        evictions=core.roster.n_evictions,
+        rejoins=core.roster.n_rejoins,
+        readmissions=core.readmissions,
     )
     if checkpoint_dir:
         model.save_model(os.path.join(checkpoint_dir, "ckpt_center.npz"))
@@ -369,6 +646,10 @@ def run_easgd_worker(
     watchdog_timeout: Optional[float] = None,  # per-process stall
     # watchdog (armed at the first completed iteration)
     watchdog_action: str = "dump",
+    adaptive_tau: bool = False,  # apply the server's per-worker tau hints
+    exchange_retries: int = 2,  # bounded retry per exchange leg before
+    # degrading to local SGD (membership.retry_with_backoff)
+    exchange_timeout_s: float = 120.0,
 ):
     """Ranks 1..N-1: the reference ``EASGD_Worker`` loop, one process."""
     widx = rank - 1  # data-shard index among the N-1 workers
@@ -377,6 +658,10 @@ def run_easgd_worker(
         rank=rank,
         verbose=verbose,
         save_dir=checkpoint_dir,
+    )
+    server = _RemoteServer(
+        server_address, wire_dtype=wire_dtype, rank=rank,
+        retries=exchange_retries, timeout_s=exchange_timeout_s,
     )
     worker = EASGD_Worker(
         widx,
@@ -387,21 +672,48 @@ def run_easgd_worker(
         n_epochs,
         rec,
         n_workers=size - 1,
-        server=_RemoteServer(server_address, wire_dtype=wire_dtype),
+        server=server,
         tau=tau,
+        adaptive_tau=adaptive_tau,
     )
-    joined = request(server_address, {"kind": "join", "rank": rank})
+    from theanompi_tpu.observability import live as obs_live
+    from theanompi_tpu.runtime.fault import FaultInjector
+
+    telemetry = obs_live.maybe_start_from_env(f"easgd_rank{rank}")
+    # chaos plans address processes by GLOBAL rank (the supervisor's
+    # view), while the worker indexes data shards by widx
+    worker.fault = FaultInjector.from_env(rank=rank)
+    worker.fault_rank = rank
+    joined = server.join()
     worker.set_params(joined["params"])
     worker.model.current_epoch = int(joined["epoch"])
     # the epoch report carries this worker's host BN-state snapshot
     # (taken at the boundary by _epoch_end): the server's own model
     # never trains, so validating the center with ITS init running
     # stats would make every mid-run val row garbage on BN models
-    worker.on_epoch_end = lambda r, e: request(
-        server_address,
-        {"kind": "epoch", "rank": rank, "epoch": e,
-         "net_state": worker.host_net_state},
-    )
+    def _report_epoch(r, e):
+        try:
+            ms.retry_with_backoff(
+                lambda: request(
+                    server_address,
+                    {"kind": "epoch", "rank": rank, "epoch": e,
+                     "net_state": worker.host_net_state},
+                    timeout=exchange_timeout_s,
+                ),
+                attempts=exchange_retries + 1,
+                counter_labels={"rule": "easgd"},
+            )
+        except (ConnectionError, OSError, TimeoutError) as err:
+            # a down server must not kill a surviving worker at an
+            # epoch boundary: training continues, the next exchange's
+            # re-admission path resyncs the membership state
+            print(
+                f"EASGD worker {rank}: epoch-{e} report failed "
+                f"({type(err).__name__}) — continuing locally",
+                flush=True,
+            )
+
+    worker.on_epoch_end = _report_epoch
     from theanompi_tpu.runtime.fault import Watchdog
 
     worker.watchdog = Watchdog.maybe(watchdog_timeout, watchdog_action)
@@ -412,12 +724,25 @@ def run_easgd_worker(
     finally:
         if worker.watchdog is not None:
             worker.watchdog.close()
+        if telemetry is not None:
+            try:
+                telemetry.stop()
+            except Exception as te:  # telemetry never masks the run
+                print(f"telemetry stop failed: {type(te).__name__}: {te}",
+                      flush=True)
         try:
             request(
                 server_address, {"kind": "done", "rank": rank, "failed": failed}
             )
         except OSError:
             pass  # server already gone; never mask the original error
+        rec.log_event(
+            "membership_client",
+            plane="easgd",
+            degraded_steps=worker.n_degraded_steps,
+            exchange_failures=worker.n_exchange_failures,
+            readmissions=server.readmissions,
+        )
         if checkpoint_dir:
             rec.save()
     return worker.model
@@ -452,7 +777,10 @@ class _GossipAdapter:
     """
 
     def __init__(self, mailbox: TcpMailbox, rank: int,
-                 ack_timeout: float = 120.0):
+                 ack_timeout: float = 120.0,
+                 evict_after_s: float = 60.0,
+                 hello_every_s: float = 2.0,
+                 on_event=None):
         self.mailbox = mailbox
         self.rank = int(rank)
         self.n_ranks = mailbox.n_ranks
@@ -464,6 +792,130 @@ class _GossipAdapter:
         self._pending: dict = {}
         self._finals_seen: set = set()
         self.n_dropped = 0  # post-final pushes dropped unacked (observability)
+        # ---- elastic membership (docs/elasticity.md) -----------------
+        # the peer table: who is alive and pushable.  Beats come from
+        # the gossip frames themselves plus periodic hello beacons (a
+        # quiet peer with low p_push still proves life); silent peers
+        # are evicted from THIS peer's table only — membership is a
+        # local view, consistent because everyone runs the same rules.
+        self.on_event = on_event
+        self.roster = ms.Roster(
+            "gosgd", evict_after_s=evict_after_s,
+            on_event=self._membership_event,
+        )
+        self.hello_every_s = float(hello_every_s)
+        self._last_hello = 0.0
+        self._snapshot_requests: List[int] = []
+        self._final_srcs: set = set()
+        self.any_joined = False
+
+    # ---- membership --------------------------------------------------
+    def _membership_event(self, kind, member, generation) -> None:
+        print(
+            f"GOSGD peer {self.rank}: membership {kind} rank {member} "
+            f"(generation {generation})",
+            flush=True,
+        )
+        if kind in ("evict", "rejoin"):
+            # fresh incarnation / dead stream: push-leg EF residuals
+            # accumulated against the old connection must not replay
+            reset = getattr(self.mailbox, "reset_residuals", None)
+            if reset is not None:
+                reset()
+        if self.on_event is not None:
+            try:
+                self.on_event(kind, member, generation)
+            except Exception as e:
+                print(f"GOSGD membership event hook failed: "
+                      f"{type(e).__name__}: {e}", flush=True)
+
+    def _beat(self, src: int, step: Optional[int] = None) -> None:
+        """Any frame from ``src`` proves life: auto-join unknowns (the
+        gossip fabric has no central admission — hearing a peer IS the
+        join), then heartbeat."""
+        src = int(src)
+        if not self.roster.beat(src, step):
+            self.roster.join(src)
+            self.any_joined = True
+            self.roster.beat(src, step)
+
+    def live_peers(self) -> List[int]:
+        """Pushable peers.  Until ANY peer has spoken the membership
+        protocol, every configured rank is assumed live (mixed-fleet /
+        pre-hello compatibility: a sender must not go mute just because
+        its peers never beacon — the weight-restore path still covers
+        their deaths).  Once the fabric is heard from, only known-live
+        members are targets."""
+        if not self.any_joined:
+            return [r for r in range(self.n_ranks) if r != self.rank]
+        return [int(r) for r in self.roster.members()]
+
+    def peer_weights(self, peers: Sequence[int]) -> List[float]:
+        """Push-target selection weights, biased AWAY from stragglers:
+        a peer whose beat-measured step rate lags the fastest gets
+        proportionally less gossip (its inbox is already its
+        bottleneck), floored at 0.25 so no live peer starves of
+        updates."""
+        out = []
+        for r in peers:
+            idx = self.roster.straggler_index(int(r))
+            out.append(1.0 if idx is None else max(0.25, 1.0 - idx))
+        return out
+
+    def sweep(self) -> List[int]:
+        return [int(r) for r in self.roster.sweep()]
+
+    def maybe_hello(self, step: Optional[int] = None) -> None:
+        """Periodic liveness beacon to every configured address — the
+        heartbeat for peers the random pushes would leave silent."""
+        now = time.monotonic()
+        if now - self._last_hello < self.hello_every_s:
+            return
+        self._last_hello = now
+        self.send_hello(step=step)
+
+    def send_hello(self, step: Optional[int] = None,
+                   need_snapshot: bool = False,
+                   ranks: Optional[Sequence[int]] = None) -> None:
+        targets = (
+            list(ranks) if ranks is not None
+            else [r for r in range(self.n_ranks) if r != self.rank]
+        )
+        for dst in targets:
+            try:
+                self.mailbox.send(
+                    dst,
+                    ("hello", self.rank, int(step or 0),
+                     1 if need_snapshot else 0),
+                )
+            except (ConnectionError, OSError):
+                pass  # unreachable peers learn of us from later beacons
+
+    def send_bye(self) -> None:
+        """Best-effort clean-leave announcement (peers drop us from
+        their tables immediately instead of waiting out the eviction
+        window)."""
+        for dst in range(self.n_ranks):
+            if dst == self.rank:
+                continue
+            try:
+                self.mailbox.send(dst, ("bye", self.rank))
+            except (ConnectionError, OSError):
+                pass
+
+    def take_snapshot_requests(self) -> List[int]:
+        out, self._snapshot_requests = self._snapshot_requests, []
+        return out
+
+    def pending_final_ranks(self) -> List[int]:
+        """Live members whose final has not arrived — what rank 0's
+        consensus gather waits on (an evicted member drops out, so a
+        dead peer cannot wedge the consensus past its eviction
+        window)."""
+        return [
+            r for r in self.live_peers()
+            if r != self.rank and r not in self._final_srcs
+        ]
 
     def _next_seq(self) -> int:
         self._seq += 1
@@ -549,6 +1001,7 @@ class _GossipAdapter:
                 self._pending.pop(m[1], None)
             elif m[0] == "push" and len(m) == 5:
                 _, src, seq, p, w = m
+                self._beat(src)
                 if self.accept_gossip:
                     self._ack(src, seq)
                     gossip.append((p, w))
@@ -568,6 +1021,24 @@ class _GossipAdapter:
                 if key not in self._finals_seen:
                     self._finals_seen.add(key)
                     self.finals.append((p, float(np.asarray(w))))
+                # a final is a clean leave: its sender can merge nothing
+                # further, so it must drop out of the push table now
+                # instead of collecting post-final pushes to reclaim
+                self._final_srcs.add(int(src))
+                if self.roster.is_member(int(src)):
+                    self.roster.leave(int(src))
+            elif m[0] == "hello" and len(m) == 4:
+                _, src, step, need = m
+                self._beat(src, int(step))
+                if need and int(src) not in self._snapshot_requests:
+                    # a (re)joining peer asked for state: queue a
+                    # directed, mass-conserving push grant for the
+                    # worker's next merge step (docs/elasticity.md —
+                    # a snapshot IS a push, so consensus mass stays 1)
+                    self._snapshot_requests.append(int(src))
+            elif m[0] == "bye" and len(m) == 2:
+                if self.roster.is_member(int(m[1])):
+                    self.roster.leave(int(m[1]))
             else:
                 gossip.append(m)
         return gossip
@@ -592,18 +1063,37 @@ def run_gosgd_peer(
     watchdog_action: str = "dump",
     ack_timeout: float = 120.0,  # mass-frame ack window (see
     # _GossipAdapter: reclaim pushes / resend finals past this)
+    evict_after_s: float = 60.0,  # membership: silent peers leave the
+    # push table after this window
+    hello_every_s: float = 2.0,  # liveness beacon cadence
+    rejoin: Optional[bool] = None,  # None → THEANOMPI_ELASTIC_REJOIN
+    # env (set by the elastic supervisor on respawned ranks): start
+    # with zero consensus weight and pull a peer snapshot instead of
+    # training from init — checkpointless recovery
+    snapshot_wait_s: float = 30.0,
 ):
     """One GOSGD peer process; rank 0 also aggregates the consensus."""
     mailbox = TcpMailbox(rank, addresses)
     if wire_dtype:
         mailbox = _CompressedMailbox(mailbox, wire_dtype)
-    adapter = _GossipAdapter(mailbox, rank, ack_timeout=ack_timeout)
     seed0 = int((model_config or {}).get("seed", 0))
     rec = Recorder(
         print_freq=int((model_config or {}).get("print_freq", 40)),
         rank=rank,
         verbose=verbose and rank == 0,
         save_dir=checkpoint_dir,
+    )
+    adapter = _GossipAdapter(
+        mailbox, rank, ack_timeout=ack_timeout,
+        evict_after_s=evict_after_s,
+        # at least 3 beacons per eviction window: the cadence must
+        # leave headroom for a slow iteration between beacons, or a
+        # merely-slow peer reads as dead under a tight window
+        hello_every_s=min(hello_every_s, evict_after_s / 3.0),
+        on_event=lambda kind, member, gen: rec.log_event(
+            "membership", plane="gosgd", event=kind, rank=member,
+            generation=gen,
+        ),
     )
     worker = GOSGD_Worker(
         rank,
@@ -618,9 +1108,39 @@ def run_gosgd_peer(
         p_push=p_push,
         rng=np.random.RandomState(10_000 + seed0 + rank),
     )
-    from theanompi_tpu.runtime.fault import Watchdog
+    from theanompi_tpu.observability import live as obs_live
+    from theanompi_tpu.runtime.fault import FaultInjector, Watchdog
 
+    telemetry = obs_live.maybe_start_from_env(f"gosgd_rank{rank}")
+    worker.fault = FaultInjector.from_env(rank=rank)
     worker.watchdog = Watchdog.maybe(watchdog_timeout, watchdog_action)
+    if rejoin is None:
+        rejoin = os.environ.get("THEANOMPI_ELASTIC_REJOIN") == "1"
+    if rejoin:
+        # checkpointless re-admission: this incarnation holds NO
+        # consensus mass (the dead one's share renormalizes away) and
+        # pulls its params from the fabric — every live peer grants a
+        # directed half-weight push, so the joiner starts at a
+        # mass-weighted average of its peers
+        worker.weight = 0.0
+        adapter.send_hello(step=0, need_snapshot=True)
+        deadline = time.monotonic() + snapshot_wait_s
+        while worker.weight <= 0.0 and time.monotonic() < deadline:
+            worker._merge_inbox()
+            if worker.weight <= 0.0:
+                time.sleep(0.05)
+        if worker.weight > 0.0:
+            print(f"GOSGD peer {rank}: re-admitted with snapshot "
+                  f"weight {worker.weight:.4f}", flush=True)
+        else:
+            print(f"GOSGD peer {rank}: no snapshot within "
+                  f"{snapshot_wait_s:.0f}s — training from init at "
+                  "zero weight (mass arrives with the first merge)",
+                  flush=True)
+    else:
+        # announce ourselves so peers add us to their push tables (a
+        # mid-run late joiner becomes a push target only once heard)
+        adapter.send_hello(step=0)
     try:
         worker._run()  # ends with a final inbox drain
         # training is done: the consensus/lingering phases below are
@@ -650,6 +1170,11 @@ def run_gosgd_peer(
             # whole consensus until the job timeout
             adapter.accept_gossip = False  # can't merge any more
             adapter.send_final(0, worker.get_params(), worker.weight)
+            # announce the clean leave fabric-wide: the final only goes
+            # to rank 0, and without a bye the other peers would time
+            # this rank out as an EVICTION while it lingers serving
+            # acks (per-sender FIFO: the final precedes the bye at 0)
+            adapter.send_bye()
             # keep the listener open until rank 0 finishes the consensus:
             # slower peers may still push gossip at this port, and a dead
             # port would crash their training (their push rolls back on
@@ -665,15 +1190,30 @@ def run_gosgd_peer(
                 if not stop:
                     time.sleep(0.2)
             return worker.model
-        # rank 0: gather everyone's final (params, weight), weight-average
+        # rank 0: gather the finals, weight-average.  Membership-aware:
+        # the gather waits on LIVE members' finals, so a dead peer
+        # blocks the consensus only until its eviction window elapses —
+        # its mass renormalizes away (the weighted average divides by
+        # the received total).  Peers that never spoke the hello
+        # protocol fall back to the static count (mixed fleets decode).
         deadline = time.monotonic() + timeout
         while len(adapter.finals) < size - 1:
+            if adapter.any_joined and not adapter.pending_final_ranks():
+                print(
+                    f"GOSGD consensus: proceeding with "
+                    f"{len(adapter.finals)}/{size - 1} finals — every "
+                    "remaining peer left or was evicted; mass "
+                    "renormalizes over the received entries",
+                    flush=True,
+                )
+                break
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"GOSGD consensus: only {len(adapter.finals)}/{size - 1} "
                     f"finals within {timeout}s"
                 )
             worker._merge_inbox()  # late gossip folds into rank 0's mass
+            adapter.sweep()
             time.sleep(0.05)
         # one defensive drain after the last final: per-sender FIFO on
         # the persistent-connection transport already guarantees a
@@ -690,6 +1230,14 @@ def run_gosgd_peer(
         model.params = replicate(model.mesh, acc)
         if val_freq:
             model.run_validation(0, rec)
+        rec.log_event(
+            "membership_summary",
+            plane="gosgd",
+            evictions=adapter.roster.n_evictions,
+            rejoins=adapter.roster.n_rejoins,
+            finals=len(adapter.finals),
+            total_mass=round(float(tot), 6),
+        )
         if checkpoint_dir:
             model.save_model(os.path.join(checkpoint_dir, "ckpt_consensus.npz"))
             rec.save()
@@ -703,4 +1251,10 @@ def run_gosgd_peer(
     finally:
         if worker.watchdog is not None:  # crash path: _run raised
             worker.watchdog.close()
+        if telemetry is not None:
+            try:
+                telemetry.stop()
+            except Exception as te:  # telemetry never masks the run
+                print(f"telemetry stop failed: {type(te).__name__}: {te}",
+                      flush=True)
         mailbox.close()

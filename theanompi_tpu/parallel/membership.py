@@ -1,0 +1,361 @@
+"""Elastic membership for the async rules — the live roster.
+
+The paper's core claim (arXiv:1605.08325) is that EASGD/GOSGD tolerate
+asynchrony *by construction*: a worker's staleness degrades convergence
+smoothly instead of stalling the fleet.  This module takes that claim to
+its operational conclusion — on a preemptible fleet, workers JOIN and
+LEAVE mid-run and the rules keep training:
+
+- :class:`Roster` — the membership table one server (EASGD) or one peer
+  (GOSGD) keeps about its counterparts.  Members register on ``join``,
+  heartbeat via ``beat`` (piggybacked on exchange traffic — an exchange
+  IS a liveness proof, no extra frames on the hot path), and are
+  EVICTED once silent past ``evict_after_s``.  Eviction frees the
+  member's per-connection state (the dict that holds compression EF
+  residuals — stale error feedback must never be replayed against a
+  fresh incarnation) and a later ``join`` of the same rank RE-ADMITS it
+  under a bumped generation number, so both sides know the history was
+  reset.
+- :class:`TauController` — straggler-adaptive EASGD τ: per-worker
+  exchange periods scaled so exchange *wall-clock* cadence is equalized
+  across ranks.  A straggler (low step rate) gets a proportionally
+  smaller τ in iterations — its center contributions stay as fresh in
+  wall time as everyone else's — while fast ranks earn a larger τ and
+  pay less serialization at the server.  The signal is the same
+  per-rank relative step rate the trace doctor's straggler index is
+  built from, measured here from the beats the roster already sees.
+- :func:`retry_with_backoff` — the bounded-retry discipline every
+  worker-side exchange leg uses: exponential backoff with jitter, a
+  hard attempt budget, and NEVER an exception into the train loop —
+  the caller degrades to local SGD and re-tries at the next boundary.
+
+Everything is host-side stdlib+numpy-free and importable without jax
+(mirroring ``observability/``): membership is a property of the
+transport plane, not of the compiled program.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from theanompi_tpu import observability as obs
+
+_REG = obs.get_registry()
+_MEMBERS = _REG.gauge(
+    "membership_members", "live members in the roster (plane label)"
+)
+_JOINS = _REG.counter(
+    "membership_joins_total", "roster joins incl. re-admissions"
+)
+_REJOINS = _REG.counter(
+    "membership_rejoins_total",
+    "re-admissions of a previously evicted/left member",
+)
+_EVICTIONS = _REG.counter(
+    "membership_evictions_total",
+    "members evicted after missed heartbeats (plane, rank labels)",
+)
+_LEAVES = _REG.counter(
+    "membership_leaves_total", "clean leaves (done/final) — not evictions"
+)
+_DEGRADED = _REG.counter(
+    "membership_degraded_steps_total",
+    "local SGD steps taken while the server/peer was unreachable",
+)
+_RETRIES = _REG.counter(
+    "membership_exchange_retries_total",
+    "exchange-leg retries before success or degradation",
+)
+
+
+class _Member:
+    __slots__ = (
+        "generation", "joined_mono", "last_beat_mono", "beats",
+        "last_step", "first_step", "first_step_mono", "state",
+    )
+
+    def __init__(self, generation: int, now: float):
+        self.generation = generation
+        self.joined_mono = now
+        self.last_beat_mono = now
+        self.beats = 0
+        # step-rate estimate: steps per second since (re)join — the
+        # straggler signal TauController and the gossip peer bias read
+        self.last_step: Optional[int] = None
+        self.first_step: Optional[int] = None
+        self.first_step_mono = now
+        # per-member connection state (reply-leg EF residuals, wire
+        # bookkeeping).  Dropped whole on evict/leave: error feedback
+        # must never reference a dead connection's history.
+        self.state: Dict[str, Any] = {}
+
+    def step_rate(self, now: float) -> Optional[float]:
+        if self.last_step is None or self.first_step is None:
+            return None
+        dt = now - self.first_step_mono
+        steps = self.last_step - self.first_step
+        if dt <= 0 or steps <= 0:
+            return None
+        return steps / dt
+
+
+class Roster:
+    """Thread-safe membership table with heartbeat eviction.
+
+    ``plane`` labels the metrics (``"easgd"`` / ``"gosgd"``) so one
+    process hosting both keeps distinct series.  ``on_event(kind,
+    member, generation)`` (kind in ``join``/``rejoin``/``evict``/
+    ``leave``) is the structured-event hook — the EASGD server logs it
+    through its Recorder, the gossip adapter prints it; the hook runs
+    outside the roster lock and must not raise (wrapped defensively).
+    """
+
+    def __init__(
+        self,
+        plane: str,
+        evict_after_s: float = 60.0,
+        join_grace_s: Optional[float] = None,
+        on_event: Optional[Callable[[str, Any, int], None]] = None,
+        clock=time.monotonic,
+    ):
+        self.plane = str(plane)
+        self.evict_after_s = float(evict_after_s)
+        # eviction ARMS on the first progress-carrying beat (step >= 1)
+        # — the watchdog's arm-on-first-tick discipline: a fresh member
+        # spends arbitrarily long compiling before its first exchange,
+        # and that warmup must not read as death.  Until armed, the
+        # (much longer) join grace applies, so a member that dies
+        # during warmup still cannot wedge its plane forever.
+        self.join_grace_s = (
+            float(join_grace_s) if join_grace_s is not None
+            else 10.0 * self.evict_after_s
+        )
+        self.clock = clock
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._members: Dict[Any, _Member] = {}
+        # ranks that were ever evicted/left and have not rejoined —
+        # lets callers distinguish "never seen" from "came back"
+        self._departed: Dict[Any, int] = {}  # rank -> last generation
+        self.n_evictions = 0
+        self.n_rejoins = 0
+
+    # ---- membership transitions --------------------------------------
+    def join(self, member: Any) -> int:
+        """Register (or RE-admit) ``member``; returns its generation.
+
+        A join of a current member is a re-admission too (the worker
+        restarted faster than the eviction window): its state is reset
+        and the generation bumps, exactly as if it had been evicted
+        first — the old incarnation's residuals must not survive."""
+        now = self.clock()
+        with self._lock:
+            prev = self._members.pop(member, None)
+            prev_gen = (
+                prev.generation if prev is not None
+                else self._departed.pop(member, None)
+            )
+            gen = (prev_gen or 0) + 1
+            self._members[member] = _Member(gen, now)
+            n = len(self._members)
+            rejoin = prev_gen is not None
+            if rejoin:
+                self.n_rejoins += 1
+        _JOINS.inc(plane=self.plane)
+        if rejoin:
+            _REJOINS.inc(plane=self.plane)
+        _MEMBERS.set(n, plane=self.plane)
+        self._emit("rejoin" if rejoin else "join", member, gen)
+        return gen
+
+    def beat(self, member: Any, step: Optional[int] = None) -> bool:
+        """Record liveness (piggybacked on an exchange/gossip frame).
+        Returns False when ``member`` is unknown — the caller decides
+        whether that means auto-join (gossip: any frame proves life) or
+        re-admission-required (EASGD: the server must reset state
+        first)."""
+        now = self.clock()
+        with self._lock:
+            m = self._members.get(member)
+            if m is None:
+                return False
+            m.last_beat_mono = now
+            m.beats += 1
+            if step is not None:
+                step = int(step)
+                if m.first_step is None:
+                    m.first_step = step
+                    m.first_step_mono = now
+                m.last_step = step
+        return True
+
+    def leave(self, member: Any) -> None:
+        """Clean departure (done/final) — no eviction alert."""
+        with self._lock:
+            m = self._members.pop(member, None)
+            if m is None:
+                return
+            self._departed[member] = m.generation
+            n = len(self._members)
+            gen = m.generation
+        _LEAVES.inc(plane=self.plane)
+        _MEMBERS.set(n, plane=self.plane)
+        self._emit("leave", member, gen)
+
+    def sweep(self, now: Optional[float] = None) -> List[Any]:
+        """Evict every member silent past ``evict_after_s``; returns
+        the evicted ranks (their per-member state is freed here)."""
+        now = self.clock() if now is None else now
+        evicted = []
+        with self._lock:
+            for member, m in list(self._members.items()):
+                armed = (m.last_step or 0) >= 1
+                window = self.evict_after_s if armed else self.join_grace_s
+                if now - m.last_beat_mono > window:
+                    del self._members[member]
+                    self._departed[member] = m.generation
+                    m.state.clear()  # EF residuals die with the member
+                    evicted.append((member, m.generation))
+            n = len(self._members)
+            self.n_evictions += len(evicted)
+        for member, gen in evicted:
+            _EVICTIONS.inc(plane=self.plane, rank=str(member))
+            self._emit("evict", member, gen)
+        if evicted:
+            _MEMBERS.set(n, plane=self.plane)
+        return [member for member, _ in evicted]
+
+    def _emit(self, kind: str, member: Any, generation: int) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(kind, member, generation)
+        except Exception as e:  # an event hook must never kill membership
+            print(
+                f"membership event hook failed ({kind} {member}): "
+                f"{type(e).__name__}: {e}",
+                flush=True,
+            )
+
+    # ---- queries -----------------------------------------------------
+    def is_member(self, member: Any) -> bool:
+        with self._lock:
+            return member in self._members
+
+    def members(self) -> List[Any]:
+        with self._lock:
+            return list(self._members)
+
+    def generation(self, member: Any) -> Optional[int]:
+        with self._lock:
+            m = self._members.get(member)
+            return None if m is None else m.generation
+
+    def state(self, member: Any) -> Optional[Dict[str, Any]]:
+        """The member's connection-state dict (EF residuals live here;
+        freed on evict/leave, fresh on rejoin).  None for non-members —
+        callers must treat that as re-admission-required."""
+        with self._lock:
+            m = self._members.get(member)
+            return None if m is None else m.state
+
+    def step_rates(self) -> Dict[Any, float]:
+        now = self.clock()
+        with self._lock:
+            out = {}
+            for member, m in self._members.items():
+                r = m.step_rate(now)
+                if r is not None:
+                    out[member] = r
+            return out
+
+    def straggler_index(self, member: Any) -> Optional[float]:
+        """Relative slowness in [0, 1): ``1 - rate/max_rate`` — 0 for
+        the fastest rank, →1 for a stalled one.  The same shape as the
+        trace doctor's per-rank straggler index, measured from beats
+        instead of spans (the roster cannot see inside steps, only the
+        cadence between exchanges)."""
+        rates = self.step_rates()
+        r = rates.get(member)
+        if r is None or not rates:
+            return None
+        fastest = max(rates.values())
+        if fastest <= 0:
+            return None
+        return max(0.0, 1.0 - r / fastest)
+
+
+class TauController:
+    """Straggler-adaptive EASGD τ — equalize exchange WALL cadence.
+
+    With a fixed τ in iterations, a 2× straggler exchanges at half the
+    wall frequency of its peers: its pulls are staler and its share of
+    the center drifts.  This controller scales each worker's τ by its
+    relative step rate — ``τ_i = clamp(round(τ0 · rate_i / median),
+    τ_min, τ_max)`` — so every rank meets the server at roughly the
+    same wall interval: stragglers exchange after FEWER local steps
+    (fresher, per the elastic-averaging staleness bound), fast ranks
+    after more (less serialization at the server, the reference's
+    known bottleneck)."""
+
+    def __init__(
+        self,
+        base_tau: int,
+        roster: Roster,
+        tau_min: Optional[int] = None,
+        tau_max: Optional[int] = None,
+    ):
+        self.base_tau = max(1, int(base_tau))
+        self.roster = roster
+        self.tau_min = int(tau_min) if tau_min else max(1, self.base_tau // 4)
+        self.tau_max = int(tau_max) if tau_max else self.base_tau * 4
+
+    def tau_for(self, member: Any) -> int:
+        rates = self.roster.step_rates()
+        r = rates.get(member)
+        if r is None or len(rates) < 2:
+            return self.base_tau  # no signal yet: keep the static τ
+        ordered = sorted(rates.values())
+        median = ordered[len(ordered) // 2]
+        if median <= 0:
+            return self.base_tau
+        tau = int(round(self.base_tau * (r / median)))
+        return max(self.tau_min, min(self.tau_max, tau))
+
+
+def retry_with_backoff(
+    fn: Callable[[], Any],
+    attempts: int = 3,
+    base_backoff_s: float = 0.1,
+    max_backoff_s: float = 2.0,
+    retry_on=(ConnectionError, OSError, TimeoutError),
+    rng: Optional[random.Random] = None,
+    counter_labels: Optional[dict] = None,
+):
+    """Call ``fn`` with a bounded retry budget and jittered exponential
+    backoff.  Re-raises the LAST error once the budget is exhausted —
+    the caller is expected to catch it and degrade (count a local step,
+    never raise into the train loop).  Each retry (not the first
+    attempt) increments ``membership_exchange_retries_total``."""
+    rng = rng or random
+    attempts = max(1, int(attempts))
+    delay = float(base_backoff_s)
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on:
+            if attempt + 1 >= attempts:
+                raise
+            _RETRIES.inc(**(counter_labels or {}))
+            # full jitter: 50–150% of the nominal delay, capped
+            time.sleep(min(max_backoff_s, delay) * (0.5 + rng.random()))
+            delay *= 2.0
+
+
+def count_degraded_step(rule: str, rank) -> None:
+    """One local SGD step taken while the exchange counterpart was
+    unreachable — the accounting half of degraded mode."""
+    _DEGRADED.inc(rule=rule, rank=str(rank))

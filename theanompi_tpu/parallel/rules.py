@@ -112,7 +112,15 @@ class _AsyncRule(Rule):
 
 class EASGD(_AsyncRule):
     """Elastic-averaging SGD (reference ``async_rule.EASGD``): N workers
-    on disjoint device subsets + a host-level center-variable server."""
+    on disjoint device subsets + a host-level center-variable server.
+
+    Elastic extras (forwarded to ``EASGD_Driver`` through
+    ``init(**kwargs)``): ``adaptive_tau=True`` turns on straggler-
+    adaptive per-worker exchange periods (``membership.TauController``
+    — exchange wall cadence equalized across unequal device subsets).
+    The cross-process spelling (``launch.py --dist-*``) adds heartbeat
+    eviction and checkpointless re-admission on top; see
+    docs/elasticity.md."""
 
     @property
     def driver_cls(self):
@@ -123,7 +131,14 @@ class EASGD(_AsyncRule):
 
 class GOSGD(_AsyncRule):
     """Gossip SGD (reference ``async_rule.GOSGD``): N peer workers with
-    randomized host-level pushes, no server."""
+    randomized host-level pushes, no server.
+
+    Cross-process peers (``launch.py --dist-*``) run under elastic
+    membership: hello/bye liveness beacons, heartbeat eviction from
+    every peer's push table, straggler-biased peer selection, and
+    snapshot-pull re-admission for respawned ranks (docs/elasticity.md).
+    The in-process driver keeps the lossless shared mailbox and needs
+    none of it."""
 
     @property
     def driver_cls(self):

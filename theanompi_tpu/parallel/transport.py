@@ -53,6 +53,10 @@ _REQ_ERRORS = _REG.counter(
     "transport_request_errors_total",
     "request/reply failures (stage label: io/handler)",
 )
+_REQ_RETRIES = _REG.counter(
+    "transport_request_retries_total",
+    "request() connect attempts beyond the first",
+)
 _HANDLER_LAT = _REG.histogram(
     "transport_handler_seconds",
     "TcpServerChannel handler latency (decode excluded)",
@@ -492,7 +496,40 @@ class TcpServerChannel:
             pass
 
 
-def request(address: Tuple[str, int], msg: Any, timeout: float = 600.0) -> Any:
+def _connect_with_retry(
+    address, timeout: float, connect_retries: int, retry_backoff_s: float
+) -> socket.socket:
+    """Bounded, jittered connect for ``request()``.  Only the CONNECT
+    leg retries: a refused/timed-out connect provably never reached the
+    handler, so a retry cannot double-apply a non-idempotent exchange
+    (a failure after the request frame was written still propagates —
+    the caller owns that semantic).  Momentary refusals (server
+    restarting mid-promotion, listener backlog burst) stop being
+    instant caller-visible failures; retries are counted in
+    ``transport_request_retries_total``."""
+    import random
+
+    attempts = max(1, int(connect_retries) + 1)
+    delay = float(retry_backoff_s)
+    for attempt in range(attempts):
+        try:
+            return socket.create_connection(tuple(address), timeout=timeout)
+        except (ConnectionError, OSError, socket.timeout):
+            if attempt + 1 >= attempts:
+                raise
+            _REQ_RETRIES.inc(transport="request")
+            time.sleep(delay * (0.5 + random.random()))  # full jitter
+            delay = min(2.0, delay * 2.0)
+    raise AssertionError("unreachable")
+
+
+def request(
+    address: Tuple[str, int],
+    msg: Any,
+    timeout: float = 600.0,
+    connect_retries: int = 2,
+    retry_backoff_s: float = 0.05,
+) -> Any:
     """Client half of TcpServerChannel: one framed request, one reply."""
     from theanompi_tpu.parallel import wire
 
@@ -507,7 +544,9 @@ def request(address: Tuple[str, int], msg: Any, timeout: float = 600.0) -> Any:
         fid, msg = _flow_wrap(_RPC_SEQ, obs.get_tracer().pid, msg)
         try:
             payload = wire.encode(msg)
-            with socket.create_connection(tuple(address), timeout=timeout) as s:
+            with _connect_with_retry(
+                address, timeout, connect_retries, retry_backoff_s
+            ) as s:
                 send_frame(s, payload)
                 # arrow tail only after the write lands — a refused
                 # connection must not leave a one-sided arrow

@@ -1,0 +1,300 @@
+"""The committed chaos drill — kill → evict → respawn → re-admit.
+
+``python -m theanompi_tpu.runtime.chaos`` rehearses the elastic
+membership story (docs/elasticity.md) end-to-end on real OS processes:
+
+1. an UNINTERRUPTED baseline run of the async rule (the loss yardstick),
+2. the CHAOS run: the same fleet under :func:`spawn_elastic`, with a
+   ``kill`` fault injected into one worker mid-run
+   (``THEANOMPI_FAULT_PLAN`` → ``FaultInjector``).  The dead rank must
+   be EVICTED by its server/peers (exactly one eviction observed at the
+   anchor), the supervisor respawns it, and the fresh incarnation must
+   RE-ADMIT checkpointlessly (EASGD center pull / GOSGD peer snapshot).
+
+The verdict is JSON on stdout; exit 1 on any violation:
+
+- the anchor (EASGD server / GOSGD consensus rank) must finish clean —
+  an exception propagating into a surviving rank fails the drill,
+- exactly ``1`` eviction and ``>= 1`` re-admission per kill,
+- final validation loss within tolerance of the uninterrupted baseline
+  (``chaos <= baseline + max(abs_tol, rel_tol * |baseline|)`` — one
+  sided: elasticity must not cost convergence, beating the baseline is
+  fine).
+
+This module is what ``scripts/perf_gate.sh``'s chaos leg runs
+(``PERF_GATE_CHAOS=1``); tests smoke the gate plumbing on fixture
+verdicts and run the EASGD drill for real under the ``distributed``
+marker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+# small enough to drill in CI, big enough that the fleet provably
+# outlives the kill->evict->respawn->rejoin sequence: the dataset is
+# SHARDED across workers (n_synth_train / batch / workers iterations
+# per worker epoch), and the respawned rank must rejoin a job that is
+# still running
+DEFAULT_CONFIG = {
+    "batch_size": 16,
+    "n_synth_train": 384,
+    "n_synth_val": 64,
+    "dropout_rate": 0.0,
+    "print_freq": 1000,
+    "comm_probe": False,
+    "seed": 5,
+}
+
+
+def _read_rows(path: str) -> List[dict]:
+    rows = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue  # truncated tail row
+    except OSError:
+        pass
+    return rows
+
+
+def _last_val_cost(path: str) -> Optional[float]:
+    costs = [r["cost"] for r in _read_rows(path) if r.get("kind") == "val"]
+    return float(costs[-1]) if costs else None
+
+
+def _membership_counts(path: str) -> Dict[str, int]:
+    """Evictions/rejoins the ANCHOR observed, plus the server-side
+    re-admission count from the summary row."""
+    out = {"evictions": 0, "rejoins": 0, "readmissions": 0}
+    for r in _read_rows(path):
+        if r.get("kind") == "membership":
+            if r.get("event") == "evict":
+                out["evictions"] += 1
+            elif r.get("event") == "rejoin":
+                out["rejoins"] += 1
+        elif r.get("kind") == "membership_summary":
+            out["readmissions"] = int(r.get("readmissions", 0) or 0)
+            out.setdefault("summary", r)
+    return out
+
+
+def _anchor_record(rule: str, ckpt_dir: str) -> str:
+    name = "record_server.jsonl" if rule == "EASGD" else "record_rank0.jsonl"
+    return os.path.join(ckpt_dir, name)
+
+
+def run_drill(
+    rule: str = "EASGD",
+    n_procs: int = 3,
+    kill_rank: int = 1,
+    kill_iter: int = 10,
+    rejoin_after_s: float = 10.0,
+    heartbeat_timeout: float = 6.0,
+    slow_iter_s: float = 0.75,
+    n_epochs: int = 3,
+    tau: int = 1,
+    p_push: float = 0.5,
+    tolerance_rel: float = 0.5,
+    tolerance_abs: float = 0.25,
+    workdir: str = "/tmp/theanompi_chaos",
+    timeout: float = 900.0,
+    env_extra: Optional[Dict[str, str]] = None,
+    run_baseline: bool = True,
+    modelfile: str = "theanompi_tpu.models.cifar10",
+    modelclass: str = "Cifar10_model",
+    config_overrides: Optional[dict] = None,
+) -> dict:
+    """One rule's kill-evict-respawn-readmit drill; returns the verdict
+    dict (``ok`` + ``violations`` + the numbers behind them)."""
+    from theanompi_tpu.runtime.multiprocess import (
+        find_free_port,
+        spawn_elastic,
+        spawn_local,
+    )
+
+    if rule not in ("EASGD", "GOSGD"):
+        raise ValueError(f"rule must be EASGD or GOSGD, not {rule!r}")
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(config_overrides or {})
+    base_dir = os.path.join(workdir, f"{rule.lower()}_baseline")
+    chaos_dir = os.path.join(workdir, f"{rule.lower()}_chaos")
+    for d in (base_dir, chaos_dir):
+        os.makedirs(d, exist_ok=True)
+
+    def _argv(ckpt_dir: str) -> List[str]:
+        argv = [
+            "--rule", rule,
+            "--modelfile", modelfile,
+            "--modelclass", modelclass,
+            "--config", json.dumps(dict(cfg, n_epochs=n_epochs)),
+            "--checkpoint-dir", ckpt_dir,
+            "--async-port-base", str(find_free_port()),
+            "--heartbeat-timeout", str(heartbeat_timeout),
+        ]
+        if rule == "EASGD":
+            argv += ["--tau", str(tau), "--duties-coalesce", "0"]
+        else:
+            argv += ["--p-push", str(p_push)]
+        return argv
+
+    verdict: dict = {
+        "rule": rule,
+        "n_procs": n_procs,
+        "kill_rank": kill_rank,
+        "kill_iter": kill_iter,
+        "violations": [],
+    }
+
+    if run_baseline:
+        spawn_local(
+            n_procs, _argv(base_dir), local_device_count=1,
+            env_extra=env_extra, timeout=timeout, stream_output=False,
+        )
+        verdict["baseline_loss"] = _last_val_cost(
+            _anchor_record(rule, base_dir)
+        )
+
+    # the fault plan: the kill, plus a per-iteration slowdown on every
+    # non-anchor rank.  The slowdown is WALL-CLOCK only (no math
+    # changes) and exists to keep the fleet alive long enough for the
+    # respawned rank to rejoin a still-running job — a CI-sized run
+    # would otherwise finish inside the respawn window.  The respawn
+    # itself runs at full speed (the supervisor strips the plan).
+    plan = [f"kill@{kill_rank}:{kill_iter}"]
+    if slow_iter_s:
+        for r in range(1, n_procs):
+            plan.append(f"slow@{r}:1:{slow_iter_s}")
+    report = spawn_elastic(
+        n_procs,
+        _argv(chaos_dir),
+        local_device_count=1,
+        env_extra=dict(
+            env_extra or {},
+            THEANOMPI_FAULT_PLAN=";".join(plan),
+        ),
+        timeout=timeout,
+        stream_output=False,
+        restarts_per_rank=1,
+        restart_delay_s=rejoin_after_s,
+    )
+    verdict["restarts"] = report["restarts"]
+    verdict["kills_observed"] = report["kills_observed"]
+    verdict["exit_codes"] = report["exit_codes"]
+    verdict["chaos_loss"] = _last_val_cost(_anchor_record(rule, chaos_dir))
+    verdict.update(_membership_counts(_anchor_record(rule, chaos_dir)))
+
+    # ---- the acceptance criteria, as violations ----------------------
+    v = verdict["violations"]
+    if report["kills_observed"] < 1:
+        v.append("the injected kill never fired (no rank died)")
+    if report["restarts"].get(kill_rank, 0) < 1:
+        v.append(f"killed rank {kill_rank} was never respawned")
+    if verdict["evictions"] != report["kills_observed"]:
+        v.append(
+            f"expected exactly one eviction per kill, saw "
+            f"{verdict['evictions']} eviction(s) for "
+            f"{report['kills_observed']} kill(s)"
+        )
+    if verdict["rejoins"] + verdict["readmissions"] < 1:
+        v.append("the respawned rank never re-admitted")
+    surviving_bad = {
+        r: c for r, c in report["exit_codes"].items()
+        if c not in (0, None) and int(r) != kill_rank
+    }
+    if surviving_bad:
+        v.append(
+            f"surviving ranks exited nonzero (an exception propagated "
+            f"into a train loop?): {surviving_bad}"
+        )
+    if verdict["chaos_loss"] is None:
+        v.append("chaos run produced no validation row")
+    if run_baseline:
+        base_loss = verdict.get("baseline_loss")
+        if base_loss is None:
+            v.append("baseline run produced no validation row")
+        elif verdict["chaos_loss"] is not None:
+            tol = max(tolerance_abs, tolerance_rel * abs(base_loss))
+            verdict["loss_tolerance"] = round(tol, 6)
+            verdict["loss_delta"] = round(
+                verdict["chaos_loss"] - base_loss, 6
+            )
+            if verdict["loss_delta"] > tol:
+                v.append(
+                    f"chaos loss {verdict['chaos_loss']:.4f} exceeds "
+                    f"baseline {base_loss:.4f} by {verdict['loss_delta']:.4f} "
+                    f"(> tolerance {tol:.4f}) — recovery cost convergence"
+                )
+    verdict["ok"] = not v
+    return verdict
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="theanompi_tpu.runtime.chaos", description=__doc__
+    )
+    p.add_argument("--rule", action="append", choices=["EASGD", "GOSGD"],
+                   help="drill this rule (repeatable; default: EASGD)")
+    p.add_argument("--n-procs", type=int, default=3)
+    p.add_argument("--kill-rank", type=int, default=1)
+    p.add_argument("--kill-iter", type=int, default=10)
+    p.add_argument("--rejoin-after", type=float, default=10.0,
+                   help="supervisor delay before respawning the kill — "
+                   "keep rejoin-after + process startup ABOVE "
+                   "--heartbeat-timeout so the eviction provably "
+                   "precedes the re-admission")
+    p.add_argument("--heartbeat-timeout", type=float, default=6.0)
+    p.add_argument("--slow-iter", type=float, default=0.75,
+                   help="wall-clock slowdown per iteration injected "
+                   "into the surviving ranks so the run outlives the "
+                   "respawn window (no math changes)")
+    p.add_argument("--n-epochs", type=int, default=3)
+    p.add_argument("--tolerance-rel", type=float, default=0.5)
+    p.add_argument("--tolerance-abs", type=float, default=0.25)
+    p.add_argument("--workdir", default="/tmp/theanompi_chaos")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--no-baseline", action="store_true",
+                   help="skip the uninterrupted run (no loss gate)")
+    args = p.parse_args(argv)
+
+    out = {"rules": {}, "ok": True}
+    for rule in args.rule or ["EASGD"]:
+        verdict = run_drill(
+            rule=rule,
+            n_procs=args.n_procs,
+            kill_rank=args.kill_rank,
+            kill_iter=args.kill_iter,
+            rejoin_after_s=args.rejoin_after,
+            heartbeat_timeout=args.heartbeat_timeout,
+            slow_iter_s=args.slow_iter,
+            n_epochs=args.n_epochs,
+            tolerance_rel=args.tolerance_rel,
+            tolerance_abs=args.tolerance_abs,
+            workdir=args.workdir,
+            timeout=args.timeout,
+            run_baseline=not args.no_baseline,
+        )
+        out["rules"][rule] = verdict
+        out["ok"] = out["ok"] and verdict["ok"]
+        for viol in verdict["violations"]:
+            print(f"[chaos] {rule} VIOLATION: {viol}", file=sys.stderr,
+                  flush=True)
+    print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

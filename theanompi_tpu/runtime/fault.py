@@ -27,17 +27,107 @@ class TrainingFault(RuntimeError):
 
 
 class FaultInjector:
-    """Raise ``TrainingFault`` at configured (rank, iteration) points."""
+    """Deterministic fault plan fired at (rank, iteration) points.
+
+    Plan entries are ``(rank, iteration)`` (back-compat: mode
+    ``'raise'``) or ``(rank, iteration, mode[, arg])`` with mode one of:
+
+    - ``'raise'`` — raise :class:`TrainingFault` (a crash the worker's
+      own exception handling sees; restart-from-checkpoint territory).
+    - ``'kill'``  — ``os._exit(KILL_EXIT_CODE)``: the process dies with
+      no Python-level cleanup, the closest in-process stand-in for a
+      preemption/SIGKILL.  The elastic membership drill's weapon: the
+      server/peers must EVICT the rank and a respawn must RE-ADMIT it.
+    - ``'hang'``  — block this iteration for ``arg`` seconds (default
+      3600): the failure crashes can't model; only the stall watchdog
+      or heartbeat eviction sees it.
+    - ``'slow'``  — from this iteration ON, sleep ``arg`` seconds
+      (default 0.05) every iteration: a persistent straggler, the
+      signal adaptive τ / gossip peer bias react to.
+
+    Each entry fires once; ``'slow'`` stays latched after firing.
+    """
+
+    KILL_EXIT_CODE = 77  # distinct from crashes AND the watchdog's 86
+
+    MODES = ("raise", "kill", "hang", "slow")
 
     def __init__(self, plan):
-        # plan: iterable of (rank, iteration) pairs, each fires once
-        self._plan = set(tuple(p) for p in plan)
+        self._plan = {}
+        for p in plan:
+            p = tuple(p)
+            rank, iteration = int(p[0]), int(p[1])
+            mode = str(p[2]) if len(p) > 2 else "raise"
+            if mode not in self.MODES:
+                raise ValueError(
+                    f"fault mode must be one of {self.MODES}, got {mode!r}"
+                )
+            arg = float(p[3]) if len(p) > 3 else None
+            self._plan[(rank, iteration)] = (mode, arg)
+        self._slow: dict = {}  # rank -> per-iteration delay, latched
+
+    @classmethod
+    def from_env(cls, rank=None, env=None) -> "FaultInjector | None":
+        """``THEANOMPI_FAULT_PLAN="kill@1:40;slow@2:10:0.05"`` — the
+        spelling the elastic supervisor hands spawned processes (one
+        ``mode@rank:iter[:arg]`` per ``;``).  ``rank`` filters the plan
+        to entries for this process; returns None when nothing applies
+        (the hot loop then skips the injector entirely)."""
+        import os as _os
+
+        spec = ((env or _os.environ).get("THEANOMPI_FAULT_PLAN") or "").strip()
+        if not spec:
+            return None
+        plan = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                mode, _, rest = part.partition("@")
+                fields = rest.split(":")
+                r, it = int(fields[0]), int(fields[1])
+                entry = [r, it, mode.strip()]
+                if len(fields) > 2:
+                    entry.append(float(fields[2]))
+            except (ValueError, IndexError):
+                raise ValueError(
+                    f"THEANOMPI_FAULT_PLAN: cannot parse {part!r} "
+                    "(want mode@rank:iter[:arg])"
+                )
+            if rank is None or r == int(rank):
+                plan.append(entry)
+        return cls(plan) if plan else None
 
     def maybe_fail(self, rank: int, iteration: int) -> None:
-        key = (rank, iteration)
-        if key in self._plan:
-            self._plan.discard(key)
-            raise TrainingFault(f"injected fault at rank={rank} iter={iteration}")
+        delay = self._slow.get(rank)
+        if delay:
+            time.sleep(delay)
+        key = (int(rank), int(iteration))
+        entry = self._plan.pop(key, None)
+        if entry is None:
+            return
+        mode, arg = entry
+        if mode == "raise":
+            raise TrainingFault(
+                f"injected fault at rank={rank} iter={iteration}"
+            )
+        if mode == "kill":
+            import os as _os
+            import sys as _sys
+
+            print(
+                f"FAULT: killing rank {rank} at iter {iteration} "
+                f"(exit {self.KILL_EXIT_CODE})",
+                file=_sys.stderr, flush=True,
+            )
+            _sys.stderr.flush()
+            _os._exit(self.KILL_EXIT_CODE)
+        if mode == "hang":
+            time.sleep(3600.0 if arg is None else arg)
+            return
+        # slow: latch the per-iteration delay from here on
+        self._slow[int(rank)] = 0.05 if arg is None else arg
 
 
 class Watchdog:

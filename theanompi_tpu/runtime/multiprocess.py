@@ -37,6 +37,38 @@ def find_free_port() -> int:
         return s.getsockname()[1]
 
 
+def _child_cmd(argv: Sequence[str], port: int, n_procs: int, rank: int):
+    return [
+        sys.executable,
+        "-m",
+        "theanompi_tpu.launch",
+        *argv,
+        "--dist-coordinator",
+        f"localhost:{port}",
+        "--dist-nprocs",
+        str(n_procs),
+        "--dist-rank",
+        str(rank),
+    ]
+
+
+def _spawn_env(local_device_count: int,
+               env_extra: Optional[Dict[str, str]]) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    # children control their own fake-device count (strip any inherited
+    # setting, e.g. the 8-device test-rig flag)
+    flags = " ".join(
+        f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={local_device_count}"
+    ).strip()
+    env.update(env_extra or {})
+    return env
+
+
 def spawn_local(
     n_procs: int,
     argv: Sequence[str],
@@ -56,36 +88,13 @@ def spawn_local(
     failed (after terminating the rest).
     """
     port = find_free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    # children control their own fake-device count (strip any inherited
-    # setting, e.g. the 8-device test-rig flag)
-    flags = " ".join(
-        f for f in flags.split() if "xla_force_host_platform_device_count" not in f
-    )
-    env["XLA_FLAGS"] = (
-        f"{flags} --xla_force_host_platform_device_count={local_device_count}"
-    ).strip()
-    env.update(env_extra or {})
+    env = _spawn_env(local_device_count, env_extra)
 
     procs = []
     for rank in range(n_procs):
-        cmd = [
-            sys.executable,
-            "-m",
-            "theanompi_tpu.launch",
-            *argv,
-            "--dist-coordinator",
-            f"localhost:{port}",
-            "--dist-nprocs",
-            str(n_procs),
-            "--dist-rank",
-            str(rank),
-        ]
         procs.append(
             subprocess.Popen(
-                cmd,
+                _child_cmd(argv, port, n_procs, rank),
                 env=env,
                 stdout=None if stream_output else subprocess.DEVNULL,
                 stderr=subprocess.STDOUT if not stream_output else None,
@@ -123,3 +132,147 @@ def spawn_local(
     if any(c != 0 for c in codes):
         raise RuntimeError(f"distributed launch failed: exit codes {codes}")
     return [int(c) for c in codes]
+
+
+def spawn_elastic(
+    n_procs: int,
+    argv: Sequence[str],
+    local_device_count: int = 1,
+    env_extra: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = 900.0,
+    stream_output: bool = True,
+    restarts_per_rank: int = 1,
+    restart_delay_s: float = 0.5,
+    late_join: Optional[Dict[int, float]] = None,
+    anchor_rank: int = 0,
+) -> dict:
+    """The ELASTIC supervisor — ``spawn_local`` for preemptible fleets.
+
+    Same child command lines as :func:`spawn_local`, different contract:
+
+    - a child that DIES (nonzero exit, SIGKILL, chaos ``kill`` fault)
+      is RESPAWNED on the same rank after ``restart_delay_s``, up to
+      ``restarts_per_rank`` times.  The replacement gets
+      ``THEANOMPI_ELASTIC_REJOIN=1`` (the async entrypoints read it:
+      EASGD re-pulls the center, GOSGD starts at zero weight and pulls
+      a peer snapshot — checkpointless recovery) and the fault-plan env
+      is STRIPPED so an injected kill cannot re-fire in the fresh
+      incarnation.
+    - ``late_join`` maps rank → delay seconds: those ranks start
+      mid-run — the join half of elastic membership.
+    - the run ends when ``anchor_rank`` (the EASGD server / GOSGD
+      consensus rank) exits: remaining children get a grace period,
+      then are terminated; a dead worker near the finish line is NOT
+      respawned once the anchor is gone.
+
+    Only meaningful for the async rules (``--rule EASGD/GOSGD``): a BSP
+    process group shares one jax.distributed world and cannot lose
+    members.  Returns a report dict: ``{"exit_codes", "restarts":
+    {rank: n}, "kills_observed"}``.  Raises RuntimeError when the
+    anchor fails or a rank exhausts its restart budget with a nonzero
+    exit.
+    """
+    port = find_free_port()
+    env = _spawn_env(local_device_count, env_extra)
+    rejoin_env = dict(env)
+    rejoin_env["THEANOMPI_ELASTIC_REJOIN"] = "1"
+    rejoin_env.pop("THEANOMPI_FAULT_PLAN", None)
+    late_join = dict(late_join or {})
+    start_mono = time.monotonic()
+
+    def _popen(rank: int, e: Dict[str, str]) -> subprocess.Popen:
+        return subprocess.Popen(
+            _child_cmd(argv, port, n_procs, rank),
+            env=e,
+            stdout=None if stream_output else subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if not stream_output else None,
+        )
+
+    procs: Dict[int, Optional[subprocess.Popen]] = {}
+    for rank in range(n_procs):
+        if rank in late_join:
+            procs[rank] = None  # joins once its delay elapses
+        else:
+            procs[rank] = _popen(rank, env)
+    restarts: Dict[int, int] = {}
+    kills = 0
+    codes: Dict[int, Optional[int]] = {r: None for r in range(n_procs)}
+    deadline = start_mono + timeout if timeout else None
+    anchor_done = False
+    try:
+        while True:
+            now = time.monotonic()
+            # late joiners whose delay elapsed
+            for rank, delay in list(late_join.items()):
+                if now - start_mono >= delay and not anchor_done:
+                    print(f"[elastic] rank {rank}: late join after "
+                          f"{delay:.1f}s", flush=True)
+                    procs[rank] = _popen(rank, env)
+                    del late_join[rank]
+            for rank, p in procs.items():
+                if p is None:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                codes[rank] = rc
+                if rank == anchor_rank:
+                    anchor_done = True
+                    continue
+                if rc != 0 and not anchor_done:
+                    kills += 1
+                    used = restarts.get(rank, 0)
+                    if used < restarts_per_rank:
+                        restarts[rank] = used + 1
+                        print(
+                            f"[elastic] rank {rank} died (exit {rc}) — "
+                            f"respawning for rejoin "
+                            f"({restarts[rank]}/{restarts_per_rank})",
+                            flush=True,
+                        )
+                        time.sleep(restart_delay_s)
+                        procs[rank] = _popen(rank, rejoin_env)
+                        codes[rank] = None
+                    else:
+                        raise RuntimeError(
+                            f"elastic launch: rank {rank} exhausted its "
+                            f"restart budget (last exit {rc})"
+                        )
+            if anchor_done:
+                break
+            if deadline and time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"elastic launch timed out after {timeout}s "
+                    f"(exit codes so far: {codes})"
+                )
+            time.sleep(0.2)
+        # anchor exited: give the rest a short grace, then reap
+        grace = time.monotonic() + 30.0
+        for rank, p in procs.items():
+            if p is None or codes[rank] is not None:
+                continue
+            try:
+                codes[rank] = p.wait(timeout=max(0.1, grace - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.terminate()
+    finally:
+        for rank, p in procs.items():
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for rank, p in procs.items():
+            if p is not None and codes[rank] is None:
+                try:
+                    codes[rank] = p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    codes[rank] = p.wait()
+    if codes.get(anchor_rank) != 0:
+        raise RuntimeError(
+            f"elastic launch: anchor rank {anchor_rank} failed "
+            f"(exit codes {codes})"
+        )
+    return {
+        "exit_codes": {r: codes[r] for r in sorted(codes)},
+        "restarts": restarts,
+        "kills_observed": kills,
+    }
