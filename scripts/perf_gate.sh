@@ -68,6 +68,21 @@
 #   PERF_GATE_SERVE_MIN_CONCURRENCY_RATIO  minimum measured paged-vs-
 #                           contiguous equal-memory concurrency ratio
 #                           under the long-tail workload (default 2.0)
+#   PERF_GATE_SPEC          1 (default) = decode-speed acceptance on the
+#                           serve JSON (ISSUE 11): speculative greedy
+#                           decode MUST be token-identical to plain
+#                           greedy, its acceptance rate must clear the
+#                           floor, int8 KV blocks must at least double
+#                           per-chip capacity at equal bytes, and the
+#                           quantized-cache greedy drift must stay
+#                           bounded.  0 = skip (escape hatch).
+#   PERF_GATE_SERVE_MIN_ACCEPT     minimum spec-decode acceptance rate
+#                           (default 0.2 — a draft below this wastes
+#                           every verify dispatch)
+#   PERF_GATE_SERVE_MIN_KV_RATIO   minimum int8/fp32 blocks-per-chip
+#                           ratio at equal cache bytes (default 2.0)
+#   PERF_GATE_SERVE_MAX_KV_DRIFT   maximum fraction of greedy tokens
+#                           the int8 cache may change (default 0.3)
 #
 # Chaos leg (the elastic-membership drill; docs/elasticity.md):
 #   PERF_GATE_CHAOS         1 (default) = run the kill-evict-respawn-readmit
@@ -318,6 +333,49 @@ if fed is None or no_reuse is None or fed >= no_reuse:
 print(f"[perf_gate] paged: ratio {ratio}, prefix hit_rate {hit_rate}, "
       f"prefill {fed} vs {no_reuse} tokens", file=sys.stderr)
 PY
+    # 5d. decode-speed acceptance (ISSUE 11): speculative decoding must
+    # be token-exact and actually accepted; quantized KV must buy real
+    # capacity without drifting greedy outputs
+    if [ "${PERF_GATE_SPEC:-1}" = "1" ]; then
+        MIN_ACCEPT="${PERF_GATE_SERVE_MIN_ACCEPT:-0.2}"
+        MIN_KV_RATIO="${PERF_GATE_SERVE_MIN_KV_RATIO:-2.0}"
+        MAX_KV_DRIFT="${PERF_GATE_SERVE_MAX_KV_DRIFT:-0.3}"
+        echo "[perf_gate] spec acceptance: token-identical, accept >= $MIN_ACCEPT; kv ratio >= $MIN_KV_RATIO, drift <= $MAX_KV_DRIFT" >&2
+        python - "$SERVE_JSON" "$MIN_ACCEPT" "$MIN_KV_RATIO" "$MAX_KV_DRIFT" <<'PY'
+import json, sys
+sys.path.insert(0, "scripts")
+from bench_compare import extract_bench
+doc = extract_bench(open(sys.argv[1]).read()) or {}
+min_accept, min_ratio, max_drift = map(float, sys.argv[2:5])
+spec = (doc.get("detail") or {}).get("spec")
+if not isinstance(spec, dict):
+    sys.exit("[perf_gate] SPEC VIOLATION: serve bench JSON has no "
+             "detail.spec section (paged bench should emit it)")
+if spec.get("token_identical") is not True:
+    sys.exit("[perf_gate] SPEC VIOLATION: speculative greedy decode is "
+             "NOT token-identical to plain greedy — the acceptance "
+             "logic is using unverified context")
+rate = spec.get("accept_rate")
+if rate is None or rate < min_accept:
+    sys.exit(f"[perf_gate] SPEC VIOLATION: acceptance rate {rate} < "
+             f"{min_accept} — the draft is not predicting the target")
+kvq = (doc.get("detail") or {}).get("kv_quant")
+if not isinstance(kvq, dict):
+    sys.exit("[perf_gate] KV-QUANT VIOLATION: serve bench JSON has no "
+             "detail.kv_quant section")
+ratio = kvq.get("blocks_per_chip_ratio")
+if ratio is None or ratio < min_ratio:
+    sys.exit(f"[perf_gate] KV-QUANT VIOLATION: int8 blocks-per-chip "
+             f"ratio {ratio} < {min_ratio} at equal cache bytes")
+drift = kvq.get("greedy_drift")
+if drift is None or drift > max_drift:
+    sys.exit(f"[perf_gate] KV-QUANT VIOLATION: greedy drift {drift} > "
+             f"{max_drift} — the quantized cache is changing outputs")
+print(f"[perf_gate] spec: identical, accept {rate} (speedup "
+      f"{spec.get('speedup')}); kv ratio {ratio}, drift {drift}",
+      file=sys.stderr)
+PY
+    fi
 fi
 
 # ---- 7. chaos leg: the elastic membership drill -----------------------------

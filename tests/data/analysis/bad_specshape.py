@@ -1,0 +1,50 @@
+# graftlint fixture: seeded GL-J005 loop-varying-shape-arg hazards —
+# the speculative-decode recompile trap.  PARSED by
+# tests/test_analysis.py, never imported or executed.
+import jax
+import jax.numpy as jnp
+
+
+def _verify(params, tokens):
+    return tokens.sum()
+
+
+verify_jit = jax.jit(_verify)
+
+
+def drive_decode_naive(params, draft, masks):
+    outs = []
+    for tick in range(8):
+        # per-tick Python variation of the draft length: every distinct
+        # k is a distinct argument shape
+        k = 1 + tick % 4
+        # GL-J005 (error): tokens[:k] reshapes the jitted argument per
+        # iteration — a compile per decode tick
+        outs.append(verify_jit(params, draft[:k]))
+        # GL-J005 (error): same hazard through a keyword and a computed
+        # bound (the acceptance-mask variant)
+        n_accept = int(outs[-1])
+        outs.append(verify_jit(params, tokens=masks[: n_accept + 1]))
+    return outs
+
+
+def drive_decode_padded(params, draft, masks):
+    # NOT a finding: the spec-decode discipline — pad to the static
+    # bucket K once, ship the varying length as traced data
+    K = 4
+    outs = []
+    for tick in range(8):
+        k = 1 + tick % 4
+        chunk = jnp.zeros((K,), jnp.int32).at[:K].set(draft[:K])
+        outs.append(verify_jit(params, chunk) * k)
+    return outs
+
+
+def slice_outside_loop(params, draft):
+    # NOT a finding: the bound is assigned OUTSIDE the loop — the
+    # shape is loop-invariant, one compile total
+    k = 3
+    outs = []
+    for _ in range(8):
+        outs.append(verify_jit(params, draft[:k]))
+    return outs

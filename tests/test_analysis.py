@@ -60,6 +60,28 @@ def test_recompile_pass_golden():
     assert by_symbol["rewrap_named_in_loop"].severity == "warning"
 
 
+def test_loop_varying_shape_arg_golden():
+    """GL-J005: the speculative-decode recompile trap — a jitted call
+    in a loop whose argument is sliced by a bound assigned in that
+    loop fires; the padded-bucket discipline and loop-invariant
+    bounds stay silent."""
+    findings = _findings("bad_specshape.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-J005", "drive_decode_naive"),
+            ("GL-J005", "drive_decode_naive"),
+        ]
+    )
+    for f in findings:
+        assert f.severity == "error"
+        assert "static bucket" in f.message
+    # one finding per hazard site: the positional draft[:k] slice and
+    # the keyword acceptance-mask slice with a computed bound
+    lines = sorted(f.line for f in findings)
+    assert lines[0] != lines[1]
+
+
 def test_donation_pass_golden():
     findings = _findings("bad_donation.py")
     got = _rule_symbol_pairs(findings)

@@ -108,8 +108,9 @@ def test_vgg16_smoke():
         mesh=make_mesh(),
     )
     _smoke(model)
-    # VGG default uses compressed exchange (config #3)
-    assert model.exchanger.strategy == "bf16"
+    # VGG default uses compressed exchange (config #3) — the default
+    # tier is the SR int8 wire since ISSUE 11
+    assert model.exchanger.strategy == "int8_sr"
 
 
 def test_resnet50_smoke():

@@ -60,31 +60,52 @@ def _run_gate(env_extra):
 
 
 def _serve_json(path, value=150.0, trace=TRACE, metrics=None,
-                ratio=3.5, hit_rate=0.57, fed=72, no_reuse=168):
-    """A BENCH_serve-shaped fixture with the paged acceptance fields."""
+                ratio=3.5, hit_rate=0.57, fed=72, no_reuse=168,
+                token_identical=True, accept_rate=0.78,
+                kv_ratio=2.65, kv_drift=0.0, spec=True, kv_quant=True):
+    """A BENCH_serve-shaped fixture with the paged + decode-speed
+    acceptance fields (detail.spec / detail.kv_quant, ISSUE 11)."""
     obs = {"trace_raw": trace}
     if metrics:
         obs["metrics_json"] = metrics
+    detail = {
+        "wall_s": 0.2,
+        "ttft_p99_s": 0.02,
+        "tpot_p99_s": 0.01,
+        "observability": obs,
+        "paged": {
+            "long_tail": {"concurrency_ratio": ratio,
+                          "contiguous_slots": 2,
+                          "paged_peak_concurrent": 7},
+            "prefix": {"hit_rate": hit_rate,
+                       "prefill_tokens": fed,
+                       "prefill_tokens_no_reuse": no_reuse},
+        },
+    }
+    if spec:
+        detail["spec"] = {
+            "token_identical": token_identical,
+            "accept_rate": accept_rate,
+            "speedup": 1.62,
+            "k": 8,
+            "rounds": 9,
+            "draft_dispatches": 65,
+            "verify_dispatches": 9,
+        }
+    if kv_quant:
+        detail["kv_quant"] = {
+            "blocks_per_chip_ratio": kv_ratio,
+            "greedy_drift": kv_drift,
+            "pool_blocks_fp32": 17,
+            "pool_blocks_int8": 45,
+        }
     doc = {
         "metric": "transformer_serve_tokens_per_sec",
         "value": value,
         "unit": "generated tokens/sec",
         "vs_baseline": 1.0,
         "measured_now": True,
-        "detail": {
-            "wall_s": 0.2,
-            "ttft_p99_s": 0.02,
-            "tpot_p99_s": 0.01,
-            "observability": obs,
-            "paged": {
-                "long_tail": {"concurrency_ratio": ratio,
-                              "contiguous_slots": 2,
-                              "paged_peak_concurrent": 7},
-                "prefix": {"hit_rate": hit_rate,
-                           "prefill_tokens": fed,
-                           "prefill_tokens_no_reuse": no_reuse},
-            },
-        },
+        "detail": detail,
     }
     with open(path, "w") as f:
         json.dump(doc, f)
@@ -279,6 +300,63 @@ def test_gate_serve_leg_fails_when_reuse_saves_nothing(fixtures, tmp_path):
     r = _run_gate(_serve_env(fixtures, serve))
     assert r.returncode != 0
     assert "no-reuse baseline" in (r.stdout + r.stderr)
+
+
+def test_gate_spec_leg_green_reports(fixtures, tmp_path):
+    """Green spec/kv-quant fields sail through and are reported."""
+    serve = _serve_json(tmp_path / "serve.json")
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode == 0, r.stderr
+    assert "spec: identical, accept 0.78" in r.stderr
+
+
+def test_gate_spec_leg_fails_on_token_divergence(fixtures, tmp_path):
+    """Greedy spec decode diverging from plain greedy is a correctness
+    bug, not a perf miss — the gate fails loudly."""
+    serve = _serve_json(tmp_path / "serve.json", token_identical=False)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "NOT token-identical" in (r.stdout + r.stderr)
+
+
+def test_gate_spec_leg_fails_below_min_accept(fixtures, tmp_path):
+    serve = _serve_json(tmp_path / "serve.json", accept_rate=0.05)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "acceptance rate" in (r.stdout + r.stderr)
+    # the floor is a knob
+    r2 = _run_gate(_serve_env(fixtures, serve,
+                              PERF_GATE_SERVE_MIN_ACCEPT="0.01"))
+    assert r2.returncode == 0, r2.stderr
+
+
+def test_gate_spec_leg_fails_on_missing_section(fixtures, tmp_path):
+    serve = _serve_json(tmp_path / "serve.json", spec=False)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "no detail.spec" in (r.stdout + r.stderr)
+
+
+def test_gate_kv_quant_violations(fixtures, tmp_path):
+    """int8 capacity below 2x, or greedy drift past the bound, fail."""
+    low = _serve_json(tmp_path / "low.json", kv_ratio=1.4)
+    r = _run_gate(_serve_env(fixtures, low))
+    assert r.returncode != 0
+    assert "blocks-per-chip" in (r.stdout + r.stderr)
+    drifty = _serve_json(tmp_path / "drift.json", kv_drift=0.9)
+    r2 = _run_gate(_serve_env(fixtures, drifty))
+    assert r2.returncode != 0
+    assert "greedy drift" in (r2.stdout + r2.stderr)
+
+
+def test_gate_spec_leg_escape_hatch(fixtures, tmp_path):
+    """PERF_GATE_SPEC=0 skips the decode-speed acceptance only — the
+    paged acceptance checks still run."""
+    serve = _serve_json(tmp_path / "serve.json", token_identical=False,
+                        kv_ratio=1.0)
+    r = _run_gate(_serve_env(fixtures, serve, PERF_GATE_SPEC="0"))
+    assert r.returncode == 0, r.stderr
+    assert "paged: ratio 3.5" in r.stderr
 
 
 def test_gate_serve_missing_baseline_skips_diff_not_slos(fixtures, tmp_path):

@@ -117,8 +117,8 @@ def test_run_preset_googlenet_bsp_e2e(tmp_path):
 
 
 def test_run_preset_vgg16_bsp_e2e(tmp_path):
-    """BASELINE config #3b: VGG16 BSP — its bf16 compressed-wire default
-    composed with the 8-device exchange."""
+    """BASELINE config #3b: VGG16 BSP — its int8_sr compressed-wire
+    default composed with the 8-device exchange."""
     model = presets.run_preset(
         "vgg16-bsp",
         config_overrides=dict(
@@ -128,8 +128,29 @@ def test_run_preset_vgg16_bsp_e2e(tmp_path):
         ),
         checkpoint_dir=str(tmp_path), val_freq=1,
     )
-    assert model.exchanger.strategy == "bf16"  # the preset's wire engaged
+    assert model.exchanger.strategy == "int8_sr"  # the default wire engaged
     _assert_bsp_run(model, str(tmp_path))
+
+
+def test_compressed_wire_default_is_int8_sr():
+    """The ISSUE-11 satellite pin: every model that defaults to a
+    compressed gradient wire defaults to STOCHASTIC-ROUNDING int8 —
+    the zero1 convergence artifact recommends it over round-to-nearest
+    (docs/convergence/README.md), and a silent regression back to a
+    cast wire (or to RN int8) would change convergence behavior."""
+    from theanompi_tpu.models.googlenet import GoogLeNet
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.models.vgg16 import VGG16
+    from theanompi_tpu.parallel.exchanger import (
+        DEFAULT_COMPRESSED_STRATEGY,
+    )
+
+    assert DEFAULT_COMPRESSED_STRATEGY == "int8_sr"
+    for cls in (TransformerLM, GoogLeNet, VGG16):
+        assert (
+            cls.default_config["exch_strategy"]
+            == DEFAULT_COMPRESSED_STRATEGY
+        ), cls.__name__
 
 
 def test_run_preset_resnet50_easgd_e2e(tmp_path):

@@ -26,6 +26,15 @@ rule targets one way this codebase could silently lose all three:
   through ``shard_map``, per-worker divergence.  ``is None`` /
   ``is not None`` tests are exempt: None-ness is part of the trace
   signature and cannot flip at run time.
+- GL-J005 ``loop-varying-shape-arg``: a call through a known jitted
+  binding inside a loop passing a *slice whose bound is assigned in
+  that loop* (``fn(params, tokens[:k])`` with ``k`` changing per
+  iteration).  Every distinct bound is a distinct aval — a decode loop
+  written this way compiles once per tick.  The serving decode paths
+  are the motivating surface: draft length ``k``, acceptance lengths
+  and kv masks must enter jitted programs as traced DATA padded to a
+  static bucket (``true_len`` vectors), never as per-tick Python
+  shapes — exactly how ``serving/spec.py`` ships ``k_eff``.
 """
 
 from __future__ import annotations
@@ -271,10 +280,113 @@ def _branches_in_traced(m: ParsedModule, wraps) -> List[Finding]:
     return out
 
 
+def _enclosing_loop(m: ParsedModule, node: ast.AST):
+    """Nearest for/while ancestor, stopping at function boundaries the
+    way ``in_loop`` does not need to (a call site only re-executes per
+    iteration when the loop is in ITS OWN function body)."""
+    cur = m.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        cur = m.parents.get(cur)
+    return None
+
+
+def _loop_assigned_names(loop: ast.AST) -> Set[str]:
+    """Names (re)bound inside a loop body — the per-iteration variables
+    whose use as a slice bound makes the slice's SHAPE vary per tick."""
+    out: Set[str] = set()
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets(e)
+
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                targets(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            targets(node.target)
+    return out
+
+
+def _varying_slice_bound(arg: ast.expr, varying: Set[str]):
+    """The first slice inside ``arg`` whose lower/upper bound reads a
+    loop-assigned name — the node to anchor the finding to, or None."""
+    for node in ast.walk(arg):
+        if not isinstance(node, ast.Subscript):
+            continue
+        sl = node.slice
+        bounds = []
+        if isinstance(sl, ast.Slice):
+            bounds = [sl.lower, sl.upper]
+        elif isinstance(sl, ast.Tuple):
+            bounds = [
+                b for d in sl.elts if isinstance(d, ast.Slice)
+                for b in (d.lower, d.upper)
+            ]
+        for b in bounds:
+            if b is None:
+                continue
+            for ref in ast.walk(b):
+                if isinstance(ref, ast.Name) and ref.id in varying:
+                    return node, ref.id
+    return None
+
+
+def _loop_varying_shape_args(m: ParsedModule, wraps) -> List[Finding]:
+    by_binding = {w.binding: w for w in wraps if w.binding}
+    if not by_binding:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        w = by_binding.get(name)
+        if w is None or node is w.call:
+            continue
+        loop = _enclosing_loop(m, node)
+        if loop is None:
+            continue
+        varying = _loop_assigned_names(loop)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            hit = _varying_slice_bound(arg, varying)
+            if hit is None:
+                continue
+            sub, bound = hit
+            out.append(
+                _finding(
+                    m,
+                    "GL-J005",
+                    "error",
+                    sub,
+                    m.symbol_for(node),
+                    f"slice bound {bound!r} is assigned inside this loop "
+                    f"and shapes an argument to jitted {name!r} — every "
+                    "distinct length is a fresh compile (a recompile per "
+                    "decode tick); pad to a static bucket and pass the "
+                    "true length as traced data instead",
+                )
+            )
+    return out
+
+
 def run(m: ParsedModule) -> List[Finding]:
     wraps = find_jit_wraps(m)
     out: List[Finding] = []
     out += _jit_in_loop(m, wraps)
     out += _unhashable_static_args(m, wraps)
     out += _branches_in_traced(m, wraps)
+    out += _loop_varying_shape_args(m, wraps)
     return out

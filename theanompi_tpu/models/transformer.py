@@ -65,7 +65,9 @@ class TransformerLM(TpuModel):
         n_synth_train=32,
         n_synth_val=2,
         val_top5=True,
-        exch_strategy="bf16",
+        exch_strategy="int8_sr",  # exchanger.DEFAULT_COMPRESSED_STRATEGY:
+        # unbiased SR int8 wire, 4x fewer bytes than ar at the zero1-
+        # evidenced convergence floor (docs/convergence README)
         moe_experts=0,  # >0 = MoE FFN blocks (GShard-style: experts
         # shard over the existing dp axis — parallel.moe.MoeMlp)
         moe_top_k=1,
@@ -448,3 +450,36 @@ class TransformerLM(TpuModel):
             )
         err, err5 = self._metrics(flat_logits, flat_y)
         return loss, (err, err5, new_state)
+
+
+def make_draft(model: TransformerLM, n_layers: int = 1) -> TransformerLM:
+    """Zoo entry: the **truncated self-draft** for speculative decoding.
+
+    Builds a ``TransformerLM`` on the target's own mesh with the same
+    embedding / positional / final-LN / head weights and the target's
+    FIRST ``n_layers`` transformer blocks — a zero-training draft whose
+    per-token cost is ~``n_layers / L`` of the target's and whose
+    greedy proposals track the target wherever the late blocks refine
+    rather than overturn the early residual stream.  The train→serve
+    loader applies unchanged (the draft IS a TransformerLM with its own
+    params), so a distilled draft checkpoint drops in by loading
+    different params into the same shape.
+
+    Serving-side composition: hand the result to
+    ``PagedServingEngine(draft, ...)`` and pass that engine as the
+    scheduler's ``draft_engine`` (``serving/spec.py``).
+    """
+    L = int(model.config.n_layers)
+    n_layers = int(n_layers)
+    if not 1 <= n_layers <= L:
+        raise ValueError(
+            f"draft n_layers must be in [1, {L}], got {n_layers}"
+        )
+    cfg = {k: model.config[k] for k in model.config}
+    cfg["n_layers"] = n_layers
+    draft = TransformerLM(config=cfg, mesh=model.mesh)
+    p = list(model.params)
+    # Sequential params layout: [embedding, positions, block_0..block_{L-1},
+    # final_ln, head] — the same split serving/engine._weights makes
+    draft.params = p[:2] + p[2:2 + n_layers] + p[2 + L:]
+    return draft

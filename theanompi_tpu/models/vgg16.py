@@ -37,7 +37,8 @@ class VGG16(TpuModel):
         n_classes=1000,
         data_dir=None,
         n_synth_batches=32,
-        exch_strategy="bf16",  # config #3: compressed exchanger path
+        exch_strategy="int8_sr",  # config #3: compressed exchanger path
+        # (default tier = exchanger.DEFAULT_COMPRESSED_STRATEGY)
     )
 
     def build_data(self):

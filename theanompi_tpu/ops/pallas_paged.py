@@ -1,0 +1,244 @@
+"""Fused paged-attention decode kernel (Pallas TPU).
+
+One decode tick's attention for every serving lane: a single query
+token per sequence against K/V gathered **block by block from the
+paged pool inside the kernel**.  The lane's block table is a
+scalar-prefetch argument, so the BlockSpec ``index_map`` reads
+``table[s, j]`` and each grid step DMAs exactly ONE pool block into
+VMEM — the XLA path instead materializes the whole gathered
+``(S, t_pad, H, hd)`` image in HBM first (and, on a dp-sharded pool,
+pays a GSPMD cross-shard gather for it).  Softmax runs as the online
+recurrence over the block stream (same max/denominator carry as
+``pallas_flash``), so nothing quadratic in the table length ever
+leaves VMEM.
+
+int8 pool payloads (``serving.paging`` ``kv_dtype='int8'``)
+dequantize **in-kernel**: the per-row/per-head fp32 scales ride a
+parallel scale pool gathered through the same table, and the int8
+rows never round-trip through an fp32 HBM image — the capacity win of
+the quantized cache is also a bandwidth win on the decode hot path.
+
+Blocks whose first row is already past the lane's resident length are
+skipped entirely (``pl.when``), mirroring the flash kernels' masked-
+block elision; within the boundary block, rows past the length mask
+to ``-inf`` exactly like the XLA path's ``att_mask``.
+
+``interpret=True`` off-TPU (the ``pallas_flash._on_tpu`` device gate)
+so CPU CI exercises the same kernel code — the tier-1 contract is
+allclose against the XLA gather path on both fp32 and int8 pools.
+
+Scope: the kernel is a SINGLE-SHARD program.  ``supported()`` gates on
+one device — a dp-sharded pool or tp-sharded heads would need a
+shard_map wrapper this jaxlib's pallas lowering does not compose with,
+so the engine keeps the XLA path there (see docs/serving.md for the
+fallback matrix).  On-chip, the small serving head counts also violate
+the (32, 128) int8 tile floor — real-TPU enablement is a next-window
+item; interpret-mode correctness is what tier-1 pins today.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from theanompi_tpu.ops.pallas_flash import _NEG_INF, _on_tpu, resolve_scale
+
+
+def supported(mesh=None) -> bool:
+    """Whether the fused kernel can serve this pool.
+
+    Single-device only: ``pallas_call`` under jit has no partitioning
+    rule on this jaxlib, so a pool sharded over dp rows or tp heads
+    must keep the XLA gather (GSPMD partitions that one for free).
+    """
+    try:
+        n = mesh.devices.size if mesh is not None else len(jax.devices())
+    except RuntimeError:
+        return False
+    return int(n) == 1
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies
+# ---------------------------------------------------------------------------
+
+def _attend_block(q, k_blk, v_blk, length, j, bs, scale,
+                  m_ref, d_ref, acc_ref):
+    """Fold one (bs, H, hd) K/V block into the online-softmax carry.
+
+    ``q`` (H, hd) fp32; rows of the block live at global positions
+    ``j*bs + [0, bs)`` and mask against ``length`` (the incoming
+    token's position — it attends to itself, like the XLA att_mask).
+    """
+    h, _ = q.shape
+    kb = k_blk.transpose(1, 0, 2)  # (H, bs, hd)
+    vb = v_blk.transpose(1, 0, 2)
+    s = lax.dot_general(
+        q[:, None, :], kb, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )[:, 0, :] * scale  # (H, bs)
+    pos = j * bs + lax.broadcasted_iota(jnp.int32, (h, kb.shape[1]), 1)
+    s = jnp.where(pos <= length, s, _NEG_INF)
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    corr = jnp.exp(m_prev - m_new)
+    d_ref[:, 0] = d_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+    acc_ref[...] = acc_ref[...] * corr[:, None] + lax.dot_general(
+        p[:, None, :], vb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )[:, 0, :]
+    m_ref[:, 0] = m_new
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, d_ref, acc_ref, *, bs, nt, scale):
+    s_idx = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        d_ref[...] = jnp.zeros_like(d_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[s_idx]
+
+    @pl.when(j * bs <= length)  # fully-masked blocks are elided
+    def _work():
+        _attend_block(
+            q_ref[0].astype(jnp.float32),
+            k_ref[...].astype(jnp.float32),
+            v_ref[...].astype(jnp.float32),
+            length, j, bs, scale, m_ref, d_ref, acc_ref,
+        )
+
+    @pl.when(j == nt - 1)
+    def _fin():
+        o_ref[0] = (
+            acc_ref[...] / d_ref[:, 0][:, None]
+        ).astype(o_ref.dtype)
+
+
+def _paged_kernel_i8(tbl_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                     vs_ref, o_ref, m_ref, d_ref, acc_ref,
+                     *, bs, nt, scale):
+    """int8 payload variant: per-row/per-head scales dequantize the
+    block in VMEM — identical recurrence after that."""
+    s_idx = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        d_ref[...] = jnp.zeros_like(d_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[s_idx]
+
+    @pl.when(j * bs <= length)
+    def _work():
+        k_blk = k_ref[...].astype(jnp.float32) * ks_ref[...][..., None]
+        v_blk = v_ref[...].astype(jnp.float32) * vs_ref[...][..., None]
+        _attend_block(
+            q_ref[0].astype(jnp.float32), k_blk, v_blk,
+            length, j, bs, scale, m_ref, d_ref, acc_ref,
+        )
+
+    @pl.when(j == nt - 1)
+    def _fin():
+        o_ref[0] = (
+            acc_ref[...] / d_ref[:, 0][:, None]
+        ).astype(o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    block_size: int,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+):
+    """softmax(q·Kᵀ·scale)·V over each lane's paged K/V, one layer.
+
+    - ``q`` (S, H, hd): the decode tick's single query per lane.
+    - ``k_pool``/``v_pool`` (R, H, hd): the flat row pool for this
+      layer (R = n_blocks · block_size), fp32/compute dtype or int8.
+    - ``tables`` (S, NT) int32: per-lane block ids (0 = trash block).
+    - ``lengths`` (S,) int32: the incoming token's position; rows at
+      positions <= length attend (the token was scattered before the
+      call, exactly like the XLA path).
+    - ``k_scale``/``v_scale`` (R, H) fp32: required when the pools are
+      int8 — per-row/per-head dequant scales.
+
+    Returns fp32 (S, H, hd).  Numerics contract (tier-1 pinned):
+    allclose to the XLA gather path on both pool dtypes.
+    """
+    s, h, hd = q.shape
+    nt = int(tables.shape[1])
+    bs = int(block_size)
+    quant = k_pool.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError("int8 pools need k_scale/v_scale")
+    sc = resolve_scale(scale, hd)
+
+    def _pool_map(si, j, tbl, ln):
+        return (tbl[si, j], 0, 0)
+
+    def _scale_map(si, j, tbl, ln):
+        return (tbl[si, j], 0)
+
+    def _row_map(si, j, tbl, ln):
+        return (si, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, hd), _row_map),          # q
+        pl.BlockSpec((bs, h, hd), _pool_map),        # k block
+        pl.BlockSpec((bs, h, hd), _pool_map),        # v block
+    ]
+    args = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((bs, h), _scale_map),       # k scales
+            pl.BlockSpec((bs, h), _scale_map),       # v scales
+        ]
+        args += [k_scale, v_scale]
+        kernel = functools.partial(_paged_kernel_i8, bs=bs, nt=nt, scale=sc)
+    else:
+        kernel = functools.partial(_paged_kernel, bs=bs, nt=nt, scale=sc)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lengths
+        grid=(s, nt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, hd), _row_map),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((h, hd), jnp.float32),  # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, hd), jnp.float32),
+        interpret=(not _on_tpu()) if interpret is None else interpret,
+    )(
+        jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32),
+        *args,
+    )

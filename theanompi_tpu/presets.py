@@ -42,7 +42,9 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     ),
     # BASELINE config #3: "GoogLeNet + VGG16 ImageNet, BSP with NCCL32
     # exchanger path" — the NCCL path maps to in-graph ICI collectives;
-    # both models default to the compressed bf16 wire (see model files)
+    # both models default to the compressed int8_sr wire
+    # (exchanger.DEFAULT_COMPRESSED_STRATEGY; see model files and the
+    # zero1 convergence evidence in docs/convergence/README.md)
     "googlenet-bsp": dict(
         rule="BSP",
         modelfile="theanompi_tpu.models.googlenet",

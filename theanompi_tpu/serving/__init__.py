@@ -25,6 +25,11 @@ serving side with the same sharded-parameter machinery:
 - ``sampling``  — temperature / top-k stochastic sampling on the decode
   path: seeded per-request PRNG keys, ``temperature=0`` preserved as
   exact greedy, zero recompiles across sampling-config changes.
+- ``spec``      — speculative decoding: a draft ``TransformerLM``
+  (``models.transformer.make_draft``) proposes k tokens per round and
+  the target verifies all of them in ONE batched paged dispatch
+  (``PagedServingEngine.verify_chunks``); greedy and sampled streams
+  are token-identical to the non-speculative path by construction.
 
 Bench entry point: ``bench_serve.py`` at the repo root (hooked from
 ``bench.py`` via ``THEANOMPI_BENCH_SERVE=1``) produces the
@@ -41,6 +46,7 @@ from theanompi_tpu.serving.paging import (
 )
 from theanompi_tpu.serving.sampling import Sampler
 from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
+from theanompi_tpu.serving.spec import SpecDecoder
 
 __all__ = [
     "ServingEngine",
@@ -51,6 +57,7 @@ __all__ = [
     "Request",
     "Sampler",
     "ServingMetrics",
+    "SpecDecoder",
     "load_engine",
     "restore_params_for_serving",
 ]

@@ -63,6 +63,8 @@ def load_engine(
     n_blocks: Optional[int] = None,
     prefill_chunk: Optional[int] = None,
     prefix_cache: bool = True,
+    kv_dtype: str = "fp32",
+    paged_attn: str = "xla",
 ):
     """One-call checkpoint → ready ``ServingEngine``.
 
@@ -75,7 +77,10 @@ def load_engine(
     ``paged=True`` returns a ``paging.PagedServingEngine`` instead —
     same checkpoint, same decode outputs, KV memory in fixed-size
     refcounted blocks (``block_size``/``n_blocks``) with prefix reuse
-    and chunked multi-slot prefill (``prefill_chunk``)."""
+    and chunked multi-slot prefill (``prefill_chunk``).  ``kv_dtype``
+    ('fp32'/'int8') and ``paged_attn`` ('xla'/'pallas'/'auto') select
+    the quantized-cache and fused-kernel decode tiers — a checkpoint
+    loads identically into any combination."""
     from theanompi_tpu.serving.engine import ServingEngine
     from theanompi_tpu.serving.paging import PagedServingEngine
 
@@ -100,6 +105,7 @@ def load_engine(
             model, n_slots=n_slots, max_len=max_len, buckets=buckets,
             block_size=block_size, n_blocks=n_blocks,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            kv_dtype=kv_dtype, paged_attn=paged_attn,
         )
     return ServingEngine(
         model, n_slots=n_slots, max_len=max_len, buckets=buckets

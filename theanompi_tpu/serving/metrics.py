@@ -94,6 +94,26 @@ ADMISSION_BACKPRESSURE = _REG.counter(
     "admission attempts deferred because the block pool was exhausted",
 )
 
+# ---- speculative decoding (serving/spec.py drives these) -----------------
+# accepted/proposed is THE spec-decode health signal: a collapsing
+# acceptance rate means the draft stopped predicting the target and
+# every verify dispatch is doing single-token work at multi-token cost.
+SPEC_DRAFT_DISPATCHES = _REG.counter(
+    "serve_spec_draft_dispatches_total",
+    "draft-model decode dispatches (proposal + catch-up ticks)",
+)
+SPEC_VERIFY_DISPATCHES = _REG.counter(
+    "serve_spec_verify_dispatches_total",
+    "target-model batched verify dispatches",
+)
+SPEC_PROPOSED = _REG.counter(
+    "serve_spec_proposed_tokens_total", "draft tokens proposed"
+)
+SPEC_ACCEPTED = _REG.counter(
+    "serve_spec_accepted_tokens_total",
+    "draft tokens the target verified and accepted",
+)
+
 
 class ServingMetrics:
     """Collects per-request latency rows; emits through a Recorder.

@@ -38,6 +38,33 @@ Three pieces:
   arrivals shares a dispatch and a giant prompt cannot hide the TTFT
   of everyone queued behind it.
 
+Decode-speed layers on top (ISSUE 11):
+
+- **kv_dtype='int8'** — K/V live in the pool as int8 with per-row /
+  per-head fp32 scales (the ``quantize.quantize_blocks`` codec over
+  ``head_dim``, applied once on write).  Quantization is per row, so a
+  block's bytes depend only on the tokens it holds — hash-consed
+  prefix blocks stay shareable, and chunked prefill remains
+  bit-identical to whole-prompt prefill (queries always attend the
+  quantized image, never a fresher fp32 copy).  Dequant fuses into the
+  attention gather (or runs in-kernel on the Pallas path).  Capacity:
+  ``kv_block_bytes()``/``blocks_at_budget()`` turn a byte budget into
+  a block count — int8 fits ~4× the fp32 blocks per chip at head_dim
+  64 (the ``detail.kv_quant`` probe in bench_serve measures it).
+- **verify_chunks** — the chunked-prefill body with logits at EVERY
+  chunk position instead of only the last: the speculative-decoding
+  verify dispatch (``serving/spec.py``) scores a draft's k proposals
+  plus the bonus token in ONE batched call.  Same jitted program for
+  every acceptance outcome — rejected tails roll lengths back
+  host-side, so acceptance churn recompiles nothing.
+- **paged_attn='pallas'** — the decode tick's attention runs the
+  fused ``ops.pallas_paged`` kernel: block tables scalar-prefetched
+  into the kernel, K/V blocks gathered inside it (int8 dequant
+  in-VMEM), online softmax over the block stream.  Falls back to the
+  XLA gather path whenever the kernel cannot serve the pool
+  (multi-device mesh — see ``pallas_paged.supported``); numerics are
+  pinned allclose between the two paths.
+
 Correctness contract (tests/test_serving_paged.py): greedy decode
 through block tables is token-identical to the contiguous engine and
 to the no-cache recompute baseline; prefix hits change which physical
@@ -46,6 +73,7 @@ rows are read, never the values read from them.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -61,6 +89,8 @@ from theanompi_tpu.serving import metrics as smetrics
 from theanompi_tpu.serving.engine import _NEG_INF, ServingEngine
 
 TRASH_BLOCK = 0  # reserved physical block: masked/inactive writes land here
+
+KV_DTYPES = ("fp32", "int8")
 
 
 class BlockPool:
@@ -251,6 +281,15 @@ class PagedServingEngine(ServingEngine):
     - ``prefill_chunk`` — max prompt tokens one prefill call advances
       a sequence by (None = whole prompt in one chunk).  Chunks pad to
       the ``chunk_buckets`` ladder, one compiled program per bucket.
+    - ``kv_dtype`` — ``'fp32'`` (compatibility path: the pool holds
+      the compute dtype, bit-identical to PR 8) or ``'int8'``
+      (quantized blocks + per-row/head scales; ~4× the blocks per
+      byte, greedy drift bounded by the bench probe).
+    - ``paged_attn`` — ``'xla'`` (gathered-image attention, the
+      GSPMD-partitionable default), ``'pallas'`` (fused in-kernel
+      gather where supported), or ``'auto'``.  Unsupported pools fall
+      back to XLA — ``paged_attn_effective`` records what actually
+      runs.
     """
 
     is_paged = True
@@ -266,6 +305,8 @@ class PagedServingEngine(ServingEngine):
         prefill_rows: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = True,
+        kv_dtype: str = "fp32",
+        paged_attn: str = "xla",
     ):
         super().__init__(model, n_slots=n_slots, max_len=max_len,
                          buckets=buckets)
@@ -299,6 +340,30 @@ class PagedServingEngine(ServingEngine):
             {b for b in self.buckets if b <= cap} | {cap}
         ))
         self.prefix_cache_enabled = bool(prefix_cache)
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}"
+            )
+        self.kv_dtype = kv_dtype
+        if paged_attn not in ("xla", "pallas", "auto"):
+            raise ValueError(
+                f"paged_attn must be 'xla', 'pallas' or 'auto', got "
+                f"{paged_attn!r}"
+            )
+        from theanompi_tpu.ops import pallas_paged
+
+        self.paged_attn = paged_attn
+        kernel_ok = pallas_paged.supported(self.mesh)
+        # 'pallas' is a REQUEST, not a demand: an unsupported pool
+        # (multi-device mesh) keeps the GSPMD-partitionable XLA path —
+        # same numerics contract, no crash at engine build
+        self.paged_attn_effective = (
+            "pallas" if paged_attn in ("pallas", "auto") and kernel_ok
+            else "xla"
+        )
+        self.paged_attn_fallback = (
+            paged_attn == "pallas" and not kernel_ok
+        )
         # pool rows shard over dp only when every per-device shard is a
         # whole number of blocks (a split block would tear the
         # gather/scatter row arithmetic across devices)
@@ -315,31 +380,72 @@ class PagedServingEngine(ServingEngine):
             else None
         )
         self.pool_spec = P(None, row_ax, head_ax, None)
+        self.scale_spec = P(None, row_ax, head_ax)
+        # trace counter for the spec-decode verify program (one compile
+        # ever per chunk width — acceptance churn must retrace nothing)
+        self._n_verify_traces = 0
         self._paged_prefill_jit = jax.jit(
-            self._paged_prefill_fn, donate_argnums=(1, 2)
+            functools.partial(self._paged_chunk_fn, all_logits=False),
+            donate_argnums=(1,),
+        )
+        self._paged_verify_jit = jax.jit(
+            functools.partial(self._paged_chunk_fn, all_logits=True),
+            donate_argnums=(1,),
         )
         self._paged_decode_jit = jax.jit(
-            self._paged_decode_fn, donate_argnums=(1, 2)
+            self._paged_decode_fn, donate_argnums=(1,)
         )
 
     # ------------------------------------------------------------------
     # state + pool construction
     # ------------------------------------------------------------------
+    def _kv_compute_dtype(self):
+        return self.compute_dtype or jnp.float32
+
     def init_state(self):
         """Device block pool: ``k``/``v`` of (layers, n_blocks·bs,
-        heads, head_dim), allocated already sharded.  Lengths and block
-        tables stay host-side (tiny ints shipped per call — they are
-        *data*, so shipping them can never recompile anything)."""
-        dt = self.compute_dtype or jnp.float32
+        heads, head_dim), allocated already sharded; ``kv_dtype='int8'``
+        adds the per-row/per-head scale planes ``ks``/``vs``.  Lengths
+        and block tables stay host-side (tiny ints shipped per call —
+        they are *data*, so shipping them can never recompile
+        anything)."""
+        dt = (
+            jnp.int8 if self.kv_dtype == "int8" else self._kv_compute_dtype()
+        )
         sh = NamedSharding(self.mesh, self.pool_spec)
         shape = (
             self.n_layers, self.n_blocks * self.block_size,
             self.n_heads, self.head_dim,
         )
-        return {
+        state = {
             "k": jnp.zeros(shape, dt, device=sh),
             "v": jnp.zeros(shape, dt, device=sh),
         }
+        if self.kv_dtype == "int8":
+            ssh = NamedSharding(self.mesh, self.scale_spec)
+            sshape = shape[:-1]
+            state["ks"] = jnp.zeros(sshape, jnp.float32, device=ssh)
+            state["vs"] = jnp.zeros(sshape, jnp.float32, device=ssh)
+        return state
+
+    def kv_block_bytes(self) -> int:
+        """Device bytes ONE pool block occupies across all layers
+        (K + V payload, plus the int8 scale planes) — the equal-byte
+        currency of the ``detail.kv_quant`` capacity probe."""
+        payload = (
+            1 if self.kv_dtype == "int8"
+            else jnp.dtype(self._kv_compute_dtype()).itemsize
+        )
+        rows = self.block_size * self.n_heads
+        b = 2 * self.n_layers * rows * self.head_dim * payload
+        if self.kv_dtype == "int8":
+            b += 2 * self.n_layers * rows * 4  # fp32 scale per (row, head)
+        return b
+
+    def blocks_at_budget(self, budget_bytes: int) -> int:
+        """How many pool blocks fit in ``budget_bytes`` of cache HBM at
+        this engine's kv_dtype (the trash block counts like any other)."""
+        return max(0, int(budget_bytes) // self.kv_block_bytes())
 
     def make_pool(self, n_blocks: Optional[int] = None) -> BlockPool:
         """A fresh allocator over (a prefix of) the device pool.  An
@@ -375,16 +481,50 @@ class PagedServingEngine(ServingEngine):
         rows = tables[:, :, None] * bs + jnp.arange(bs)[None, None, :]
         return rows.reshape(tables.shape[0], -1)
 
-    def _paged_prefill_fn(
-        self, params, pk, pv, tokens, tables, p0, true_len, active
+    def _kv_write(self, pool_l, scale_l, rows, wr):
+        """Scatter freshly-computed K or V ``rows`` (N, H, hd) into one
+        layer's pool at row indices ``wr``.  fp32 path: a cast +
+        scatter, bit-identical to PR 8.  int8 path: the
+        ``quantize_blocks`` codec over head_dim (per-row/per-head amax
+        scale) — quantized ONCE on write, so every later reader (XLA
+        gather, Pallas kernel, a prefix-sharing sibling) sees the same
+        bytes."""
+        if self.kv_dtype == "int8":
+            from theanompi_tpu.parallel.quantize import quantize_blocks
+
+            q, s = quantize_blocks(rows.astype(jnp.float32))
+            return pool_l.at[wr].set(q), scale_l.at[wr].set(s)
+        return pool_l.at[wr].set(rows.astype(pool_l.dtype)), scale_l
+
+    def _kv_image(self, pool_l, scale_l, gr_flat, n, dtype):
+        """Gather the (n, t_pad, H, hd) attention image for one layer —
+        dequantizing int8 payloads against their gathered scales."""
+        img = jnp.take(pool_l, gr_flat, axis=0)
+        if self.kv_dtype == "int8":
+            sc = jnp.take(scale_l, gr_flat, axis=0)
+            img = img.astype(jnp.float32) * sc[..., None]
+        return img.astype(dtype).reshape(
+            n, self.t_pad, self.n_heads, self.head_dim
+        )
+
+    def _paged_chunk_fn(
+        self, params, state, tokens, tables, p0, true_len, active,
+        all_logits,
     ):
-        """One batched, chunked prefill: ``tokens`` (P, C) int32 —
-        chunk c of each lane, entering logical positions
+        """One batched, chunked multi-token pass: ``tokens`` (P, C)
+        int32 — chunk c of each lane, entering logical positions
         ``p0[i] + [0, C)``; ``true_len`` (P,) real tokens per lane
         (pad and inactive lanes scatter to the trash block).  Writes
         each lane's chunk K/V into its table's blocks and returns
-        logits (P, V) at each lane's last real chunk token."""
-        self._n_prefill_traces += 1  # runs at trace time only
+        logits at each lane's last real chunk token (prefill,
+        ``all_logits=False``) or at EVERY chunk position (the
+        speculative-decoding verify dispatch, ``all_logits=True`` —
+        (P, C, V), so a draft's k proposals and the bonus token are
+        scored in this ONE call)."""
+        if all_logits:  # runs at trace time only
+            self._n_verify_traces += 1
+        else:
+            self._n_prefill_traces += 1
         emb, pos, blocks, lnf, head = self._weights(params)
         p_, c_ = tokens.shape
         bs = self.block_size
@@ -400,26 +540,33 @@ class PagedServingEngine(ServingEngine):
         )
         wr = jnp.where(valid, blk * bs + positions % bs, TRASH_BLOCK)
         wr = wr.reshape(-1)  # (P·C,) — collisions only inside trash
-        gr = self._gather_rows(tables)  # (P, t_pad)
+        gr = self._gather_rows(tables).reshape(-1)  # (P·t_pad,)
         # causal over ABSOLUTE positions: chunk queries see the whole
         # cached history (earlier chunks / prefix-hit blocks) plus the
         # intra-chunk triangle, exactly like one full-prompt pass
         mask = jnp.arange(self.t_pad)[None, None, :] <= positions[:, :, None]
-        dt = pk.dtype
-        new_k, new_v = [], []
+        pk, pv = state["k"], state["v"]
+        pks = state.get("ks")
+        pvs = state.get("vs")
+        img_dt = (
+            self._kv_compute_dtype() if self.kv_dtype == "int8" else pk.dtype
+        )
+        new_k, new_v, new_ks, new_vs = [], [], [], []
         for i, bp in enumerate(blocks):
             y = self._ln(bp["ln1"], x)
             q = self._proj(y, bp["attn"]["wq"]).reshape(p_, c_, h, hd)
             k = self._proj(y, bp["attn"]["wk"]).reshape(p_, c_, h, hd)
             v = self._proj(y, bp["attn"]["wv"]).reshape(p_, c_, h, hd)
-            pk_l = pk[i].at[wr].set(k.reshape(p_ * c_, h, hd).astype(dt))
-            pv_l = pv[i].at[wr].set(v.reshape(p_ * c_, h, hd).astype(dt))
-            kc = jnp.take(pk_l, gr.reshape(-1), axis=0).reshape(
-                p_, self.t_pad, h, hd
+            pk_l, pks_l = self._kv_write(
+                pk[i], None if pks is None else pks[i],
+                k.reshape(p_ * c_, h, hd), wr,
             )
-            vc = jnp.take(pv_l, gr.reshape(-1), axis=0).reshape(
-                p_, self.t_pad, h, hd
+            pv_l, pvs_l = self._kv_write(
+                pv[i], None if pvs is None else pvs[i],
+                v.reshape(p_ * c_, h, hd), wr,
             )
+            kc = self._kv_image(pk_l, pks_l, gr, p_, img_dt)
+            vc = self._kv_image(pv_l, pvs_l, gr, p_, img_dt)
             s = jnp.einsum(
                 "pchd,pthd->phct", q, kc,
                 preferred_element_type=jnp.float32,
@@ -434,20 +581,31 @@ class PagedServingEngine(ServingEngine):
             x = x + self._mlp(bp, self._ln(bp["ln2"], x))
             new_k.append(pk_l)
             new_v.append(pv_l)
-        last = jnp.take_along_axis(
-            x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
-        )[:, 0]  # (P, D)
-        logits = self._head(lnf, head, last)
-        return jnp.stack(new_k), jnp.stack(new_v), logits
+            new_ks.append(pks_l)
+            new_vs.append(pvs_l)
+        out = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        if self.kv_dtype == "int8":
+            out["ks"] = jnp.stack(new_ks)
+            out["vs"] = jnp.stack(new_vs)
+        if all_logits:
+            logits = self._head(lnf, head, x)  # (P, C, V)
+        else:
+            last = jnp.take_along_axis(
+                x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
+            )[:, 0]  # (P, D)
+            logits = self._head(lnf, head, last)
+        return out, logits
 
     def _paged_decode_fn(
-        self, params, pk, pv, tokens, tables, lengths, active
+        self, params, state, tokens, tables, lengths, active
     ):
         """One decode tick for every lane: identical math to the
         contiguous ``_decode_fn`` with the per-slot cache image
         gathered through the block table.  Inactive lanes scatter to
         the trash block — a recycled block can never be corrupted by a
-        lane that no longer owns it."""
+        lane that no longer owns it.  ``paged_attn='pallas'`` swaps
+        the gather+softmax for the fused kernel (same scatter, same
+        mask semantics — allclose-pinned)."""
         self._n_decode_traces += 1  # runs at trace time only
         emb, pos, blocks, lnf, head = self._weights(params)
         s_ = tokens.shape[0]
@@ -463,40 +621,59 @@ class PagedServingEngine(ServingEngine):
             axis=1,
         )[:, 0]
         wr = jnp.where(active, blk * bs + pos_idx % bs, TRASH_BLOCK)
-        gr = self._gather_rows(tables)  # (S, t_pad)
+        gr = self._gather_rows(tables).reshape(-1)  # (S·t_pad,)
         att_mask = jnp.arange(self.t_pad)[None, :] <= pos_idx[:, None]
-        dt = pk.dtype
-        new_k, new_v = [], []
+        pk, pv = state["k"], state["v"]
+        pks = state.get("ks")
+        pvs = state.get("vs")
+        img_dt = (
+            self._kv_compute_dtype() if self.kv_dtype == "int8" else pk.dtype
+        )
+        use_pallas = self.paged_attn_effective == "pallas"
+        if use_pallas:
+            from theanompi_tpu.ops import pallas_paged
+        new_k, new_v, new_ks, new_vs = [], [], [], []
         for i, bp in enumerate(blocks):
             y = self._ln(bp["ln1"], x)
             q = self._proj(y, bp["attn"]["wq"]).reshape(s_, h, hd)
             k = self._proj(y, bp["attn"]["wk"]).reshape(s_, h, hd)
             v = self._proj(y, bp["attn"]["wv"]).reshape(s_, h, hd)
-            pk_l = pk[i].at[wr].set(k.astype(dt))
-            pv_l = pv[i].at[wr].set(v.astype(dt))
-            kc = jnp.take(pk_l, gr.reshape(-1), axis=0).reshape(
-                s_, self.t_pad, h, hd
+            pk_l, pks_l = self._kv_write(
+                pk[i], None if pks is None else pks[i], k, wr
             )
-            vc = jnp.take(pv_l, gr.reshape(-1), axis=0).reshape(
-                s_, self.t_pad, h, hd
+            pv_l, pvs_l = self._kv_write(
+                pv[i], None if pvs is None else pvs[i], v, wr
             )
-            s = jnp.einsum(
-                "shd,sthd->sht", q, kc, preferred_element_type=jnp.float32
-            ) * self.scale
-            s = jnp.where(att_mask[:, None, :], s, _NEG_INF)
-            prob = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum(
-                "sht,sthd->shd", prob.astype(vc.dtype), vc,
-                preferred_element_type=jnp.float32,
-            ).astype(y.dtype)
+            if use_pallas:
+                o = pallas_paged.paged_decode_attention(
+                    q, pk_l, pv_l, tables, pos_idx,
+                    block_size=bs, scale=self.scale,
+                    k_scale=pks_l, v_scale=pvs_l,
+                ).astype(y.dtype)
+            else:
+                kc = self._kv_image(pk_l, pks_l, gr, s_, img_dt)
+                vc = self._kv_image(pv_l, pvs_l, gr, s_, img_dt)
+                s = jnp.einsum(
+                    "shd,sthd->sht", q, kc,
+                    preferred_element_type=jnp.float32,
+                ) * self.scale
+                s = jnp.where(att_mask[:, None, :], s, _NEG_INF)
+                prob = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum(
+                    "sht,sthd->shd", prob.astype(vc.dtype), vc,
+                    preferred_element_type=jnp.float32,
+                ).astype(y.dtype)
             x = x + self._proj(o.reshape(s_, h * hd), bp["attn"]["wo"])
             x = x + self._mlp(bp, self._ln(bp["ln2"], x))
             new_k.append(pk_l)
             new_v.append(pv_l)
-        return (
-            jnp.stack(new_k), jnp.stack(new_v),
-            self._head(lnf, head, x),
-        )
+            new_ks.append(pks_l)
+            new_vs.append(pvs_l)
+        out = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        if self.kv_dtype == "int8":
+            out["ks"] = jnp.stack(new_ks)
+            out["vs"] = jnp.stack(new_vs)
+        return out, self._head(lnf, head, x)
 
     # ------------------------------------------------------------------
     # host entries
@@ -531,37 +708,65 @@ class PagedServingEngine(ServingEngine):
         smetrics.PREFILL_CHUNKS.inc(bucket=str(c))
         smetrics.PREFILL_TOKENS.inc(int(true_len.sum()))
         with obs.span("prefill_chunk_dispatch", rows=len(rows), bucket=c):
-            k, v, logits = self._paged_prefill_jit(
-                params, state["k"], state["v"],
+            state, logits = self._paged_prefill_jit(
+                params, state,
                 jnp.asarray(tokens), jnp.asarray(tables),
                 jnp.asarray(p0), jnp.asarray(true_len),
                 jnp.asarray(active),
             )
-        return {"k": k, "v": v}, logits
+        return state, logits
+
+    def verify_chunks(self, params, state, tokens, tables, p0, true_len,
+                      active):
+        """One batched speculative-VERIFY dispatch: ``tokens`` (S, C)
+        int32 — each active lane's [last emitted token, draft
+        proposals…] chunk entering positions ``p0[i] + [0, C)``;
+        ``true_len`` (S,) how many of the C are real for this lane
+        (budget-clamped lanes pad — the pad writes go to the trash
+        block and their logits are never picked).  Returns ``(state,
+        logits (S, C, V))``: row i column j scores the token FOLLOWING
+        chunk position j, so greedy acceptance is an argmax compare and
+        sampled acceptance draws with the request's own per-index keys.
+        C is pinned by the caller (spec_k + 1) — ONE compiled program
+        across every acceptance/rollback outcome."""
+        smetrics.SPEC_VERIFY_DISPATCHES.inc()
+        with obs.span("spec_verify_dispatch", rows=int(np.sum(active)),
+                      width=int(np.asarray(tokens).shape[1])):
+            state, logits = self._paged_verify_jit(
+                params, state,
+                jnp.asarray(tokens, dtype=jnp.int32),
+                jnp.asarray(tables, dtype=jnp.int32),
+                jnp.asarray(p0, dtype=jnp.int32),
+                jnp.asarray(true_len, dtype=jnp.int32),
+                jnp.asarray(active, dtype=bool),
+            )
+        return state, logits
 
     def decode_step_paged(self, params, state, tokens, tables, lengths,
                           active):
         """One decode tick; host arrays in, ``(state, logits)`` out."""
-        k, v, logits = self._paged_decode_jit(
-            params, state["k"], state["v"],
+        return self._paged_decode_jit(
+            params, state,
             jnp.asarray(tokens, dtype=jnp.int32),
             jnp.asarray(tables, dtype=jnp.int32),
             jnp.asarray(lengths, dtype=jnp.int32),
             jnp.asarray(active, dtype=bool),
         )
-        return {"k": k, "v": v}, logits
 
     # ------------------------------------------------------------------
     # convenience: single-sequence greedy decode (tests / smoke)
     # ------------------------------------------------------------------
-    def greedy(self, prompt, n_new: int, params=None) -> List[int]:
+    def greedy(self, prompt, n_new: int, params=None, **sched_kwargs) -> List[int]:
         """Greedy-decode through the full paged scheduler path (block
-        allocation, chunked prefill, table-threaded decode)."""
+        allocation, chunked prefill, table-threaded decode).
+        ``sched_kwargs`` reach the scheduler — e.g. ``spec_k=4,
+        draft_engine=...`` runs the speculative path."""
         from theanompi_tpu.serving.scheduler import (
             ContinuousBatchingScheduler, Request,
         )
 
-        sched = ContinuousBatchingScheduler(self, params=params)
+        sched = ContinuousBatchingScheduler(self, params=params,
+                                            **sched_kwargs)
         sched.submit(
             Request(id="greedy", prompt=list(prompt), max_new_tokens=n_new)
         )

@@ -130,7 +130,8 @@ class ContinuousBatchingScheduler:
     """
 
     def __init__(self, engine, metrics=None, params=None,
-                 clock=time.perf_counter, pool=None):
+                 clock=time.perf_counter, pool=None,
+                 spec_k: int = 0, draft_engine=None, draft_params=None):
         self.engine = engine
         self.metrics = metrics
         self.params = params if params is not None else engine.model.params
@@ -176,6 +177,28 @@ class ContinuousBatchingScheduler:
             self.pool = None
             self.prefix = None
             self.cache = engine.init_cache()
+        self._spec = None
+        if int(spec_k):
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding (spec_k>0) requires a paged "
+                    "engine — the verify dispatch is the chunked-prefill "
+                    "machinery"
+                )
+            if draft_engine is None:
+                raise ValueError(
+                    "spec_k>0 needs a draft_engine (see "
+                    "models.transformer.make_draft)"
+                )
+            from theanompi_tpu.serving.spec import SpecDecoder
+
+            self._spec = SpecDecoder(
+                engine, draft_engine, int(spec_k),
+                draft_params=draft_params,
+            )
+        elif draft_engine is not None:
+            raise ValueError("draft_engine given but spec_k=0 — pass "
+                             "spec_k>=1 to enable speculation")
 
     # ------------------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -199,6 +222,12 @@ class ContinuousBatchingScheduler:
         self.queue.append(request)
         _ADMITTED.inc()
         _QUEUE.set(len(self.queue))
+
+    def spec_summary(self) -> Optional[Dict]:
+        """Speculation accounting for this run (None when spec is off):
+        rounds, dispatch counts, proposed/accepted totals, accept_rate,
+        tokens_per_round — the ``detail.spec`` feed for bench_serve."""
+        return self._spec.summary() if self._spec is not None else None
 
     @property
     def n_active(self) -> int:
@@ -227,6 +256,8 @@ class ContinuousBatchingScheduler:
             slot.decoding = False
             self._tables[i, :] = 0
             self._lengths[i] = 0
+            if self._spec is not None:
+                self._spec.release_slot(i)
         slot.request = None
         slot.produced = 0
         self._active[i] = False
@@ -263,9 +294,21 @@ class ContinuousBatchingScheduler:
         path with a dummy key.  Greedy rows are exact argmax; sampling
         rows draw with the SAME per-request key as the single-row
         sampler, so batching never perturbs a stream."""
+        return self._pick_tokens(
+            [(r, len(r.output)) if r is not None else None for r in reqs],
+            logits,
+        )
+
+    def _pick_tokens(self, picks, logits):
+        """The general batched pick: row i of ``logits`` (N, V) draws
+        for ``picks[i] = (request, token_index)`` (None = discarded
+        row).  The explicit token index is what the speculative-verify
+        path needs — one dispatch picks a request's NEXT ``k+1`` tokens
+        at indices ``len(output) + [0, k]``, each with the exact key the
+        non-speculative path would have used at that index."""
         import jax.numpy as jnp
 
-        if not any(r is not None and r.temperature > 0.0 for r in reqs):
+        if not any(p is not None and p[0].temperature > 0.0 for p in picks):
             return np.asarray(jnp.argmax(logits, axis=-1))
         if self._sampler is None:
             from theanompi_tpu.serving.sampling import Sampler
@@ -273,18 +316,17 @@ class ContinuousBatchingScheduler:
             self._sampler = Sampler()
         from theanompi_tpu.serving.sampling import request_key
 
-        n = len(reqs)
+        n = len(picks)
         temps = np.zeros((n,), np.float32)
         topks = np.zeros((n,), np.int32)
         keys = np.zeros((n, 2), np.uint32)
-        for i, r in enumerate(reqs):
-            if r is None or r.temperature == 0.0:
+        for i, p in enumerate(picks):
+            if p is None or p[0].temperature == 0.0:
                 continue
+            r, idx = p
             temps[i] = r.temperature
             topks[i] = r.top_k
-            keys[i] = np.asarray(
-                request_key(r.seed, r.id, len(r.output))
-            )
+            keys[i] = np.asarray(request_key(r.seed, r.id, idx))
         return self._sampler.pick_batch(logits, keys, temps, topks)
 
     def _emit(self, i: int, token: int) -> bool:
@@ -489,10 +531,100 @@ class ContinuousBatchingScheduler:
                 self._finish(i)
         return produced
 
+    # ------------------------------------------------------------------
+    # speculative tick (serving/spec.py holds the draft-side state)
+    # ------------------------------------------------------------------
+    def _spec_tick_paged(self) -> int:
+        """One speculative round replacing the plain decode tick: the
+        draft proposes up to ``k`` tokens per decoding lane, the target
+        scores all of them in ONE ``verify_chunks`` dispatch, and each
+        lane emits its accepted run plus the target's own next pick
+        (1..k+1 tokens).  Token streams are identical to the plain tick
+        by construction — position ``j``'s pick is only used when every
+        earlier proposal matched the target's pick."""
+        spec = self._spec
+        decoding = np.array([s.decoding for s in self.slots], dtype=bool)
+        if not decoding.any():
+            return 0
+        for i, slot in enumerate(self.slots):
+            if decoding[i] and not spec._blocks[i]:
+                spec.ensure_slot(i, slot.request.prompt,
+                                 slot.request.max_new_tokens)
+        n = len(self.slots)
+        k = spec.k
+        last = np.zeros((n,), np.int32)
+        k_eff = np.zeros((n,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if not decoding[i]:
+                continue
+            last[i] = slot.request.output[-1]
+            # budget clamp: a lane about to finish verifies a shorter
+            # chunk — rows past its block allocation must never hold
+            # live K/V.  k_eff is DATA (true_len below), never a shape.
+            rem = slot.request.max_new_tokens - slot.produced
+            k_eff[i] = min(k, rem - 1)
+        p0 = self._lengths.copy()
+        props = spec.propose(decoding, last, k_eff)
+        c = k + 1
+        tokens = np.zeros((n, c), np.int32)
+        true_len = np.zeros((n,), np.int32)
+        for i in range(n):
+            if not decoding[i]:
+                continue
+            tokens[i, 0] = last[i]
+            tokens[i, 1:1 + k_eff[i]] = props[i, :k_eff[i]]
+            true_len[i] = k_eff[i] + 1
+        with obs.span("spec_verify", active=int(decoding.sum()),
+                      proposed=int(k_eff.sum())):
+            self.state, logits = self.engine.verify_chunks(
+                self.params, self.state, tokens, self._tables, p0,
+                true_len, decoding,
+            )
+        spec.stats["verify_dispatches"] += 1
+        spec.stats["rounds"] += 1
+        picks = self._pick_tokens(
+            [
+                (self.slots[i].request,
+                 len(self.slots[i].request.output) + j)
+                if decoding[i] and j <= k_eff[i] else None
+                for i in range(n) for j in range(c)
+            ],
+            logits.reshape(n * c, -1),
+        ).reshape(n, c)
+        produced = 0
+        for i in range(n):
+            if not decoding[i]:
+                continue
+            slot = self.slots[i]
+            a = 0
+            while a < k_eff[i] and int(picks[i, a]) == int(props[i, a]):
+                a += 1
+            finished = False
+            m = 0
+            for j in range(a + 1):  # accepted proposals + the pick
+                m += 1
+                produced += 1
+                if self._emit(i, int(picks[i, j])):
+                    finished = True
+                    break
+            spec.note_lane(int(k_eff[i]), a, m)
+            # target K/V bookkeeping: rows p0..p0+m-1 hold the emitted
+            # prefix's tokens; everything past them is masked garbage
+            self._lengths[i] = int(p0[i]) + m
+            if finished:
+                self._finish(i)  # also releases the draft mirror
+            else:
+                spec.commit(i, a, int(k_eff[i]), props[i], int(last[i]),
+                            int(p0[i]))
+        return produced
+
     def _step_paged(self) -> int:
         self._admit_paged()
         produced = self._prefill_tick_paged()
-        produced += self._decode_tick_paged()
+        produced += (
+            self._spec_tick_paged() if self._spec is not None
+            else self._decode_tick_paged()
+        )
         return produced
 
     # ------------------------------------------------------------------
@@ -537,5 +669,7 @@ class ContinuousBatchingScheduler:
                 stats["pool_blocks"] = self.pool.n_blocks - 1
                 if self.prefix is not None:
                     stats["prefix_entries"] = len(self.prefix)
+                if self._spec is not None:
+                    stats["spec"] = self._spec.summary()
             self.metrics.set_engine_stats(stats)
         return self.finished
