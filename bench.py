@@ -385,7 +385,9 @@ def main():
         # entry point, two benches; bench_serve.py owns the schema
         import bench_serve
 
-        bench_serve.main()
+        # explicit empty argv: bench.py's own flags must not leak into
+        # bench_serve's parser (--replicas rides the env knob here)
+        bench_serve.main([])
         return
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
     # span tracing for the whole bench (bounded buffer): the emitted

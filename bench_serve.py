@@ -37,6 +37,16 @@ could not even pose:
   cache bytes for kv_dtype='int8' vs 'fp32' (the >= 2x capacity
   criterion) and a greedy-drift probe (fraction of greedy tokens that
   differ across the quantized cache — the gate bounds it).
+- **the serving fleet** (``detail.fleet``, ``--replicas N`` /
+  ``THEANOMPI_BENCH_SERVE_REPLICAS``) — N replicas behind the
+  ``serving/fleet.py`` router: prefix-affinity routing vs round-robin
+  on a multi-tenant shared-prefix workload (per-replica tokens/s,
+  affinity hit-rate, reused vs prefilled tokens), the radix-vs-chain
+  prefix cache comparison under pool pressure (radix hit-rate must
+  beat chain with strictly fewer prefilled tokens — outputs pinned
+  identical), a kill-one-replica failover probe (re-admissions,
+  token-identity vs the uninterrupted fleet) and a health-shed probe
+  (zero admissions while red).
 
 Protocol:
 - ``TransformerLM`` at the flagship serve config (rehearsal shrinks it,
@@ -128,6 +138,11 @@ _KNOBS_REAL = dict(
     spec_emb_boost=10.0,
     # int8-KV capacity + drift probe
     kvq_prompts=4, kvq_new_tokens=16,
+    # serving-fleet probe: replicas × multi-tenant shared prefixes
+    fleet_replicas=3, fleet_prefixes=3, fleet_requests_per_prefix=4,
+    fleet_prefix_len=64, fleet_tail=8, fleet_new_tokens=8,
+    fleet_slots=4, fleet_evict_after_s=2.0,
+    fleet_failover_requests=4, fleet_failover_new_tokens=24,
 )
 _KNOBS_REHEARSAL = dict(
     d_model=32, n_heads=4, n_layers=2, vocab_size=64, seq_len=64,
@@ -147,6 +162,10 @@ _KNOBS_REHEARSAL = dict(
     spec_prompt_lo=4, spec_prompt_hi=16, spec_damp=0.003,
     spec_emb_boost=10.0,
     kvq_prompts=4, kvq_new_tokens=8,
+    fleet_replicas=3, fleet_prefixes=3, fleet_requests_per_prefix=4,
+    fleet_prefix_len=24, fleet_tail=4, fleet_new_tokens=4,
+    fleet_slots=2, fleet_evict_after_s=2.0,
+    fleet_failover_requests=4, fleet_failover_new_tokens=16,
 )
 
 
@@ -321,6 +340,262 @@ def _kv_quant_probe(model, engine, knobs, prompts):
     }
 
 
+def _fleet_probe(model, knobs, n_replicas):
+    """detail.fleet: the multi-replica front door measured four ways —
+    affinity-vs-round-robin routing, radix-vs-chain caching under pool
+    pressure, kill-one-replica failover, and health shedding.  All
+    in-process (the same protocol a TCP replica serves); wall-clock is
+    real."""
+    import numpy as np
+
+    from theanompi_tpu.serving import (
+        ContinuousBatchingScheduler, PagedServingEngine, Request,
+    )
+    from theanompi_tpu.serving.fleet import FleetRouter, ServeReplica
+
+    bs = knobs["block_size"]
+    geom = dict(
+        n_slots=knobs["fleet_slots"], max_len=knobs["max_len"],
+        block_size=bs, prefill_chunk=knobs["prefill_chunk"],
+    )
+    engines = [PagedServingEngine(model, **geom) for _ in range(n_replicas)]
+    rng = np.random.RandomState(4)
+    vocab = knobs["vocab_size"]
+    prefixes = [
+        rng.randint(0, vocab, size=knobs["fleet_prefix_len"]).tolist()
+        for _ in range(knobs["fleet_prefixes"])
+    ]
+    tails = [
+        rng.randint(0, vocab, size=knobs["fleet_tail"]).tolist()
+        for _ in range(
+            knobs["fleet_prefixes"] * knobs["fleet_requests_per_prefix"]
+        )
+    ]
+    new = knobs["fleet_new_tokens"]
+
+    def build(affinity=True, n=None):
+        reps = [
+            ServeReplica(f"b{i}", engines[i]).start()
+            for i in range(n or n_replicas)
+        ]
+        router = FleetRouter(
+            evict_after_s=knobs["fleet_evict_after_s"], affinity=affinity,
+        )
+        for rep in reps:
+            router.add_replica(rep.name, rep)
+        return reps, router
+
+    def drain(reps):
+        deadline = time.perf_counter() + 600
+        while not all(r.scheduler.idle for r in reps):
+            if time.perf_counter() > deadline:
+                raise RuntimeError("fleet probe replicas never drained")
+            time.sleep(0.005)
+
+    def warm():
+        reps, router = build()
+        for i, rep in enumerate(reps):
+            router.submit(Request(
+                id=f"w{i}", prompt=prefixes[0][: bs + 1],
+                max_new_tokens=2,
+            ))
+        router.run(timeout_s=600)
+        for rep in reps:
+            rep.stop()
+
+    def routing_arm(affinity):
+        reps, router = build(affinity=affinity)
+        # tenant warmup wave: one request per prefix, run to completion
+        # so caches are resident and summaries gossiped before the
+        # measured wave (affinity can only follow blocks that exist)
+        rid = 0
+        for p in prefixes:
+            router.submit(Request(id=f"f{rid}", prompt=list(p) + tails[rid],
+                                  max_new_tokens=new))
+            rid += 1
+        router.run(timeout_s=600)
+        t0 = time.perf_counter()
+        n_tokens = 0
+        for wave in range(knobs["fleet_requests_per_prefix"] - 1):
+            for p in prefixes:
+                router.submit(Request(
+                    id=f"f{rid}", prompt=list(p) + tails[rid],
+                    max_new_tokens=new,
+                ))
+                rid += 1
+            router.run(timeout_s=600)
+        dt = time.perf_counter() - t0
+        n_tokens = sum(len(v) for v in router.outputs().values())
+        stats = router.fleet_stats()
+        # prefix accounting aggregated across the replicas' schedulers
+        hit_tokens = sum(
+            r.scheduler.stats["prefix_hit_tokens"] for r in reps
+        )
+        fed_tokens = sum(
+            r.scheduler.stats["prefill_tokens"] for r in reps
+        )
+        prompt_tokens = sum(
+            len(prefixes[i % len(prefixes)]) + len(tails[i])
+            for i in range(rid)
+        )
+        for rep in reps:
+            rep.stop()
+        return {
+            "routed_affine": stats["routed_affine"],
+            "routed_fallback": stats["routed_fallback"],
+            "affinity_hit_rate": stats["affinity_hit_rate"],
+            "prefix_hit_tokens": hit_tokens,
+            "prefill_tokens": fed_tokens,
+            "prompt_tokens": prompt_tokens,
+            "hit_rate": round(hit_tokens / max(1, prompt_tokens), 4),
+            "wall_s": round(dt, 3),
+            "tokens_per_sec": round(n_tokens / dt, 2) if dt > 0 else 0.0,
+            "per_replica_tokens": {
+                name: row["tokens_out"]
+                for name, row in stats["replicas"].items()
+            },
+        }
+
+    def cache_compare():
+        """radix vs chain on ONE engine under pool pressure: shared
+        trunk + cold fillers; the radix tree evicts only the
+        shortfall, the chain sweeps everything idle."""
+        engine = engines[0]
+        trunk = rng.randint(0, vocab, size=2 * bs).tolist()
+        tail_len = max(1, bs // 2)
+        filler_len = 4 * bs - 4
+        phase1 = [trunk + rng.randint(0, vocab, size=tail_len).tolist()
+                  for _ in range(2)]
+        fillers = [rng.randint(0, vocab, size=filler_len).tolist()
+                   for _ in range(2)]
+        phase3 = [trunk + rng.randint(0, vocab, size=tail_len).tolist()
+                  for _ in range(2)]
+        out = {}
+        for impl in ("chain", "radix"):
+            sched = ContinuousBatchingScheduler(
+                engine, pool=engine.make_pool(10), prefix_impl=impl
+            )
+            rid = 0
+            for batch in (phase1, fillers, phase3):
+                for p in batch:
+                    sched.submit(Request(id=f"c{rid}", prompt=list(p),
+                                         max_new_tokens=2))
+                    rid += 1
+                sched.run()
+            prompt_tokens = sum(
+                len(p) for p in phase1 + fillers + phase3
+            )
+            out[impl] = {
+                "hit_tokens": sched.stats["prefix_hit_tokens"],
+                "prefill_tokens": sched.stats["prefill_tokens"],
+                "hit_rate": round(
+                    sched.stats["prefix_hit_tokens"] / prompt_tokens, 4
+                ),
+                "outputs": dict(sched.finished),
+            }
+        identical = out["chain"]["outputs"] == out["radix"]["outputs"]
+        return {
+            "radix_hit_rate": out["radix"]["hit_rate"],
+            "chain_hit_rate": out["chain"]["hit_rate"],
+            "radix_hit_tokens": out["radix"]["hit_tokens"],
+            "chain_hit_tokens": out["chain"]["hit_tokens"],
+            "radix_prefill_tokens": out["radix"]["prefill_tokens"],
+            "chain_prefill_tokens": out["chain"]["prefill_tokens"],
+            "outputs_identical": identical,
+        }
+
+    def failover():
+        n_req = knobs["fleet_failover_requests"]
+        f_new = knobs["fleet_failover_new_tokens"]
+        prompts = [
+            rng.randint(0, vocab,
+                        size=int(rng.randint(bs // 2, 2 * bs))).tolist()
+            for _ in range(n_req)
+        ]
+
+        def run_arm(kill):
+            reps, router = build(n=2)
+            for j, p in enumerate(prompts):
+                router.submit(Request(id=f"k{j}", prompt=list(p),
+                                      max_new_tokens=f_new))
+            if kill:
+                deadline = time.perf_counter() + 600
+                while True:
+                    by = {}
+                    for s in router._streams.values():
+                        if not s.done and s.tokens:
+                            by[s.replica] = by.get(s.replica, 0) + 1
+                    if by and max(by.values()) >= 2:
+                        break
+                    if time.perf_counter() > deadline:
+                        break
+                    router.pump()
+                    time.sleep(0.002)
+                victim = max(by, key=by.get)
+                next(r for r in reps if r.name == victim).kill()
+            out = router.run(timeout_s=600)
+            stats = router.fleet_stats()
+            for rep in reps:
+                rep.stop()
+            return out, stats
+
+        base_out, _ = run_arm(kill=False)
+        chaos_out, stats = run_arm(kill=True)
+        return {
+            "evictions": stats["evictions"],
+            "readmissions": stats["readmissions"],
+            "token_identical": base_out == chaos_out,
+        }
+
+    def shed():
+        reps, router = build(n=2)
+        red = {"v": False}
+        reps[0].set_health_fn(lambda: not red["v"])
+        red["v"] = True
+        router.pump()
+        for j in range(3):
+            router.submit(Request(id=f"s{j}", prompt=[j + 1, 2, 3],
+                                  max_new_tokens=2))
+        router.run(timeout_s=600)
+        tokens_while_red = router.fleet_stats()["replicas"]["b0"][
+            "tokens_out"
+        ]
+        red["v"] = False
+        router.pump()
+        stats = router.fleet_stats()
+        for rep in reps:
+            rep.stop()
+        return {
+            "shed_events": stats["shed_events"],
+            "tokens_admitted_while_red": tokens_while_red,
+            "shed_seconds": stats["replicas"]["b0"]["shed_seconds"],
+        }
+
+    warm()
+    affine = routing_arm(affinity=True)
+    rr = routing_arm(affinity=False)
+    detail = {
+        "replicas": n_replicas,
+        "workload": {
+            "prefixes": knobs["fleet_prefixes"],
+            "requests_per_prefix": knobs["fleet_requests_per_prefix"],
+            "prefix_len": knobs["fleet_prefix_len"],
+            "tail_len": knobs["fleet_tail"],
+            "max_new_tokens": new,
+        },
+        "affinity": affine,
+        "round_robin": rr,
+        "affinity_beats_round_robin": (
+            affine["prefix_hit_tokens"] > rr["prefix_hit_tokens"]
+            and affine["prefill_tokens"] < rr["prefill_tokens"]
+        ),
+        "cache_compare": cache_compare(),
+        "failover": failover(),
+        "shed": shed(),
+    }
+    return detail
+
+
 def _long_tail_prompts(rng, knobs):
     """Mixed-length burst: mostly short prompts, a long tail near
     max_len — the workload shape that wastes contiguous slot memory."""
@@ -338,10 +613,22 @@ def _long_tail_prompts(rng, knobs):
     return out
 
 
-def main():
+def main(argv=None):
+    import argparse
+
     import numpy as np
 
+    ap = argparse.ArgumentParser(prog="bench_serve.py")
+    ap.add_argument(
+        "--replicas", type=int,
+        default=int(os.environ.get("THEANOMPI_BENCH_SERVE_REPLICAS", "0")),
+        help="serving-fleet probe size (0 = knob default; the probe "
+        "runs whenever the paged engine does)",
+    )
+    args = ap.parse_args(argv)
+
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
+    n_fleet = args.replicas or knobs["fleet_replicas"]
     # same attribution contract as bench.py: the BENCH_serve line
     # carries trace-export paths + a metrics snapshot (TTFT/TPOT
     # histograms, slot/queue gauges, prefill-bucket counters,
@@ -517,6 +804,11 @@ def main():
         kv_quant_detail = _kv_quant_probe(model, engine, knobs, prompts)
         spec_detail = _spec_probe(knobs)
 
+    # ---- serving-fleet probe (ISSUE 12) -----------------------------
+    fleet_detail = None
+    if engine_kind != "contiguous" and n_fleet >= 2:
+        fleet_detail = _fleet_probe(model, knobs, n_fleet)
+
     summary = metrics.summary()
     n_tokens = summary["n_tokens_out"]
     detail = {
@@ -555,6 +847,8 @@ def main():
         detail["spec"] = spec_detail
     if kv_quant_detail is not None:
         detail["kv_quant"] = kv_quant_detail
+    if fleet_detail is not None:
+        detail["fleet"] = fleet_detail
     try:
         paths = observability.dump_all(prefix="bench_serve_")
         detail["observability"] = {

@@ -104,6 +104,26 @@
 #   PERF_GATE_CHAOS_REJOIN_AFTER seconds before the supervisor respawns
 #                           the killed rank (default 2)
 #
+# Fleet leg (the serving-fleet kill drill; docs/fleet.md):
+#   PERF_GATE_FLEET         1 (default) = run the serving chaos drill:
+#                           an N-replica fleet behind the prefix-affine
+#                           router, one replica KILLED with streams in
+#                           flight.  REQUIRE exactly one eviction (one
+#                           replica_evicted alert), every in-flight
+#                           stream re-admitted on a survivor, outputs
+#                           token-identical to the uninterrupted fleet
+#                           run, and p99 TTFT/TPOT within tolerance of
+#                           that run.  0 = skip (escape hatch).
+#   PERF_GATE_FLEET_JSON    pre-produced drill verdict JSON (skips
+#                           running — the tier-1 smoke path)
+#   PERF_GATE_FLEET_CMD     command producing the drill JSON (default:
+#                           python -m theanompi_tpu.runtime.chaos
+#                           --rule SERVE)
+#   PERF_GATE_FLEET_TOLERANCE   relative p99 tolerance vs the
+#                           uninterrupted run (default 2.0; the drill
+#                           keeps a 3s absolute floor for the CI-sized
+#                           eviction window)
+#
 # Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
 set -euo pipefail
 
@@ -419,6 +439,61 @@ for rule, v in sorted(rules.items()):
           f"{v.get('rejoins', 0) + v.get('readmissions', 0)} re-admission(s), "
           f"loss delta {v.get('loss_delta')} (tol {v.get('loss_tolerance')})",
           file=sys.stderr)
+PY
+fi
+
+# ---- 8. fleet leg: the serving-fleet kill drill -----------------------------
+if [ "${PERF_GATE_FLEET:-1}" = "1" ]; then
+    FLEET_JSON="${PERF_GATE_FLEET_JSON:-}"
+    if [ -z "$FLEET_JSON" ]; then
+        FLEET_JSON="$WORKDIR/fleet.json"
+        FLEET_TOL="${PERF_GATE_FLEET_TOLERANCE:-2.0}"
+        FLEET_CMD="${PERF_GATE_FLEET_CMD:-env JAX_PLATFORMS=cpu python -m theanompi_tpu.runtime.chaos --rule SERVE --serve-p99-tolerance $FLEET_TOL}"
+        echo "[perf_gate] fleet drill: $FLEET_CMD" >&2
+        set +e
+        sh -c "$FLEET_CMD" > "$FLEET_JSON"
+        FLEET_RC=$?
+        set -e
+        if [ ! -s "$FLEET_JSON" ]; then
+            echo "[perf_gate] FLEET VIOLATION: drill produced no verdict (exit $FLEET_RC)" >&2
+            exit 1
+        fi
+    fi
+    # structure check, independent of the drill's self-assessment:
+    # exactly one eviction per kill, token-identical failover, at least
+    # one re-admission, p99 deltas inside their recorded tolerances
+    python - "$FLEET_JSON" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+v = (doc.get("rules") or {}).get("SERVE")
+if not isinstance(v, dict):
+    sys.exit("[perf_gate] FLEET VIOLATION: drill verdict has no SERVE rule")
+for viol in v.get("violations", []):
+    print(f"[perf_gate] FLEET VIOLATION: {viol}", file=sys.stderr)
+if not v.get("ok"):
+    sys.exit(1)
+kills = v.get("kills_observed", 0)
+if kills < 1 or v.get("evictions") != kills:
+    sys.exit(f"[perf_gate] FLEET VIOLATION: {v.get('evictions')} "
+             f"eviction(s) for {kills} kill(s)")
+if v.get("eviction_alerts") != kills:
+    sys.exit(f"[perf_gate] FLEET VIOLATION: {v.get('eviction_alerts')} "
+             f"replica_evicted alert(s) for {kills} kill(s)")
+if v.get("readmissions", 0) < 1:
+    sys.exit("[perf_gate] FLEET VIOLATION: no stream re-admitted — the "
+             "kill was a serving blackout, not a survived failure")
+if v.get("token_identical") is not True:
+    sys.exit("[perf_gate] FLEET VIOLATION: failover outputs are NOT "
+             "token-identical to the uninterrupted run")
+for m in ("ttft_p99_s", "tpot_p99_s"):
+    delta, tol = v.get(f"{m}_delta"), v.get(f"{m}_tolerance")
+    if delta is None or tol is None or delta > tol:
+        sys.exit(f"[perf_gate] FLEET VIOLATION: {m} delta {delta}s "
+                 f"exceeds tolerance {tol}s")
+print(f"[perf_gate] fleet: {kills} kill -> {v.get('evictions')} eviction, "
+      f"{v.get('readmissions')} re-admission(s), token-identical, "
+      f"ttft p99 delta {v.get('ttft_p99_s_delta')}s "
+      f"(tol {v.get('ttft_p99_s_tolerance')}s)", file=sys.stderr)
 PY
 fi
 echo "[perf_gate] green" >&2

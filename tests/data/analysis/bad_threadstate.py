@@ -1,0 +1,81 @@
+"""Seeded GL-T corpus: unlocked mutation of shared state dicts.
+
+A roster-shaped class whose dict is mutated under its lock in some
+methods and bare in others — the exact hazard surface the serving
+fleet's router/replica tables add (ISSUE 12).  The pass must fire on
+the bare mutations and stay silent on every sanctioned pattern in
+``CleanRoster``.
+"""
+
+import threading
+
+
+class RacyRoster:
+    """Mutates self._members under the lock in beat(), bare elsewhere."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+        self._departed = {}
+        # __init__ population is construction, never a finding
+        self._members["seed"] = 0
+
+    def beat(self, member):
+        with self._lock:
+            self._members[member] = 1  # sanctioned: under the lock
+
+    def evict_bare_subscript(self, member):
+        # BAD: subscript assign outside the lock
+        self._members[member] = None
+
+    def evict_bare_del(self, member):
+        # BAD: del outside the lock
+        del self._members[member]
+
+    def evict_bare_pop(self, member):
+        # BAD: dict mutator call outside the lock
+        self._members.pop(member, None)
+
+    def never_locked_dict_is_fine(self, member):
+        # _departed is never mutated under the lock anywhere in this
+        # class, so the pass cannot know it is shared — out of scope
+        self._departed[member] = 1
+
+    def _drop_locked(self, member):
+        # sanctioned: the *_locked naming convention promises the
+        # caller holds self._lock (TcpMailbox._send_locked style)
+        self._members.pop(member, None)
+
+    def sweep(self):
+        with self._lock:
+            self._drop_locked("gone")
+
+
+class CleanRoster:
+    """Every mutation under the lock — zero findings."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def join(self, member):
+        with self._lock:
+            self._members[member] = 0
+
+    def leave(self, member):
+        with self._lock:
+            self._members.pop(member, None)
+
+    def snapshot(self):
+        # reads are out of scope (flagging them would drown the signal)
+        return dict(self._members)
+
+
+class NoLockNoOpinion:
+    """A class without a lock is not analyzed at all."""
+
+    def __init__(self):
+        self.table = {}
+
+    def put(self, k, v):
+        self.table[k] = v

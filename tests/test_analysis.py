@@ -119,6 +119,28 @@ def test_collectives_pass_golden():
     }
 
 
+def test_threadstate_pass_golden():
+    """GL-T001: the fleet's hazard surface — a dict mutated under the
+    class's lock in one method and bare in another fires; __init__
+    population, *_locked helpers, never-locked dicts, lockless
+    classes, and reads all stay silent."""
+    findings = _findings("bad_threadstate.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-T001", "evict_bare_subscript"),
+            ("GL-T001", "evict_bare_del"),
+            ("GL-T001", "evict_bare_pop"),
+        ]
+    )
+    for f in findings:
+        assert f.severity == "error"
+        assert "_members" in f.message and "_lock" in f.message
+    clean = {"beat", "never_locked_dict_is_fine", "_drop_locked",
+             "join", "leave", "snapshot", "put", "__init__"}
+    assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+
+
 def test_lockorder_pass_golden():
     findings = _findings("bad_locks.py")
     rules = sorted(f.rule for f in findings)
@@ -140,6 +162,7 @@ def test_every_pass_fires_on_corpus():
         "collectives",
         "lockorder",
         "steptrace",
+        "threadstate",
     }
 
 

@@ -52,6 +52,7 @@ def _run_gate(env_extra):
     # for the chaos leg's multi-process drill (PERF_GATE_CHAOS_JSON)
     env.setdefault("PERF_GATE_SERVE", "0")
     env.setdefault("PERF_GATE_CHAOS", "0")
+    env.setdefault("PERF_GATE_FLEET", "0")
     env.update(env_extra)
     return subprocess.run(
         ["bash", GATE], capture_output=True, text=True, env=env,
@@ -535,4 +536,147 @@ def test_gate_chaos_leg_skippable(fixtures):
     assert r.returncode == 0, r.stderr
     assert "chaos drill" not in r.stderr
     assert "chaos [" not in r.stderr
+    assert "green" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# fleet leg (ISSUE 12): the serving-fleet kill drill verdict gates the
+# round — smoke-tested on fixture verdicts like the chaos leg
+# ---------------------------------------------------------------------------
+
+def _fleet_json(path, ok=True, kills=1, evictions=1, eviction_alerts=None,
+                readmissions=3, token_identical=True,
+                ttft_delta=0.4, ttft_tol=3.0, tpot_delta=0.05,
+                tpot_tol=3.0, violations=None):
+    doc = {"rules": {"SERVE": {
+        "rule": "SERVE",
+        "ok": ok,
+        "violations": list(violations or ()),
+        "n_replicas": 3,
+        "n_requests": 8,
+        "kills_observed": kills,
+        "killed": "r0",
+        "streams_in_flight_at_kill": 2,
+        "evictions": evictions,
+        "eviction_alerts": (
+            evictions if eviction_alerts is None else eviction_alerts
+        ),
+        "readmissions": readmissions,
+        "readmission_alerts": readmissions,
+        "token_identical": token_identical,
+        "baseline": {"ttft_p99_s": 0.4, "tpot_p99_s": 0.02,
+                     "n_tokens": 192},
+        "chaos": {"ttft_p99_s": 0.4 + ttft_delta,
+                  "tpot_p99_s": 0.02 + tpot_delta, "n_tokens": 192},
+        "ttft_p99_s_delta": ttft_delta,
+        "ttft_p99_s_tolerance": ttft_tol,
+        "tpot_p99_s_delta": tpot_delta,
+        "tpot_p99_s_tolerance": tpot_tol,
+    }}, "ok": ok}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def test_gate_fleet_leg_green(fixtures, tmp_path):
+    base, good, _ = fixtures
+    fleet = _fleet_json(tmp_path / "fleet.json")
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_FLEET": "1",
+        "PERF_GATE_FLEET_JSON": fleet,
+    })
+    assert r.returncode == 0, r.stderr
+    assert "fleet: 1 kill -> 1 eviction" in r.stderr
+    assert "token-identical" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_fleet_leg_detects_blackout(fixtures, tmp_path):
+    """A drill whose in-flight streams never re-admitted is a serving
+    blackout: the structure check refuses it even when the verdict
+    self-reports ok."""
+    base, good, _ = fixtures
+    fleet = _fleet_json(tmp_path / "fleet.json", readmissions=0)
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_FLEET": "1",
+        "PERF_GATE_FLEET_JSON": fleet,
+    })
+    assert r.returncode != 0
+    assert "no stream re-admitted" in (r.stdout + r.stderr)
+
+
+def test_gate_fleet_leg_fails_on_non_identical_output(fixtures, tmp_path):
+    base, good, _ = fixtures
+    fleet = _fleet_json(
+        tmp_path / "fleet.json", ok=False, token_identical=False,
+        violations=["outputs diverged from the uninterrupted run"],
+    )
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_FLEET": "1",
+        "PERF_GATE_FLEET_JSON": fleet,
+    })
+    assert r.returncode != 0
+    assert "FLEET VIOLATION" in r.stderr
+    assert "outputs diverged" in (r.stdout + r.stderr)
+
+
+def test_gate_fleet_leg_fails_on_eviction_mismatch(fixtures, tmp_path):
+    """Two evictions for one kill = the roster double-paged; one kill
+    with zero eviction alerts = the live plane missed it.  Both are
+    refused independent of the drill's self-assessment."""
+    base, good, _ = fixtures
+    fleet = _fleet_json(tmp_path / "fleet.json", evictions=2,
+                        eviction_alerts=2)
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_FLEET": "1",
+        "PERF_GATE_FLEET_JSON": fleet,
+    })
+    assert r.returncode != 0
+    assert "eviction(s) for 1 kill(s)" in (r.stdout + r.stderr)
+
+
+def test_gate_fleet_leg_fails_on_p99_overrun(fixtures, tmp_path):
+    base, good, _ = fixtures
+    fleet = _fleet_json(tmp_path / "fleet.json", ttft_delta=5.0,
+                        ttft_tol=3.0)
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_FLEET": "1",
+        "PERF_GATE_FLEET_JSON": fleet,
+    })
+    assert r.returncode != 0
+    assert "exceeds tolerance" in (r.stdout + r.stderr)
+
+
+def test_gate_fleet_leg_skippable(fixtures):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_FLEET": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "fleet drill" not in r.stderr
+    assert "fleet:" not in r.stderr
     assert "green" in r.stderr

@@ -471,3 +471,97 @@ def _retry_count() -> float:
     if not doc:
         return 0.0
     return sum(float(row["value"]) for row in doc["series"])
+
+
+# ---------------------------------------------------------------------------
+# request() per-call deadline budget (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _deadline_count() -> float:
+    from theanompi_tpu import observability as obs
+
+    snap = obs.get_registry().snapshot()
+    doc = snap.get("transport_request_deadline_exceeded_total")
+    if not doc:
+        return 0.0
+    return sum(float(row["value"]) for row in doc["series"])
+
+
+def test_request_deadline_bounds_slow_reply():
+    """A slow-but-ACCEPTING endpoint is the case per-attempt timeouts
+    miss: the connect succeeds instantly, then the caller would sit in
+    recv for the full `timeout`.  deadline_s caps the whole call."""
+    import socket as _socket
+    import time as _time
+
+    from theanompi_tpu.parallel.transport import RequestDeadlineExceeded
+
+    port = find_free_port()
+    srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(4)  # accepts (kernel backlog) but never replies
+    before = _deadline_count()
+    t0 = _time.monotonic()
+    try:
+        with pytest.raises(RequestDeadlineExceeded):
+            request(("127.0.0.1", port), {"x": 1}, timeout=30,
+                    deadline_s=0.4)
+    finally:
+        srv.close()
+    assert _time.monotonic() - t0 < 5.0  # nowhere near timeout=30
+    assert _deadline_count() == before + 1
+
+
+def test_request_deadline_spans_the_whole_retry_ladder():
+    """Without a deadline every retry gets a fresh timeout; with one,
+    the ladder's sleeps + attempts share a single budget."""
+    import time as _time
+
+    from theanompi_tpu.parallel.transport import RequestDeadlineExceeded
+
+    port = find_free_port()  # nothing listening, ever
+    before = _deadline_count()
+    t0 = _time.monotonic()
+    with pytest.raises(RequestDeadlineExceeded):
+        request(("127.0.0.1", port), {"x": 1}, timeout=5,
+                connect_retries=100, retry_backoff_s=0.2,
+                deadline_s=0.5)
+    assert _time.monotonic() - t0 < 3.0  # not 100 x backoff
+    assert _deadline_count() == before + 1
+
+
+def test_request_without_deadline_is_unchanged():
+    """deadline_s=None keeps the pre-existing contract byte-for-byte:
+    a reachable server answers, no deadline counter movement."""
+    port = find_free_port()
+    ch = TcpServerChannel(port, lambda msg: {"echo": msg})
+    before = _deadline_count()
+    try:
+        reply = request(("127.0.0.1", port), {"x": 2}, timeout=10)
+        assert reply == {"echo": {"x": 2}}
+    finally:
+        ch.close()
+    assert _deadline_count() == before
+
+
+def test_deadline_counter_ships_to_the_live_plane():
+    """The satellite's observability half: the deadline counter rides
+    the ordinary telemetry frame (counter deltas), so the live doctor
+    sees SLO-busting transport stalls without any new plumbing."""
+    import time as _time
+
+    from theanompi_tpu.observability.live import Aggregator, TelemetryShipper
+    from theanompi_tpu.parallel.transport import RequestDeadlineExceeded
+
+    agg = Aggregator(period_s=0.1)
+    shipper = TelemetryShipper("rank0", aggregator=agg, period_s=0.1)
+    port = find_free_port()
+    with pytest.raises(RequestDeadlineExceeded):
+        request(("127.0.0.1", port), {"x": 1}, timeout=5,
+                connect_retries=100, retry_backoff_s=0.2, deadline_s=0.2)
+    frame = shipper.build_frame()
+    keys = [k for k in (frame.get("counters") or {})
+            if k.startswith("transport_request_deadline_exceeded_total")]
+    assert keys, sorted(frame.get("counters") or {})

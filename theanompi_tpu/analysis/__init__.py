@@ -10,7 +10,7 @@ lock inversions in the host-level async transport.  None of these need
 hardware to detect — they are visible in the AST — so this package
 checks them at review time, on CPU, in CI.
 
-Five passes, each pure-stdlib (no jax import — the CLI must start fast
+Six passes, each pure-stdlib (no jax import — the CLI must start fast
 and run on machines with no accelerator stack):
 
 - ``recompile``   (GL-J*): jit wrappers rebuilt per loop iteration,
@@ -33,6 +33,10 @@ and run on machines with no accelerator stack):
 - ``lockorder``   (GL-L*): a whole-package lock-acquisition-graph
   cycle detector (plus non-reentrant double-acquire) over the
   ``threading.Lock``/``RLock``/``Condition`` population.
+- ``threadstate`` (GL-T*): unlocked mutation of shared state dicts —
+  a class that mutates a dict under its own lock in one method and
+  bare in another (the roster/router surface the serving fleet adds)
+  is racing itself; ``__init__`` and ``*_locked`` helpers exempt.
 
 Findings carry severity + ``file:line`` and are matched against a
 checked-in baseline (``.graftlint_baseline.json`` at the repo root) so

@@ -28,13 +28,14 @@ from theanompi_tpu.analysis import (
     locks,
     recompile,
     step_trace,
+    threadstate,
 )
 from theanompi_tpu.analysis.findings import Finding, sort_key
 from theanompi_tpu.analysis.source import ParsedModule, parse_module
 
 BASELINE_NAME = ".graftlint_baseline.json"
 
-_PER_MODULE_PASSES = (recompile, donation, collectives)
+_PER_MODULE_PASSES = (recompile, donation, collectives, threadstate)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\-\s]+))?"

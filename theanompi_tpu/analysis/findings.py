@@ -8,7 +8,8 @@ and down a file — and hashes (rule, file, symbol, snippet) instead,
 which is stable until the flagged code itself changes.
 
 Pass ids: ``recompile`` | ``donation`` | ``collectives`` |
-``lockorder`` | ``steptrace`` (the interprocedural whole-step pass).
+``lockorder`` | ``steptrace`` (the interprocedural whole-step pass) |
+``threadstate`` (GL-T*, unlocked shared-dict mutation).
 ``FIXABLE_RULES`` names the rules the ``--fix`` rewriter
 (``analysis/fixer.py``) can repair mechanically; ``Finding.fixable``
 surfaces that in both expositions so a human (or CI annotate step)

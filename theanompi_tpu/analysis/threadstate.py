@@ -1,0 +1,193 @@
+"""Pass 6 — thread-safety of shared state dicts (GL-T*).
+
+The host layer keeps growing objects whose dicts are mutated from
+multiple threads: the membership ``Roster`` (exchange threads beat it,
+a sweep thread evicts from it), the serving fleet's replica/stream
+tables (the router's pump vs. the replica tick threads), the
+aggregator's rank views.  The codebase discipline is one lock per
+object and every dict mutation under it — but nothing *enforced* that
+until now, and the failure mode is nasty: a dict mutated during
+iteration throws ``RuntimeError`` on a rare interleaving, or worse,
+silently drops an entry.
+
+The pass is deliberately narrow (near-zero false positives beats
+coverage here — this is a tier-1 gate):
+
+1. **Scope**: classes that own a lock — ``self.<lock> =
+   threading.Lock()/RLock()/Condition()`` in their own body
+   (``LOCK_FACTORIES``, same identification as the lockorder pass).
+2. **Guarded attrs**: attribute names whose DICT mutations
+   (``self.x[k] = v``, ``del self.x[k]``, ``self.x.pop/update/clear/
+   setdefault/popitem(...)``) appear at least once lexically inside a
+   ``with self.<lock>`` block in any method of that class.  A dict the
+   class itself locks is declared shared by that act.
+3. **Findings** (GL-T001, error): a dict mutation of a guarded attr
+   OUTSIDE any ``with self.<lock>``, in any method except
+   ``__init__`` (construction precedes sharing) and except methods
+   whose name ends in ``_locked`` (the codebase's documented
+   convention for helpers whose contract is "caller holds the lock" —
+   ``TcpMailbox._send_locked``).
+
+Known blind spots (documented, not guessed at): bare ``.acquire()``
+calls, locks inherited from a base class in another module, and
+helpers called under the caller's lock without the ``_locked`` naming
+convention — rename the helper rather than suppressing the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import (
+    LOCK_FACTORIES,
+    ParsedModule,
+    attr_path,
+)
+
+PASS_ID = "threadstate"
+
+# dict-shaped mutators: the pass is about shared STATE DICTS, so list
+# appends etc. stay out of scope (far noisier, far less iterator-fatal)
+_DICT_MUTATORS = {"pop", "update", "clear", "setdefault", "popitem"}
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    """``self.x`` → ``"x"``; anything else (incl. ``self.x.y``) → None."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+class _Mutation:
+    __slots__ = ("attr", "node", "locked")
+
+    def __init__(self, attr: str, node: ast.AST, locked: bool):
+        self.attr = attr
+        self.node = node
+        self.locked = locked
+
+
+def _class_lock_attrs(m: ParsedModule, cls: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        if m.imports.resolve(node.value.func) not in LOCK_FACTORIES:
+            continue
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr is not None:
+                locks.add(attr)
+    return locks
+
+
+def _holds_lock(m: ParsedModule, node: ast.AST, cls: ast.ClassDef,
+                locks: Set[str]) -> bool:
+    """Is ``node`` lexically inside a ``with self.<lock>`` of this
+    class (any of its locks — which lock guards which dict is the
+    object's own convention; flagging cross-lock confusion would need
+    runtime knowledge the AST does not have)."""
+    cur = m.parents.get(node)
+    while cur is not None and cur is not cls:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                path = attr_path(item.context_expr)
+                if path and path.startswith("self."):
+                    if path[len("self."):] in locks:
+                        return True
+        cur = m.parents.get(cur)
+    return False
+
+
+def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
+                         locks: Set[str]) -> List[_Mutation]:
+    out: List[_Mutation] = []
+
+    def note(attr: Optional[str], node: ast.AST) -> None:
+        if attr is None:
+            return
+        out.append(
+            _Mutation(attr, node, _holds_lock(m, node, cls, locks))
+        )
+
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    note(_self_attr(t.value), node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    note(_self_attr(t.value), node)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _DICT_MUTATORS
+            ):
+                note(_self_attr(f.value), node)
+    return out
+
+
+def _exempt(m: ParsedModule, node: ast.AST) -> bool:
+    """__init__ (construction precedes sharing) and *_locked helpers
+    (contract: caller holds the lock)."""
+    fi = m.enclosing_function(node)
+    while fi is not None:
+        name = fi.qualname.rsplit(".", 1)[-1]
+        if name == "__init__" or name.endswith("_locked"):
+            return True
+        fi = fi.parent
+    return False
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        locks = _class_lock_attrs(m, node)
+        if not locks:
+            continue
+        mutations = _iter_dict_mutations(m, node, locks)
+        guarded: Dict[str, bool] = {}
+        for mu in mutations:
+            if mu.locked:
+                guarded[mu.attr] = True
+        for mu in mutations:
+            if mu.locked or mu.attr not in guarded:
+                continue
+            if _exempt(m, mu.node):
+                continue
+            findings.append(Finding(
+                rule="GL-T001",
+                pass_id=PASS_ID,
+                severity="error",
+                file=m.rel,
+                line=mu.node.lineno,
+                symbol=m.symbol_for(mu.node),
+                message=(
+                    f"unlocked mutation of shared state dict "
+                    f"'self.{mu.attr}': other methods of "
+                    f"{node.name} mutate it under "
+                    f"'with self.{sorted(locks)[0]}', so this bare "
+                    "mutation races them (dict-changed-during-"
+                    "iteration, lost entries).  Wrap it in the lock, "
+                    "or rename the enclosing helper *_locked if the "
+                    "caller provably holds it"
+                ),
+                snippet=m.snippet(mu.node.lineno),
+            ))
+    return findings

@@ -783,8 +783,10 @@ class Aggregator:
         self._missed_hb = 0
         self._primary_window = 0
         # training-plane membership: eviction counters already alerted
-        # on (flattened key -> cumulative count), for worker_evicted
+        # on (flattened key -> cumulative count), for worker_evicted /
+        # replica_evicted; same bookkeeping for fleet re-admissions
         self._evictions_alerted: Dict[str, float] = {}
+        self._readmissions_alerted: Dict[str, float] = {}
         self.verdict_log = (
             VerdictLog(persist_path, max_bytes=persist_max_bytes)
             if persist_path else None
@@ -1048,21 +1050,45 @@ class Aggregator:
         verdict["alerts"] = self.watchdog.evaluate(
             verdict, dead_ranks=tuple(dead if dead else ())
         )
-        # training-plane membership: evictions shipped in the rank
-        # counters become worker_evicted alerts — exactly one per
-        # evicted worker (the counters are cumulative; only the unseen
-        # increment alerts, so a re-shipped total can never double-page)
+        # membership: evictions shipped in the rank counters become
+        # worker_evicted (training planes) / replica_evicted (the serve
+        # fleet) alerts — exactly one per evicted member (the counters
+        # are cumulative; only the unseen increment alerts, so a
+        # re-shipped total can never double-page)
         for who, plane, n_new in self._new_evictions():
+            serve = plane == "serve"
             for _ in range(n_new):
                 verdict["alerts"].append(self.watchdog.raise_alert({
-                    "rule": "worker_evicted",
+                    "rule": "replica_evicted" if serve else "worker_evicted",
                     "rank": who,
                     "value": None,
                     "threshold": None,
                     "message": (
+                        f"serving fleet evicted replica {who} after "
+                        "missed heartbeats — its in-flight streams "
+                        "re-admit on the survivors"
+                        if serve else
                         f"training plane ({plane}) evicted rank {who} "
                         "after missed heartbeats — respawn/rejoin "
                         "expected, or capacity is down one worker"
+                    ),
+                    "window": verdict.get("window"),
+                    "t_wall": verdict.get("t_wall"),
+                }))
+        # fleet re-admissions page too (request_readmitted): each one is
+        # a stream that survived its replica dying — expected during a
+        # drill, a capacity signal in production
+        for replica, n_new in self._new_readmissions():
+            for _ in range(n_new):
+                verdict["alerts"].append(self.watchdog.raise_alert({
+                    "rule": "request_readmitted",
+                    "rank": replica,
+                    "value": None,
+                    "threshold": None,
+                    "message": (
+                        f"an in-flight stream re-admitted off dead "
+                        f"replica {replica} with its accepted-token "
+                        "journal replayed elsewhere"
                     ),
                     "window": verdict.get("window"),
                     "t_wall": verdict.get("t_wall"),
@@ -1157,6 +1183,30 @@ class Aggregator:
                     plane.group(1) if plane else "?",
                     n_new,
                 ))
+        return out
+
+    def _new_readmissions(self):
+        """Fleet re-admissions not yet alerted on: ``(replica, n_new)``
+        rows from the ``serve_fleet_readmissions_total`` counter deltas
+        (same unseen-increment discipline as ``_new_evictions``)."""
+        import re
+
+        totals: Dict[str, float] = {}
+        with self._lock:
+            for rv in self.view.values():
+                for k, val in rv.counters.items():
+                    if k.startswith("serve_fleet_readmissions_total"):
+                        totals[k] = totals.get(k, 0.0) + float(val)
+            out = []
+            for k, val in sorted(totals.items()):
+                n_new = int(round(
+                    val - self._readmissions_alerted.get(k, 0.0)
+                ))
+                if n_new <= 0:
+                    continue
+                self._readmissions_alerted[k] = val
+                replica = re.search(r'replica="([^"]*)"', k)
+                out.append((replica.group(1) if replica else "?", n_new))
         return out
 
     def _send_heartbeat(self, peer) -> None:

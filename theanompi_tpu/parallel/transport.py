@@ -57,6 +57,11 @@ _REQ_RETRIES = _REG.counter(
     "transport_request_retries_total",
     "request() connect attempts beyond the first",
 )
+_REQ_DEADLINE = _REG.counter(
+    "transport_request_deadline_exceeded_total",
+    "request() calls abandoned because the per-call deadline budget "
+    "ran out (spans the WHOLE retry ladder, not one attempt)",
+)
 _HANDLER_LAT = _REG.histogram(
     "transport_handler_seconds",
     "TcpServerChannel handler latency (decode excluded)",
@@ -496,8 +501,29 @@ class TcpServerChannel:
             pass
 
 
+class RequestDeadlineExceeded(TimeoutError):
+    """``request()`` ran out of its per-call ``deadline_s`` budget —
+    across connect retries, the write, or the reply wait.  Counted in
+    ``transport_request_deadline_exceeded_total`` before it raises."""
+
+
+def _budget(deadline: Optional[float]) -> Optional[float]:
+    """Seconds left before ``deadline`` (monotonic); raises (and
+    counts) when the budget is spent.  ``None`` deadline = unlimited."""
+    if deadline is None:
+        return None
+    left = deadline - time.monotonic()
+    if left <= 0:
+        _REQ_DEADLINE.inc(transport="request")
+        raise RequestDeadlineExceeded(
+            "request() deadline budget exhausted"
+        )
+    return left
+
+
 def _connect_with_retry(
-    address, timeout: float, connect_retries: int, retry_backoff_s: float
+    address, timeout: float, connect_retries: int, retry_backoff_s: float,
+    deadline: Optional[float] = None,
 ) -> socket.socket:
     """Bounded, jittered connect for ``request()``.  Only the CONNECT
     leg retries: a refused/timed-out connect provably never reached the
@@ -506,19 +532,33 @@ def _connect_with_retry(
     the caller owns that semantic).  Momentary refusals (server
     restarting mid-promotion, listener backlog burst) stop being
     instant caller-visible failures; retries are counted in
-    ``transport_request_retries_total``."""
+    ``transport_request_retries_total``.
+
+    ``deadline`` (a monotonic instant) caps the WHOLE ladder: each
+    attempt's connect timeout shrinks to the remaining budget and the
+    backoff sleep never overshoots it — without a deadline, every
+    retry gets a fresh ``timeout`` and a slow-but-accepting endpoint
+    can stall the caller ``attempts × timeout`` past its SLO."""
     import random
 
     attempts = max(1, int(connect_retries) + 1)
     delay = float(retry_backoff_s)
     for attempt in range(attempts):
+        left = _budget(deadline)
         try:
-            return socket.create_connection(tuple(address), timeout=timeout)
+            return socket.create_connection(
+                tuple(address),
+                timeout=timeout if left is None else min(timeout, left),
+            )
         except (ConnectionError, OSError, socket.timeout):
             if attempt + 1 >= attempts:
                 raise
+            left = _budget(deadline)
             _REQ_RETRIES.inc(transport="request")
-            time.sleep(delay * (0.5 + random.random()))  # full jitter
+            sleep_s = min(2.0, delay) * (0.5 + random.random())  # full jitter
+            if left is not None:
+                sleep_s = min(sleep_s, left)
+            time.sleep(sleep_s)
             delay = min(2.0, delay * 2.0)
     raise AssertionError("unreachable")
 
@@ -529,10 +569,25 @@ def request(
     timeout: float = 600.0,
     connect_retries: int = 2,
     retry_backoff_s: float = 0.05,
+    deadline_s: Optional[float] = None,
 ) -> Any:
-    """Client half of TcpServerChannel: one framed request, one reply."""
+    """Client half of TcpServerChannel: one framed request, one reply.
+
+    ``deadline_s`` is a PER-CALL budget spanning the whole exchange —
+    every connect retry, the request write, and the reply wait share
+    it.  ``timeout`` alone bounds each socket operation individually,
+    so a slow-but-accepting endpoint could stall a caller for several
+    timeouts; with a deadline the caller gets an answer or a
+    ``RequestDeadlineExceeded`` within its own SLO, counted in
+    ``transport_request_deadline_exceeded_total`` (shipped to the live
+    plane like every counter — the fleet router's poll budget reads as
+    a first-class signal there)."""
     from theanompi_tpu.parallel import wire
 
+    deadline = (
+        time.monotonic() + float(deadline_s)
+        if deadline_s is not None else None
+    )
     # the span covers the whole round trip (connect + request + the
     # server's turnaround + reply decode) — the client-visible cost of
     # one EASGD exchange leg; errors are counted before they propagate
@@ -545,15 +600,34 @@ def request(
         try:
             payload = wire.encode(msg)
             with _connect_with_retry(
-                address, timeout, connect_retries, retry_backoff_s
+                address, timeout, connect_retries, retry_backoff_s,
+                deadline=deadline,
             ) as s:
+                left = _budget(deadline)
+                if left is not None:
+                    s.settimeout(min(timeout, left))
                 send_frame(s, payload)
                 # arrow tail only after the write lands — a refused
                 # connection must not leave a one-sided arrow
                 if fid is not None:
                     obs.flow_begin("rpc_msg", fid, {"dst": list(address)})
                 _BYTES_SENT.inc(len(payload), transport="request")
-                reply = recv_frame(s)
+                left = _budget(deadline)
+                if left is not None:
+                    s.settimeout(min(timeout, left))
+                try:
+                    reply = recv_frame(s)
+                except socket.timeout:
+                    if deadline is not None and (
+                        deadline - time.monotonic() <= 0
+                    ):
+                        _REQ_DEADLINE.inc(transport="request")
+                        raise RequestDeadlineExceeded(
+                            "request() deadline expired awaiting the reply"
+                        ) from None
+                    raise
+        except RequestDeadlineExceeded:
+            raise  # already counted in its own series, not stage=io
         except (ConnectionError, OSError, socket.timeout):
             _REQ_ERRORS.inc(transport="request", stage="io")
             raise

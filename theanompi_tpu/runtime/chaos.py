@@ -1,4 +1,21 @@
-"""The committed chaos drill — kill → evict → respawn → re-admit.
+"""The committed chaos drills — kill → evict → (respawn|re-admit).
+
+Two drills share this module and the ``perf_gate.sh`` discipline:
+
+**Training drill** (``--rule EASGD|GOSGD``, PR 10): kill a worker
+process mid-run, require exactly one eviction, a respawn, a
+checkpointless re-admission, and a final loss within tolerance of an
+uninterrupted baseline.
+
+**Serving drill** (``--rule SERVE``, ISSUE 12 — the perf_gate FLEET
+leg): kill a serving replica with streams in flight, require exactly
+one eviction, every in-flight stream re-admitted on a surviving
+replica, outputs **token-identical** to an uninterrupted fleet run
+(the router journals accepted tokens and replays prompt + prefix
+through the ordinary prefill path), and p99 TTFT/TPOT within
+tolerance of the uninterrupted run.  The fleet is in-process
+(``serving/fleet.py`` replicas are threads behind the same protocol a
+TCP replica serves), so the drill is deterministic and CI-sized.
 
 ``python -m theanompi_tpu.runtime.chaos`` rehearses the elastic
 membership story (docs/elasticity.md) end-to-end on real OS processes:
@@ -237,6 +254,235 @@ def run_drill(
     return verdict
 
 
+# rehearsal-sized transformer for the serving drill: small enough to
+# compile in seconds on one CPU core, big enough that streams live long
+# enough to be killed mid-flight
+SERVE_CONFIG = {
+    "seq_len": 64,
+    "vocab_size": 32,
+    "d_model": 32,
+    "n_heads": 4,
+    "n_layers": 2,
+    "batch_size": 2,
+    "n_synth_train": 2,
+    "n_synth_val": 1,
+    "comm_probe": False,
+    "print_freq": 10_000,
+}
+
+
+def run_serve_drill(
+    n_replicas: int = 3,
+    n_requests: int = 8,
+    max_new_tokens: int = 24,
+    shared_prefix_len: int = 16,
+    evict_after_s: float = 3.0,
+    p99_tolerance_rel: float = 2.0,
+    p99_tolerance_abs: float = 3.0,
+    timeout: float = 300.0,
+    seed: int = 0,
+    config_overrides: Optional[dict] = None,
+) -> dict:
+    """The serving-fleet kill drill; returns the verdict dict.
+
+    Protocol: build an N-replica fleet, run the workload uninterrupted
+    (the baseline — outputs AND p99 latencies), then rerun it on a
+    fresh fleet over the SAME warmed engines, kill the busiest replica
+    once every stream has tokens in flight, and compare.
+    """
+    import time
+
+    import numpy as np
+
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.runtime.mesh import make_mesh
+    from theanompi_tpu.serving import (
+        PagedServingEngine,
+        Request,
+        ServingMetrics,
+    )
+    from theanompi_tpu.serving.fleet import FleetRouter, ServeReplica
+
+    import jax
+
+    cfg = dict(SERVE_CONFIG)
+    cfg.update(config_overrides or {})
+    mesh = make_mesh(devices=jax.devices()[:1])
+    model = TransformerLM(config=cfg, mesh=mesh)
+    geom = dict(n_slots=2, max_len=cfg["seq_len"], buckets=(8, 16, 64),
+                block_size=8)
+    engines = [PagedServingEngine(model, **geom) for _ in range(n_replicas)]
+
+    rng = np.random.RandomState(seed)
+    trunk = rng.randint(0, cfg["vocab_size"],
+                        size=shared_prefix_len).tolist()
+    prompts = []
+    for j in range(n_requests):
+        if j % 2 == 0:  # half share the system prompt (affinity work)
+            p = trunk + rng.randint(0, cfg["vocab_size"], size=4).tolist()
+        else:
+            p = rng.randint(0, cfg["vocab_size"],
+                            size=int(rng.randint(4, 12))).tolist()
+        prompts.append(p)
+
+    def requests():
+        out = []
+        for j, p in enumerate(prompts):
+            if j == n_requests - 1:  # one sampled stream rides along:
+                # token_index0 must keep its keys aligned across replay
+                out.append(Request(id=f"q{j}", prompt=list(p),
+                                   max_new_tokens=max_new_tokens,
+                                   temperature=0.8, top_k=8, seed=42))
+            else:
+                out.append(Request(id=f"q{j}", prompt=list(p),
+                                   max_new_tokens=max_new_tokens))
+        return out
+
+    def build_fleet(alerts):
+        reps = [
+            ServeReplica(f"r{i}", engines[i]).start()
+            for i in range(n_replicas)
+        ]
+        router = FleetRouter(
+            evict_after_s=evict_after_s,
+            metrics=ServingMetrics(),
+            on_alert=lambda rule, msg: alerts.append(rule),
+        )
+        for i, rep in enumerate(reps):
+            router.add_replica(f"r{i}", rep)
+        return reps, router
+
+    def warm(reps):
+        # one prompt per chunk bucket: baseline and chaos runs must
+        # both see fully-warmed programs, or compile time masquerades
+        # as TTFT and poisons the p99 comparison
+        for rep in reps:
+            for wi, n in enumerate((3, 12, 20)):
+                rep.handle(("submit", {
+                    "id": f"_warm{wi}", "prompt": list(range(1, n + 1)),
+                    "max_new_tokens": 2,
+                }))
+            # the sampled pick path compiles lazily — warm it too
+            rep.handle(("submit", {
+                "id": "_warms", "prompt": [1, 2, 3],
+                "max_new_tokens": 2, "temperature": 0.5, "seed": 1,
+            }))
+        deadline = time.monotonic() + timeout
+        while not all(r.scheduler.idle for r in reps):
+            if time.monotonic() > deadline:
+                raise RuntimeError("serve drill warmup never drained")
+            time.sleep(0.01)
+
+    verdict: dict = {
+        "rule": "SERVE",
+        "n_replicas": n_replicas,
+        "n_requests": n_requests,
+        "kills_observed": 1,
+        "violations": [],
+    }
+    v = verdict["violations"]
+
+    # ---- baseline: the uninterrupted fleet ---------------------------
+    base_alerts: list = []
+    reps, router = build_fleet(base_alerts)
+    try:
+        warm(reps)
+        for r in requests():
+            router.submit(r)
+        base_out = router.run(timeout_s=timeout)
+        base_sum = router.metrics.summary()
+    finally:
+        for rep in reps:
+            rep.stop()
+    if router.fleet_stats()["evictions"] != 0:
+        v.append("baseline fleet run evicted a replica — the drill rig "
+                 "itself is unstable (evict_after_s too tight?)")
+    verdict["baseline"] = {
+        "ttft_p99_s": round(base_sum["ttft_p99_s"], 4),
+        "tpot_p99_s": round(base_sum["tpot_p99_s"], 4),
+        "n_tokens": base_sum["n_tokens_out"],
+    }
+
+    # ---- chaos: kill the busiest replica mid-stream ------------------
+    alerts: list = []
+    reps, router = build_fleet(alerts)
+    try:
+        for r in requests():
+            router.submit(r)
+        # kill once some replica has >= 2 open streams with accepted
+        # tokens journaled — a genuinely mid-stream kill, early enough
+        # that plenty of budget remains to finish elsewhere
+        deadline = time.monotonic() + timeout
+
+        def open_with_tokens():
+            by = {}
+            for s in router._streams.values():
+                if not s.done and s.tokens:
+                    by[s.replica] = by.get(s.replica, 0) + 1
+            return by
+        open_by = {}
+        while True:
+            open_by = open_with_tokens()
+            if open_by and max(open_by.values()) >= min(2, n_requests):
+                break
+            if time.monotonic() > deadline:
+                if open_by:
+                    break  # settle for the busiest we ever saw
+                raise RuntimeError("streams never started producing")
+            router.pump()
+            time.sleep(0.002)
+        victim = max(open_by, key=open_by.get)
+        next(rep for rep in reps if rep.name == victim).kill()
+        verdict["killed"] = victim
+        verdict["streams_in_flight_at_kill"] = open_by.get(victim, 0)
+        chaos_out = router.run(timeout_s=timeout)
+        chaos_sum = router.metrics.summary()
+    finally:
+        for rep in reps:
+            rep.stop()
+
+    stats = router.fleet_stats()
+    verdict["evictions"] = stats["evictions"]
+    verdict["readmissions"] = stats["readmissions"]
+    verdict["eviction_alerts"] = alerts.count("replica_evicted")
+    verdict["readmission_alerts"] = alerts.count("request_readmitted")
+    verdict["token_identical"] = chaos_out == base_out
+    verdict["chaos"] = {
+        "ttft_p99_s": round(chaos_sum["ttft_p99_s"], 4),
+        "tpot_p99_s": round(chaos_sum["tpot_p99_s"], 4),
+        "n_tokens": chaos_sum["n_tokens_out"],
+    }
+
+    # ---- the acceptance criteria, as violations ----------------------
+    if verdict["evictions"] != 1:
+        v.append(f"expected exactly one eviction for one kill, saw "
+                 f"{verdict['evictions']}")
+    if verdict["eviction_alerts"] != 1:
+        v.append(f"expected exactly one replica_evicted alert, saw "
+                 f"{verdict['eviction_alerts']}")
+    if verdict["readmissions"] < 1:
+        v.append("no in-flight stream re-admitted — the kill was a "
+                 "monitoring blackout, not a survived failure")
+    if not verdict["token_identical"]:
+        diff = [k for k in base_out if chaos_out.get(k) != base_out[k]]
+        v.append(f"outputs diverged from the uninterrupted run for "
+                 f"streams {diff[:4]} — replay is NOT token-identical")
+    for metric in ("ttft_p99_s", "tpot_p99_s"):
+        base_p, chaos_p = verdict["baseline"][metric], verdict["chaos"][metric]
+        tol = max(p99_tolerance_abs, p99_tolerance_rel * base_p)
+        delta = chaos_p - base_p
+        verdict[f"{metric}_delta"] = round(delta, 4)
+        verdict[f"{metric}_tolerance"] = round(tol, 4)
+        if delta > tol:
+            v.append(
+                f"{metric} {chaos_p:.4f}s exceeds baseline {base_p:.4f}s "
+                f"by {delta:.4f}s (> tolerance {tol:.4f}s) — failover "
+                "cost the tail latency SLO"
+            )
+    verdict["ok"] = not v
+    return verdict
+
+
 def main(argv=None) -> int:
     import argparse
     import sys
@@ -244,8 +490,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="theanompi_tpu.runtime.chaos", description=__doc__
     )
-    p.add_argument("--rule", action="append", choices=["EASGD", "GOSGD"],
-                   help="drill this rule (repeatable; default: EASGD)")
+    p.add_argument("--rule", action="append",
+                   choices=["EASGD", "GOSGD", "SERVE"],
+                   help="drill this rule (repeatable; default: EASGD). "
+                   "SERVE runs the in-process serving-fleet kill drill "
+                   "(evict → re-admit → token-identical, p99 gate)")
     p.add_argument("--n-procs", type=int, default=3)
     p.add_argument("--kill-rank", type=int, default=1)
     p.add_argument("--kill-iter", type=int, default=10)
@@ -266,25 +515,41 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--no-baseline", action="store_true",
                    help="skip the uninterrupted run (no loss gate)")
+    p.add_argument("--serve-replicas", type=int, default=3)
+    p.add_argument("--serve-requests", type=int, default=8)
+    p.add_argument("--serve-evict-after", type=float, default=3.0)
+    p.add_argument("--serve-p99-tolerance", type=float, default=2.0,
+                   help="relative p99 TTFT/TPOT tolerance vs the "
+                   "uninterrupted fleet run (abs floor 3s covers the "
+                   "eviction window at CI scale)")
     args = p.parse_args(argv)
 
     out = {"rules": {}, "ok": True}
     for rule in args.rule or ["EASGD"]:
-        verdict = run_drill(
-            rule=rule,
-            n_procs=args.n_procs,
-            kill_rank=args.kill_rank,
-            kill_iter=args.kill_iter,
-            rejoin_after_s=args.rejoin_after,
-            heartbeat_timeout=args.heartbeat_timeout,
-            slow_iter_s=args.slow_iter,
-            n_epochs=args.n_epochs,
-            tolerance_rel=args.tolerance_rel,
-            tolerance_abs=args.tolerance_abs,
-            workdir=args.workdir,
-            timeout=args.timeout,
-            run_baseline=not args.no_baseline,
-        )
+        if rule == "SERVE":
+            verdict = run_serve_drill(
+                n_replicas=args.serve_replicas,
+                n_requests=args.serve_requests,
+                evict_after_s=args.serve_evict_after,
+                p99_tolerance_rel=args.serve_p99_tolerance,
+                timeout=args.timeout,
+            )
+        else:
+            verdict = run_drill(
+                rule=rule,
+                n_procs=args.n_procs,
+                kill_rank=args.kill_rank,
+                kill_iter=args.kill_iter,
+                rejoin_after_s=args.rejoin_after,
+                heartbeat_timeout=args.heartbeat_timeout,
+                slow_iter_s=args.slow_iter,
+                n_epochs=args.n_epochs,
+                tolerance_rel=args.tolerance_rel,
+                tolerance_abs=args.tolerance_abs,
+                workdir=args.workdir,
+                timeout=args.timeout,
+                run_baseline=not args.no_baseline,
+            )
         out["rules"][rule] = verdict
         out["ok"] = out["ok"] and verdict["ok"]
         for viol in verdict["violations"]:

@@ -30,6 +30,14 @@ serving side with the same sharded-parameter machinery:
   the target verifies all of them in ONE batched paged dispatch
   (``PagedServingEngine.verify_chunks``); greedy and sampled streams
   are token-identical to the non-speculative path by construction.
+- ``radix``     — the prefix cache generalized to a radix tree: LRU
+  leaf-first partial eviction (shared trunks survive pool pressure)
+  and compact digest summaries for prefix-affinity routing.
+- ``fleet``     — the fault-tolerant serving fleet: ``ServeReplica``
+  (one engine behind the request/reply protocol) and ``FleetRouter``
+  (prefix-affine admission, roster heartbeats piggybacked on poll
+  replies, kill→evict→re-admit with token-identical journaled
+  replay, drain-on-leave, 503 shedding).  See ``docs/fleet.md``.
 
 Bench entry point: ``bench_serve.py`` at the repo root (hooked from
 ``bench.py`` via ``THEANOMPI_BENCH_SERVE=1``) produces the
@@ -37,6 +45,7 @@ Bench entry point: ``bench_serve.py`` at the repo root (hooked from
 """
 
 from theanompi_tpu.serving.engine import ServingEngine
+from theanompi_tpu.serving.fleet import FleetRouter, ServeReplica
 from theanompi_tpu.serving.loader import load_engine, restore_params_for_serving
 from theanompi_tpu.serving.metrics import ServingMetrics
 from theanompi_tpu.serving.paging import (
@@ -44,8 +53,13 @@ from theanompi_tpu.serving.paging import (
     PagedServingEngine,
     PrefixCache,
 )
+from theanompi_tpu.serving.radix import RadixPrefixCache
 from theanompi_tpu.serving.sampling import Sampler
-from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
+from theanompi_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerDraining,
+)
 from theanompi_tpu.serving.spec import SpecDecoder
 
 __all__ = [
@@ -53,11 +67,15 @@ __all__ = [
     "PagedServingEngine",
     "BlockPool",
     "PrefixCache",
+    "RadixPrefixCache",
     "ContinuousBatchingScheduler",
     "Request",
+    "SchedulerDraining",
     "Sampler",
     "ServingMetrics",
     "SpecDecoder",
+    "FleetRouter",
+    "ServeReplica",
     "load_engine",
     "restore_params_for_serving",
 ]

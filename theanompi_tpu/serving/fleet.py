@@ -1,0 +1,702 @@
+"""The serving fleet: N paged engines behind one fault-tolerant door.
+
+The paper's core claim (arXiv:1605.08325) is that a fleet of
+independently-scheduled workers beats one monolith; PR 10 took the
+*training* tier there (heartbeat rosters, eviction, checkpointless
+re-admission).  This module is the same move for serving — three landed
+subsystems composed into the millions-of-users story:
+
+- **paging** (PR 8/11): each replica is a ``PagedServingEngine`` +
+  ``ContinuousBatchingScheduler`` — prefix cache, chunked prefill,
+  zero-recompile tables.
+- **membership** (PR 10): replicas live in a ``parallel.membership``
+  ``Roster`` (plane ``"serve"``).  Heartbeats piggyback on the
+  router's ordinary poll replies — an answered poll IS a liveness
+  proof, no extra frames — and a silent replica is EVICTED, never
+  waited on.
+- **transport** (PR 7/10/this PR): the router speaks
+  ``transport.request()``'s request/reply channel (retries, rpc flow
+  ids, spans, and now a per-call deadline budget), so replicas can be
+  in-process objects (tests, the chaos drill) or real TCP endpoints
+  (``ServeReplica(port=...)``) behind the SAME router code path.
+
+Robustness contract (the chaos drill in ``runtime/chaos.py`` gates it):
+
+- **Kill a replica mid-stream** and its in-flight requests re-admit on
+  a surviving replica with token-identical output.  The router
+  journals every accepted token per stream, so re-admission submits a
+  FRESH request whose prompt is ``original prompt + accepted tokens``
+  and whose budget is the remaining tokens; the replay rides the
+  ordinary prefill path (the prefix cache makes it cheap when the
+  surviving replica has seen the prefix) and ``Request.token_index0``
+  keeps sampled streams drawing with the original per-index keys.
+  Greedy AND sampled outputs are identical to an uninterrupted run by
+  construction.
+- **Drain-on-leave**: a draining replica finishes its in-flight slots,
+  refuses new admissions (counted backpressure the router re-routes),
+  then ``leave()``s the roster cleanly — zero accepted requests
+  dropped, zero eviction alerts.
+- **Health shedding**: a replica whose live doctor trips ``/health``
+  503 is shed from the admission rotation — zero new admissions until
+  it reports green — while its in-flight streams run on.
+
+Routing is **prefix-affine**: replicas gossip compact radix-tree
+summaries (``radix.RadixPrefixCache.summary`` — content digests, no
+tokens) in their poll replies, and the router scores each incoming
+prompt against every live summary (``radix.score_prompt``), placing
+the request where the longest prefix is already resident.  Ties and
+cold prompts fall back to least-loaded.  ``detail.fleet`` in
+``bench_serve.py --replicas N`` measures the win over round-robin.
+
+Observability: replica threads are named (per-replica trace tracks);
+evictions raise ONE ``replica_evicted`` alert and re-admissions page
+``request_readmitted`` through the live plane's counter-delta rules
+(``serve_fleet_readmissions_total``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.parallel import transport
+from theanompi_tpu.parallel.membership import Roster
+from theanompi_tpu.serving import metrics as smetrics
+from theanompi_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerDraining,
+)
+
+PROTOCOL_VERSION = 1
+
+
+class FleetError(RuntimeError):
+    """No replica could take a request (fleet down / all draining)."""
+
+
+class ReplicaKilled(ConnectionError):
+    """In-process stand-in for a dead TCP endpoint: calls into a
+    killed replica fail exactly like a refused connection, so the
+    router's failure path is one code path for both transports."""
+
+
+class ServeReplica:
+    """One serving engine behind the fleet's request/reply protocol.
+
+    ``handle(msg)`` is the single protocol entry — it IS the
+    ``TcpServerChannel`` handler when ``port`` is given, and the
+    router calls it directly for in-process replicas.  A background
+    thread drives scheduler ticks; every protocol access and every
+    tick serialize on ``self._lock`` (the scheduler is not
+    thread-safe — the GL-T graftlint pass watches exactly this
+    surface).
+
+    ``health_fn`` mirrors the live plane's ``/health`` contract: a
+    zero-arg callable returning True (green) or False (503).  Wire the
+    live watchdog's ``ok()`` here in production; tests and the chaos
+    drill inject trips directly.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        engine,
+        params=None,
+        port: Optional[int] = None,
+        health_fn=None,
+        prefix_impl: str = "radix",
+        summary_cap: int = 256,
+        tick_idle_s: float = 0.002,
+        **sched_kwargs,
+    ):
+        self.name = str(name)
+        self.engine = engine
+        self._lock = threading.Lock()
+        self.scheduler = ContinuousBatchingScheduler(
+            engine, params=params, prefix_impl=prefix_impl, **sched_kwargs
+        )
+        self.summary_cap = int(summary_cap)
+        self.tick_idle_s = float(tick_idle_s)
+        self._health_fn = health_fn
+        self._streams: Dict[str, Request] = {}
+        self.ticks = 0
+        self._killed = False
+        self._stop = threading.Event()
+        self.port = port
+        self.channel = (
+            transport.TcpServerChannel(port, self.handle)
+            if port is not None else None
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name=f"ServeReplica-{self.name}", daemon=True
+        )
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> "ServeReplica":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful teardown (tests): stop ticking, close the port."""
+        self._stop.set()
+        if self.channel is not None:
+            self.channel.close()
+        self._thread.join(timeout=5.0)
+
+    def kill(self) -> None:
+        """The chaos hammer: die NOW, mid-stream, without goodbye.
+        In-flight slots are abandoned exactly as a SIGKILL'd process
+        abandons them; subsequent ``handle`` calls raise like a dead
+        endpoint refuses connections."""
+        self._killed = True
+        self._stop.set()
+        if self.channel is not None:
+            self.channel.close()
+
+    @property
+    def healthy(self) -> bool:
+        if self._health_fn is None:
+            return True
+        try:
+            return bool(self._health_fn())
+        except Exception:
+            return False  # a crashing health probe is not green
+
+    def set_health_fn(self, fn) -> None:
+        self._health_fn = fn
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                work = bool(self.scheduler.queue) or self.scheduler.n_active
+                if work:
+                    with obs.span("replica_tick", replica=self.name):
+                        self.scheduler.step()
+                    self.ticks += 1
+            if not work:
+                time.sleep(self.tick_idle_s)
+
+    # ---- protocol ----------------------------------------------------
+    def handle(self, msg: Any) -> Any:
+        """One protocol message → one reply dict.  Raises
+        :class:`ReplicaKilled` after ``kill()`` so in-process callers
+        share the TCP caller's failure path."""
+        if self._killed:
+            raise ReplicaKilled(f"replica {self.name!r} is dead")
+        kind = msg[0]
+        if kind == "hello":
+            return {
+                "ok": True,
+                "v": PROTOCOL_VERSION,
+                "name": self.name,
+                "block_size": int(self.engine.block_size),
+                "n_slots": int(self.engine.n_slots),
+                "max_len": int(self.engine.max_len),
+            }
+        if kind == "submit":
+            return self._handle_submit(msg[1])
+        if kind == "poll":
+            return self._handle_poll(msg[1])
+        if kind == "drain":
+            with self._lock:
+                self.scheduler.begin_drain()
+            return {"ok": True}
+        if kind == "health":
+            return {"ok": True, "healthy": self.healthy}
+        return {"ok": False, "reason": f"unknown message kind {kind!r}"}
+
+    def _handle_submit(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        req = Request(
+            id=str(spec["id"]),
+            prompt=[int(t) for t in spec["prompt"]],
+            max_new_tokens=int(spec["max_new_tokens"]),
+            eos_id=(None if spec.get("eos_id") is None
+                    else int(spec["eos_id"])),
+            temperature=float(spec.get("temperature", 0.0)),
+            top_k=int(spec.get("top_k", 0)),
+            seed=(None if spec.get("seed") is None else int(spec["seed"])),
+            token_index0=int(spec.get("token_index0", 0)),
+        )
+        with self._lock:
+            try:
+                self.scheduler.submit(req)
+            except SchedulerDraining:
+                return {"ok": False, "reason": "draining"}
+            except ValueError as e:  # impossible geometry — loud, not lost
+                return {"ok": False, "reason": f"refused: {e}"}
+            self._streams[req.id] = req
+        return {"ok": True, "ticks": self.ticks}
+
+    def _handle_poll(self, cursors: Dict[str, int]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for rid, cursor in cursors.items():
+                req = self._streams.get(rid)
+                if req is None:
+                    continue  # unknown stream: the router re-routed it
+                done = rid in self.scheduler.finished
+                toks = [int(t) for t in req.output[int(cursor):]]
+                out[rid] = {"toks": toks, "done": done}
+                if done:
+                    del self._streams[rid]
+            summary = []
+            if self.scheduler.prefix is not None:
+                fn = getattr(self.scheduler.prefix, "summary", None)
+                if fn is not None:
+                    summary = fn(self.summary_cap)
+            reply = {
+                "ok": True,
+                "streams": out,
+                "ticks": self.ticks,
+                "healthy": self.healthy,
+                "draining": self.scheduler.draining,
+                "idle": self.scheduler.idle,
+                "summary": summary,
+            }
+        return reply
+
+
+class _Stream:
+    """The router's journal for one accepted request: everything needed
+    to re-admit it token-identically on another replica."""
+
+    __slots__ = (
+        "id", "prompt", "max_new_tokens", "eos_id", "temperature",
+        "top_k", "seed", "replica", "tokens", "done", "readmissions",
+        "base",
+    )
+
+    def __init__(self, spec: Dict[str, Any], replica: str):
+        self.id = spec["id"]
+        self.prompt = list(spec["prompt"])
+        self.max_new_tokens = int(spec["max_new_tokens"])
+        self.eos_id = spec.get("eos_id")
+        self.temperature = float(spec.get("temperature", 0.0))
+        self.top_k = int(spec.get("top_k", 0))
+        self.seed = spec.get("seed")
+        self.replica = replica
+        self.tokens: List[int] = []  # the accepted-token journal
+        self.done = False
+        self.readmissions = 0
+        # journal length when the CURRENT assignment started: the
+        # replica-side request only generates the remainder, so poll
+        # cursors into its output are journal-relative minus this base
+        self.base = 0
+
+    def journal_complete(self) -> bool:
+        """The accepted journal already ends the stream (budget met or
+        eos accepted) — nothing left to re-admit."""
+        return (
+            len(self.tokens) >= self.max_new_tokens
+            or (self.eos_id is not None and self.eos_id in self.tokens)
+        )
+
+    def resubmit_spec(self) -> Dict[str, Any]:
+        """The re-admission request: prompt + accepted prefix replayed
+        through the ordinary prefill path, budget = what remains,
+        ``token_index0`` = how many picks already happened (sampled
+        streams keep their per-index keys)."""
+        return {
+            "id": self.id,
+            "prompt": self.prompt + self.tokens,
+            "max_new_tokens": self.max_new_tokens - len(self.tokens),
+            "eos_id": self.eos_id,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "seed": self.seed,
+            "token_index0": len(self.tokens),
+        }
+
+
+class _ReplicaState:
+    __slots__ = (
+        "name", "target", "block_size", "summary", "shed", "draining",
+        "left", "dead", "active", "shed_events", "shed_since",
+        "shed_seconds", "tokens_out",
+    )
+
+    def __init__(self, name: str, target):
+        self.name = name
+        self.target = target  # ServeReplica-like (has .handle) or (host, port)
+        self.block_size = 0
+        self.summary: List[str] = []
+        self.shed = False  # health-red: no new admissions until green
+        self.draining = False
+        self.left = False  # clean leave — out of the fleet for good
+        self.dead = False  # evicted
+        self.active = 0  # streams currently assigned here
+        self.shed_events = 0
+        self.shed_since: Optional[float] = None
+        self.shed_seconds = 0.0
+        self.tokens_out = 0
+
+    @property
+    def admitting(self) -> bool:
+        return not (self.dead or self.left or self.draining or self.shed)
+
+
+class FleetRouter:
+    """The admission front door over N replicas.
+
+    One router thread of control: callers ``submit()`` requests and
+    drive ``pump()`` (or ``run()``), which polls every live replica,
+    journals accepted tokens, heartbeats the roster from the replies,
+    sweeps for evictions, and re-admits orphaned streams.  The router
+    is the ONLY caller of its own state (no internal threads), so a
+    supervisor can compose it with whatever loop it already runs.
+
+    ``affinity=False`` degrades routing to least-loaded/round-robin —
+    the bench's control arm for measuring the prefix-affinity win.
+    """
+
+    def __init__(
+        self,
+        evict_after_s: float = 2.0,
+        join_grace_s: Optional[float] = None,
+        rpc_deadline_s: float = 5.0,
+        affinity: bool = True,
+        metrics=None,
+        clock=time.monotonic,
+        on_alert=None,
+    ):
+        self.clock = clock
+        self.metrics = metrics
+        self.affinity = bool(affinity)
+        self.rpc_deadline_s = float(rpc_deadline_s)
+        self._on_alert = on_alert
+        self.roster = Roster(
+            "serve",
+            evict_after_s=evict_after_s,
+            join_grace_s=join_grace_s,
+            clock=clock,
+            on_event=self._roster_event,
+        )
+        self._replicas: Dict[str, _ReplicaState] = {}
+        self._streams: Dict[str, _Stream] = {}
+        self._rr = 0  # round-robin tiebreak cursor
+        self._pending_evictions: List[str] = []
+        self.stats = {
+            "submitted": 0,
+            "finished": 0,
+            "routed_affine": 0,
+            "routed_fallback": 0,
+            "affine_hit_tokens": 0,
+            "evictions": 0,
+            "readmissions": 0,
+            "shed_events": 0,
+            "drain_reroutes": 0,
+            "poll_failures": 0,
+        }
+
+    # ---- membership ---------------------------------------------------
+    def add_replica(self, name: str, target) -> None:
+        """Register one replica (in-process object or ``(host, port)``)
+        and join it to the roster.  The hello round-trip proves the
+        endpoint is alive before it can ever be routed to."""
+        name = str(name)
+        if name in self._replicas and not (
+            self._replicas[name].dead or self._replicas[name].left
+        ):
+            raise ValueError(f"replica {name!r} already registered")
+        state = _ReplicaState(name, target)
+        hello = self._call(state, ("hello",))
+        state.block_size = int(hello["block_size"])
+        self._replicas[name] = state
+        self.roster.join(name)
+
+    def _roster_event(self, kind: str, member, generation: int) -> None:
+        if kind == "evict":
+            # defer the re-admission work to pump(): the hook runs
+            # inside sweep() and must stay cheap/non-reentrant
+            self._pending_evictions.append(str(member))
+
+    def _call(self, state: _ReplicaState, msg) -> Any:
+        if isinstance(state.target, tuple):
+            return transport.request(
+                tuple(state.target), msg, timeout=self.rpc_deadline_s,
+                deadline_s=self.rpc_deadline_s,
+            )
+        return state.target.handle(msg)
+
+    # ---- routing ------------------------------------------------------
+    def _eligible(self) -> List[_ReplicaState]:
+        return [s for s in self._replicas.values() if s.admitting]
+
+    def _score(self, state: _ReplicaState, prompt: Sequence[int]) -> int:
+        if not self.affinity or not state.summary or not state.block_size:
+            return 0
+        from theanompi_tpu.serving.radix import score_prompt
+
+        return score_prompt(prompt, state.block_size, state.summary)
+
+    def route(self, prompt: Sequence[int]) -> Tuple[str, int]:
+        """(replica name, affinity score in blocks) for one prompt:
+        highest summary score wins; score 0 falls back to least-loaded
+        with a round-robin tiebreak."""
+        elig = self._eligible()
+        if not elig:
+            raise FleetError("no replica is admitting (fleet down, "
+                             "draining, or fully shed)")
+        scored = [(self._score(s, prompt), s) for s in elig]
+        best = max(sc for sc, _ in scored)
+        if best > 0:
+            cands = [s for sc, s in scored if sc == best]
+        else:
+            load = min(s.active for s in elig)
+            cands = [s for s in elig if s.active == load]
+        pick = cands[self._rr % len(cands)]
+        self._rr += 1
+        return pick.name, best
+
+    def submit(self, request: Union[Request, Dict[str, Any]]) -> str:
+        """Admit one request to the fleet; returns the replica name it
+        landed on.  A refusing replica (drain race, just-died) is
+        skipped and the request re-routes — ``FleetError`` only when
+        every replica refused."""
+        spec = (
+            {
+                "id": request.id,
+                "prompt": list(request.prompt),
+                "max_new_tokens": request.max_new_tokens,
+                "eos_id": request.eos_id,
+                "temperature": request.temperature,
+                "top_k": request.top_k,
+                "seed": request.seed,
+            }
+            if isinstance(request, Request) else dict(request)
+        )
+        if spec["id"] in self._streams:
+            raise ValueError(f"stream id {spec['id']!r} already submitted")
+        name, score = self.route(spec["prompt"])
+        stream = _Stream(spec, name)
+        placed = self._place(stream, spec, first_choice=name)
+        if self.metrics is not None:
+            self.metrics.admitted(stream.id, len(stream.prompt))
+        self._streams[stream.id] = stream
+        self.stats["submitted"] += 1
+        if score > 0 and placed == name:
+            self.stats["routed_affine"] += 1
+            self.stats["affine_hit_tokens"] += (
+                score * self._replicas[name].block_size
+            )
+            smetrics.FLEET_ROUTED.inc(policy="affine")
+        else:
+            self.stats["routed_fallback"] += 1
+            smetrics.FLEET_ROUTED.inc(policy="fallback")
+        return placed
+
+    def _place(self, stream: _Stream, spec: Dict[str, Any],
+               first_choice: str) -> str:
+        """Try the routed replica, then every other admitting one."""
+        order = [first_choice] + [
+            s.name for s in self._eligible() if s.name != first_choice
+        ]
+        for name in order:
+            state = self._replicas[name]
+            try:
+                reply = self._call(state, ("submit", spec))
+            except (ConnectionError, OSError, TimeoutError):
+                continue  # dead/dying: the sweep will evict it
+            if reply.get("ok"):
+                if name != first_choice:
+                    self.stats["drain_reroutes"] += 1
+                    smetrics.FLEET_DRAIN_REROUTES.inc()
+                stream.replica = name
+                state.active += 1
+                self.roster.beat(name, step=reply.get("ticks"))
+                return name
+            if reply.get("reason") == "draining":
+                state.draining = True
+        raise FleetError(
+            f"request {spec['id']!r}: every replica refused or failed"
+        )
+
+    # ---- the pump -----------------------------------------------------
+    def pump(self) -> int:
+        """One router round: poll every replica that owns streams (or
+        could), journal tokens, heartbeat + sweep the roster, re-admit
+        orphans.  Returns the number of still-open streams."""
+        with obs.span("fleet_pump", streams=len(self._streams)):
+            by_replica: Dict[str, Dict[str, int]] = {}
+            for st in self._streams.values():
+                if not st.done:
+                    by_replica.setdefault(st.replica, {})[st.id] = (
+                        len(st.tokens) - st.base
+                    )
+            for name, state in list(self._replicas.items()):
+                if state.dead or state.left:
+                    continue
+                cursors = by_replica.get(name, {})
+                try:
+                    reply = self._call(state, ("poll", cursors))
+                except (ConnectionError, OSError, TimeoutError):
+                    self.stats["poll_failures"] += 1
+                    continue  # no beat: silence is how eviction starts
+                self._absorb_poll(state, reply)
+            self.roster.sweep()
+            while self._pending_evictions:
+                self._handle_eviction(self._pending_evictions.pop(0))
+        return sum(1 for s in self._streams.values() if not s.done)
+
+    def _absorb_poll(self, state: _ReplicaState, reply: Dict) -> None:
+        self.roster.beat(state.name, step=reply.get("ticks"))
+        state.summary = list(reply.get("summary") or ())
+        state.draining = bool(reply.get("draining"))
+        now = self.clock()
+        healthy = bool(reply.get("healthy", True))
+        if not healthy and not state.shed:
+            state.shed = True
+            state.shed_events += 1
+            state.shed_since = now
+            self.stats["shed_events"] += 1
+            smetrics.FLEET_SHED.inc(replica=state.name)
+            self._alert(
+                "replica_shed",
+                f"replica {state.name!r} health went red — shed from "
+                "admission rotation until green",
+            )
+        elif healthy and state.shed:
+            state.shed = False
+            if state.shed_since is not None:
+                state.shed_seconds += now - state.shed_since
+                state.shed_since = None
+        for rid, row in (reply.get("streams") or {}).items():
+            st = self._streams.get(rid)
+            if st is None or st.done or st.replica != state.name:
+                continue
+            toks = [int(t) for t in row.get("toks") or ()]
+            if toks:
+                if self.metrics is not None and not st.tokens:
+                    self.metrics.first_token(st.id)
+                st.tokens.extend(toks)
+                state.tokens_out += len(toks)
+            if row.get("done") or st.journal_complete():
+                st.done = True
+                state.active = max(0, state.active - 1)
+                self.stats["finished"] += 1
+                if self.metrics is not None:
+                    self.metrics.finished(st.id, len(st.tokens))
+
+    def _handle_eviction(self, name: str) -> None:
+        state = self._replicas.get(name)
+        if state is None or state.dead:
+            return
+        state.dead = True
+        self.stats["evictions"] += 1
+        self._alert(
+            "replica_evicted",
+            f"replica {name!r} evicted after missed heartbeats — "
+            "re-admitting its in-flight streams",
+        )
+        for st in list(self._streams.values()):
+            if st.replica != name or st.done:
+                continue
+            state.active = max(0, state.active - 1)
+            if st.journal_complete():
+                st.done = True  # journal already complete
+                self.stats["finished"] += 1
+                if self.metrics is not None:
+                    self.metrics.finished(st.id, len(st.tokens))
+                continue
+            spec = st.resubmit_spec()
+            st.readmissions += 1
+            self.stats["readmissions"] += 1
+            smetrics.FLEET_READMISSIONS.inc(replica=name)
+            self._alert(
+                "request_readmitted",
+                f"stream {st.id!r} re-admitted off dead replica "
+                f"{name!r} with {len(st.tokens)} accepted token(s) "
+                "journaled",
+            )
+            try:
+                placed = self._place(st, spec, first_choice=self.route(
+                    spec["prompt"]
+                )[0])
+            except FleetError:
+                st.done = True  # surfaced as a violation by the drill
+                self._alert(
+                    "request_lost",
+                    f"stream {st.id!r} could not re-admit anywhere",
+                )
+                continue
+            st.replica = placed
+            st.base = len(st.tokens)
+
+    def _alert(self, rule: str, message: str) -> None:
+        if self._on_alert is not None:
+            try:
+                self._on_alert(rule, message)
+            except Exception:
+                pass
+        obs.instant(f"fleet_{rule}", {"message": message})
+
+    # ---- drain / run --------------------------------------------------
+    def drain_replica(self, name: str, timeout_s: float = 60.0,
+                      poll_interval_s: float = 0.01) -> None:
+        """Drain-on-leave: tell ``name`` to stop admitting, pump until
+        its in-flight streams complete, then ``leave()`` it from the
+        roster (clean — no eviction alert) and drop it from rotation."""
+        state = self._replicas[name]
+        self._call(state, ("drain",))
+        state.draining = True
+        deadline = self.clock() + timeout_s
+        while any(
+            not st.done and st.replica == name
+            for st in self._streams.values()
+        ):
+            if self.clock() > deadline:
+                raise FleetError(
+                    f"drain of {name!r} did not finish within {timeout_s}s"
+                )
+            self.pump()
+            time.sleep(poll_interval_s)
+        self.roster.leave(name)
+        state.left = True
+
+    def run(self, timeout_s: float = 300.0,
+            poll_interval_s: float = 0.005) -> Dict[str, List[int]]:
+        """Pump until every submitted stream is done; returns
+        ``{id: tokens}`` (the journals — what the fleet actually
+        accepted, not what any one replica believes)."""
+        deadline = self.clock() + timeout_s
+        while self.pump():
+            if self.clock() > deadline:
+                open_ids = [
+                    s.id for s in self._streams.values() if not s.done
+                ]
+                raise FleetError(
+                    f"fleet did not drain within {timeout_s}s; open "
+                    f"streams: {open_ids[:8]}"
+                )
+            time.sleep(poll_interval_s)
+        return self.outputs()
+
+    def outputs(self) -> Dict[str, List[int]]:
+        return {s.id: list(s.tokens) for s in self._streams.values()}
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        """The ``detail.fleet`` feed: router stats + per-replica rows."""
+        total_routed = (
+            self.stats["routed_affine"] + self.stats["routed_fallback"]
+        )
+        per_replica = {}
+        for name, s in self._replicas.items():
+            per_replica[name] = {
+                "tokens_out": s.tokens_out,
+                "dead": s.dead,
+                "left": s.left,
+                "shed_events": s.shed_events,
+                "shed_seconds": round(s.shed_seconds, 4),
+            }
+        return {
+            **self.stats,
+            "affinity_enabled": self.affinity,
+            "affinity_hit_rate": (
+                round(self.stats["routed_affine"] / total_routed, 4)
+                if total_routed else 0.0
+            ),
+            "replicas": per_replica,
+        }

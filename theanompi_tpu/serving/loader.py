@@ -63,6 +63,7 @@ def load_engine(
     n_blocks: Optional[int] = None,
     prefill_chunk: Optional[int] = None,
     prefix_cache: bool = True,
+    prefix_impl: str = "chain",
     kv_dtype: str = "fp32",
     paged_attn: str = "xla",
 ):
@@ -105,8 +106,31 @@ def load_engine(
             model, n_slots=n_slots, max_len=max_len, buckets=buckets,
             block_size=block_size, n_blocks=n_blocks,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-            kv_dtype=kv_dtype, paged_attn=paged_attn,
+            prefix_impl=prefix_impl, kv_dtype=kv_dtype,
+            paged_attn=paged_attn,
         )
     return ServingEngine(
         model, n_slots=n_slots, max_len=max_len, buckets=buckets
     )
+
+
+def load_replica(
+    path: str,
+    name: str,
+    config: Optional[dict] = None,
+    port: Optional[int] = None,
+    **engine_kwargs,
+):
+    """Checkpointless replica spin-up: one call from a training
+    checkpoint to a started, fleet-joinable ``ServeReplica`` — what a
+    supervisor runs to replace an evicted replica (the serving analog
+    of the async rules' re-admission: state is re-derived from the
+    durable artifact, never copied from the dead incarnation).  The
+    engine is paged (radix prefix cache — fleet routing wants the
+    summaries); ``engine_kwargs`` reach :func:`load_engine`."""
+    from theanompi_tpu.serving.fleet import ServeReplica
+
+    engine_kwargs.setdefault("paged", True)
+    engine_kwargs.setdefault("prefix_impl", "radix")
+    engine = load_engine(path, config=config, **engine_kwargs)
+    return ServeReplica(name, engine, port=port).start()

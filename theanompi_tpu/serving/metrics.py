@@ -93,6 +93,37 @@ ADMISSION_BACKPRESSURE = _REG.counter(
     "serve_admission_backpressure_total",
     "admission attempts deferred because the block pool was exhausted",
 )
+DRAIN_REFUSALS = _REG.counter(
+    "serve_drain_refusals_total",
+    "submissions refused because the scheduler was draining "
+    "(drain-on-leave backpressure — the router re-routes these)",
+)
+
+# ---- serving fleet (serving/fleet.py drives these) ------------------------
+# One router fronting N replicas: admissions route by prefix affinity,
+# a killed replica's in-flight streams re-admit elsewhere (counted —
+# the live plane pages request_readmitted on the delta), and a replica
+# whose /health trips 503 is shed from rotation until green.
+FLEET_ROUTED = _REG.counter(
+    "serve_fleet_routed_total",
+    "router placements by policy label (affine = scored prefix "
+    "overlap, fallback = least-loaded/round-robin)",
+)
+FLEET_READMISSIONS = _REG.counter(
+    "serve_fleet_readmissions_total",
+    "in-flight streams re-admitted on a surviving replica after their "
+    "replica was evicted (replica label = the dead one)",
+)
+FLEET_SHED = _REG.counter(
+    "serve_fleet_shed_total",
+    "shed transitions: a replica's health went red and it left the "
+    "admission rotation until green (replica label)",
+)
+FLEET_DRAIN_REROUTES = _REG.counter(
+    "serve_fleet_drain_reroutes_total",
+    "submissions a draining/refusing replica bounced that the router "
+    "placed elsewhere",
+)
 
 # ---- speculative decoding (serving/spec.py drives these) -----------------
 # accepted/proposed is THE spec-decode health signal: a collapsing

@@ -244,12 +244,19 @@ class PrefixCache:
             added += 1
         return added
 
-    def evict_unused(self) -> int:
+    def evict_unused(self, need: Optional[int] = None) -> int:
         """Free every cached block whose ONLY reference is the cache
         itself.  Called when allocation fails — cached-but-idle prefix
         memory yields to live sequences before admission backpressures.
         Evicting a parent strands its children unreachable; they have
-        ref 1 too, so the same sweep collects them."""
+        ref 1 too, so the same sweep collects them.
+
+        ``need`` (how many blocks the failed allocation wanted) is
+        accepted for signature parity with ``radix.RadixPrefixCache``
+        and ignored: the flat chain dict cannot tell a hot shared
+        trunk from a cold tail, so its only safe pressure valve is the
+        full sweep — exactly the behavior the radix tree improves on
+        (``docs/fleet.md``)."""
         dropped = 0
         with obs.span("prefix_evict", entries=len(self._entries)):
             for digest in list(self._entries):
@@ -305,6 +312,7 @@ class PagedServingEngine(ServingEngine):
         prefill_rows: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = True,
+        prefix_impl: str = "chain",
         kv_dtype: str = "fp32",
         paged_attn: str = "xla",
     ):
@@ -340,6 +348,16 @@ class PagedServingEngine(ServingEngine):
             {b for b in self.buckets if b <= cap} | {cap}
         ))
         self.prefix_cache_enabled = bool(prefix_cache)
+        if prefix_impl not in ("chain", "radix"):
+            raise ValueError(
+                f"prefix_impl must be 'chain' or 'radix', got "
+                f"{prefix_impl!r}"
+            )
+        # 'chain' = the PR 8 flat hash-consed dict (all-or-nothing
+        # eviction); 'radix' = serving/radix.py's tree (LRU leaf-first
+        # partial eviction + routing summaries — the fleet default).
+        # Both serve identical tokens; only eviction/summaries differ.
+        self.prefix_impl = prefix_impl
         if kv_dtype not in KV_DTYPES:
             raise ValueError(
                 f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}"

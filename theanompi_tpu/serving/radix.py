@@ -1,0 +1,243 @@
+"""Radix-tree prefix cache — the chain cache generalized for a fleet.
+
+PR 8's ``PrefixCache`` hash-conses *chains*: a flat dict from
+``digest(parent_digest, block_tokens)`` to a physical block.  Chains
+already share any block-aligned common prefix between two prompts, but
+the flat dict is blind to the *structure* of that sharing — which is
+exactly what the serving fleet needs twice over:
+
+- **Eviction keeps shared trunks.**  Under pool pressure the chain
+  cache's ``evict_unused`` is all-or-nothing: it drops EVERY idle
+  entry, the hot shared system prompt along with the cold one-off
+  tails.  The radix tree knows which blocks are interior (shared by
+  many descendants) and which are leaves (one cold tail), so eviction
+  walks leaf-first in LRU order and frees only as many blocks as the
+  failed allocation actually needs — partial overlaps keep sharing
+  while the cold tails yield.
+- **Compact routing summaries.**  A replica can describe its resident
+  prefixes as a small set of node digests (``summary()``); the fleet
+  router scores an incoming prompt against each replica's summary
+  (``score_prompt``) and routes to the replica already holding the
+  longest cached prefix — prefix-affinity placement without shipping
+  block contents anywhere.
+
+The external contract is the chain cache's, bit for bit: ``match``
+returns only chains of FULL immutable blocks starting at position 0,
+capped at ``(len(prompt) - 1) // block_size`` so the final prompt token
+is always prefilled; hits retain blocks for the caller; reuse changes
+which physical rows are read, never the values read from them
+(equivalence pinned in tests/test_serving_fleet.py).
+
+Digests are the SAME sha1 chain digests the flat cache uses, so a
+router can score a prompt against a replica's summary without knowing
+which cache implementation the replica runs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.serving import metrics as smetrics
+from theanompi_tpu.serving.paging import BlockPool
+
+
+def chain_digests(prompt: Sequence[int], block_size: int) -> List[bytes]:
+    """The chain digest of every FULL block of ``prompt``: entry j
+    names the exact content AND position of block j (it hashes the
+    whole chain up to j).  Shared by cache lookup and router scoring —
+    both sides of the affinity protocol speak these."""
+    bs = int(block_size)
+    out: List[bytes] = []
+    parent = b""
+    for j in range(len(prompt) // bs):
+        h = hashlib.sha1(parent)
+        h.update(
+            np.asarray(prompt[j * bs:(j + 1) * bs], dtype=np.int64).tobytes()
+        )
+        parent = h.digest()
+        out.append(parent)
+    return out
+
+
+class _Node:
+    """One cached full block: its chain digest, physical block id, and
+    tree links.  The cache holds ONE pool reference per node."""
+
+    __slots__ = ("digest", "block", "parent", "children", "lru", "depth")
+
+    def __init__(self, digest: bytes, block: int, parent: Optional["_Node"],
+                 lru: int):
+        self.digest = digest
+        self.block = block
+        self.parent = parent
+        self.children: Dict[bytes, "_Node"] = {}
+        self.lru = lru
+        self.depth = 0 if parent is None else parent.depth + 1
+
+
+class RadixPrefixCache:
+    """Hash-consed prefix blocks in an explicit radix tree.
+
+    Drop-in for ``paging.PrefixCache`` (same ``match``/``insert``/
+    ``evict_unused``/``__len__`` surface and counters), plus the two
+    fleet capabilities: LRU leaf-first *partial* eviction
+    (``evict_unused(need=n)`` frees only ``n`` blocks, coldest tails
+    first, shared trunks last) and ``summary()`` digests for
+    prefix-affinity routing.
+    """
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._by_digest: Dict[bytes, _Node] = {}
+        self._roots: Dict[bytes, _Node] = {}
+        self._clock = 0  # LRU ticks: bumped on every match/insert touch
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evicted_blocks = 0
+
+    def __len__(self) -> int:
+        return len(self._by_digest)
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.lru = self._clock
+
+    # ---- the chain-cache contract ------------------------------------
+    def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached chain of full blocks covering a PREFIX of
+        ``prompt``; each matched block retained for the caller, every
+        touched node (trunk included) bumped in LRU — a partial
+        overlap refreshes the shared trunk even when the tails have
+        long gone cold."""
+        bs = self.block_size
+        digests = chain_digests(prompt, bs)[: (len(prompt) - 1) // bs]
+        out: List[int] = []
+        with obs.span("prefix_match", n_prompt=len(prompt), impl="radix"):
+            node: Optional[_Node] = None
+            for d in digests:
+                nxt = (
+                    self._roots.get(d) if node is None
+                    else node.children.get(d)
+                )
+                if nxt is None:
+                    break
+                self._touch(nxt)
+                out.append(nxt.block)
+                node = nxt
+        for b in out:
+            self.pool.retain(b)
+        if out:
+            self.hits += 1
+            self.hit_tokens += len(out) * bs
+            smetrics.PREFIX_HITS.inc()
+            smetrics.PREFIX_HIT_TOKENS.inc(len(out) * bs)
+        else:
+            self.misses += 1
+            smetrics.PREFIX_MISSES.inc()
+        return out, len(out) * bs
+
+    def insert(self, prompt: Sequence[int], blocks: Sequence[int]) -> int:
+        """Register every full block of a just-prefilled prompt along
+        its tree path; new nodes retain their block on behalf of the
+        cache, existing nodes (the hit, or identical content prefilled
+        by a sibling) are kept and LRU-bumped.  Returns entries
+        added."""
+        added = 0
+        node: Optional[_Node] = None
+        for j, d in enumerate(chain_digests(prompt, self.block_size)):
+            existing = (
+                self._roots.get(d) if node is None else node.children.get(d)
+            )
+            if existing is not None:
+                self._touch(existing)
+                node = existing
+                continue
+            self._clock += 1
+            fresh = _Node(d, blocks[j], node, self._clock)
+            self.pool.retain(blocks[j])
+            self._by_digest[d] = fresh
+            if node is None:
+                self._roots[d] = fresh
+            else:
+                node.children[d] = fresh
+            node = fresh
+            added += 1
+        return added
+
+    def evict_unused(self, need: Optional[int] = None) -> int:
+        """Free cached blocks whose ONLY reference is the cache itself,
+        leaf-first in LRU order.  ``need=None`` keeps the chain cache's
+        semantics (drop everything droppable); ``need=n`` stops after
+        freeing ``n`` blocks — the radix win: a failed allocation takes
+        the coldest tails and leaves hot shared trunks resident.
+
+        Only leaves are candidates (an interior node's children pin it;
+        freeing a trunk under live descendants would tear their
+        chains), so each sweep pass peels one leaf layer; the loop
+        repeats until the target is met or nothing more can go."""
+        dropped = 0
+        with obs.span("prefix_evict", entries=len(self._by_digest),
+                      impl="radix"):
+            while need is None or dropped < need:
+                leaves = [
+                    n for n in self._by_digest.values()
+                    if not n.children and self.pool.ref(n.block) == 1
+                ]
+                if not leaves:
+                    break
+                leaves.sort(key=lambda n: n.lru)
+                progressed = False
+                for n in leaves:
+                    if need is not None and dropped >= need:
+                        break
+                    self._drop(n)
+                    dropped += 1
+                    progressed = True
+                if not progressed:
+                    break
+        self.evicted_blocks += dropped
+        return dropped
+
+    def _drop(self, node: _Node) -> None:
+        self.pool.release(node.block)
+        del self._by_digest[node.digest]
+        if node.parent is None:
+            self._roots.pop(node.digest, None)
+        else:
+            node.parent.children.pop(node.digest, None)
+
+    # ---- fleet surface -----------------------------------------------
+    def summary(self, cap: int = 256) -> List[str]:
+        """Compact routing summary: hex chain digests of the resident
+        nodes, most-recently-used first, truncated at ``cap``.  A
+        router holding this can score any prompt with
+        :func:`score_prompt` — no tokens, no block ids, just content
+        addresses."""
+        nodes = sorted(
+            self._by_digest.values(), key=lambda n: -n.lru
+        )[: max(0, int(cap))]
+        return [n.digest.hex() for n in nodes]
+
+
+def score_prompt(
+    prompt: Sequence[int], block_size: int, summary: Iterable[str]
+) -> int:
+    """Prefix-affinity score: how many LEADING full blocks of
+    ``prompt`` a replica advertising ``summary`` already holds.  The
+    router multiplies by ``block_size`` to rank replicas by reusable
+    prefill tokens; 0 means the replica has nothing for this prompt."""
+    held: Set[str] = set(summary)
+    if not held:
+        return 0
+    score = 0
+    for d in chain_digests(prompt, block_size):
+        if d.hex() not in held:
+            break
+        score += 1
+    return score

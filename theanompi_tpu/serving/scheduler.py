@@ -82,6 +82,13 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0
     seed: Optional[int] = None
+    # token-index origin for sampling keys: a fleet re-admission
+    # replays prompt + accepted tokens through a FRESH request, and its
+    # first new pick must draw with the key the original stream would
+    # have used at that index (request_key(seed, id, token_index0 +
+    # len(output))) — greedy streams don't care, sampled streams stay
+    # identical across a replica failover
+    token_index0: int = 0
     # filled by the scheduler
     output: List[int] = field(default_factory=list)
 
@@ -102,6 +109,11 @@ class Request:
                 f"request {self.id!r}: top_k must be >= 0 "
                 f"(0 = disabled), got {self.top_k}"
             )
+
+
+class SchedulerDraining(RuntimeError):
+    """Raised by ``submit`` once ``begin_drain`` ran — the counted
+    refusal a fleet router turns into route-elsewhere."""
 
 
 class _Slot:
@@ -131,7 +143,8 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, metrics=None, params=None,
                  clock=time.perf_counter, pool=None,
-                 spec_k: int = 0, draft_engine=None, draft_params=None):
+                 spec_k: int = 0, draft_engine=None, draft_params=None,
+                 prefix_impl: Optional[str] = None):
         self.engine = engine
         self.metrics = metrics
         self.params = params if params is not None else engine.model.params
@@ -140,6 +153,10 @@ class ContinuousBatchingScheduler:
         self.slots = [_Slot() for _ in range(engine.n_slots)]
         self.queue: List[Request] = []
         self.finished: Dict[str, List[int]] = {}
+        # drain-on-leave: a draining scheduler finishes its in-flight
+        # slots and queued requests but REFUSES new submissions with
+        # counted backpressure (the fleet router routes them elsewhere)
+        self.draining = False
         self._tokens = np.zeros((engine.n_slots,), np.int32)
         self._active = np.zeros((engine.n_slots,), bool)
         self._sampler = None  # built lazily on the first sampling request
@@ -153,17 +170,31 @@ class ContinuousBatchingScheduler:
             "prefix_misses": 0,
             "prefix_hit_tokens": 0,
             "backpressure_events": 0,
+            "drain_refusals": 0,
         }
         if self.paged:
             if pool is not None and pool.block_size != engine.block_size:
                 raise ValueError("pool/engine block_size mismatch")
             self.pool = pool if pool is not None else engine.make_pool()
-            from theanompi_tpu.serving.paging import PrefixCache
-
-            self.prefix = (
-                PrefixCache(self.pool)
-                if engine.prefix_cache_enabled else None
+            impl = (
+                prefix_impl if prefix_impl is not None
+                else getattr(engine, "prefix_impl", "chain")
             )
+            if impl not in ("chain", "radix"):
+                raise ValueError(
+                    f"prefix_impl must be 'chain' or 'radix', got {impl!r}"
+                )
+            if engine.prefix_cache_enabled:
+                if impl == "radix":
+                    from theanompi_tpu.serving.radix import RadixPrefixCache
+
+                    self.prefix = RadixPrefixCache(self.pool)
+                else:
+                    from theanompi_tpu.serving.paging import PrefixCache
+
+                    self.prefix = PrefixCache(self.pool)
+            else:
+                self.prefix = None
             self.state = engine.init_state()
             self._tables = np.zeros(
                 (engine.n_slots, engine.blocks_per_seq), np.int32
@@ -201,7 +232,27 @@ class ContinuousBatchingScheduler:
                              "spec_k>=1 to enable speculation")
 
     # ------------------------------------------------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting: queued + in-flight requests run to
+        completion (their blocks release through the ordinary finish
+        path), every later ``submit`` raises ``SchedulerDraining`` and
+        counts.  The fleet's drain-on-leave protocol: a replica drains,
+        reports idle, then ``leave()``s its roster cleanly."""
+        self.draining = True
+
+    @property
+    def idle(self) -> bool:
+        """Nothing queued, nothing in flight — a draining scheduler
+        reports its drain complete through this."""
+        return not self.queue and self.n_active == 0
+
     def submit(self, request: Request) -> None:
+        if self.draining:
+            self.stats["drain_refusals"] += 1
+            smetrics.DRAIN_REFUSALS.inc()
+            raise SchedulerDraining(
+                f"request {request.id!r} refused: scheduler is draining"
+            )
         total = len(request.prompt) + request.max_new_tokens
         if total > self.engine.max_len:
             raise ValueError(
@@ -282,7 +333,9 @@ class ContinuousBatchingScheduler:
             self._sampler = Sampler()
         from theanompi_tpu.serving.sampling import request_key
 
-        key = request_key(req.seed, req.id, len(req.output))
+        key = request_key(
+            req.seed, req.id, req.token_index0 + len(req.output)
+        )
         return self._sampler.sample(
             logits, key, req.temperature, req.top_k
         )
@@ -326,7 +379,9 @@ class ContinuousBatchingScheduler:
             r, idx = p
             temps[i] = r.temperature
             topks[i] = r.top_k
-            keys[i] = np.asarray(request_key(r.seed, r.id, idx))
+            keys[i] = np.asarray(
+                request_key(r.seed, r.id, r.token_index0 + idx)
+            )
         return self._sampler.pick_batch(logits, keys, temps, topks)
 
     def _emit(self, i: int, token: int) -> bool:
@@ -415,7 +470,11 @@ class ContinuousBatchingScheduler:
                 hits, hit_tokens = self.prefix.match(req.prompt)
             fresh = self.pool.alloc(need - len(hits))
             if fresh is None and self.prefix is not None:
-                self.prefix.evict_unused()
+                # the shortfall rides along so a need-aware cache (the
+                # radix tree) can evict ONLY the coldest tails; the
+                # chain cache ignores it and sweeps everything idle
+                shortfall = (need - len(hits)) - self.pool.n_free
+                self.prefix.evict_unused(max(1, shortfall))
                 fresh = self.pool.alloc(need - len(hits))
             if fresh is None:
                 # roll back the prefix refs; the request stays queued
