@@ -104,6 +104,32 @@
 #   PERF_GATE_CHAOS_REJOIN_AFTER seconds before the supervisor respawns
 #                           the killed rank (default 2)
 #
+# BSP leg (the elastic-BSP shrink/rejoin drill; docs/elasticity.md
+# "Elastic BSP"):
+#   PERF_GATE_BSP          1 (default) = run the sync-tier kill drill:
+#                          kill one rank of a BSP fleet mid-run and
+#                          REQUIRE exactly one eviction with exactly one
+#                          worker_evicted alert, the survivors' replayed
+#                          post-resize step bit-identical to a fresh
+#                          (n-1)-rank world (bucket plans re-derived, EF
+#                          residuals reset), a rejoin that re-expands the
+#                          world under a bumped generation, final loss
+#                          within tolerance of the uninterrupted
+#                          baseline, and ZERO recompiles beyond the one
+#                          expected resize recompile (trace counters).
+#                          0 = skip (escape hatch).
+#   PERF_GATE_BSP_JSON     pre-produced drill verdict JSON (skips
+#                          running — the tier-1 smoke path)
+#   PERF_GATE_BSP_CMD      command producing the drill JSON (default:
+#                          python -m theanompi_tpu.runtime.chaos
+#                          --rule BSP)
+#   PERF_GATE_BSP_KILL_ITER    step the injected kill fires at
+#                          (default 6)
+#   PERF_GATE_BSP_REJOIN_AFTER seconds before the killed rank respawns
+#                          (default 2.5 — keep it above the eviction
+#                          window so the eviction provably precedes the
+#                          re-admission)
+#
 # Fleet leg (the serving-fleet kill drill; docs/fleet.md):
 #   PERF_GATE_FLEET         1 (default) = run the serving chaos drill:
 #                           an N-replica fleet behind the prefix-affine
@@ -442,7 +468,70 @@ for rule, v in sorted(rules.items()):
 PY
 fi
 
-# ---- 8. fleet leg: the serving-fleet kill drill -----------------------------
+# ---- 8. BSP leg: the elastic-BSP shrink/rejoin drill ------------------------
+if [ "${PERF_GATE_BSP:-1}" = "1" ]; then
+    BSP_JSON="${PERF_GATE_BSP_JSON:-}"
+    if [ -z "$BSP_JSON" ]; then
+        BSP_JSON="$WORKDIR/bsp.json"
+        BSP_KILL_ITER="${PERF_GATE_BSP_KILL_ITER:-6}"
+        BSP_REJOIN_AFTER="${PERF_GATE_BSP_REJOIN_AFTER:-2.5}"
+        BSP_CMD="${PERF_GATE_BSP_CMD:-env JAX_PLATFORMS=cpu python -m theanompi_tpu.runtime.chaos --rule BSP --bsp-kill-iter $BSP_KILL_ITER --bsp-rejoin-after $BSP_REJOIN_AFTER}"
+        echo "[perf_gate] bsp drill: $BSP_CMD" >&2
+        set +e
+        sh -c "$BSP_CMD" > "$BSP_JSON"
+        BSP_RC=$?
+        set -e
+        if [ ! -s "$BSP_JSON" ]; then
+            echo "[perf_gate] BSP VIOLATION: drill produced no verdict (exit $BSP_RC)" >&2
+            exit 1
+        fi
+    fi
+    # structure check, independent of the drill's self-assessment:
+    # one kill -> one eviction -> one worker_evicted alert, the resized
+    # step bit-identical to the fresh smaller world, the rejoin
+    # re-expanding under a monotone generation, zero extra recompiles,
+    # loss inside tolerance
+    python - "$BSP_JSON" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+v = (doc.get("rules") or {}).get("BSP")
+if not isinstance(v, dict):
+    sys.exit("[perf_gate] BSP VIOLATION: drill verdict has no BSP rule")
+for viol in v.get("violations", []):
+    print(f"[perf_gate] BSP VIOLATION: {viol}", file=sys.stderr)
+if not v.get("ok"):
+    sys.exit(1)
+kills = v.get("kills_observed", 0)
+if kills < 1 or v.get("evictions") != kills:
+    sys.exit(f"[perf_gate] BSP VIOLATION: {v.get('evictions')} "
+             f"eviction(s) for {kills} kill(s)")
+if v.get("worker_evicted_alerts") != kills:
+    sys.exit(f"[perf_gate] BSP VIOLATION: {v.get('worker_evicted_alerts')} "
+             f"worker_evicted alert(s) for {kills} kill(s)")
+if v.get("resized_step_bit_identical") is not True:
+    sys.exit("[perf_gate] BSP VIOLATION: survivors' post-resize step is "
+             "NOT bit-identical to a fresh smaller-world step")
+if not (v.get("world_restored") and v.get("rejoined")):
+    sys.exit("[perf_gate] BSP VIOLATION: the respawned rank never "
+             "re-expanded the world — rejoin is a capacity blackout")
+if v.get("generation_monotone") is not True:
+    sys.exit("[perf_gate] BSP VIOLATION: generation sequence not "
+             "strictly increasing across shrink/expand")
+if v.get("extra_recompiles", 1) != 0:
+    sys.exit(f"[perf_gate] BSP VIOLATION: {v.get('extra_recompiles')} "
+             "recompile(s) beyond the single expected resize recompile")
+delta, tol = v.get("loss_delta"), v.get("loss_tolerance")
+if delta is None or tol is None or delta > tol:
+    sys.exit(f"[perf_gate] BSP VIOLATION: loss delta {delta} exceeds "
+             f"tolerance {tol}")
+print(f"[perf_gate] bsp: {kills} kill -> {v.get('evictions')} eviction, "
+      f"resize bit-identical, gen {v.get('generations')}, "
+      f"{v.get('extra_recompiles')} extra recompile(s), "
+      f"loss delta {delta} (tol {tol})", file=sys.stderr)
+PY
+fi
+
+# ---- 9. fleet leg: the serving-fleet kill drill -----------------------------
 if [ "${PERF_GATE_FLEET:-1}" = "1" ]; then
     FLEET_JSON="${PERF_GATE_FLEET_JSON:-}"
     if [ -z "$FLEET_JSON" ]; then

@@ -79,3 +79,80 @@ class NoLockNoOpinion:
 
     def put(self, k, v):
         self.table[k] = v
+
+
+class AcquireReleaseRoster:
+    """ISSUE 13 widening: bare acquire()/release() spans count as the
+    lock — they guard the attr AND sanction mutations inside the span;
+    a bare mutation elsewhere still fires."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def beat_acquire_release(self, member):
+        self._lock.acquire()
+        try:
+            # sanctioned: lexically inside the acquire/release span
+            self._members[member] = 1
+        finally:
+            self._lock.release()
+
+    def evict_bare_after_span(self, member):
+        # BAD: the span belongs to beat_acquire_release — this method
+        # mutates the (now provably shared) dict with no lock at all
+        self._members.pop(member, None)
+
+
+class HelperUnderCallersLock:
+    """ISSUE 13 widening: a helper whose EVERY same-class call site
+    holds the lock inherits it (call-graph edge, not the *_locked
+    naming convention) — zero findings here."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def join(self, member):
+        with self._lock:
+            self._members[member] = 0
+
+    def sweep(self):
+        with self._lock:
+            self._drop("gone")  # with-block call site
+
+    def reap(self):
+        self._lock.acquire()
+        try:
+            self._drop("reaped")  # acquire-span call site
+        finally:
+            self._lock.release()
+
+    def _drop(self, member):
+        # sanctioned: every call site above provably holds the lock
+        self._members.pop(member, None)
+
+
+class LeakyHelper:
+    """One unlocked call site breaks the lock inheritance: the AST
+    cannot prove the caller holds it, so the helper's mutation keeps
+    firing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def join(self, member):
+        with self._lock:
+            self._members[member] = 0
+
+    def locked_call(self):
+        with self._lock:
+            self._drop_leaky("a")
+
+    def unlocked_call(self):
+        self._drop_leaky("b")  # the edge that breaks the inheritance
+
+    def _drop_leaky(self, member):
+        # BAD: unlocked_call reaches here without the lock
+        self._members.pop(member, None)

@@ -123,7 +123,10 @@ def test_threadstate_pass_golden():
     """GL-T001: the fleet's hazard surface — a dict mutated under the
     class's lock in one method and bare in another fires; __init__
     population, *_locked helpers, never-locked dicts, lockless
-    classes, and reads all stay silent."""
+    classes, and reads all stay silent.  ISSUE 13 widening: bare
+    acquire/release spans count as the lock (and guard the attr), and
+    a helper whose EVERY same-class call site holds the lock inherits
+    it — while one unlocked call site keeps it firing."""
     findings = _findings("bad_threadstate.py")
     got = _rule_symbol_pairs(findings)
     assert got == sorted(
@@ -131,13 +134,16 @@ def test_threadstate_pass_golden():
             ("GL-T001", "evict_bare_subscript"),
             ("GL-T001", "evict_bare_del"),
             ("GL-T001", "evict_bare_pop"),
+            ("GL-T001", "evict_bare_after_span"),
+            ("GL-T001", "_drop_leaky"),
         ]
     )
     for f in findings:
         assert f.severity == "error"
         assert "_members" in f.message and "_lock" in f.message
     clean = {"beat", "never_locked_dict_is_fine", "_drop_locked",
-             "join", "leave", "snapshot", "put", "__init__"}
+             "join", "leave", "snapshot", "put", "__init__",
+             "beat_acquire_release", "sweep", "reap", "_drop"}
     assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
 
 

@@ -1,0 +1,294 @@
+"""Elastic BSP (ISSUE 13): shrink-to-survivors data parallelism.
+
+Layered like the implementation: the host bucket wire pinned against a
+HANDWRITTEN numpy q8 oracle (independent of ``parallel/wire.py``), the
+uninterrupted threaded fleet pinned bit-identical to the transport-free
+reference driver, the shrink path (kill → exactly one eviction → the
+survivors' replayed step bit-identical to a fresh smaller world → one
+resize recompile), and the committed full drill (shrink + rejoin
+re-expansion + the worker_evicted alert golden) — the tier-1 acceptance
+gate perf_gate's BSP leg re-runs.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+from theanompi_tpu.parallel import elastic_bsp as eb
+from theanompi_tpu.runtime.multiprocess import find_free_port
+
+# CI-sized program: w1 (16x32=512 elems) rides the q8 wire, the small
+# leaves pass through raw — both codec paths exercised every exchange
+CFG = dict(seed=3)
+
+
+def _spawn(workers):
+    threads = [
+        threading.Thread(target=w.run, name=f"t-rank{w.rank}",
+                         daemon=True)
+        for w in workers
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _join_all(threads, workers, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(timeout=max(0.5, deadline - time.monotonic()))
+    for w in workers:
+        w.stop()
+    alive = [t.name for t in threads if t.is_alive()]
+    assert not alive, f"worker threads wedged: {alive}"
+
+
+def _trees_equal(a, b):
+    return all(
+        np.array_equal(x, y)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# ---------------------------------------------------------------------------
+# the host bucket wire vs a handwritten numpy oracle
+# ---------------------------------------------------------------------------
+
+def _oracle_q8_roundtrip(flat):
+    """Independent spelling of the q8 block codec (256-elem blocks,
+    amax/127 scales, round-to-nearest) — NOT parallel.wire."""
+    if flat.size < 256:
+        return flat.astype(np.float32)
+    n = flat.size
+    pad = (-n) % 256
+    x = np.pad(flat.astype(np.float32), (0, pad)).reshape(-1, 256)
+    scale = np.abs(x).max(axis=1) / 127.0
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.rint(x / safe[:, None]), -127, 127)
+    return (q * scale[:, None]).ravel()[:n]
+
+
+def _oracle_exchange(grad_trees):
+    """Fresh-world exchange by hand: flatten-order concat into one
+    bucket, q8 roundtrip per member (zero residuals), sum in sorted
+    member order, split back."""
+    ranks = sorted(grad_trees)
+    leaves0, treedef = jax.tree.flatten(grad_trees[ranks[0]])
+    total = None
+    for r in ranks:
+        leaves = jax.tree.leaves(grad_trees[r])
+        flat = np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves]
+        )
+        rt = _oracle_q8_roundtrip(flat)
+        total = rt if total is None else total + rt
+    outs, off = [], 0
+    for l in leaves0:
+        outs.append(total[off:off + l.size].reshape(l.shape))
+        off += l.size
+    return treedef.unflatten(outs)
+
+
+def test_bucket_wire_matches_numpy_oracle():
+    """pack/unpack/sum against the handwritten codec — fresh residuals
+    (exactly the post-resize state the bit-identity pin relies on)."""
+    rng = np.random.RandomState(7)
+    trees = {
+        r: {
+            "b1": rng.randn(32).astype(np.float32),
+            "w1": rng.randn(16, 32).astype(np.float32),
+        }
+        for r in (0, 2)
+    }
+    payloads = {
+        r: eb.unpack_contrib(eb.pack_contrib(t, 2, None)[0])
+        for r, t in trees.items()
+    }
+    got = eb.sum_contribs(payloads, trees[0], 2)
+    want = _oracle_exchange(trees)
+    assert _trees_equal(got, want)
+
+
+def test_ef_residual_reset_restores_fresh_world_image():
+    """A stale EF residual CHANGES the packed image (that is its job);
+    packing with residual=None after a resize restores byte-equality
+    with the fresh world — the numpy-oracle pin of the reset."""
+    rng = np.random.RandomState(11)
+    g = {"w1": rng.randn(16, 32).astype(np.float32)}
+    fresh_packed, res = eb.pack_contrib(g, 2, None)
+    assert any(
+        np.abs(r).max() > 0 for r in jax.tree.leaves(res) if r is not None
+    ), "the q8 leg should drop SOMETHING (else EF is vacuous)"
+    stale = eb.unpack_contrib(eb.pack_contrib(g, 2, res)[0])
+    fresh = eb.unpack_contrib(fresh_packed)
+    assert not _trees_equal(stale, fresh)  # residual re-presented
+    reset = eb.unpack_contrib(eb.pack_contrib(g, 2, None)[0])
+    assert _trees_equal(reset, fresh)  # reset == fresh world
+
+
+def test_bucket_plan_rekeys_on_world_resize():
+    """The cached plan's key carries the live world in its axes: a
+    resize re-derives the plan, re-expansion gets the cached one back."""
+    from theanompi_tpu.parallel import bucketing as B
+
+    g = {"w1": np.zeros((16, 32), np.float32)}
+    p3, _, _ = eb._bucket_plan(g, 3, B.DEFAULT_BUCKET_BYTES)
+    p2, _, _ = eb._bucket_plan(g, 2, B.DEFAULT_BUCKET_BYTES)
+    p3b, _, _ = eb._bucket_plan(g, 3, B.DEFAULT_BUCKET_BYTES)
+    assert p3 is not p2  # shrunken world: fresh plan
+    assert p3 is p3b  # re-expansion: the SAME cached plan object
+
+
+# ---------------------------------------------------------------------------
+# the threaded fleet
+# ---------------------------------------------------------------------------
+
+def test_uninterrupted_fleet_matches_reference():
+    """3 threads over real localhost sockets, no chaos: every rank ends
+    bit-identical to the transport-free reference driver (EF residuals
+    threading across steps included) — the drill's baseline is honest."""
+    n, steps = 3, 5
+    addrs = [("127.0.0.1", find_free_port()) for _ in range(n)]
+    workers = [
+        eb.ElasticBSPWorker(
+            r, addrs, eb.BSPTrainProgram(**CFG), n_steps=steps,
+            evict_after_s=5.0,
+        )
+        for r in range(n)
+    ]
+    _join_all(_spawn(workers), workers)
+    ref_params, _ = eb.run_reference(
+        eb.BSPTrainProgram(**CFG), steps, n
+    )
+    for w in workers:
+        assert w.error is None
+        assert _trees_equal(w.params, ref_params)
+    # recompile pin, fixed world: one grad trace, one apply trace each
+    assert all(w.program.grad_traces == 1 for w in workers)
+    assert all(w.program.apply_traces == 1 for w in workers)
+
+
+def test_shrink_resized_step_bit_identical_and_one_recompile():
+    """Kill one rank mid-run (no rejoin): exactly one eviction
+    fleet-wide, the survivors' replayed step bit-identical to a fresh
+    2-rank world from the same state (dp remap + plan re-key + EF
+    reset), exactly one extra recompile (the 2-world apply), and both
+    survivors still bit-identical to each other at the end."""
+    n, steps, victim = 3, 8, 1
+    addrs = [("127.0.0.1", find_free_port()) for _ in range(n)]
+    events = []
+    workers = [
+        eb.ElasticBSPWorker(
+            r, addrs, eb.BSPTrainProgram(**CFG), n_steps=steps,
+            evict_after_s=0.8,
+            die_at_step=3 if r == victim else None,
+            on_event=lambda k, m, g, _r=r: events.append((_r, k, m, g)),
+        )
+        for r in range(n)
+    ]
+    _join_all(_spawn(workers), workers)
+    survivors = [w for w in workers if w.rank != victim]
+    for w in survivors:
+        assert w.error is None, repr(w.error)
+        assert w.world == 2 and w.gen == 2
+    evicts = [e for e in events if e[1] == "evict"]
+    assert len(evicts) == 1, evicts  # the leader's, exactly once
+    assert evicts[0][2] == victim
+    # followers learn the death from the commit — a clean leave, so
+    # racing membership views can never double-evict
+    assert all(e[0] == 0 for e in evicts)
+    cap = next(
+        w.resize_capture for w in survivors
+        if w.resize_capture is not None
+    )
+    ref_params, _, ref_sum = eb.reference_step(
+        eb.BSPTrainProgram(**CFG), cap["params"], cap["opt"],
+        cap["step"], cap["members"],
+    )
+    assert _trees_equal(cap["grad_sum"], ref_sum)
+    assert _trees_equal(cap["params_after"], ref_params)
+    assert _trees_equal(survivors[0].params, survivors[1].params)
+    for w in survivors:
+        assert w.program.grad_traces == 1  # world-independent, ever
+        assert w.program.apply_traces == 2  # worlds 3 and 2, once each
+
+
+def test_committed_bsp_chaos_drill():
+    """The acceptance drill (ISSUE 13), tier-1: kill one rank mid-run
+    → exactly one eviction and one worker_evicted alert → survivors'
+    post-resize step bit-identical to a fresh (n−1)-rank world → the
+    respawn rejoins and re-expands under a bumped generation → final
+    loss within tolerance of the uninterrupted baseline → zero
+    recompiles beyond the single expected resize recompile.  The same
+    verdict gates perf_gate's BSP leg."""
+    from theanompi_tpu.runtime import chaos
+
+    verdict = chaos.run_bsp_drill()
+    assert verdict["ok"], verdict["violations"]
+    assert verdict["kills_observed"] == 1
+    assert verdict["evictions"] == 1
+    assert verdict["worker_evicted_alerts"] == 1
+    assert verdict["resized_step_bit_identical"] is True
+    assert verdict["generation_monotone"] is True
+    assert verdict["resizes"] == {"shrink": 1, "expand": 1}
+    assert verdict["world_restored"] and verdict["rejoined"]
+    assert verdict["extra_recompiles"] == 0
+    assert verdict["loss_delta"] <= verdict["loss_tolerance"]
+
+
+def test_rejoiner_port_reuse_never_resurrects_the_dead_rank():
+    """A respawned rank binds its predecessor's port BEFORE the
+    eviction lands: its 'rejoining' replies must not read as the dead
+    incarnation's liveness — the eviction still happens, then the
+    expansion admits the successor."""
+    n, steps, victim = 3, 16, 1
+    addrs = [("127.0.0.1", find_free_port()) for _ in range(n)]
+    events = []
+    workers = {
+        r: eb.ElasticBSPWorker(
+            r, addrs, eb.BSPTrainProgram(**CFG), n_steps=steps,
+            evict_after_s=1.2, step_delay_s=0.08,
+            die_at_step=3 if r == victim else None,
+            on_event=lambda k, m, g, _r=r: events.append((_r, k, m, g)),
+        )
+        for r in range(n)
+    }
+    threads = _spawn(list(workers.values()))
+    # respawn IMMEDIATELY (inside the eviction window, on purpose);
+    # the dead listener's port frees asynchronously — retry the bind
+    # like a real supervisor respawn would
+    while not workers[victim]._killed:
+        time.sleep(0.01)
+    rejoiner = None
+    bind_deadline = time.monotonic() + 10.0
+    while rejoiner is None:
+        try:
+            rejoiner = eb.ElasticBSPWorker(
+                victim, addrs, eb.BSPTrainProgram(**CFG),
+                n_steps=steps,
+                members=[r for r in range(n) if r != victim],
+                evict_after_s=1.2, step_delay_s=0.08, rejoin=True,
+            )
+        except OSError:
+            if time.monotonic() > bind_deadline:
+                raise
+            time.sleep(0.05)
+    threads.append(
+        threading.Thread(target=rejoiner.run, daemon=True)
+    )
+    threads[-1].start()
+    _join_all(threads, list(workers.values()) + [rejoiner])
+    assert rejoiner.error is None, repr(rejoiner.error)
+    evicts = [e for e in events if e[1] == "evict"]
+    assert len(evicts) == 1, evicts  # the eviction still landed
+    assert rejoiner.world == n  # and the successor was admitted
+    assert rejoiner.final_loss is not None
+    survivors = [w for r, w in workers.items() if r != victim]
+    assert all(w.world == n for w in survivors)
+    # all three incarnations end parameter-identical (BSP invariant
+    # restored across the whole shrink→expand episode)
+    assert _trees_equal(survivors[0].params, survivors[1].params)
+    assert _trees_equal(survivors[0].params, rejoiner.params)

@@ -141,6 +141,119 @@ def test_roster_concurrent_leave_join_consistency():
 
 
 # ---------------------------------------------------------------------------
+# roster churn under resize (ISSUE 13 satellite) — plane "bsp"
+# ---------------------------------------------------------------------------
+
+
+def test_bsp_roster_eviction_exactly_once_under_racing_sweeps():
+    """N threads racing sweep() over the same silent member: exactly
+    ONE of them observes the eviction — the elastic-BSP 'one eviction
+    per kill fleet-wide' invariant at the roster layer."""
+    t = [0.0]
+    events = []
+    lock = threading.Lock()
+
+    def on_event(kind, member, gen):
+        with lock:
+            events.append((kind, member, gen))
+
+    r = ms.Roster("bsp", evict_after_s=1.0, clock=lambda: t[0],
+                  on_event=on_event)
+    r.join("w1")
+    r.beat("w1", step=3)  # armed
+    t[0] = 5.0
+    evicted = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        out = r.sweep()
+        with lock:
+            evicted.extend(out)
+
+    threads = [threading.Thread(target=race) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert evicted == ["w1"]  # one sweep won; the rest saw nothing
+    assert [e for e in events if e[0] == "evict"] == [("evict", "w1", 1)]
+    assert r.n_evictions == 1
+
+
+def test_bsp_roster_generation_monotone_across_shrink_expand_shrink():
+    """The generation a member carries is strictly increasing across a
+    full shrink → expand → shrink episode — both sides always know
+    which incarnation's history they hold."""
+    t = [0.0]
+    r = ms.Roster("bsp", evict_after_s=1.0, clock=lambda: t[0])
+    gens = [r.join("w1")]
+    r.beat("w1", step=2)
+    t[0] += 5.0
+    assert r.sweep() == ["w1"]  # shrink
+    gens.append(r.join("w1"))  # expand: re-admission
+    r.beat("w1", step=9)
+    t[0] += 5.0
+    assert r.sweep() == ["w1"]  # shrink again
+    gens.append(r.join("w1"))
+    assert gens == [1, 2, 3]
+    assert all(b > a for a, b in zip(gens, gens[1:]))
+
+
+def test_bsp_roster_concurrent_sweep_and_rejoin_hammer():
+    """Sweeps racing rejoins on plane 'bsp': the table stays coherent,
+    every eviction pairs with the member being absent at that instant,
+    and generations never move backwards."""
+    r = ms.Roster("bsp", evict_after_s=0.01, join_grace_s=0.02)
+    errors = []
+    stop = time.monotonic() + 0.5
+    seen_gens = {f"w{i}": 0 for i in range(4)}
+    glock = threading.Lock()
+
+    def rejoiner(rank):
+        try:
+            step = 0
+            while time.monotonic() < stop:
+                gen = r.join(rank)
+                with glock:
+                    assert gen > seen_gens[rank] or gen == 1
+                    seen_gens[rank] = max(seen_gens[rank], gen)
+                step += 1
+                r.beat(rank, step=step)
+        except Exception as e:  # pragma: no cover - the assertion
+            errors.append(e)
+
+    def sweeper():
+        try:
+            while time.monotonic() < stop:
+                # each swept rank was atomically removed inside sweep();
+                # it may already be BACK by now (a racing rejoin — the
+                # very churn under test), so only coherence is asserted
+                for m in r.sweep():
+                    gen = r.generation(m)
+                    assert gen is None or gen >= 1
+                time.sleep(0.002)
+        except Exception as e:  # pragma: no cover - the assertion
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=rejoiner, args=(f"w{i}",))
+        for i in range(4)
+    ] + [threading.Thread(target=sweeper) for _ in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    members = r.members()
+    assert len(members) == len(set(members))
+    for rank, gen in seen_gens.items():
+        cur = r.generation(rank)
+        if cur is not None:
+            assert cur >= gen  # never backwards
+
+
+# ---------------------------------------------------------------------------
 # TauController — straggler-adaptive tau
 # ---------------------------------------------------------------------------
 
@@ -174,6 +287,55 @@ def test_tau_controller_bounds():
     ctrl = ms.TauController(8, r, tau_min=2, tau_max=32)
     assert ctrl.tau_for("fast") == 32
     assert ctrl.tau_for("slow") == 2
+
+
+def test_tau_controller_prefers_live_doctor_straggler_index():
+    """ISSUE 13 satellite: with a live source installed, τ scales from
+    the doctor's span-level per-rank straggler index (rate ∝ 1−index),
+    not the roster's beat-rate proxy — the roster here would say the
+    OPPOSITE (it rates 'rank1' fast), so a wrong source is visible."""
+    r = _rated_roster({1: 20.0, 2: 10.0, 3: 5.0})
+    live = {"easgd_rank1": 0.5, "easgd_rank2": 0.0, "easgd_rank3": 0.75}
+    ctrl = ms.TauController(8, r, live_source=lambda: live)
+    # speeds (1-idx): rank1 0.5, rank2 1.0, rank3 0.25; median 0.5
+    assert ctrl.tau_for(1) == 8    # at the median
+    assert ctrl.tau_for(2) == 16   # the fast rank earns a longer τ
+    assert ctrl.tau_for(3) == 4    # the straggler exchanges sooner
+    # a member the live window does not cover falls back to the proxy
+    r.join(4)
+
+
+def test_tau_controller_falls_back_to_proxy_when_live_plane_off():
+    r = _rated_roster({1: 20.0, 2: 10.0, 3: 5.0})
+    # source returning None (no closed window yet), a single-rank
+    # window (no relative signal), and a RAISING source all fall back
+    for src in (lambda: None, lambda: {"rank1": 0.5},
+                lambda: (_ for _ in ()).throw(RuntimeError("down"))):
+        ctrl = ms.TauController(8, r, live_source=src)
+        assert ctrl.tau_for(1) == 16  # the beat-rate proxy's answer
+        assert ctrl.tau_for(3) == 4
+
+
+def test_live_straggler_source_reads_latest_window_with_stragglers():
+    class FakeAgg:
+        def __init__(self, windows):
+            self._w = windows
+
+        def recent_windows(self):
+            return self._w
+
+    win = {
+        "window": 3,
+        "stragglers": {"per_rank": {
+            "rank1": {"straggler_index": 0.0},
+            "rank2": {"straggler_index": 0.6},
+        }},
+    }
+    empty = {"window": 4}  # newest window closed without span data
+    src = ms.live_straggler_source(FakeAgg([win, empty]))
+    assert src() == {"rank1": 0.0, "rank2": 0.6}
+    assert ms.live_straggler_source(FakeAgg([empty]))() is None
+    assert ms.live_straggler_source(FakeAgg([]))() is None
 
 
 # ---------------------------------------------------------------------------

@@ -53,6 +53,7 @@ def _run_gate(env_extra):
     env.setdefault("PERF_GATE_SERVE", "0")
     env.setdefault("PERF_GATE_CHAOS", "0")
     env.setdefault("PERF_GATE_FLEET", "0")
+    env.setdefault("PERF_GATE_BSP", "0")
     env.update(env_extra)
     return subprocess.run(
         ["bash", GATE], capture_output=True, text=True, env=env,
@@ -679,4 +680,125 @@ def test_gate_fleet_leg_skippable(fixtures):
     assert r.returncode == 0, r.stderr
     assert "fleet drill" not in r.stderr
     assert "fleet:" not in r.stderr
+    assert "green" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# BSP leg (ISSUE 13): the elastic-BSP shrink/rejoin drill verdict gates
+# the round — smoke-tested on fixture verdicts like the other legs
+# ---------------------------------------------------------------------------
+
+def _bsp_json(path, ok=True, kills=1, evictions=1, alerts=None,
+              bit_identical=True, world_restored=True, rejoined=True,
+              monotone=True, extra_recompiles=0, loss_delta=0.01,
+              tolerance=0.25, violations=None):
+    doc = {"rules": {"BSP": {
+        "rule": "BSP",
+        "ok": ok,
+        "violations": list(violations or ()),
+        "n_ranks": 3,
+        "kill_rank": 1,
+        "kill_iter": 6,
+        "n_steps": 20,
+        "kills_observed": kills,
+        "evictions": evictions,
+        "worker_evicted_alerts": (
+            evictions if alerts is None else alerts
+        ),
+        "resized_step_bit_identical": bit_identical,
+        "generations": {"0": [1, 2, 3], "2": [1, 2, 3]},
+        "generation_monotone": monotone,
+        "world_restored": world_restored,
+        "rejoined": rejoined,
+        "resizes": {"shrink": 1, "expand": 1},
+        "apply_traces": {"0": 2, "2": 2},
+        "extra_recompiles": extra_recompiles,
+        "baseline_loss": 2.0,
+        "chaos_loss": 2.0 + loss_delta,
+        "loss_delta": loss_delta,
+        "loss_tolerance": tolerance,
+    }}, "ok": ok}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def _bsp_env(fixtures, bsp_json):
+    base, good, _ = fixtures
+    return {
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_BSP": "1",
+        "PERF_GATE_BSP_JSON": bsp_json,
+    }
+
+
+def test_gate_bsp_leg_green(fixtures, tmp_path):
+    r = _run_gate(_bsp_env(fixtures, _bsp_json(tmp_path / "bsp.json")))
+    assert r.returncode == 0, r.stderr
+    assert "bsp: 1 kill -> 1 eviction" in r.stderr
+    assert "resize bit-identical" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_bsp_leg_detects_blackout(fixtures, tmp_path):
+    """A drill whose respawned rank never re-expanded the world is a
+    capacity blackout: refused even when the verdict self-reports
+    ok."""
+    bsp = _bsp_json(tmp_path / "bsp.json", world_restored=False,
+                    rejoined=False)
+    r = _run_gate(_bsp_env(fixtures, bsp))
+    assert r.returncode != 0
+    assert "never re-expanded the world" in (r.stdout + r.stderr)
+
+
+def test_gate_bsp_leg_fails_on_non_identical_resize(fixtures, tmp_path):
+    bsp = _bsp_json(
+        tmp_path / "bsp.json", ok=False, bit_identical=False,
+        violations=["survivors' post-resize step is NOT bit-identical"],
+    )
+    r = _run_gate(_bsp_env(fixtures, bsp))
+    assert r.returncode != 0
+    assert "BSP VIOLATION" in r.stderr
+    assert "bit-identical" in (r.stdout + r.stderr)
+
+
+def test_gate_bsp_leg_fails_on_eviction_mismatch(fixtures, tmp_path):
+    """Two evictions for one kill = followers double-evicted; one kill
+    with zero worker_evicted alerts = the live plane missed it.  Both
+    refused independent of the drill's self-assessment."""
+    bsp = _bsp_json(tmp_path / "bsp.json", evictions=2, alerts=2)
+    r = _run_gate(_bsp_env(fixtures, bsp))
+    assert r.returncode != 0
+    assert "eviction(s) for 1 kill(s)" in (r.stdout + r.stderr)
+    bsp2 = _bsp_json(tmp_path / "bsp2.json", alerts=0)
+    env = _bsp_env(fixtures, bsp2)
+    r2 = _run_gate(env)
+    assert r2.returncode != 0
+    assert "worker_evicted alert(s)" in (r2.stdout + r2.stderr)
+
+
+def test_gate_bsp_leg_fails_on_extra_recompiles(fixtures, tmp_path):
+    bsp = _bsp_json(tmp_path / "bsp.json", extra_recompiles=2)
+    r = _run_gate(_bsp_env(fixtures, bsp))
+    assert r.returncode != 0
+    assert "beyond the single expected resize recompile" in (
+        r.stdout + r.stderr
+    )
+
+
+def test_gate_bsp_leg_skippable(fixtures):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_BSP": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "bsp drill" not in r.stderr
+    assert "bsp:" not in r.stderr
     assert "green" in r.stderr

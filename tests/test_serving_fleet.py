@@ -229,6 +229,131 @@ def test_summary_and_score_prompt_round_trip():
     assert summary[0] in {d.hex() for d in chain_digests(prompt, 8)}
 
 
+def test_score_prompt_weighted_depth_dominates_recency():
+    """ISSUE 13 satellite (d): depth × recency scoring — a deeper
+    match always outranks a fresher shallower one (recency scales in
+    (0.5, 1.0], so it can never cross a whole block of reusable
+    prefill), and at EQUAL depth the fresher summary wins."""
+    from theanompi_tpu.serving.radix import score_prompt_weighted
+
+    prompt = list(range(16))
+    d0, d1 = [d.hex() for d in chain_digests(prompt, 8)]
+    cold_tail = ["%040x" % i for i in range(6)]
+    # depth 2 held in the COLDEST positions still beats depth 1 at MRU
+    deep_cold = cold_tail + [d0, d1]
+    shallow_hot = [d0] + cold_tail
+    w_deep, depth_deep = score_prompt_weighted(prompt, 8, deep_cold)
+    w_shallow, depth_shallow = score_prompt_weighted(
+        prompt, 8, shallow_hot
+    )
+    assert (depth_deep, depth_shallow) == (2, 1)
+    assert w_deep > w_shallow
+    # equal depth: the replica whose chain is MRU-warm outranks the
+    # one holding it in entries about to be LRU-evicted
+    hot = [d0, d1] + cold_tail
+    cold = cold_tail + [d0, d1]
+    assert score_prompt_weighted(prompt, 8, hot)[0] \
+        > score_prompt_weighted(prompt, 8, cold)[0]
+    # no match stays (0.0, 0); empty summary too
+    assert score_prompt_weighted([7] * 16, 8, hot) == (0.0, 0)
+    assert score_prompt_weighted(prompt, 8, []) == (0.0, 0)
+
+
+class _StubReplica:
+    """Protocol-level stand-in: enough of the replica surface for
+    router placement tests (summary/headroom are the subject, no
+    engine required)."""
+
+    def __init__(self, summary=(), headroom=0, block_size=8):
+        self.summary = list(summary)
+        self.headroom = headroom
+        self.block_size = block_size
+        self.submitted = []
+
+    def handle(self, msg):
+        kind = msg[0]
+        if kind == "hello":
+            return {"ok": True, "v": 1, "block_size": self.block_size,
+                    "n_slots": 2, "max_len": 64}
+        if kind == "submit":
+            self.submitted.append(msg[1])
+            return {"ok": True, "ticks": 1}
+        if kind == "poll":
+            return {"ok": True, "streams": {}, "ticks": 1,
+                    "healthy": True, "draining": False, "idle": True,
+                    "summary": list(self.summary),
+                    "headroom": self.headroom}
+        return {"ok": False}
+
+
+def test_router_places_by_depth_times_recency():
+    """Equal-depth candidates: the router picks the replica whose
+    matching chain is warm (MRU-first summary position), deterministic
+    — not a round-robin coin flip."""
+    prompt = list(range(16))
+    d0, d1 = [d.hex() for d in chain_digests(prompt, 8)]
+    cold_tail = ["%040x" % i for i in range(6)]
+    warm = _StubReplica(summary=[d0, d1] + cold_tail)
+    cold = _StubReplica(summary=cold_tail + [d0, d1])
+    deep = _StubReplica(summary=cold_tail + [d0, d1])
+    shallow = _StubReplica(summary=[d0])
+    router = FleetRouter(evict_after_s=60.0)
+    router.add_replica("warm", warm)
+    router.add_replica("cold", cold)
+    router.pump()  # absorb summaries/headroom from poll replies
+    for _ in range(4):  # deterministic, not alternating
+        assert router.route(prompt) == ("warm", 2)
+    # and a deeper match beats a fresher shallower one
+    router2 = FleetRouter(evict_after_s=60.0)
+    router2.add_replica("deep", deep)
+    router2.add_replica("shallow", shallow)
+    router2.pump()
+    for _ in range(4):
+        assert router2.route(prompt) == ("deep", 2)
+
+
+def test_router_breaks_ties_on_advertised_headroom():
+    """Reuse being equal (identical summaries; and again on the cold
+    path with no summaries), placement goes where the advertised pool
+    headroom is — replicas trade reuse against capacity."""
+    prompt = list(range(16))
+    digests = [d.hex() for d in chain_digests(prompt, 8)]
+    roomy = _StubReplica(summary=digests, headroom=40)
+    full = _StubReplica(summary=digests, headroom=2)
+    router = FleetRouter(evict_after_s=60.0)
+    router.add_replica("roomy", roomy)
+    router.add_replica("full", full)
+    router.pump()
+    for _ in range(4):
+        assert router.route(prompt)[0] == "roomy"
+    # cold prompts: least-loaded ties ALSO break on headroom
+    cold_router = FleetRouter(evict_after_s=60.0)
+    cold_router.add_replica("roomy", _StubReplica(headroom=40))
+    cold_router.add_replica("full", _StubReplica(headroom=2))
+    cold_router.pump()
+    for _ in range(4):
+        assert cold_router.route([9] * 12)[0] == "roomy"
+
+
+def test_replica_poll_reply_advertises_pool_headroom(model):
+    """A real replica's poll reply carries its BlockPool's free-block
+    count, and allocation moves it."""
+    rep = _replica(model, "r0", warm=False)
+    try:
+        before = rep.handle(("poll", {}))["headroom"]
+        assert before == rep.scheduler.pool.n_free > 0
+        rep.handle(("submit", {"id": "s0", "prompt": [1, 2, 3, 4],
+                               "max_new_tokens": 4}))
+        deadline = time.monotonic() + 60.0
+        while not rep.scheduler.idle:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        after = rep.handle(("poll", {"s0": 0}))["headroom"]
+        assert isinstance(after, int)
+    finally:
+        rep.stop()
+
+
 def test_radix_scheduler_outputs_match_chain(model):
     """prefix_impl changes eviction policy, never tokens."""
     engine = _engine(model)

@@ -28,10 +28,25 @@ coverage here — this is a tier-1 gate):
    convention for helpers whose contract is "caller holds the lock" —
    ``TcpMailbox._send_locked``).
 
-Known blind spots (documented, not guessed at): bare ``.acquire()``
-calls, locks inherited from a base class in another module, and
-helpers called under the caller's lock without the ``_locked`` naming
-convention — rename the helper rather than suppressing the finding.
+ISSUE 13 widened what counts as "inside the lock" (each previously a
+documented blind spot):
+
+- **bare ``self.<lock>.acquire()``/``release()`` pairs**: a mutation
+  lexically between an acquire and its release (acquire count before
+  the line exceeds release count, within the enclosing function —
+  covers the ``acquire(); try: ... finally: release()`` idiom) is
+  locked, and marks its attr guarded, exactly like a ``with`` block.
+- **helpers invoked under the caller's lock** (a call-graph edge, not
+  the naming convention): a method of the class whose every
+  same-class call site (``self._helper(...)``) is itself locked — in
+  a ``with``/acquire span, or inside ``__init__``/``*_locked``/
+  another such helper (fixpoint) — inherits the caller's lock, so its
+  mutations stop firing.  A helper with even ONE unlocked call site
+  keeps firing: the AST cannot prove that caller holds the lock.
+
+Remaining blind spots (documented, not guessed at): locks inherited
+from a base class in another module, and helpers only ever called
+from OUTSIDE the class (no same-class call site proves anything).
 """
 
 from __future__ import annotations
@@ -107,6 +122,103 @@ def _holds_lock(m: ParsedModule, node: ast.AST, cls: ast.ClassDef,
     return False
 
 
+def _in_acquire_span(m: ParsedModule, node: ast.AST,
+                     locks: Set[str]) -> bool:
+    """Is ``node`` lexically between a bare ``self.<lock>.acquire()``
+    and its ``release()`` within the enclosing function?  Lexical
+    line-order counting (acquires before the node minus releases
+    before it) — exact for the straight-line ``acquire(); try: ...
+    finally: release()`` idiom this repo would ever write; a release
+    in an earlier branch conservatively closes the span."""
+    fi = m.enclosing_function(node)
+    if fi is None:
+        return False
+    line = getattr(node, "lineno", 0)
+    depth = 0
+    for sub in ast.walk(fi.node):
+        if not (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("acquire", "release")
+        ):
+            continue
+        path = attr_path(sub.func.value)
+        if not (path and path.startswith("self.")
+                and path[len("self."):] in locks):
+            continue
+        if sub.lineno < line:
+            depth += 1 if sub.func.attr == "acquire" else -1
+    return depth > 0
+
+
+def _node_locked(m: ParsedModule, node: ast.AST, cls: ast.ClassDef,
+                 locks: Set[str]) -> bool:
+    return _holds_lock(m, node, cls, locks) or _in_acquire_span(
+        m, node, locks
+    )
+
+
+def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {
+        item.name: item
+        for item in cls.body
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _self_call_sites(cls: ast.ClassDef,
+                     methods: Dict[str, ast.AST]) -> Dict[str, list]:
+    """method name -> the Call nodes ``self.<name>(...)`` anywhere in
+    the class — the call-graph edges lock inheritance flows along."""
+    sites: Dict[str, list] = {name: [] for name in methods}
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+            and node.func.attr in sites
+        ):
+            sites[node.func.attr].append(node)
+    return sites
+
+
+def _lock_inherited_methods(
+    m: ParsedModule, cls: ast.ClassDef, locks: Set[str],
+    methods: Dict[str, ast.AST],
+) -> Set[str]:
+    """Methods whose EVERY same-class call site provably holds the
+    lock — directly (with/acquire span) or transitively (the site
+    lives in ``__init__``, a ``*_locked`` helper, or another inherited
+    method); fixpoint until stable."""
+    sites = _self_call_sites(cls, methods)
+    exempt = {"__init__"} | {
+        n for n in methods if n.endswith("_locked")
+    }
+
+    def site_ok(site: ast.AST, sanctioned: Set[str]) -> bool:
+        if _node_locked(m, site, cls, locks):
+            return True
+        fi = m.enclosing_function(site)
+        while fi is not None:
+            if fi.qualname.rsplit(".", 1)[-1] in sanctioned:
+                return True
+            fi = fi.parent
+        return False
+
+    inherited: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, calls in sites.items():
+            if name in exempt or name in inherited or not calls:
+                continue
+            if all(site_ok(c, exempt | inherited) for c in calls):
+                inherited.add(name)
+                changed = True
+    return inherited
+
+
 def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
                          locks: Set[str]) -> List[_Mutation]:
     out: List[_Mutation] = []
@@ -115,7 +227,7 @@ def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
         if attr is None:
             return
         out.append(
-            _Mutation(attr, node, _holds_lock(m, node, cls, locks))
+            _Mutation(attr, node, _node_locked(m, node, cls, locks))
         )
 
     for node in ast.walk(cls):
@@ -141,13 +253,17 @@ def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
     return out
 
 
-def _exempt(m: ParsedModule, node: ast.AST) -> bool:
-    """__init__ (construction precedes sharing) and *_locked helpers
-    (contract: caller holds the lock)."""
+def _exempt(m: ParsedModule, node: ast.AST,
+            inherited: Set[str]) -> bool:
+    """__init__ (construction precedes sharing), *_locked helpers
+    (contract: caller holds the lock), and helpers whose every
+    same-class call site provably holds it (``inherited`` — the
+    call-graph widening)."""
     fi = m.enclosing_function(node)
     while fi is not None:
         name = fi.qualname.rsplit(".", 1)[-1]
-        if name == "__init__" or name.endswith("_locked"):
+        if (name == "__init__" or name.endswith("_locked")
+                or name in inherited):
             return True
         fi = fi.parent
     return False
@@ -161,6 +277,9 @@ def run(m: ParsedModule) -> List[Finding]:
         locks = _class_lock_attrs(m, node)
         if not locks:
             continue
+        inherited = _lock_inherited_methods(
+            m, node, locks, _class_methods(node)
+        )
         mutations = _iter_dict_mutations(m, node, locks)
         guarded: Dict[str, bool] = {}
         for mu in mutations:
@@ -169,7 +288,7 @@ def run(m: ParsedModule) -> List[Finding]:
         for mu in mutations:
             if mu.locked or mu.attr not in guarded:
                 continue
-            if _exempt(m, mu.node):
+            if _exempt(m, mu.node, inherited):
                 continue
             findings.append(Finding(
                 rule="GL-T001",
@@ -182,10 +301,11 @@ def run(m: ParsedModule) -> List[Finding]:
                     f"unlocked mutation of shared state dict "
                     f"'self.{mu.attr}': other methods of "
                     f"{node.name} mutate it under "
-                    f"'with self.{sorted(locks)[0]}', so this bare "
-                    "mutation races them (dict-changed-during-"
-                    "iteration, lost entries).  Wrap it in the lock, "
-                    "or rename the enclosing helper *_locked if the "
+                    f"'with self.{sorted(locks)[0]}' (or a bare "
+                    "acquire/release span), so this bare mutation "
+                    "races them (dict-changed-during-iteration, lost "
+                    "entries).  Wrap it in the lock, call the helper "
+                    "only from under it, or rename it *_locked if the "
                     "caller provably holds it"
                 ),
                 snippet=m.snippet(mu.node.lineno),

@@ -41,7 +41,15 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="theanompi_tpu.launch", description=__doc__, allow_abbrev=False
     )
-    p.add_argument("--rule", choices=["BSP", "EASGD", "GOSGD"], default="BSP")
+    p.add_argument(
+        "--rule",
+        choices=["BSP", "BSP_ELASTIC", "EASGD", "GOSGD"],
+        default="BSP",
+        help="BSP_ELASTIC: the shrink-to-survivors sync tier "
+        "(parallel/elastic_bsp.py) — independent processes over the "
+        "TCP transport like the async rules, so the fleet survives "
+        "member loss and re-expands on rejoin (docs/elasticity.md)",
+    )
     p.add_argument("--modelfile", default="theanompi_tpu.models.cifar10")
     p.add_argument("--modelclass", default="Cifar10_model")
     p.add_argument(
@@ -179,6 +187,21 @@ def _async_distributed_main(args) -> int:
             else _np.float16 if args.wire_dtype == "float16" else None
         ),
     )
+    if args.rule == "BSP_ELASTIC":
+        from theanompi_tpu.parallel import elastic_bsp as eb
+
+        eb.run_bsp_rank(
+            rank, size,
+            da.default_addresses(size, hosts, args.async_port_base),
+            n_steps=int(model_config.get("n_steps", 64)),
+            evict_after_s=args.heartbeat_timeout,
+            program_config={
+                k: v for k, v in model_config.items()
+                if k in ("seed", "dim", "hidden", "out", "batch",
+                         "lr", "momentum")
+            },
+        )
+        return 0
     if args.rule == "EASGD":
         if size < 2:
             raise SystemExit("EASGD needs ≥2 processes (1 server + workers)")
@@ -270,9 +293,11 @@ def main(argv=None) -> int:
         if args.elastic_restarts is not None or args.late_join:
             if args.rule == "BSP":
                 raise SystemExit(
-                    "--elastic-restarts/--late-join apply to the async "
-                    "rules: a BSP group shares one jax.distributed "
-                    "world and cannot lose members"
+                    "--elastic-restarts/--late-join apply to the "
+                    "membership-aware rules: a plain BSP group shares "
+                    "one jax.distributed world and cannot lose members "
+                    "— use --rule BSP_ELASTIC for the "
+                    "shrink-to-survivors sync tier"
                 )
             late = {}
             for part in (args.late_join or "").split(","):
@@ -333,6 +358,15 @@ def main(argv=None) -> int:
             # async rules: independent processes + TCP transport — no
             # collectives cross the process boundary (SURVEY.md §8.1)
             return _async_distributed_main(args)
+
+    if args.rule == "BSP_ELASTIC":
+        # the elastic sync tier is a process fleet by definition — a
+        # single controller has nobody to lose or re-admit
+        raise SystemExit(
+            "--rule BSP_ELASTIC needs a process fleet: run it under "
+            "--spawn-procs N (with --elastic-restarts for the "
+            "supervisor) or per-process --dist-rank/--dist-nprocs"
+        )
 
     import theanompi_tpu
     from theanompi_tpu.runtime.fault import run_with_restart

@@ -1,1 +1,5 @@
 from theanompi_tpu.parallel.exchanger import BSP_Exchanger  # noqa: F401
+
+# the elastic sync tier (ISSUE 13) is imported lazily by its users
+# (launch.py / runtime.chaos): parallel/__init__ must stay importable
+# at the same weight as before — see parallel/elastic_bsp.py.

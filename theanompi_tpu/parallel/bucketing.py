@@ -182,6 +182,22 @@ def plan_cache_info() -> int:
         return len(_PLAN_CACHE)
 
 
+def host_wire_axes(axis: str, world: int) -> Tuple:
+    """The reduction-axes key a HOST-side wire passes to
+    :func:`cached_plan` — ``(axis name, live world size)``.
+
+    In-graph wires key plans on mesh-axis NAMES alone (the axis size
+    is fixed for the life of the compiled program).  A host wire over
+    an elastic membership (``parallel/elastic_bsp.py``) has no such
+    guarantee: the dp world shrinks and re-expands mid-run, and its
+    bucket layout must follow — folding the world size into the axes
+    tuple makes every resize re-derive the plan by construction and
+    every re-expansion hit the original world's cache entry.  One
+    definition here so the wire and any future host consumer cannot
+    key differently."""
+    return (str(axis), int(world))
+
+
 # ---------------------------------------------------------------------------
 # in-DAG issue points
 # ---------------------------------------------------------------------------

@@ -518,6 +518,16 @@ def run_easgd_server(
     telemetry = obs_live.maybe_start_from_env("easgd_server")
     rec = Recorder(print_freq=1, rank=0, verbose=verbose,
                    save_dir=checkpoint_dir)
+    # adaptive τ prefers the live doctor's SPAN-LEVEL straggler index
+    # (shipped in the workers' telemetry frames) over the roster's
+    # beat-rate proxy — installed only when this process hosts the
+    # aggregator (THEANOMPI_LIVE=1); the controller falls back to the
+    # proxy whenever the live plane is off or has no window yet
+    live_tau_source = (
+        ms.live_straggler_source(telemetry.aggregator)
+        if telemetry is not None and hasattr(telemetry, "aggregator")
+        else None
+    )
     core = EasgdServerCore(
         center,
         alpha,
@@ -531,6 +541,8 @@ def run_easgd_server(
             generation=gen,
         ),
     )
+    if core.tau_ctrl is not None and live_tau_source is not None:
+        core.tau_ctrl.live_source = live_tau_source
     cv = core.cv
 
     channel = TcpServerChannel(address[1], core.handler)

@@ -1,0 +1,1096 @@
+"""Elastic BSP — shrink-to-survivors data parallelism with rejoin.
+
+The sync tier was the one place elasticity stopped (ROADMAP "the one
+place elasticity stops"): the in-graph ``BSP_Exchanger`` rides XLA
+collectives inside ONE ``jax.distributed`` world, and that world cannot
+lose a member — a dead rank wedges every survivor at the next psum.
+Theano-MPI's BSP exchanger (arXiv:1605.08325) assumed the same fixed
+world; a preemptible multi-slice pod does not.
+
+This module is the sync tier's membership-aware rendering, built from
+the pieces PR 10/12 already proved rule-agnostic:
+
+- **Roster on plane ``"bsp"``** (``parallel/membership.py``):
+  heartbeats piggyback on the exchange traffic itself — every contrib
+  request beats the requester at the server side, every contrib reply
+  beats the peer at the requester side; there are NO extra liveness
+  frames on the hot path.  Eviction arms on the first progress-carrying
+  beat (step ≥ 1), so a cold compile can never read as death.
+- **Host-bucketed q8 wire** (``parallel/bucketing.py`` +
+  ``parallel/wire.py``): each rank's gradient pytree is concatenated
+  into deterministic buckets (``bucketing.cached_plan`` — the plan
+  re-keys NATURALLY on the new axes when the dp world resizes, because
+  the axes tuple carries the live world size) and the bucket payloads
+  ride ``wire.q8_pack`` with a push-leg EF residual, exactly the
+  recipe the async TCP legs run.  Every rank folds the same
+  dequantized images in sorted-rank order, so parameters stay
+  bit-identical across the fleet.
+- **Resize consensus over ``transport.request()``** (the PR 12 retry
+  ladder, bounded retry + per-call deadline): when a rank goes silent
+  past the eviction window, the LEADER (lowest live rank) evicts it
+  from the roster — exactly once, fleet-wide; followers learn the new
+  membership from the commit and ``leave()`` the dead rank cleanly —
+  then runs a small propose/commit round: the proposal collects each
+  survivor's first-uncommitted step, the commit carries ``(generation
+  + 1, survivors, replay_step = min(uncommitted))``.  A blocked
+  exchange mid-step unwinds via the gather's timeout guard and the
+  torn step REPLAYS under the new generation — a survivor that had
+  already folded the old-world reduction for the replay step rolls
+  back to its pre-apply snapshot (BSP lockstep bounds the skew to one
+  step, so a depth-1 snapshot suffices, asserted).  On install every
+  survivor remaps its dp index over the sorted survivor list, resets
+  its wire EF residual, and re-derives its bucket plan for the
+  shrunken world — the survivors' replayed step is **bit-identical to
+  a fresh (n−1)-rank world's** (pinned against :func:`reference_step`
+  and a handwritten numpy oracle in ``tests/test_elastic_bsp.py``).
+- **Checkpointless rejoin** (the EASGD-center pattern): a respawned
+  rank pulls ``pull_state`` from any survivor, announces ``join`` to
+  the leader, and the world re-expands at the next step boundary under
+  a bumped generation — the joiner polls the leader's state snapshot
+  until it reaches the expansion boundary, so it enters with exactly
+  the parameters every survivor holds there.
+
+Recompile accounting: the local gradient step never depends on the
+world (per-rank batch shape is constant — the GLOBAL batch shrinks
+with the world), so it compiles once; the update fuses the
+loss/gradient mean rescale ``grad_sum / n_live`` as a static divisor,
+so a shrink costs exactly ONE recompile and the re-expansion reuses
+the original world's cached program — zero further recompiles,
+trace-counter pinned (``BSPTrainProgram.grad_traces`` /
+``apply_traces``).
+
+The committed drill is ``python -m theanompi_tpu.runtime.chaos --rule
+BSP`` (perf_gate's BSP leg); in tier-1 it runs ranks as threads over
+real localhost sockets with jax dispatch serialized through
+``_DISPATCH_LOCK`` (the legacy-jaxlib guard: concurrent in-process
+dispatch segfaults this container's CPU client), and the same worker
+runs one-per-process via ``launch.py --rule BSP_ELASTIC`` under
+``spawn_elastic``.  See docs/elasticity.md "Elastic BSP".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.parallel import bucketing as B
+from theanompi_tpu.parallel import membership as ms
+from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.transport import (
+    RequestDeadlineExceeded,
+    TcpServerChannel,
+    request,
+)
+from theanompi_tpu.runtime.mesh import DATA_AXIS
+
+Address = Tuple[str, int]
+Pytree = Any
+
+_REG = obs.get_registry()
+_RESIZES = _REG.counter(
+    "bsp_resizes_total",
+    "elastic BSP world resizes (direction label: shrink/expand)",
+)
+_REPLAYS = _REG.counter(
+    "bsp_step_replays_total",
+    "steps replayed under a new generation after a torn exchange",
+)
+
+# One process, one jax dispatch at a time: the tier-1 drill runs ranks
+# as THREADS, and on this container's legacy jaxlib concurrent
+# in-process dispatch segfaults the CPU client (conftest legacy guard).
+# BSP is synchronous anyway, so serializing the compiled calls costs
+# nothing; cross-process ranks never contend (one thread per process).
+_DISPATCH_LOCK = threading.Lock()
+
+# how many recent (gen, step) contrib publications each rank retains:
+# BSP lockstep bounds the fleet skew to one step, so a peer can never
+# need a contrib older than current-1; keep one extra for safety
+_PUBLISH_KEEP = 3
+
+
+def _host_tree(tree: Pytree) -> Pytree:
+    """Host COPY of every leaf (same contract as async_workers._to_host:
+    snapshots cross threads and must be immutable history)."""
+    import jax
+
+    return jax.tree.map(lambda x: np.array(x), tree)
+
+
+class BSPTrainProgram:
+    """The compiled per-rank half of the elastic BSP tier.
+
+    A deliberately small data-parallel trainer (tanh-MLP regression on
+    deterministic synthetic data) whose two compiled programs carry
+    trace counters — the recompile pin the drill asserts on:
+
+    - ``local_grads`` — world-INDEPENDENT (the per-rank batch shape is
+      constant; the global batch shrinks with the world): compiles
+      once, ever (``grad_traces``).
+    - ``apply(world, ...)`` — the update with the gradient-mean rescale
+      ``grad_sum / world`` fused as a STATIC divisor, cached per world
+      (``apply_traces``): a shrink costs exactly one new trace, the
+      re-expansion reuses the original world's cached program.
+
+    Data assignment is ``batch_for(step, dp_index, world)`` —
+    deterministic in all three, so remapping the dp axis over the
+    survivors reproduces exactly the batches a fresh smaller world
+    would draw, which is what makes the resized step bit-identical to
+    a fresh run.  All state in/out is host numpy pytrees.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        dim: int = 16,
+        hidden: int = 32,
+        out: int = 4,
+        batch: int = 8,
+        lr: float = 0.05,
+        momentum: float = 0.9,
+    ):
+        self.seed = int(seed)
+        self.dim, self.hidden, self.out = int(dim), int(hidden), int(out)
+        self.batch = int(batch)
+        self.lr, self.momentum = float(lr), float(momentum)
+        self.grad_traces = 0
+        self.apply_traces = 0
+        self._grad_fn = None
+        self._apply_fns: Dict[int, Any] = {}
+        rng = np.random.RandomState(1_000 + self.seed)
+        # the fixed "teacher" map targets are drawn from — shared by
+        # every rank (and every fresh-world oracle) at the same seed
+        self._teacher = rng.randn(self.dim, self.out).astype(np.float32)
+
+    # ---- state -------------------------------------------------------
+    def init_state(self) -> Tuple[Pytree, Pytree]:
+        rng = np.random.RandomState(2_000 + self.seed)
+        params = {
+            "b1": np.zeros((self.hidden,), np.float32),
+            "b2": np.zeros((self.out,), np.float32),
+            "w1": (rng.randn(self.dim, self.hidden) * 0.3).astype(
+                np.float32
+            ),
+            "w2": (rng.randn(self.hidden, self.out) * 0.3).astype(
+                np.float32
+            ),
+        }
+        opt = {k: np.zeros_like(v) for k, v in params.items()}
+        return params, opt
+
+    def batch_for(self, step: int, dp_index: int, world: int):
+        """This dp shard's batch for one step — deterministic in
+        ``(seed, step, dp_index, world)`` so a fresh world at the same
+        assignment draws byte-identical data (no salted ``hash()``)."""
+        s = (
+            self.seed * 1_000_003
+            + int(step) * 8_191
+            + int(dp_index) * 131
+            + int(world)
+        ) % (2**31 - 1)
+        rng = np.random.RandomState(s)
+        x = rng.randn(self.batch, self.dim).astype(np.float32)
+        y = x @ self._teacher
+        return x, y
+
+    # ---- compiled programs -------------------------------------------
+    def _ensure_grad(self):
+        if self._grad_fn is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        def loss_fn(params, x, y):
+            h = jnp.tanh(x @ params["w1"] + params["b1"])
+            pred = h @ params["w2"] + params["b2"]
+            return jnp.mean((pred - y) ** 2)
+
+        def grads(params, x, y):
+            self.grad_traces += 1  # runs at trace time only
+            return jax.grad(loss_fn)(params, x, y)
+
+        self._grad_fn = jax.jit(grads)
+
+    def local_grads(self, params: Pytree, batch) -> Pytree:
+        self._ensure_grad()
+        x, y = batch
+        with _DISPATCH_LOCK:
+            return _host_tree(self._grad_fn(params, x, y))
+
+    def _apply_for(self, world: int):
+        fn = self._apply_fns.get(world)
+        if fn is not None:
+            return fn
+        import jax
+
+        lr, mom = self.lr, self.momentum
+        w = int(world)
+
+        def apply(params, opt, grad_sum):
+            self.apply_traces += 1  # runs at trace time only
+            # the gradient-mean rescale by the LIVE world, fused static:
+            # this is the one program that must recompile on a resize
+            mean = jax.tree.map(lambda s: s / w, grad_sum)
+            new_opt = jax.tree.map(lambda m, g: mom * m + g, opt, mean)
+            new_params = jax.tree.map(
+                lambda p, m: p - lr * m, params, new_opt
+            )
+            return new_params, new_opt
+
+        fn = jax.jit(apply)
+        self._apply_fns[world] = fn
+        return fn
+
+    def apply(self, world: int, params: Pytree, opt: Pytree,
+              grad_sum: Pytree) -> Tuple[Pytree, Pytree]:
+        fn = self._apply_for(int(world))
+        with _DISPATCH_LOCK:
+            p, o = fn(params, opt, grad_sum)
+            return _host_tree(p), _host_tree(o)
+
+    def loss(self, params: Pytree, batch=None) -> float:
+        """Host-side (numpy) eval on a fixed validation batch — no jit,
+        so the drill's loss yardstick never pollutes the trace pins."""
+        if batch is None:
+            rng = np.random.RandomState(3_000 + self.seed)
+            x = rng.randn(64, self.dim).astype(np.float32)
+            batch = (x, x @ self._teacher)
+        x, y = batch
+        h = np.tanh(x @ params["w1"] + params["b1"])
+        pred = h @ params["w2"] + params["b2"]
+        return float(np.mean((pred - y) ** 2))
+
+
+# ---------------------------------------------------------------------------
+# the host bucket wire: cached_plan buckets + q8(+EF) payloads
+# ---------------------------------------------------------------------------
+
+def _bucket_plan(grads: Pytree, world: int,
+                 bucket_bytes: int) -> Tuple[Any, Any, list]:
+    """(plan, treedef, leaves) for one gradient pytree at one world.
+    The plan keys on ``(treedef, shapes, axes, strategy, bucket_bytes)``
+    with the live world folded into the axes tuple — so a resize
+    re-derives the plan for the shrunken world by construction, and the
+    re-expansion gets the original world's cached plan back."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(grads)
+    axes = (B.host_wire_axes(DATA_AXIS, world),)
+    return (
+        B.cached_plan(
+            treedef,
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+            (axes,) * len(leaves),
+            "host_q8",
+            int(bucket_bytes),
+        ),
+        treedef,
+        leaves,
+    )
+
+
+def pack_contrib(grads: Pytree, world: int, residual,
+                 bucket_bytes: int = B.DEFAULT_BUCKET_BYTES):
+    """One rank's exchange contribution: bucket-concatenated fp32
+    payloads through the q8 wire with the push-leg EF residual —
+    returns ``(packed, new_residual)``.  Pass ``residual=None`` after
+    any membership change: stale error feedback must never be replayed
+    into a resized world (the fresh-world bit-identity depends on it)."""
+    plan, _treedef, leaves = _bucket_plan(grads, world, bucket_bytes)
+    payload = {}
+    for bi, b in enumerate(plan.buckets):
+        parts = [
+            np.asarray(leaves[i], np.float32).ravel() for i in b.idx
+        ]
+        payload[f"b{bi}"] = (
+            parts[0] if len(parts) == 1 else np.concatenate(parts)
+        )
+    return wire.q8_pack(payload, residual)
+
+
+def unpack_contrib(packed) -> Dict[str, np.ndarray]:
+    """Receiver half: packed bucket payloads back to fp32 flats."""
+    return wire.q8_unpack(packed)
+
+
+def sum_contribs(payloads: Dict[int, Dict[str, np.ndarray]],
+                 template: Pytree, world: int,
+                 bucket_bytes: int = B.DEFAULT_BUCKET_BYTES) -> Pytree:
+    """Fold every member's dequantized bucket payloads — in SORTED rank
+    order, so fp32 summation order is identical on every rank and in
+    the fresh-world oracle — and split the totals back into the
+    gradient pytree via the same cached plan."""
+    plan, treedef, leaves = _bucket_plan(template, world, bucket_bytes)
+    ranks = sorted(payloads)
+    totals = {}
+    for key in payloads[ranks[0]]:
+        acc = np.array(payloads[ranks[0]][key], np.float32, copy=True)
+        for r in ranks[1:]:
+            acc += np.asarray(payloads[r][key], np.float32)
+        totals[key] = acc
+    outs: List[Optional[np.ndarray]] = [None] * len(leaves)
+    for bi, b in enumerate(plan.buckets):
+        flat = totals[f"b{bi}"]
+        for i, off, sz in zip(b.idx, b.offsets, b.sizes):
+            outs[i] = flat[off:off + sz].reshape(leaves[i].shape).astype(
+                np.float32
+            )
+    return treedef.unflatten(outs)
+
+
+def reference_step(
+    program: BSPTrainProgram,
+    params: Pytree,
+    opt: Pytree,
+    step: int,
+    members: Sequence[int],
+    bucket_bytes: int = B.DEFAULT_BUCKET_BYTES,
+) -> Tuple[Pytree, Pytree, Pytree]:
+    """One FRESH-world BSP step, transport-free: every member's local
+    grads through the bucket wire with ZERO EF residuals, summed in
+    sorted-member order, applied with the world-static mean.  This is
+    the oracle the drill compares the survivors' post-resize step
+    against (bit-identical required), itself pinned against a
+    handwritten numpy q8 oracle in tests.  Returns ``(params, opt,
+    grad_sum)``."""
+    ranks = sorted(int(m) for m in members)
+    world = len(ranks)
+    payloads = {}
+    for idx, r in enumerate(ranks):
+        g = program.local_grads(
+            params, program.batch_for(step, idx, world)
+        )
+        packed, _res = pack_contrib(g, world, None, bucket_bytes)
+        payloads[r] = unpack_contrib(packed)
+        template = g
+    total = sum_contribs(payloads, template, world, bucket_bytes)
+    new_p, new_o = program.apply(world, params, opt, total)
+    return new_p, new_o, total
+
+
+def run_reference(
+    program: BSPTrainProgram, n_steps: int, n_ranks: int,
+    bucket_bytes: int = B.DEFAULT_BUCKET_BYTES,
+) -> Tuple[Pytree, Pytree]:
+    """The uninterrupted fixed-world run — the drill's loss baseline
+    (the threaded fleet is pinned bit-identical to this driver by
+    ``test_uninterrupted_fleet_matches_reference``).  Unlike the
+    single-step :func:`reference_step` oracle, the per-member EF
+    residuals here thread across steps, exactly as each live rank's
+    do."""
+    params, opt = program.init_state()
+    ranks = list(range(int(n_ranks)))
+    residuals: Dict[int, Any] = {r: None for r in ranks}
+    for step in range(int(n_steps)):
+        payloads = {}
+        template = None
+        for idx, r in enumerate(ranks):
+            g = program.local_grads(
+                params, program.batch_for(step, idx, len(ranks))
+            )
+            packed, residuals[r] = pack_contrib(
+                g, len(ranks), residuals[r], bucket_bytes
+            )
+            payloads[r] = unpack_contrib(packed)
+            template = g
+        total = sum_contribs(payloads, template, len(ranks), bucket_bytes)
+        params, opt = program.apply(len(ranks), params, opt, total)
+    return params, opt
+
+
+# ---------------------------------------------------------------------------
+# the elastic worker
+# ---------------------------------------------------------------------------
+
+class _Killed(RuntimeError):
+    """In-thread SIGKILL stand-in (the drill's chaos hammer)."""
+
+
+class ElasticBSPWorker:
+    """One rank of the elastic BSP fleet.
+
+    Serves its own ``TcpServerChannel`` (contrib / resize / pull_state
+    / join) and drives the step loop: compute local grads → publish the
+    packed contrib → gather every live member's contrib (the exchange;
+    requests carry the per-call deadline ladder) → fold in sorted rank
+    order → apply with the world-static mean.  Membership transitions
+    ride the resize consensus described in the module docstring.
+
+    Thread-safety: every mutation of the shared tables
+    (``_published``/``_state_snapshot``/``_pending_joins``) happens
+    under ``self._lock`` — the handler thread and the step loop share
+    them (the GL-T graftlint pass watches exactly this surface).
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        addresses: Sequence[Address],
+        program: BSPTrainProgram,
+        n_steps: int,
+        members: Optional[Sequence[int]] = None,
+        evict_after_s: float = 2.0,
+        join_grace_s: Optional[float] = None,
+        bucket_bytes: int = B.DEFAULT_BUCKET_BYTES,
+        contrib_timeout_s: float = 0.5,
+        consensus_timeout_s: float = 15.0,
+        step_timeout_s: float = 120.0,
+        step_delay_s: float = 0.0,
+        die_at_step: Optional[int] = None,
+        rejoin: bool = False,
+        fault=None,
+        on_event: Optional[Callable[[str, Any, int], None]] = None,
+    ):
+        self.rank = int(rank)
+        self.addresses = [tuple(a) for a in addresses]
+        self.program = program
+        self.n_steps = int(n_steps)
+        self.members: List[int] = sorted(
+            int(m) for m in (
+                members if members is not None
+                else range(len(self.addresses))
+            )
+        )
+        self.evict_after_s = float(evict_after_s)
+        self.join_grace_s = (
+            float(join_grace_s) if join_grace_s is not None
+            else 10.0 * self.evict_after_s
+        )
+        self.bucket_bytes = int(bucket_bytes)
+        self.contrib_timeout_s = float(contrib_timeout_s)
+        self.consensus_timeout_s = float(consensus_timeout_s)
+        self.step_timeout_s = float(step_timeout_s)
+        self.step_delay_s = float(step_delay_s)
+        self.die_at_step = die_at_step
+        self.rejoin = bool(rejoin)
+        self.fault = fault
+        self._on_event = on_event
+
+        self.gen = 1
+        self.generations: List[int] = [1]
+        self.world = len(self.members)
+        # a rejoiner's initial membership is the survivor set (itself
+        # excluded) — its real dp index arrives with the expand commit
+        self.dp_index = (
+            self.members.index(self.rank)
+            if self.rank in self.members else 0
+        )
+        self.step = 0
+        self.n_replays = 0
+        self.n_shrinks = 0
+        self.n_expands = 0
+        self.params: Pytree = None
+        self.opt: Pytree = None
+        self.final_loss: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.resize_capture: Optional[dict] = None
+
+        self._lock = threading.Lock()
+        self._killed = False
+        self._done = False
+        # a respawned rank binds its predecessor's port: until the
+        # expand commit admits it, its replies must NOT read as the
+        # dead incarnation's liveness (the eviction must land first)
+        self._admitted = not rejoin
+        self._pub_residual = None
+        self._published: Dict[Tuple[int, int], Any] = {}
+        # commits QUEUE in generation order and install lowest-first:
+        # a leader that shrinks and immediately expands (a respawn
+        # waiting in the wings) must not have its second commit
+        # overwrite a survivor's still-uninstalled first one
+        self._pending_commits: List[dict] = []
+        self._pending_joins: List[int] = []
+        self._state_snapshot: dict = {}
+        self._prev: Optional[dict] = None
+        self._start_mono = time.monotonic()
+        # peers live in the plane-"bsp" roster; ONLY the consensus
+        # leader sweeps it, so each eviction is observed — and counted,
+        # and paged by the live plane — exactly once fleet-wide
+        self.roster = ms.Roster(
+            "bsp",
+            evict_after_s=self.evict_after_s,
+            join_grace_s=self.join_grace_s,
+            on_event=self._roster_event,
+        )
+        for m in self.members:
+            if m != self.rank:
+                self.roster.join(m)
+        self.channel = TcpServerChannel(
+            self.addresses[self.rank][1], self._handle
+        )
+
+    # ---- events ------------------------------------------------------
+    def _roster_event(self, kind: str, member, generation: int) -> None:
+        if self._on_event is not None:
+            self._on_event(kind, member, generation)
+
+    # ---- chaos -------------------------------------------------------
+    def kill(self) -> None:
+        """Die NOW, mid-step, without goodbye: the channel refuses
+        connections exactly like a SIGKILL'd process's port."""
+        self._killed = True
+        self.channel.close()
+
+    def stop(self) -> None:
+        """Clean teardown after the drill joins the thread."""
+        self.channel.close()
+
+    # ---- protocol handler (the serve thread) -------------------------
+    def _handle(self, msg: Any) -> Any:
+        if self._killed:
+            raise ConnectionError(f"rank {self.rank} is dead")
+        kind = msg.get("kind")
+        if kind == "contrib":
+            if not self._admitted:
+                return {"status": "rejoining"}
+            peer = int(msg["rank"])
+            # the request IS the peer's heartbeat — no extra frames
+            if not self.roster.beat(peer, step=msg.get("step")):
+                if peer in self.members:
+                    self.roster.join(peer)
+                    self.roster.beat(peer, step=msg.get("step"))
+            key = (int(msg["gen"]), int(msg["step"]))
+            with self._lock:
+                packed = self._published.get(key)
+                cur_gen = self.gen
+            if packed is not None:
+                return {"status": "ok", "packed": packed}
+            if int(msg["gen"]) < cur_gen:
+                return {"status": "gen_behind", "gen": cur_gen}
+            return {"status": "wait"}
+        if kind == "resize":
+            phase = msg["phase"]
+            if phase == "propose":
+                if int(msg["gen"]) <= self.gen:
+                    return {"ok": False, "gen": self.gen}
+                return {"ok": True, "uncommitted_step": self.step}
+            # commit: queued, installed by the step loop in gen order
+            self._queue_commit(dict(msg))
+            return {"ok": True}
+        if kind == "pull_state":
+            with self._lock:
+                return dict(self._state_snapshot)
+        if kind == "join":
+            joiner = int(msg["rank"])
+            with self._lock:
+                if joiner not in self._pending_joins:
+                    self._pending_joins.append(joiner)
+            return {"ok": True, "gen": self.gen,
+                    "members": list(self.members)}
+        return {"ok": False, "reason": f"unknown kind {kind!r}"}
+
+    # ---- shared-state helpers ----------------------------------------
+    def _snapshot_state(self) -> None:
+        with self._lock:
+            self._state_snapshot = {
+                "step": self.step,
+                "gen": self.gen,
+                "members": list(self.members),
+                "params": _host_tree(self.params),
+                "opt": _host_tree(self.opt),
+            }
+
+    def _publish(self, step: int, gen: int, grads: Pytree) -> None:
+        packed, res = pack_contrib(
+            grads, self.world, self._pub_residual, self.bucket_bytes
+        )
+        with self._lock:
+            self._pub_residual = res
+            self._published[(gen, step)] = packed
+            while len(self._published) > _PUBLISH_KEEP:
+                oldest = min(self._published)
+                self._published.pop(oldest, None)
+
+    def _suspected(self, peer: int) -> bool:
+        """Leadership-eligibility suspicion, read from the ROSTER (it
+        sees incoming-request beats too, so a peer pausing its own
+        polls — e.g. paying the resize recompile — never makes us look
+        past it)."""
+        silent = self.roster.silent_for(peer)
+        if silent is None:
+            return True  # evicted/unknown: no leadership vote
+        return silent > self.evict_after_s
+
+    def _is_leader(self) -> bool:
+        live = [self.rank] + [
+            m for m in self.members
+            if m != self.rank and not self._suspected(m)
+        ]
+        return min(live) == self.rank
+
+    def _queue_commit(self, commit: dict) -> None:
+        with self._lock:
+            gen = int(commit["gen"])
+            if gen <= self.gen or any(
+                int(c["gen"]) == gen for c in self._pending_commits
+            ):
+                return  # stale or duplicate delivery
+            self._pending_commits.append(commit)
+            self._pending_commits.sort(key=lambda c: int(c["gen"]))
+
+    def _commit_ready(self) -> Optional[dict]:
+        """The next commit to install — LOWEST generation first (a
+        shrink must land before the expand the leader queued right
+        behind it); an expand waits for its start boundary."""
+        with self._lock:
+            while self._pending_commits:
+                c = self._pending_commits[0]
+                if int(c["gen"]) <= self.gen:
+                    self._pending_commits.pop(0)  # already installed
+                    continue
+                if (c["mode"] == "expand"
+                        and self.step < int(c["start_step"])):
+                    return None
+                return c
+            return None
+
+    # ---- resize consensus --------------------------------------------
+    def _request_peer(self, peer: int, msg: dict, deadline_s: float):
+        return request(
+            self.addresses[peer], msg,
+            timeout=deadline_s, connect_retries=1,
+            retry_backoff_s=0.05, deadline_s=deadline_s,
+        )
+
+    def _lead_shrink(self, dead: List[int]) -> None:
+        """The leader's propose/commit round over transport.request()
+        — bounded retry + deadline, the PR 12 ladder."""
+        survivors = [m for m in self.members if m not in set(dead)]
+        new_gen = self.gen + 1
+        uncommitted = {self.rank: self.step}
+        for peer in list(survivors):
+            if peer == self.rank:
+                continue
+            try:
+                reply = ms.retry_with_backoff(
+                    lambda p=peer: self._request_peer(
+                        p,
+                        {"kind": "resize", "phase": "propose",
+                         "gen": new_gen, "members": survivors,
+                         "rank": self.rank},
+                        self.consensus_timeout_s / 3,
+                    ),
+                    attempts=3,
+                    counter_labels={"rule": "bsp"},
+                )
+            except (ConnectionError, OSError, TimeoutError,
+                    RequestDeadlineExceeded):
+                # a "survivor" that cannot even ack the proposal is
+                # dead too: shrink past it now rather than committing
+                # a membership it will never serve
+                survivors.remove(peer)
+                continue
+            if reply.get("ok"):
+                uncommitted[peer] = int(reply["uncommitted_step"])
+        replay_step = min(uncommitted.values())
+        commit = {
+            "kind": "resize", "phase": "commit", "mode": "shrink",
+            "gen": new_gen, "members": survivors,
+            "replay_step": replay_step, "rank": self.rank,
+        }
+        for peer in survivors:
+            if peer == self.rank:
+                continue
+            ms.retry_with_backoff(
+                lambda p=peer: self._request_peer(
+                    p, commit, self.consensus_timeout_s / 3
+                ),
+                attempts=3,
+                counter_labels={"rule": "bsp"},
+            )
+        self._queue_commit(commit)
+        _RESIZES.inc(direction="shrink")
+
+    def _lead_expand(self, joiners: List[int]) -> None:
+        joiners = [j for j in joiners if j not in set(self.members)]
+        if not joiners:
+            with self._lock:  # current members need no re-admission
+                self._pending_joins = []
+            return
+        new_members = sorted(set(self.members) | set(joiners))
+        new_gen = self.gen + 1
+        # +2 clears every member's in-flight step (BSP lockstep bounds
+        # the fleet skew to one step)
+        start_step = self.step + 2
+        if start_step >= self.n_steps:
+            with self._lock:  # too late in the run to re-expand
+                self._pending_joins = [
+                    j for j in self._pending_joins
+                    if j not in set(joiners)
+                ]
+            return
+        commit = {
+            "kind": "resize", "phase": "commit", "mode": "expand",
+            "gen": new_gen, "members": new_members,
+            "start_step": start_step, "rank": self.rank,
+        }
+        targets = [m for m in new_members if m != self.rank]
+        for peer in targets:
+            ms.retry_with_backoff(
+                lambda p=peer: self._request_peer(
+                    p, commit, self.consensus_timeout_s / 3
+                ),
+                attempts=3,
+                counter_labels={"rule": "bsp"},
+            )
+        self._queue_commit(commit)
+        with self._lock:
+            self._pending_joins = [
+                j for j in self._pending_joins if j not in set(joiners)
+            ]
+        _RESIZES.inc(direction="expand")
+
+    def _install(self, commit: dict) -> None:
+        mode = commit["mode"]
+        new_members = sorted(int(m) for m in commit["members"])
+        departed = [m for m in self.members if m not in set(new_members)]
+        arrived = [m for m in new_members if m not in set(self.members)]
+        with self._lock:
+            self._pending_commits = [
+                c for c in self._pending_commits
+                if int(c["gen"]) > int(commit["gen"])
+            ]
+            self.gen = int(commit["gen"])
+            self.generations.append(self.gen)
+            self.members = new_members
+            self.world = len(new_members)
+            self.dp_index = new_members.index(self.rank)
+            # EF residual reset: the departed rank's history (and ours
+            # against the old group) must never replay into the resized
+            # world — the fresh-world bit-identity depends on it
+            self._pub_residual = None
+            if mode == "shrink":
+                # the torn generation's contribs must never be served
+                # again.  An EXPAND keeps the history: a member one
+                # step behind the boundary still needs this rank's
+                # old-generation contribs to reach it.
+                self._published.clear()
+        for m in departed:
+            # followers learn the death from the commit: a clean
+            # roster leave, never a second eviction (the leader's
+            # sweep already paged it exactly once)
+            if self.roster.is_member(m):
+                self.roster.leave(m)
+        for m in arrived:
+            if m != self.rank and not self.roster.is_member(m):
+                self.roster.join(m)
+        if mode == "shrink":
+            self.n_shrinks += 1
+            replay_step = int(commit["replay_step"])
+            if self.step > replay_step:
+                # this rank already folded the OLD world's reduction
+                # for the replay step: unwind to the pre-apply snapshot
+                # (lockstep bounds the skew to one step — asserted)
+                prev = self._prev
+                if prev is None or prev["step"] != replay_step:
+                    raise RuntimeError(
+                        f"rank {self.rank}: cannot roll back from "
+                        f"step {self.step} to {replay_step} (snapshot "
+                        f"{None if prev is None else prev['step']}) — "
+                        "the one-step lockstep invariant broke"
+                    )
+                self.params = _host_tree(prev["params"])
+                self.opt = _host_tree(prev["opt"])
+            self.step = replay_step
+            self.n_replays += 1
+            _REPLAYS.inc()
+            # arm the drill's bit-identity capture: the very next
+            # applied step is the resized one
+            self.resize_capture = {
+                "step": replay_step,
+                "gen": self.gen,
+                "members": list(new_members),
+                "params": _host_tree(self.params),
+                "opt": _host_tree(self.opt),
+                "params_after": None,
+                "grad_sum": None,
+            }
+        else:
+            self.n_expands += 1
+        self._snapshot_state()
+
+    # ---- the exchange ------------------------------------------------
+    def _gather(self, step: int, gen: int,
+                template: Pytree) -> Optional[Pytree]:
+        """All live members' contribs for ``(step, gen)``; None when a
+        resize commit interrupted the exchange (the caller replays).
+        The timeout guard: a peer that stays silent past the eviction
+        window is swept (leader) or awaited for the leader's commit
+        (followers) — a blocked exchange never wedges the step loop."""
+        with self._lock:
+            own = self._published.get((gen, step))
+        got = {self.rank: unpack_contrib(own)}
+        missing = [m for m in self.members if m != self.rank]
+        deadline = time.monotonic() + self.step_timeout_s
+        while missing:
+            if self._killed:
+                raise _Killed()
+            if self._commit_ready() is not None:
+                return None
+            for peer in list(missing):
+                try:
+                    reply = self._request_peer(
+                        peer,
+                        {"kind": "contrib", "step": step, "gen": gen,
+                         "rank": self.rank},
+                        self.contrib_timeout_s,
+                    )
+                except (ConnectionError, OSError, TimeoutError,
+                        RequestDeadlineExceeded):
+                    continue  # silence is how eviction starts
+                status = reply.get("status")
+                if status == "rejoining":
+                    # a respawned, not-yet-admitted successor on the
+                    # dead rank's port: NOT the old incarnation's
+                    # liveness — the eviction must still land
+                    continue
+                # any admitted reply proves life: heartbeat the peer
+                self.roster.beat(peer, step=step)
+                if status == "ok":
+                    got[peer] = unpack_contrib(reply["packed"])
+                    missing.remove(peer)
+                # "wait"/"gen_behind": peer alive, retry next round
+            if missing:
+                # a peer whose contrib already landed this round is
+                # presumed live until the NEXT step's exchange: it may
+                # legitimately pause its own polls (the resize
+                # recompile, or a stall on a peer WE already have).
+                # This must precede the leadership check — during a
+                # victim stall, two survivors that both hold each
+                # other's contribs poll only the victim, and without
+                # the presumption each reads the other as silent and
+                # BOTH self-promote (two evictions for one kill).
+                for peer in self.members:
+                    if peer != self.rank and peer not in missing:
+                        self.roster.beat(peer, step=step)
+                if self._is_leader():
+                    dead = [int(d) for d in self.roster.sweep()]
+                    if dead:
+                        self._lead_shrink(dead)
+                        return None
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"rank {self.rank}: exchange for step {step} "
+                        f"(gen {gen}) wedged past "
+                        f"{self.step_timeout_s}s on {missing}"
+                    )
+                time.sleep(0.01)
+        return sum_contribs(got, template, self.world, self.bucket_bytes)
+
+    # ---- rejoin ------------------------------------------------------
+    def _pull_and_join(self) -> None:
+        """Checkpointless re-admission: pull state from any survivor,
+        announce the join to the leader, then poll the leader's state
+        snapshot until the expansion boundary — entering with exactly
+        the parameters every survivor holds there."""
+        deadline = time.monotonic() + self.step_timeout_s
+        state = None
+        while state is None:
+            for peer in range(len(self.addresses)):
+                if peer == self.rank:
+                    continue
+                try:
+                    reply = self._request_peer(
+                        peer, {"kind": "pull_state"},
+                        self.contrib_timeout_s,
+                    )
+                except (ConnectionError, OSError, TimeoutError,
+                        RequestDeadlineExceeded):
+                    continue
+                if reply.get("members"):
+                    state = reply
+                    break
+            if state is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"rank {self.rank}: no survivor answered "
+                        "pull_state — nothing to rejoin"
+                    )
+                time.sleep(0.05)
+        members = sorted(int(m) for m in state["members"])
+        leader = members[0]
+        with self._lock:
+            self.gen = int(state["gen"])
+            self.generations = [self.gen]
+        last_join = 0.0
+
+        def _raw_pending():
+            # NOT _commit_ready: the expand gate compares self.step
+            # (still 0 here) to start_step — the joiner reads the raw
+            # commit the moment it lands
+            with self._lock:
+                return (
+                    self._pending_commits[0]
+                    if self._pending_commits else None
+                )
+
+        while _raw_pending() is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"rank {self.rank}: rejoin never re-expanded the "
+                    "world (no commit within the window)"
+                )
+            if time.monotonic() - last_join > 0.25:
+                last_join = time.monotonic()
+                try:
+                    self._request_peer(
+                        leader,
+                        {"kind": "join", "rank": self.rank},
+                        self.contrib_timeout_s,
+                    )
+                except (ConnectionError, OSError, TimeoutError,
+                        RequestDeadlineExceeded):
+                    pass
+            time.sleep(0.02)
+        with self._lock:
+            commit = self._pending_commits.pop(0)
+            self.gen = int(commit["gen"])
+            self.generations.append(self.gen)
+            self.members = sorted(int(m) for m in commit["members"])
+            self.world = len(self.members)
+            self.dp_index = self.members.index(self.rank)
+        start_step = int(commit["start_step"])
+        # the commit SENDER is the live leader — members[0] may be this
+        # very joiner (a respawned rank 0 reclaims the low rank)
+        source = int(commit["rank"])
+        for m in self.members:
+            if m != self.rank and not self.roster.is_member(m):
+                self.roster.join(m)
+        # poll the leader until its snapshot reaches the boundary —
+        # those are exactly the params every survivor enters it with
+        while True:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"rank {self.rank}: leader never reached the "
+                    f"expansion boundary (step {start_step})"
+                )
+            try:
+                snap = self._request_peer(
+                    source, {"kind": "pull_state"},
+                    self.contrib_timeout_s,
+                )
+            except (ConnectionError, OSError, TimeoutError,
+                    RequestDeadlineExceeded):
+                time.sleep(0.02)
+                continue
+            if (int(snap.get("gen", -1)) == self.gen
+                    and int(snap.get("step", -1)) == start_step):
+                self.params = snap["params"]
+                self.opt = snap["opt"]
+                self.step = start_step
+                break
+            time.sleep(0.02)
+        self._admitted = True
+        self._snapshot_state()
+
+    # ---- the loop ----------------------------------------------------
+    def run(self) -> "ElasticBSPWorker":
+        try:
+            self._run()
+        except _Killed:
+            pass  # the chaos hammer: die silently, like SIGKILL
+        except BaseException as e:  # surfaced as a drill violation
+            self.error = e
+            self.channel.close()
+            raise
+        return self
+
+    def _run(self) -> None:
+        if self.rejoin:
+            self._pull_and_join()
+        else:
+            self.params, self.opt = self.program.init_state()
+            self._snapshot_state()
+        while self.step < self.n_steps:
+            if self._killed:
+                raise _Killed()
+            if (self.die_at_step is not None
+                    and self.step >= self.die_at_step
+                    and not self.rejoin):
+                self.kill()
+                raise _Killed()
+            if self.fault is not None:
+                self.fault.maybe_fail(self.rank, self.step + 1)
+            commit = self._commit_ready()
+            if commit is not None:
+                self._install(commit)
+                continue
+            with self._lock:
+                joiners = list(self._pending_joins)
+            if joiners and self._is_leader():
+                self._lead_expand(joiners)
+                continue
+            if self.step_delay_s:
+                time.sleep(self.step_delay_s)
+            step, gen = self.step, self.gen
+            with obs.span("bsp_elastic_step", step=step, gen=gen):
+                batch = self.program.batch_for(
+                    step, self.dp_index, self.world
+                )
+                grads = self.program.local_grads(self.params, batch)
+                self._publish(step, gen, grads)
+                total = self._gather(step, gen, grads)
+                if total is None:
+                    continue  # resize mid-exchange: replay the step
+                self._prev = {
+                    "step": step,
+                    "params": _host_tree(self.params),
+                    "opt": _host_tree(self.opt),
+                }
+                self.params, self.opt = self.program.apply(
+                    self.world, self.params, self.opt, total
+                )
+                cap = self.resize_capture
+                if cap is not None and cap["params_after"] is None:
+                    cap["grad_sum"] = _host_tree(total)
+                    cap["params_after"] = _host_tree(self.params)
+                self.step += 1
+                self._snapshot_state()
+        self.final_loss = self.program.loss(self.params)
+        self._done = True
+
+
+# ---------------------------------------------------------------------------
+# cross-process entry (launch.py --rule BSP_ELASTIC, under spawn_elastic)
+# ---------------------------------------------------------------------------
+
+def run_bsp_rank(
+    rank: int,
+    size: int,
+    addresses: Sequence[Address],
+    n_steps: int = 64,
+    evict_after_s: float = 5.0,
+    program_config: Optional[dict] = None,
+    rejoin: Optional[bool] = None,
+) -> ElasticBSPWorker:
+    """One elastic-BSP rank as an OS process — the ``spawn_elastic``
+    child body.  A respawned rank (``THEANOMPI_ELASTIC_REJOIN=1``, set
+    by the supervisor) takes the checkpointless rejoin path; fault
+    plans ride ``THEANOMPI_FAULT_PLAN`` exactly like the async rules."""
+    from theanompi_tpu.runtime.fault import FaultInjector
+
+    if rejoin is None:
+        rejoin = os.environ.get("THEANOMPI_ELASTIC_REJOIN") == "1"
+    program = BSPTrainProgram(**(program_config or {}))
+    worker = ElasticBSPWorker(
+        rank,
+        addresses,
+        program,
+        n_steps=n_steps,
+        members=None if not rejoin else [
+            m for m in range(size) if m != rank
+        ],
+        evict_after_s=evict_after_s,
+        rejoin=rejoin,
+        fault=FaultInjector.from_env(rank=rank),
+    )
+    try:
+        worker.run()
+    finally:
+        worker.stop()
+    return worker

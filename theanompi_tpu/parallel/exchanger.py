@@ -80,6 +80,15 @@ the optimizer step; ``avg`` = local step then *parameter* averaging.
 Both are exposed; EASGD/GOSGD exchangers live in
 ``theanompi_tpu.parallel.async_exchanger`` (host-mediated — XLA has no
 dynamic p2p).
+
+World-resize note (ISSUE 13): everything here compiles against ONE
+fixed mesh — a member loss is unrecoverable inside the program.  The
+membership-aware sync tier (``parallel/elastic_bsp.py``) runs the same
+bucket-plan + q8+EF recipe HOST-side over the TCP transport, where the
+dp world can shrink to the survivors and re-expand on rejoin; its EF
+residuals reset on every membership change (stale error feedback must
+never replay into a resized world) and its bucket plans re-key on the
+live world size.  See docs/elasticity.md "Elastic BSP".
 """
 
 from __future__ import annotations

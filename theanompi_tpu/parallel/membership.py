@@ -37,6 +37,7 @@ transport plane, not of the compiled program.
 from __future__ import annotations
 
 import random
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -254,6 +255,18 @@ class Roster:
             m = self._members.get(member)
             return None if m is None else m.generation
 
+    def silent_for(self, member: Any) -> Optional[float]:
+        """Seconds since ``member``'s last beat — liveness evidence
+        from BOTH directions of piggybacked traffic (the elastic BSP
+        leader-eligibility check reads this instead of keeping its own
+        last-contact table, which would go stale whenever this rank
+        stopped polling, e.g. during a resize recompile).  None for
+        non-members."""
+        now = self.clock()
+        with self._lock:
+            m = self._members.get(member)
+            return None if m is None else now - m.last_beat_mono
+
     def state(self, member: Any) -> Optional[Dict[str, Any]]:
         """The member's connection-state dict (EF residuals live here;
         freed on evict/leave, fresh on rejoin).  None for non-members —
@@ -299,7 +312,22 @@ class TauController:
     same wall interval: stragglers exchange after FEWER local steps
     (fresher, per the elastic-averaging staleness bound), fast ranks
     after more (less serialization at the server, the reference's
-    known bottleneck)."""
+    known bottleneck).
+
+    Signal sources, in preference order:
+
+    1. ``live_source`` (when installed — :func:`live_straggler_source`
+       over a live-plane ``Aggregator``): the doctor's SPAN-LEVEL
+       per-rank straggler index from the latest closed verdict window.
+       It sees inside steps (compute vs inbox-stall vs comm), so a
+       rank slowed by a noisy neighbor mid-τ is re-rated within one
+       window instead of one exchange.  Each index maps back to a
+       relative rate as ``rate_i ∝ 1 − index_i`` (the index is
+       ``1 − rate/max`` by construction on both planes).
+    2. the roster's beat-measured step rates — the proxy, and the
+       fallback whenever the live plane is off, has no window yet, or
+       does not cover this member.
+    """
 
     def __init__(
         self,
@@ -307,13 +335,59 @@ class TauController:
         roster: Roster,
         tau_min: Optional[int] = None,
         tau_max: Optional[int] = None,
+        live_source: Optional[Callable[[], Optional[Dict[Any, float]]]] = None,
     ):
         self.base_tau = max(1, int(base_tau))
         self.roster = roster
         self.tau_min = int(tau_min) if tau_min else max(1, self.base_tau // 4)
         self.tau_max = int(tau_max) if tau_max else self.base_tau * 4
+        # installed post-construction by drivers that own a live
+        # aggregator (run_easgd_server); None = roster proxy only
+        self.live_source = live_source
+
+    def _clamp(self, tau: float) -> int:
+        return max(self.tau_min, min(self.tau_max, int(round(tau))))
+
+    def _live_indices(self) -> Optional[Dict[int, float]]:
+        """{rank: straggler index} from the live doctor, rank labels
+        normalized to their trailing integer (``easgd_rank2`` → 2 —
+        the spelling the shippers use).  None on any gap: no source,
+        no window, fewer than two covered ranks, or a source error
+        (the live plane must never take τ hints down with it)."""
+        if self.live_source is None:
+            return None
+        try:
+            raw = self.live_source()
+        except Exception:
+            return None
+        if not raw:
+            return None
+        out: Dict[int, float] = {}
+        for label, idx in raw.items():
+            m = re.search(r"(\d+)$", str(label))
+            if m is None:
+                continue
+            out[int(m.group(1))] = float(idx)
+        return out if len(out) >= 2 else None
 
     def tau_for(self, member: Any) -> int:
+        live = self._live_indices()
+        if live is not None:
+            try:
+                idx = live.get(int(member))
+            except (TypeError, ValueError):
+                idx = None
+            if idx is not None:
+                # rate ∝ 1 − index; same median-normalized scaling as
+                # the proxy path, so switching sources never jumps τ
+                speeds = sorted(
+                    max(0.0, 1.0 - i) for i in live.values()
+                )
+                median = speeds[len(speeds) // 2]
+                if median > 0:
+                    return self._clamp(
+                        self.base_tau * max(0.0, 1.0 - idx) / median
+                    )
         rates = self.roster.step_rates()
         r = rates.get(member)
         if r is None or len(rates) < 2:
@@ -322,8 +396,26 @@ class TauController:
         median = ordered[len(ordered) // 2]
         if median <= 0:
             return self.base_tau
-        tau = int(round(self.base_tau * (r / median)))
-        return max(self.tau_min, min(self.tau_max, tau))
+        return self._clamp(self.base_tau * (r / median))
+
+
+def live_straggler_source(aggregator) -> Callable[[], Optional[Dict[str, float]]]:
+    """Adapt a live-plane ``Aggregator`` into a ``TauController``
+    ``live_source``: the per-rank SPAN-LEVEL straggler indices of the
+    newest closed verdict window that has any (``stragglers.per_rank``
+    needs at least two ranks' spans), or None — the controller then
+    falls back to the roster's beat-rate proxy."""
+    def source() -> Optional[Dict[str, float]]:
+        for verdict in reversed(aggregator.recent_windows()):
+            per_rank = (verdict.get("stragglers") or {}).get("per_rank")
+            if per_rank:
+                return {
+                    label: float(row.get("straggler_index", 0.0))
+                    for label, row in per_rank.items()
+                }
+        return None
+
+    return source
 
 
 def retry_with_backoff(

@@ -38,7 +38,17 @@ class BSP_Worker:
     Multi-process aware: under a ``jax.distributed`` group every process
     runs this same loop SPMD (the reference's N MPI ranks), each logging
     to ``record_rank{process}.jsonl``; only process 0 prints and writes
-    checkpoints (the reference also checkpointed on rank 0)."""
+    checkpoints (the reference also checkpointed on rank 0).
+
+    Elasticity note (ISSUE 13): this loop's world is FIXED — the
+    jax.distributed group cannot lose a member, so a dead rank wedges
+    every survivor at the next in-graph collective and recovery is
+    restart-from-checkpoint (``run_with_restart``).  On a preemptible
+    fleet use the membership-aware sync tier instead:
+    ``parallel.elastic_bsp.ElasticBSPWorker`` (``launch.py --rule
+    BSP_ELASTIC`` under ``spawn_elastic``) survives member loss by
+    shrinking to the survivors and re-expands on rejoin — see
+    docs/elasticity.md "Elastic BSP"."""
 
     def __init__(
         self,

@@ -1,6 +1,6 @@
 """The committed chaos drills — kill → evict → (respawn|re-admit).
 
-Two drills share this module and the ``perf_gate.sh`` discipline:
+Three drills share this module and the ``perf_gate.sh`` discipline:
 
 **Training drill** (``--rule EASGD|GOSGD``, PR 10): kill a worker
 process mid-run, require exactly one eviction, a respawn, a
@@ -16,6 +16,22 @@ through the ordinary prefill path), and p99 TTFT/TPOT within
 tolerance of the uninterrupted run.  The fleet is in-process
 (``serving/fleet.py`` replicas are threads behind the same protocol a
 TCP replica serves), so the drill is deterministic and CI-sized.
+
+**Elastic BSP drill** (``--rule BSP``, ISSUE 13 — the perf_gate BSP
+leg): kill one rank of a synchronous data-parallel fleet mid-run.
+Require exactly one eviction (the consensus leader's — fleet-wide) and
+exactly one ``worker_evicted`` live-plane alert; the survivors'
+replayed post-resize step must be **bit-identical to a fresh
+(n−1)-rank world's** (bucket plans re-derived for the shrunken world,
+EF residuals reset — ``elastic_bsp.reference_step`` is the oracle,
+itself numpy-oracle pinned in tests); the respawned rank must rejoin
+and re-expand the world under a bumped generation; the final loss must
+stay within tolerance of the uninterrupted baseline; and the whole
+episode may recompile exactly ONCE (the shrunken world's apply
+program) — trace-counter pinned.  Ranks run as threads over real
+localhost sockets (jax dispatch serialized — the legacy-jaxlib guard);
+the identical worker runs one-per-process via ``launch.py --rule
+BSP_ELASTIC`` under ``spawn_elastic``.
 
 ``python -m theanompi_tpu.runtime.chaos`` rehearses the elastic
 membership story (docs/elasticity.md) end-to-end on real OS processes:
@@ -483,6 +499,295 @@ def run_serve_drill(
     return verdict
 
 
+def run_bsp_drill(
+    n_ranks: int = 3,
+    kill_rank: int = 1,
+    kill_iter: int = 6,
+    n_steps: int = 22,
+    rejoin_after_s: float = 2.5,
+    evict_after_s: float = 1.25,
+    step_delay_s: float = 0.12,
+    tolerance_rel: float = 0.5,
+    tolerance_abs: float = 0.05,
+    timeout: float = 240.0,
+    program_config: Optional[dict] = None,
+    run_baseline: bool = True,
+) -> dict:
+    """The elastic-BSP kill drill; returns the verdict dict.
+
+    Protocol: run the uninterrupted baseline through the transport-free
+    reference driver (the threaded fleet is pinned bit-identical to it
+    by test), then a real threaded fleet over localhost sockets with
+    one rank dying mid-run, a respawn after ``rejoin_after_s``, and
+    compare: exactly one eviction + one ``worker_evicted`` alert, the
+    resized step bit-identical to the fresh smaller world, rejoin
+    re-expansion under a bumped generation, loss within tolerance, and
+    exactly one recompile (the shrunken world's apply program)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from theanompi_tpu.observability import live as obs_live
+    from theanompi_tpu.observability.metrics import (
+        counter_deltas,
+        flatten_counters,
+        get_registry,
+    )
+    from theanompi_tpu.parallel import elastic_bsp as eb
+    from theanompi_tpu.runtime.multiprocess import find_free_port
+
+    cfg = dict(program_config or {})
+    verdict: dict = {
+        "rule": "BSP",
+        "n_ranks": n_ranks,
+        "kill_rank": kill_rank,
+        "kill_iter": kill_iter,
+        "n_steps": n_steps,
+        "kills_observed": 0,
+        "violations": [],
+    }
+    v = verdict["violations"]
+
+    if run_baseline:
+        base_prog = eb.BSPTrainProgram(**cfg)
+        base_params, _ = eb.run_reference(base_prog, n_steps, n_ranks)
+        verdict["baseline_loss"] = base_prog.loss(base_params)
+
+    # ---- the chaos fleet: threads over real localhost sockets --------
+    base_counters = flatten_counters(get_registry().snapshot())
+    addresses = [("127.0.0.1", find_free_port()) for _ in range(n_ranks)]
+    events: List[tuple] = []
+    ev_lock = threading.Lock()
+
+    def on_event(rank):
+        def hook(kind, member, generation):
+            with ev_lock:
+                events.append((rank, kind, member, generation))
+        return hook
+
+    workers = {}
+    programs = {}
+    for r in range(n_ranks):
+        programs[r] = eb.BSPTrainProgram(**cfg)
+        workers[r] = eb.ElasticBSPWorker(
+            r, addresses, programs[r], n_steps=n_steps,
+            evict_after_s=evict_after_s,
+            step_delay_s=step_delay_s,
+            die_at_step=kill_iter if r == kill_rank else None,
+            step_timeout_s=timeout / 2,
+            on_event=on_event(r),
+        )
+    threads = {
+        r: threading.Thread(
+            target=workers[r].run, name=f"bsp-rank{r}", daemon=True
+        )
+        for r in workers
+    }
+    rejoiner = None
+    try:
+        for t in threads.values():
+            t.start()
+        # respawn the killed rank after the delay (the supervisor's
+        # restart_delay_s analog) — its fresh program instance keeps
+        # the recompile accounting per incarnation
+        deadline = _time.monotonic() + timeout
+        while not workers[kill_rank]._killed:
+            if _time.monotonic() > deadline:
+                raise RuntimeError("the injected kill never fired")
+            _time.sleep(0.02)
+        verdict["kills_observed"] = 1
+        _time.sleep(rejoin_after_s)
+        rejoin_prog = eb.BSPTrainProgram(**cfg)
+        survivors = [r for r in range(n_ranks) if r != kill_rank]
+        rejoiner = eb.ElasticBSPWorker(
+            kill_rank, addresses, rejoin_prog, n_steps=n_steps,
+            members=survivors,
+            evict_after_s=evict_after_s,
+            step_delay_s=step_delay_s,
+            step_timeout_s=timeout / 2,
+            rejoin=True,
+            on_event=on_event(f"{kill_rank}'"),
+        )
+        threads["rejoin"] = threading.Thread(
+            target=rejoiner.run, name=f"bsp-rank{kill_rank}-rejoin",
+            daemon=True,
+        )
+        threads["rejoin"].start()
+        for key, t in threads.items():
+            t.join(timeout=max(1.0, deadline - _time.monotonic()))
+            if t.is_alive():
+                v.append(f"worker thread {key} never finished")
+    finally:
+        for w in list(workers.values()) + ([rejoiner] if rejoiner else []):
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+    survivors = [workers[r] for r in range(n_ranks) if r != kill_rank]
+    crashed = {
+        r: repr(w.error) for r, w in workers.items()
+        if w.error is not None
+    }
+    if rejoiner is not None and rejoiner.error is not None:
+        crashed[f"{kill_rank}'"] = repr(rejoiner.error)
+    if crashed:
+        v.append(
+            f"surviving ranks raised (an exception propagated into a "
+            f"train loop?): {crashed}"
+        )
+
+    # ---- exactly one eviction, fleet-wide ----------------------------
+    evictions = [e for e in events if e[1] == "evict"]
+    verdict["evictions"] = len(evictions)
+    if len(evictions) != 1:
+        v.append(
+            f"expected exactly one eviction for one kill, saw "
+            f"{len(evictions)}: {evictions}"
+        )
+    # ---- exactly one worker_evicted alert through the live plane -----
+    deltas = counter_deltas(
+        flatten_counters(get_registry().snapshot()), base_counters
+    )
+    bsp_deltas = {
+        k: val for k, val in deltas.items()
+        if k.startswith("membership_evictions_total")
+        and 'plane="bsp"' in k
+    }
+    agg = obs_live.Aggregator(log=lambda line: None)
+    agg.ingest({
+        "kind": obs_live.FRAME_KIND, "v": obs_live.FRAME_VERSION,
+        "rank": "bsp_leader", "seq": 1, "t_wall": 0.0,
+        "sample_rate": 1, "dropped": 0,
+        "spans": {"names": [], "idx": [], "ts": [], "dur": []},
+        "ctrs": {"ts": [], "key": [], "val": []},
+        "flows": {"b_id": [], "b_ts": [], "f_id": [], "f_ts": []},
+        "counters": bsp_deltas, "hist": {},
+    })
+    win = agg.close_window()
+    alerts = [
+        a for a in win["alerts"] if a["rule"] == "worker_evicted"
+    ]
+    verdict["worker_evicted_alerts"] = len(alerts)
+    if len(alerts) != 1:
+        v.append(
+            f"expected exactly one worker_evicted alert, saw "
+            f"{len(alerts)}"
+        )
+
+    # ---- resized step bit-identical to a fresh (n-1)-world step ------
+    cap = next(
+        (w.resize_capture for w in survivors
+         if w.resize_capture is not None), None,
+    )
+    if cap is None or cap.get("params_after") is None:
+        verdict["resized_step_bit_identical"] = False
+        v.append("no survivor captured a post-resize step")
+    else:
+        oracle = eb.BSPTrainProgram(**cfg)
+        ref_params, _ref_opt, ref_sum = eb.reference_step(
+            oracle, cap["params"], cap["opt"], cap["step"],
+            cap["members"],
+        )
+        import jax
+
+        same_sum = all(
+            np.array_equal(a, b) for a, b in zip(
+                jax.tree.leaves(cap["grad_sum"]),
+                jax.tree.leaves(ref_sum),
+            )
+        )
+        same_params = all(
+            np.array_equal(a, b) for a, b in zip(
+                jax.tree.leaves(cap["params_after"]),
+                jax.tree.leaves(ref_params),
+            )
+        )
+        verdict["resized_step_bit_identical"] = bool(
+            same_sum and same_params
+        )
+        if not (same_sum and same_params):
+            v.append(
+                "survivors' post-resize step is NOT bit-identical to a "
+                "fresh smaller-world step (stale EF residual or bucket "
+                "plan not re-derived?)"
+            )
+
+    # ---- rejoin re-expands under a bumped generation -----------------
+    gens = {w.rank: list(w.generations) for w in survivors}
+    verdict["generations"] = gens
+    verdict["generation_monotone"] = all(
+        all(b > a for a, b in zip(g, g[1:])) for g in gens.values()
+    )
+    if not verdict["generation_monotone"]:
+        v.append(f"generation sequence not strictly increasing: {gens}")
+    verdict["world_restored"] = all(
+        w.world == n_ranks for w in survivors
+    ) and (rejoiner is not None and rejoiner.world == n_ranks)
+    verdict["rejoined"] = bool(
+        rejoiner is not None and rejoiner.final_loss is not None
+    )
+    if not verdict["world_restored"] or not verdict["rejoined"]:
+        v.append(
+            "the respawned rank never re-expanded the world (rejoin "
+            f"failed; worlds {[w.world for w in survivors]}, rejoiner "
+            f"{None if rejoiner is None else rejoiner.world})"
+        )
+    verdict["resizes"] = {
+        "shrink": max(w.n_shrinks for w in survivors),
+        "expand": max(w.n_expands for w in survivors),
+    }
+
+    # ---- recompile pin: exactly one resize recompile -----------------
+    # each survivor: ONE grad program ever, apply programs == worlds
+    # seen (n and n-1 — the re-expansion reuses the cached n-world
+    # program); the rejoiner's fresh incarnation compiles its own pair
+    extra = 0
+    for r in range(n_ranks):
+        if r == kill_rank:
+            continue
+        extra += max(0, programs[r].grad_traces - 1)
+        extra += max(0, programs[r].apply_traces - 2)
+    if rejoiner is not None:
+        extra += max(0, rejoin_prog.grad_traces - 1)
+        extra += max(0, rejoin_prog.apply_traces - 1)
+    verdict["apply_traces"] = {
+        r: programs[r].apply_traces for r in range(n_ranks)
+        if r != kill_rank
+    }
+    verdict["extra_recompiles"] = extra
+    if extra != 0:
+        v.append(
+            f"{extra} recompile(s) beyond the single expected resize "
+            "recompile (trace counters)"
+        )
+
+    # ---- loss within tolerance of the uninterrupted baseline ---------
+    losses = [
+        w.final_loss for w in survivors if w.final_loss is not None
+    ]
+    verdict["chaos_loss"] = max(losses) if losses else None
+    if verdict["chaos_loss"] is None:
+        v.append("chaos run produced no final loss")
+    elif run_baseline:
+        base_loss = verdict["baseline_loss"]
+        tol = max(tolerance_abs, tolerance_rel * abs(base_loss))
+        verdict["loss_tolerance"] = round(tol, 6)
+        verdict["loss_delta"] = round(
+            verdict["chaos_loss"] - base_loss, 6
+        )
+        if verdict["loss_delta"] > tol:
+            v.append(
+                f"chaos loss {verdict['chaos_loss']:.4f} exceeds "
+                f"baseline {base_loss:.4f} by "
+                f"{verdict['loss_delta']:.4f} (> tolerance {tol:.4f}) "
+                "— recovery cost convergence"
+            )
+    verdict["ok"] = not v
+    return verdict
+
+
 def main(argv=None) -> int:
     import argparse
     import sys
@@ -491,10 +796,13 @@ def main(argv=None) -> int:
         prog="theanompi_tpu.runtime.chaos", description=__doc__
     )
     p.add_argument("--rule", action="append",
-                   choices=["EASGD", "GOSGD", "SERVE"],
+                   choices=["EASGD", "GOSGD", "SERVE", "BSP"],
                    help="drill this rule (repeatable; default: EASGD). "
                    "SERVE runs the in-process serving-fleet kill drill "
-                   "(evict → re-admit → token-identical, p99 gate)")
+                   "(evict → re-admit → token-identical, p99 gate); "
+                   "BSP runs the elastic-BSP shrink/rejoin drill "
+                   "(evict → resize bit-identical to the fresh smaller "
+                   "world → re-expand, one-recompile gate)")
     p.add_argument("--n-procs", type=int, default=3)
     p.add_argument("--kill-rank", type=int, default=1)
     p.add_argument("--kill-iter", type=int, default=10)
@@ -522,11 +830,31 @@ def main(argv=None) -> int:
                    help="relative p99 TTFT/TPOT tolerance vs the "
                    "uninterrupted fleet run (abs floor 3s covers the "
                    "eviction window at CI scale)")
+    p.add_argument("--bsp-ranks", type=int, default=3)
+    p.add_argument("--bsp-steps", type=int, default=22)
+    p.add_argument("--bsp-kill-iter", type=int, default=6,
+                   help="step the elastic-BSP victim dies at")
+    p.add_argument("--bsp-rejoin-after", type=float, default=2.5,
+                   help="seconds before the killed BSP rank respawns — "
+                   "keep it above --bsp-evict-after so the eviction "
+                   "provably precedes the re-admission")
+    p.add_argument("--bsp-evict-after", type=float, default=1.25)
     args = p.parse_args(argv)
 
     out = {"rules": {}, "ok": True}
     for rule in args.rule or ["EASGD"]:
-        if rule == "SERVE":
+        if rule == "BSP":
+            verdict = run_bsp_drill(
+                n_ranks=args.bsp_ranks,
+                kill_rank=args.kill_rank,
+                kill_iter=args.bsp_kill_iter,
+                n_steps=args.bsp_steps,
+                rejoin_after_s=args.bsp_rejoin_after,
+                evict_after_s=args.bsp_evict_after,
+                timeout=args.timeout,
+                run_baseline=not args.no_baseline,
+            )
+        elif rule == "SERVE":
             verdict = run_serve_drill(
                 n_replicas=args.serve_replicas,
                 n_requests=args.serve_requests,

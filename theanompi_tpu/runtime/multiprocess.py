@@ -153,24 +153,28 @@ def spawn_elastic(
     - a child that DIES (nonzero exit, SIGKILL, chaos ``kill`` fault)
       is RESPAWNED on the same rank after ``restart_delay_s``, up to
       ``restarts_per_rank`` times.  The replacement gets
-      ``THEANOMPI_ELASTIC_REJOIN=1`` (the async entrypoints read it:
-      EASGD re-pulls the center, GOSGD starts at zero weight and pulls
-      a peer snapshot — checkpointless recovery) and the fault-plan env
-      is STRIPPED so an injected kill cannot re-fire in the fresh
+      ``THEANOMPI_ELASTIC_REJOIN=1`` (the membership-aware entrypoints
+      read it: EASGD re-pulls the center, GOSGD starts at zero weight
+      and pulls a peer snapshot, elastic BSP pulls a survivor's state
+      and re-expands the world at the next step boundary —
+      checkpointless recovery, all three) and the fault-plan env is
+      STRIPPED so an injected kill cannot re-fire in the fresh
       incarnation.
     - ``late_join`` maps rank → delay seconds: those ranks start
       mid-run — the join half of elastic membership.
     - the run ends when ``anchor_rank`` (the EASGD server / GOSGD
-      consensus rank) exits: remaining children get a grace period,
-      then are terminated; a dead worker near the finish line is NOT
-      respawned once the anchor is gone.
+      consensus rank / elastic-BSP rank 0) exits: remaining children
+      get a grace period, then are terminated; a dead worker near the
+      finish line is NOT respawned once the anchor is gone.
 
-    Only meaningful for the async rules (``--rule EASGD/GOSGD``): a BSP
-    process group shares one jax.distributed world and cannot lose
-    members.  Returns a report dict: ``{"exit_codes", "restarts":
-    {rank: n}, "kills_observed"}``.  Raises RuntimeError when the
-    anchor fails or a rank exhausts its restart budget with a nonzero
-    exit.
+    Meaningful for every membership-aware rule — ``--rule
+    EASGD/GOSGD`` (PR 10) and ``--rule BSP_ELASTIC`` (ISSUE 13, the
+    shrink-to-survivors sync tier over the TCP transport).  Only the
+    PLAIN ``--rule BSP`` group is excluded: it shares one
+    jax.distributed world and cannot lose members.  Returns a report
+    dict: ``{"exit_codes", "restarts": {rank: n}, "kills_observed"}``.
+    Raises RuntimeError when the anchor fails or a rank exhausts its
+    restart budget with a nonzero exit.
     """
     port = find_free_port()
     env = _spawn_env(local_device_count, env_extra)

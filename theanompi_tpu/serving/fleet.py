@@ -42,11 +42,16 @@ Robustness contract (the chaos drill in ``runtime/chaos.py`` gates it):
 
 Routing is **prefix-affine**: replicas gossip compact radix-tree
 summaries (``radix.RadixPrefixCache.summary`` — content digests, no
-tokens) in their poll replies, and the router scores each incoming
-prompt against every live summary (``radix.score_prompt``), placing
-the request where the longest prefix is already resident.  Ties and
-cold prompts fall back to least-loaded.  ``detail.fleet`` in
-``bench_serve.py --replicas N`` measures the win over round-robin.
+tokens, MRU-first) in their poll replies, and the router scores each
+incoming prompt against every live summary by **match depth ×
+recency** (``radix.score_prompt_weighted`` — a replica whose matching
+chain is warm outranks one holding the same depth in entries about to
+be LRU-evicted), placing the request where the longest live prefix is
+resident.  Poll replies also advertise **pool headroom** (free KV
+blocks), the placement tiebreak: reuse being equal, the request goes
+where capacity is; cold prompts fall back to least-loaded with the
+same tiebreak.  ``detail.fleet`` in ``bench_serve.py --replicas N``
+measures the win over round-robin.
 
 Observability: replica threads are named (per-replica trace tracks);
 evictions raise ONE ``replica_evicted`` alert and re-admissions page
@@ -247,6 +252,7 @@ class ServeReplica:
                 fn = getattr(self.scheduler.prefix, "summary", None)
                 if fn is not None:
                     summary = fn(self.summary_cap)
+            pool = getattr(self.scheduler, "pool", None)
             reply = {
                 "ok": True,
                 "streams": out,
@@ -255,6 +261,12 @@ class ServeReplica:
                 "draining": self.scheduler.draining,
                 "idle": self.scheduler.idle,
                 "summary": summary,
+                # pool headroom rides the poll reply as a placement
+                # tiebreak: equal-affinity candidates go to the replica
+                # with the most free KV blocks, not just fewest streams
+                "headroom": (
+                    int(pool.n_free) if pool is not None else 0
+                ),
             }
         return reply
 
@@ -315,7 +327,7 @@ class _ReplicaState:
     __slots__ = (
         "name", "target", "block_size", "summary", "shed", "draining",
         "left", "dead", "active", "shed_events", "shed_since",
-        "shed_seconds", "tokens_out",
+        "shed_seconds", "tokens_out", "headroom",
     )
 
     def __init__(self, name: str, target):
@@ -323,6 +335,7 @@ class _ReplicaState:
         self.target = target  # ServeReplica-like (has .handle) or (host, port)
         self.block_size = 0
         self.summary: List[str] = []
+        self.headroom = 0  # free pool blocks from the last poll reply
         self.shed = False  # health-red: no new admissions until green
         self.draining = False
         self.left = False  # clean leave — out of the fleet for good
@@ -425,31 +438,48 @@ class FleetRouter:
     def _eligible(self) -> List[_ReplicaState]:
         return [s for s in self._replicas.values() if s.admitting]
 
-    def _score(self, state: _ReplicaState, prompt: Sequence[int]) -> int:
+    def _score(
+        self, state: _ReplicaState, prompt: Sequence[int]
+    ) -> Tuple[float, int]:
+        """(depth × recency weight, match depth in blocks) for one
+        replica's MRU-first summary — radix.score_prompt_weighted."""
         if not self.affinity or not state.summary or not state.block_size:
-            return 0
-        from theanompi_tpu.serving.radix import score_prompt
+            return 0.0, 0
+        from theanompi_tpu.serving.radix import score_prompt_weighted
 
-        return score_prompt(prompt, state.block_size, state.summary)
+        return score_prompt_weighted(
+            prompt, state.block_size, state.summary
+        )
 
     def route(self, prompt: Sequence[int]) -> Tuple[str, int]:
-        """(replica name, affinity score in blocks) for one prompt:
-        highest summary score wins; score 0 falls back to least-loaded
-        with a round-robin tiebreak."""
+        """(replica name, affinity match depth in blocks) for one
+        prompt: highest depth × recency weight wins (a replica whose
+        matching chain is warm outranks one holding the same depth in
+        entries about to be LRU-evicted); weight ties break on
+        advertised pool headroom, then round-robin.  No match falls
+        back to least-loaded, headroom-then-round-robin tiebroken."""
         elig = self._eligible()
         if not elig:
             raise FleetError("no replica is admitting (fleet down, "
                              "draining, or fully shed)")
-        scored = [(self._score(s, prompt), s) for s in elig]
-        best = max(sc for sc, _ in scored)
+        scored = [(*self._score(s, prompt), s) for s in elig]
+        best = max(sc for sc, _d, _s in scored)
         if best > 0:
-            cands = [s for sc, s in scored if sc == best]
+            cands = [(d, s) for sc, d, s in scored if sc == best]
+            depth = max(d for d, _ in cands)
+            cands = [s for d, s in cands if d == depth]
         else:
+            depth = 0
             load = min(s.active for s in elig)
             cands = [s for s in elig if s.active == load]
+        if len(cands) > 1:
+            # placement tiebreak: the most free KV blocks — reuse being
+            # equal, spend the request where capacity is
+            room = max(s.headroom for s in cands)
+            cands = [s for s in cands if s.headroom == room]
         pick = cands[self._rr % len(cands)]
         self._rr += 1
-        return pick.name, best
+        return pick.name, depth
 
     def submit(self, request: Union[Request, Dict[str, Any]]) -> str:
         """Admit one request to the fleet; returns the replica name it
@@ -544,6 +574,7 @@ class FleetRouter:
     def _absorb_poll(self, state: _ReplicaState, reply: Dict) -> None:
         self.roster.beat(state.name, step=reply.get("ticks"))
         state.summary = list(reply.get("summary") or ())
+        state.headroom = int(reply.get("headroom") or 0)
         state.draining = bool(reply.get("draining"))
         now = self.clock()
         healthy = bool(reply.get("healthy", True))

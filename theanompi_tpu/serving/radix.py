@@ -241,3 +241,38 @@ def score_prompt(
             break
         score += 1
     return score
+
+
+def score_prompt_weighted(
+    prompt: Sequence[int], block_size: int, summary: Sequence[str]
+) -> Tuple[float, int]:
+    """Depth × recency affinity: ``(weighted score, match depth)``.
+
+    ``summary`` is MRU-first (``RadixPrefixCache.summary``), so the
+    POSITION of the deepest matched digest is a recency signal: a
+    replica whose matching chain was touched recently outranks one
+    holding the same depth in cold entries about to be evicted under
+    pool pressure.  The weight is ``depth × (1 − pos/(2·len))`` —
+    recency scales within (0.5, 1.0], so depth always dominates (a
+    deeper match beats a fresher shallower one: ``d ≥ d'+1`` implies
+    ``d·0.5 ≥ d'·0.5 + 0.5 > d'·w'·0.5`` never crosses a full block of
+    reusable prefill).  Depth rides along for the router's
+    reuse-token accounting.  ``(0.0, 0)`` when nothing matches."""
+    entries = list(summary)
+    held = {h: i for i, h in enumerate(reversed(entries))}
+    # reversed: later duplicates must not shadow a fresher position
+    held = {h: len(entries) - 1 - i for h, i in held.items()}
+    if not held:
+        return 0.0, 0
+    depth = 0
+    deepest_pos = 0
+    for d in chain_digests(prompt, block_size):
+        pos = held.get(d.hex())
+        if pos is None:
+            break
+        depth += 1
+        deepest_pos = pos
+    if depth == 0:
+        return 0.0, 0
+    recency = 1.0 - deepest_pos / (2.0 * len(entries))
+    return depth * recency, depth
