@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""graftlint_diff — gate the tree against the committed lint artifact.
+
+The ``--step-trace``-as-reviewable-CI-artifact carryover, closed: the
+repo commits ``.graftlint_artifact.json`` (findings + per-strategy
+whole-step collective traces, stable and sorted), and this script
+compares the CURRENT tree's artifact against it:
+
+- a finding present now but not in the baseline artifact is a **new
+  finding** → exit 1;
+- any change to a step trace — an entrypoint's collective sequence
+  differing, an entrypoint appearing or disappearing — is **step-trace
+  drift** → exit 1.  Drift is not necessarily a bug (adding a jitted
+  function adds a root), but it IS a reviewable change to the
+  sequence every worker must agree on, so it fails until the artifact
+  is regenerated and the diff reviewed/committed alongside the code:
+
+      python -m theanompi_tpu.analysis --artifact .graftlint_artifact.json
+
+- findings recorded in the baseline that no longer occur are printed
+  as notes (regenerate at your leisure) — never a failure;
+- a missing or unparseable artifact on either side → exit 2.
+
+Exit codes (pinned by tests/test_analysis.py): 0 clean / 1 new finding
+or step-trace drift / 2 parse or usage error.
+
+The current tree's artifact is produced in-process through the
+analyzer's mtime+hash incremental cache, so the warm gate costs a stat
+sweep; ``--current PATH`` substitutes a pre-produced artifact (the
+perf_gate smoke fixtures use this).  Pure stdlib, no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from theanompi_tpu.analysis import engine  # noqa: E402
+
+
+def _load(path: str, side: str):
+    try:
+        return engine.load_artifact(path)
+    except (OSError, ValueError) as e:
+        print(
+            f"graftlint_diff: cannot read {side} artifact {path}: {e}\n"
+            "graftlint_diff: regenerate with: python -m "
+            f"theanompi_tpu.analysis --artifact {engine.ARTIFACT_NAME}",
+            file=sys.stderr,
+        )
+        return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="scripts/graftlint_diff.py",
+        description="diff the current graftlint artifact against the "
+        "committed baseline artifact (exit 0 clean / 1 new finding or "
+        "step-trace drift / 2 parse)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline artifact (default: <repo>/{engine.ARTIFACT_NAME})",
+    )
+    p.add_argument(
+        "--current",
+        default=None,
+        help="pre-produced current artifact (default: analyze the tree "
+        "through the incremental cache)",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the incremental cache for the current-tree run",
+    )
+    args = p.parse_args(argv)
+
+    base_path = args.baseline or engine.artifact_path()
+    base = _load(base_path, "baseline")
+    if base is None:
+        return 2
+
+    if args.current:
+        cur = _load(args.current, "current")
+        if cur is None:
+            return 2
+    else:
+        try:
+            cur = engine.current_artifact(use_cache=not args.no_cache)
+        except OSError as e:
+            print(f"graftlint_diff: analyze failed: {e}", file=sys.stderr)
+            return 2
+
+    rc = 0
+    base_fps = {
+        f.get("fingerprint"): f for f in base.get("findings", [])
+    }
+    cur_findings = cur.get("findings", [])
+    new = [f for f in cur_findings if f.get("fingerprint") not in base_fps]
+    for f in new:
+        print(
+            f"graftlint_diff: NEW FINDING {f.get('file')}:{f.get('line')}: "
+            f"[{f.get('rule')}] {f.get('message')}  (in {f.get('symbol')})"
+        )
+    if new:
+        rc = 1
+    cur_fps = {f.get("fingerprint") for f in cur_findings}
+    for fp, f in sorted(base_fps.items()):
+        if fp not in cur_fps:
+            print(
+                f"graftlint_diff: note: baselined finding gone "
+                f"[{f.get('rule')}] {f.get('file')} ({fp}) — regenerate "
+                "the artifact to retire it"
+            )
+
+    base_tr = base.get("step_traces", {})
+    cur_tr = cur.get("step_traces", {})
+    drift = 0
+    for ep in sorted(set(base_tr) | set(cur_tr)):
+        a, b = base_tr.get(ep), cur_tr.get(ep)
+        if a == b:
+            continue
+        drift += 1
+        if a is None:
+            print(
+                f"graftlint_diff: STEP-TRACE DRIFT {ep}: new entrypoint "
+                f"[{', '.join(b)}]"
+            )
+        elif b is None:
+            print(
+                f"graftlint_diff: STEP-TRACE DRIFT {ep}: entrypoint "
+                f"removed (was [{', '.join(a)}])"
+            )
+        else:
+            print(
+                f"graftlint_diff: STEP-TRACE DRIFT {ep}: "
+                f"[{', '.join(a)}] -> [{', '.join(b)}]"
+            )
+    if drift:
+        rc = 1
+        print(
+            "graftlint_diff: the whole-step collective sequence changed — "
+            "review the diff above, then regenerate the artifact "
+            "(python -m theanompi_tpu.analysis --artifact "
+            f"{engine.ARTIFACT_NAME}) and commit it with the change"
+        )
+    if rc == 0:
+        print(
+            f"graftlint_diff: clean ({len(cur_findings)} finding(s), "
+            f"{len(cur_tr)} step trace(s) match {base_path})"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

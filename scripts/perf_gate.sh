@@ -150,6 +150,23 @@
 #                           keeps a 3s absolute floor for the CI-sized
 #                           eviction window)
 #
+# Lint leg (the graftlint CI artifact diff; docs/static_analysis.md):
+#   PERF_GATE_LINT          1 (default) = diff the current tree's lint
+#                           artifact (findings + per-strategy step
+#                           traces) against the committed
+#                           .graftlint_artifact.json via
+#                           scripts/graftlint_diff.py.  A new finding
+#                           OR any step-trace drift fails the gate; a
+#                           missing/unparseable baseline artifact is a
+#                           loud failure, not a skip.  The analyzer's
+#                           mtime+hash incremental cache makes the
+#                           warm run a stat sweep.  0 = skip (escape
+#                           hatch).
+#   PERF_GATE_LINT_BASELINE baseline artifact (default:
+#                           .graftlint_artifact.json)
+#   PERF_GATE_LINT_CURRENT  pre-produced current artifact (skips the
+#                           analyzer run — the smoke-test path)
+#
 # Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
 set -euo pipefail
 
@@ -160,6 +177,26 @@ TOLERANCE="${PERF_GATE_TOLERANCE:-0.10}"
 MIN_OVERLAP="${PERF_GATE_MIN_OVERLAP:-0.0}"
 WORKDIR="$(mktemp -d "${TMPDIR:-/tmp}/perf_gate.XXXXXX")"
 trap 'rm -rf "$WORKDIR"' EXIT
+
+# ---- 0. lint leg: the graftlint artifact diff -------------------------------
+if [ "${PERF_GATE_LINT:-1}" = "1" ]; then
+    LINT_BASELINE="${PERF_GATE_LINT_BASELINE:-.graftlint_artifact.json}"
+    LINT_CURRENT="${PERF_GATE_LINT_CURRENT:-}"
+    echo "[perf_gate] lint artifact diff vs $LINT_BASELINE" >&2
+    set +e
+    if [ -n "$LINT_CURRENT" ]; then
+        python scripts/graftlint_diff.py --baseline "$LINT_BASELINE" \
+            --current "$LINT_CURRENT"
+    else
+        python scripts/graftlint_diff.py --baseline "$LINT_BASELINE"
+    fi
+    LINT_RC=$?
+    set -e
+    if [ "$LINT_RC" != "0" ]; then
+        echo "[perf_gate] LINT VIOLATION: graftlint artifact diff exited $LINT_RC (new finding, step-trace drift, or missing baseline artifact)" >&2
+        exit 1
+    fi
+fi
 
 # ---- 1. the bench -----------------------------------------------------------
 NEW_JSON="${PERF_GATE_BENCH_JSON:-}"

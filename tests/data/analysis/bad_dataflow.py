@@ -1,0 +1,114 @@
+# graftlint fixture: seeded FLOW-SENSITIVE donation hazards — the
+# expression-propagation cases the bare-names line-ordered pass
+# provably missed (ISSUE 14 tentpole).  Parsed only, never executed.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _step(params, batch):
+    return jax.tree.map(lambda p: p - 0.1, params)
+
+
+_train = jax.jit(_step, donate_argnums=(0,))
+
+
+def tuple_pack_read(params, batch):
+    pair = (params, batch)
+    new = _train(params, batch)
+    # GL-D001: `pair` still points at the donated buffer — tuple
+    # packing is invisible to a bare-name rebind scan
+    return new, pair[0]["w"]
+
+
+def tuple_unpack_read(params, batch):
+    alias, extra = params, batch
+    new = _train(params, batch)
+    # GL-D001: `alias` was unpacked from the same buffer before the
+    # donating call
+    return new, alias["w"]
+
+
+class _Stash:
+    def stash_then_read(self, params, batch):
+        self.kept = params
+        new = _train(params, batch)
+        # GL-D001: the attribute store aliased the donated buffer
+        return new, self.kept["w"]
+
+
+def subscript_store_read(params, batch, cache):
+    cache["p"] = params
+    new = _train(params, batch)
+    # GL-D001: the container holds the donated buffer
+    return new, cache["p"]
+
+
+def conditional_rebind_read(params, batch, flag):
+    new = _train(params, batch)
+    if flag:
+        params = new
+    # GL-D001: the donation is unconditional but the rebind happens on
+    # ONE arm only — on the flag=False path `params` still names the
+    # donated buffer.  The line-ordered pass saw "a rebind between
+    # donation and read" and stayed silent; the CFG join keeps the
+    # fall-through path's taint alive
+    return jnp.sum(params["w"])
+
+
+def loop_read_after_donate(params, batches):
+    norm = 0.0
+    for b in batches:
+        # GL-D001: iteration 2 reads the buffer iteration 1 donated —
+        # the back edge carries the taint; nothing rebinds `params`
+        norm = norm + jnp.sum(params["w"])
+        _train(params, b)
+    return norm
+
+
+def _sink(p):
+    # forwards into the donating jit and hands the DONATED buffer back
+    _train(p, None)
+    # GL-D001: the helper's own read — returning a donated parameter
+    # is exactly as stale as any other read of it
+    return p
+
+
+def result_alias_read(params):
+    out = _sink(params)
+    # GL-D005: `out` aliases the buffer _sink donated (the call-graph
+    # returns_donated summary); reading it is reading reused memory
+    return out["w"]
+
+
+# ---- sanctioned shapes: all silent -----------------------------------------
+
+def all_paths_rebound_ok(params, batch, flag):
+    if flag:
+        params = _train(params, batch)
+    else:
+        params = _train(params, batch)
+    # NOT a finding: every path to this read rebound the binding
+    return jnp.sum(params["w"])
+
+
+def pack_after_donate_ok(params, batch):
+    new = _train(params, batch)
+    pair = (new, batch)
+    # NOT a finding: the tuple holds the RESULT, not the donated input
+    return pair
+
+
+def copy_before_donate_ok(params, batch):
+    snap = jax.tree.map(np.array, params)
+    new = _train(params, batch)
+    # NOT a finding: the snapshot owns host memory
+    return new, snap
+
+
+def loop_rebind_ok(params, batches):
+    for b in batches:
+        # NOT a finding: the loop-carried binding is rebound from the
+        # call's own result every iteration
+        params = _train(params, b)
+    return params

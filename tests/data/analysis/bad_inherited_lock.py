@@ -1,0 +1,22 @@
+# graftlint fixture: the SUBCLASS half of the cross-module
+# inherited-lock pair.  The lock and the guarded-dict discipline live
+# in inherited_lock_base.LockedBase — ANOTHER module — which was the
+# GL-T pass's documented narrow spot until the class-hierarchy layer
+# (ISSUE 14): analyzed alone this file has no lock and stays silent;
+# analyzed as a package the subclass's bare mutation fires.  Parsed
+# only, never executed.
+from tests.data.analysis.inherited_lock_base import LockedBase
+
+
+class RacySub(LockedBase):
+    """Mutates the inherited guarded dict without the inherited lock."""
+
+    def evict_bare_inherited(self, member):
+        # GL-T001 (corpus run only): self._members is guarded by the
+        # base's self._lock; this bare mutation races base.beat()
+        self._members.pop(member, None)
+
+    def beat_locked_ok(self, member):
+        with self._lock:
+            # NOT a finding: the inherited lock is held
+            self._members[member] = 2

@@ -1,0 +1,145 @@
+# graftlint fixture: seeded distributed-protocol hazards (GL-P*) over
+# the transport/membership surface (ISSUE 14).  Parsed only, never
+# executed.
+import threading
+
+from theanompi_tpu.parallel import transport
+from theanompi_tpu.parallel.transport import request
+
+
+def poll_loop_unbounded(addrs):
+    out = []
+    for a in addrs:
+        # GL-P001: request() in a pump loop with no deadline_s, no
+        # timeout, no retry wrapper — the 600s default wedges the loop
+        out.append(transport.request(a, {"kind": "poll"}))
+    return out
+
+
+def poll_loop_deadline_ok(addrs):
+    out = []
+    for a in addrs:
+        # NOT a finding: per-call deadline budget
+        out.append(transport.request(a, {"kind": "poll"}, deadline_s=2.0))
+    return out
+
+
+def poll_loop_timeout_ok(addrs):
+    out = []
+    for a in addrs:
+        # NOT a finding: per-op timeout is a (weaker) budget
+        out.append(transport.request(a, {"kind": "poll"}, timeout=5.0))
+    return out
+
+
+def one_shot_farewell_ok(addr):
+    # NOT a finding: a single bounded-by-default call on a shutdown
+    # path cannot wedge a loop
+    return request(addr, {"kind": "done"})
+
+
+class HeartbeatShipper:
+    """Thread-target functions get the same scrutiny as loops."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=self._beat)
+
+    def _beat(self):
+        # GL-P001: runs on its own schedule, nobody bounds the block
+        request(("agg", 9100), {"kind": "beat"})
+
+
+class RouterTable:
+    """GL-P002: blocking rpc while holding a lock other threads need."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams = {}
+
+    def journal(self, rid, toks):
+        with self._lock:
+            self._streams[rid] = toks
+
+    def poll_under_lock(self, addr, mailbox):
+        with self._lock:
+            # GL-P002: the reply needs the replica's pump thread, which
+            # may be queued on self._lock right now
+            reply = request(addr, {"kind": "poll"}, timeout=5.0)
+            # GL-P002: same shape for a blocking mailbox recv
+            extra = mailbox.recv(0)
+        return reply, extra
+
+    def poll_outside_lock_ok(self, addr):
+        with self._lock:
+            cursors = dict(self._streams)
+        # NOT a finding: the lock is released before blocking
+        return request(addr, {"kind": "poll", "c": cursors}, timeout=5.0)
+
+
+class GenerationalRoster:
+    """GL-P003: a class whose own discipline is generation-checked
+    mutation must apply it on every mutating path."""
+
+    def __init__(self):
+        self._members = {}
+        self.generation = 0
+
+    def apply_update(self, member, msg):
+        if msg["gen"] == self.generation:
+            # sanctioned: gated on the generation comparison
+            self._members[member] = msg["state"]
+
+    def readmit(self, member, msg):
+        if msg["gen"] != self.generation:
+            return  # guard-clause form is also sanctioned
+        self._members[member] = msg["state"]
+
+    def stale_apply(self, member, msg):
+        # GL-P003: no generation comparison anywhere on this path — a
+        # stale incarnation's update lands after an evict/rejoin
+        self._members[member] = msg["state"]
+
+
+class UndisciplinedTable:
+    """NOT analyzed: no mutation here is generation-gated, so the
+    class never declared the discipline (a plain cache)."""
+
+    def __init__(self):
+        self._entries = {}
+        self.gen = 0
+
+    def put(self, k, v):
+        self._entries[k] = v
+
+
+class Journal:
+    """GL-P004: the re-admission spec must re-key token_index0."""
+
+    def resubmit_spec_bad(self):
+        return {
+            "id": self.id,
+            # GL-P004: prompt replays the journal, budget is the
+            # remainder, but token_index0 is dropped — sampled streams
+            # re-roll their per-index keys on failover
+            "prompt": self.prompt + self.tokens,
+            "max_new_tokens": self.max_new_tokens - len(self.tokens),
+        }
+
+    def resubmit_spec_ok(self):
+        return {
+            "id": self.id,
+            "prompt": self.prompt + self.tokens,
+            "max_new_tokens": self.max_new_tokens - len(self.tokens),
+            # NOT a finding: the accepted-journal length re-keys the
+            # sampled stream onto its original per-index keys
+            "token_index0": len(self.tokens),
+        }
+
+    def fresh_submission_ok(self, prefix, tail, budget):
+        # NOT a finding: a fresh request may concatenate prompt pieces;
+        # its budget is not a remainder
+        return {
+            "id": "new",
+            "prompt": list(prefix) + tail,
+            "max_new_tokens": budget,
+        }

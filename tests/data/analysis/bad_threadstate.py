@@ -133,6 +133,40 @@ class HelperUnderCallersLock:
         self._members.pop(member, None)
 
 
+class LeakyLockedSuffix:
+    """ISSUE 14: the *_locked suffix is a HINT, not a free pass — a
+    suffixed helper that the call graph catches being called from an
+    unlocked site is demoted and its mutation fires.  A suffixed
+    helper whose every same-class call site holds the lock (or that
+    has no same-class call sites at all) keeps the exemption."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def join(self, member):
+        with self._lock:
+            self._members[member] = 0
+
+    def sanctioned_call(self):
+        with self._lock:
+            self._evict_locked("a")
+
+    def lying_call(self):
+        # the suffix promised "caller holds the lock" — this call site
+        # disproves it
+        self._evict_locked("b")
+
+    def _evict_locked(self, member):
+        # BAD: reachable with no lock held via lying_call()
+        self._members.pop(member, None)
+
+    def _trusted_locked(self, member):
+        # sanctioned: no same-class call site contradicts the suffix
+        # (public locked-API surface — callers outside the class)
+        self._members.pop(member, None)
+
+
 class LeakyHelper:
     """One unlocked call site breaks the lock inheritance: the AST
     cannot prove the caller holds it, so the helper's mutation keeps

@@ -1,0 +1,15 @@
+# graftlint fixture: the CLEAN cross-module base-class pair.  The
+# subclass always takes the inherited lock before touching the
+# inherited dict — zero findings in this file, corpus run or not.
+# Parsed only, never executed.
+from tests.data.analysis.inherited_lock_base import CleanBase
+
+
+class CleanSub(CleanBase):
+    def leave(self, member):
+        with self._lock:
+            self._members.pop(member, None)
+
+    def snapshot(self):
+        # reads are out of scope
+        return dict(self._members)

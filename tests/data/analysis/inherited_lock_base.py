@@ -1,0 +1,32 @@
+# graftlint fixture: the BASE half of the cross-module inherited-lock
+# pair (GL-T via the class hierarchy).  Both bases are clean on their
+# own: the lock is constructed here and every mutation in this module
+# is under it — what matters is what SUBCLASSES in other modules do
+# with the inherited lock and the inherited guarded-dict discipline.
+# Parsed only, never executed.
+import threading
+
+
+class LockedBase:
+    """Owns the lock and declares self._members shared by mutating it
+    under the lock.  Subclasses inherit both facts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def beat(self, member):
+        with self._lock:
+            self._members[member] = 1
+
+
+class CleanBase:
+    """The clean pair's base — identical shape, different subclass."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = {}
+
+    def join(self, member):
+        with self._lock:
+            self._members[member] = 0

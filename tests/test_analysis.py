@@ -136,6 +136,10 @@ def test_threadstate_pass_golden():
             ("GL-T001", "evict_bare_pop"),
             ("GL-T001", "evict_bare_after_span"),
             ("GL-T001", "_drop_leaky"),
+            # ISSUE 14: a *_locked helper with an unlocked same-class
+            # call site is demoted — the suffix is a hint the call
+            # graph must confirm
+            ("GL-T001", "_evict_locked"),
         ]
     )
     for f in findings:
@@ -143,7 +147,8 @@ def test_threadstate_pass_golden():
         assert "_members" in f.message and "_lock" in f.message
     clean = {"beat", "never_locked_dict_is_fine", "_drop_locked",
              "join", "leave", "snapshot", "put", "__init__",
-             "beat_acquire_release", "sweep", "reap", "_drop"}
+             "beat_acquire_release", "sweep", "reap", "_drop",
+             "_trusted_locked", "sanctioned_call", "lying_call"}
     assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
 
 
@@ -169,6 +174,7 @@ def test_every_pass_fires_on_corpus():
         "lockorder",
         "steptrace",
         "threadstate",
+        "protocol",
     }
 
 
@@ -336,7 +342,10 @@ def test_fixable_flag_in_expositions():
     findings = _findings("bad_donation.py")
     by_rule = {f.rule: f for f in findings}
     assert by_rule["GL-D004"].fixable
-    assert not by_rule["GL-D001"].fixable
+    # GL-D001 joined the fixable set in ISSUE 14 (rebind-from-result
+    # rewrite); GL-D003 has no mechanical repair
+    assert by_rule["GL-D001"].fixable
+    assert not by_rule["GL-D003"].fixable
     assert by_rule["GL-D004"].to_json()["fixable"] is True
     assert "[--fix]" in by_rule["GL-D004"].format_human()
 
@@ -499,3 +508,390 @@ def test_comm_probe_snapshot_copies(monkeypatch):
 
 class _StopProbe(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# flow-sensitive donation (ISSUE 14 tentpole): the expression-
+# propagation corpus the line-ordered bare-name pass provably missed
+# ---------------------------------------------------------------------------
+
+def test_dataflow_golden():
+    findings = _findings("bad_dataflow.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-D001", "tuple_pack_read"),
+            ("GL-D001", "tuple_unpack_read"),
+            ("GL-D001", "stash_then_read"),
+            ("GL-D001", "subscript_store_read"),
+            ("GL-D001", "conditional_rebind_read"),
+            ("GL-D001", "loop_read_after_donate"),
+            ("GL-D001", "_sink"),
+            ("GL-D005", "result_alias_read"),
+        ]
+    )
+    assert all(f.severity == "error" for f in findings)
+    clean = {"all_paths_rebound_ok", "pack_after_donate_ok",
+             "copy_before_donate_ok", "loop_rebind_ok"}
+    assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+    # the alias-read reports name BOTH ends of the alias
+    by_symbol = {f.symbol.rsplit(".", 1)[-1]: f for f in findings}
+    assert "aliasing 'params'" in by_symbol["tuple_pack_read"].message
+    assert "returns" not in by_symbol["result_alias_read"].rule
+
+
+def test_dataflow_one_arm_rebind_is_flow_sensitive(tmp_path):
+    """The exact case the line-ordered pass got WRONG in both
+    directions: a one-arm rebind after an unconditional donation used
+    to read as 'a rebind between donation and read' (silent); a
+    donate+rebind on one arm used to be invisible too.  The CFG join
+    keeps the first hazardous and the second clean."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def _step(p, b):\n"
+        "    return p\n"
+        "\n"
+        "\n"
+        "_train = jax.jit(_step, donate_argnums=(0,))\n"
+        "\n"
+        "\n"
+        "def one_arm_rebind(params, batch, flag):\n"
+        "    new = _train(params, batch)\n"
+        "    if flag:\n"
+        "        params = new\n"
+        "    return jnp.sum(params[\"w\"])\n"
+        "\n"
+        "\n"
+        "def per_path_consistent(params, batch, flag):\n"
+        "    if flag:\n"
+        "        params = _train(params, batch)\n"
+        "    return jnp.sum(params[\"w\"])\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze(paths=[str(p)], root=str(tmp_path))
+    assert [f.symbol for f in findings] == ["one_arm_rebind"]
+
+
+def test_dataflow_cfg_shapes():
+    """build_cfg sanity: branches join, loops carry a back edge,
+    returns leave through the exit block."""
+    import ast
+
+    from theanompi_tpu.analysis import dataflow
+
+    fn = ast.parse(
+        "def f(x):\n"
+        "    if x:\n"
+        "        a = 1\n"
+        "    else:\n"
+        "        a = 2\n"
+        "    for i in range(3):\n"
+        "        a += i\n"
+        "        if a > 10:\n"
+        "            break\n"
+        "    return a\n"
+    ).body[0]
+    cfg = dataflow.build_cfg(fn.body)
+    preds = cfg.preds()
+    # some block has two predecessors (the if/else join)
+    assert any(len(v) >= 2 for v in preds.values())
+    # a back edge exists: some successor id is <= its predecessor's id
+    back = [
+        (b.id, s) for b in cfg.blocks for s in b.succs if s < b.id
+    ]
+    assert back, "loop produced no back edge"
+    # the exit block is reachable (the return)
+    assert preds[cfg.exit]
+
+
+# ---------------------------------------------------------------------------
+# GL-P protocol pass (ISSUE 14 tentpole)
+# ---------------------------------------------------------------------------
+
+def test_protocol_golden():
+    findings = _findings("bad_protocol.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-P001", "poll_loop_unbounded"),
+            ("GL-P001", "_beat"),
+            ("GL-P002", "poll_under_lock"),
+            ("GL-P002", "poll_under_lock"),
+            ("GL-P003", "stale_apply"),
+            ("GL-P004", "resubmit_spec_bad"),
+        ]
+    )
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, f)
+    assert by_rule["GL-P001"].severity == "warning"
+    for rule in ("GL-P002", "GL-P003", "GL-P004"):
+        assert by_rule[rule].severity == "error"
+    assert "deadline_s" in by_rule["GL-P001"].message
+    assert "deadlock" in by_rule["GL-P002"].message
+    assert "generation" in by_rule["GL-P003"].message
+    assert "token_index0" in by_rule["GL-P004"].message
+    clean = {"poll_loop_deadline_ok", "poll_loop_timeout_ok",
+             "one_shot_farewell_ok", "poll_outside_lock_ok", "journal",
+             "apply_update", "readmit", "put", "resubmit_spec_ok",
+             "fresh_submission_ok"}
+    assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+
+
+def test_protocol_rules_are_suppressible(tmp_path):
+    """Acceptance: GL-P obeys the existing inline-disable mechanism."""
+    src = (
+        "from theanompi_tpu.parallel import transport\n"
+        "\n"
+        "\n"
+        "def pump(addrs):\n"
+        "    for a in addrs:\n"
+        "        transport.request(a, {})  # graftlint: disable=GL-P001\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze(paths=[str(p)], root=str(tmp_path))
+    assert findings == []
+    p.write_text(src.replace("  # graftlint: disable=GL-P001", ""))
+    findings, _ = analyze(paths=[str(p)], root=str(tmp_path))
+    assert [f.rule for f in findings] == ["GL-P001"]
+
+
+def test_protocol_retry_wrapper_counts_as_budget(tmp_path):
+    src = (
+        "from theanompi_tpu.parallel import membership as ms\n"
+        "from theanompi_tpu.parallel import transport\n"
+        "\n"
+        "\n"
+        "def exchange_loop(addr, msgs):\n"
+        "    for m in msgs:\n"
+        "        ms.retry_with_backoff(\n"
+        "            lambda: transport.request(addr, m), attempts=3\n"
+        "        )\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze(paths=[str(p)], root=str(tmp_path))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# inherited locks across modules (ISSUE 14 tentpole: GL-T + ClassTable)
+# ---------------------------------------------------------------------------
+
+def test_inherited_lock_cross_module():
+    """The stated narrow spot, closed: the lock and the guarded-dict
+    discipline live in a base class in ANOTHER module; the subclass's
+    bare mutation fires only when the corpus is analyzed together."""
+    findings, _ = analyze(paths=[CORPUS])
+    hits = [
+        f for f in findings
+        if f.file.endswith("bad_inherited_lock.py")
+    ]
+    assert [(f.rule, f.symbol) for f in hits] == [
+        ("GL-T001", "RacySub.evict_bare_inherited")
+    ]
+    assert "inherited from" in hits[0].message
+    # the clean cross-module pair stays silent, as does the base
+    assert not any(
+        f.file.endswith("clean_inherited_sub.py")
+        or f.file.endswith("inherited_lock_base.py")
+        for f in findings
+    )
+
+
+def test_inherited_lock_single_file_is_silent():
+    """Analyzed alone the subclass has no lock in scope — the pass
+    prefers missing the hazard over guessing at an unresolved base."""
+    findings = _findings("bad_inherited_lock.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the CI lint artifact: --artifact JSON, SARIF, graftlint_diff
+# ---------------------------------------------------------------------------
+
+def test_artifact_is_stable_and_sorted(tmp_path):
+    from theanompi_tpu.analysis import engine
+
+    findings = _findings("bad_locks.py")
+    doc1 = engine.build_artifact(findings, {"b.ep": ("psum",), "a.ep": ()}, [])
+    doc2 = engine.build_artifact(
+        list(reversed(findings)), {"a.ep": (), "b.ep": ("psum",)}, []
+    )
+    assert doc1 == doc2
+    assert list(doc1["step_traces"]) == ["a.ep", "b.ep"]
+    path = engine.write_artifact(doc1, str(tmp_path / "a.json"))
+    assert engine.load_artifact(path) == doc1
+    # byte-stable: a second write is identical
+    first = open(path).read()
+    engine.write_artifact(doc2, path)
+    assert open(path).read() == first
+
+
+def test_cli_artifact_flag_writes_document(tmp_path, capsys):
+    rc = cli_main(
+        [os.path.join(CORPUS, "bad_donation.py"), "--no-baseline",
+         "--artifact", str(tmp_path / "art.json")]
+    )
+    assert rc == 1  # findings still drive the exit code
+    from theanompi_tpu.analysis import engine
+
+    doc = engine.load_artifact(str(tmp_path / "art.json"))
+    assert doc["artifact_version"] == 1
+    assert {f["rule"] for f in doc["findings"]} >= {"GL-D001", "GL-D004"}
+    # step traces ride along (the jitted root in the fixture)
+    assert isinstance(doc["step_traces"], dict)
+
+
+def test_cli_sarif_output(capsys):
+    rc = cli_main(
+        [os.path.join(CORPUS, "bad_locks.py"), "--no-baseline",
+         "--format", "sarif"]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    assert len(run["results"]) == 3
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rules == {"GL-L001", "GL-L002"}
+    res = run["results"][0]
+    assert res["partialFingerprints"]["graftlint/v1"]
+    assert res["locations"][0]["physicalLocation"]["region"]["startLine"] > 0
+
+
+def _run_diff(args):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "graftlint_diff.py")]
+        + args,
+        capture_output=True,
+        text=True,
+        cwd=repo,
+        timeout=300,
+    )
+
+
+def test_graftlint_diff_exit_codes(tmp_path):
+    """Acceptance: 0 clean / 1 new finding / 1 step-trace drift /
+    2 parse — pinned."""
+    from theanompi_tpu.analysis import engine
+
+    base = engine.load_artifact(engine.artifact_path())
+    # identical current artifact -> clean
+    cur = str(tmp_path / "cur.json")
+    engine.write_artifact(base, cur)
+    r = _run_diff(["--current", cur])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+    # a new finding -> 1
+    doc = json.loads(json.dumps(base))
+    doc["findings"].append({
+        "fingerprint": "feedfacefeedface", "rule": "GL-P001",
+        "pass": "protocol", "severity": "warning", "file": "x.py",
+        "line": 1, "symbol": "f", "message": "m", "snippet": "s",
+        "fixable": False,
+    })
+    engine.write_artifact(doc, cur)
+    r = _run_diff(["--current", cur])
+    assert r.returncode == 1 and "NEW FINDING" in r.stdout
+    # step-trace drift -> 1
+    doc = json.loads(json.dumps(base))
+    key = sorted(doc["step_traces"])[0]
+    doc["step_traces"][key] = list(doc["step_traces"][key]) + ["psum"]
+    engine.write_artifact(doc, cur)
+    r = _run_diff(["--current", cur])
+    assert r.returncode == 1 and "STEP-TRACE DRIFT" in r.stdout
+    # unparseable baseline -> 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    r = _run_diff(["--baseline", str(bad), "--current", cur])
+    assert r.returncode == 2
+
+
+def test_full_run_cache_roundtrip(tmp_path):
+    """The mtime+hash incremental cache: a warm run is a hit with
+    identical findings/traces; touching any analyzed file's CONTENT
+    invalidates it (an mtime-only touch re-hashes and still hits)."""
+    import shutil
+
+    from theanompi_tpu.analysis import engine
+
+    root = tmp_path / "repo"
+    (root / "theanompi_tpu").mkdir(parents=True)
+    pkg = root / "theanompi_tpu"
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        "import jax\n\n\n"
+        "def f(p, b):\n    return p\n\n\n"
+        "g = jax.jit(f, donate_argnums=(0,))\n\n\n"
+        "def bad(p, b):\n"
+        "    out = g(p, b)\n"
+        "    return out, p\n"
+    )
+    f1, s1, t1, hit1 = engine.full_run(str(root))
+    assert not hit1 and [x.rule for x in f1] == ["GL-D001"]
+    f2, s2, t2, hit2 = engine.full_run(str(root))
+    assert hit2
+    assert [x.fingerprint for x in f2] == [x.fingerprint for x in f1]
+    assert t2 == t1
+    # mtime churn without a content change still hits (hash check)
+    os.utime(str(pkg / "mod.py"))
+    _f3, _s3, _t3, hit3 = engine.full_run(str(root))
+    assert hit3
+    # a content change misses and re-analyzes
+    (pkg / "mod.py").write_text(
+        (pkg / "mod.py").read_text().replace("return out, p", "return out")
+    )
+    f4, _s4, _t4, hit4 = engine.full_run(str(root))
+    assert not hit4 and f4 == []
+    shutil.rmtree(str(root))
+
+
+def test_warm_cached_full_repo_run_is_fast():
+    """Tier-1 guard (ISSUE 14): the LINT gate rides the warm cache —
+    a warm full-repo run must stay a stat sweep, not an analyzer run,
+    so the lint leg cannot quietly eat the suite budget."""
+    import time
+
+    from theanompi_tpu.analysis import engine
+
+    engine.full_run()  # ensure the cache is populated
+    t0 = time.perf_counter()
+    _f, _s, _t, hit = engine.full_run()
+    dt = time.perf_counter() - t0
+    assert hit, "warm run missed the cache"
+    assert dt < 2.5, f"warm cached run took {dt:.2f}s (budget 2.5s)"
+
+
+def test_cli_importable_without_jax():
+    """Acceptance: python -m theanompi_tpu.analysis still imports (and
+    lints) in an interpreter with no jax — subprocess-pinned."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys; sys.modules['jax'] = None\n"
+        "from theanompi_tpu.analysis.__main__ import main\n"
+        "assert sys.modules.get('jax') is None\n"
+        "rc = main(['tests/data/analysis/bad_locks.py', '--no-baseline',\n"
+        "           '--format', 'json'])\n"
+        "assert sys.modules.get('jax') is None\n"
+        "print('RC', rc)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "RC 1" in out.stdout

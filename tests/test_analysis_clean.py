@@ -133,6 +133,35 @@ def test_tests_baseline_is_empty_forever():
     )
 
 
+def test_committed_lint_artifact_is_fresh():
+    """ISSUE 14: the committed CI lint artifact
+    (``.graftlint_artifact.json`` — findings + per-strategy step
+    traces) must match the current tree exactly.  A mismatch is the
+    same failure scripts/graftlint_diff.py (the perf_gate LINT leg)
+    reports: review the drift, regenerate with
+    ``python -m theanompi_tpu.analysis --artifact
+    .graftlint_artifact.json``, and commit it with the change."""
+    from theanompi_tpu.analysis import engine
+
+    committed = engine.load_artifact(engine.artifact_path())
+    current = engine.current_artifact()
+    assert current["findings"] == committed["findings"] == [], (
+        "lint findings drifted from the committed artifact"
+    )
+    cur_tr, com_tr = current["step_traces"], committed["step_traces"]
+    drifted = sorted(
+        ep
+        for ep in set(cur_tr) | set(com_tr)
+        if cur_tr.get(ep) != com_tr.get(ep)
+    )
+    assert drifted == [], (
+        "whole-step collective traces drifted from the committed "
+        f"artifact for: {', '.join(drifted)} — review, regenerate "
+        "(python -m theanompi_tpu.analysis --artifact "
+        ".graftlint_artifact.json) and commit the diff"
+    )
+
+
 def test_fixture_corpus_is_excluded():
     """The deliberately-bad corpus must never leak into the gate: the
     same walk WITHOUT the exclusion sees its findings."""

@@ -39,16 +39,18 @@ def _fixable(findings):
 def test_fix_output_relints_clean_and_parses(corpus_copy):
     tmp_path, paths = corpus_copy
     before, _ = analyze(paths=paths, root=str(tmp_path))
-    assert len(_fixable(before)) == 4  # 2x GL-D004 + 2x GL-J002
+    # 2x GL-D004 + 2x GL-J002 + 1x GL-D001 (read_after_donation is the
+    # rebind-from-result shape, mechanical as of ISSUE 14)
+    assert len(_fixable(before)) == 5
     reports = fix_files(paths, str(tmp_path), write=True)
-    assert sum(len(r.applied) for r in reports) == 4
+    assert sum(len(r.applied) for r in reports) == 5
     assert not any(r.error for r in reports)
     after, skipped = analyze(paths=paths, root=str(tmp_path))
     assert skipped == []  # both files still parse
     assert _fixable(after) == []  # fixable rules are gone
     # the fixer must not eat the rest of the seeded corpus: the
     # non-mechanical findings survive the rewrite untouched
-    assert {f.rule for f in after} >= {"GL-D001", "GL-D003", "GL-J001"}
+    assert {f.rule for f in after} >= {"GL-D003", "GL-J001"}
 
 
 def test_fix_is_idempotent_and_byte_identical(corpus_copy):
@@ -67,6 +69,9 @@ def test_fixed_sources_get_the_canonical_rewrites(corpus_copy):
     assert "jax.tree.map(np.array, params)" in donation
     assert "lambda x: np.array(x)" in donation
     assert "np.asarray, params)" not in donation
+    # the GL-D001 repair: the read after the donating call now reads
+    # the rebound result
+    assert "norm = jnp.sum(new_params[\"w\"])" in donation
     recompile = (tmp_path / "bad_recompile.py").read_text()
     assert "(1, 2, 3)" in recompile  # list display → tuple
     assert '(("fast", True),)' in recompile  # dict display → item pairs
@@ -76,7 +81,7 @@ def test_diff_mode_writes_nothing(corpus_copy):
     tmp_path, paths = corpus_copy
     orig = {p: open(p).read() for p in paths}
     reports = fix_files(paths, str(tmp_path), write=False)
-    assert sum(len(r.applied) for r in reports) == 4
+    assert sum(len(r.applied) for r in reports) == 5
     assert any("np.array" in r.diff for r in reports)
     assert not any(r.wrote for r in reports)
     assert {p: open(p).read() for p in paths} == orig
@@ -140,15 +145,96 @@ def test_cli_diff_then_fix_roundtrip(tmp_path, capsys):
     rc = cli_main([str(dst), "--diff"])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "would fix 2 site(s) in 1 file(s)" in out
+    assert "would fix 3 site(s) in 1 file(s)" in out
     assert "+    return jax.tree.map(np.array, params)" in out
     assert "np.asarray, params)" in dst.read_text()  # dry run: unchanged
     rc = cli_main([str(dst), "--fix"])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "fixed 2 site(s) in 1 file(s)" in out
+    assert "fixed 3 site(s) in 1 file(s)" in out
     assert "np.asarray, params)" not in dst.read_text()
     # third invocation: nothing left to do
     rc = cli_main([str(dst), "--fix"])
     assert rc == 0
     assert "fixed 0 site(s) in 0 file(s)" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# GL-D001 rebind-from-result autofix (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+_D001_SRC = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "\n"
+    "\n"
+    "def _step(params, batch):\n"
+    "    return params\n"
+    "\n"
+    "\n"
+    "_train = jax.jit(_step, donate_argnums=(0,))\n"
+    "\n"
+    "\n"
+    "def read_after(params, batch):\n"
+    "    new_params = _train(params, batch)\n"
+    "    norm = jnp.sum(params[\"w\"])\n"
+    "    check = params[\"b\"] + norm\n"
+    "    return new_params, check\n"
+    "\n"
+    "\n"
+    "def tuple_result_unfixable(params, batch):\n"
+    "    new, aux = _train(params, batch), 0\n"
+    "    return new, params[\"w\"], aux\n"
+)
+
+
+def test_d001_fix_rewrites_reads_to_rebound_name(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(_D001_SRC)
+    m = parse_module(str(p), str(tmp_path))
+    new_source, report = fix_module(m)
+    d001 = [f for f in report.applied if f.rule == "GL-D001"]
+    assert len(d001) == 2  # both reads, up to the next rebind
+    assert 'norm = jnp.sum(new_params["w"])' in new_source
+    assert 'check = new_params["b"] + norm' in new_source
+    # the non-mechanical shape is skipped with a note, never mangled
+    assert any(
+        s.rule == "GL-D001" and "single" in s.reason for s in report.skipped
+    )
+    assert 'return new, params["w"], aux' in new_source
+
+
+def test_d001_fix_idempotent_and_relints_clean(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(_D001_SRC)
+    rc = cli_main([str(p), "--fix"])
+    assert rc == 0
+    first = p.read_text()
+    findings, _ = analyze(paths=[str(p)], root=str(tmp_path))
+    assert not [
+        f for f in findings
+        if f.rule == "GL-D001" and f.symbol == "read_after"
+    ]
+    # the unfixable tuple-result shape still reports (skipped != fixed)
+    assert [
+        f.symbol for f in findings if f.rule == "GL-D001"
+    ] == ["tuple_result_unfixable"]
+    rc = cli_main([str(p), "--fix"])
+    assert rc == 0 and p.read_text() == first
+
+
+def test_d001_fix_respects_result_rebind_boundary(tmp_path):
+    """Reads after the RESULT name is rebound must not be rewritten —
+    the result no longer names the updated buffer."""
+    src = _D001_SRC.replace(
+        '    check = params["b"] + norm\n',
+        "    new_params = None\n    check = params[\"b\"] + norm\n",
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    m = parse_module(str(p), str(tmp_path))
+    new_source, report = fix_module(m)
+    applied = [f for f in report.applied if f.rule == "GL-D001"]
+    assert len(applied) == 1  # only the read before the result rebind
+    assert 'norm = jnp.sum(new_params["w"])' in new_source
+    assert 'check = params["b"] + norm' in new_source

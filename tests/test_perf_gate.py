@@ -44,6 +44,9 @@ def _bench_json(path, value, trace=None, live_alerts=None):
     return str(path)
 
 
+ARTIFACT = os.path.join(REPO, ".graftlint_artifact.json")
+
+
 def _run_gate(env_extra):
     env = dict(os.environ)
     # the serve leg runs a real (CPU-rehearsal) serving bench when no
@@ -54,6 +57,11 @@ def _run_gate(env_extra):
     env.setdefault("PERF_GATE_CHAOS", "0")
     env.setdefault("PERF_GATE_FLEET", "0")
     env.setdefault("PERF_GATE_BSP", "0")
+    # the LINT leg stays default-ON; feeding the committed artifact
+    # back as the "current" document keeps the smoke tests off the
+    # analyzer run (the dedicated LINT tests below exercise the real
+    # path and the failure shapes)
+    env.setdefault("PERF_GATE_LINT_CURRENT", ARTIFACT)
     env.update(env_extra)
     return subprocess.run(
         ["bash", GATE], capture_output=True, text=True, env=env,
@@ -802,3 +810,95 @@ def test_gate_bsp_leg_skippable(fixtures):
     assert "bsp drill" not in r.stderr
     assert "bsp:" not in r.stderr
     assert "green" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# lint leg (ISSUE 14 satellite): the graftlint artifact diff, default-on
+# ---------------------------------------------------------------------------
+
+def _lint_current(tmp_path, mutate=None):
+    """A current-artifact fixture derived from the committed one."""
+    doc = json.load(open(ARTIFACT))
+    if mutate:
+        mutate(doc)
+    path = tmp_path / "lint_current.json"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def test_gate_lint_leg_green_runs_real_analyzer(fixtures):
+    """No PERF_GATE_LINT_CURRENT: the leg analyzes the tree through
+    the incremental cache and must match the committed artifact."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT_CURRENT": "",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "lint artifact diff" in r.stderr
+    assert "graftlint_diff: clean" in r.stdout
+
+
+def test_gate_lint_leg_fails_on_new_finding(fixtures, tmp_path):
+    base, good, _ = fixtures
+
+    def add_finding(doc):
+        doc["findings"].append({
+            "fingerprint": "0123456789abcdef", "rule": "GL-P001",
+            "pass": "protocol", "severity": "warning", "file": "x.py",
+            "line": 1, "symbol": "f", "message": "m", "snippet": "s",
+            "fixable": False,
+        })
+
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT_CURRENT": _lint_current(tmp_path, add_finding),
+    })
+    assert r.returncode != 0
+    assert "NEW FINDING" in r.stdout
+    assert "LINT VIOLATION" in r.stderr
+
+
+def test_gate_lint_leg_fails_on_step_trace_drift(fixtures, tmp_path):
+    base, good, _ = fixtures
+
+    def drift(doc):
+        key = sorted(doc["step_traces"])[0]
+        doc["step_traces"][key] = list(doc["step_traces"][key]) + ["psum"]
+
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT_CURRENT": _lint_current(tmp_path, drift),
+    })
+    assert r.returncode != 0
+    assert "STEP-TRACE DRIFT" in r.stdout
+    assert "LINT VIOLATION" in r.stderr
+
+
+def test_gate_lint_leg_fails_on_missing_baseline(fixtures, tmp_path):
+    """An absent committed artifact is a loud failure, not a skip —
+    a gate that silently baselines against nothing is no gate."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT_BASELINE": str(tmp_path / "missing.json"),
+    })
+    assert r.returncode != 0
+    assert "LINT VIOLATION" in r.stderr
+
+
+def test_gate_lint_leg_skippable(fixtures, tmp_path):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT": "0",
+        "PERF_GATE_LINT_BASELINE": str(tmp_path / "missing.json"),
+    })
+    assert r.returncode == 0, r.stderr
+    assert "lint artifact diff" not in r.stderr

@@ -87,7 +87,8 @@ def test_tcp_server_channel_request_reply():
 
         def client():
             r = request(("127.0.0.1", port),
-                        {"kind": "exchange", "params": {"w": np.ones(3)}})
+                        {"kind": "exchange", "params": {"w": np.ones(3)}},
+                        timeout=30.0)
             results.append(r)
 
         threads = [threading.Thread(target=client) for _ in range(4)]

@@ -10,18 +10,22 @@ lock inversions in the host-level async transport.  None of these need
 hardware to detect — they are visible in the AST — so this package
 checks them at review time, on CPU, in CI.
 
-Six passes, each pure-stdlib (no jax import — the CLI must start fast
-and run on machines with no accelerator stack):
+Seven passes, each pure-stdlib (no jax import — the CLI must start
+fast and run on machines with no accelerator stack):
 
 - ``recompile``   (GL-J*): jit wrappers rebuilt per loop iteration,
   unhashable values at static-arg positions, Python branches on traced
   values or shapes inside traced code.
 - ``donation``    (GL-D*): reads of a donated binding after the
-  donating call, donation aliasing, donated buffers escaping to
-  background threads/queues without a host copy — and, through the
-  whole-package call graph (``analysis/callgraph.py``), GL-D005:
-  bindings forwarded into a *helper* whose parameter flows into a
-  donated jit position, then read afterwards.
+  donating call — FLOW-SENSITIVE via ``analysis/dataflow.py`` (a
+  per-function CFG + may-alias/may-taint), so donated values
+  propagate through tuple packing/unpacking, attribute/subscript
+  stores, conditional rebinds and loop back edges — donation
+  aliasing, donated buffers escaping to background threads/queues
+  without a host copy, and, through the whole-package call graph
+  (``analysis/callgraph.py``), GL-D005: bindings forwarded into a
+  *helper* whose parameter flows into a donated jit position (or
+  whose result aliases one), then read afterwards.
 - ``collectives`` (GL-C*): per-function collective sequences under
   ``shard_map``/``jit`` that diverge across ``lax.cond`` branches or
   data-dependent Python branches, and collectives under a
@@ -36,7 +40,16 @@ and run on machines with no accelerator stack):
 - ``threadstate`` (GL-T*): unlocked mutation of shared state dicts —
   a class that mutates a dict under its own lock in one method and
   bare in another (the roster/router surface the serving fleet adds)
-  is racing itself; ``__init__`` and ``*_locked`` helpers exempt.
+  is racing itself.  Locks and the guarded-dict discipline resolve
+  across base classes in other modules (``callgraph.ClassTable``
+  MRO); ``__init__`` is exempt, and ``*_locked`` helpers are exempt
+  only while the call graph has not caught an unlocked call site.
+- ``protocol``    (GL-P*): distributed-protocol misuse on the
+  transport/membership surface — ``transport.request()`` in a
+  loop/thread without a deadline or timeout budget, blocking rpcs
+  issued under a shared lock (the distributed-deadlock shape),
+  per-member state mutated outside a generation check, and journal
+  re-admission specs that drop the ``token_index0`` re-key.
 
 Findings carry severity + ``file:line`` and are matched against a
 checked-in baseline (``.graftlint_baseline.json`` at the repo root) so
@@ -46,17 +59,28 @@ way — fix new findings or suppress them inline with a justification.
 Inline suppression: ``# graftlint: disable=GL-XXXX`` (or a bare
 ``# graftlint: disable``) on the flagged line or the line above.
 
-The mechanical rules (GL-D004, GL-J002) have an autofixer
-(``analysis/fixer.py``): span-anchored rewrites, verified idempotent
-and re-linted clean before a file is touched.
+The mechanical rules (GL-D001 rebind-from-result, GL-D004, GL-J002)
+have an autofixer (``analysis/fixer.py``): span-anchored rewrites,
+verified idempotent and re-linted clean before a file is touched.
+
+Lint output is a first-class CI artifact: ``--format sarif`` emits
+SARIF 2.1.0, ``--artifact`` writes the stable sorted findings +
+per-strategy step traces document the repo commits as
+``.graftlint_artifact.json``, and ``scripts/graftlint_diff.py`` exits
+nonzero on any new finding or step-trace drift (perf_gate's
+default-on LINT leg).  An mtime+hash incremental cache
+(``.graftlint_cache.json``, gitignored) keeps the warm full-repo run
+a stat sweep.
 
 CLI::
 
-    python -m theanompi_tpu.analysis [--format json|human]
+    python -m theanompi_tpu.analysis [--format json|human|sarif]
     python -m theanompi_tpu.analysis --write-baseline   # accept current
     python -m theanompi_tpu.analysis --diff             # dry-run fixes
     python -m theanompi_tpu.analysis --fix              # apply fixes
     python -m theanompi_tpu.analysis --step-trace       # whole-step traces
+    python -m theanompi_tpu.analysis --artifact PATH    # CI artifact
+    python -m theanompi_tpu.analysis --bench            # per-pass timing
 
 See ``docs/static_analysis.md`` for the workflow.
 """

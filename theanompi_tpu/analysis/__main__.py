@@ -44,9 +44,30 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--format",
-        choices=("human", "json"),
+        choices=("human", "json", "sarif"),
         default="human",
         dest="fmt",
+    )
+    p.add_argument(
+        "--artifact",
+        default=None,
+        metavar="PATH",
+        help="also write the stable, sorted CI lint artifact (findings "
+        "+ per-strategy step traces) to PATH — the document "
+        "scripts/graftlint_diff.py diffs against the committed "
+        f"{engine.ARTIFACT_NAME}",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the mtime+hash incremental cache "
+        f"(<repo>/{engine.CACHE_NAME}) for this run",
+    )
+    p.add_argument(
+        "--bench",
+        action="store_true",
+        help="print per-pass wall time over the default target set "
+        "and exit (tier-1 pins the warm cached runtime separately)",
     )
     p.add_argument(
         "--baseline",
@@ -122,16 +143,33 @@ def _run_fixer(args) -> int:
     if any(r.error for r in reports):
         return 2
     if args.fix and n_fixed:
-        # prove the rewrite: the fixable rules must no longer fire on
-        # the same targets (unfixable shapes were reported above)
+        # prove the rewrite: the sites we rewrote must no longer fire
+        # (shapes we skipped with a note are expected to remain, and a
+        # GL-D001 the planner never claimed — e.g. an alias read only
+        # the flow engine sees — is a report, not a fixer bug)
         findings, _ = engine.analyze(
             paths=args.paths or None, exclude_dirs=tuple(args.exclude)
         )
+        applied_lines = {}
+        skipped_lines = {}
+        for r in reports:
+            if r.changed:
+                applied_lines.setdefault(r.rel, set()).update(
+                    x.line for x in r.applied
+                )
+            skipped_lines.setdefault(r.rel, set()).update(
+                s.line for s in r.skipped
+            )
         residual = [
             f
             for f in findings
             if f.fixable
-            and any(f.file == r.rel and r.changed for r in reports)
+            and f.file in applied_lines
+            and f.line not in skipped_lines.get(f.file, ())
+            and (
+                f.rule != "GL-D001"
+                or f.line in applied_lines.get(f.file, ())
+            )
         ]
         if residual:
             for f in residual:
@@ -162,19 +200,51 @@ def _run_step_trace(args) -> int:
     return 0
 
 
+def _run_bench(args) -> int:
+    timings = engine.bench_passes()
+    total = sum(t for _n, t in timings)
+    width = max(len(n) for n, _t in timings)
+    for name, t in timings:
+        print(f"{name:<{width}}  {t * 1000.0:9.1f} ms")
+    print(f"{'total':<{width}}  {total * 1000.0:9.1f} ms")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.bench:
+        return _run_bench(args)
     if args.step_trace:
         return _run_step_trace(args)
     if args.fix or args.diff:
         return _run_fixer(args)
+    traces = None
     try:
-        findings, skipped = engine.analyze(
-            paths=args.paths or None, exclude_dirs=tuple(args.exclude)
-        )
+        if not args.paths and not args.exclude:
+            # default target set: the cache-backed full run (findings +
+            # traces from ONE parse; a warm run is a stat sweep)
+            findings, skipped, traces, _hit = engine.full_run(
+                use_cache=not args.no_cache
+            )
+        else:
+            modules, skipped, _root = engine.parse_targets(
+                paths=args.paths or None, exclude_dirs=tuple(args.exclude)
+            )
+            findings, traces, _timings = engine._analyze_modules(
+                modules, with_traces=bool(args.artifact)
+            )
     except OSError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    if args.artifact:
+        doc = engine.build_artifact(findings, traces or {}, skipped)
+        engine.write_artifact(doc, args.artifact)
+        print(
+            f"graftlint: wrote artifact ({len(doc['findings'])} finding(s), "
+            f"{len(doc['step_traces'])} step trace(s)) to {args.artifact}",
+            file=sys.stderr,
+        )
 
     if args.write_baseline:
         path = engine.write_baseline(findings, args.baseline)
@@ -186,6 +256,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     new, matched, stale = engine.split_by_baseline(findings, baseline)
 
+    if args.fmt == "sarif":
+        from theanompi_tpu.analysis import sarif
+
+        json.dump(sarif.to_sarif(new), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 1 if new else 0
     if args.fmt == "json":
         doc = {
             "tool": "graftlint",

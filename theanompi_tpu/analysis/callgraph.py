@@ -115,6 +115,10 @@ class FunctionSummary:
     donated_params: Set[str] = field(default_factory=set)
     # (line, param) of the DIRECT donation sites inside this function
     direct_donations: List[Tuple[int, str]] = field(default_factory=list)
+    # the function RETURNS one of its donated parameters — the caller's
+    # result binding aliases a buffer the callee already handed to XLA
+    # (GL-D005's result-alias source)
+    returns_donated: bool = False
 
 
 class CallGraph:
@@ -416,6 +420,21 @@ class CallGraph:
                         ):
                             summ.donated_params.add(arg.id)
                             changed = True
+        # result aliasing: `return p` where p is donated means every
+        # caller's result binding still points at the reused buffer
+        for summ in self.functions.values():
+            if not summ.donated_params:
+                continue
+            m = summ.module
+            for node in ast.walk(summ.info.node):
+                if (
+                    isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in summ.donated_params
+                    and m.enclosing_function(node) is summ.info
+                ):
+                    summ.returns_donated = True
+                    break
 
     # ------------------------------------------------------------------
     # queries
@@ -475,3 +494,83 @@ def _arg_bindings(
 
 def build(modules: Sequence[ParsedModule]) -> CallGraph:
     return CallGraph(modules)
+
+
+# ---------------------------------------------------------------------------
+# package-wide class hierarchy (MRO over imports)
+# ---------------------------------------------------------------------------
+
+class ClassTable:
+    """Base-class resolution across the analyzed set.
+
+    The GL-T pass's stated narrow spot was locks inherited from a base
+    class in another module: ``class Router(LockedBase)`` where
+    ``LockedBase.__init__`` constructs ``self._lock`` is invisible to
+    a per-class scan.  This table resolves base-class expressions —
+    same-module names, ``from pkg.mod import Base`` names, and dotted
+    ``mod.Base`` attributes through the import map — into the
+    ClassDefs of the analyzed set, and linearizes the chain (local
+    class first, then bases depth-first, C3 not needed at this
+    codebase's hierarchy depth).  Bases that resolve OUTSIDE the
+    analyzed set (ABCs, stdlib, jax) contribute nothing — the same
+    prefer-missing-over-inventing discipline as call resolution."""
+
+    def __init__(self, modules: Sequence[ParsedModule]):
+        self.modules = list(modules)
+        self._tags = assign_tags(self.modules)
+        self._dotted: Dict[str, str] = {}
+        # (tag, class name) -> (module, ClassDef)
+        self._defs: Dict[Tuple[str, str], Tuple[ParsedModule, ast.ClassDef]] = {}
+        for m in self.modules:
+            tag = self._tags.get(m.rel) or module_tag(m)
+            self._dotted[_dotted_of(m)] = tag
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._defs.setdefault((tag, node.name), (m, node))
+
+    def _tag_of(self, m: ParsedModule) -> str:
+        return self._tags.get(m.rel) or module_tag(m)
+
+    def _resolve_base(
+        self, m: ParsedModule, base: ast.expr
+    ) -> Optional[Tuple[ParsedModule, ast.ClassDef]]:
+        if isinstance(base, ast.Name):
+            hit = self._defs.get((self._tag_of(m), base.id))
+            if hit is not None:
+                return hit
+            src = m.imports.names.get(base.id)
+            if src:
+                mod, _, name = src.rpartition(".")
+                tag = self._dotted.get(mod)
+                if tag is not None:
+                    return self._defs.get((tag, name))
+            return None
+        resolved = m.imports.resolve(base)
+        if resolved:
+            mod, _, name = resolved.rpartition(".")
+            tag = self._dotted.get(mod)
+            if tag is not None:
+                return self._defs.get((tag, name))
+        return None
+
+    def mro(
+        self, m: ParsedModule, cls: ast.ClassDef
+    ) -> List[Tuple[ParsedModule, ast.ClassDef]]:
+        """The class itself, then resolved bases depth-first, deduped
+        and cycle-guarded — every (module, ClassDef) whose attributes
+        an instance of ``cls`` carries at runtime."""
+        out: List[Tuple[ParsedModule, ast.ClassDef]] = []
+        seen: Set[int] = set()
+
+        def walk(mm: ParsedModule, c: ast.ClassDef) -> None:
+            if id(c) in seen or len(out) > 64:
+                return
+            seen.add(id(c))
+            out.append((mm, c))
+            for b in c.bases:
+                hit = self._resolve_base(mm, b)
+                if hit is not None:
+                    walk(hit[0], hit[1])
+
+        walk(m, cls)
+        return out

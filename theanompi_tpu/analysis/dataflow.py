@@ -1,0 +1,282 @@
+"""The flow-sensitive layer: a per-function CFG and a forward
+may-analysis framework the passes share.
+
+PR 2..13 reasoned about one function body with *source-line ordering*
+as the control-flow approximation — good enough for straight-line
+worker loops, blind to everything the ROADMAP carryover names: a
+donated value smuggled through a tuple, an alias created before the
+donating call, a rebind that only happens on one arm of a branch.
+Those are dataflow facts, so this module gives every pass the same two
+primitives:
+
+- ``build_cfg(body)``: a conventional basic-block CFG over one
+  function body's statement list.  Branch/loop/try/with structure maps
+  to edges; ``For``/``While``/``With``/``If`` *headers* are appended to
+  their guard block as header statements so a client transfer function
+  can see the loop target binding / test reads / context-manager
+  binding without re-deriving structure.  ``break``/``continue``/
+  ``return``/``raise`` terminate their block with the right edge.
+  ``try`` is approximated conservatively for a may-analysis: the body
+  may jump to any handler at any point (edges from the body's entry
+  AND exit), handlers and ``orelse`` re-join before ``finally``.
+- ``forward_may(cfg, init, transfer)``: a worklist fixpoint for any
+  monotone forward analysis whose join is a union.  The client owns
+  the state shape; the framework only needs ``join(a, b)`` and
+  ``transfer(state, stmt) -> state`` plus an equality check for
+  convergence.  After the fixpoint, ``replay`` walks each block once
+  more from its fixed in-state with reporting enabled — the standard
+  two-phase trick that keeps findings deterministic and unduplicated
+  regardless of worklist order.
+
+Nested function/class definitions are opaque single statements (they
+run when called, not where defined — the same discipline every other
+pass follows).  Pure stdlib, no jax import, like the whole package.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, TypeVar
+
+S = TypeVar("S")
+
+# blocks beyond this are a pathological input, not real code; the
+# builder degrades to one linear block rather than blowing the stack
+MAX_BLOCKS = 4096
+
+
+@dataclass
+class Block:
+    id: int
+    stmts: List[ast.AST] = field(default_factory=list)
+    succs: List[int] = field(default_factory=list)
+
+
+@dataclass
+class CFG:
+    blocks: List[Block]
+    entry: int
+    exit: int
+
+    def add_edge(self, src: int, dst: int) -> None:
+        if dst not in self.blocks[src].succs:
+            self.blocks[src].succs.append(dst)
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {b.id: [] for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succs:
+                out[s].append(b.id)
+        return out
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.cfg = CFG(blocks=[], entry=0, exit=-1)
+        self._exit = self._new()  # block 0 is reserved as the sink
+        self.cfg.exit = self._exit
+
+    def _new(self) -> int:
+        b = Block(id=len(self.cfg.blocks))
+        self.cfg.blocks.append(b)
+        return b.id
+
+    def build(self, body: Sequence[ast.AST]) -> CFG:
+        entry = self._new()
+        self.cfg.entry = entry
+        last = self._stmts(body, entry, loop_stack=())
+        if last is not None:
+            self.cfg.add_edge(last, self._exit)
+        return self.cfg
+
+    # ------------------------------------------------------------------
+    # statement lowering.  Each helper returns the block id control
+    # falls out of, or None when every path left (return/break/...).
+    # ------------------------------------------------------------------
+    def _stmts(
+        self, body: Sequence[ast.AST], cur: Optional[int], loop_stack
+    ) -> Optional[int]:
+        for stmt in body:
+            if cur is None:
+                # unreachable code after return/raise — still lower it
+                # (a may-analysis over it is harmless) into a detached
+                # block so line-anchored clients don't lose the nodes
+                cur = self._new()
+            cur = self._stmt(stmt, cur, loop_stack)
+        return cur
+
+    def _stmt(self, stmt: ast.AST, cur: int, loop_stack) -> Optional[int]:
+        if len(self.cfg.blocks) > MAX_BLOCKS:
+            self.cfg.blocks[cur].stmts.append(stmt)
+            return cur
+        if isinstance(stmt, ast.If):
+            self.cfg.blocks[cur].stmts.append(_Header(stmt))
+            then_b = self._new()
+            self.cfg.add_edge(cur, then_b)
+            then_out = self._stmts(stmt.body, then_b, loop_stack)
+            if stmt.orelse:
+                else_b = self._new()
+                self.cfg.add_edge(cur, else_b)
+                else_out = self._stmts(stmt.orelse, else_b, loop_stack)
+            else:
+                else_out = cur  # fall through the test
+            if then_out is None and else_out is None:
+                return None
+            join = self._new()
+            if then_out is not None:
+                self.cfg.add_edge(then_out, join)
+            if else_out is not None:
+                self.cfg.add_edge(else_out, join)
+            return join
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            head = self._new()
+            self.cfg.add_edge(cur, head)
+            self.cfg.blocks[head].stmts.append(_Header(stmt))
+            after = self._new()
+            self.cfg.add_edge(head, after)  # zero-trip / test-false
+            body_b = self._new()
+            self.cfg.add_edge(head, body_b)
+            body_out = self._stmts(
+                stmt.body, body_b, loop_stack + ((head, after),)
+            )
+            if body_out is not None:
+                self.cfg.add_edge(body_out, head)  # back edge
+            if stmt.orelse:
+                else_out = self._stmts(stmt.orelse, after, loop_stack)
+                if else_out is not None and else_out != after:
+                    return else_out
+            return after
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.cfg.blocks[cur].stmts.append(_Header(stmt))
+            return self._stmts(stmt.body, cur, loop_stack)
+        if isinstance(stmt, ast.Try):
+            body_b = self._new()
+            self.cfg.add_edge(cur, body_b)
+            body_out = self._stmts(stmt.body, body_b, loop_stack)
+            join = self._new()
+            # the body may raise anywhere: handlers are reachable from
+            # both the body's entry state and its exit state
+            outs: List[Optional[int]] = []
+            for h in stmt.handlers:
+                h_b = self._new()
+                self.cfg.add_edge(body_b, h_b)
+                if body_out is not None:
+                    self.cfg.add_edge(body_out, h_b)
+                if isinstance(h, ast.ExceptHandler):
+                    outs.append(self._stmts(h.body, h_b, loop_stack))
+                else:  # pragma: no cover - future ast shapes
+                    outs.append(h_b)
+            if stmt.orelse:
+                if body_out is not None:
+                    body_out = self._stmts(stmt.orelse, body_out, loop_stack)
+            outs.append(body_out)
+            live = [o for o in outs if o is not None]
+            if stmt.finalbody:
+                fin = self._new()
+                for o in live:
+                    self.cfg.add_edge(o, fin)
+                if not live:
+                    # finally still runs on the exceptional path; keep
+                    # it reachable from the body entry
+                    self.cfg.add_edge(body_b, fin)
+                return self._stmts(stmt.finalbody, fin, loop_stack)
+            if not live:
+                return None
+            for o in live:
+                self.cfg.add_edge(o, join)
+            return join
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            self.cfg.blocks[cur].stmts.append(stmt)
+            self.cfg.add_edge(cur, self._exit)
+            return None
+        if isinstance(stmt, ast.Break):
+            if loop_stack:
+                self.cfg.add_edge(cur, loop_stack[-1][1])
+                return None
+            return cur
+        if isinstance(stmt, ast.Continue):
+            if loop_stack:
+                self.cfg.add_edge(cur, loop_stack[-1][0])
+                return None
+            return cur
+        # plain statement (incl. nested defs/classes, which clients
+        # treat as opaque)
+        self.cfg.blocks[cur].stmts.append(stmt)
+        return cur
+
+
+class _Header:
+    """Wrapper marking an If/For/While/With node appended to the block
+    that *evaluates its guard* — the client transfer sees the node's
+    test/iter/items without walking into the already-lowered body."""
+
+    __slots__ = ("node",)
+
+    def __init__(self, node: ast.AST):
+        self.node = node
+
+
+def is_header(stmt) -> bool:
+    return isinstance(stmt, _Header)
+
+
+def header_node(stmt) -> ast.AST:
+    return stmt.node if isinstance(stmt, _Header) else stmt
+
+
+def build_cfg(body: Sequence[ast.AST]) -> CFG:
+    """CFG over one function body (pass ``fn_node.body``)."""
+    return _Builder().build(body)
+
+
+def forward_may(
+    cfg: CFG,
+    init: S,
+    transfer: Callable[[S, ast.AST], S],
+    join: Callable[[S, S], S],
+    equal: Callable[[S, S], bool],
+    bottom: Callable[[], S],
+    max_rounds: int = 64,
+) -> Dict[int, S]:
+    """Worklist forward fixpoint; returns the IN-state per block id.
+
+    ``init`` seeds the entry block; unreached blocks start at
+    ``bottom()``.  ``transfer`` is applied statement-by-statement
+    inside a block; ``join`` must be a union-like upper bound for
+    termination.  ``max_rounds`` caps full sweeps (defense against a
+    non-monotone client, not a correctness device)."""
+    in_states: Dict[int, S] = {b.id: bottom() for b in cfg.blocks}
+    in_states[cfg.entry] = init
+    work = [b.id for b in cfg.blocks]
+    rounds = 0
+    while work and rounds < max_rounds * max(1, len(cfg.blocks)):
+        rounds += 1
+        bid = work.pop(0)
+        out = in_states[bid]
+        for stmt in cfg.blocks[bid].stmts:
+            out = transfer(out, stmt)
+        for s in cfg.blocks[bid].succs:
+            merged = join(in_states[s], out)
+            if not equal(merged, in_states[s]):
+                in_states[s] = merged
+                if s not in work:
+                    work.append(s)
+    return in_states
+
+
+def replay(
+    cfg: CFG,
+    in_states: Dict[int, S],
+    transfer: Callable[[S, ast.AST], S],
+) -> None:
+    """One reporting sweep: run ``transfer`` (with its side-effecting
+    report hook enabled) over every block from its fixed in-state, in
+    block order — deterministic findings independent of worklist
+    order."""
+    for b in cfg.blocks:
+        state = in_states.get(b.id)
+        if state is None:
+            continue
+        for stmt in b.stmts:
+            state = transfer(state, stmt)

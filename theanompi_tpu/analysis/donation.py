@@ -50,10 +50,20 @@ bindings:
   caller's buffer has been donated exactly as if the caller had called
   the jit itself.  Same rebind/same-statement exemptions as GL-D001.
 
-GL-D001..4 reason over one function body with line-ordered source
-approximation of control flow; GL-D005 extends the *donation* fact
-across the package call graph while keeping the same per-caller read
-analysis (see docs/static_analysis.md).
+GL-D002..4 reason over one function body with line-ordered source
+approximation of control flow.  GL-D001 and GL-D005's read analysis
+are FLOW-SENSITIVE as of this PR: both run a forward may-alias +
+may-taint analysis over the per-function CFG (``analysis/dataflow.py``)
+so donated values propagate **through expressions** — tuple
+packing/unpacking, attribute/subscript stores, conditional rebinding
+(a binding rebound on only one arm of a branch stays hazardous on the
+other), and helper results that alias a donated argument (the
+call-graph ``returns_donated`` summary).  The bare-names-only gap the
+ROADMAP carried since PR 4 is closed: ``pair = (params, x)`` followed
+by a donating call on ``params`` makes a later ``pair[0]`` read a
+finding, while a rebind on EVERY path to the read stays silent (see
+docs/static_analysis.md and the seeded corpus in
+``tests/data/analysis/bad_dataflow.py``).
 """
 
 from __future__ import annotations
@@ -61,6 +71,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from theanompi_tpu.analysis import dataflow
 from theanompi_tpu.analysis.findings import Finding
 from theanompi_tpu.analysis.source import (
     JIT_NAMES,
@@ -278,6 +289,374 @@ class _FnScan(ast.NodeVisitor):
             self.reads.setdefault(node.id, []).append((node.lineno, node))
 
 
+# ---------------------------------------------------------------------------
+# the flow-sensitive taint engine (GL-D001 / GL-D005 read analysis)
+# ---------------------------------------------------------------------------
+#
+# State at a program point: ``(aliases, tainted)``.
+#
+# - ``aliases``: binding key -> frozenset of buffer *tokens* the key
+#   may refer to.  A token is either the key's own name (the buffer it
+#   named at function entry) or ``"@line.col"`` for a value produced
+#   at an assignment site.  Keys not in the map default to
+#   ``{key}`` — their entry-state buffer.
+# - ``tainted``: token -> (donation line, origin key, via) — the
+#   buffers some donating call has already handed to XLA.
+#
+# Donating a key taints every token it may alias; a read whose token
+# set intersects ``tainted`` is a finding.  Aliases propagate through
+# the *pure aliasing* expression forms only (names, attributes,
+# tuple/list/dict displays, subscripts, ternaries, starred) — a call
+# or arithmetic result is a fresh buffer.  Joins are unions, so a
+# rebind on one branch arm leaves the other arm's taint live, and a
+# rebind on EVERY path kills it — exactly the flow facts the
+# line-ordered pass could not express.
+
+_State = Optional[Tuple[Dict[str, frozenset], Dict[str, tuple]]]
+
+# expression forms whose result aliases (a subset of) their operands
+_ALIASING = (ast.Tuple, ast.List, ast.Starred, ast.IfExp)
+
+
+def _st_join(a: _State, b: _State) -> _State:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    aliases: Dict[str, frozenset] = dict(a[0])
+    for k, toks in b[0].items():
+        base = aliases.get(k, frozenset((k,)))
+        aliases[k] = base | toks
+    # keys assigned on only one side keep the other side's entry-state
+    # default — a one-arm rebind must not hide the fall-through alias
+    for k in list(a[0].keys()):
+        if k not in b[0]:
+            aliases[k] = a[0][k] | frozenset((k,))
+    tainted: Dict[str, tuple] = dict(a[1])
+    for t, info in b[1].items():
+        if t not in tainted or info[0] < tainted[t][0]:
+            tainted[t] = info
+    return (aliases, tainted)
+
+
+class _TaintEngine:
+    """One function's forward alias+taint analysis.
+
+    ``donating``: terminal binding name -> donated positions (the
+    module-mode GL-D001 sources).  ``silent_bindings``: donating
+    binding names that must neither taint nor report here (project
+    mode leaves direct donating calls to the per-module pass).
+    ``site_taints``: id(Call) -> (callee_fq, [(param, arg_expr)]) —
+    forwarding calls whose arguments are donated inside the callee
+    (GL-D005 sources).  ``returning``: id(Call) nodes whose RESULT
+    aliases a donated argument (the callee returns a donated
+    parameter).  ``report(line, key, info)`` fires once per taint
+    token, in block order."""
+
+    def __init__(
+        self,
+        m: ParsedModule,
+        donating: Dict[str, Set[int]],
+        site_taints: Optional[Dict[int, tuple]] = None,
+        returning: Optional[Set[int]] = None,
+        silent_bindings: Optional[Set[str]] = None,
+        report=None,
+    ):
+        self.m = m
+        self.donating = donating
+        self.site_taints = site_taints or {}
+        self.returning = returning or set()
+        self.silent = silent_bindings or set()
+        self.report = report
+        self.reporting = False
+        self.reported: Set[str] = set()
+
+    # -- state plumbing -------------------------------------------------
+    @staticmethod
+    def _lookup(aliases: Dict[str, frozenset], key: str) -> frozenset:
+        return aliases.get(key, frozenset((key,)))
+
+    @staticmethod
+    def _fresh(node: ast.AST) -> frozenset:
+        return frozenset(
+            (f"@{getattr(node, 'lineno', 0)}.{getattr(node, 'col_offset', 0)}",)
+        )
+
+    # -- expression evaluation ------------------------------------------
+    def _maybe_report(self, node, key, toks, tainted: Dict[str, tuple]):
+        if not self.reporting or self.report is None:
+            return
+        hits = sorted(t for t in toks if t in tainted and t not in self.reported)
+        if not hits:
+            return
+        tok = min(hits, key=lambda t: tainted[t][0])
+        self.reported.add(tok)
+        self.report(getattr(node, "lineno", 0), key, tainted[tok])
+
+    def _eval(self, expr, st, reads: bool = True) -> frozenset:
+        """Token set of ``expr``; records reads against the taint set
+        when ``reads`` (donating/forwarding/copy call arguments are
+        evaluated with ``reads=False`` — they are the legitimate last
+        use of the buffer)."""
+        if expr is None or isinstance(expr, ast.Constant):
+            return frozenset()
+        aliases, tainted = st
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            key = _binding_key(expr)
+            if key is None:
+                for child in ast.iter_child_nodes(expr):
+                    if isinstance(child, ast.expr):
+                        self._eval(child, st, reads)
+                return frozenset()
+            toks = self._lookup(aliases, key)
+            if reads and isinstance(getattr(expr, "ctx", ast.Load()), ast.Load):
+                self._maybe_report(expr, key, toks, tainted)
+            return toks
+        if isinstance(expr, ast.Subscript):
+            toks = self._eval(expr.value, st, reads)
+            self._eval(expr.slice, st, reads)
+            return toks
+        if isinstance(expr, _ALIASING):
+            out = frozenset()
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    out = out | self._eval(child, st, reads)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = frozenset()
+            for k in expr.keys:
+                if k is not None:
+                    self._eval(k, st, reads)
+            for v in expr.values:
+                out = out | self._eval(v, st, reads)
+            return out
+        if isinstance(expr, ast.NamedExpr):
+            toks = self._eval(expr.value, st, reads)
+            self._assign(expr.target, toks, st)
+            return toks
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, st, reads)
+        if isinstance(expr, ast.Lambda):
+            return frozenset()
+        if isinstance(expr, (ast.Await, ast.Yield, ast.YieldFrom)):
+            if getattr(expr, "value", None) is not None:
+                self._eval(expr.value, st, reads)
+            return frozenset()
+        # generic: arithmetic/comparison/comprehension/fstring results
+        # are fresh buffers; their operand reads still count
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._eval(child, st, reads)
+        return frozenset()
+
+    def _eval_call(self, node: ast.Call, st, reads: bool) -> frozenset:
+        aliases, tainted = st
+        name = terminal_name(node.func)
+        all_args = list(node.args) + [k.value for k in node.keywords]
+        # direct donating-binding call (module mode)
+        if name in self.donating:
+            argtoks = [self._eval(a, st, reads=False) for a in node.args]
+            for kw in node.keywords:
+                self._eval(kw.value, st, reads=False)
+            positions = self.donating[name]
+            for i, arg in enumerate(node.args):
+                if i in positions:
+                    origin = _binding_key(arg) or "<expression>"
+                    for tok in argtoks[i]:
+                        if tok not in tainted:
+                            tainted[tok] = (node.lineno, origin, None)
+            return frozenset()
+        if name in self.silent:  # project mode: GL-D001's territory
+            for a in all_args:
+                self._eval(a, st, reads=False)
+            return frozenset()
+        if id(node) in self.site_taints:
+            callee_fq, hits = self.site_taints[id(node)]
+            for a in all_args:
+                self._eval(a, st, reads=False)
+            donated = frozenset()
+            for param, arg in hits:
+                toks = self._eval(arg, st, reads=False)
+                donated = donated | toks
+                origin = _binding_key(arg) or "<expression>"
+                for tok in toks:
+                    if tok not in tainted:
+                        tainted[tok] = (node.lineno, origin, (callee_fq, param))
+            if id(node) in self.returning:
+                return donated
+            return frozenset()
+        if _is_copying_call(node):
+            for a in all_args:
+                self._eval(a, st, reads=False)
+            return frozenset()
+        # ordinary call: operands are reads, result is a fresh buffer
+        # (empty token set -> the assignment leaf mints a per-target
+        # fresh token, so tuple-unpacked results never alias each other)
+        self._eval(node.func, st, reads)
+        for a in all_args:
+            self._eval(a, st, reads)
+        return frozenset()
+
+    # -- assignment -----------------------------------------------------
+    def _assign(self, target: ast.expr, toks: frozenset, st) -> None:
+        aliases, _tainted = st
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign(e, toks, st)
+            return
+        if isinstance(target, ast.Starred):
+            self._assign(target.value, toks, st)
+            return
+        if isinstance(target, ast.Subscript):
+            # weak update: the container may now hold the buffer
+            key = _binding_key(target.value)
+            self._eval(target.slice, st)
+            if key is not None:
+                aliases[key] = self._lookup(aliases, key) | toks
+            return
+        key = _binding_key(target)
+        if key is not None:
+            if toks:
+                aliases[key] = toks
+            else:
+                fresh = self._fresh(target)
+                aliases[key] = fresh
+                # site tokens are keyed by position, so around a loop
+                # back edge the SAME token names this iteration's brand-
+                # new value and the previous iteration's (possibly
+                # donated) one — re-minting invalidates the stale taint,
+                # or `params = train_fn(params)` in a loop would flag
+                # its own sanctioned rebind-from-result pattern
+                for t in fresh:
+                    _tainted.pop(t, None)
+
+    @staticmethod
+    def _prune(st) -> None:
+        """Garbage-collect unobservable taint: a token no binding can
+        reach — not in any explicit alias set, and not the implicit
+        entry-state buffer of a key that was never strong-updated —
+        can never be read, so its taint is dead.  This is what makes
+        ``params = train_fn(params)`` on EVERY path (including around
+        a loop back edge) provably safe while a one-path rebind keeps
+        the other path's taint alive through the join."""
+        aliases, tainted = st
+        if not tainted:
+            return
+        reachable = set()
+        for toks in aliases.values():
+            reachable |= toks
+        for t in list(tainted):
+            if t in reachable:
+                continue
+            if t.startswith("@") or t in aliases:
+                del tainted[t]
+
+    # -- statement transfer ---------------------------------------------
+    def transfer(self, state: _State, stmt) -> _State:
+        if state is None:
+            return None
+        st = (dict(state[0]), dict(state[1]))
+        out = self._transfer_inner(st, stmt)
+        self._prune(out)
+        return out
+
+    def _transfer_inner(self, st, stmt):
+        if dataflow.is_header(stmt):
+            node = dataflow.header_node(stmt)
+            if isinstance(node, (ast.If, ast.While)):
+                self._eval(node.test, st)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                toks = self._eval(node.iter, st)
+                self._assign(node.target, toks, st)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    toks = self._eval(item.context_expr, st)
+                    if item.optional_vars is not None:
+                        self._assign(item.optional_vars, toks, st)
+            return st
+        if isinstance(stmt, ast.Assign):
+            if (
+                isinstance(stmt.value, ast.Tuple)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Tuple)
+                and len(stmt.targets[0].elts) == len(stmt.value.elts)
+                and not any(
+                    isinstance(e, ast.Starred) for e in stmt.targets[0].elts
+                )
+            ):
+                # pairwise: a, b = x, y keeps the element aliasing exact
+                pairs = [
+                    (t, self._eval(v, st))
+                    for t, v in zip(stmt.targets[0].elts, stmt.value.elts)
+                ]
+                for t, toks in pairs:
+                    self._assign(t, toks, st)
+                return st
+            toks = self._eval(stmt.value, st)
+            for t in stmt.targets:
+                self._assign(t, toks, st)
+            return st
+        if isinstance(stmt, ast.AnnAssign):
+            toks = (
+                self._eval(stmt.value, st)
+                if stmt.value is not None
+                else frozenset()
+            )
+            if stmt.value is not None:
+                self._assign(stmt.target, toks, st)
+            return st
+        if isinstance(stmt, ast.AugAssign):
+            self._eval(stmt.value, st)
+            self._assign(stmt.target, frozenset(), st)
+            return st
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    self._eval(t.value, st)
+                else:
+                    key = _binding_key(t)
+                    if key is not None:
+                        st[0][key] = self._fresh(t)
+            return st
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            st[0][stmt.name] = self._fresh(stmt)
+            return st
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._eval(stmt.value, st)
+            return st
+        if isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, st)
+            return st
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child, st)
+            return st
+        return st
+
+    # -- driver ---------------------------------------------------------
+    def run(self, fn_node) -> None:
+        body = getattr(fn_node, "body", None)
+        if not body:
+            return
+        cfg = dataflow.build_cfg(body)
+        init: _State = ({}, {})
+        in_states = dataflow.forward_may(
+            cfg,
+            init,
+            self.transfer,
+            _st_join,
+            lambda a, b: a == b,
+            lambda: None,
+        )
+        self.reporting = True
+        try:
+            dataflow.replay(cfg, in_states, self.transfer)
+        finally:
+            self.reporting = False
+
+
 def _collect_donating_bindings(m: ParsedModule) -> Dict[str, Set[int]]:
     """binding terminal name -> donated positional indices (call-site
     positions; only jit-family wrappers donate)."""
@@ -343,6 +722,79 @@ def iter_asarray_snapshot_sites(m: ParsedModule):
             yield node, node.args[0]
 
 
+def iter_d001_fix_sites(m: ParsedModule):
+    """Yield GL-D001 repair candidates for the ``--fix`` rewriter
+    (``analysis/fixer.py``) — shared detection, like the GL-D004/J002
+    ``iter_*`` helpers, so fixer and linter cannot drift.
+
+    The mechanically-repairable shape is the rebind-from-result
+    pattern applied after the fact: ``new = train_fn(params, ...)``
+    followed by reads of ``params`` — the sanctioned repair is to read
+    the RESULT, so every later bare-name read of the donated binding
+    (up to the next rebind of either name) is rewritten to the result
+    name.  Yields ``("fix", call, donated_name, result_name,
+    [read_nodes])`` for that shape and ``("skip", call, donated_key,
+    reason)`` when reads-after exist but the shape is not mechanical
+    (tuple/attribute results, attribute bindings, alias reads are
+    reported, not rewritten)."""
+    donating = _collect_donating_bindings(m)
+    if not donating:
+        return
+    for fi in m.functions:
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            continue
+        scan = _FnScan(m, donating)
+        for stmt in node.body:
+            scan.visit(stmt)
+        for line, key, call, rebound_same in scan.donate_events:
+            if rebound_same:
+                continue  # already the sanctioned pattern
+            rebind_lines = sorted(scan.rebinds.get(key, []))
+            later_reads = [
+                (l, n)
+                for (l, n) in scan.reads.get(key, [])
+                if l > line
+                and not any(line < rb <= l for rb in rebind_lines)
+            ]
+            if not later_reads:
+                continue  # no line-order finding to repair here
+            parent = m.parents.get(call)
+            target = None
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                target = parent.targets[0]
+            elif isinstance(parent, ast.AnnAssign):
+                target = parent.target
+            if not isinstance(target, ast.Name):
+                yield (
+                    "skip",
+                    call,
+                    key,
+                    "donating call's result is not bound to a single "
+                    "name — rebind from the result by hand",
+                )
+                continue
+            if "." in key:
+                yield (
+                    "skip",
+                    call,
+                    key,
+                    "donated binding is an attribute — rewrite reads to "
+                    f"{target.id!r} by hand",
+                )
+                continue
+            result = target.id
+            result_rebinds = sorted(scan.rebinds.get(result, []))
+            reads = [
+                n
+                for (l, n) in later_reads
+                if isinstance(n, ast.Name)
+                and not any(line < rb <= l for rb in result_rebinds)
+            ]
+            if reads:
+                yield ("fix", call, key, result, reads)
+
+
 def _asarray_snapshots(m: ParsedModule) -> List[Finding]:
     return [
         _finding(
@@ -363,82 +815,62 @@ def _asarray_snapshots(m: ParsedModule) -> List[Finding]:
 def run_project(modules, cg) -> List[Finding]:
     """GL-D005: forwarding a binding into a helper that donates it.
 
-    ``cg`` is the run's ``analysis.callgraph.CallGraph``; the per-
-    module ``run`` below stays unchanged — this pass only adds the
-    interprocedural donation fact, then reuses the same read/rebind
-    reasoning GL-D001 applies to direct donating calls."""
-    import ast as _ast
-
+    ``cg`` is the run's ``analysis.callgraph.CallGraph``.  The taint
+    sources are the resolved forwarding call sites (an argument flows
+    into a callee parameter that reaches a donated jit position) plus
+    helper RESULTS that alias a donated argument (the callee returns a
+    donated parameter — ``FunctionSummary.returns_donated``); the read
+    analysis is the same flow-sensitive alias+taint engine GL-D001
+    runs, so expression propagation and conditional rebinds behave
+    identically across both rules."""
     out: List[Finding] = []
+    silent = set(cg.donating)
     for summ in cg.functions.values():
         forwarded = cg.forwarded_donations(summ)
         if not forwarded:
             continue
         m = summ.module
         fi = summ.info
-        scan = _FnScan(m, {})
-        for stmt in fi.node.body:
-            scan.visit(stmt)
+        site_taints: Dict[int, tuple] = {}
+        returning: Set[int] = set()
         for site, callee, hits in forwarded:
-            # x = helper(x): rebound by the forwarding statement itself
-            rebound_same_stmt: set = set()
-            parent = m.parents.get(site.node)
-            if isinstance(parent, (_ast.Assign, _ast.AnnAssign)):
-                targets = (
-                    parent.targets
-                    if isinstance(parent, _ast.Assign)
-                    else [parent.target]
+            site_taints[id(site.node)] = (callee.fq, sorted(hits.items()))
+            if callee.returns_donated:
+                returning.add(id(site.node))
+
+        def _report(line, key, info):
+            dline, origin, via = info
+            callee_fq, callee_param = via if via else ("<helper>", "?")
+            alias = (
+                ""
+                if key == origin
+                else f" (aliasing {origin!r} through an expression)"
+            )
+            out.append(
+                _finding(
+                    m,
+                    "GL-D005",
+                    "error",
+                    line,
+                    fi.qualname,
+                    f"read of {key!r}{alias} after it was forwarded into "
+                    f"a donating jit through {callee_fq}() on line "
+                    f"{dline} — parameter {callee_param!r} of the helper "
+                    "flows to a donated argument position, so the buffer "
+                    "may already be reused; rebind from the call's result "
+                    "or copy to host before forwarding",
                 )
+            )
 
-                def _flat(t):
-                    if isinstance(t, (_ast.Tuple, _ast.List)):
-                        for e in t.elts:
-                            _flat(e)
-                    elif isinstance(t, _ast.Starred):
-                        _flat(t.value)
-                    else:
-                        k = _binding_key(t)
-                        if k is not None:
-                            rebound_same_stmt.add(k)
-
-                for t in targets:
-                    _flat(t)
-            reported: set = set()
-            for callee_param, arg in hits.items():
-                key = _binding_key(arg)
-                if key is None or key in rebound_same_stmt:
-                    continue
-                if key in reported:
-                    continue
-                rebind_lines = sorted(scan.rebinds.get(key, []))
-                later_reads = [
-                    (l, n)
-                    for (l, n) in scan.reads.get(key, [])
-                    if l > site.line
-                ]
-                for read_line, _n in later_reads:
-                    if any(
-                        site.line < rb <= read_line for rb in rebind_lines
-                    ):
-                        continue
-                    reported.add(key)
-                    out.append(
-                        _finding(
-                            m,
-                            "GL-D005",
-                            "error",
-                            read_line,
-                            fi.qualname,
-                            f"read of {key!r} after it was forwarded into "
-                            f"a donating jit through {callee.fq}() on line "
-                            f"{site.line} — parameter {callee_param!r} of "
-                            "the helper flows to a donated argument "
-                            "position, so the buffer may already be "
-                            "reused; rebind from the call's result or "
-                            "copy to host before forwarding",
-                        )
-                    )
-                    break  # one report per forwarding event is enough
+        engine = _TaintEngine(
+            m,
+            donating={},
+            site_taints=site_taints,
+            returning=returning,
+            silent_bindings=silent,
+            report=_report,
+        )
+        engine.run(fi.node)
     return out
 
 
@@ -454,8 +886,6 @@ def run(m: ParsedModule) -> List[Finding]:
         scan = _FnScan(m, donating)
         for stmt in node.body:
             scan.visit(stmt)
-        if not scan.donate_events and not scan.alias_findings:
-            continue
         for call, key in scan.alias_findings:
             out.append(
                 _finding(
@@ -469,10 +899,8 @@ def run(m: ParsedModule) -> List[Finding]:
                     "may reuse the buffer the other position still reads",
                 )
             )
-        for line, key, call, rebound_same_stmt in scan.donate_events:
-            rebind_lines = sorted(scan.rebinds.get(key, []))
-            sink_hits = scan.sink_refs.get(key, [])
-            for sink_line, sink_name in sink_hits:
+        for line, key, call, _rebound in scan.donate_events:
+            for sink_line, sink_name in scan.sink_refs.get(key, []):
                 out.append(
                     _finding(
                         m,
@@ -488,30 +916,30 @@ def run(m: ParsedModule) -> List[Finding]:
                         "np.array)",
                     )
                 )
-            if rebound_same_stmt:
-                continue  # out = f(x); x rebound by the same statement
-            later_reads = [
-                (l, n)
-                for (l, n) in scan.reads.get(key, [])
-                if l > line
-            ]
-            for read_line, _n in later_reads:
-                # a rebind strictly after the call and at-or-before the
-                # read makes the read safe
-                if any(line < rb <= read_line for rb in rebind_lines):
-                    continue
-                out.append(
-                    _finding(
-                        m,
-                        "GL-D001",
-                        "error",
-                        read_line,
-                        fi.qualname,
-                        f"read of {key!r} after it was donated to a jitted "
-                        f"call on line {line} with no rebind in between — "
-                        "the buffer may already be reused; rebind from the "
-                        "call's result or copy to host before the call",
-                    )
+        if not scan.donate_events:
+            continue
+
+        def _report(line, key, info, _fi=fi):
+            dline, origin, _via = info
+            alias = (
+                ""
+                if key == origin
+                else f" (aliasing {origin!r} through an expression)"
+            )
+            out.append(
+                _finding(
+                    m,
+                    "GL-D001",
+                    "error",
+                    line,
+                    _fi.qualname,
+                    f"read of {key!r}{alias} after it was donated to a "
+                    f"jitted call on line {dline} with no rebind on this "
+                    "path — the buffer may already be reused; rebind from "
+                    "the call's result or copy to host before the call",
                 )
-                break  # one report per donation event is enough
+            )
+
+        engine = _TaintEngine(m, donating, report=_report)
+        engine.run(node)
     return out

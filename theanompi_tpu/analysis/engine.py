@@ -26,6 +26,7 @@ from theanompi_tpu.analysis import (
     collectives,
     donation,
     locks,
+    protocol,
     recompile,
     step_trace,
     threadstate,
@@ -35,7 +36,7 @@ from theanompi_tpu.analysis.source import ParsedModule, parse_module
 
 BASELINE_NAME = ".graftlint_baseline.json"
 
-_PER_MODULE_PASSES = (recompile, donation, collectives, threadstate)
+_PER_MODULE_PASSES = (recompile, donation, collectives)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\-\s]+))?"
@@ -115,19 +116,62 @@ def analyze(
     ``data`` so the deliberately-bad fixture corpus under
     ``tests/data/analysis/`` can't poison the gate."""
     modules, skipped, root = parse_targets(paths, root, exclude_dirs)
-    findings: List[Finding] = []
-    by_rel = {m.rel: m for m in modules}
-    for m in modules:
-        for p in _PER_MODULE_PASSES:
-            findings.extend(p.run(m))
-    findings.extend(locks.run_project(modules))
-    # interprocedural layer: one call graph per run feeds both the
-    # cross-module donation rule (GL-D005) and the whole-step
-    # collective trace rule (GL-C004)
-    cg = callgraph.build(modules)
-    findings.extend(donation.run_project(modules, cg))
-    findings.extend(step_trace.run_project(modules, cg))
+    findings, _traces, _timings = _analyze_modules(modules)
+    return findings, skipped
 
+
+def _analyze_modules(
+    modules: List[ParsedModule], with_traces: bool = False
+) -> Tuple[List[Finding], Optional[Dict[str, tuple]], List[Tuple[str, float]]]:
+    """The pass pipeline over already-parsed modules: (findings,
+    step-traces-or-None, per-pass timings).  One call graph serves the
+    interprocedural rules AND the step-trace artifact, so the
+    ``--artifact`` run parses and resolves everything exactly once."""
+    import time as _time
+
+    findings: List[Finding] = []
+    timings: List[Tuple[str, float]] = []
+
+    def timed(name, fn):
+        t0 = _time.perf_counter()
+        out = fn()
+        timings.append((name, _time.perf_counter() - t0))
+        return out
+
+    for p in _PER_MODULE_PASSES:
+        timed(
+            p.__name__.rsplit(".", 1)[-1],
+            lambda p=p: findings.extend(
+                f for m in modules for f in p.run(m)
+            ),
+        )
+    timed("lockorder", lambda: findings.extend(locks.run_project(modules)))
+    # project passes that need cross-module facts: base-class chains
+    # (GL-T), the transport/membership protocol surface (GL-P)
+    timed(
+        "threadstate",
+        lambda: findings.extend(threadstate.run_project(modules)),
+    )
+    timed("protocol", lambda: findings.extend(protocol.run_project(modules)))
+    # interprocedural layer: one call graph per run feeds the
+    # cross-module donation rule (GL-D005), the whole-step collective
+    # trace rule (GL-C004), and the per-strategy trace artifact
+    cg = timed("callgraph", lambda: callgraph.build(modules))
+    timed(
+        "donation-interproc",
+        lambda: findings.extend(donation.run_project(modules, cg)),
+    )
+    timed(
+        "steptrace",
+        lambda: findings.extend(step_trace.run_project(modules, cg)),
+    )
+    traces: Optional[Dict[str, tuple]] = None
+    if with_traces:
+        traces = timed(
+            "step-traces", lambda: step_trace.step_traces(modules, cg)
+        )
+
+    by_rel = {m.rel: m for m in modules}
     kept: List[Finding] = []
     for f in findings:
         m = by_rel.get(f.file)
@@ -137,7 +181,7 @@ def analyze(
                 continue
         kept.append(f)
     kept.sort(key=sort_key)
-    return kept, skipped
+    return kept, traces, timings
 
 
 def parse_targets(
@@ -173,6 +217,195 @@ def step_trace_report(
     modules, _skipped, _root = parse_targets(paths, root, exclude_dirs)
     cg = callgraph.build(modules)
     return step_trace.step_traces(modules, cg)
+
+
+# ---------------------------------------------------------------------------
+# the CI lint artifact + the mtime+hash incremental cache
+# ---------------------------------------------------------------------------
+
+ARTIFACT_NAME = ".graftlint_artifact.json"
+CACHE_NAME = ".graftlint_cache.json"
+CACHE_SCHEMA = 1
+
+
+def artifact_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), ARTIFACT_NAME)
+
+
+def build_artifact(
+    findings: Sequence[Finding],
+    traces: Dict[str, tuple],
+    skipped: Sequence[str],
+) -> Dict:
+    """The stable, sorted, diffable lint state: every (post-
+    suppression) finding plus the per-strategy whole-step collective
+    traces.  Deterministic by construction — sorted findings, sorted
+    trace keys, no timestamps — so two runs over identical sources are
+    byte-identical and ``scripts/graftlint_diff.py`` can treat any
+    difference as a reviewable drift."""
+    return {
+        "tool": "graftlint",
+        "artifact_version": 1,
+        "note": (
+            "Committed CI lint artifact: findings + per-strategy step "
+            "traces. Regenerate with: python -m theanompi_tpu.analysis "
+            f"--artifact {ARTIFACT_NAME}  (scripts/graftlint_diff.py "
+            "gates tier-1 on it)"
+        ),
+        "findings": [f.to_json() for f in sorted(findings, key=sort_key)],
+        "step_traces": {ep: list(tr) for ep, tr in sorted(traces.items())},
+        "unparseable_files": sorted(skipped),
+    }
+
+
+def write_artifact(doc: Dict, path: Optional[str] = None) -> str:
+    path = path or artifact_path()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_artifact(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("tool") != "graftlint":
+        raise ValueError(f"{path} is not a graftlint artifact")
+    return doc
+
+
+def _finding_from_json(d: Dict) -> Finding:
+    return Finding(
+        rule=d["rule"],
+        pass_id=d["pass"],
+        severity=d["severity"],
+        file=d["file"],
+        line=int(d["line"]),
+        symbol=d["symbol"],
+        message=d["message"],
+        snippet=d.get("snippet", ""),
+    )
+
+
+def _file_states(
+    files: Sequence[str], root: str, prev: Dict[str, dict]
+) -> Dict[str, dict]:
+    """Per-file (mtime_ns, size, sha1).  The sha1 is recomputed only
+    when mtime or size moved — the warm path is pure ``stat``."""
+    import hashlib
+
+    out: Dict[str, dict] = {}
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            stat = os.stat(path)
+        except OSError:
+            continue
+        entry = prev.get(rel)
+        if (
+            entry is not None
+            and entry.get("mtime_ns") == stat.st_mtime_ns
+            and entry.get("size") == stat.st_size
+        ):
+            out[rel] = entry
+            continue
+        try:
+            with open(path, "rb") as f:
+                digest = hashlib.sha1(f.read()).hexdigest()
+        except OSError:
+            continue
+        out[rel] = {
+            "mtime_ns": stat.st_mtime_ns,
+            "size": stat.st_size,
+            "sha1": digest,
+        }
+    return out
+
+
+def _cache_key(states: Dict[str, dict]) -> str:
+    import hashlib
+
+    blob = json.dumps(
+        {rel: s["sha1"] for rel, s in sorted(states.items())},
+        sort_keys=True,
+    )
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def full_run(
+    root: Optional[str] = None,
+    use_cache: bool = True,
+) -> Tuple[List[Finding], List[str], Dict[str, tuple], bool]:
+    """The default-target analyze + step traces, memoized by file
+    content.  Returns (findings, skipped, step_traces, cache_hit).
+
+    The cache key hashes every analyzed file — INCLUDING the analysis
+    package itself, which lives inside the default target set — so
+    editing a pass invalidates it naturally; a warm run is a stat
+    sweep plus one JSON load, which is what lets the tier-1 LINT leg
+    run the full-repo gate on every invocation without eating the
+    suite budget."""
+    root = root or repo_root()
+    files = _iter_py_files(default_targets(root))
+    cache_file = os.path.join(root, CACHE_NAME)
+    prev: Dict = {}
+    if use_cache and os.path.exists(cache_file):
+        try:
+            with open(cache_file, "r", encoding="utf-8") as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = {}
+    if prev.get("schema") != CACHE_SCHEMA:
+        prev = {}
+    states = _file_states(files, root, prev.get("files", {}))
+    key = _cache_key(states)
+    if use_cache and prev.get("key") == key:
+        findings = [_finding_from_json(d) for d in prev.get("findings", [])]
+        traces = {
+            ep: tuple(tr)
+            for ep, tr in prev.get("step_traces", {}).items()
+        }
+        return findings, list(prev.get("unparseable_files", [])), traces, True
+
+    modules, skipped, root = parse_targets(None, root)
+    findings, traces, _timings = _analyze_modules(modules, with_traces=True)
+    traces = traces or {}
+    if use_cache:
+        doc = {
+            "schema": CACHE_SCHEMA,
+            "key": key,
+            "files": states,
+            "findings": [f.to_json() for f in findings],
+            "step_traces": {ep: list(tr) for ep, tr in traces.items()},
+            "unparseable_files": list(skipped),
+        }
+        try:
+            with open(cache_file, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+        except OSError:
+            pass  # a read-only checkout still lints, just never warm
+    return findings, skipped, traces, False
+
+
+def current_artifact(
+    root: Optional[str] = None, use_cache: bool = True
+) -> Dict:
+    """The artifact document for the CURRENT tree (cache-backed) —
+    what ``graftlint_diff`` compares against the committed one."""
+    findings, skipped, traces, _hit = full_run(root, use_cache=use_cache)
+    return build_artifact(findings, traces, skipped)
+
+
+def bench_passes(root: Optional[str] = None) -> List[Tuple[str, float]]:
+    """Per-pass wall time over the default target set (plus parse),
+    for ``python -m theanompi_tpu.analysis --bench``."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    modules, _skipped, root = parse_targets(None, root)
+    parse_s = _time.perf_counter() - t0
+    _findings, _traces, timings = _analyze_modules(modules, with_traces=True)
+    return [("parse", parse_s)] + timings
 
 
 # ---------------------------------------------------------------------------

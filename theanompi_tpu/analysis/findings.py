@@ -9,7 +9,8 @@ which is stable until the flagged code itself changes.
 
 Pass ids: ``recompile`` | ``donation`` | ``collectives`` |
 ``lockorder`` | ``steptrace`` (the interprocedural whole-step pass) |
-``threadstate`` (GL-T*, unlocked shared-dict mutation).
+``threadstate`` (GL-T*, unlocked shared-dict mutation) |
+``protocol`` (GL-P*, distributed-protocol misuse).
 ``FIXABLE_RULES`` names the rules the ``--fix`` rewriter
 (``analysis/fixer.py``) can repair mechanically; ``Finding.fixable``
 surfaces that in both expositions so a human (or CI annotate step)
@@ -24,8 +25,11 @@ from typing import Any, Dict
 
 SEVERITIES = ("error", "warning")
 
-# kept in sync with analysis/fixer.py (the fixer imports this)
-FIXABLE_RULES = frozenset({"GL-D004", "GL-J002"})
+# kept in sync with analysis/fixer.py (the fixer imports this).
+# GL-D001's fixable shape is the rebind-from-result pattern
+# (`new = train_fn(params, ...)` with later bare-name reads of
+# `params`); other GL-D001 shapes are skipped with a note.
+FIXABLE_RULES = frozenset({"GL-D001", "GL-D004", "GL-J002"})
 
 
 @dataclass(frozen=True)

@@ -41,7 +41,10 @@ import os
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
-from theanompi_tpu.analysis.donation import iter_asarray_snapshot_sites
+from theanompi_tpu.analysis.donation import (
+    iter_asarray_snapshot_sites,
+    iter_d001_fix_sites,
+)
 from theanompi_tpu.analysis.recompile import iter_unhashable_static_sites
 from theanompi_tpu.analysis.source import (
     ParsedModule,
@@ -49,7 +52,7 @@ from theanompi_tpu.analysis.source import (
     parse_source,
 )
 
-FIXABLE_RULES = ("GL-D004", "GL-J002")
+FIXABLE_RULES = ("GL-D001", "GL-D004", "GL-J002")
 
 
 @dataclass(frozen=True)
@@ -231,11 +234,47 @@ def _plan_j002(m: ParsedModule, starts) -> Tuple[List[Fix], List[Skip]]:
     return fixes, skips
 
 
+def _plan_d001(m: ParsedModule, starts) -> Tuple[List[Fix], List[Skip]]:
+    """Rebind-from-result repair: rewrite each later bare-name read of
+    the donated binding to the result name the donating call was
+    assigned to — the exact sanctioned pattern GL-D001's message asks
+    for.  Detection is shared with the donation pass
+    (``iter_d001_fix_sites``)."""
+    fixes: List[Fix] = []
+    skips: List[Skip] = []
+    for entry in iter_d001_fix_sites(m):
+        if entry[0] == "skip":
+            _tag, call, _key, reason = entry
+            skips.append(Skip("GL-D001", call.lineno, reason))
+            continue
+        _tag, call, key, result, reads = entry
+        for read in reads:
+            sp = _span(starts, read)
+            if sp is None:
+                skips.append(Skip("GL-D001", read.lineno, "no span info"))
+                continue
+            fixes.append(
+                Fix(
+                    rule="GL-D001",
+                    line=read.lineno,
+                    start=sp[0],
+                    end=sp[1],
+                    replacement=result,
+                    note=(
+                        f"read of donated {key!r} -> rebound result "
+                        f"{result!r}"
+                    ),
+                )
+            )
+    return fixes, skips
+
+
 def plan_fixes(m: ParsedModule) -> Tuple[List[Fix], List[Skip]]:
     starts = _line_starts(m.source)
     f1, s1 = _plan_d004(m, starts)
     f2, s2 = _plan_j002(m, starts)
-    return sorted(f1 + f2, key=lambda f: f.start), s1 + s2
+    f3, s3 = _plan_d001(m, starts)
+    return sorted(f1 + f2 + f3, key=lambda f: f.start), s1 + s2 + s3
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,466 @@
+"""Pass 7 — distributed-protocol misuse (GL-P*).
+
+The repo is a distributed system now: a fleet router speaking
+``transport.request()``, elastic-BSP resize consensus, rosters with
+generation numbers, token journals replayed across replicas.  The
+hazards on that surface are exactly the ones the paper's era debugged
+by hand (issue-order divergence and protocol misuse across ranks,
+arXiv:1605.08325) — and none of them needs hardware to detect:
+
+- **GL-P001 ``unbounded-request``** (warning): a
+  ``transport.request()`` issued from a loop or a thread-target
+  function with NEITHER a per-call ``deadline_s`` NOR a per-op
+  ``timeout`` and no enclosing bounded-retry helper
+  (``retry_with_backoff``).  The default socket timeout is 600s and
+  the connect ladder multiplies it — in a pump loop or heartbeat
+  thread that is a silent stall, not an error.  One-shot calls on
+  shutdown paths (the ``done`` farewell) are out of scope: a single
+  bounded-by-default call cannot wedge a loop.
+- **GL-P002 ``blocking-rpc-under-shared-lock``** (error): a blocking
+  ``request()``/``.recv()`` issued while LEXICALLY holding a
+  ``threading.Lock``/``RLock`` that the package's lock population
+  shows acquired in more than one function — the distributed-deadlock
+  shape: the reply can only be produced by a thread that needs the
+  lock you are holding.  Condition/semaphore waits are the *designed*
+  blocking-under-lock pattern and are excluded.
+- **GL-P003 ``generation-unchecked-mutation``** (error): a class that
+  guards SOME mutation of a per-member dict with a generation
+  comparison (an enclosing ``if`` whose test compares a ``gen``/
+  ``generation``-named value) declares that dict generation-
+  disciplined; another method mutating the same dict with no
+  generation comparison anywhere in its body applies a stale
+  incarnation's update — the torn-rejoin hazard the membership layer
+  re-keys generations to prevent.  ``__init__`` is exempt.
+- **GL-P004 ``readmission-rekey-drop``** (error): building a
+  re-admission/replay request whose prompt is ``original + accepted``
+  (a ``prompt`` entry holding a concatenation) WITHOUT re-keying
+  ``token_index0``.  Sampled streams draw with per-index keys
+  (``request_key(seed, id, token_index0 + i)``); dropping the re-key
+  silently replays the journal with index-0 keys and the "token-
+  identical failover" contract breaks only for sampled requests,
+  only after a kill — the worst kind of bug to find at runtime.
+
+Like every pass: syntactic, package-local, prefer missing a hazard
+over inventing one, suppressible with ``# graftlint: disable=GL-PXXX``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from theanompi_tpu.analysis import locks as _locks
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import (
+    LOCK_FACTORIES,
+    ParsedModule,
+    attr_path,
+    terminal_name,
+)
+
+PASS_ID = "protocol"
+
+# helpers that bound their callable's retries — a request wrapped in
+# one has a budget even without its own deadline_s
+_RETRY_WRAPPERS = {"retry_with_backoff"}
+
+# names that identify a generation-number value in a comparison
+_GEN_MARKERS = ("generation", "gen")
+
+
+def _finding(m, rule, sev, node, symbol, msg) -> Finding:
+    return Finding(
+        rule=rule,
+        pass_id=PASS_ID,
+        severity=sev,
+        file=m.rel,
+        line=node.lineno,
+        symbol=symbol,
+        message=msg,
+        snippet=m.snippet(node.lineno),
+    )
+
+
+# ---------------------------------------------------------------------------
+# transport.request() identification
+# ---------------------------------------------------------------------------
+
+def _is_transport_request(m: ParsedModule, call: ast.Call) -> bool:
+    """True when the call provably targets the transport's request():
+    ``transport.request(...)`` / ``request(...)`` where the name was
+    imported from a module whose dotted path contains ``transport``.
+    A local def named ``request`` shadows the import and is skipped."""
+    resolved = m.imports.resolve(call.func)
+    if resolved is not None:
+        return resolved.endswith(".request") and "transport" in resolved
+    return False
+
+
+def _kw_names(call: ast.Call) -> Set[str]:
+    return {k.arg for k in call.keywords if k.arg is not None}
+
+
+def _thread_target_names(m: ParsedModule) -> Set[str]:
+    """Terminal names handed to ``threading.Thread(target=...)`` or an
+    executor ``submit(fn, ...)`` anywhere in the module — functions
+    that run on their own schedule, where an unbounded block is a
+    stalled thread nobody joins."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    t = terminal_name(kw.value)
+                    if t:
+                        out.add(t)
+        elif name == "submit" and node.args:
+            t = terminal_name(node.args[0])
+            if t:
+                out.add(t)
+    return out
+
+
+def _inside_retry_wrapper(m: ParsedModule, node: ast.AST) -> bool:
+    """Is the call's enclosing lambda/def passed to a bounded-retry
+    helper?  Covers the house idiom
+    ``retry_with_backoff(lambda: request(...), attempts=...)``."""
+    cur = m.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            if terminal_name(cur.func) in _RETRY_WRAPPERS:
+                return True
+        cur = m.parents.get(cur)
+    return False
+
+
+def _p001(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    thread_targets = _thread_target_names(m)
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_transport_request(m, node):
+            continue
+        kws = _kw_names(node)
+        if "deadline_s" in kws or "timeout" in kws:
+            continue
+        if _inside_retry_wrapper(m, node):
+            continue
+        fi = m.enclosing_function(node)
+        in_thread = False
+        walk_fi = fi
+        while walk_fi is not None:
+            name = walk_fi.qualname.rsplit(".", 1)[-1]
+            if name in thread_targets:
+                in_thread = True
+                break
+            walk_fi = walk_fi.parent
+        if not (m.in_loop(node) or in_thread):
+            continue
+        where = "a loop" if m.in_loop(node) else "a thread-target function"
+        out.append(
+            _finding(
+                m,
+                "GL-P001",
+                "warning",
+                node,
+                m.symbol_for(node),
+                f"transport.request() issued from {where} with neither "
+                "deadline_s nor a per-op timeout and no bounded-retry "
+                "wrapper — the 600s default timeout times the connect "
+                "ladder can wedge this path for minutes past any SLO; "
+                "pass deadline_s (spans the whole retry ladder) or at "
+                "least timeout",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL-P002: blocking rpc while holding a shared lock
+# ---------------------------------------------------------------------------
+
+_BLOCKING_TERMINALS = {"request", "recv"}
+
+
+def _p002(modules: Sequence[ParsedModule]) -> List[Finding]:
+    defs = _locks._collect_locks(modules)
+    if not defs:
+        return []
+    resolver = _locks._Resolver(defs)
+    plain = {
+        d.lock_id for d in defs if d.kind in ("lock", "rlock")
+    }
+    # a lock acquired (with-stmt) in 2+ distinct functions is SHARED —
+    # some other thread can be queued on it while we hold it
+    holders: Dict[str, Set[str]] = {}
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            fi = m.enclosing_function(node)
+            for item in node.items:
+                d = resolver.resolve(m, item.context_expr, fi)
+                if d is not None and d.lock_id in plain:
+                    holders.setdefault(d.lock_id, set()).add(
+                        f"{m.rel}:{fi.qualname if fi else '<module>'}"
+                    )
+    shared = {lid for lid, fns in holders.items() if len(fns) >= 2}
+    if not shared:
+        return []
+    out: List[Finding] = []
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name not in _BLOCKING_TERMINALS:
+                continue
+            is_rpc = _is_transport_request(m, node) or (
+                name == "recv" and isinstance(node.func, ast.Attribute)
+            )
+            if not is_rpc:
+                continue
+            fi = m.enclosing_function(node)
+            held: Optional[str] = None
+            cur = m.parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    for item in cur.items:
+                        d = resolver.resolve(m, item.context_expr, fi)
+                        if d is not None and d.lock_id in shared:
+                            held = d.lock_id
+                            break
+                if held:
+                    break
+                cur = m.parents.get(cur)
+            if not held:
+                continue
+            out.append(
+                _finding(
+                    m,
+                    "GL-P002",
+                    "error",
+                    node,
+                    m.symbol_for(node),
+                    f"blocking {name}() issued while holding shared lock "
+                    f"{held!r} (acquired in "
+                    f"{len(holders.get(held, ()))} functions) — if the "
+                    "peer's reply needs any thread that is queued on this "
+                    "lock, both sides wait forever: the distributed-"
+                    "deadlock shape.  Copy what you need under the lock, "
+                    "release it, then block",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL-P003: per-member state mutated outside a generation check
+# ---------------------------------------------------------------------------
+
+def _mentions_gen(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            name = terminal_name(sub)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            name = sub.value
+        if name is None:
+            continue
+        low = name.lower()
+        if any(
+            low == g or low.startswith(g + "_") or low.endswith("_" + g)
+            or g == "generation" and "generation" in low
+            for g in _GEN_MARKERS
+        ):
+            return True
+    return False
+
+
+def _is_gen_test(test: ast.expr) -> bool:
+    """A comparison whose either side names a generation value —
+    ``msg["gen"] != self.gen``, ``generation < self._gen`` — not a
+    mere membership test that happens to live near one."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare):
+            sides = [sub.left] + list(sub.comparators)
+            if any(_mentions_gen(s) for s in sides):
+                return True
+    return False
+
+
+def _self_dict_mutations(cls: ast.ClassDef):
+    """(attr, node) for every ``self.<attr>[...] = / del / .pop()``
+    style mutation in the class body — the same dict-mutator set the
+    threadstate pass watches."""
+    muts = []
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript) and (
+                    isinstance(t.value, ast.Attribute)
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"
+                ):
+                    muts.append((t.value.attr, node))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and (
+                    isinstance(t.value, ast.Attribute)
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"
+                ):
+                    muts.append((t.value.attr, node))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("pop", "update", "setdefault")
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+            ):
+                muts.append((f.value.attr, node))
+    return muts
+
+
+def _under_gen_check(m: ParsedModule, node: ast.AST,
+                     cls: ast.ClassDef) -> bool:
+    cur = m.parents.get(node)
+    while cur is not None and cur is not cls:
+        if isinstance(cur, (ast.If, ast.While)) and _is_gen_test(cur.test):
+            return True
+        cur = m.parents.get(cur)
+    return False
+
+
+def _fn_has_gen_compare(m: ParsedModule, node: ast.AST) -> bool:
+    fi = m.enclosing_function(node)
+    if fi is None:
+        return False
+    return any(
+        isinstance(sub, ast.Compare) and _is_gen_test(sub)
+        for sub in ast.walk(fi.node)
+    )
+
+
+def _p003(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(m.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        muts = _self_dict_mutations(cls)
+        disciplined: Set[str] = {
+            attr for attr, node in muts if _under_gen_check(m, node, cls)
+        }
+        if not disciplined:
+            continue
+        for attr, node in muts:
+            if attr not in disciplined:
+                continue
+            if _under_gen_check(m, node, cls):
+                continue
+            if _fn_has_gen_compare(m, node):
+                continue  # guard-clause form: if gen != ...: return
+            fi = m.enclosing_function(node)
+            name = (
+                fi.qualname.rsplit(".", 1)[-1] if fi is not None else ""
+            )
+            if name == "__init__":
+                continue
+            out.append(
+                _finding(
+                    m,
+                    "GL-P003",
+                    "error",
+                    node,
+                    m.symbol_for(node),
+                    f"per-member state 'self.{attr}' mutated with no "
+                    f"generation check: other methods of {cls.name} gate "
+                    "their mutations on a gen/generation comparison, so "
+                    "this path can apply a stale incarnation's update "
+                    "after an evict/rejoin bumped the generation — check "
+                    "the message's generation against the member's before "
+                    "mutating",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL-P004: readmission spec without the token_index0 re-key
+# ---------------------------------------------------------------------------
+
+def _is_concat(expr: ast.expr) -> bool:
+    return isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add)
+
+
+def _p004(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(m.tree):
+        keys: Dict[str, ast.expr] = {}
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys[k.value] = v
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    keys[kw.arg] = kw.value
+        else:
+            continue
+        if "prompt" not in keys or "max_new_tokens" not in keys:
+            continue
+        # the re-admission signature: the prompt replays a journal
+        # (original + accepted concatenation) AND the budget is the
+        # REMAINDER (a subtraction).  A fresh submission that merely
+        # concatenates prompt pieces has a plain budget and is skipped.
+        if not _is_concat(keys["prompt"]):
+            continue
+        budget = keys["max_new_tokens"]
+        if not (
+            isinstance(budget, ast.BinOp) and isinstance(budget.op, ast.Sub)
+        ):
+            continue
+        if "token_index0" in keys:
+            continue
+        out.append(
+            _finding(
+                m,
+                "GL-P004",
+                "error",
+                node,
+                m.symbol_for(node),
+                "re-admission spec replays 'prompt + accepted tokens' "
+                "but drops the token_index0 re-key — sampled streams "
+                "draw per-index keys (request_key(seed, id, "
+                "token_index0 + i)), so the replay re-rolls every "
+                "already-accepted pick and failover stops being token-"
+                "identical exactly when a replica dies; set token_index0 "
+                "to the accepted-journal length",
+            )
+        )
+    return out
+
+
+def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        out.extend(_p001(m))
+        out.extend(_p003(m))
+        out.extend(_p004(m))
+    out.extend(_p002(modules))
+    return out
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    """Single-module convenience wrapper."""
+    return run_project([m])

@@ -1,0 +1,112 @@
+"""SARIF 2.1.0 exposition of a graftlint run.
+
+SARIF is the interchange format every mainstream code-scanning UI
+ingests (GitHub code scanning, VS Code SARIF viewer, Azure DevOps), so
+``python -m theanompi_tpu.analysis --format sarif`` turns the lint into
+a first-class CI artifact without a bespoke annotate step: upload the
+document and findings render inline on the PR diff.
+
+The mapping is deliberately small: one ``run`` for the whole
+invocation, one ``result`` per finding, rule metadata derived from the
+passes themselves, and the graftlint fingerprint carried in
+``partialFingerprints`` so SARIF-side baselining matches the
+``.graftlint_baseline.json`` identity exactly.  Deterministic output
+(sorted findings, sorted rules, no timestamps) keeps it diffable like
+the ``--artifact`` JSON.  Pure stdlib, like the whole package.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from theanompi_tpu.analysis.findings import Finding, sort_key
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemas/sarif-schema-2.1.0.json"
+)
+
+# one-line rule summaries, keyed by prefix when a family shares one
+_RULE_HELP = {
+    "GL-J": "jit recompile hazard",
+    "GL-D": "buffer-donation safety",
+    "GL-C": "collective issue-order divergence",
+    "GL-L": "lock-order hazard",
+    "GL-T": "unlocked shared-state mutation",
+    "GL-P": "distributed-protocol misuse",
+}
+
+_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def _rule_help(rule: str) -> str:
+    return _RULE_HELP.get(rule[:4], "graftlint hazard")
+
+
+def to_sarif(findings: Sequence[Finding]) -> Dict:
+    """One SARIF document for the given findings (typically the NEW,
+    non-baselined set — the same population the exit code reflects)."""
+    ordered = sorted(findings, key=sort_key)
+    rules: List[Dict] = []
+    seen = set()
+    for f in ordered:
+        if f.rule in seen:
+            continue
+        seen.add(f.rule)
+        rules.append(
+            {
+                "id": f.rule,
+                "name": f.pass_id,
+                "shortDescription": {"text": _rule_help(f.rule)},
+                "defaultConfiguration": {
+                    "level": _LEVELS.get(f.severity, "warning")
+                },
+            }
+        )
+    rules.sort(key=lambda r: r["id"])
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": _LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.file,
+                            "uriBaseId": "REPOROOT",
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            "snippet": {"text": f.snippet},
+                        },
+                    },
+                    "logicalLocations": [
+                        {"fullyQualifiedName": f.symbol}
+                    ],
+                }
+            ],
+            "partialFingerprints": {
+                "graftlint/v1": f.fingerprint,
+            },
+        }
+        for f in ordered
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+                "columnKind": "utf16CodeUnits",
+            }
+        ],
+    }

@@ -14,19 +14,21 @@ The pass is deliberately narrow (near-zero false positives beats
 coverage here — this is a tier-1 gate):
 
 1. **Scope**: classes that own a lock — ``self.<lock> =
-   threading.Lock()/RLock()/Condition()`` in their own body
-   (``LOCK_FACTORIES``, same identification as the lockorder pass).
+   threading.Lock()/RLock()/Condition()`` in their own body OR
+   anywhere in their resolved base-class chain (``LOCK_FACTORIES``,
+   same identification as the lockorder pass).  Base classes resolve
+   **across modules** through ``callgraph.ClassTable`` (MRO over
+   imports) — the previously-documented narrow spot: a subclass of a
+   lock-owning base in another module now inherits the base's lock
+   AND its guarded-dict discipline.
 2. **Guarded attrs**: attribute names whose DICT mutations
    (``self.x[k] = v``, ``del self.x[k]``, ``self.x.pop/update/clear/
    setdefault/popitem(...)``) appear at least once lexically inside a
-   ``with self.<lock>`` block in any method of that class.  A dict the
-   class itself locks is declared shared by that act.
+   ``with self.<lock>`` block in any method of the class or its base
+   chain.  A dict the hierarchy locks is declared shared by that act.
 3. **Findings** (GL-T001, error): a dict mutation of a guarded attr
-   OUTSIDE any ``with self.<lock>``, in any method except
-   ``__init__`` (construction precedes sharing) and except methods
-   whose name ends in ``_locked`` (the codebase's documented
-   convention for helpers whose contract is "caller holds the lock" —
-   ``TcpMailbox._send_locked``).
+   OUTSIDE any ``with self.<lock>``, in any method of the class's own
+   body except ``__init__`` (construction precedes sharing).
 
 ISSUE 13 widened what counts as "inside the lock" (each previously a
 documented blind spot):
@@ -44,16 +46,32 @@ documented blind spot):
   mutations stop firing.  A helper with even ONE unlocked call site
   keeps firing: the AST cannot prove that caller holds the lock.
 
-Remaining blind spots (documented, not guessed at): locks inherited
-from a base class in another module, and helpers only ever called
-from OUTSIDE the class (no same-class call site proves anything).
+This PR closed two more:
+
+- **inherited locks** (above): the chain is linearized subclass-first
+  and locks/guarded-discipline union across it; findings still anchor
+  to the class whose own body holds the bare mutation, so a racy base
+  reports once (as itself), not once per subclass.
+- **``*_locked`` is a hint, not a free pass**: a ``*_locked``-suffixed
+  helper that ALSO has an unlocked same-class call site is demoted —
+  the suffix promised "caller holds the lock" and the call graph
+  disproved it, so its mutations fire like any other method's.  A
+  ``*_locked`` helper with no same-class call sites (public locked-API
+  surface, callers outside the class) keeps the conventional
+  exemption.
+
+Remaining blind spots (documented, not guessed at): helpers only ever
+called from OUTSIDE the class (no same-class call site proves
+anything), and which lock guards which dict when a hierarchy owns
+several (any of its locks satisfies the pass).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from theanompi_tpu.analysis.callgraph import ClassTable
 from theanompi_tpu.analysis.findings import Finding
 from theanompi_tpu.analysis.source import (
     LOCK_FACTORIES,
@@ -66,6 +84,9 @@ PASS_ID = "threadstate"
 # dict-shaped mutators: the pass is about shared STATE DICTS, so list
 # appends etc. stay out of scope (far noisier, far less iterator-fatal)
 _DICT_MUTATORS = {"pop", "update", "clear", "setdefault", "popitem"}
+
+# one chain element: (module, ClassDef) — all helpers below take these
+_ChainElem = Tuple[ParsedModule, ast.ClassDef]
 
 
 def _self_attr(expr: ast.expr) -> Optional[str]:
@@ -80,12 +101,15 @@ def _self_attr(expr: ast.expr) -> Optional[str]:
 
 
 class _Mutation:
-    __slots__ = ("attr", "node", "locked")
+    __slots__ = ("attr", "node", "locked", "module", "cls")
 
-    def __init__(self, attr: str, node: ast.AST, locked: bool):
+    def __init__(self, attr: str, node: ast.AST, locked: bool,
+                 module: ParsedModule, cls: ast.ClassDef):
         self.attr = attr
         self.node = node
         self.locked = locked
+        self.module = module
+        self.cls = cls
 
 
 def _class_lock_attrs(m: ParsedModule, cls: ast.ClassDef) -> Set[str]:
@@ -158,54 +182,62 @@ def _node_locked(m: ParsedModule, node: ast.AST, cls: ast.ClassDef,
     )
 
 
-def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.AST]:
-    return {
-        item.name: item
-        for item in cls.body
-        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+def _chain_methods(chain: Sequence[_ChainElem]) -> Dict[str, ast.AST]:
+    """Merged method table, subclass-first (an override shadows the
+    base's definition, exactly like runtime attribute lookup)."""
+    out: Dict[str, ast.AST] = {}
+    for _m, cls in chain:
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(item.name, item)
+    return out
+
+
+def _chain_call_sites(
+    chain: Sequence[_ChainElem], methods: Dict[str, ast.AST]
+) -> Dict[str, List[Tuple[ParsedModule, ast.ClassDef, ast.AST]]]:
+    """method name -> the ``self.<name>(...)`` Call nodes anywhere in
+    the chain's bodies — the edges lock inheritance flows along."""
+    sites: Dict[str, List[Tuple[ParsedModule, ast.ClassDef, ast.AST]]] = {
+        name: [] for name in methods
     }
-
-
-def _self_call_sites(cls: ast.ClassDef,
-                     methods: Dict[str, ast.AST]) -> Dict[str, list]:
-    """method name -> the Call nodes ``self.<name>(...)`` anywhere in
-    the class — the call-graph edges lock inheritance flows along."""
-    sites: Dict[str, list] = {name: [] for name in methods}
-    for node in ast.walk(cls):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id == "self"
-            and node.func.attr in sites
-        ):
-            sites[node.func.attr].append(node)
+    for m, cls in chain:
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr in sites
+            ):
+                sites[node.func.attr].append((m, cls, node))
     return sites
 
 
+def _site_ok(m: ParsedModule, cls: ast.ClassDef, site: ast.AST,
+             locks: Set[str], sanctioned: Set[str]) -> bool:
+    if _node_locked(m, site, cls, locks):
+        return True
+    fi = m.enclosing_function(site)
+    while fi is not None:
+        if fi.qualname.rsplit(".", 1)[-1] in sanctioned:
+            return True
+        fi = fi.parent
+    return False
+
+
 def _lock_inherited_methods(
-    m: ParsedModule, cls: ast.ClassDef, locks: Set[str],
+    chain: Sequence[_ChainElem], locks: Set[str],
     methods: Dict[str, ast.AST],
 ) -> Set[str]:
     """Methods whose EVERY same-class call site provably holds the
     lock — directly (with/acquire span) or transitively (the site
     lives in ``__init__``, a ``*_locked`` helper, or another inherited
     method); fixpoint until stable."""
-    sites = _self_call_sites(cls, methods)
+    sites = _chain_call_sites(chain, methods)
     exempt = {"__init__"} | {
         n for n in methods if n.endswith("_locked")
     }
-
-    def site_ok(site: ast.AST, sanctioned: Set[str]) -> bool:
-        if _node_locked(m, site, cls, locks):
-            return True
-        fi = m.enclosing_function(site)
-        while fi is not None:
-            if fi.qualname.rsplit(".", 1)[-1] in sanctioned:
-                return True
-            fi = fi.parent
-        return False
-
     inherited: Set[str] = set()
     changed = True
     while changed:
@@ -213,10 +245,41 @@ def _lock_inherited_methods(
         for name, calls in sites.items():
             if name in exempt or name in inherited or not calls:
                 continue
-            if all(site_ok(c, exempt | inherited) for c in calls):
+            if all(
+                _site_ok(m, cls, c, locks, exempt | inherited)
+                for m, cls, c in calls
+            ):
                 inherited.add(name)
                 changed = True
     return inherited
+
+
+def _leaky_locked_helpers(
+    chain: Sequence[_ChainElem], locks: Set[str],
+    methods: Dict[str, ast.AST], inherited: Set[str],
+) -> Set[str]:
+    """``*_locked`` helpers the call graph DISPROVES: at least one
+    same-class call site reaches them without the lock.  The suffix is
+    a hint, not a free pass — a helper with no same-class call sites
+    keeps the conventional exemption (callers outside the class are
+    beyond what the AST can prove either way)."""
+    sites = _chain_call_sites(chain, methods)
+    sanctioned = {"__init__"} | inherited | {
+        n for n in methods if n.endswith("_locked")
+    }
+    leaky: Set[str] = set()
+    for name in methods:
+        if not name.endswith("_locked"):
+            continue
+        calls = sites.get(name, [])
+        if not calls:
+            continue
+        own = sanctioned - {name}  # a self-recursive site proves nothing new
+        if any(
+            not _site_ok(m, cls, c, locks, own) for m, cls, c in calls
+        ):
+            leaky.add(name)
+    return leaky
 
 
 def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
@@ -227,7 +290,7 @@ def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
         if attr is None:
             return
         out.append(
-            _Mutation(attr, node, _node_locked(m, node, cls, locks))
+            _Mutation(attr, node, _node_locked(m, node, cls, locks), m, cls)
         )
 
     for node in ast.walk(cls):
@@ -254,60 +317,85 @@ def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
 
 
 def _exempt(m: ParsedModule, node: ast.AST,
-            inherited: Set[str]) -> bool:
-    """__init__ (construction precedes sharing), *_locked helpers
-    (contract: caller holds the lock), and helpers whose every
-    same-class call site provably holds it (``inherited`` — the
-    call-graph widening)."""
+            inherited: Set[str], leaky: Set[str]) -> bool:
+    """__init__ (construction precedes sharing), *_locked helpers the
+    call graph has not disproven, and helpers whose every same-class
+    call site provably holds the lock (``inherited``)."""
     fi = m.enclosing_function(node)
     while fi is not None:
         name = fi.qualname.rsplit(".", 1)[-1]
-        if (name == "__init__" or name.endswith("_locked")
-                or name in inherited):
+        if name == "__init__" or name in inherited:
+            return True
+        if name.endswith("_locked") and name not in leaky:
             return True
         fi = fi.parent
     return False
 
 
-def run(m: ParsedModule) -> List[Finding]:
+def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
+    table = ClassTable(modules)
     findings: List[Finding] = []
-    for node in ast.walk(m.tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        locks = _class_lock_attrs(m, node)
-        if not locks:
-            continue
-        inherited = _lock_inherited_methods(
-            m, node, locks, _class_methods(node)
-        )
-        mutations = _iter_dict_mutations(m, node, locks)
-        guarded: Dict[str, bool] = {}
-        for mu in mutations:
-            if mu.locked:
-                guarded[mu.attr] = True
-        for mu in mutations:
-            if mu.locked or mu.attr not in guarded:
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ClassDef):
                 continue
-            if _exempt(m, mu.node, inherited):
+            chain = table.mro(m, node)
+            locks: Set[str] = set()
+            for cm, cc in chain:
+                locks |= _class_lock_attrs(cm, cc)
+            if not locks:
                 continue
-            findings.append(Finding(
-                rule="GL-T001",
-                pass_id=PASS_ID,
-                severity="error",
-                file=m.rel,
-                line=mu.node.lineno,
-                symbol=m.symbol_for(mu.node),
-                message=(
-                    f"unlocked mutation of shared state dict "
-                    f"'self.{mu.attr}': other methods of "
-                    f"{node.name} mutate it under "
-                    f"'with self.{sorted(locks)[0]}' (or a bare "
-                    "acquire/release span), so this bare mutation "
-                    "races them (dict-changed-during-iteration, lost "
-                    "entries).  Wrap it in the lock, call the helper "
-                    "only from under it, or rename it *_locked if the "
-                    "caller provably holds it"
-                ),
-                snippet=m.snippet(mu.node.lineno),
-            ))
+            methods = _chain_methods(chain)
+            inherited = _lock_inherited_methods(chain, locks, methods)
+            leaky = _leaky_locked_helpers(chain, locks, methods, inherited)
+            # guarded discipline unions over the chain; findings anchor
+            # to the class's OWN body (the base reports as itself)
+            guarded: Set[str] = set()
+            chain_mutations: List[_Mutation] = []
+            for cm, cc in chain:
+                for mu in _iter_dict_mutations(cm, cc, locks):
+                    chain_mutations.append(mu)
+                    if mu.locked:
+                        guarded.add(mu.attr)
+            inherited_from = ", ".join(
+                f"{cm.rel}:{cc.name}" for cm, cc in chain[1:]
+            )
+            for mu in chain_mutations:
+                if mu.cls is not node:
+                    continue  # the base chain reports as itself
+                if mu.locked or mu.attr not in guarded:
+                    continue
+                if _exempt(mu.module, mu.node, inherited, leaky):
+                    continue
+                where = (
+                    f" (lock/discipline inherited from {inherited_from})"
+                    if chain[1:] and not _class_lock_attrs(m, node)
+                    else ""
+                )
+                findings.append(Finding(
+                    rule="GL-T001",
+                    pass_id=PASS_ID,
+                    severity="error",
+                    file=mu.module.rel,
+                    line=mu.node.lineno,
+                    symbol=mu.module.symbol_for(mu.node),
+                    message=(
+                        f"unlocked mutation of shared state dict "
+                        f"'self.{mu.attr}': other methods of "
+                        f"{node.name} mutate it under "
+                        f"'with self.{sorted(locks)[0]}' (or a bare "
+                        "acquire/release span), so this bare mutation "
+                        "races them (dict-changed-during-iteration, lost "
+                        "entries).  Wrap it in the lock, call the helper "
+                        "only from under it, or rename it *_locked if the "
+                        f"caller provably holds it{where}"
+                    ),
+                    snippet=mu.module.snippet(mu.node.lineno),
+                ))
     return findings
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    """Single-module convenience wrapper (the engine runs
+    ``run_project`` so base classes resolve across files)."""
+    return run_project([m])
