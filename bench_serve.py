@@ -168,6 +168,33 @@ _KNOBS_REHEARSAL = dict(
     fleet_failover_requests=4, fleet_failover_new_tokens=16,
 )
 
+# ---- closed-loop tuning contract (theanompi_tpu/tuning/trials.py) ---------
+# The trial harness injects one candidate config via THEANOMPI_TUNE_
+# OVERRIDES (JSON knob->value) and a workload seed via THEANOMPI_BENCH_
+# SEED; the bench applies what it understands, echoes the full map in
+# detail.tuning, and exits loudly on a knob it does not know.  All
+# seeded workload streams shift together with the trial seed; seed 0
+# reproduces the historical workloads bit-for-bit.
+TUNE_SEED = int(os.environ.get("THEANOMPI_BENCH_SEED", "0") or 0)
+_SEED_BASE = TUNE_SEED * 1000
+
+
+def _tune_overrides():
+    raw = os.environ.get("THEANOMPI_TUNE_OVERRIDES", "")
+    if not raw.strip():
+        return None
+    try:
+        overrides = json.loads(raw)
+    except ValueError as e:
+        print(f"[bench_serve] bad THEANOMPI_TUNE_OVERRIDES json: {e}",
+              file=sys.stderr)
+        sys.exit(2)
+    if not isinstance(overrides, dict):
+        print("[bench_serve] THEANOMPI_TUNE_OVERRIDES must be a JSON "
+              "object", file=sys.stderr)
+        sys.exit(2)
+    return overrides
+
 
 def _drive_open_loop(sched, Request, prompts, arrivals, max_new):
     """The open-loop Poisson drive: submit what has arrived, tick."""
@@ -252,7 +279,7 @@ def _spec_probe(knobs):
     draft = make_draft(model, n_layers=knobs["spec_draft_layers"])
     draft_engine = PagedServingEngine(draft, **geom)
 
-    rng = np.random.RandomState(2)
+    rng = np.random.RandomState(_SEED_BASE + 2)
     prompts = [
         rng.randint(
             0, knobs["spec_vocab"],
@@ -359,7 +386,7 @@ def _fleet_probe(model, knobs, n_replicas):
         block_size=bs, prefill_chunk=knobs["prefill_chunk"],
     )
     engines = [PagedServingEngine(model, **geom) for _ in range(n_replicas)]
-    rng = np.random.RandomState(4)
+    rng = np.random.RandomState(_SEED_BASE + 4)
     vocab = knobs["vocab_size"]
     prefixes = [
         rng.randint(0, vocab, size=knobs["fleet_prefix_len"]).tolist()
@@ -438,9 +465,11 @@ def _fleet_probe(model, knobs, n_replicas):
             len(prefixes[i % len(prefixes)]) + len(tails[i])
             for i in range(rid)
         )
+        scaling = router.scaling_signals()
         for rep in reps:
             rep.stop()
         return {
+            "scaling": scaling,
             "routed_affine": stats["routed_affine"],
             "routed_fallback": stats["routed_fallback"],
             "affinity_hit_rate": stats["affinity_hit_rate"],
@@ -574,7 +603,10 @@ def _fleet_probe(model, knobs, n_replicas):
     warm()
     affine = routing_arm(affinity=True)
     rr = routing_arm(affinity=False)
+    scaling = affine.pop("scaling")
+    rr.pop("scaling", None)
     detail = {
+        "scaling": scaling,
         "replicas": n_replicas,
         "workload": {
             "prefixes": knobs["fleet_prefixes"],
@@ -627,15 +659,39 @@ def main(argv=None):
     )
     args = ap.parse_args(argv)
 
-    knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
+    knobs = dict(_KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL)
+    # candidate-config injection for the self-tuning driver: named
+    # workload/geometry knobs (spec_k, prefill_chunk, fleet_replicas,
+    # ...) override the knob table; kv_dtype re-types the headline
+    # engine's KV pool; trace_sample rides into enable_tracing
+    tune = _tune_overrides()
+    tune_kv_dtype = "fp32"
+    tune_sample = None
+    if tune is not None:
+        for t_name, t_value in sorted(tune.items()):
+            if t_name == "kv_dtype":
+                tune_kv_dtype = str(t_value)
+            elif t_name == "trace_sample":
+                tune_sample = int(t_value)
+            elif t_name in knobs:
+                knobs[t_name] = type(knobs[t_name])(t_value)
+            else:
+                print(f"[bench_serve] unknown tune override {t_name!r}",
+                      file=sys.stderr)
+                sys.exit(2)
     n_fleet = args.replicas or knobs["fleet_replicas"]
     # same attribution contract as bench.py: the BENCH_serve line
     # carries trace-export paths + a metrics snapshot (TTFT/TPOT
     # histograms, slot/queue gauges, prefill-bucket counters,
     # block-pool occupancy, prefix hit counters)
     from theanompi_tpu import observability as observability
+    from theanompi_tpu.observability import live as obs_live
 
-    observability.enable_tracing()
+    observability.enable_tracing(sample=tune_sample)
+    # live plane (THEANOMPI_LIVE=1): the persisted verdict timeline is
+    # what the tuning driver's history-diff gate compares round-over-
+    # round (trials.py sets THEANOMPI_LIVE_PERSIST per trial)
+    telemetry = obs_live.maybe_start_from_env("serve0")
     if not CPU_REHEARSAL and jax.default_backend() not in ("tpu",):
         # same guard shape as bench.py: a dead tunnel silently falling
         # back to 1 CPU device must not masquerade as a TPU number
@@ -676,13 +732,14 @@ def main(argv=None):
             block_size=knobs["block_size"],
             n_blocks=contiguous_blocks + 1,  # +1: reserved trash block
             prefill_chunk=knobs["prefill_chunk"],
+            kv_dtype=tune_kv_dtype,
         )
     rec = Recorder(verbose=False)
     metrics = ServingMetrics(recorder=rec)
     sched = ContinuousBatchingScheduler(engine, metrics=metrics)
 
     # seeded open-loop Poisson workload, pre-drawn
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(_SEED_BASE + 0)
     n = knobs["n_requests"]
     arrivals = np.cumsum(rng.exponential(
         1.0 / knobs["arrival_rate_rps"], size=n
@@ -708,7 +765,7 @@ def main(argv=None):
     # ---- paged capacity probes (CPU bench acceptance evidence) -------
     paged_detail = None
     if engine_kind != "contiguous":
-        wl_rng = np.random.RandomState(1)
+        wl_rng = np.random.RandomState(_SEED_BASE + 1)
         lt_prompts = _long_tail_prompts(wl_rng, knobs)
         # paged at EQUAL cache memory: the accounted pool is capped to
         # exactly the contiguous engine's row budget
@@ -849,6 +906,20 @@ def main(argv=None):
         detail["kv_quant"] = kv_quant_detail
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
+    if tune is not None:
+        # echo the candidate config: the trial harness proves injection
+        # by comparing this against what it sent
+        detail["tuning"] = {
+            "overrides": tune,
+            "seed": TUNE_SEED,
+            "budget": os.environ.get("THEANOMPI_TUNE_BUDGET", "full"),
+        }
+    live_summary = None
+    if telemetry is not None:
+        try:
+            live_summary = telemetry.stop()
+        except Exception as e:  # the monitor must never cost the number
+            live_summary = f"failed: {type(e).__name__}: {e}"
     try:
         paths = observability.dump_all(prefix="bench_serve_")
         detail["observability"] = {
@@ -857,6 +928,8 @@ def main(argv=None):
             "metrics_json": paths["metrics_json"],
             "metrics": observability.get_registry().snapshot(),
         }
+        if live_summary is not None:
+            detail["observability"]["live"] = live_summary
         if "doctor" in paths:
             detail["observability"]["doctor"] = paths["doctor"]
     except OSError as e:  # export must never discard the measurement

@@ -28,6 +28,12 @@ regression.  A baseline value of 0 (a failed round) skips that metric
 with a note, because a ratio against a dead run means nothing.
 
 Exit codes: 0 ok, 1 regression beyond tolerance, 2 usage/parse error.
+(Pinned by tests — the tuning driver and CI both script against them.)
+
+``--json`` emits ``{tolerance, rows, notes, regressions, pass}``;
+each row carries ``ratio`` (new/baseline) and ``pass`` alongside the
+delta so machine consumers (the tuning verdict renderer) never
+re-derive the direction logic.
 """
 
 from __future__ import annotations
@@ -106,14 +112,17 @@ def compare(
             continue
         delta = (new_v - old_v) / abs(old_v)
         worse = -delta if direction == "higher" else delta
+        regression = worse > tolerance
         rows.append(
             {
                 "metric": key,
                 "direction": direction,
                 "baseline": old_v,
                 "new": new_v,
+                "ratio": new_v / old_v,
                 "delta_pct": 100.0 * delta,
-                "regression": worse > tolerance,
+                "regression": regression,
+                "pass": not regression,
             }
         )
     return rows, notes
@@ -189,6 +198,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "rows": rows,
                     "notes": notes,
                     "regressions": [r["metric"] for r in regressions],
+                    "pass": not regressions,
                 },
                 indent=2,
             )

@@ -150,6 +150,22 @@
 #                           keeps a 3s absolute floor for the CI-sized
 #                           eviction window)
 #
+# Tune leg (the closed-loop self-tuning driver's own drill; docs/tuning.md):
+#   PERF_GATE_TUNE          1 (default) = run the tuning driver twice
+#                           against the committed fixture bench on a COPY
+#                           of presets.py.  Planted-better landscape: the
+#                           sweep MUST converge to the known-better rungs
+#                           (serve: spec_k=16, kv_dtype='int8') and write
+#                           them into the copy's TUNED span.  Planted-
+#                           regression landscape (every deviation looks
+#                           faster but trips a verdict instrument): the
+#                           sweep MUST commit NOTHING and leave the copy
+#                           byte-identical.  A tuner that can't find the
+#                           planted winner — or that commits the planted
+#                           trap — is a broken gate.  0 = skip.
+#   PERF_GATE_TUNE_CMD      driver command prefix (default:
+#                           python -m theanompi_tpu.tuning)
+#
 # Lint leg (the graftlint CI artifact diff; docs/static_analysis.md):
 #   PERF_GATE_LINT          1 (default) = diff the current tree's lint
 #                           artifact (findings + per-strategy step
@@ -621,5 +637,72 @@ print(f"[perf_gate] fleet: {kills} kill -> {v.get('evictions')} eviction, "
       f"ttft p99 delta {v.get('ttft_p99_s_delta')}s "
       f"(tol {v.get('ttft_p99_s_tolerance')}s)", file=sys.stderr)
 PY
+fi
+
+# ---- 10. tune leg: the self-tuning driver's own drill -----------------------
+if [ "${PERF_GATE_TUNE:-1}" = "1" ]; then
+    TUNE_DRIVER="${PERF_GATE_TUNE_CMD:-python -m theanompi_tpu.tuning}"
+    TUNE_FIXTURE="tests/data/tuning/fixture_bench.py"
+    TUNE_PRESETS="$WORKDIR/presets_tune.py"
+    # planted-better: the sweep must find and commit the known winner
+    cp theanompi_tpu/presets.py "$TUNE_PRESETS"
+    echo "[perf_gate] tune drill (planted-better): $TUNE_DRIVER --plan serve" >&2
+    if ! env THEANOMPI_TUNE_FIXTURE_MODE=better sh -c "$TUNE_DRIVER --plan serve \
+            --bench-cmd 'python $TUNE_FIXTURE' \
+            --presets '$TUNE_PRESETS' --workdir '$WORKDIR/tune_better' --json" \
+            > "$WORKDIR/tune_better.json"; then
+        echo "[perf_gate] TUNE VIOLATION: sweep failed on the planted-better fixture" >&2
+        exit 1
+    fi
+    python - "$WORKDIR/tune_better.json" "$TUNE_PRESETS" <<'PY'
+import json, sys
+sys.path.insert(0, ".")
+from theanompi_tpu.tuning.presets_io import read_tuned
+report = json.load(open(sys.argv[1]))
+if not (report.get("ok") and report.get("committed")):
+    sys.exit("[perf_gate] TUNE VIOLATION: planted-better sweep did not "
+             f"commit (ok={report.get('ok')} "
+             f"committed={report.get('committed')})")
+want = {"spec_k": 16, "kv_dtype": "int8"}
+changed = report.get("changed") or {}
+for k, v in want.items():
+    if changed.get(k) != v:
+        sys.exit(f"[perf_gate] TUNE VIOLATION: planted winner {k}={v!r} "
+                 f"not adopted (changed={changed})")
+tuned = read_tuned(sys.argv[2]).get("serve", {})
+for k, v in want.items():
+    if tuned.get(k) != v:
+        sys.exit(f"[perf_gate] TUNE VIOLATION: winner {k}={v!r} not "
+                 f"written to the presets TUNED span (got {tuned})")
+print(f"[perf_gate] tune: planted winner adopted + committed "
+      f"({changed}, {report.get('trials')} trial runs)", file=sys.stderr)
+PY
+    # planted-regression: tempting headline, red instruments — the sweep
+    # must refuse everything and leave the presets file untouched
+    cp theanompi_tpu/presets.py "$TUNE_PRESETS"
+    echo "[perf_gate] tune drill (planted-regression): must refuse" >&2
+    if ! env THEANOMPI_TUNE_FIXTURE_MODE=regression sh -c "$TUNE_DRIVER --plan serve \
+            --bench-cmd 'python $TUNE_FIXTURE' \
+            --presets '$TUNE_PRESETS' --workdir '$WORKDIR/tune_reg' --json" \
+            > "$WORKDIR/tune_reg.json"; then
+        echo "[perf_gate] TUNE VIOLATION: sweep errored on the planted-regression fixture (refusal should be a clean exit)" >&2
+        exit 1
+    fi
+    python - "$WORKDIR/tune_reg.json" <<'PY'
+import json, sys
+report = json.load(open(sys.argv[1]))
+if report.get("changed") or report.get("committed"):
+    sys.exit("[perf_gate] TUNE VIOLATION: the planted regression was "
+             f"ADOPTED (changed={report.get('changed')} "
+             f"committed={report.get('committed')}) — the verdict gate "
+             "is not gating")
+print("[perf_gate] tune: planted regression refused "
+      f"({report.get('trials')} trial runs, nothing committed)",
+      file=sys.stderr)
+PY
+    if ! cmp -s theanompi_tpu/presets.py "$TUNE_PRESETS"; then
+        echo "[perf_gate] TUNE VIOLATION: regression sweep modified the presets file despite committing nothing" >&2
+        exit 1
+    fi
 fi
 echo "[perf_gate] green" >&2

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Deterministic fixture bench for the tuning driver (stdlib only).
+
+Speaks the exact contract the trial harness speaks to the real
+benches: reads the candidate config from ``THEANOMPI_TUNE_OVERRIDES``
+(JSON), the workload seed from ``THEANOMPI_BENCH_SEED``, the budget
+tier from ``THEANOMPI_TUNE_BUDGET``; echoes the applied overrides in
+``detail.tuning``; persists a live-plane-shaped verdict timeline to
+``THEANOMPI_LIVE_PERSIST``; prints ONE BENCH JSON line.
+
+Two planted landscapes, selected by ``THEANOMPI_TUNE_FIXTURE_MODE``:
+
+- ``better`` (default): a known-better rung exists per knob (serve:
+  ``spec_k=16``, ``kv_dtype='int8'``; train: ``exchange_bucket_mb=8.0``,
+  ``trace_sample=8``; fleet: ``fleet_replicas=4``) and every verdict
+  instrument stays green — the driver MUST converge to it.
+- ``regression``: every move away from the defaults looks FASTER on
+  the headline (tempting) but trips a red flag on the instrument that
+  owns the knob — a spec token-identity break, a kv dequant-drift
+  blowout, a TTFT p99 explosion (bench_compare), a lost fleet stream
+  (required scaling check), and a planted watchdog alert on the
+  timeline (history diff).  The driver MUST keep the incumbent and
+  commit nothing.
+
+The headline is a pure function of the config (never of seed, budget
+or time), so the same seed reproduces the same sweep byte-for-byte.
+"""
+
+import json
+import os
+import sys
+
+DEFAULTS = {
+    "spec_k": 8,
+    "kv_dtype": "fp32",
+    "prefill_chunk": 256,
+    "exchange_bucket_mb": 4.0,
+    "easgd_tau": 10,
+    "trace_sample": 1,
+    "fleet_replicas": 3,
+}
+
+# better mode: headline bonus per (knob, value) — the planted landscape
+BONUS = {
+    "spec_k": {0: 0.0, 2: 2.0, 4: 4.0, 8: 6.0, 16: 10.0},
+    "kv_dtype": {"fp32": 0.0, "int8": 4.0},
+    "prefill_chunk": {64: 0.0, 128: 1.0, 256: 3.0, 512: 2.0},
+    "exchange_bucket_mb": {1.0: 0.0, 2.0: 1.0, 4.0: 3.0, 8.0: 5.0,
+                           16.0: 2.0},
+    "easgd_tau": {2: 0.0, 5: 1.0, 10: 2.0, 20: 1.5, 40: 0.5},
+    "trace_sample": {1: 1.0, 2: 2.0, 8: 3.0, 32: 2.5},
+    "fleet_replicas": {2: 0.0, 3: 2.0, 4: 3.0},
+}
+
+
+def main():
+    raw = os.environ.get("THEANOMPI_TUNE_OVERRIDES", "") or "{}"
+    overrides = json.loads(raw)
+    seed = int(os.environ.get("THEANOMPI_BENCH_SEED", "0") or 0)
+    budget = os.environ.get("THEANOMPI_TUNE_BUDGET", "full")
+    mode = os.environ.get("THEANOMPI_TUNE_FIXTURE_MODE", "better")
+    config = dict(DEFAULTS)
+    config.update(overrides)
+    deviated = sorted(
+        k for k, v in config.items() if v != DEFAULTS[k]
+    )
+
+    value = 100.0
+    detail = {
+        "wall_s": 1.0,
+        "tuning": {"overrides": overrides, "seed": seed,
+                   "budget": budget},
+        "spec": {"token_identical": True, "accept_rate": 0.7},
+        "kv_quant": {"greedy_drift": 0.01},
+    }
+    if mode == "regression":
+        # tempting: every deviation from the defaults "wins" the
+        # headline...
+        value += 10.0 * len(deviated)
+        detail["ttft_p99_s"] = 0.1
+        # ...and each trips the instrument that owns the knob
+        if config["spec_k"] != DEFAULTS["spec_k"]:
+            detail["spec"]["token_identical"] = False
+        if config["kv_dtype"] != DEFAULTS["kv_dtype"]:
+            detail["kv_quant"]["greedy_drift"] = 0.9
+        if config["prefill_chunk"] != DEFAULTS["prefill_chunk"]:
+            detail["ttft_p99_s"] = 50.0
+    else:
+        for knob, v in config.items():
+            value += BONUS[knob][v]
+        detail["ttft_p99_s"] = round(10.0 / value, 6)
+
+    if "fleet_replicas" in overrides:
+        lost = (
+            1
+            if mode == "regression"
+            and config["fleet_replicas"] != DEFAULTS["fleet_replicas"]
+            else 0
+        )
+        detail["fleet"] = {
+            "scaling": {
+                "requests_lost": lost,
+                "queue_depth": 0,
+                "replicas_admitting": int(config["fleet_replicas"]),
+                "replicas_live": int(config["fleet_replicas"]),
+                "shed_events": 0,
+                "backpressure_refusals": 0,
+                "headroom_total": 8 * int(config["fleet_replicas"]),
+            }
+        }
+
+    timeline = os.environ.get("THEANOMPI_LIVE_PERSIST")
+    if timeline:
+        alerts = (
+            [{"rule": "planted_regression",
+              "message": f"deviated: {deviated}"}]
+            if mode == "regression" and deviated
+            else []
+        )
+        rows = [
+            {"window": 1, "t_wall": 1.0, "ranks": {}, "alerts": []},
+            {"window": 2, "t_wall": 2.0, "ranks": {}, "alerts": alerts},
+        ]
+        with open(timeline, "w", encoding="utf-8") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+    print(json.dumps({
+        "metric": "fixture_tokens_per_sec",
+        "value": round(value, 4),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "measured_now": True,
+        "detail": detail,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,7 @@ def _run_gate(env_extra):
     env.setdefault("PERF_GATE_CHAOS", "0")
     env.setdefault("PERF_GATE_FLEET", "0")
     env.setdefault("PERF_GATE_BSP", "0")
+    env.setdefault("PERF_GATE_TUNE", "0")
     # the LINT leg stays default-ON; feeding the committed artifact
     # back as the "current" document keeps the smoke tests off the
     # analyzer run (the dedicated LINT tests below exercise the real
@@ -902,3 +903,112 @@ def test_gate_lint_leg_skippable(fixtures, tmp_path):
     })
     assert r.returncode == 0, r.stderr
     assert "lint artifact diff" not in r.stderr
+
+# ---------------------------------------------------------------------------
+# tune leg (ISSUE 16): the self-tuning driver's own drill — the gate
+# must prove the sweep finds a planted winner AND refuses a planted
+# regression, against a COPY of presets.py (never the real file)
+# ---------------------------------------------------------------------------
+
+def test_gate_tune_leg_green(fixtures):
+    """Default fixture landscapes: planted-better converges and
+    commits; planted-regression refuses and leaves the copy
+    byte-identical. Both sweeps are seeded, so this is deterministic."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_TUNE": "1",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "tune: planted winner adopted" in r.stderr
+    assert "planted regression refused" in r.stderr
+    assert "green" in r.stderr
+
+
+def _fake_tune_driver(tmp_path):
+    """A driver stand-in that 'passes' the planted-better leg (it
+    really commits the expected winners via presets_io) and then, on
+    its second invocation, claims to have adopted a change in
+    regression mode — the shape of a tuner whose verdict gate broke."""
+    script = tmp_path / "fake_driver.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        "args = sys.argv[1:]\n"
+        "presets = args[args.index('--presets') + 1]\n"
+        f"state = {str(tmp_path / 'state.txt')!r}\n"
+        "first = not os.path.exists(state)\n"
+        "open(state, 'a').write('x')\n"
+        "if first:\n"
+        "    from theanompi_tpu.tuning.presets_io import update_presets\n"
+        "    update_presets(presets, 'serve',\n"
+        "                   {'spec_k': 16, 'kv_dtype': 'int8'})\n"
+        "    print(json.dumps({'ok': True, 'committed': True,\n"
+        "                      'changed': {'spec_k': 16,\n"
+        "                                  'kv_dtype': 'int8'},\n"
+        "                      'trials': {'run': 0, 'cached': 0}}))\n"
+        "else:\n"
+        "    print(json.dumps({'ok': True, 'committed': True,\n"
+        "                      'changed': {'spec_k': 0},\n"
+        "                      'trials': {'run': 0, 'cached': 0}}))\n"
+    )
+    return str(script)
+
+
+def test_gate_tune_leg_detects_adopted_regression(fixtures, tmp_path):
+    """A tuner that commits anything in regression mode is a broken
+    gate — the structure check must fail the round."""
+    base, good, _ = fixtures
+    fake = _fake_tune_driver(tmp_path)
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_TUNE": "1",
+        "PERF_GATE_TUNE_CMD": f"python {fake}",
+    })
+    assert r.returncode != 0
+    assert "TUNE VIOLATION" in r.stderr
+    assert "ADOPTED" in r.stderr
+
+
+def test_gate_tune_leg_detects_missed_winner(fixtures, tmp_path):
+    """A sweep that completes without committing the planted winner
+    (here: a driver that refuses everything) fails the better leg."""
+    base, good, _ = fixtures
+    script = tmp_path / "no_commit.py"
+    script.write_text(
+        "import json\n"
+        "print(json.dumps({'ok': True, 'committed': False,\n"
+        "                  'changed': {},\n"
+        "                  'trials': {'run': 0, 'cached': 0}}))\n"
+    )
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_TUNE": "1",
+        "PERF_GATE_TUNE_CMD": f"python {script}",
+    })
+    assert r.returncode != 0
+    assert "TUNE VIOLATION" in r.stderr
+    assert "did not commit" in r.stderr
+
+
+def test_gate_tune_leg_skippable(fixtures):
+    """PERF_GATE_TUNE=0 restores the pre-tuning gate behavior."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_TUNE": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "tune drill" not in r.stderr

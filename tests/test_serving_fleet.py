@@ -264,10 +264,14 @@ class _StubReplica:
     router placement tests (summary/headroom are the subject, no
     engine required)."""
 
-    def __init__(self, summary=(), headroom=0, block_size=8):
+    def __init__(self, summary=(), headroom=0, block_size=8,
+                 healthy=True, backpressure=0, drain_refusals=0):
         self.summary = list(summary)
         self.headroom = headroom
         self.block_size = block_size
+        self.healthy = healthy
+        self.backpressure = backpressure
+        self.drain_refusals = drain_refusals
         self.submitted = []
 
     def handle(self, msg):
@@ -280,9 +284,11 @@ class _StubReplica:
             return {"ok": True, "ticks": 1}
         if kind == "poll":
             return {"ok": True, "streams": {}, "ticks": 1,
-                    "healthy": True, "draining": False, "idle": True,
-                    "summary": list(self.summary),
-                    "headroom": self.headroom}
+                    "healthy": self.healthy, "draining": False,
+                    "idle": True, "summary": list(self.summary),
+                    "headroom": self.headroom,
+                    "backpressure": self.backpressure,
+                    "drain_refusals": self.drain_refusals}
         return {"ok": False}
 
 
@@ -352,6 +358,74 @@ def test_replica_poll_reply_advertises_pool_headroom(model):
         assert isinstance(after, int)
     finally:
         rep.stop()
+
+
+def test_router_scaling_signals_golden():
+    """One snapshot of the demand-vs-capacity picture, whole-dict
+    golden: backlog, roster composition, refusal counters, and
+    per-replica headroom — the feed the tuning driver sizes the
+    fleet by."""
+    router = FleetRouter(evict_after_s=60.0)
+    router.add_replica("a", _StubReplica(headroom=40, backpressure=2))
+    router.add_replica("b", _StubReplica(headroom=8, drain_refusals=1))
+    router.add_replica("c", _StubReplica(headroom=12, healthy=False))
+    router.pump()  # absorb poll replies; c's red health sheds it
+    router.submit(Request(id="q0", prompt=[1, 2, 3],
+                          max_new_tokens=4))
+    assert router.scaling_signals() == {
+        "queue_depth": 1,
+        "replicas_total": 3,
+        "replicas_live": 3,
+        "replicas_admitting": 2,
+        "replicas_shedding": 1,
+        "backpressure_refusals": 2,
+        "drain_refusals": 1,
+        "drain_reroutes": 0,
+        "shed_events": 1,
+        "requests_lost": 0,
+        "headroom": {"a": 40, "b": 8, "c": 12},
+        "headroom_total": 60,
+        "headroom_min": 8,
+    }
+
+
+def test_router_scaling_signals_exports_gauges():
+    """The snapshot is also the gauge refresh: queue depth, admitting
+    count, backpressure sum, and labeled per-replica headroom land in
+    the metrics registry on every call."""
+    from theanompi_tpu.serving import metrics as smetrics
+    router = FleetRouter(evict_after_s=60.0)
+    router.add_replica("a", _StubReplica(headroom=40, backpressure=2))
+    router.add_replica("b", _StubReplica(headroom=8, backpressure=3))
+    router.pump()
+    sig = router.scaling_signals()
+    assert smetrics.FLEET_QUEUE_DEPTH.value() == sig["queue_depth"] == 0
+    assert smetrics.FLEET_ADMITTING.value() == 2
+    assert smetrics.FLEET_BACKPRESSURE.value() == 5
+    assert smetrics.FLEET_HEADROOM.value(replica="a") == 40
+    assert smetrics.FLEET_HEADROOM.value(replica="b") == 8
+
+
+def test_router_counts_lost_requests():
+    """A stream that cannot re-admit anywhere after an eviction is a
+    counted loss (stats + scaling snapshot), not a silent drop."""
+    clock = {"t": 0.0}
+    router = FleetRouter(evict_after_s=0.5,
+                         clock=lambda: clock["t"])
+    rep = _StubReplica(headroom=40)
+    router.add_replica("a", rep)
+    router.pump()
+    router.submit(Request(id="q0", prompt=[1, 2, 3],
+                          max_new_tokens=4))
+    # the only replica goes silent past the eviction window; with no
+    # survivor to re-admit on, the stream is lost — and counted
+    rep.handle = lambda msg: (_ for _ in ()).throw(
+        ConnectionError("down"))
+    clock["t"] = 1.0
+    router.pump()
+    assert router.stats["evictions"] == 1
+    assert router.stats["requests_lost"] == 1
+    assert router.scaling_signals()["requests_lost"] == 1
 
 
 def test_radix_scheduler_outputs_match_chain(model):

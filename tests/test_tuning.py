@@ -1,0 +1,472 @@
+"""The closed-loop self-tuning driver (ISSUE 16).
+
+Contracts under test:
+
+- **Typed knob registry**: every domain constraint is refused loudly
+  at construction (bad kinds, off-ladder defaults, unsorted/duplicate
+  rungs, unknown plans/benches, malformed checks) — a knob that can
+  lie about its domain would let the search commit garbage.
+- **Trial harness**: the env-channel contract round-trips through the
+  committed fixture bench; the echo check disqualifies a bench that
+  applied something other than what was sent; the JSONL journal makes
+  re-measurement impossible and survives a torn tail (crash resume).
+- **Deterministic search**: same seed → same trial sequence → same
+  winner, twice in a row, from scratch.
+- **Verdict gating**: the planted-regression landscape (tempting
+  headline, red instruments) is never adopted and never committed;
+  the history-diff leg flags a planted timeline alert on its own.
+- **Presets updater**: marker-span surgery is idempotent (second run
+  byte-identical), round-trip-verified, and refuses mangled spans.
+- **bench_compare --json**: the enriched row schema (ratio/pass) and
+  the 0/1/2 exit-code contract are pinned — the driver and CI both
+  script against them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from theanompi_tpu.tuning import knobs as knobs_mod
+from theanompi_tpu.tuning import presets_io, trials
+from theanompi_tpu.tuning.driver import DriverConfig, run_search
+from theanompi_tpu.tuning.knobs import Check, Knob, KnobError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_BENCH = [sys.executable,
+                 os.path.join(REPO, "tests", "data", "tuning",
+                              "fixture_bench.py")]
+
+
+def _knob(**overrides):
+    base = dict(
+        name="k", kind="int", ladder=(1, 2, 4), default=2,
+        plan="serve", bench="serve", description="d",
+    )
+    base.update(overrides)
+    return Knob(**base)
+
+
+# ---------------------------------------------------------------------------
+# knob registry: bad domains are refused loudly
+# ---------------------------------------------------------------------------
+
+def test_registry_knobs_all_validate():
+    """The committed registry itself constructs (the dataclass
+    validators run at import) and every plan resolves."""
+    assert len(knobs_mod.REGISTRY) >= 7
+    for plan in knobs_mod.PLANS:
+        ks = knobs_mod.knobs_for_plan(plan)
+        assert ks, f"plan {plan} has no knobs"
+        defaults = knobs_mod.plan_defaults(plan)
+        assert set(defaults) == {k.name for k in ks}
+
+
+@pytest.mark.parametrize("bad", [
+    dict(name="not an identifier"),
+    dict(kind="bool"),
+    dict(plan="warehouse"),
+    dict(bench="warehouse"),
+    dict(ladder=(1,)),                      # < 2 rungs
+    dict(ladder=(1, 2, 2)),                 # duplicates
+    dict(ladder=(4, 2, 1), default=4),      # numeric, not ascending
+    dict(ladder=(1, 2.5, 4)),               # mistyped rung
+    dict(default=3),                        # off-ladder default
+    dict(doctor_flags={"overlap": 0.5}),    # not max_*/min_*
+])
+def test_bad_knob_domains_refused(bad):
+    with pytest.raises(KnobError):
+        _knob(**bad)
+
+
+def test_bad_check_specs_refused():
+    with pytest.raises(KnobError):
+        Check(path=(), op="<=", value=1.0)
+    with pytest.raises(KnobError):
+        Check(path=("a",), op="~=", value=1.0)
+    with pytest.raises(KnobError):
+        Check(path=("a",), op="<=", value="fast")  # non-numeric bound
+
+
+def test_check_evaluate_statuses():
+    c = Check(path=("spec", "accept_rate"), op=">=", value=0.5)
+    assert c.evaluate({"spec": {"accept_rate": 0.7}})[0] == "ok"
+    assert c.evaluate({"spec": {"accept_rate": 0.1}})[0] == "violation"
+    assert c.evaluate({"spec": {}})[0] == "missing"
+    required = Check(path=("fleet", "scaling", "requests_lost"),
+                     op="<=", value=0, required=True)
+    assert required.evaluate({})[0] == "violation"
+
+
+def test_coerce_refuses_off_ladder_values():
+    k = _knob()
+    assert k.coerce(4) == 4
+    with pytest.raises(KnobError):
+        k.coerce(3)
+
+
+def test_validate_config_strays_and_gaps_are_loud():
+    good = knobs_mod.plan_defaults("serve")
+    assert knobs_mod.validate_config("serve", good) == good
+    with pytest.raises(KnobError):
+        knobs_mod.validate_config("serve", {**good, "warp": 9})
+    missing = dict(good)
+    missing.popitem()
+    with pytest.raises(KnobError):
+        knobs_mod.validate_config("serve", missing)
+    with pytest.raises(KnobError):
+        knobs_mod.knobs_for_plan("warehouse")
+
+
+# ---------------------------------------------------------------------------
+# trial harness: env channel, echo proof, journal resume
+# ---------------------------------------------------------------------------
+
+def _fixture_trial(tmp_path, config=None, journal=None, mode="better",
+                   budget="short", seed=0):
+    return trials.run_trial(
+        "serve",
+        config or knobs_mod.plan_defaults("serve"),
+        budget=budget, seed=seed, workdir=str(tmp_path / "trials"),
+        bench_cmd=FIXTURE_BENCH, journal=journal,
+        env_extra={"THEANOMPI_TUNE_FIXTURE_MODE": mode},
+    )
+
+
+def test_trial_roundtrip_through_fixture_bench(tmp_path):
+    rec = _fixture_trial(tmp_path)
+    assert rec["rc"] == 0 and rec["error"] is None
+    bench = rec["bench"]
+    assert bench["metric"] == "fixture_tokens_per_sec"
+    # the bench echoed exactly the config that was sent
+    echoed = bench["detail"]["tuning"]
+    assert echoed["overrides"] == rec["config"]
+    assert echoed["seed"] == 0 and echoed["budget"] == "short"
+    # and persisted the verdict timeline the history gate diffs
+    assert rec["timeline"] and os.path.exists(rec["timeline"])
+
+
+def test_trial_journal_caches_and_resumes(tmp_path):
+    jpath = str(tmp_path / "journal.jsonl")
+    j = trials.Journal(jpath)
+    first = _fixture_trial(tmp_path, journal=j)
+    assert first["cached"] is False
+    again = _fixture_trial(tmp_path, journal=j)
+    assert again["cached"] is True
+    assert again["bench"] == first["bench"]
+    # a fresh Journal over the same file resumes without re-measuring
+    resumed = _fixture_trial(tmp_path, journal=trials.Journal(jpath))
+    assert resumed["cached"] is True
+    # a torn final line (crash mid-write) is tolerated, prior entries
+    # survive
+    with open(jpath, "a", encoding="utf-8") as f:
+        f.write('{"key": "torn')
+    assert len(trials.Journal(jpath)) == 1
+
+
+def test_trial_fingerprint_separates_everything(tmp_path):
+    cfg = knobs_mod.plan_defaults("serve")
+    base = trials.fingerprint("serve", cfg, "short", 0, FIXTURE_BENCH)
+    assert trials.fingerprint("serve", cfg, "short", 0,
+                              FIXTURE_BENCH) == base
+    assert trials.fingerprint("serve", cfg, "full", 0,
+                              FIXTURE_BENCH) != base
+    assert trials.fingerprint("serve", cfg, "short", 1,
+                              FIXTURE_BENCH) != base
+    assert trials.fingerprint("serve", {**cfg, "spec_k": 16}, "short",
+                              0, FIXTURE_BENCH) != base
+    assert trials.fingerprint("serve", cfg, "short", 0,
+                              ["python", "other.py"]) != base
+
+
+def test_trial_echo_mismatch_disqualifies(tmp_path):
+    """A bench that applies something other than what was sent must
+    not be allowed to score the candidate."""
+    liar = tmp_path / "liar_bench.py"
+    liar.write_text(
+        "import json\n"
+        "print(json.dumps({'metric': 'm', 'value': 999.0,\n"
+        "                  'detail': {'tuning':\n"
+        "                             {'overrides': {'spec_k': 0}}}}))\n"
+    )
+    rec = trials.run_trial(
+        "serve", knobs_mod.plan_defaults("serve"), budget="short",
+        seed=0, workdir=str(tmp_path / "t"),
+        bench_cmd=[sys.executable, str(liar)],
+    )
+    assert rec["error"] and "echo mismatch" in rec["error"]
+    verdict = trials.judge(rec, rec, knobs_mod.knobs_for_plan("serve"))
+    assert not verdict["pass"]
+    assert any("echo mismatch" in f for f in verdict["flags"])
+
+
+def test_real_benches_refuse_unknown_override_keys():
+    """Exit 2 on a stray knob name — a typo must never be a silently
+    un-applied candidate. (The train bench's gate runs before any jax
+    work, so this is cheap.)"""
+    env = dict(os.environ)
+    env["THEANOMPI_TUNE_OVERRIDES"] = json.dumps({"warp_factor": 9})
+    env["THEANOMPI_BENCH_CPU"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240,
+    )
+    assert proc.returncode == 2
+    assert "warp_factor" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the search: deterministic, resumable, verdict-gated
+# ---------------------------------------------------------------------------
+
+def _sweep(tmp_path, name, mode="better", plan="serve", seed=0,
+           presets=None, commit=True):
+    if presets is None:
+        presets = str(tmp_path / f"presets_{name}.py")
+        with open(os.path.join(REPO, "theanompi_tpu", "presets.py")) as f:
+            src = f.read()
+        with open(presets, "w") as f:
+            f.write(src)
+    cfg = DriverConfig(
+        plan=plan, seed=seed, workdir=str(tmp_path / name),
+        bench_cmd=list(FIXTURE_BENCH), presets_path=presets,
+        commit=commit,
+        env_extra={"THEANOMPI_TUNE_FIXTURE_MODE": mode},
+    )
+    return run_search(cfg, log=lambda *a, **k: None), presets
+
+
+def test_search_converges_to_planted_winner(tmp_path):
+    report, presets = _sweep(tmp_path, "s0")
+    assert report["ok"] and report["committed"]
+    assert report["changed"] == {"spec_k": 16, "kv_dtype": "int8"}
+    tuned = presets_io.read_tuned(presets)["serve"]
+    assert tuned["spec_k"] == 16 and tuned["kv_dtype"] == "int8"
+    # losers are banked as evidence, one decision file per knob round
+    files = sorted(os.listdir(report["evidence_dir"]))
+    assert any(f.startswith("serve_r0_spec_k") for f in files)
+    doc = json.load(open(os.path.join(report["evidence_dir"], files[0])))
+    assert doc["shorts"] and "verdict" in doc["shorts"][0]
+
+
+def test_search_is_deterministic(tmp_path):
+    """Same seed, fresh workdirs: identical trial sequence, identical
+    winners. This is the reproducibility contract in docs/tuning.md."""
+    r1, _ = _sweep(tmp_path, "d1")
+    r2, _ = _sweep(tmp_path, "d2")
+    assert r1["sequence"] == r2["sequence"]
+    assert r1["changed"] == r2["changed"]
+    assert r1["winners"] == r2["winners"]
+    # a different seed reaches the same planted winner by a different
+    # trial sequence (the fingerprints embed the seed)
+    r3, _ = _sweep(tmp_path, "d3", seed=7)
+    assert r3["sequence"] != r1["sequence"]
+    assert r3["changed"] == r1["changed"]
+
+
+def test_search_resumes_from_truncated_journal(tmp_path):
+    """Kill a sweep mid-flight (simulated: truncate its journal), rerun
+    with the same config — the finished prefix returns from the journal
+    and the winner is unchanged."""
+    # the crashed sweep never reached its commit (commit is the final
+    # step), so the rerun starts from the same incumbent presets
+    r1, presets = _sweep(tmp_path, "c1", commit=False)
+    jpath = os.path.join(str(tmp_path / "c1"), "journal.jsonl")
+    lines = open(jpath).read().splitlines(True)
+    assert len(lines) == r1["trials"]["run"]
+    keep = len(lines) // 2
+    with open(jpath, "w") as f:
+        f.writelines(lines[:keep])
+        f.write('{"key": "torn-by-cra')  # the crash the journal is for
+    r2, _ = _sweep(tmp_path, "c1", presets=presets)
+    # the surviving half returns from the journal (on top of in-run
+    # repeat hits, which both runs share); only the lost half re-runs
+    assert r2["trials"]["run"] == r1["trials"]["run"] - keep
+    assert r2["trials"]["cached"] == r1["trials"]["cached"] + keep
+    assert r2["sequence"] == r1["sequence"]
+    assert r2["winners"] == r1["winners"]
+    assert r2["changed"] == r1["changed"] and r2["committed"]
+
+
+def test_search_refuses_planted_regression(tmp_path):
+    """Every deviation looks faster on the headline but trips the
+    instrument that owns the knob — nothing may be adopted, the presets
+    file must stay byte-identical."""
+    before = open(os.path.join(REPO, "theanompi_tpu",
+                               "presets.py")).read()
+    report, presets = _sweep(tmp_path, "reg", mode="regression")
+    assert report["ok"]
+    assert report["changed"] == {} and report["committed"] is False
+    assert open(presets).read() == before
+    # the refusals are on instruments, not on the headline: the spec_k
+    # decision must carry a token-identity flag somewhere
+    flags = [
+        f
+        for d in report["decisions"] if d["knob"] == "spec_k"
+        for s in d["shorts"]
+        for f in s["verdict"]["flags"]
+    ]
+    assert any("token_identical" in f for f in flags)
+
+
+def test_search_fleet_plan_judges_scaling_signals(tmp_path):
+    """The fleet plan's knob rides the scaling-signal checks: better
+    mode adopts the planted replica count, regression mode (a lost
+    request) refuses it."""
+    good, _ = _sweep(tmp_path, "fb", plan="fleet")
+    assert good["changed"] == {"fleet_replicas": 4}
+    bad, _ = _sweep(tmp_path, "fr", plan="fleet", mode="regression")
+    assert bad["changed"] == {}
+    flags = [
+        f
+        for d in bad["decisions"]
+        for s in d["shorts"]
+        for f in s["verdict"]["flags"]
+    ]
+    assert any("requests_lost" in f for f in flags)
+
+
+def test_search_skips_inert_knobs_honestly(tmp_path):
+    """EASGD τ does not touch the committed BSP bench's measured
+    workload — 'tuning' it would measure noise, so the driver must
+    refuse and say so."""
+    report, _ = _sweep(tmp_path, "tr", plan="train")
+    assert report["skipped_inert"] == ["easgd_tau"]
+    assert "easgd_tau" not in report["changed"]
+    assert all(d["knob"] != "easgd_tau" for d in report["decisions"])
+
+
+def test_history_diff_gates_planted_timeline_alert(tmp_path):
+    """The PR 9 carryover, isolated: identical benches, but the
+    candidate's persisted verdict timeline carries a new alert — the
+    history diff alone must disqualify."""
+    def tl(path, alerts):
+        rows = [{"window": 1, "t_wall": 1.0, "ranks": {},
+                 "alerts": alerts}]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    bench = {"metric": "m", "value": 100.0, "detail": {}}
+    inc = {"rc": 0, "bench": bench, "error": None,
+           "timeline": tl(tmp_path / "a.jsonl", [])}
+    cand = {"rc": 0, "bench": bench, "error": None,
+            "timeline": tl(tmp_path / "b.jsonl",
+                           [{"rule": "planted", "message": "x"}])}
+    gated = _knob(history_flags={"max_new_alerts": 0})
+    verdict = trials.judge(inc, cand, [gated])
+    assert not verdict["pass"]
+    assert any("history diff" in f for f in verdict["flags"])
+    # and with no history flags declared, the same pair passes
+    assert trials.judge(inc, cand, [_knob()])["pass"]
+
+
+# ---------------------------------------------------------------------------
+# presets updater: span-anchored, idempotent, loud on mangled files
+# ---------------------------------------------------------------------------
+
+def test_presets_updater_is_idempotent(tmp_path):
+    path = str(tmp_path / "p.py")
+    with open(os.path.join(REPO, "theanompi_tpu", "presets.py")) as f:
+        src = f.read()
+    open(path, "w").write(src)
+    assert presets_io.update_presets(path, "serve", {"spec_k": 16})
+    once = open(path).read()
+    # second run with the same winners: byte-identical, reported no-op
+    assert not presets_io.update_presets(path, "serve", {"spec_k": 16})
+    assert open(path).read() == once
+    # the block re-reads to exactly what was written, other plans intact
+    tuned = presets_io.read_tuned(path)
+    assert tuned["serve"]["spec_k"] == 16
+    assert tuned["train"] == presets_io.read_tuned(
+        os.path.join(REPO, "theanompi_tpu", "presets.py"))["train"]
+    # and the edited file still parses as the real presets module shape
+    compile(once, path, "exec")
+
+
+def test_presets_updater_refuses_mangled_spans(tmp_path):
+    src = open(os.path.join(REPO, "theanompi_tpu", "presets.py")).read()
+    no_begin = str(tmp_path / "no_begin.py")
+    open(no_begin, "w").write(src.replace(presets_io.BEGIN_MARK, "# gone"))
+    with pytest.raises(presets_io.PresetsEditError):
+        presets_io.update_presets(no_begin, "serve", {"spec_k": 16})
+    doubled = str(tmp_path / "doubled.py")
+    open(doubled, "w").write(
+        src + "\n" + presets_io.BEGIN_MARK + "\n" + presets_io.END_MARK
+        + "\n"
+    )
+    with pytest.raises(presets_io.PresetsEditError):
+        presets_io.update_presets(doubled, "serve", {"spec_k": 16})
+    # mangled original content must be untouched after the refusal
+    assert presets_io.BEGIN_MARK not in open(no_begin).read()
+
+
+def test_presets_updater_refuses_off_registry_winners(tmp_path):
+    path = str(tmp_path / "p.py")
+    open(path, "w").write(
+        open(os.path.join(REPO, "theanompi_tpu", "presets.py")).read())
+    with pytest.raises((KnobError, presets_io.PresetsEditError)):
+        presets_io.update_presets(path, "serve", {"spec_k": 3})
+
+
+def test_committed_presets_tuned_span_matches_registry_defaults():
+    """The repo ships registry defaults in the TUNED span (real-bench
+    winners land there via real sweeps, not fixture runs)."""
+    tuned = presets_io.read_tuned(presets_io.default_presets_path())
+    for plan in knobs_mod.PLANS:
+        assert tuned[plan] == knobs_mod.plan_defaults(plan)
+    from theanompi_tpu import presets as presets_mod
+    assert presets_mod.get_tuned("serve") == tuned["serve"]
+    with pytest.raises(KeyError):
+        presets_mod.get_tuned("warehouse")
+
+
+# ---------------------------------------------------------------------------
+# bench_compare --json: enriched schema + pinned exit-code contract
+# ---------------------------------------------------------------------------
+
+def _bench_json(path, value, wall_s):
+    doc = {"metric": "m", "value": value, "detail": {"wall_s": wall_s}}
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def _compare(*argv):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_compare.py"), *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+
+
+def test_bench_compare_json_schema_and_exit_codes(tmp_path):
+    base = _bench_json(tmp_path / "base.json", 100.0, 10.0)
+    fast = _bench_json(tmp_path / "fast.json", 110.0, 9.0)
+    slow = _bench_json(tmp_path / "slow.json", 50.0, 20.0)
+
+    ok = _compare(base, fast, "--json")
+    assert ok.returncode == 0  # pinned: green
+    doc = json.loads(ok.stdout)
+    assert doc["pass"] is True and doc["regressions"] == []
+    by_metric = {r["metric"]: r for r in doc["rows"]}
+    assert by_metric["m"]["ratio"] == pytest.approx(1.1)
+    assert by_metric["m"]["pass"] is True
+    assert by_metric["m"]["direction"] == "higher"
+    assert by_metric["wall_s"]["direction"] == "lower"
+    assert by_metric["wall_s"]["ratio"] == pytest.approx(0.9)
+
+    bad = _compare(base, slow, "--json")
+    assert bad.returncode == 1  # pinned: regression
+    doc = json.loads(bad.stdout)
+    assert doc["pass"] is False
+    assert set(doc["regressions"]) == {"m", "wall_s"}
+    assert all(r["pass"] is (not r["regression"]) for r in doc["rows"])
+
+    assert _compare(base, str(tmp_path / "nope.json"),
+                    "--json").returncode == 2  # pinned: usage error
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("not json at all")
+    assert _compare(base, str(garbage), "--json").returncode == 2

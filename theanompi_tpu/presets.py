@@ -79,6 +79,41 @@ PRESETS: Dict[str, Dict[str, Any]] = {
 }
 
 
+# Tuned knob values per plan, committed by the closed-loop driver
+# (docs/tuning.md).  The span between the markers is machine-owned:
+# `python -m theanompi_tpu.tuning` regenerates it (span-anchored,
+# re-parse-verified, idempotent — tuning/presets_io.py); hand-edits
+# inside the span are overwritten by the next committed sweep.  Values
+# start at the registry defaults and only move when a seeded sweep's
+# verdict gate (bench_compare + doctor flags + history diff) passes.
+# --- BEGIN TUNED PRESETS (maintained by `python -m theanompi_tpu.tuning`) ---
+TUNED: Dict[str, Dict[str, Any]] = {
+    'fleet': {
+        'fleet_replicas': 3,
+    },
+    'serve': {
+        'kv_dtype': 'fp32',
+        'prefill_chunk': 256,
+        'spec_k': 8,
+    },
+    'train': {
+        'easgd_tau': 10,
+        'exchange_bucket_mb': 4.0,
+        'trace_sample': 1,
+    },
+}
+# --- END TUNED PRESETS ---
+
+
+def get_tuned(plan: str) -> Dict[str, Any]:
+    """The committed tuned knob values for one plan (a copy)."""
+    if plan not in TUNED:
+        raise KeyError(
+            f"unknown tuning plan {plan!r}; available: {sorted(TUNED)}"
+        )
+    return dict(TUNED[plan])
+
+
 def get_preset(name: str) -> Dict[str, Any]:
     if name not in PRESETS:
         raise KeyError(

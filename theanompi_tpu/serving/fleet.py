@@ -267,6 +267,14 @@ class ServeReplica:
                 "headroom": (
                     int(pool.n_free) if pool is not None else 0
                 ),
+                # demand-pressure counters for scaling_signals(): how
+                # often THIS replica pushed work away
+                "backpressure": int(
+                    self.scheduler.stats.get("backpressure_events", 0)
+                ),
+                "drain_refusals": int(
+                    self.scheduler.stats.get("drain_refusals", 0)
+                ),
             }
         return reply
 
@@ -327,7 +335,8 @@ class _ReplicaState:
     __slots__ = (
         "name", "target", "block_size", "summary", "shed", "draining",
         "left", "dead", "active", "shed_events", "shed_since",
-        "shed_seconds", "tokens_out", "headroom",
+        "shed_seconds", "tokens_out", "headroom", "backpressure",
+        "drain_refusals",
     )
 
     def __init__(self, name: str, target):
@@ -345,6 +354,8 @@ class _ReplicaState:
         self.shed_since: Optional[float] = None
         self.shed_seconds = 0.0
         self.tokens_out = 0
+        self.backpressure = 0  # replica-side backpressure_events
+        self.drain_refusals = 0  # replica-side drain_refusals
 
     @property
     def admitting(self) -> bool:
@@ -402,6 +413,7 @@ class FleetRouter:
             "shed_events": 0,
             "drain_reroutes": 0,
             "poll_failures": 0,
+            "requests_lost": 0,
         }
 
     # ---- membership ---------------------------------------------------
@@ -575,6 +587,8 @@ class FleetRouter:
         self.roster.beat(state.name, step=reply.get("ticks"))
         state.summary = list(reply.get("summary") or ())
         state.headroom = int(reply.get("headroom") or 0)
+        state.backpressure = int(reply.get("backpressure") or 0)
+        state.drain_refusals = int(reply.get("drain_refusals") or 0)
         state.draining = bool(reply.get("draining"))
         now = self.clock()
         healthy = bool(reply.get("healthy", True))
@@ -648,6 +662,7 @@ class FleetRouter:
                 )[0])
             except FleetError:
                 st.done = True  # surfaced as a violation by the drill
+                self.stats["requests_lost"] += 1
                 self._alert(
                     "request_lost",
                     f"stream {st.id!r} could not re-admit anywhere",
@@ -707,6 +722,54 @@ class FleetRouter:
 
     def outputs(self) -> Dict[str, List[int]]:
         return {s.id: list(s.tokens) for s in self._streams.values()}
+
+    def scaling_signals(self) -> Dict[str, Any]:
+        """One snapshot of the demand-vs-capacity picture — the feed the
+        tuning driver's ``fleet_replicas`` knob judges against.
+
+        Everything here is already maintained by ``pump()``; this method
+        only assembles it (and exports the gauges), so it is safe to
+        call at any cadence.  ``queue_depth`` counts streams the router
+        has accepted but not finished — the fleet's actual backlog, not
+        any one replica's."""
+        queue_depth = sum(
+            1 for s in self._streams.values() if not s.done
+        )
+        headroom: Dict[str, int] = {}
+        live = admitting = shedding = 0
+        backpressure = drain_refusals = 0
+        for name, s in self._replicas.items():
+            if s.dead or s.left:
+                continue
+            live += 1
+            headroom[name] = s.headroom
+            backpressure += s.backpressure
+            drain_refusals += s.drain_refusals
+            if s.admitting:
+                admitting += 1
+            if s.shed:
+                shedding += 1
+        sig = {
+            "queue_depth": queue_depth,
+            "replicas_total": len(self._replicas),
+            "replicas_live": live,
+            "replicas_admitting": admitting,
+            "replicas_shedding": shedding,
+            "backpressure_refusals": backpressure,
+            "drain_refusals": drain_refusals,
+            "drain_reroutes": self.stats["drain_reroutes"],
+            "shed_events": self.stats["shed_events"],
+            "requests_lost": self.stats["requests_lost"],
+            "headroom": headroom,
+            "headroom_total": sum(headroom.values()),
+            "headroom_min": min(headroom.values()) if headroom else 0,
+        }
+        smetrics.FLEET_QUEUE_DEPTH.set(queue_depth)
+        smetrics.FLEET_ADMITTING.set(admitting)
+        smetrics.FLEET_BACKPRESSURE.set(backpressure)
+        for name, free in headroom.items():
+            smetrics.FLEET_HEADROOM.set(free, replica=name)
+        return sig
 
     def fleet_stats(self) -> Dict[str, Any]:
         """The ``detail.fleet`` feed: router stats + per-replica rows."""

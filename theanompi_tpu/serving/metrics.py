@@ -124,6 +124,27 @@ FLEET_DRAIN_REROUTES = _REG.counter(
     "submissions a draining/refusing replica bounced that the router "
     "placed elsewhere",
 )
+# scaling gauges: FleetRouter.scaling_signals() refreshes these — the
+# demand-vs-capacity snapshot the tuning driver sizes the fleet by
+FLEET_QUEUE_DEPTH = _REG.gauge(
+    "serve_fleet_queue_depth",
+    "streams the router has accepted but not finished (fleet backlog)",
+)
+FLEET_ADMITTING = _REG.gauge(
+    "serve_fleet_replicas_admitting",
+    "replicas currently accepting new admissions (live, not draining, "
+    "not shed)",
+)
+FLEET_BACKPRESSURE = _REG.gauge(
+    "serve_fleet_backpressure_refusals",
+    "replica-side backpressure refusals summed over live replicas "
+    "(demand the fleet pushed away)",
+)
+FLEET_HEADROOM = _REG.gauge(
+    "serve_fleet_headroom_blocks",
+    "free KV pool blocks per live replica (replica label) — the "
+    "capacity side of the scaling decision",
+)
 
 # ---- speculative decoding (serving/spec.py drives these) -----------------
 # accepted/proposed is THE spec-decode health signal: a collapsing

@@ -1,0 +1,44 @@
+"""Closed-loop self-tuning: knob registry, trial harness, search driver.
+
+Theano-MPI's throughput hinged on hand-tuned exchange parameters
+(arXiv:1605.08325), and the comm-tuning landscape is workload-dependent
+enough (arXiv:1810.11112) that static choices leave real throughput on
+the table.  This repo accumulated every judging instrument —
+``bench_compare``, doctor threshold flags, ``observability history
+diff``, perf_gate legs — but nothing invoked them round-over-round.
+This package closes the loop:
+
+- :mod:`~theanompi_tpu.tuning.knobs` — the typed registry: every
+  tunable names its ladder, the bench that measures it, and the
+  verdict flags that judge it.  Bad domains are refused loudly at
+  import time.
+- :mod:`~theanompi_tpu.tuning.trials` — one candidate config through
+  ``bench.py``/``bench_serve.py`` in a subprocess with a seeded
+  workload; the structured verdict composes ``bench_compare`` (vs the
+  incumbent), doctor threshold flags, declared detail checks, and
+  ``history diff`` over the live-plane verdict timelines.  Any red
+  flag disqualifies.  Trials journal to JSONL so a crashed sweep
+  resumes instead of re-measuring.
+- :mod:`~theanompi_tpu.tuning.driver` — deterministic coordinate
+  descent over the ladders with successive-halving budgets (short
+  trials prune, survivors re-measure on a fresh seed); winners land
+  in ``presets.py`` via the span-anchored updater in
+  :mod:`~theanompi_tpu.tuning.presets_io`, losers are banked as
+  evidence files.
+- ``python -m theanompi_tpu.tuning --plan serve|train|fleet`` — the
+  CLI; the plan selector scopes the knob set.
+
+Everything here is pure stdlib (no jax import): the driver must run
+on the coordinator host while the benches own the accelerator.
+"""
+
+from theanompi_tpu.tuning.knobs import (  # noqa: F401
+    Check,
+    Knob,
+    KnobError,
+    PLANS,
+    REGISTRY,
+    knobs_for_plan,
+    plan_defaults,
+    validate_config,
+)

@@ -1,0 +1,112 @@
+"""``python -m theanompi_tpu.tuning`` — the closed-loop sweep CLI.
+
+Examples::
+
+    # sweep the serving knobs on the CPU-rehearsal bench, commit winners
+    python -m theanompi_tpu.tuning --plan serve
+
+    # fixture-driven mini-sweep (what the perf_gate TUNE leg runs)
+    python -m theanompi_tpu.tuning --plan serve \
+        --bench-cmd "python tests/data/tuning/fixture_bench.py" \
+        --presets /tmp/presets_copy.py --workdir /tmp/tune --json
+
+Exit codes: 0 sweep completed (with or without a new winner),
+1 the sweep could not run (bad knob domain, dead incumbent bench,
+presets edit refused).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+from typing import List, Optional
+
+from theanompi_tpu.tuning import knobs as knobs_mod
+from theanompi_tpu.tuning.driver import DriverConfig, run_search
+from theanompi_tpu.tuning.knobs import KnobError
+from theanompi_tpu.tuning.presets_io import PresetsEditError
+from theanompi_tpu.tuning.trials import TrialError
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m theanompi_tpu.tuning",
+        description="verdict-gated knob search; winners land in "
+                    "presets.py's TUNED span",
+    )
+    p.add_argument("--plan", required=True, choices=knobs_mod.PLANS,
+                   help="which knob set to sweep")
+    p.add_argument("--seed", type=int, default=0,
+                   help="workload seed; same seed => same trial "
+                        "sequence => same winner (default 0)")
+    p.add_argument("--rounds", type=int, default=2,
+                   help="max coordinate-descent passes over the knob "
+                        "set (stops early when a pass improves "
+                        "nothing; default 2)")
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="bench_compare relative tolerance (default "
+                        "0.05)")
+    p.add_argument("--top-k", type=int, default=2,
+                   help="max short-trial survivors re-measured at "
+                        "full budget per knob (default 2)")
+    p.add_argument("--bench-cmd", default=None,
+                   help="override the plan's bench command (shlex-"
+                        "split; the fixture path for gate/tests)")
+    p.add_argument("--workdir", default="",
+                   help="trial scratch dir (default .tuning/<plan>)")
+    p.add_argument("--journal", default="",
+                   help="trial journal JSONL (default "
+                        "<workdir>/journal.jsonl) — a crashed sweep "
+                        "rerun resumes from it")
+    p.add_argument("--evidence", default="",
+                   help="evidence dir for per-knob decision JSONs "
+                        "(default <workdir>/evidence)")
+    p.add_argument("--presets", default="",
+                   help="presets file to read/commit TUNED winners "
+                        "(default theanompi_tpu/presets.py)")
+    p.add_argument("--timeout-s", type=float, default=1800.0,
+                   help="per-trial bench timeout (default 1800)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="search and bank evidence but never write "
+                        "presets")
+    p.add_argument("--json", action="store_true",
+                   help="print the full report as JSON on stdout")
+    args = p.parse_args(argv)
+
+    cfg = DriverConfig(
+        plan=args.plan,
+        seed=args.seed,
+        rounds=args.rounds,
+        tolerance=args.tolerance,
+        top_k=args.top_k,
+        workdir=args.workdir,
+        bench_cmd=(
+            shlex.split(args.bench_cmd) if args.bench_cmd else None
+        ),
+        journal_path=args.journal,
+        evidence_dir=args.evidence,
+        presets_path=args.presets,
+        commit=not args.dry_run,
+        timeout_s=args.timeout_s,
+    )
+    log = (lambda *a, **k: print(*a, file=sys.stderr, **k))
+    try:
+        report = run_search(cfg, log=log)
+    except (KnobError, TrialError, PresetsEditError, OSError) as e:
+        print(f"[tuning] FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        log(f"[tuning] done: winners={report.get('winners')} "
+            f"changed={report.get('changed')} "
+            f"committed={report.get('committed')} "
+            f"trials={report.get('trials')}")
+    return 0 if report.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

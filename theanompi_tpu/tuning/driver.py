@@ -1,0 +1,283 @@
+"""Deterministic coordinate-descent search with successive-halving budgets.
+
+The search is boring on purpose — same seed, same trial sequence,
+same winner, every run:
+
+- **coordinate descent**: knobs sweep in registry order; each knob's
+  candidates are its ladder rungs minus the incumbent value, in
+  ladder order.  No randomness anywhere.
+- **successive halving**: every candidate first runs a ``short``
+  budget trial (seed = ``--seed``) against the incumbent's short
+  measurement; only passing candidates that beat the incumbent's
+  short headline survive, and only the top half (capped at
+  ``--top-k``) graduate to a ``full`` budget re-measure on a FRESH
+  seed (``--seed + 1``) — a candidate that only won by overfitting
+  the short workload dies here.
+- **verdict-gated adoption**: a survivor is adopted only when its
+  full-budget :func:`~theanompi_tpu.tuning.trials.judge` verdict
+  passes (bench_compare + detail checks + doctor flags + history
+  diff) AND its headline strictly beats the incumbent's full
+  measurement.  A red flag on any instrument disqualifies — a planted
+  regression can look fast and still never commit.
+- **evidence banking**: every knob decision (all candidates, their
+  verdicts, the winner or the refusal) lands as a deterministic JSON
+  file; the losers' measurements are the audit trail for "why is the
+  committed value X".
+
+Winners are merged into ``presets.py``'s TUNED span via
+:mod:`~theanompi_tpu.tuning.presets_io` unless ``--dry-run``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from theanompi_tpu.tuning import knobs as knobs_mod
+from theanompi_tpu.tuning import presets_io, trials
+from theanompi_tpu.tuning.knobs import Knob, KnobError
+
+
+def default_bench_cmd(plan: str) -> List[str]:
+    """The real bench for a plan (CPU rehearsal is forced by trials)."""
+    root = trials._repo_root()
+    script = "bench.py" if plan == "train" else "bench_serve.py"
+    return [sys.executable, os.path.join(root, script)]
+
+
+@dataclass
+class DriverConfig:
+    plan: str
+    seed: int = 0
+    rounds: int = 2
+    tolerance: float = 0.05
+    top_k: int = 2
+    workdir: str = ""
+    bench_cmd: Optional[List[str]] = None
+    journal_path: str = ""
+    evidence_dir: str = ""
+    presets_path: str = ""
+    commit: bool = True
+    timeout_s: float = 1800.0
+    env_extra: Dict[str, str] = field(default_factory=dict)
+
+    def resolve(self) -> "DriverConfig":
+        if self.plan not in knobs_mod.PLANS:
+            raise KnobError(
+                f"unknown plan {self.plan!r}; plans: {knobs_mod.PLANS}"
+            )
+        if not self.workdir:
+            self.workdir = os.path.join(".tuning", self.plan)
+        if not self.journal_path:
+            self.journal_path = os.path.join(self.workdir,
+                                             "journal.jsonl")
+        if not self.evidence_dir:
+            self.evidence_dir = os.path.join(self.workdir, "evidence")
+        if not self.presets_path:
+            self.presets_path = presets_io.default_presets_path()
+        if self.bench_cmd is None:
+            self.bench_cmd = default_bench_cmd(self.plan)
+        if self.rounds < 1:
+            raise KnobError("--rounds must be >= 1")
+        if self.top_k < 1:
+            raise KnobError("--top-k must be >= 1")
+        return self
+
+
+def _bank(evidence_dir: str, name: str, doc: dict) -> str:
+    os.makedirs(evidence_dir, exist_ok=True)
+    path = os.path.join(evidence_dir, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _row_headline(row: dict) -> Optional[float]:
+    return trials._headline(row["trial"])
+
+
+def _strip_paths(rec: dict) -> dict:
+    """Evidence copy of a trial record without machine-local absolute
+    paths (evidence must diff clean across checkouts)."""
+    out = dict(rec)
+    out.pop("bench_cmd", None)
+    out.pop("timeline", None)
+    return out
+
+
+def run_search(cfg: DriverConfig, log=print) -> dict:
+    """The sweep.  Returns the report dict (also what ``--json``
+    prints): winners, per-knob decisions, trial counts, whether
+    presets changed."""
+    cfg.resolve()
+    plan_knobs: List[Knob] = knobs_mod.knobs_for_plan(cfg.plan)
+    active = [k for k in plan_knobs if not k.inert_on_bench]
+    skipped_inert = [k.name for k in plan_knobs if k.inert_on_bench]
+    for name in skipped_inert:
+        log(f"[tuning] knob {name}: inert on the committed bench — "
+            "skipped (would measure noise)")
+
+    # the incumbent starts from what is already committed; defaults
+    # fill any knob the TUNED block has not met yet
+    committed = presets_io.read_tuned(cfg.presets_path).get(cfg.plan, {})
+    config = knobs_mod.plan_defaults(cfg.plan)
+    for name, value in committed.items():
+        if name in config:
+            config[name] = knobs_mod.get_knob(name).coerce(value)
+    config = knobs_mod.validate_config(cfg.plan, config)
+
+    journal = trials.Journal(cfg.journal_path)
+    counters = {"run": 0, "cached": 0}
+    sequence: List[str] = []
+
+    def measure(candidate: Dict[str, Any], budget: str, seed: int) -> dict:
+        rec = trials.run_trial(
+            cfg.plan, candidate, budget=budget, seed=seed,
+            workdir=cfg.workdir, bench_cmd=list(cfg.bench_cmd),
+            journal=journal, env_extra=cfg.env_extra,
+            timeout_s=cfg.timeout_s,
+        )
+        counters["cached" if rec.get("cached") else "run"] += 1
+        sequence.append(rec["key"])
+        return rec
+
+    short_seed, full_seed = cfg.seed, cfg.seed + 1
+    log(f"[tuning] plan={cfg.plan} seed={cfg.seed} knobs="
+        f"{[k.name for k in active]} incumbent={config}")
+    incumbent_full = measure(config, "full", full_seed)
+    if incumbent_full.get("bench") is None:
+        report = {
+            "plan": cfg.plan, "seed": cfg.seed, "ok": False,
+            "error": "incumbent measurement failed: "
+                     f"{incumbent_full.get('error')}",
+            "winners": config, "changed": {}, "committed": False,
+            "trials": dict(counters), "decisions": [],
+        }
+        return report
+
+    decisions: List[dict] = []
+    changed: Dict[str, Any] = {}
+    for rnd in range(cfg.rounds):
+        improved = False
+        for knob in active:
+            incumbent_short = measure(config, "short", short_seed)
+            inc_short_v = trials._headline(incumbent_short)
+            candidates = [v for v in knob.ladder
+                          if v != config[knob.name]]
+            shorts: List[dict] = []
+            for value in candidates:
+                cand_cfg = dict(config)
+                cand_cfg[knob.name] = value
+                rec = measure(cand_cfg, "short", short_seed)
+                verdict = trials.judge(
+                    incumbent_short, rec, [knob], cfg.tolerance
+                )
+                shorts.append(
+                    {"value": value, "trial": _strip_paths(rec),
+                     "verdict": verdict}
+                )
+            passing = [
+                s for s in shorts
+                if s["verdict"]["pass"]
+                and _row_headline(s) is not None
+                and inc_short_v is not None
+                and _row_headline(s) > inc_short_v
+            ]
+            # halving: top half by short headline (>=1 when any
+            # passed), deterministic tiebreak on ladder position
+            passing.sort(
+                key=lambda s: (
+                    -_row_headline(s),
+                    knob.ladder.index(s["value"]),
+                )
+            )
+            keep = min(cfg.top_k, max(1, (len(passing) + 1) // 2))
+            survivors = passing[:keep]
+            fulls: List[dict] = []
+            best = None
+            inc_full_v = trials._headline(incumbent_full)
+            for s in survivors:
+                cand_cfg = dict(config)
+                cand_cfg[knob.name] = s["value"]
+                rec = measure(cand_cfg, "full", full_seed)
+                verdict = trials.judge(
+                    incumbent_full, rec, plan_knobs, cfg.tolerance
+                )
+                row = {"value": s["value"],
+                       "trial": _strip_paths(rec), "verdict": verdict}
+                fulls.append(row)
+                v = trials._headline(rec)
+                if (
+                    verdict["pass"]
+                    and v is not None
+                    and inc_full_v is not None
+                    and v > inc_full_v
+                    and (best is None
+                         or v > trials._headline(best["trial"]))
+                ):
+                    best = {"value": s["value"], "trial": rec,
+                            "verdict": verdict}
+            decision = {
+                "round": rnd,
+                "knob": knob.name,
+                "incumbent_value": config[knob.name],
+                "incumbent_headline": inc_full_v,
+                "shorts": shorts,
+                "survivors": [s["value"] for s in survivors],
+                "fulls": fulls,
+                "winner": None if best is None else best["value"],
+            }
+            if best is not None:
+                config[knob.name] = best["value"]
+                changed[knob.name] = best["value"]
+                incumbent_full = best["trial"]
+                improved = True
+                log(f"[tuning] r{rnd} {knob.name}: "
+                    f"{decision['incumbent_value']!r} -> "
+                    f"{best['value']!r} (headline "
+                    f"{inc_full_v} -> "
+                    f"{trials._headline(best['trial'])})")
+            else:
+                log(f"[tuning] r{rnd} {knob.name}: incumbent "
+                    f"{config[knob.name]!r} stands "
+                    f"({len(shorts) - len(passing)} of {len(shorts)} "
+                    "candidates disqualified or slower)")
+            decisions.append(decision)
+            _bank(
+                cfg.evidence_dir,
+                f"{cfg.plan}_r{rnd}_{knob.name}.json",
+                decision,
+            )
+        if not improved:
+            break
+
+    committed_now = False
+    if changed and cfg.commit:
+        committed_now = presets_io.update_presets(
+            cfg.presets_path, cfg.plan, changed
+        )
+        log(f"[tuning] committed {changed} into {cfg.presets_path}"
+            if committed_now else
+            "[tuning] winners already committed (idempotent no-op)")
+    elif changed:
+        log(f"[tuning] dry run: winners {changed} NOT committed")
+
+    return {
+        "plan": cfg.plan,
+        "seed": cfg.seed,
+        "ok": True,
+        "winners": config,
+        "changed": changed,
+        "committed": committed_now,
+        "skipped_inert": skipped_inert,
+        "trials": dict(counters),
+        "sequence": sequence,
+        "decisions": decisions,
+        "evidence_dir": cfg.evidence_dir,
+    }

@@ -1,0 +1,359 @@
+"""The typed knob registry: what is tunable, over what domain, judged how.
+
+A :class:`Knob` is a declaration, not a mechanism: it names the ladder
+of values the search may try, the plan that owns it (``train`` /
+``serve`` / ``fleet`` — the ``--plan`` selector), the bench that
+measures it, and the verdict instruments that judge a candidate:
+
+- ``checks`` — declarative bounds evaluated directly on the BENCH
+  JSON's ``detail`` tree (the same fields the perf_gate legs assert);
+- ``doctor_flags`` — ``observability.analysis.check_thresholds``
+  kwargs applied to the candidate's dumped trace;
+- ``history_flags`` — ``observability.history.diff`` kwargs applied
+  incumbent-timeline → candidate-timeline (the round-over-round gate).
+
+Validation is loud and happens at construction: a ladder with
+duplicates, a default outside the ladder, or a value of the wrong
+type is a :class:`KnobError` at import time, not a silent sweep over
+garbage.  The registry order is the search order (deterministic).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Tuple
+
+PLANS = ("train", "serve", "fleet")
+BENCHES = ("train", "serve")
+_KINDS = {"int": int, "float": (int, float), "choice": str}
+_CHECK_OPS = ("<=", ">=", "==", "truthy")
+
+
+class KnobError(ValueError):
+    """A knob declaration (or a config against one) that cannot stand."""
+
+
+@dataclass(frozen=True)
+class Check:
+    """One declarative bound on the candidate's BENCH ``detail`` tree.
+
+    ``path`` indexes into ``detail``; ``required=True`` makes a missing
+    path a violation (the probe the knob rides on did not run), while
+    ``required=False`` downgrades absence to a note — the check only
+    judges what the bench actually measured.
+    """
+
+    path: Tuple[str, ...]
+    op: str
+    value: Any = None
+    required: bool = False
+
+    def __post_init__(self):
+        if not self.path or not all(
+            isinstance(p, str) and p for p in self.path
+        ):
+            raise KnobError(f"check path must be non-empty strings: "
+                            f"{self.path!r}")
+        if self.op not in _CHECK_OPS:
+            raise KnobError(
+                f"check op {self.op!r} not in {_CHECK_OPS}"
+            )
+        if self.op != "truthy" and not isinstance(
+            self.value, (int, float)
+        ):
+            raise KnobError(
+                f"check {'.'.join(self.path)}: op {self.op!r} needs a "
+                f"numeric bound, got {self.value!r}"
+            )
+
+    def evaluate(self, detail: Mapping[str, Any]) -> Tuple[str, str]:
+        """``(status, message)`` with status ``ok``/``violation``/
+        ``missing`` (missing escalates per ``required``)."""
+        cur: Any = detail
+        label = ".".join(self.path)
+        for key in self.path:
+            if not isinstance(cur, Mapping) or key not in cur:
+                if self.required:
+                    return ("violation",
+                            f"{label}: required by check but absent "
+                            "from the bench detail")
+                return ("missing", f"{label}: absent — check skipped")
+            cur = cur[key]
+        if self.op == "truthy":
+            ok = bool(cur)
+            want = "truthy"
+        elif self.op == "<=":
+            ok = float(cur) <= float(self.value)
+            want = f"<= {self.value}"
+        elif self.op == ">=":
+            ok = float(cur) >= float(self.value)
+            want = f">= {self.value}"
+        else:  # "=="
+            ok = float(cur) == float(self.value)
+            want = f"== {self.value}"
+        if ok:
+            return ("ok", f"{label}: {cur!r} {want}")
+        return ("violation", f"{label}: {cur!r} violates {want}")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # "int" | "float" | "choice"
+    ladder: Tuple[Any, ...]
+    default: Any
+    plan: str  # which --plan sweeps it
+    bench: str  # which bench measures it ("train" -> bench.py)
+    description: str
+    checks: Tuple[Check, ...] = ()
+    doctor_flags: Mapping[str, float] = field(default_factory=dict)
+    history_flags: Mapping[str, float] = field(default_factory=dict)
+    # honest flag: the committed bench exercises the injection path but
+    # the measured workload does not depend on the value (e.g. EASGD τ
+    # against the BSP train bench) — the driver refuses to "tune" it
+    inert_on_bench: bool = False
+
+    def __post_init__(self):
+        if not self.name.isidentifier():
+            raise KnobError(f"knob name {self.name!r} is not an "
+                            "identifier")
+        if self.kind not in _KINDS:
+            raise KnobError(
+                f"knob {self.name}: kind {self.kind!r} not in "
+                f"{sorted(_KINDS)}"
+            )
+        if self.plan not in PLANS:
+            raise KnobError(
+                f"knob {self.name}: plan {self.plan!r} not in {PLANS}"
+            )
+        if self.bench not in BENCHES:
+            raise KnobError(
+                f"knob {self.name}: bench {self.bench!r} not in "
+                f"{BENCHES}"
+            )
+        if not isinstance(self.ladder, tuple) or len(self.ladder) < 2:
+            raise KnobError(
+                f"knob {self.name}: ladder needs >= 2 rungs, got "
+                f"{self.ladder!r}"
+            )
+        want = _KINDS[self.kind]
+        for v in self.ladder:
+            if not isinstance(v, want) or isinstance(v, bool):
+                raise KnobError(
+                    f"knob {self.name}: ladder value {v!r} is not "
+                    f"{self.kind}"
+                )
+        if len(set(self.ladder)) != len(self.ladder):
+            raise KnobError(
+                f"knob {self.name}: ladder has duplicates: "
+                f"{self.ladder!r}"
+            )
+        if self.kind in ("int", "float") and list(self.ladder) != sorted(
+            self.ladder
+        ):
+            raise KnobError(
+                f"knob {self.name}: numeric ladder must be ascending "
+                f"(deterministic search order): {self.ladder!r}"
+            )
+        if self.default not in self.ladder:
+            raise KnobError(
+                f"knob {self.name}: default {self.default!r} is not on "
+                f"the ladder {self.ladder!r}"
+            )
+        for flag in self.doctor_flags:
+            if not flag.startswith(("max_", "min_")):
+                raise KnobError(
+                    f"knob {self.name}: doctor flag {flag!r} must be a "
+                    "max_*/min_* threshold kwarg"
+                )
+
+    def coerce(self, value: Any) -> Any:
+        """Validate one value against this knob's domain (loud)."""
+        if value not in self.ladder:
+            raise KnobError(
+                f"knob {self.name}: {value!r} is not on the ladder "
+                f"{self.ladder!r}"
+            )
+        return value
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Order within a plan = coordinate-descent order: the
+# knob with the best-understood landscape first (its winner re-anchors
+# the incumbent the later knobs are judged against).
+# ---------------------------------------------------------------------------
+
+_NO_NEW_ALERTS = {"max_new_alerts": 0}
+
+REGISTRY: Tuple[Knob, ...] = (
+    # ---- train plan (bench.py: AlexNet-128 8-way BSP) --------------------
+    Knob(
+        name="exchange_bucket_mb",
+        kind="float",
+        ladder=(1.0, 2.0, 4.0, 8.0, 16.0),
+        default=4.0,
+        plan="train",
+        bench="train",
+        description=(
+            "allreduce bucket size (MB) — the docs/perf/NOTES.md knee: "
+            "too small pays per-bucket pad/scale overhead, too large "
+            "kills comm/compute overlap"
+        ),
+        doctor_flags={"min_overlap": 0.0},
+        history_flags=dict(_NO_NEW_ALERTS, max_overlap_drop=0.5),
+    ),
+    Knob(
+        name="easgd_tau",
+        kind="int",
+        ladder=(2, 5, 10, 20, 40),
+        default=10,
+        plan="train",
+        bench="train",
+        description=(
+            "EASGD communication period τ (worker steps between center "
+            "exchanges) — the elastic-averaging staleness/traffic "
+            "trade-off (arXiv:1605.08325 §4)"
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+        # the committed train bench is the BSP AlexNet config: it
+        # accepts and echoes the override but its workload never runs
+        # the EASGD rule, so a sweep here would measure noise.  The
+        # driver skips inert knobs and says so; a multi-host EASGD
+        # bench window flips this off.
+        inert_on_bench=True,
+    ),
+    Knob(
+        name="trace_sample",
+        kind="int",
+        ladder=(1, 2, 8, 32),
+        default=1,
+        plan="train",
+        bench="train",
+        description=(
+            "span-trace sampling keep-1-in-N (observability overhead "
+            "vs attribution resolution; instants/counters always kept)"
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+    ),
+    # ---- serve plan (bench_serve.py: paged transformer serving) ----------
+    Knob(
+        name="spec_k",
+        kind="int",
+        ladder=(0, 2, 4, 8, 16),
+        default=8,
+        plan="serve",
+        bench="serve",
+        description=(
+            "speculative-decoding draft length k (0 disables): deeper "
+            "drafts amortize more target dispatches but waste compute "
+            "when acceptance collapses"
+        ),
+        checks=(
+            Check(path=("spec", "token_identical"), op="truthy"),
+            Check(path=("spec", "accept_rate"), op=">=", value=0.05),
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+    ),
+    Knob(
+        name="kv_dtype",
+        kind="choice",
+        ladder=("fp32", "int8"),
+        default="fp32",
+        plan="serve",
+        bench="serve",
+        description=(
+            "KV-cache pool dtype: int8 doubles block capacity at a "
+            "bounded dequant-drift cost (the kv_quant probe measures "
+            "the drift)"
+        ),
+        checks=(
+            Check(path=("kv_quant", "greedy_drift"),
+                  op="<=", value=0.1),
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+    ),
+    Knob(
+        name="prefill_chunk",
+        kind="int",
+        ladder=(64, 128, 256, 512),
+        default=256,
+        plan="serve",
+        bench="serve",
+        description=(
+            "chunked-prefill dispatch size (tokens): the prefill "
+            "bucket ladder's top rung — bigger chunks batch better, "
+            "smaller chunks interleave decode sooner (TTFT)"
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+    ),
+    # ---- fleet plan (bench_serve.py --replicas: router + N replicas) -----
+    Knob(
+        name="fleet_replicas",
+        kind="int",
+        ladder=(2, 3, 4),
+        default=3,
+        plan="fleet",
+        bench="serve",
+        description=(
+            "serving-fleet replica count — tuned against the router's "
+            "scaling signals (FleetRouter.scaling_signals): a rung "
+            "that sheds, loses streams, or starves headroom is "
+            "disqualified regardless of its tokens/sec"
+        ),
+        checks=(
+            Check(path=("fleet", "scaling", "requests_lost"),
+                  op="<=", value=0, required=True),
+            Check(path=("fleet", "scaling", "queue_depth"),
+                  op="<=", value=0, required=True),
+            Check(path=("fleet", "scaling", "replicas_admitting"),
+                  op=">=", value=1, required=True),
+            Check(path=("fleet", "scaling", "shed_events"),
+                  op="<=", value=0),
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+    ),
+)
+
+
+_BY_NAME: Dict[str, Knob] = {}
+for _k in REGISTRY:
+    if _k.name in _BY_NAME:
+        raise KnobError(f"duplicate knob name {_k.name!r} in REGISTRY")
+    _BY_NAME[_k.name] = _k
+
+
+def get_knob(name: str) -> Knob:
+    if name not in _BY_NAME:
+        raise KnobError(
+            f"unknown knob {name!r}; registered: {sorted(_BY_NAME)}"
+        )
+    return _BY_NAME[name]
+
+
+def knobs_for_plan(plan: str) -> List[Knob]:
+    """The plan's knob set in registry (= search) order."""
+    if plan not in PLANS:
+        raise KnobError(f"unknown plan {plan!r}; plans: {PLANS}")
+    return [k for k in REGISTRY if k.plan == plan]
+
+
+def plan_defaults(plan: str) -> Dict[str, Any]:
+    return {k.name: k.default for k in knobs_for_plan(plan)}
+
+
+def validate_config(plan: str, config: Mapping[str, Any]) -> Dict[str, Any]:
+    """A full candidate config for ``plan``: every knob present, every
+    value on its ladder, no strays.  Returns a plain dict copy."""
+    knobs = knobs_for_plan(plan)
+    names = {k.name for k in knobs}
+    stray = sorted(set(config) - names)
+    if stray:
+        raise KnobError(
+            f"plan {plan!r}: config has unregistered knob(s) {stray}"
+        )
+    missing = sorted(names - set(config))
+    if missing:
+        raise KnobError(
+            f"plan {plan!r}: config is missing knob(s) {missing}"
+        )
+    return {k.name: k.coerce(config[k.name]) for k in knobs}

@@ -1,0 +1,157 @@
+"""Span-anchored ``presets.py`` updater (fixer-style; see analysis/fixer).
+
+The driver's winners land in the ``TUNED`` block of
+``theanompi_tpu/presets.py`` — the one marker-delimited span this
+module owns.  Same discipline as the graftlint fixer:
+
+- **span-anchored**: only the text between the single BEGIN/END marker
+  pair is regenerated; everything else in the file is untouched bytes.
+  Zero or multiple marker pairs is a loud error, never a guess.
+- **re-parse-verified**: the updated file must ``ast.parse``, and the
+  regenerated span must round-trip (parse → render) to itself before
+  anything is written.
+- **idempotent**: rendering is deterministic (sorted plans, sorted
+  knobs, ``repr`` values), so committing the same winners twice is
+  byte-identical and a no-op write.
+
+Writes are atomic (tmp + ``os.replace``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, Mapping, Tuple
+
+from theanompi_tpu.tuning.knobs import KnobError, PLANS, get_knob
+
+BEGIN_MARK = "# --- BEGIN TUNED PRESETS (maintained by `python -m theanompi_tpu.tuning`) ---"
+END_MARK = "# --- END TUNED PRESETS ---"
+
+
+class PresetsEditError(RuntimeError):
+    """The presets file cannot be safely edited (markers, parse)."""
+
+
+def default_presets_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "presets.py",
+    )
+
+
+def render_tuned(tuned: Mapping[str, Mapping[str, Any]]) -> str:
+    """The TUNED block body (no markers), deterministically ordered."""
+    lines = ["TUNED: Dict[str, Dict[str, Any]] = {"]
+    for plan in sorted(tuned):
+        lines.append(f"    {plan!r}: {{")
+        for name in sorted(tuned[plan]):
+            lines.append(f"        {name!r}: {tuned[plan][name]!r},")
+        lines.append("    },")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _find_span(text: str) -> Tuple[int, int, list]:
+    """(begin_line_idx, end_line_idx, lines) — exactly one marker pair."""
+    lines = text.splitlines()
+    begins = [i for i, l in enumerate(lines) if l.strip() == BEGIN_MARK]
+    ends = [i for i, l in enumerate(lines) if l.strip() == END_MARK]
+    if len(begins) != 1 or len(ends) != 1:
+        raise PresetsEditError(
+            f"need exactly one TUNED marker pair, found "
+            f"{len(begins)} BEGIN / {len(ends)} END"
+        )
+    if begins[0] >= ends[0]:
+        raise PresetsEditError("TUNED BEGIN marker comes after END")
+    return begins[0], ends[0], lines
+
+
+def _parse_block(block: str) -> Dict[str, Dict[str, Any]]:
+    try:
+        mod = ast.parse(block)
+    except SyntaxError as e:
+        raise PresetsEditError(f"TUNED block does not parse: {e}")
+    for node in mod.body:
+        target = None
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            target = node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        if target == "TUNED" and node.value is not None:
+            value = ast.literal_eval(node.value)
+            if not isinstance(value, dict) or not all(
+                isinstance(v, dict) for v in value.values()
+            ):
+                raise PresetsEditError(
+                    "TUNED must be a dict of per-plan dicts"
+                )
+            return value
+    raise PresetsEditError("no TUNED assignment inside the marker span")
+
+
+def read_tuned(path: str) -> Dict[str, Dict[str, Any]]:
+    """The TUNED dict parsed out of the marker span (no import/exec)."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    b, e, lines = _find_span(text)
+    return _parse_block("\n".join(lines[b + 1:e]))
+
+
+def update_presets(
+    path: str, plan: str, winners: Mapping[str, Any]
+) -> bool:
+    """Merge ``winners`` into ``TUNED[plan]`` inside the span.
+
+    Returns True when the file changed (False = winners already
+    committed — the idempotent second run).  Verified before write:
+    the regenerated span round-trips and the whole file re-parses."""
+    if plan not in PLANS:
+        raise KnobError(f"unknown plan {plan!r}; plans: {PLANS}")
+    # domain gate: only registry knobs of this plan, on-ladder values —
+    # a committed winner the registry would refuse is corruption
+    for name, value in winners.items():
+        knob = get_knob(name)
+        if knob.plan != plan:
+            raise KnobError(
+                f"knob {name!r} belongs to plan {knob.plan!r}, not "
+                f"{plan!r}"
+            )
+        knob.coerce(value)
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    b, e, lines = _find_span(text)
+    tuned = _parse_block("\n".join(lines[b + 1:e]))
+    merged = {p: dict(v) for p, v in tuned.items()}
+    merged.setdefault(plan, {}).update(dict(winners))
+    block = render_tuned(merged)
+    # round-trip proof: what we render parses back to what we merged
+    if _parse_block(block) != merged:
+        raise PresetsEditError(
+            "render/parse round-trip mismatch — refusing to write"
+        )
+    # idempotency proof: rendering the parse of the render is stable
+    if render_tuned(_parse_block(block)) != block:
+        raise PresetsEditError(
+            "render is not idempotent — refusing to write"
+        )
+    new_lines = lines[: b + 1] + block.splitlines() + lines[e:]
+    new_text = "\n".join(new_lines)
+    if text.endswith("\n") and not new_text.endswith("\n"):
+        new_text += "\n"
+    try:
+        ast.parse(new_text)
+    except SyntaxError as err:
+        raise PresetsEditError(
+            f"updated presets file would not parse: {err}"
+        )
+    if new_text == text:
+        return False
+    tmp = path + ".tuning.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(new_text)
+    os.replace(tmp, path)
+    return True
