@@ -181,7 +181,15 @@
 #   PERF_GATE_LINT_BASELINE baseline artifact (default:
 #                           .graftlint_artifact.json)
 #   PERF_GATE_LINT_CURRENT  pre-produced current artifact (skips the
-#                           analyzer run — the smoke-test path)
+#                           analyzer run — the smoke-test path; also
+#                           skips the per-pass budget below, which
+#                           needs the real analyzer)
+#   PERF_GATE_LINT_PASS_BUDGET_MS  per-pass wall-time budget in ms for
+#                           `--bench --format json` (default 2500 —
+#                           the same number as the warm-run guard, but
+#                           applied to every UNCACHED pass, lockset
+#                           engine included, so one pass can never
+#                           quietly eat the whole budget)
 #
 # Exit codes: 0 green; 1 regression or threshold violation; 2 usage.
 set -euo pipefail
@@ -211,6 +219,47 @@ if [ "${PERF_GATE_LINT:-1}" = "1" ]; then
     if [ "$LINT_RC" != "0" ]; then
         echo "[perf_gate] LINT VIOLATION: graftlint artifact diff exited $LINT_RC (new finding, step-trace drift, or missing baseline artifact)" >&2
         exit 1
+    fi
+    # per-pass wall-time budget over the real (uncached) analyzer —
+    # skipped on the --current smoke path, which never runs it
+    if [ -z "$LINT_CURRENT" ]; then
+        LINT_PASS_BUDGET_MS="${PERF_GATE_LINT_PASS_BUDGET_MS:-2500}"
+        LINT_BENCH_JSON="$WORKDIR/lint_bench.json"
+        echo "[perf_gate] lint per-pass budget: ${LINT_PASS_BUDGET_MS} ms" >&2
+        if ! python -m theanompi_tpu.analysis --bench --format json \
+                > "$LINT_BENCH_JSON"; then
+            echo "[perf_gate] LINT VIOLATION: --bench --format json failed" >&2
+            exit 1
+        fi
+        if ! python - "$LINT_BENCH_JSON" "$LINT_PASS_BUDGET_MS" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+budget = float(sys.argv[2])
+passes = {p["name"]: p["ms"] for p in doc.get("passes", [])}
+bad = 0
+if "lockflow" not in passes:
+    print(
+        "[perf_gate] lint bench: no 'lockflow' timing — the lockset "
+        "engine did not run",
+        file=sys.stderr,
+    )
+    bad = 1
+for name, ms in sorted(passes.items()):
+    if ms > budget:
+        print(
+            f"[perf_gate] lint pass {name} took {ms:.1f} ms "
+            f"> budget {budget:.0f} ms",
+            file=sys.stderr,
+        )
+        bad = 1
+sys.exit(bad)
+PYEOF
+        then
+            echo "[perf_gate] LINT VIOLATION: per-pass wall-time budget exceeded (PERF_GATE_LINT_PASS_BUDGET_MS)" >&2
+            exit 1
+        fi
     fi
 fi
 

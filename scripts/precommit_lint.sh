@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# precommit_lint.sh — the pre-commit hook wrapper around graftlint's
+# --changed-only mode.
+#
+# Runs the full cache-backed analysis (the interprocedural passes need
+# the whole package in scope; a warm run is a stat sweep thanks to the
+# mtime+hash incremental cache) but reports ONLY findings in files git
+# sees as changed — staged, unstaged, or untracked — so a hook run on
+# a dirty tree stays readable.  Exit codes are graftlint's own:
+# 0 clean, 1 new findings in the changed set, 2 usage/I-O error.
+#
+# Install:  ln -s ../../scripts/precommit_lint.sh .git/hooks/pre-commit
+# (or call it from an existing hook).  Extra args pass through, e.g.
+# `scripts/precommit_lint.sh --format json`.
+set -euo pipefail
+
+cd "$(git rev-parse --show-toplevel)"
+exec python -m theanompi_tpu.analysis --changed-only "$@"

@@ -1,0 +1,46 @@
+# graftlint fixture: context-sensitive step inlining (ISSUE 17) — the
+# FALSE-MERGE family.  Two call sites of the SAME helper pass
+# different static mode strings; a context-insensitive inliner
+# memoizes one flattened trace for the helper and declares the arms
+# balanced.  The 1-level call-site context keys the summaries apart:
+# the "sum" site inlines to [psum], the "none" site to [], and the
+# divergence fires.  The helper itself is C002-clean — string-equality
+# dispatch is the sanctioned trace-time-constant shape — so ONLY the
+# context-sensitive whole-step comparison can see this.  Parsed only,
+# never executed.
+import jax
+from jax import lax
+
+
+def _exchange(v, mode):
+    if mode == "sum":
+        return lax.psum(v, "dp")
+    return v
+
+
+def merged_call_sites(x, flag):
+    # GL-C004 (warning): lexically EQUAL arms — both just call
+    # _exchange — but the static mode differs, so the inlined traces
+    # are [psum] vs [] and a worker pair disagreeing on `flag` hangs
+    if flag:
+        x = _exchange(x, "sum")
+    else:
+        x = _exchange(x, mode="none")
+    return x
+
+
+step_ctx = jax.jit(merged_call_sites, static_argnums=(1,))
+
+
+def same_ctx_ok(x, flag):
+    # NOT a finding: both sites pass the same static mode, so both
+    # arms inline to the same [psum] trace — context keys must merge
+    # identical contexts, not just split different ones
+    if flag:
+        x = _exchange(x, "sum")
+    else:
+        x = _exchange(x * 2.0, "sum")
+    return x
+
+
+step_same = jax.jit(same_ctx_ok, static_argnums=(1,))

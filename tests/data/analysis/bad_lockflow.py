@@ -1,0 +1,107 @@
+# graftlint fixture: seeded INTERPROCEDURAL lockset hazards (ISSUE 17
+# tentpole).  Every finding here is invisible to a lexical with-block
+# walk: the blocking rpc lives in a helper only ever CALLED under the
+# shared lock, or inside an acquire()/release() span, and the
+# lock-order cycle's edges are two calls deep.  Parsed only, never
+# executed.
+import threading
+
+from theanompi_tpu.parallel.transport import request
+
+
+class DeepRouter:
+    """Blocking rpcs behind helpers invoked under the shared lock."""
+
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._streams = {}
+
+    def journal(self, addr, rid, toks):
+        with self._table_lock:
+            self._streams[rid] = toks
+            self._refresh(addr)
+
+    def _refresh(self, addr):
+        # GL-P002 (transitive, 1 deep): every caller holds
+        # self._table_lock — there is no with-block in sight here, so
+        # the lexical leg provably misses this
+        return request(addr, {"kind": "refresh"}, timeout=5.0)
+
+    def poll(self, addr):
+        with self._table_lock:
+            return self._probe(addr)
+
+    def _probe(self, addr):
+        return self._sync(addr)
+
+    def _sync(self, addr):
+        # GL-P002 (transitive, 2 deep): poll → _probe → _sync — the
+        # witness chain in the message names the whole path
+        return request(addr, {"kind": "poll"}, timeout=5.0)
+
+
+class SpanGate:
+    """acquire()/release() spans — the CFG fact, not the lexical one."""
+
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._inbox = {}
+
+    def pump(self, addr):
+        self._gate.acquire()
+        snapshot = dict(self._inbox)
+        self._gate.release()
+        # NOT a finding: the lock is RELEASED before the block — the
+        # span dataflow kills the token at release(), where a
+        # whole-function approximation would cry wolf
+        return request(addr, {"kind": "push", "s": snapshot}, timeout=5.0)
+
+    def drain(self, addr):
+        self._gate.acquire()
+        try:
+            # GL-P002 (span form): blocking inside the
+            # acquire()/release() span — same deadlock shape as the
+            # with-block, spelled without one
+            return request(addr, {"kind": "drain"}, timeout=5.0)
+        finally:
+            self._gate.release()
+
+
+# --- 2-deep lock-order cycle: no single function (and no single
+# caller/callee PAIR) ever shows both locks, so neither the lexical
+# nested-with walk nor the 1-level via-call edge can see it ----------
+
+ORDER_ALPHA = threading.Lock()
+ORDER_BETA = threading.Lock()
+
+
+def take_alpha_route(x):
+    with ORDER_ALPHA:
+        return _alpha_mid(x)
+
+
+def _alpha_mid(x):
+    return _alpha_leaf(x)
+
+
+def _alpha_leaf(x):
+    # deep edge ORDER_ALPHA → ORDER_BETA: ALPHA is held on entry via
+    # take_alpha_route → _alpha_mid → _alpha_leaf
+    with ORDER_BETA:
+        return x + 1
+
+
+def take_beta_route(x):
+    with ORDER_BETA:
+        return _beta_mid(x)
+
+
+def _beta_mid(x):
+    return _beta_leaf(x)
+
+
+def _beta_leaf(x):
+    # deep edge ORDER_BETA → ORDER_ALPHA — closes the GL-L001 cycle,
+    # with the call-path witness in the finding message
+    with ORDER_ALPHA:
+        return x - 1

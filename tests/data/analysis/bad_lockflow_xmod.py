@@ -1,0 +1,14 @@
+# graftlint fixture: the SUBCLASS half of the cross-module lockset
+# pair (ISSUE 17).  push acquires the INHERITED lock and calls the
+# INHERITED blocking helper: with both modules in scope the lock gains
+# its second holder (shared) and WireBase._post inherits push's
+# lockset through the call-graph fixpoint — the finding lands in the
+# BASE module, proving locksets flow across files and class bodies.
+# Parsed only, never executed.
+from tests.data.analysis.lockflow_xmod_helper import WireBase
+
+
+class WireSub(WireBase):
+    def push(self, addr):
+        with self._wire_lock:
+            return self._post(addr)

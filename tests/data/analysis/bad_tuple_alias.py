@@ -1,0 +1,59 @@
+# graftlint fixture: PER-ELEMENT tuple alias tracking (ISSUE 17) —
+# the documented over-approximation of the PR-14 flow engine, closed:
+# `t = (a, b)` now records indexed views, so `t[0]` reads only a's
+# tokens and `p, q = t` distributes element views instead of smearing
+# the whole union over both targets.  The clean cases here FIRED
+# before this PR.  Parsed only, never executed.
+import jax
+import jax.numpy as jnp
+
+
+def _step(params, batch):
+    return jax.tree.map(lambda p: p - 0.1, params)
+
+
+_train = jax.jit(_step, donate_argnums=(0,))
+
+
+def indexed_read_donated(params, batch):
+    pair = (params, batch)
+    new = _train(params, batch)
+    # GL-D001: pair[0] is the element view of the DONATED buffer
+    stale = pair[0]["w"]
+    # NOT a finding: pair[1] views only `batch`, which was never
+    # donated — the pre-v4 union smear flagged this line too
+    return new, stale, jnp.sum(pair[1])
+
+
+def unpack_through_intermediary(params, batch):
+    pair = (params, batch)
+    p2, b2 = pair
+    new = _train(params, batch)
+    # GL-D001: p2 came from element 0 — the donated buffer
+    stale = p2["w"]
+    # NOT a finding: b2 carries element 1's tokens only
+    return new, stale, jnp.sum(b2)
+
+
+def b_alias_clean(params, batch):
+    pair = (params, batch)
+    b_only = pair[1]
+    _train(params, batch)
+    # NOT a finding (entire function): every read here traces to the
+    # un-donated element
+    return jnp.sum(b_only)
+
+
+def _make(p):
+    return (p, p)
+
+
+def call_result_elements_are_fresh(params, batch):
+    pair = _make(params)
+    new = _train(params, batch)
+    # NOT a finding — the HONEST LIMIT docs/static_analysis.md
+    # records: element views are created only for tuple DISPLAYS, not
+    # call results, and _make does not itself donate, so `pair` gets
+    # fresh tokens.  Semantically this read IS stale; the engine
+    # chooses the silent false negative over guessing at summaries
+    return new, pair[0]["w"]

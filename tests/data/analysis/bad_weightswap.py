@@ -1,0 +1,81 @@
+# graftlint fixture: seeded weight-swap hazards (GL-W*, ISSUE 17) on
+# jit-fed param trees.  Each class isolates one rule with its clean
+# counterpart beside it; none of the dict mutations here is
+# generation-gated and no locks exist, so GL-P003 and GL-T stay out of
+# the counts.  Parsed only, never executed.
+import jax
+import numpy as np
+
+
+def _infer_step(params, x):
+    return jax.tree.map(lambda p: p @ x, params)
+
+
+class RecompileSwapServer:
+    """GL-W001: the swap itself re-casts the leaves."""
+
+    def __init__(self, params):
+        self.step = jax.jit(_infer_step)
+        self.params = params
+
+    def infer(self, x):
+        return self.step(self.params, x)
+
+    def swap_cast(self, new):
+        # GL-W001: every swap changes leaf dtype → the jitted step
+        # retraces and recompiles per swap
+        self.params = jax.tree.map(lambda p: p.astype(np.float32), new)
+
+    def swap_plain_ok(self, new):
+        # NOT a finding: same-structure rebind, no cast/reshape (this
+        # class never gen-gates, so GL-W002 has nothing to calibrate
+        # against either)
+        self.params = new
+
+
+class MixedGateRoster:
+    """GL-W002: the class gen-gates one swap path but not the other."""
+
+    def __init__(self, params):
+        self.step = jax.jit(_infer_step)
+        self.params = params
+        self.gen = 0
+
+    def infer(self, x):
+        return self.step(self.params, x)
+
+    def swap_gated_ok(self, new, msg_gen):
+        if msg_gen > self.gen:
+            # sanctioned: the generation compare gates the swap
+            self.params = new
+            self.gen = msg_gen
+
+    def swap_hot(self, new):
+        # GL-W002: no generation check on this path — a late swap can
+        # overwrite a newer generation's params
+        self.params = new
+
+
+class TornPublisher:
+    """GL-W003: generation published before every leaf is rebound."""
+
+    def __init__(self, params):
+        self.step = jax.jit(_infer_step)
+        self.params = params
+        self.generation = 0
+
+    def infer(self, x):
+        return self.step(self.params, x)
+
+    def promote(self, leaves, new_gen):
+        # GL-W003: a reader that checks the generation between the
+        # publish and the last leaf store sees a torn tree
+        self.generation = new_gen
+        self.params["w1"] = leaves["w1"]
+        self.params["w2"] = leaves["w2"]
+
+    def promote_ok(self, leaves, new_gen):
+        # NOT a finding: every leaf rebound first, generation last
+        self.params["w1"] = leaves["w1"]
+        self.params["w2"] = leaves["w2"]
+        self.generation = new_gen

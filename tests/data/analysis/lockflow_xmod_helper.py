@@ -1,0 +1,27 @@
+# graftlint fixture: the BASE half of the cross-module lockset pair
+# (ISSUE 17).  Analyzed ALONE this module is SILENT: self._wire_lock
+# is with-acquired by exactly one function (reap), so it is not
+# "shared", and _post's blocking request() has no locked caller inside
+# this file.  The subclass module supplies both missing facts — a
+# second holder and the locked call path — so the GL-P002 fires here
+# only in the corpus-pair run.  Parsed only, never executed.
+import threading
+
+from theanompi_tpu.parallel.transport import request
+
+
+class WireBase:
+    """Owns the lock; the blocking helper is innocent in isolation."""
+
+    def __init__(self):
+        self._wire_lock = threading.Lock()
+        self._peers = {}
+
+    def reap(self):
+        with self._wire_lock:
+            self._peers.clear()
+
+    def _post(self, addr):
+        # GL-P002 (pair run only): WireSub.push calls this while
+        # holding the inherited self._wire_lock
+        return request(addr, {"kind": "post"}, timeout=5.0)

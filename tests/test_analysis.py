@@ -175,6 +175,7 @@ def test_every_pass_fires_on_corpus():
         "steptrace",
         "threadstate",
         "protocol",
+        "weightswap",
     }
 
 
@@ -242,6 +243,9 @@ def test_steptrace_cross_module():
     assert set(c004) == {
         "hidden_branch_divergence",
         "cond_hidden_divergence",
+        # ISSUE 17: the context-keyed false-merge seed rides the same
+        # corpus-wide run
+        "merged_call_sites",
     }
     assert c004["cond_hidden_divergence"].severity == "error"
     assert not any(
@@ -895,3 +899,381 @@ def test_cli_importable_without_jax():
     )
     assert out.returncode == 0, out.stderr
     assert "RC 1" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# interprocedural lockset engine (ISSUE 17 tentpole): may-hold-locks
+# through helpers, acquire/release spans, deep lock-order edges
+# ---------------------------------------------------------------------------
+
+def test_lockflow_golden():
+    """Exact-count golden for the lockset corpus: helper-under-lock
+    chains 1 and 2 deep, the acquire/release span form, and the 2-deep
+    lock-order cycle; release-before-block stays silent."""
+    findings = _findings("bad_lockflow.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-L001", "<package>"),
+            ("GL-P002", "_refresh"),
+            ("GL-P002", "_sync"),
+            ("GL-P002", "drain"),
+        ]
+    )
+    by_symbol = {f.symbol.rsplit(".", 1)[-1]: f for f in findings}
+    for rule, f in ((r, by_symbol[s]) for r, s in got):
+        assert f.severity == "error", (rule, f.symbol)
+    # witness chains: the message names the call path that inherits
+    # the lock, depth included
+    assert (
+        "DeepRouter.journal → DeepRouter._refresh"
+        in by_symbol["_refresh"].message
+    )
+    assert (
+        "DeepRouter.poll → DeepRouter._probe → DeepRouter._sync"
+        in by_symbol["_sync"].message
+    )
+    # the span form is phrased as a span, not a call chain
+    assert "acquire()/release() span" in by_symbol["drain"].message
+    # release-before-block (SpanGate.pump) is the CFG-precision case:
+    # a whole-function approximation would flag it
+    assert "pump" not in {f.symbol.rsplit(".", 1)[-1] for f in findings}
+
+
+def test_lockflow_transitive_is_lexically_invisible():
+    """The acceptance regression pin: the LEXICAL GL-P002 walk returns
+    NOTHING on the lockset corpus — every blocking call there is
+    reached through a helper or a bare span — while the full pass
+    (lockset engine underneath) fires all three."""
+    from theanompi_tpu.analysis import engine, protocol
+
+    mods, skipped, _root = engine.parse_targets(
+        paths=[os.path.join(CORPUS, "bad_lockflow.py")]
+    )
+    assert skipped == []
+    assert protocol._p002_lexical(mods) == []
+    full = [
+        f for f in protocol.run_project(mods) if f.rule == "GL-P002"
+    ]
+    assert len(full) == 3
+
+
+def test_lockflow_deep_cycle_has_chain_witness():
+    """GL-L001 over 2-deep edges: no function (or caller/callee pair)
+    shows both locks, and the cycle message carries both call-path
+    witnesses."""
+    findings = _findings("bad_lockflow.py")
+    cycle = next(f for f in findings if f.rule == "GL-L001")
+    assert "ORDER_ALPHA" in cycle.message
+    assert "ORDER_BETA" in cycle.message
+    assert (
+        "via call chain take_alpha_route → _alpha_mid → _alpha_leaf"
+        in cycle.message
+    )
+    assert (
+        "via call chain take_beta_route → _beta_mid → _beta_leaf"
+        in cycle.message
+    )
+
+
+def test_lockflow_cross_module_pair():
+    """Inherited-lock × lockset compose: the lock, the helper, and the
+    blocking call live in the BASE module; the subclass supplies the
+    second holder and the locked call path.  Single-file both halves
+    are silent; the pair fires exactly once, in the base."""
+    assert _findings("lockflow_xmod_helper.py") == []
+    assert _findings("bad_lockflow_xmod.py") == []
+    findings, _ = analyze(paths=[CORPUS])
+    hits = [
+        f for f in findings
+        if f.file.endswith("lockflow_xmod_helper.py")
+    ]
+    assert [(f.rule, f.symbol) for f in hits] == [
+        ("GL-P002", "WireBase._post")
+    ]
+    assert "WireSub.push" in hits[0].message
+    assert not any(
+        f.file.endswith("bad_lockflow_xmod.py") for f in findings
+    )
+
+
+def test_lockset_corpus_wide_exact_counts():
+    """Corpus-wide exact counts for the lockset-backed rules: the new
+    seeds ADD to the established totals without disturbing them."""
+    findings, _ = analyze(paths=[CORPUS])
+    p002 = [f for f in findings if f.rule == "GL-P002"]
+    # 2 lexical (bad_protocol) + 3 transitive (bad_lockflow) + 1
+    # cross-module (lockflow_xmod pair)
+    assert len(p002) == 6
+    l001 = [f for f in findings if f.rule == "GL-L001"]
+    # 1 lexical cycle (bad_locks) + 1 deep-edge cycle (bad_lockflow)
+    assert len(l001) == 2
+
+
+# ---------------------------------------------------------------------------
+# context-sensitive step inlining (ISSUE 17): the false-merge family
+# ---------------------------------------------------------------------------
+
+def test_ctxtrace_golden():
+    findings = _findings("bad_ctxtrace.py")
+    assert _rule_symbol_pairs(findings) == [
+        ("GL-C004", "merged_call_sites")
+    ]
+    f = findings[0]
+    assert f.pass_id == "steptrace" and f.severity == "warning"
+    assert "psum" in f.message
+    # identical contexts at both sites must still merge
+    assert f.symbol != "same_ctx_ok"
+
+
+def test_ctx_inliner_keys_summaries_by_call_site_context():
+    """Unit pin on the 1-level context memo: the same helper flattens
+    to different traces under different literal bindings, and the
+    context-free entry keeps the pre-v4 both-arms union."""
+    from theanompi_tpu.analysis import callgraph, engine
+    from theanompi_tpu.analysis.step_trace import _Inliner
+
+    mods, skipped, _root = engine.parse_targets(
+        paths=[os.path.join(CORPUS, "bad_ctxtrace.py")]
+    )
+    assert skipped == []
+    inl = _Inliner(callgraph.build(mods))
+    fq = "bad_ctxtrace._exchange"
+    assert inl.flat(fq, ctx=(("mode", "sum"),)) == ("psum",)
+    assert inl.flat(fq, ctx=(("mode", "none"),)) == ()
+    assert inl.flat(fq) == ("psum",)
+
+
+def test_ctx_keys_do_not_drift_committed_artifact():
+    """The committed artifact's step-trace keys stay PLAIN (entrypoint
+    roots run with the empty context) — context sensitivity changes
+    which arms merge, not the artifact schema."""
+    from theanompi_tpu.analysis import engine
+
+    doc = engine.load_artifact(engine.artifact_path())
+    assert all("[" not in k for k in doc["step_traces"])
+
+
+def test_graftlint_diff_context_trace_keys_are_additive(tmp_path):
+    """A current-only step-trace key containing '[' (a
+    context-qualified variant) is a NOTE, not drift — exit 0."""
+    from theanompi_tpu.analysis import engine
+
+    base = engine.load_artifact(engine.artifact_path())
+    doc = json.loads(json.dumps(base))
+    doc["step_traces"]["bad_ctxtrace._exchange[mode=sum]"] = ["psum"]
+    cur = str(tmp_path / "cur.json")
+    engine.write_artifact(doc, cur)
+    r = _run_diff(["--current", cur])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "context-qualified" in r.stdout
+    # a PLAIN new key is still drift
+    doc2 = json.loads(json.dumps(base))
+    doc2["step_traces"]["bad_ctxtrace.new_root"] = ["psum"]
+    engine.write_artifact(doc2, cur)
+    r = _run_diff(["--current", cur])
+    assert r.returncode == 1 and "STEP-TRACE DRIFT" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# per-element tuple alias tracking (ISSUE 17): the documented
+# donation-pass over-approximation, closed
+# ---------------------------------------------------------------------------
+
+def test_tuple_alias_golden():
+    findings = _findings("bad_tuple_alias.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-D001", "indexed_read_donated"),
+            ("GL-D001", "unpack_through_intermediary"),
+        ]
+    )
+    # the pre-v4 union smear flagged all four of these
+    clean = {"b_alias_clean", "call_result_elements_are_fresh"}
+    assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+    # exactly ONE finding per function: the pair[1]/b2 reads in the
+    # flagged functions trace to the un-donated element and stay quiet
+    assert len(findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# GL-W weight-swap pass (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_weightswap_golden():
+    findings = _findings("bad_weightswap.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-W001", "swap_cast"),
+            ("GL-W002", "swap_hot"),
+            ("GL-W003", "promote"),
+        ]
+    )
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["GL-W001"].severity == "warning"
+    assert by_rule["GL-W002"].severity == "error"
+    assert by_rule["GL-W003"].severity == "error"
+    assert "RECOMPILES" in by_rule["GL-W001"].message
+    assert "generation" in by_rule["GL-W002"].message
+    assert "TORN" in by_rule["GL-W003"].message
+    clean = {"swap_plain_ok", "swap_gated_ok", "promote_ok", "infer",
+             "__init__"}
+    assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# cache key covers the baseline document (ISSUE 17 bugfix) and the
+# --changed-only pre-commit mode
+# ---------------------------------------------------------------------------
+
+def test_cache_key_includes_baseline_state(tmp_path):
+    """Editing .graftlint_baseline.json must invalidate the warm
+    verdict — a stale cached 'clean' must not survive a baseline
+    edit (the suppression-comment half rides the .py content hashes
+    already in the key)."""
+    from theanompi_tpu.analysis import engine
+
+    root = tmp_path / "repo"
+    (root / "theanompi_tpu").mkdir(parents=True)
+    pkg = root / "theanompi_tpu"
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        "import jax\n\n\n"
+        "def f(p, b):\n    return p\n\n\n"
+        "g = jax.jit(f, donate_argnums=(0,))\n\n\n"
+        "def bad(p, b):\n"
+        "    out = g(p, b)\n"
+        "    return out, p\n"
+    )
+    _f1, _s1, _t1, hit1 = engine.full_run(str(root))
+    assert not hit1
+    _f2, _s2, _t2, hit2 = engine.full_run(str(root))
+    assert hit2
+    # writing a baseline invalidates; the NEXT run re-warms
+    (root / engine.BASELINE_NAME).write_text('{"findings": []}')
+    _f3, _s3, _t3, hit3 = engine.full_run(str(root))
+    assert not hit3, "baseline edit must invalidate the cache"
+    _f4, _s4, _t4, hit4 = engine.full_run(str(root))
+    assert hit4
+    # editing the baseline's CONTENT invalidates again
+    (root / engine.BASELINE_NAME).write_text('{"findings": [1]}')
+    _f5, _s5, _t5, hit5 = engine.full_run(str(root))
+    assert not hit5
+
+
+def test_changed_files_scopes_to_git_state(tmp_path):
+    """engine.changed_files: staged/unstaged/untracked .py paths (new
+    directories expanded), None when there is no repository."""
+    import subprocess
+    import sys
+
+    from theanompi_tpu.analysis import engine
+
+    work = tmp_path / "w"
+    work.mkdir()
+    assert engine.changed_files(str(work)) is None
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=str(work), check=True,
+            capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    (work / "committed.py").write_text("x = 1\n")
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+    (work / "untracked.py").write_text("y = 2\n")
+    (work / "newpkg").mkdir()
+    (work / "newpkg" / "inner.py").write_text("z = 3\n")
+    (work / "committed.py").write_text("x = 4\n")
+    (work / "notes.txt").write_text("not python\n")
+    got = sorted(engine.changed_files(str(work)) or [])
+    assert got == ["committed.py", "newpkg/inner.py", "untracked.py"]
+
+
+def test_changed_only_precommit_wrapper_subprocess_smoke(tmp_path):
+    """End-to-end smoke of scripts/precommit_lint.sh in a scratch git
+    repo: a committed finding is OUT of scope, an untracked one fails
+    the hook — the pre-commit contract."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = tmp_path / "w"
+    (work / "scripts").mkdir(parents=True)
+    # the package resolves through a symlink so engine.repo_root() —
+    # the parent of the imported package — lands on the scratch repo
+    os.symlink(
+        os.path.join(repo, "theanompi_tpu"),
+        str(work / "theanompi_tpu"),
+    )
+    import shutil
+
+    wrapper = str(work / "scripts" / "precommit_lint.sh")
+    shutil.copy(os.path.join(repo, "scripts", "precommit_lint.sh"), wrapper)
+
+    bad_src = (
+        "import jax\nimport numpy as np\n\n\n"
+        "def snap(tree):\n"
+        "    return jax.tree.map(np.asarray, tree)\n"
+    )
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=str(work), check=True,
+            capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    (work / "committed_bad.py").write_text(bad_src)
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    env = {**os.environ, "PYTHONPATH": str(work)}
+
+    def hook(*extra):
+        return subprocess.run(
+            ["bash", wrapper, "--no-baseline", "--format", "json",
+             *extra],
+            cwd=str(work), capture_output=True, text=True, timeout=300,
+            env=env,
+        )
+
+    # clean tree: the committed finding exists but is OUT of scope
+    r = hook()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+    assert "scoped to" in r.stderr
+
+    # an untracked bad file IS in scope and fails the hook
+    (work / "changed_bad.py").write_text(bad_src)
+    r = hook()
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert {f["file"] for f in doc["findings"]} == {"changed_bad.py"}
+
+
+def test_bench_json_format(capsys):
+    """--bench --format json: the perf_gate per-pass budget's input —
+    every pipeline stage present with a numeric ms, lockflow (the
+    lockset engine) included."""
+    rc = cli_main(["--bench", "--format", "json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    names = {p["name"] for p in doc["passes"]}
+    assert {"parse", "lockflow", "weightswap", "protocol",
+            "callgraph"} <= names
+    assert all(
+        isinstance(p["ms"], (int, float)) and p["ms"] >= 0
+        for p in doc["passes"]
+    )
+    assert doc["total_ms"] >= max(p["ms"] for p in doc["passes"])

@@ -904,6 +904,37 @@ def test_gate_lint_leg_skippable(fixtures, tmp_path):
     assert r.returncode == 0, r.stderr
     assert "lint artifact diff" not in r.stderr
 
+
+def test_gate_lint_per_pass_budget_violation(fixtures):
+    """ISSUE 17: the LINT leg pins a per-pass wall-time budget over
+    `--bench --format json` — an impossibly small budget must trip it
+    on the real analyzer run, naming the offending pass."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT_CURRENT": "",
+        "PERF_GATE_LINT_PASS_BUDGET_MS": "0.1",
+    })
+    assert r.returncode != 0
+    assert "LINT VIOLATION" in r.stderr
+    assert "budget" in r.stderr
+
+
+def test_gate_lint_budget_skipped_on_smoke_path(fixtures):
+    """The pre-produced --current path never runs the analyzer, so
+    the per-pass budget must not fire there even when impossibly
+    small — otherwise every artifact smoke test would pay the full
+    uncached bench."""
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_LINT_PASS_BUDGET_MS": "0.001",
+    })
+    assert r.returncode == 0, r.stderr
+
+
 # ---------------------------------------------------------------------------
 # tune leg (ISSUE 16): the self-tuning driver's own drill — the gate
 # must prove the sweep finds a planted winner AND refuses a planted

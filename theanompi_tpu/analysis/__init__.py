@@ -10,8 +10,13 @@ lock inversions in the host-level async transport.  None of these need
 hardware to detect — they are visible in the AST — so this package
 checks them at review time, on CPU, in CI.
 
-Seven passes, each pure-stdlib (no jax import — the CLI must start
-fast and run on machines with no accelerator stack):
+Eight passes, each pure-stdlib (no jax import — the CLI must start
+fast and run on machines with no accelerator stack).  The lock-aware
+passes share one interprocedural substrate, the LOCKSET ENGINE
+(``analysis/lockflow.py``): a may-hold-locks forward dataflow over the
+per-function CFG joined with a call-graph fixpoint, so "what locks may
+be held HERE" is a queryable fact at every statement — including
+inside helpers only ever *called* under a lock.
 
 - ``recompile``   (GL-J*): jit wrappers rebuilt per loop iteration,
   unhashable values at static-arg positions, Python branches on traced
@@ -36,7 +41,9 @@ fast and run on machines with no accelerator stack):
   traces diverge even though each function looks balanced on its own.
 - ``lockorder``   (GL-L*): a whole-package lock-acquisition-graph
   cycle detector (plus non-reentrant double-acquire) over the
-  ``threading.Lock``/``RLock``/``Condition`` population.
+  ``threading.Lock``/``RLock``/``Condition`` population; lockset
+  facts add DEEP edges (lock held on entry via a call chain, second
+  lock acquired inside) and call-path witnesses in the message.
 - ``threadstate`` (GL-T*): unlocked mutation of shared state dicts —
   a class that mutates a dict under its own lock in one method and
   bare in another (the roster/router surface the serving fleet adds)
@@ -49,7 +56,14 @@ fast and run on machines with no accelerator stack):
   loop/thread without a deadline or timeout budget, blocking rpcs
   issued under a shared lock (the distributed-deadlock shape),
   per-member state mutated outside a generation check, and journal
-  re-admission specs that drop the ``token_index0`` re-key.
+  re-admission specs that drop the ``token_index0`` re-key.  GL-P002
+  has two legs: the lexical with-block walk, and a TRANSITIVE leg
+  over the lockset engine that flags a blocking rpc inside a helper
+  only ever reached through a caller's locked region.
+- ``weightswap``  (GL-W*): swap discipline for jit-fed param trees —
+  swaps that change leaf dtype/shape (recompile-per-swap), ungated
+  swaps in classes that gen-gate elsewhere, and torn swaps that
+  publish the generation marker before every leaf is rebound.
 
 Findings carry severity + ``file:line`` and are matched against a
 checked-in baseline (``.graftlint_baseline.json`` at the repo root) so
@@ -81,6 +95,8 @@ CLI::
     python -m theanompi_tpu.analysis --step-trace       # whole-step traces
     python -m theanompi_tpu.analysis --artifact PATH    # CI artifact
     python -m theanompi_tpu.analysis --bench            # per-pass timing
+    python -m theanompi_tpu.analysis --changed-only     # git-diff scope
+    scripts/precommit_lint.sh                           # hook wrapper
 
 See ``docs/static_analysis.md`` for the workflow.
 """

@@ -67,7 +67,20 @@ def _build_parser() -> argparse.ArgumentParser:
         "--bench",
         action="store_true",
         help="print per-pass wall time over the default target set "
-        "and exit (tier-1 pins the warm cached runtime separately)",
+        "and exit (tier-1 pins the warm cached runtime separately); "
+        "with --format json emits {passes: [{name, ms}], total_ms} "
+        "for perf_gate's per-pass budget",
+    )
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        dest="changed_only",
+        help="report findings only for files git sees as changed "
+        "(staged, unstaged, or untracked) — the pre-commit mode.  The "
+        "full cache-backed run still executes (interprocedural passes "
+        "need the whole package; a warm run is a stat sweep), only the "
+        "REPORT is scoped.  Falls back to a full report when git "
+        "state is unavailable",
     )
     p.add_argument(
         "--baseline",
@@ -203,6 +216,17 @@ def _run_step_trace(args) -> int:
 def _run_bench(args) -> int:
     timings = engine.bench_passes()
     total = sum(t for _n, t in timings)
+    if args.fmt == "json":
+        doc = {
+            "passes": [
+                {"name": name, "ms": round(t * 1000.0, 1)}
+                for name, t in timings
+            ],
+            "total_ms": round(total * 1000.0, 1),
+        }
+        json.dump(doc, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0
     width = max(len(n) for n, _t in timings)
     for name, t in timings:
         print(f"{name:<{width}}  {t * 1000.0:9.1f} ms")
@@ -236,6 +260,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    if args.changed_only:
+        changed = engine.changed_files(engine.repo_root())
+        if changed is None:
+            print(
+                "graftlint: --changed-only: git state unavailable, "
+                "reporting everything",
+                file=sys.stderr,
+            )
+        else:
+            scope = set(changed)
+            findings = [f for f in findings if f.file in scope]
+            skipped = [s for s in skipped if s in scope]
+            print(
+                f"graftlint: --changed-only: scoped to "
+                f"{len(scope)} changed file(s)",
+                file=sys.stderr,
+            )
 
     if args.artifact:
         doc = engine.build_artifact(findings, traces or {}, skipped)

@@ -227,6 +227,12 @@ def _branch_divergence(m: ParsedModule) -> List[Finding]:
                 continue  # reported by the owning (nested) function
             if not _test_reads_params(stmt.test, params):
                 continue
+            if _is_static_str_test(stmt.test):
+                # string-equality dispatch (`mode == "sum"`) — a
+                # trace-time host constant on every worker; the
+                # context-sensitive step inliner compares the call
+                # sites instead (GL-C004)
+                continue
             if_seq = _sequence(m, list(stmt.body))
             else_seq = _sequence(m, list(stmt.orelse))
             if if_seq != else_seq and (if_seq or else_seq):

@@ -64,6 +64,16 @@ by a donating call on ``params`` makes a later ``pair[0]`` read a
 finding, while a rebind on EVERY path to the read stays silent (see
 docs/static_analysis.md and the seeded corpus in
 ``tests/data/analysis/bad_dataflow.py``).
+
+As of this PR the alias domain tracks tuple elements PER ELEMENT
+through named intermediaries: ``t = (a, b)`` records indexed views
+``t[0]``/``t[1]`` beside the whole-container union, so ``x = t[0]``
+taints ``x`` only with ``a``'s tokens, and ``p, q = t`` distributes
+the element views instead of smearing the union over both targets.
+Views die on any strong update to the container, survive a join only
+when BOTH paths carry them, and are NOT created for call results —
+``pair = make_pair(x)`` still reads as one opaque union (the honest
+limit docs/static_analysis.md records).
 """
 
 from __future__ import annotations
@@ -325,13 +335,19 @@ def _st_join(a: _State, b: _State) -> _State:
         return a
     aliases: Dict[str, frozenset] = dict(a[0])
     for k, toks in b[0].items():
+        if "[" in k and k not in a[0]:
+            continue  # element views survive a join only when both
+            # paths carry them — they have no entry-state default
         base = aliases.get(k, frozenset((k,)))
         aliases[k] = base | toks
     # keys assigned on only one side keep the other side's entry-state
     # default — a one-arm rebind must not hide the fall-through alias
     for k in list(a[0].keys()):
         if k not in b[0]:
-            aliases[k] = a[0][k] | frozenset((k,))
+            if "[" in k:
+                del aliases[k]
+            else:
+                aliases[k] = a[0][k] | frozenset((k,))
     tainted: Dict[str, tuple] = dict(a[1])
     for t, info in b[1].items():
         if t not in tainted or info[0] < tainted[t][0]:
@@ -382,6 +398,14 @@ class _TaintEngine:
             (f"@{getattr(node, 'lineno', 0)}.{getattr(node, 'col_offset', 0)}",)
         )
 
+    @staticmethod
+    def _kill_indexed(aliases: Dict[str, frozenset], key: str) -> None:
+        """A strong update of ``key`` invalidates its per-element views
+        (``key[0]``, ``key[1]``, ...) — they described the OLD value."""
+        prefix = key + "["
+        for k in [k for k in aliases if k.startswith(prefix)]:
+            del aliases[k]
+
     # -- expression evaluation ------------------------------------------
     def _maybe_report(self, node, key, toks, tainted: Dict[str, tuple]):
         if not self.reporting or self.report is None:
@@ -413,6 +437,24 @@ class _TaintEngine:
                 self._maybe_report(expr, key, toks, tainted)
             return toks
         if isinstance(expr, ast.Subscript):
+            # per-element view: ``t = (a, b); t[0]`` reads exactly a's
+            # tokens when the element index is a literal int and the
+            # container's element views are live — the v3 whole-
+            # container over-approximation flagged the clean element
+            key = _binding_key(expr.value)
+            sl = expr.slice
+            if (
+                key is not None
+                and isinstance(sl, ast.Constant)
+                and isinstance(sl.value, int)
+                and not isinstance(sl.value, bool)
+            ):
+                ikey = f"{key}[{sl.value}]"
+                if ikey in aliases:
+                    toks = aliases[ikey]
+                    if reads:
+                        self._maybe_report(expr, ikey, toks, tainted)
+                    return toks
             toks = self._eval(expr.value, st, reads)
             self._eval(expr.slice, st, reads)
             return toks
@@ -508,14 +550,17 @@ class _TaintEngine:
             self._assign(target.value, toks, st)
             return
         if isinstance(target, ast.Subscript):
-            # weak update: the container may now hold the buffer
+            # weak update: the container may now hold the buffer (and
+            # its per-element views are no longer trustworthy)
             key = _binding_key(target.value)
             self._eval(target.slice, st)
             if key is not None:
                 aliases[key] = self._lookup(aliases, key) | toks
+                self._kill_indexed(aliases, key)
             return
         key = _binding_key(target)
         if key is not None:
+            self._kill_indexed(aliases, key)
             if toks:
                 aliases[key] = toks
             else:
@@ -592,6 +637,49 @@ class _TaintEngine:
                 for t, toks in pairs:
                     self._assign(t, toks, st)
                 return st
+            if (
+                isinstance(stmt.value, (ast.Tuple, ast.List))
+                and len(stmt.targets) == 1
+                and _binding_key(stmt.targets[0]) is not None
+                and not any(
+                    isinstance(e, ast.Starred) for e in stmt.value.elts
+                )
+            ):
+                # a tuple display stored whole under a NAME learns
+                # per-element views: ``t = (a, b)`` keeps a's and b's
+                # tokens apart so a later ``t[0]`` reads only a's
+                key = _binding_key(stmt.targets[0])
+                elem_toks = [self._eval(e, st) for e in stmt.value.elts]
+                union = frozenset().union(*elem_toks) if elem_toks else (
+                    frozenset()
+                )
+                self._assign(stmt.targets[0], union, st)
+                for i, toks in enumerate(elem_toks):
+                    st[0][f"{key}[{i}]"] = (
+                        toks if toks else self._fresh(stmt.value.elts[i])
+                    )
+                return st
+            if (
+                isinstance(stmt.value, (ast.Name, ast.Attribute))
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], (ast.Tuple, ast.List))
+                and not any(
+                    isinstance(e, ast.Starred)
+                    for e in stmt.targets[0].elts
+                )
+            ):
+                # unpack THROUGH the named intermediary: when the
+                # container's element views are live, each target gets
+                # its own element's tokens instead of the whole union
+                src = _binding_key(stmt.value)
+                elts = stmt.targets[0].elts
+                if src is not None and all(
+                    f"{src}[{i}]" in st[0] for i in range(len(elts))
+                ):
+                    views = [st[0][f"{src}[{i}]"] for i in range(len(elts))]
+                    for t, toks in zip(elts, views):
+                        self._assign(t, toks, st)
+                    return st
             toks = self._eval(stmt.value, st)
             for t in stmt.targets:
                 self._assign(t, toks, st)
@@ -616,6 +704,7 @@ class _TaintEngine:
                 else:
                     key = _binding_key(t)
                     if key is not None:
+                        self._kill_indexed(st[0], key)
                         st[0][key] = self._fresh(t)
             return st
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):

@@ -25,18 +25,20 @@ from theanompi_tpu.analysis import (
     callgraph,
     collectives,
     donation,
+    lockflow,
     locks,
     protocol,
     recompile,
     step_trace,
     threadstate,
+    weightswap,
 )
 from theanompi_tpu.analysis.findings import Finding, sort_key
 from theanompi_tpu.analysis.source import ParsedModule, parse_module
 
 BASELINE_NAME = ".graftlint_baseline.json"
 
-_PER_MODULE_PASSES = (recompile, donation, collectives)
+_PER_MODULE_PASSES = (recompile, donation, collectives, weightswap)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\-\s]+))?"
@@ -145,14 +147,28 @@ def _analyze_modules(
                 f for m in modules for f in p.run(m)
             ),
         )
-    timed("lockorder", lambda: findings.extend(locks.run_project(modules)))
+    # the shared interprocedural lockset engine: built once, re-based
+    # on by lockorder (deep-edge witnesses), threadstate (site-locked
+    # facts) and protocol (the transitive GL-P002 leg)
+    lf = timed("lockflow", lambda: lockflow.LocksetEngine(modules))
+    timed(
+        "lockorder",
+        lambda: findings.extend(locks.run_project(modules, lockflow=lf)),
+    )
     # project passes that need cross-module facts: base-class chains
     # (GL-T), the transport/membership protocol surface (GL-P)
     timed(
         "threadstate",
-        lambda: findings.extend(threadstate.run_project(modules)),
+        lambda: findings.extend(
+            threadstate.run_project(modules, lockflow=lf)
+        ),
     )
-    timed("protocol", lambda: findings.extend(protocol.run_project(modules)))
+    timed(
+        "protocol",
+        lambda: findings.extend(
+            protocol.run_project(modules, lockflow=lf)
+        ),
+    )
     # interprocedural layer: one call graph per run feeds the
     # cross-module donation rule (GL-D005), the whole-step collective
     # trace rule (GL-C004), and the per-strategy trace artifact
@@ -225,7 +241,7 @@ def step_trace_report(
 
 ARTIFACT_NAME = ".graftlint_artifact.json"
 CACHE_NAME = ".graftlint_cache.json"
-CACHE_SCHEMA = 1
+CACHE_SCHEMA = 2  # v2: the key covers the baseline document too
 
 
 def artifact_path(root: Optional[str] = None) -> str:
@@ -322,13 +338,32 @@ def _file_states(
     return out
 
 
-def _cache_key(states: Dict[str, dict]) -> str:
+def _baseline_state(root: str) -> str:
+    """Digest of the baseline document, folded into the cache key.
+
+    The fix this encodes (ISSUE 17 satellite): the cached verdict must
+    go stale when the ACCEPTED-findings set changes, not only when
+    source changes — editing ``.graftlint_baseline.json`` by hand used
+    to leave a warm "clean" verdict standing.  Suppression state needs
+    no extra term: ``# graftlint: disable`` lines live in the ``.py``
+    sources, whose sha1s are already in the key."""
+    import hashlib
+
+    path = os.path.join(root, BASELINE_NAME)
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha1(f.read()).hexdigest()
+    except OSError:
+        return "no-baseline"
+
+
+def _cache_key(states: Dict[str, dict], extra: str = "") -> str:
     import hashlib
 
     blob = json.dumps(
         {rel: s["sha1"] for rel, s in sorted(states.items())},
         sort_keys=True,
-    )
+    ) + extra
     return hashlib.sha1(blob.encode("utf-8")).hexdigest()
 
 
@@ -358,7 +393,7 @@ def full_run(
     if prev.get("schema") != CACHE_SCHEMA:
         prev = {}
     states = _file_states(files, root, prev.get("files", {}))
-    key = _cache_key(states)
+    key = _cache_key(states, extra=_baseline_state(root))
     if use_cache and prev.get("key") == key:
         findings = [_finding_from_json(d) for d in prev.get("findings", [])]
         traces = {
@@ -385,6 +420,43 @@ def full_run(
         except OSError:
             pass  # a read-only checkout still lints, just never warm
     return findings, skipped, traces, False
+
+
+def changed_files(root: Optional[str] = None) -> Optional[List[str]]:
+    """Repo-relative ``.py`` paths git reports as changed (staged,
+    unstaged, or untracked) — the ``--changed-only`` file set.  None
+    when git is unavailable or the tree is not a repository (the
+    caller falls back to the full run)."""
+    import subprocess
+
+    root = root or repo_root()
+    try:
+        proc = subprocess.run(
+            # -uall expands untracked DIRECTORIES into their files —
+            # without it a brand-new package shows as one "dir/" entry
+            # and every .py inside it would silently escape the scope
+            ["git", "status", "--porcelain", "-uall"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        # a rename shows "old -> new"; lint the new path
+        if " -> " in path:
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            out.append(path.replace(os.sep, "/"))
+    return out
 
 
 def current_artifact(

@@ -10,7 +10,8 @@ which is stable until the flagged code itself changes.
 Pass ids: ``recompile`` | ``donation`` | ``collectives`` |
 ``lockorder`` | ``steptrace`` (the interprocedural whole-step pass) |
 ``threadstate`` (GL-T*, unlocked shared-dict mutation) |
-``protocol`` (GL-P*, distributed-protocol misuse).
+``protocol`` (GL-P*, distributed-protocol misuse) |
+``weightswap`` (GL-W*, jit-fed param-tree swap discipline).
 ``FIXABLE_RULES`` names the rules the ``--fix`` rewriter
 (``analysis/fixer.py``) can repair mechanically; ``Finding.fixable``
 surfaces that in both expositions so a human (or CI annotate step)

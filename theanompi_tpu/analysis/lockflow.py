@@ -1,0 +1,337 @@
+"""The interprocedural lockset engine (the v4 tentpole).
+
+One shared fact base for every lock-aware pass: for each statement and
+call site in the analyzed set, the set of class-resolved locks that
+MAY be held there.
+
+Two layers, composed:
+
+1. **Direct facts, per function.**  Lexical ``with <lock>`` nesting is
+   exact by construction (the CFG lowers a ``with`` body into the
+   guard's block with no release marker, so with-held is a
+   parent-chain property) and is computed by walking parents.  Bare
+   ``<lock>.acquire()``/``release()`` spans are a *flow* property —
+   a release kills the lock on that path, so a lock released before a
+   blocking call is NOT held — and are computed with a forward
+   may-dataflow over the per-function CFG (``dataflow.build_cfg`` +
+   ``forward_may``), the same framework the donation pass rides.
+2. **An interprocedural fixpoint** over call edges resolved through
+   known receivers (``locks._TypeMap`` — ``self.meth()``, typed
+   attrs/locals, bare module functions): a callee may run with every
+   lock its callers may hold at the call site, transitively, with the
+   witness call chain recorded per (function, lock).
+
+Tokens are resolved lock ids (``"tag.Cls.attr"`` / ``"tag.var"`` —
+``locks._Resolver``) when resolution succeeds, else a ``"self::attr"``
+pseudo-token for a ``self.<attr>`` acquisition of a package lock
+attribute the resolver could not pin to one class (several classes own
+an attr of that name).  Self-tokens only flow through ``self.meth()``
+edges — the receiver is the same object — and never into the
+shared-lock population, which needs a resolved identity.
+
+Consumers:
+
+- GL-P002 gains its transitive leg (a blocking rpc reached through
+  helpers invoked under a shared lock — the shape the lexical pass
+  provably misses);
+- GL-L001 gains deeper-than-one-call acquisition edges with call-path
+  witnesses in the cycle message;
+- GL-T's helper-inheritance reads its site-is-locked facts from here
+  instead of its bespoke lexical walk + line counting.
+
+Pure stdlib, no jax import, like the whole package.  The engine emits
+no findings of its own — it is a fact base the passes query — but it
+IS a timed stage in the engine pipeline so ``--bench`` shows its cost.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
+
+from theanompi_tpu.analysis import dataflow as _df
+from theanompi_tpu.analysis import locks as _locks
+from theanompi_tpu.analysis.source import (
+    FunctionInfo,
+    ParsedModule,
+    attr_path,
+)
+
+PASS_ID = "lockflow"
+
+# pseudo-token prefix: an unresolved-but-provably-self lock attribute
+SELF_PREFIX = "self::"
+
+_EMPTY: FrozenSet[str] = frozenset()
+
+
+def is_self_token(tok: str) -> bool:
+    return tok.startswith(SELF_PREFIX)
+
+
+def _walk_no_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that treats nested defs/lambdas as opaque (they run
+    when called, on their own schedule — the package-wide discipline)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield from _walk_no_defs(child)
+
+
+class LocksetEngine:
+    """May-hold-locks facts over one analyzed module set."""
+
+    SELF_PREFIX = SELF_PREFIX
+
+    def __init__(self, modules: Sequence[ParsedModule]):
+        self.modules = list(modules)
+        self.defs = _locks._collect_locks(self.modules)
+        self.kind: Dict[str, str] = {d.lock_id: d.kind for d in self.defs}
+        self.resolver = _locks._Resolver(self.defs)
+        self.types = _locks._TypeMap(self.modules)
+        self._lock_attrs = {d.attr for d in self.defs if d.attr is not None}
+        # id(sub-node) -> acquire/release-span lockset before the node
+        self._span_at: Dict[int, FrozenSet[str]] = {}
+        # id(fi.node) -> (module, fi) / resolved call sites / entry facts
+        self._fn_of: Dict[int, Tuple[ParsedModule, FunctionInfo]] = {}
+        self._calls: Dict[int, List[Tuple[ast.Call, int, bool]]] = {}
+        self._entry: Dict[int, FrozenSet[str]] = {}
+        # (id(fi.node), token) -> qualname call chain ending at fi
+        self._witness: Dict[Tuple[int, str], Tuple[str, ...]] = {}
+        # resolved lock id -> {"rel:qualname"} holding sites (with OR
+        # bare acquire) — the shared-lock population
+        self.holders: Dict[str, set] = {}
+        self.shared_plain: set = set()
+        if self.defs:
+            self._build()
+
+    # ------------------------------------------------------------------
+    # token resolution
+    # ------------------------------------------------------------------
+    def _token_for(
+        self,
+        m: ParsedModule,
+        expr: ast.expr,
+        fi: Optional[FunctionInfo],
+    ) -> Optional[str]:
+        d = self.resolver.resolve(m, expr, fi)
+        if d is not None:
+            return d.lock_id
+        path = attr_path(expr)
+        if (
+            path is not None
+            and path.startswith("self.")
+            and path.count(".") == 1
+        ):
+            attr = path[len("self."):]
+            if attr in self._lock_attrs:
+                return SELF_PREFIX + attr
+        return None
+
+    # ------------------------------------------------------------------
+    # direct facts
+    # ------------------------------------------------------------------
+    def with_held(self, m: ParsedModule, node: ast.AST) -> FrozenSet[str]:
+        """Locks held LEXICALLY at ``node`` via enclosing ``with``s."""
+        out: set = set()
+        cur = m.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                fi = m.enclosing_function(cur)
+                for item in cur.items:
+                    tok = self._token_for(m, item.context_expr, fi)
+                    if tok is not None:
+                        out.add(tok)
+            cur = m.parents.get(cur)
+        return frozenset(out) if out else _EMPTY
+
+    def span_held(self, node: ast.AST) -> FrozenSet[str]:
+        """Locks held at ``node`` via a bare acquire()/release() span
+        on some CFG path (may-analysis; a release kills the path)."""
+        return self._span_at.get(id(node), _EMPTY)
+
+    def held_direct(self, m: ParsedModule, node: ast.AST) -> FrozenSet[str]:
+        """with-held ∪ span-held — locks this function itself holds."""
+        return self.with_held(m, node) | self.span_held(node)
+
+    def entry_for(self, fi: FunctionInfo) -> FrozenSet[str]:
+        """Locks that MAY be held when ``fi`` is entered — inherited
+        transitively from resolved callers."""
+        return self._entry.get(id(fi.node), _EMPTY)
+
+    def may_held(self, m: ParsedModule, node: ast.AST) -> FrozenSet[str]:
+        """The full may-lockset at ``node``: direct ∪ caller-inherited."""
+        out = self.held_direct(m, node)
+        fi = m.enclosing_function(node)
+        if fi is not None:
+            out = out | self.entry_for(fi)
+        return out
+
+    def witness(self, fi: FunctionInfo, tok: str) -> Tuple[str, ...]:
+        """Qualname call chain along which ``tok`` reaches ``fi``'s
+        entry (empty when the lock is not caller-inherited)."""
+        return self._witness.get((id(fi.node), tok), ())
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def _build(self) -> None:
+        for m in self.modules:
+            for fi in m.functions:
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                self._fn_of[id(fi.node)] = (m, fi)
+                self._compute_spans(m, fi)
+        self._build_calls()
+        self._fixpoint()
+        self._collect_holders()
+
+    def _span_transfer(self, m, fi, state, stmt, record):
+        """One CFG statement: record the pre-state at every relevant
+        sub-node, then apply acquire/release effects in walk order."""
+        if _df.is_header(stmt):
+            node = _df.header_node(stmt)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                roots: List[ast.AST] = []  # with-held is lexical, not span
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                roots = [node.iter]
+            elif isinstance(node, (ast.If, ast.While)):
+                roots = [node.test]
+            else:  # pragma: no cover - future header shapes
+                roots = []
+        else:
+            roots = [stmt]
+        for root in roots:
+            for sub in _walk_no_defs(root):
+                if record:
+                    self._span_at[id(sub)] = state
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("acquire", "release")
+                ):
+                    tok = self._token_for(m, sub.func.value, fi)
+                    if tok is not None:
+                        if sub.func.attr == "acquire":
+                            state = state | {tok}
+                        else:
+                            state = state - {tok}
+        return state
+
+    def _compute_spans(self, m: ParsedModule, fi: FunctionInfo) -> None:
+        node = fi.node
+        body = getattr(node, "body", None)
+        if not body:
+            return
+        # fast path: a function with no bare acquire/release has no
+        # span facts — skip the CFG entirely (the common case)
+        has_span = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("acquire", "release")
+            for sub in _walk_no_defs(node)
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        if not has_span:
+            return
+        cfg = _df.build_cfg(body)
+        in_states = _df.forward_may(
+            cfg,
+            _EMPTY,
+            lambda s, st: self._span_transfer(m, fi, s, st, False),
+            join=lambda a, b: a | b,
+            equal=lambda a, b: a == b,
+            bottom=lambda: _EMPTY,
+        )
+        _df.replay(
+            cfg,
+            in_states,
+            lambda s, st: self._span_transfer(m, fi, s, st, True),
+        )
+
+    def _build_calls(self) -> None:
+        for m in self.modules:
+            for fi in m.functions:
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                calls: List[Tuple[ast.Call, int, bool]] = []
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if m.enclosing_function(node) is not fi:
+                        continue
+                    hit = self.types.resolve_callee(m, fi, node)
+                    if hit is None:
+                        continue
+                    _cm, cfi = hit
+                    if id(cfi.node) not in self._fn_of:
+                        continue
+                    path = attr_path(node.func)
+                    is_self = bool(path and path.startswith("self."))
+                    calls.append((node, id(cfi.node), is_self))
+                if calls:
+                    self._calls[id(fi.node)] = calls
+
+    def _fixpoint(self) -> None:
+        entry: Dict[int, set] = {key: set() for key in self._fn_of}
+        work = list(self._fn_of)
+        while work:
+            fkey = work.pop()
+            m, fi = self._fn_of[fkey]
+            for call, gkey, is_self in self._calls.get(fkey, ()):
+                direct = self.held_direct(m, call)
+                toks = direct | entry[fkey]
+                if not is_self:
+                    # a different receiver: self-tokens name a different
+                    # object's attribute — only resolved ids cross
+                    toks = {t for t in toks if not is_self_token(t)}
+                new = toks - entry[gkey]
+                if not new:
+                    continue
+                gq = self._fn_of[gkey][1].qualname
+                for t in sorted(new):
+                    if t in direct:
+                        chain = (fi.qualname, gq)
+                    else:
+                        chain = self._witness.get(
+                            (fkey, t), (fi.qualname,)
+                        ) + (gq,)
+                    self._witness.setdefault((gkey, t), chain)
+                entry[gkey] |= new
+                if gkey not in work:
+                    work.append(gkey)
+        self._entry = {k: frozenset(v) for k, v in entry.items() if v}
+
+    def _collect_holders(self) -> None:
+        for m in self.modules:
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    fi = m.enclosing_function(node)
+                    exprs = [i.context_expr for i in node.items]
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    fi = m.enclosing_function(node)
+                    exprs = [node.func.value]
+                else:
+                    continue
+                for e in exprs:
+                    tok = self._token_for(m, e, fi)
+                    if tok is not None and not is_self_token(tok):
+                        self.holders.setdefault(tok, set()).add(
+                            f"{m.rel}:{fi.qualname if fi else '<module>'}"
+                        )
+        self.shared_plain = {
+            lid
+            for lid, fns in self.holders.items()
+            if len(fns) >= 2 and self.kind.get(lid) in ("lock", "rlock")
+        }
+
+
+def build(modules: Sequence[ParsedModule]) -> LocksetEngine:
+    return LocksetEngine(modules)

@@ -27,7 +27,12 @@ The pass runs over every module at once:
    is provably a package object), ``self.attr.meth()`` / ``var.meth()``
    where the attr/var was assigned from a package-class constructor,
    and bare ``fn()`` for module-level functions.  A ``.close()`` on a
-   socket therefore never counts as ``TcpMailbox.close``.
+   socket therefore never counts as ``TcpMailbox.close``.  Since v4
+   the interprocedural lockset engine (``analysis/lockflow.py``) adds
+   the deeper edges the one-level walk misses: a lock may-held on a
+   function's ENTRY (inherited through ≥2 resolved call levels)
+   ordered against that function's own acquisitions, with the witness
+   call chain carried into the cycle message.
 4. **Reports**:
    - GL-L001 ``lock-order-cycle`` (error): a cycle in the acquisition
      graph, reported once per cycle with every contributing site.
@@ -71,6 +76,10 @@ class Edge:
     file: str
     line: int
     via_call: Optional[str]  # callee qualname when interprocedural
+    # v4: qualname call chain ("a → b → c") when the src lock reaches
+    # this function's entry through ≥2 resolved call levels — the
+    # lockset-engine witness shown in GL-L001 cycle messages
+    chain: Optional[str] = None
 
 
 def _module_tag(m: ParsedModule) -> str:
@@ -394,7 +403,9 @@ def _find_cycles(adj: Dict[str, Set[str]]) -> List[List[str]]:
     return [list(c) for c in sorted(cycles)]
 
 
-def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
+def run_project(
+    modules: Sequence[ParsedModule], lockflow=None
+) -> List[Finding]:
     defs = _collect_locks(modules)
     if not defs:
         return []
@@ -404,6 +415,7 @@ def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
     # per-function direct acquisitions (for the one-level call graph)
     types = _TypeMap(modules)
     acquired_by: Dict[int, Set[str]] = {}
+    acquire_line: Dict[Tuple[int, str], int] = {}
     for m in modules:
         for fi in m.functions:
             if isinstance(fi.node, ast.Lambda):
@@ -415,6 +427,9 @@ def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
                         continue
                     for d in _with_lock_items(m, node, resolver, fi):
                         acquired.add(d.lock_id)
+                        acquire_line.setdefault(
+                            (id(fi.node), d.lock_id), node.lineno
+                        )
             if acquired:
                 acquired_by[id(fi.node)] = acquired
 
@@ -427,6 +442,48 @@ def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
                 lock_kind,
             )
 
+    # v4: deeper-than-one-call ordering edges from the lockset engine —
+    # a lock that may be held on ENTRY (inherited through ≥2 resolved
+    # call levels) ordered against this function's own acquisitions.
+    # Pairs the lexical/one-level walk already produced are skipped, so
+    # existing cycles keep their original sites; genuinely deep cycles
+    # gain edges whose message carries the call-path witness.
+    if lockflow is None:
+        from theanompi_tpu.analysis import lockflow as _lf
+
+        lockflow = _lf.LocksetEngine(modules)
+    pairs = {(e.src, e.dst) for e in edges}
+    for m in modules:
+        for fi in m.functions:
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            entry = sorted(
+                t
+                for t in lockflow.entry_for(fi)
+                if not t.startswith(lockflow.SELF_PREFIX)
+            )
+            if not entry:
+                continue
+            for dst in sorted(acquired_by.get(id(fi.node), ())):
+                line = acquire_line.get(
+                    (id(fi.node), dst), fi.node.lineno
+                )
+                for src in entry:
+                    if src == dst or (src, dst) in pairs:
+                        continue
+                    pairs.add((src, dst))
+                    witness = lockflow.witness(fi, src)
+                    edges.append(
+                        Edge(
+                            src=src,
+                            dst=dst,
+                            file=m.rel,
+                            line=line,
+                            via_call=None,
+                            chain=" → ".join(witness) if witness else None,
+                        )
+                    )
+
     adj: Dict[str, Set[str]] = {}
     for e in edges:
         adj.setdefault(e.src, set()).add(e.dst)
@@ -436,7 +493,12 @@ def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
         for a, b in zip(ring, ring[1:]):
             for e in edges:
                 if e.src == a and e.dst == b:
-                    via = f" via {e.via_call}()" if e.via_call else ""
+                    if e.via_call:
+                        via = f" via {e.via_call}()"
+                    elif e.chain:
+                        via = f" via call chain {e.chain}"
+                    else:
+                        via = ""
                     sites.append(f"{a}→{b} at {e.file}:{e.line}{via}")
                     break
         anchor = next(

@@ -17,11 +17,17 @@ arXiv:1605.08325) — and none of them needs hardware to detect:
   shutdown paths (the ``done`` farewell) are out of scope: a single
   bounded-by-default call cannot wedge a loop.
 - **GL-P002 ``blocking-rpc-under-shared-lock``** (error): a blocking
-  ``request()``/``.recv()`` issued while LEXICALLY holding a
+  ``request()``/``.recv()`` issued while holding a
   ``threading.Lock``/``RLock`` that the package's lock population
   shows acquired in more than one function — the distributed-deadlock
   shape: the reply can only be produced by a thread that needs the
-  lock you are holding.  Condition/semaphore waits are the *designed*
+  lock you are holding.  Two legs: the original *lexical* walk
+  (enclosing ``with`` statements), and since v4 a *transitive* leg on
+  the interprocedural lockset engine (``analysis/lockflow.py``) that
+  catches the rpc buried in a helper invoked under the lock — through
+  call chains of any resolved depth — and the bare ``acquire()``/
+  ``release()`` span form; a lock released on every path before the
+  call stays silent.  Condition/semaphore waits are the *designed*
   blocking-under-lock pattern and are excluded.
 - **GL-P003 ``generation-unchecked-mutation``** (error): a class that
   guards SOME mutation of a per-member dict with a generation
@@ -186,7 +192,20 @@ def _p001(m: ParsedModule) -> List[Finding]:
 _BLOCKING_TERMINALS = {"request", "recv"}
 
 
-def _p002(modules: Sequence[ParsedModule]) -> List[Finding]:
+def _is_blocking_rpc(m: ParsedModule, node: ast.AST) -> Optional[str]:
+    """Terminal name when ``node`` is a blocking rpc call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = terminal_name(node.func)
+    if name not in _BLOCKING_TERMINALS:
+        return None
+    is_rpc = _is_transport_request(m, node) or (
+        name == "recv" and isinstance(node.func, ast.Attribute)
+    )
+    return name if is_rpc else None
+
+
+def _p002_lexical(modules: Sequence[ParsedModule]) -> List[Finding]:
     defs = _locks._collect_locks(modules)
     if not defs:
         return []
@@ -214,15 +233,8 @@ def _p002(modules: Sequence[ParsedModule]) -> List[Finding]:
     out: List[Finding] = []
     for m in modules:
         for node in ast.walk(m.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = terminal_name(node.func)
-            if name not in _BLOCKING_TERMINALS:
-                continue
-            is_rpc = _is_transport_request(m, node) or (
-                name == "recv" and isinstance(node.func, ast.Attribute)
-            )
-            if not is_rpc:
+            name = _is_blocking_rpc(m, node)
+            if name is None:
                 continue
             fi = m.enclosing_function(node)
             held: Optional[str] = None
@@ -253,6 +265,67 @@ def _p002(modules: Sequence[ParsedModule]) -> List[Finding]:
                     "lock, both sides wait forever: the distributed-"
                     "deadlock shape.  Copy what you need under the lock, "
                     "release it, then block",
+                )
+            )
+    return out
+
+
+def _p002_transitive(
+    modules: Sequence[ParsedModule],
+    engine,
+    skip: Set[Tuple[str, int]],
+) -> List[Finding]:
+    """The leg the lexical pass provably misses: a blocking rpc whose
+    enclosing function may RUN with a shared lock held — inherited
+    through a resolved call chain, or held via a bare acquire()/
+    release() span in this function (no ``with`` for the parent walk
+    to see).  Lockset facts come from the shared interprocedural
+    engine (``analysis/lockflow.py``); a lock released before the call
+    is not in the may-set, so release-then-block stays silent."""
+    shared = engine.shared_plain
+    if not shared:
+        return []
+    out: List[Finding] = []
+    for m in modules:
+        for node in ast.walk(m.tree):
+            name = _is_blocking_rpc(m, node)
+            if name is None or (m.rel, node.lineno) in skip:
+                continue
+            lexical = engine.with_held(m, node)
+            cands = sorted(
+                (engine.may_held(m, node) & shared) - lexical
+            )
+            if not cands:
+                continue
+            held = cands[0]
+            fi = m.enclosing_function(node)
+            if held in engine.span_held(node):
+                how = (
+                    "held in this function via a bare acquire()/release() "
+                    "span"
+                )
+            else:
+                chain = engine.witness(fi, held) if fi is not None else ()
+                how = (
+                    "inherited via call chain " + " → ".join(chain)
+                    if chain
+                    else "inherited from a resolved caller"
+                )
+            out.append(
+                _finding(
+                    m,
+                    "GL-P002",
+                    "error",
+                    node,
+                    m.symbol_for(node),
+                    f"blocking {name}() may run while shared lock "
+                    f"{held!r} is held (acquired in "
+                    f"{len(engine.holders.get(held, ()))} functions; "
+                    f"{how}) — if the peer's reply needs any thread "
+                    "queued on this lock, both sides wait forever: the "
+                    "distributed-deadlock shape the lexical walk cannot "
+                    "see.  Release the lock before the helper blocks, or "
+                    "hoist the rpc out of the locked region",
                 )
             )
     return out
@@ -451,13 +524,22 @@ def _p004(m: ParsedModule) -> List[Finding]:
     return out
 
 
-def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
+def run_project(
+    modules: Sequence[ParsedModule], lockflow=None
+) -> List[Finding]:
     out: List[Finding] = []
     for m in modules:
         out.extend(_p001(m))
         out.extend(_p003(m))
         out.extend(_p004(m))
-    out.extend(_p002(modules))
+    lexical = _p002_lexical(modules)
+    out.extend(lexical)
+    if lockflow is None:
+        from theanompi_tpu.analysis import lockflow as _lf
+
+        lockflow = _lf.LocksetEngine(modules)
+    skip = {(f.file, f.line) for f in lexical}
+    out.extend(_p002_transitive(modules, lockflow, skip))
     return out
 
 

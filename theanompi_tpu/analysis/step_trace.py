@@ -32,7 +32,12 @@ GL-C004 therefore reports exactly the divergences the per-function
 pass cannot see; a site GL-C001/GL-C002 already reports is never
 double-reported.  Unresolved calls contribute nothing (prefer missing
 a hazard over inventing one), recursion is cycle-cut, and inlining is
-memoized per function.
+memoized per ``(function, call-site context)``: since v4 a call site
+binding a callee parameter to a LITERAL constant flattens the callee
+under that binding (1 level — an ``if`` on the bound flag walks only
+the taken arm), so ``helper(x, True)`` and ``helper(x, False)`` no
+longer merge their traces.  Entrypoint roots flatten with the empty
+context, keeping the committed artifact's step-trace keys plain.
 
 ``step_traces()`` additionally exposes the flattened per-entrypoint
 traces (``python -m theanompi_tpu.analysis --step-trace`` prints
@@ -46,7 +51,7 @@ import ast
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from theanompi_tpu.analysis import collectives as _coll
-from theanompi_tpu.analysis.callgraph import CallGraph
+from theanompi_tpu.analysis.callgraph import CallGraph, _arg_bindings
 from theanompi_tpu.analysis.findings import Finding
 from theanompi_tpu.analysis.recompile import _is_none_test
 from theanompi_tpu.analysis.source import (
@@ -69,27 +74,78 @@ WORKER_ENTRYPOINTS = (
 
 _MAX_DEPTH = 24
 
+# a call-site context: sorted (param_name, literal_constant) pairs —
+# the 1-level context key that keeps two call sites of one helper with
+# different static args from merging their flattened traces
+_Ctx = Tuple[Tuple[str, object], ...]
+
+
+def _decide_test(test: ast.expr, binds: Dict[str, object]):
+    """Statically decide an ``if`` test under context bindings: a bare
+    parameter name (truthiness), ``not <param>``, or a single
+    ``<param> ==/!= <literal>`` comparison.  None = undecidable (both
+    arms are walked, the context-insensitive behavior)."""
+    if isinstance(test, ast.Name):
+        if test.id in binds:
+            return bool(binds[test.id])
+        return None
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _decide_test(test.operand, binds)
+        return None if inner is None else not inner
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and len(test.comparators) == 1
+        and isinstance(test.ops[0], (ast.Eq, ast.NotEq))
+    ):
+        left, right = test.left, test.comparators[0]
+        if isinstance(left, ast.Constant):
+            left, right = right, left
+        if (
+            isinstance(left, ast.Name)
+            and left.id in binds
+            and isinstance(right, ast.Constant)
+        ):
+            eq = binds[left.id] == right.value
+            return eq if isinstance(test.ops[0], ast.Eq) else not eq
+    return None
+
 
 class _Inliner:
-    """Flattened-collective-trace computation over the call graph."""
+    """Flattened-collective-trace computation over the call graph.
+
+    v4: summaries are memoized per ``(fq, ctx)`` where ctx binds the
+    callee's parameters to LITERAL constants at the call site — one
+    level deep.  A helper whose collective is gated on a static flag
+    flattens differently under ``helper(x, True)`` and
+    ``helper(x, False)``; under the old fq-only memo both call sites
+    shared one trace (the false-merge family).  Contexts do not
+    propagate: a helper forwarding its flag into a deeper call
+    re-merges there (documented limit)."""
 
     def __init__(self, cg: CallGraph):
         self.cg = cg
-        self._memo: Dict[str, Tuple[str, ...]] = {}
+        self._memo: Dict[Tuple[str, _Ctx], Tuple[str, ...]] = {}
 
     # -- function-level ----------------------------------------------------
-    def flat(self, fq: str, stack: Tuple[str, ...] = ()) -> Tuple[str, ...]:
-        if fq in self._memo:
-            return self._memo[fq]
+    def flat(
+        self,
+        fq: str,
+        stack: Tuple[str, ...] = (),
+        ctx: _Ctx = (),
+    ) -> Tuple[str, ...]:
+        key = (fq, ctx)
+        if key in self._memo:
+            return self._memo[key]
         if fq in stack or len(stack) >= _MAX_DEPTH:
             return ()
         summ = self.cg.functions.get(fq)
         if summ is None:
             return ()
         body = getattr(summ.info.node, "body", [])
-        out = self.flat_nodes(summ.module, body, stack + (fq,))
+        out = self.flat_nodes(summ.module, body, stack + (fq,), ctx)
         if fq not in stack:
-            self._memo[fq] = out
+            self._memo[key] = out
         return out
 
     # -- node-level --------------------------------------------------------
@@ -98,8 +154,10 @@ class _Inliner:
         m: ParsedModule,
         nodes: Sequence[ast.AST],
         stack: Tuple[str, ...],
+        ctx: _Ctx = (),
     ) -> Tuple[str, ...]:
         out: List[str] = []
+        binds = dict(ctx)
 
         def walk(n):
             if isinstance(
@@ -117,6 +175,13 @@ class _Inliner:
                     return
                 out.extend(self._inline_call(m, n, stack))
                 return
+            if isinstance(n, ast.If) and binds:
+                verdict = _decide_test(n.test, binds)
+                if verdict is not None:
+                    walk(n.test)
+                    for child in n.body if verdict else n.orelse:
+                        walk(child)
+                    return
             for child in ast.iter_child_nodes(n):
                 walk(child)
 
@@ -124,19 +189,35 @@ class _Inliner:
             walk(n)
         return tuple(out)
 
+    def _call_ctx(self, fq: str, call: ast.Call) -> _Ctx:
+        """Literal-constant argument bindings at one call site — the
+        1-level context key for the callee's flatten."""
+        summ = self.cg.functions.get(fq)
+        if summ is None:
+            return ()
+        pairs = []
+        for name, arg in _arg_bindings(call, summ):
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, (bool, int, float, str, type(None))
+            ):
+                pairs.append((name, arg.value))
+        return tuple(sorted(pairs, key=lambda p: p[0]))
+
     def _inline_call(
         self, m: ParsedModule, call: ast.Call, stack: Tuple[str, ...]
     ) -> Tuple[str, ...]:
         callee = self.cg.resolve(m, call)
         if callee is not None:
-            return self.flat(callee, stack)
+            return self.flat(callee, stack, self._call_ctx(callee, call))
         # a call through a jit/shard_map binding (self.train_fn(...))
         # traces the function it wraps
         name = terminal_name(call.func)
         if name is not None:
             target = self.cg.jit_targets.get(name)
             if target is not None:
-                return self.flat(target, stack)
+                return self.flat(
+                    target, stack, self._call_ctx(target, call)
+                )
         return ()
 
     # -- cond/switch branch callables --------------------------------------

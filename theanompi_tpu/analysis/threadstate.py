@@ -34,10 +34,12 @@ ISSUE 13 widened what counts as "inside the lock" (each previously a
 documented blind spot):
 
 - **bare ``self.<lock>.acquire()``/``release()`` pairs**: a mutation
-  lexically between an acquire and its release (acquire count before
-  the line exceeds release count, within the enclosing function —
-  covers the ``acquire(); try: ... finally: release()`` idiom) is
-  locked, and marks its attr guarded, exactly like a ``with`` block.
+  inside the acquire/release span (covers the ``acquire(); try: ...
+  finally: release()`` idiom) is locked, and marks its attr guarded,
+  exactly like a ``with`` block.  Since v4 the span fact is the
+  lockset engine's CFG dataflow (``analysis/lockflow.py``) rather than
+  this pass's lexical line counting — a release on the path genuinely
+  ends the span.
 - **helpers invoked under the caller's lock** (a call-graph edge, not
   the naming convention): a method of the class whose every
   same-class call site (``self._helper(...)``) is itself locked — in
@@ -76,7 +78,6 @@ from theanompi_tpu.analysis.findings import Finding
 from theanompi_tpu.analysis.source import (
     LOCK_FACTORIES,
     ParsedModule,
-    attr_path,
 )
 
 PASS_ID = "threadstate"
@@ -128,58 +129,26 @@ def _class_lock_attrs(m: ParsedModule, cls: ast.ClassDef) -> Set[str]:
     return locks
 
 
-def _holds_lock(m: ParsedModule, node: ast.AST, cls: ast.ClassDef,
-                locks: Set[str]) -> bool:
-    """Is ``node`` lexically inside a ``with self.<lock>`` of this
-    class (any of its locks — which lock guards which dict is the
-    object's own convention; flagging cross-lock confusion would need
-    runtime knowledge the AST does not have)."""
-    cur = m.parents.get(node)
-    while cur is not None and cur is not cls:
-        if isinstance(cur, (ast.With, ast.AsyncWith)):
-            for item in cur.items:
-                path = attr_path(item.context_expr)
-                if path and path.startswith("self."):
-                    if path[len("self."):] in locks:
-                        return True
-        cur = m.parents.get(cur)
+def _node_locked(m: ParsedModule, node: ast.AST, locks: Set[str],
+                 engine) -> bool:
+    """Does ``node`` run under one of the chain's locks (any of them —
+    which lock guards which dict is the object's own convention)?
+
+    v4: the facts come from the shared lockset engine
+    (``analysis/lockflow.py``) — lexical ``with`` nesting plus
+    CFG-accurate bare ``acquire()``/``release()`` spans — replacing
+    this pass's bespoke parent walk and lexical line counting.  A
+    resolved token matches on its attribute segment; an unresolved
+    ``self::attr`` pseudo-token (several classes own the attr name)
+    matches the attr directly."""
+    for tok in engine.held_direct(m, node):
+        if tok.startswith(engine.SELF_PREFIX):
+            attr = tok[len(engine.SELF_PREFIX):]
+        else:
+            attr = tok.rsplit(".", 1)[-1]
+        if attr in locks:
+            return True
     return False
-
-
-def _in_acquire_span(m: ParsedModule, node: ast.AST,
-                     locks: Set[str]) -> bool:
-    """Is ``node`` lexically between a bare ``self.<lock>.acquire()``
-    and its ``release()`` within the enclosing function?  Lexical
-    line-order counting (acquires before the node minus releases
-    before it) — exact for the straight-line ``acquire(); try: ...
-    finally: release()`` idiom this repo would ever write; a release
-    in an earlier branch conservatively closes the span."""
-    fi = m.enclosing_function(node)
-    if fi is None:
-        return False
-    line = getattr(node, "lineno", 0)
-    depth = 0
-    for sub in ast.walk(fi.node):
-        if not (
-            isinstance(sub, ast.Call)
-            and isinstance(sub.func, ast.Attribute)
-            and sub.func.attr in ("acquire", "release")
-        ):
-            continue
-        path = attr_path(sub.func.value)
-        if not (path and path.startswith("self.")
-                and path[len("self."):] in locks):
-            continue
-        if sub.lineno < line:
-            depth += 1 if sub.func.attr == "acquire" else -1
-    return depth > 0
-
-
-def _node_locked(m: ParsedModule, node: ast.AST, cls: ast.ClassDef,
-                 locks: Set[str]) -> bool:
-    return _holds_lock(m, node, cls, locks) or _in_acquire_span(
-        m, node, locks
-    )
 
 
 def _chain_methods(chain: Sequence[_ChainElem]) -> Dict[str, ast.AST]:
@@ -214,9 +183,9 @@ def _chain_call_sites(
     return sites
 
 
-def _site_ok(m: ParsedModule, cls: ast.ClassDef, site: ast.AST,
-             locks: Set[str], sanctioned: Set[str]) -> bool:
-    if _node_locked(m, site, cls, locks):
+def _site_ok(m: ParsedModule, site: ast.AST,
+             locks: Set[str], sanctioned: Set[str], engine) -> bool:
+    if _node_locked(m, site, locks, engine):
         return True
     fi = m.enclosing_function(site)
     while fi is not None:
@@ -228,12 +197,12 @@ def _site_ok(m: ParsedModule, cls: ast.ClassDef, site: ast.AST,
 
 def _lock_inherited_methods(
     chain: Sequence[_ChainElem], locks: Set[str],
-    methods: Dict[str, ast.AST],
+    methods: Dict[str, ast.AST], engine,
 ) -> Set[str]:
     """Methods whose EVERY same-class call site provably holds the
-    lock — directly (with/acquire span) or transitively (the site
-    lives in ``__init__``, a ``*_locked`` helper, or another inherited
-    method); fixpoint until stable."""
+    lock — directly (lockset-engine fact: with/acquire span) or
+    transitively (the site lives in ``__init__``, a ``*_locked``
+    helper, or another inherited method); fixpoint until stable."""
     sites = _chain_call_sites(chain, methods)
     exempt = {"__init__"} | {
         n for n in methods if n.endswith("_locked")
@@ -246,8 +215,8 @@ def _lock_inherited_methods(
             if name in exempt or name in inherited or not calls:
                 continue
             if all(
-                _site_ok(m, cls, c, locks, exempt | inherited)
-                for m, cls, c in calls
+                _site_ok(m, c, locks, exempt | inherited, engine)
+                for m, _cls, c in calls
             ):
                 inherited.add(name)
                 changed = True
@@ -256,7 +225,7 @@ def _lock_inherited_methods(
 
 def _leaky_locked_helpers(
     chain: Sequence[_ChainElem], locks: Set[str],
-    methods: Dict[str, ast.AST], inherited: Set[str],
+    methods: Dict[str, ast.AST], inherited: Set[str], engine,
 ) -> Set[str]:
     """``*_locked`` helpers the call graph DISPROVES: at least one
     same-class call site reaches them without the lock.  The suffix is
@@ -276,21 +245,23 @@ def _leaky_locked_helpers(
             continue
         own = sanctioned - {name}  # a self-recursive site proves nothing new
         if any(
-            not _site_ok(m, cls, c, locks, own) for m, cls, c in calls
+            not _site_ok(m, c, locks, own, engine)
+            for m, _cls, c in calls
         ):
             leaky.add(name)
     return leaky
 
 
 def _iter_dict_mutations(m: ParsedModule, cls: ast.ClassDef,
-                         locks: Set[str]) -> List[_Mutation]:
+                         locks: Set[str], engine) -> List[_Mutation]:
     out: List[_Mutation] = []
 
     def note(attr: Optional[str], node: ast.AST) -> None:
         if attr is None:
             return
         out.append(
-            _Mutation(attr, node, _node_locked(m, node, cls, locks), m, cls)
+            _Mutation(attr, node, _node_locked(m, node, locks, engine),
+                      m, cls)
         )
 
     for node in ast.walk(cls):
@@ -332,8 +303,14 @@ def _exempt(m: ParsedModule, node: ast.AST,
     return False
 
 
-def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
+def run_project(
+    modules: Sequence[ParsedModule], lockflow=None
+) -> List[Finding]:
     table = ClassTable(modules)
+    if lockflow is None:
+        from theanompi_tpu.analysis import lockflow as _lf
+
+        lockflow = _lf.LocksetEngine(modules)
     findings: List[Finding] = []
     for m in modules:
         for node in ast.walk(m.tree):
@@ -346,14 +323,18 @@ def run_project(modules: Sequence[ParsedModule]) -> List[Finding]:
             if not locks:
                 continue
             methods = _chain_methods(chain)
-            inherited = _lock_inherited_methods(chain, locks, methods)
-            leaky = _leaky_locked_helpers(chain, locks, methods, inherited)
+            inherited = _lock_inherited_methods(
+                chain, locks, methods, lockflow
+            )
+            leaky = _leaky_locked_helpers(
+                chain, locks, methods, inherited, lockflow
+            )
             # guarded discipline unions over the chain; findings anchor
             # to the class's OWN body (the base reports as itself)
             guarded: Set[str] = set()
             chain_mutations: List[_Mutation] = []
             for cm, cc in chain:
-                for mu in _iter_dict_mutations(cm, cc, locks):
+                for mu in _iter_dict_mutations(cm, cc, locks, lockflow):
                     chain_mutations.append(mu)
                     if mu.locked:
                         guarded.add(mu.attr)

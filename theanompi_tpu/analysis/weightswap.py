@@ -1,0 +1,284 @@
+"""Pass 8 — weight-swap discipline for jit-fed param trees (GL-W*).
+
+A serving/training class that holds a param tree on ``self`` and feeds
+it to a jitted binding (``self.step = jax.jit(fn)`` ... ``self.step(
+self.params, x)``) has three swap-time traps that are invisible at the
+call site and only bite in production:
+
+- GL-W001 ``swap-changes-leaf`` (warning): a swap (assignment to the
+  fed attribute outside ``__init__``) whose value casts or reshapes
+  leaves — ``.astype(...)``, ``.reshape(...)``, ``np.asarray(...,
+  dtype=...)``, including inside a ``jax.tree.map`` lambda.  New leaf
+  dtype/shape means the jitted step RETRACES AND RECOMPILES on every
+  swap: the steady-state serving path degenerates to compile latency.
+  Cast once at load time instead, keeping the published tree's
+  dtypes/shapes fixed.
+- GL-W002 ``swap-ungated`` (error): the class gen-gates at least one
+  swap of a fed attribute (a generation compare around or inside the
+  swapping method — the same test GL-P003 recognizes) but another
+  method swaps a fed attribute with NO generation check.  The gated
+  sites prove the author knows stale swaps exist; the ungated one can
+  overwrite a newer generation's params.  Self-calibrating: classes
+  that never gen-gate are not flagged.  ``__init__`` is exempt.
+- GL-W003 ``torn-swap`` (error): within one method, the generation
+  marker (``self.gen``/``self.generation``-named attribute) is
+  published BEFORE a later per-leaf store into the fed tree
+  (``self.params["w1"] = ...``).  A reader that checks the generation
+  between the two observes a torn tree — new generation, old leaves.
+  Rebind every leaf first, publish the generation last.
+
+"Fed" is resolved per class: the attributes passed as arguments to a
+jit binding the class itself created.  Parsed only, never executed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.protocol import (
+    _fn_has_gen_compare,
+    _under_gen_check,
+)
+from theanompi_tpu.analysis.source import (
+    ParsedModule,
+    find_jit_wraps,
+    terminal_name,
+)
+
+PASS_ID = "weightswap"
+
+# leaf-shape/dtype changers: calling these on swap input guarantees the
+# next jitted call sees a new avals signature
+_CASTERS = ("astype", "reshape")
+
+_GEN_NAMES = ("generation", "gen")
+
+
+def _is_gen_name(name: str) -> bool:
+    low = name.lower()
+    return any(
+        low == g or low.startswith(g + "_") or low.endswith("_" + g)
+        or (g == "generation" and "generation" in low)
+        for g in _GEN_NAMES
+    )
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _fed_attrs(m: ParsedModule, cls: ast.ClassDef, wraps) -> Set[str]:
+    """Attributes of ``cls`` passed as arguments to a jit binding the
+    class itself created (``self.step = jax.jit(...)``)."""
+    bindings = {
+        w.binding
+        for w in wraps
+        if w.binding and m.enclosing_class(w.call) == cls.name
+    }
+    if not bindings:
+        return set()
+    fed: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _self_attr(node.func)
+        if target not in bindings:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            attr = _self_attr(arg)
+            if attr is not None:
+                fed.add(attr)
+    return fed
+
+
+def _leaf_changer(value: ast.expr) -> Optional[str]:
+    """Name of the cast/reshape a swap value applies to its leaves, or
+    None.  ``ast.walk`` descends into ``tree.map`` lambdas for free."""
+    for sub in ast.walk(value):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = terminal_name(sub.func)
+        if name in _CASTERS:
+            return f".{name}()"
+        if name in ("asarray", "array") and any(
+            kw.arg == "dtype" for kw in sub.keywords
+        ):
+            return f"{name}(dtype=...)"
+    return None
+
+
+def _swap_sites(
+    m: ParsedModule, cls: ast.ClassDef, fed: Set[str]
+) -> List[Tuple[str, ast.Assign, str]]:
+    """(attr, assign-node, method-qualname) for every whole-tree swap
+    of a fed attribute outside ``__init__``."""
+    out = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is None or attr not in fed:
+                continue
+            fi = m.enclosing_function(node)
+            if fi is None or fi.qualname.endswith("__init__"):
+                continue
+            out.append((attr, node, fi.qualname))
+    return out
+
+
+def _w001(
+    m: ParsedModule, swaps: List[Tuple[str, ast.Assign, str]]
+) -> List[Finding]:
+    out = []
+    for attr, node, _fn in swaps:
+        what = _leaf_changer(node.value)
+        if what is None:
+            continue
+        out.append(
+            Finding(
+                rule="GL-W001",
+                pass_id=PASS_ID,
+                severity="warning",
+                file=m.rel,
+                line=node.lineno,
+                symbol=m.symbol_for(node),
+                message=(
+                    f"weight swap rebinds jit-fed param tree "
+                    f"'self.{attr}' through {what} — the new leaves "
+                    f"change dtype/shape, so the jitted step retraces "
+                    f"and RECOMPILES on every swap (steady-state "
+                    f"serving degenerates to compile latency).  Cast "
+                    f"once at load time and keep the published tree's "
+                    f"dtypes fixed"
+                ),
+                snippet=m.snippet(node.lineno),
+            )
+        )
+    return out
+
+
+def _w002(
+    m: ParsedModule,
+    cls: ast.ClassDef,
+    swaps: List[Tuple[str, ast.Assign, str]],
+) -> List[Finding]:
+    gated: List[str] = []
+    ungated: List[Tuple[str, ast.Assign, str]] = []
+    for attr, node, fn in swaps:
+        if _under_gen_check(m, node, cls) or _fn_has_gen_compare(m, node):
+            gated.append(fn)
+        else:
+            ungated.append((attr, node, fn))
+    if not gated or not ungated:
+        return []
+    out = []
+    exemplar = sorted(set(gated))[0]
+    for attr, node, fn in ungated:
+        out.append(
+            Finding(
+                rule="GL-W002",
+                pass_id=PASS_ID,
+                severity="error",
+                file=m.rel,
+                line=node.lineno,
+                symbol=m.symbol_for(node),
+                message=(
+                    f"weight swap of jit-fed 'self.{attr}' in {fn} has "
+                    f"no generation check, but this class gen-gates "
+                    f"its swaps elsewhere ({exemplar}) — a late swap "
+                    f"through this path can overwrite a newer "
+                    f"generation's params.  Guard it with the same "
+                    f"generation compare"
+                ),
+                snippet=m.snippet(node.lineno),
+            )
+        )
+    return out
+
+
+def _w003(
+    m: ParsedModule, cls: ast.ClassDef, fed: Set[str]
+) -> List[Finding]:
+    # per method: earliest gen-marker publish vs latest per-leaf store
+    publishes: Dict[str, Tuple[str, ast.AST]] = {}
+    leaf_stores: Dict[str, List[Tuple[str, int]]] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        fi = m.enclosing_function(node)
+        if fi is None or fi.qualname.endswith("__init__"):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None and _is_gen_name(attr):
+                prev = publishes.get(fi.qualname)
+                if prev is None or node.lineno < prev[1].lineno:
+                    publishes[fi.qualname] = (attr, node)
+            if (
+                isinstance(t, ast.Subscript)
+                and _self_attr(t.value) in fed
+            ):
+                leaf_stores.setdefault(fi.qualname, []).append(
+                    (_self_attr(t.value), node.lineno)
+                )
+    out = []
+    for fn, (gattr, node) in sorted(publishes.items()):
+        later = [
+            (attr, line)
+            for attr, line in leaf_stores.get(fn, [])
+            if line > node.lineno
+        ]
+        if not later:
+            continue
+        attr, line = max(later, key=lambda p: p[1])
+        out.append(
+            Finding(
+                rule="GL-W003",
+                pass_id=PASS_ID,
+                severity="error",
+                file=m.rel,
+                line=node.lineno,
+                symbol=m.symbol_for(node),
+                message=(
+                    f"generation marker 'self.{gattr}' is published "
+                    f"before all leaves of jit-fed 'self.{attr}' are "
+                    f"rebound (leaf store still follows at line {line})"
+                    f" — a reader that checks the generation between "
+                    f"the two sees a TORN tree: new generation, old "
+                    f"leaves.  Rebind every leaf first, publish the "
+                    f"generation last"
+                ),
+                snippet=m.snippet(node.lineno),
+            )
+        )
+    return out
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    wraps = None
+    for cls in ast.walk(m.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if wraps is None:
+            wraps = find_jit_wraps(m)
+        fed = _fed_attrs(m, cls, wraps)
+        if not fed:
+            continue
+        swaps = _swap_sites(m, cls, fed)
+        out.extend(_w001(m, swaps))
+        out.extend(_w002(m, cls, swaps))
+        out.extend(_w003(m, cls, fed))
+    return sorted(out, key=lambda f: (f.file, f.line, f.rule))
