@@ -405,6 +405,224 @@ def _tune_overrides():
     return overrides
 
 
+# every size that differs between the real EASGD arm and its CPU
+# rehearsal, one place (same discipline as _KNOBS_*): the rehearsal
+# runs the SAME loop, only smaller.  steps_per_worker must clear the
+# ladder's top τ (40) or a big-τ candidate never exchanges and the
+# registry's required detail.easgd.exchanges check rightly kills it.
+_EASGD_KNOBS_REAL = dict(
+    model=dict(seq_len=128, vocab_size=256, d_model=128, n_heads=8,
+               n_layers=2, batch_size=8),
+    n_workers=2,
+    steps_per_worker=120,
+    warmup_steps=5,
+)
+_EASGD_KNOBS_REHEARSAL = dict(
+    model=dict(seq_len=32, vocab_size=64, d_model=32, n_heads=4,
+               n_layers=2, batch_size=2),
+    n_workers=2,
+    steps_per_worker=44,
+    warmup_steps=2,
+)
+
+
+def _easgd_main():
+    """The EASGD bench arm (``THEANOMPI_BENCH_RULE=EASGD``): the
+    workload the ``easgd`` tuning plan measures ``easgd_tau`` against.
+
+    Simulated workers train a small TransformerLM and exchange with an
+    in-process :class:`EasgdServerCore` every τ local steps — the real
+    elastic math, membership roster, and the online-learning
+    ``CenterPublisher`` cadence (docs/online_learning.md), minus the
+    TCP transport.  Everything runs in the MAIN thread, round-robin:
+    this rig's CPU client segfaults under threaded jax dispatch, and
+    the server core's handler is host-numpy so in-process calls are
+    safe.  Headline: aggregate worker steps/sec (its own metric name —
+    the driver's history compares like against like, never against the
+    BSP images/sec line).
+    """
+    tune = _tune_overrides()
+    tau = 10
+    tune_echo = None
+    if tune is not None:
+        for t_name, t_value in sorted(tune.items()):
+            if t_name == "easgd_tau":
+                tau = int(t_value)
+            else:
+                print(f"[bench] unknown EASGD tune override {t_name!r}",
+                      file=sys.stderr)
+                sys.exit(2)
+        tune_echo = {
+            "overrides": tune,
+            "seed": TUNE_SEED,
+            "budget": os.environ.get("THEANOMPI_TUNE_BUDGET", "full"),
+            "inert": [],
+        }
+    knobs = _EASGD_KNOBS_REHEARSAL if CPU_REHEARSAL else _EASGD_KNOBS_REAL
+    if os.environ.get("THEANOMPI_TUNE_BUDGET") == "short":
+        # successive-halving first rung: half the window, same τ reach
+        # (44 > the ladder's top τ=40, so every rung still exchanges)
+        knobs = dict(knobs, steps_per_worker=max(44, knobs["steps_per_worker"] // 2))
+
+    from theanompi_tpu import observability as observability
+    from theanompi_tpu.observability import live as obs_live
+
+    observability.enable_tracing()
+    telemetry = obs_live.maybe_start_from_env("easgd0")
+    if CPU_REHEARSAL:
+        print(
+            f"[bench] CPU rehearsal (EASGD arm): {jax.device_count()} "
+            "fake devices, probe skipped, windows shrunk",
+            file=sys.stderr,
+        )
+    else:
+        _require_devices()
+    from theanompi_tpu.cachedir import configure_compile_cache
+
+    configure_compile_cache(jax, use_repo_cache=not CPU_REHEARSAL)
+
+    import numpy as np
+
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.parallel.distributed_async import EasgdServerCore
+    from theanompi_tpu.runtime.mesh import replicate, shard_batch
+
+    cfg = dict(
+        knobs["model"],
+        lr=0.05,
+        n_synth_train=4,
+        n_synth_val=1,
+        print_freq=10_000,
+    )
+    mesh = TransformerLM.build_mesh(config=cfg)
+    model = TransformerLM(config=cfg, mesh=mesh)
+    train_fn = model.compile_train()
+    batches = [shard_batch(mesh, b) for b in model.data.train_batches()]
+    keys = list(jax.random.split(jax.random.PRNGKey(TUNE_SEED), 2100))
+
+    n_workers = knobs["n_workers"]
+    n_steps = knobs["steps_per_worker"]
+    alpha = 0.5
+    publish_every = 2  # ≥1 publication even when only ⌊steps/τ⌋ = 1
+    # exchange per worker lands — the knob's required publish check
+    # must depend on the rule running, not on a lucky τ
+
+    # the center is a HOST copy: the server core's elastic math is
+    # plain numpy, exactly what rides the TCP path in production
+    center = jax.tree.map(np.array, jax.device_get(model.params))
+    core = EasgdServerCore(center, alpha=alpha, publish_every=publish_every)
+
+    # per-worker training state on the shared mesh; distinct key slices
+    # stand in for per-worker data/rng diversity (synthetic workload)
+    workers = []
+    for w in range(n_workers):
+        core.handler({"kind": "join", "rank": w})
+        workers.append({
+            "rank": w,
+            "state": jax.tree.map(
+                jnp.copy, (model.params, model.net_state, model.opt_state)
+            ),
+            "local_steps": 0,
+        })
+
+    def step_worker(wk, i):
+        p, s, o = wk["state"]
+        x, y = batches[(i * n_workers + wk["rank"]) % len(batches)]
+        k = keys[(i * n_workers + wk["rank"]) % len(keys)]
+        p, s, o, loss, _ = train_fn(p, s, o, x, y, k)
+        wk["state"] = (p, s, o)
+        return loss
+
+    def exchange(wk):
+        host = jax.tree.map(np.array, jax.device_get(wk["state"][0]))
+        with observability.span("easgd_exchange", rank=wk["rank"],
+                                tau=tau):
+            reply = core.handler({
+                "kind": "exchange", "rank": wk["rank"],
+                "params": host, "step": wk["local_steps"],
+            })
+        p = replicate(mesh, reply["params"])
+        wk["state"] = (p,) + wk["state"][1:]
+
+    # warmup: compile + settle, outside the measured window
+    for i in range(knobs["warmup_steps"]):
+        for wk in workers:
+            loss = step_worker(wk, i)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        for wk in workers:
+            with observability.span("train_iter", iter=i,
+                                    rank=wk["rank"]):
+                loss = step_worker(wk, i + knobs["warmup_steps"])
+            wk["local_steps"] += 1
+            if wk["local_steps"] % tau == 0:
+                exchange(wk)
+    for wk in workers:
+        jax.block_until_ready(wk["state"][0])
+    dt = time.perf_counter() - t0
+    assert jnp.isfinite(loss), f"EASGD bench diverged: loss={loss}"
+
+    steps_per_sec = n_workers * n_steps / dt
+    ann = core.publisher.announcement()
+    detail = {
+        "chips": jax.device_count(),
+        "device_kind": jax.devices()[0].device_kind,
+        "workers": n_workers,
+        "steps_per_worker": n_steps,
+        "total_s": round(dt, 3),
+        "loss_final": float(loss),
+        "easgd": {
+            "tau": tau,
+            "alpha": alpha,
+            "exchanges": core.n_exchanges,
+            "publish": {
+                "publish_every": publish_every,
+                "published": core.publisher.n_published,
+                "center_generation": (
+                    ann["generation"] if ann is not None else 0
+                ),
+            },
+        },
+    }
+    live_summary = None
+    if telemetry is not None:
+        try:
+            live_summary = telemetry.stop()
+        except Exception as e:  # the monitor must never cost the number
+            live_summary = f"failed: {type(e).__name__}: {e}"
+    try:
+        paths = observability.dump_all(prefix="bench_easgd_")
+        detail["observability"] = {
+            "trace_chrome": paths["trace_chrome"],
+            "trace_raw": paths["trace_raw"],
+            "metrics": observability.get_registry().snapshot(),
+        }
+        if live_summary is not None:
+            detail["observability"]["live"] = live_summary
+        if "doctor" in paths:
+            detail["observability"]["doctor"] = paths["doctor"]
+    except OSError as e:  # export must never discard the measurement
+        print(f"[bench] observability export failed: {e}",
+              file=sys.stderr, flush=True)
+        detail["observability"] = f"failed: {type(e).__name__}: {e}"
+    if tune_echo is not None:
+        detail["tuning"] = tune_echo
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_easgd_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "worker steps/sec",
+                "vs_baseline": 1.0,
+                "measured_now": True,
+                "detail": detail,
+            }
+        )
+    )
+
+
 def main():
     if os.environ.get("THEANOMPI_BENCH_SERVE") == "1":
         # serving-side bench (BENCH_serve schema: generated tokens/s +
@@ -416,12 +634,20 @@ def main():
         # bench_serve's parser (--replicas rides the env knob here)
         bench_serve.main([])
         return
+    if os.environ.get("THEANOMPI_BENCH_RULE") == "EASGD":
+        # the elastic-averaging arm (easgd tuning plan): simulated
+        # workers against an in-process EASGD server core with the
+        # online-learning publisher live — easgd_tau is a REAL knob
+        # there, not the inert echo it used to be on the BSP workload
+        _easgd_main()
+        return
     knobs = _KNOBS_REHEARSAL if CPU_REHEARSAL else _KNOBS_REAL
     # candidate-config injection for the self-tuning driver: model-config
     # knobs ride into every staged candidate's build, the trace sampling
-    # knob into enable_tracing; easgd_tau is accepted + echoed but inert
-    # here (the BSP bench never runs the EASGD rule — the registry
-    # declares it inert_on_bench so the driver refuses to "tune" it)
+    # knob into enable_tracing.  easgd_tau no longer lands here: the
+    # registry routes it to the easgd plan, whose driver sets
+    # THEANOMPI_BENCH_RULE=EASGD and takes the branch above — on the
+    # BSP workload it is an unknown override and exits loudly.
     tune = _tune_overrides()
     tune_model_cfg = {}
     tune_sample = None
@@ -432,8 +658,6 @@ def main():
                 tune_model_cfg["exchange_bucket_mb"] = float(t_value)
             elif t_name == "trace_sample":
                 tune_sample = int(t_value)
-            elif t_name == "easgd_tau":
-                tune_inert.append(t_name)
             else:
                 print(f"[bench] unknown tune override {t_name!r}",
                       file=sys.stderr)

@@ -47,6 +47,13 @@ could not even pose:
   identical), a kill-one-replica failover probe (re-admissions,
   token-identity vs the uninterrupted fleet) and a health-shed probe
   (zero admissions while red).
+- **the online learning loop** (``detail.publish``) — an in-process
+  EASGD core publishes a fresh center mid-decode and the replica's
+  ``publish.WeightSubscriber`` pulls/validates/installs it between
+  ticks: install wait behind in-flight work, snapshot bytes pulled,
+  and the extra-compile count (must be 0 — params are data).  Token
+  identity / rollback / refusal correctness lives in the PUBLISH chaos
+  drill (perf_gate publish leg), not here.
 
 Protocol:
 - ``TransformerLM`` at the flagship serve config (rehearsal shrinks it,
@@ -628,6 +635,139 @@ def _fleet_probe(model, knobs, n_replicas):
     return detail
 
 
+def _publish_probe(model, knobs):
+    """detail.publish: the online-learning live swap measured from the
+    SERVING side (docs/online_learning.md) — an in-process EASGD core
+    publishes a new center mid-decode, the replica's subscriber pulls,
+    validates, and installs between ticks.  This probe records the
+    swap's serving-visible COSTS (install wait behind in-flight work,
+    snapshot bytes pulled, extra compiles); full correctness — token
+    identity, rollback, refusal — is the PUBLISH chaos drill's job
+    (runtime/chaos.py, perf_gate publish leg)."""
+    import numpy as np
+
+    from theanompi_tpu.parallel.distributed_async import EasgdServerCore
+    from theanompi_tpu.publish import WeightSubscriber
+    from theanompi_tpu.serving import PagedServingEngine, Request
+    from theanompi_tpu.serving.fleet import FleetRouter, ServeReplica
+    from theanompi_tpu.serving.loader import relayout_for_serving
+
+    bs = knobs["block_size"]
+    engine = PagedServingEngine(
+        model, n_slots=knobs["paged_slots"], max_len=knobs["max_len"],
+        block_size=bs, prefill_chunk=knobs["prefill_chunk"],
+    )
+    rep = ServeReplica("pub0", engine).start()
+    router = FleetRouter(evict_after_s=3600.0)
+    router.add_replica("pub0", rep)
+
+    params0 = jax.tree.map(np.array, jax.device_get(model.params))
+    snapshot_bytes = sum(
+        a.nbytes for a in jax.tree.leaves(params0)
+        if hasattr(a, "nbytes")
+    )
+    publish_every = 2
+    core = EasgdServerCore(
+        jax.tree.map(np.copy, params0), alpha=0.5,
+        publish_every=publish_every,
+    )
+    rng = np.random.RandomState(_SEED_BASE + 7)
+    worker = jax.tree.map(
+        lambda a: a + rng.normal(0, 0.02, a.shape).astype(a.dtype)
+        if a.dtype == np.float32 else a,
+        params0,
+    )
+    core.handler({"kind": "join", "rank": 0})
+
+    def fetch(generation):
+        reply = core.handler(
+            {"kind": "weights", "generation": int(generation)}
+        )
+        return reply if reply.get("ok") else None
+
+    sub = WeightSubscriber(
+        rep, fetch, relayout=lambda p: relayout_for_serving(model, p)
+    )
+
+    # one prompt length -> one prefill bucket: the probe's trace pin
+    # isolates the SWAP's compile cost, not workload bucket variety
+    n_req = 4
+    new = min(8, knobs["max_new_tokens"])
+    prompts = [
+        rng.randint(0, knobs["vocab_size"], size=bs + 2).tolist()
+        for _ in range(n_req)
+    ]
+
+    def cohort(tag):
+        ids = []
+        for j, p in enumerate(prompts):
+            r = Request(id=f"{tag}{j}", prompt=list(p),
+                        max_new_tokens=new)
+            router.submit(r)
+            ids.append(r.id)
+        out = router.run(timeout_s=600)
+        return [list(out[i]) for i in ids]
+
+    try:
+        cohort("warm")  # compile both phases outside every measurement
+        traces0 = (engine._n_prefill_traces, engine._n_decode_traces)
+
+        # cohort A decoding when the publish lands: install must wait
+        # for the in-flight work (the between-ticks/idle contract)
+        for j, p in enumerate(prompts):
+            router.submit(Request(id=f"a{j}", prompt=list(p),
+                                  max_new_tokens=new))
+        deadline = time.perf_counter() + 600
+        while not any(
+            s.tokens and not s.done for s in router._streams.values()
+        ):
+            if time.perf_counter() > deadline:
+                raise RuntimeError("publish probe never started decoding")
+            router.pump()
+            time.sleep(0.002)
+        ann = None
+        for _ in range(publish_every):
+            ann = core.handler(
+                {"kind": "exchange", "rank": 0,
+                 "params": jax.tree.map(np.copy, worker)}
+            ).get("publish", ann)
+        t_pub = time.perf_counter()
+        accepted = sub.poll(ann)
+        deferred = rep.serving_generation == 0
+        while rep.serving_generation != 1:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("publish probe install never landed")
+            router.pump()
+            time.sleep(0.002)
+        install_wait = time.perf_counter() - t_pub
+        a_out = [list(router.run(timeout_s=600)[f"a{j}"])
+                 for j in range(n_req)]
+
+        b_out = cohort("b")  # admitted on the new generation
+        traces1 = (engine._n_prefill_traces, engine._n_decode_traces)
+        return {
+            "publish_every": publish_every,
+            "published": core.publisher.n_published,
+            "announced_generation": (
+                int(ann["generation"]) if ann else 0
+            ),
+            "accepted": bool(accepted),
+            "snapshot_bytes": int(snapshot_bytes),
+            "install_deferred_while_busy": bool(deferred),
+            "install_wait_s": round(install_wait, 4),
+            "serving_generation": rep.serving_generation,
+            "installs": sub.installs,
+            "refusals": sub.refusals,
+            # different weights should decode differently; recorded,
+            # not asserted (the drill owns correctness claims)
+            "outputs_changed_across_swap": a_out != b_out,
+            "extra_prefill_traces": traces1[0] - traces0[0],
+            "extra_decode_traces": traces1[1] - traces0[1],
+        }
+    finally:
+        rep.stop()
+
+
 def _long_tail_prompts(rng, knobs):
     """Mixed-length burst: mostly short prompts, a long tail near
     max_len — the workload shape that wastes contiguous slot memory."""
@@ -866,6 +1006,11 @@ def main(argv=None):
     if engine_kind != "contiguous" and n_fleet >= 2:
         fleet_detail = _fleet_probe(model, knobs, n_fleet)
 
+    # ---- online-learning publish probe (ISSUE 18) -------------------
+    publish_detail = None
+    if engine_kind != "contiguous":
+        publish_detail = _publish_probe(model, knobs)
+
     summary = metrics.summary()
     n_tokens = summary["n_tokens_out"]
     detail = {
@@ -906,6 +1051,8 @@ def main(argv=None):
         detail["kv_quant"] = kv_quant_detail
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
+    if publish_detail is not None:
+        detail["publish"] = publish_detail
     if tune is not None:
         # echo the candidate config: the trial harness proves injection
         # by comparing this against what it sent

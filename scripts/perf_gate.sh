@@ -150,6 +150,26 @@
 #                           keeps a 3s absolute floor for the CI-sized
 #                           eviction window)
 #
+# Publish leg (the online-learning live-swap drill; docs/online_learning.md):
+#   PERF_GATE_PUBLISH       1 (default) = run the live weight-publication
+#                           drill: an EASGD center publishes generation 1
+#                           mid-decode into a 2-replica fleet.  REQUIRE
+#                           exactly one install per publish fleet-wide,
+#                           token-boundary consistency (every pinned
+#                           cohort token-identical to its generation's
+#                           single-scheduler reference), a planted SLO
+#                           regression rolled back exactly once with one
+#                           weights_rolled_back alert, a wrong-shape
+#                           snapshot refused before install, and ZERO
+#                           recompiles across the install/rollback
+#                           episode.  0 = skip (escape hatch).
+#   PERF_GATE_PUBLISH_JSON  pre-produced drill verdict JSON (skips
+#                           running — the tier-1 smoke path)
+#   PERF_GATE_PUBLISH_CMD   command producing the drill JSON (default:
+#                           python -m theanompi_tpu.runtime.chaos
+#                           --rule PUBLISH)
+#   PERF_GATE_PUBLISH_EVERY exchanges between publishes (default 3)
+#
 # Tune leg (the closed-loop self-tuning driver's own drill; docs/tuning.md):
 #   PERF_GATE_TUNE          1 (default) = run the tuning driver twice
 #                           against the committed fixture bench on a COPY
@@ -688,7 +708,78 @@ print(f"[perf_gate] fleet: {kills} kill -> {v.get('evictions')} eviction, "
 PY
 fi
 
-# ---- 10. tune leg: the self-tuning driver's own drill -----------------------
+# ---- 10. publish leg: the online-learning live-swap drill -------------------
+if [ "${PERF_GATE_PUBLISH:-1}" = "1" ]; then
+    PUBLISH_JSON="${PERF_GATE_PUBLISH_JSON:-}"
+    if [ -z "$PUBLISH_JSON" ]; then
+        PUBLISH_JSON="$WORKDIR/publish.json"
+        PUBLISH_EVERY="${PERF_GATE_PUBLISH_EVERY:-3}"
+        PUBLISH_CMD="${PERF_GATE_PUBLISH_CMD:-env JAX_PLATFORMS=cpu python -m theanompi_tpu.runtime.chaos --rule PUBLISH --publish-every $PUBLISH_EVERY}"
+        echo "[perf_gate] publish drill: $PUBLISH_CMD" >&2
+        set +e
+        sh -c "$PUBLISH_CMD" > "$PUBLISH_JSON"
+        PUBLISH_RC=$?
+        set -e
+        if [ ! -s "$PUBLISH_JSON" ]; then
+            echo "[perf_gate] PUBLISH VIOLATION: drill produced no verdict (exit $PUBLISH_RC)" >&2
+            exit 1
+        fi
+    fi
+    # structure check, independent of the drill's self-assessment:
+    # one install per publish, every pinned cohort token-identical to
+    # its generation's reference, the planted regression rolled back
+    # exactly once with exactly one alert, refusal before install,
+    # zero recompiles across the episode
+    python - "$PUBLISH_JSON" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+v = (doc.get("rules") or {}).get("PUBLISH")
+if not isinstance(v, dict):
+    sys.exit("[perf_gate] PUBLISH VIOLATION: drill verdict has no "
+             "PUBLISH rule")
+for viol in v.get("violations", []):
+    print(f"[perf_gate] PUBLISH VIOLATION: {viol}", file=sys.stderr)
+if not v.get("ok"):
+    sys.exit(1)
+pubs = v.get("n_publishes", 0)
+if pubs < 1 or v.get("n_installs") != pubs:
+    sys.exit(f"[perf_gate] PUBLISH VIOLATION: {v.get('n_installs')} "
+             f"install(s) for {pubs} publish(es) — want exactly one "
+             "install per publish fleet-wide")
+if v.get("token_identical_gen0") is not True:
+    sys.exit("[perf_gate] PUBLISH VIOLATION: the mid-decode cohort is "
+             "NOT token-identical to its admission generation — the "
+             "install tore into in-flight streams")
+if v.get("ab_cohort_identical") is not True:
+    sys.exit("[perf_gate] PUBLISH VIOLATION: pinned A/B cohorts are "
+             "NOT token-identical to their generations' references")
+if v.get("ab_verdict_planted") != "regression":
+    sys.exit(f"[perf_gate] PUBLISH VIOLATION: planted SLO regression "
+             f"judged {v.get('ab_verdict_planted')!r}, not 'regression'")
+if v.get("rollbacks") != 1:
+    sys.exit(f"[perf_gate] PUBLISH VIOLATION: {v.get('rollbacks')} "
+             "rollback(s) for one flagged generation, want exactly 1")
+if v.get("weights_rolled_back_alerts") != 1:
+    sys.exit(f"[perf_gate] PUBLISH VIOLATION: "
+             f"{v.get('weights_rolled_back_alerts')} weights_rolled_back "
+             "alert(s), want exactly 1")
+if v.get("post_rollback_identical") is not True:
+    sys.exit("[perf_gate] PUBLISH VIOLATION: post-rollback cohort does "
+             "not match the restored generation")
+if v.get("refused_bad_dtype") is not True:
+    sys.exit("[perf_gate] PUBLISH VIOLATION: a wrong-shape snapshot was "
+             "not refused before install")
+if v.get("extra_recompiles", 1) != 0:
+    sys.exit(f"[perf_gate] PUBLISH VIOLATION: "
+             f"{v.get('extra_recompiles')} recompile(s) across the "
+             "install/rollback episode — the swap must be params-as-data")
+print(f"[perf_gate] publish: {pubs} publish -> {v.get('n_installs')} "
+      f"install, cohorts token-identical, {v.get('rollbacks')} rollback, "
+      f"{v.get('extra_recompiles')} extra recompile(s)", file=sys.stderr)
+PY
+fi
+
+# ---- 11. tune leg: the self-tuning driver's own drill -----------------------
 if [ "${PERF_GATE_TUNE:-1}" = "1" ]; then
     TUNE_DRIVER="${PERF_GATE_TUNE_CMD:-python -m theanompi_tpu.tuning}"
     TUNE_FIXTURE="tests/data/tuning/fixture_bench.py"

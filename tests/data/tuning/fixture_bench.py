@@ -12,8 +12,9 @@ Two planted landscapes, selected by ``THEANOMPI_TUNE_FIXTURE_MODE``:
 
 - ``better`` (default): a known-better rung exists per knob (serve:
   ``spec_k=16``, ``kv_dtype='int8'``; train: ``exchange_bucket_mb=8.0``,
-  ``trace_sample=8``; fleet: ``fleet_replicas=4``) and every verdict
-  instrument stays green — the driver MUST converge to it.
+  ``trace_sample=8``; fleet: ``fleet_replicas=4``; easgd:
+  ``easgd_tau=20``) and every verdict instrument stays green — the
+  driver MUST converge to it.
 - ``regression``: every move away from the defaults looks FASTER on
   the headline (tempting) but trips a red flag on the instrument that
   owns the knob — a spec token-identity break, a kv dequant-drift
@@ -47,7 +48,7 @@ BONUS = {
     "prefill_chunk": {64: 0.0, 128: 1.0, 256: 3.0, 512: 2.0},
     "exchange_bucket_mb": {1.0: 0.0, 2.0: 1.0, 4.0: 3.0, 8.0: 5.0,
                            16.0: 2.0},
-    "easgd_tau": {2: 0.0, 5: 1.0, 10: 2.0, 20: 1.5, 40: 0.5},
+    "easgd_tau": {2: 0.0, 5: 1.0, 10: 2.0, 20: 4.0, 40: 0.5},
     "trace_sample": {1: 1.0, 2: 2.0, 8: 3.0, 32: 2.5},
     "fleet_replicas": {2: 0.0, 3: 2.0, 4: 3.0},
 }
@@ -89,6 +90,18 @@ def main():
         for knob, v in config.items():
             value += BONUS[knob][v]
         detail["ttft_p99_s"] = round(10.0 / value, 6)
+
+    if "easgd_tau" in overrides:
+        # the easgd knob's REQUIRED detail checks: the arm must prove
+        # the elastic rule actually ran and the publisher fired —
+        # mirror bench.py's detail.easgd block (shape contract only)
+        tau = int(config["easgd_tau"])
+        detail["easgd"] = {
+            "tau": tau,
+            "exchanges": max(1, 88 // tau),
+            "publish": {"publish_every": 2, "published": 1,
+                        "center_generation": 1},
+        }
 
     if "fleet_replicas" in overrides:
         lost = (

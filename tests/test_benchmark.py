@@ -357,3 +357,44 @@ def test_bench_cpu_rehearsal_end_to_end():
     # a CPU rehearsal must never bank: only real-TPU runs may write the
     # re-emittable measurement (redirected here via THEANOMPI_BENCH_BANK)
     assert not os.path.exists(bank_redirect), "rehearsal banked a CPU value"
+
+
+def test_bench_easgd_arm_cpu_rehearsal_end_to_end():
+    """The EASGD arm (THEANOMPI_BENCH_RULE=EASGD) — the easgd tuning
+    plan's workload — runs end-to-end in rehearsal: round-robin
+    workers, real elastic exchanges against the in-process server
+    core, and the online-learning publish cadence all proven live
+    (detail.easgd carries the required-check fields the registry's
+    easgd_tau knob judges)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, THEANOMPI_BENCH_CPU="1",
+               THEANOMPI_BENCH_RULE="EASGD",
+               THEANOMPI_TUNE_BUDGET="short",
+               THEANOMPI_TUNE_OVERRIDES=json.dumps({"easgd_tau": 5}))
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, f"EASGD arm failed:\n{out.stderr[-2000:]}"
+    line = out.stdout.strip().splitlines()[-1]
+    j = json.loads(line)
+    assert j["metric"] == "transformer_easgd_steps_per_sec"
+    assert j["value"] > 0 and j["measured_now"] is True
+    e = j["detail"]["easgd"]
+    assert e["tau"] == 5
+    # 2 workers x 44 steps at tau=5 -> 8 exchanges each; the required
+    # detail checks (exchanges >= 1, published >= 1) must hold with room
+    assert e["exchanges"] == 16
+    assert e["publish"]["publish_every"] >= 1
+    assert e["publish"]["published"] == 8
+    assert e["publish"]["center_generation"] == 8
+    # injection is provable: the echo matches what was sent
+    assert j["detail"]["tuning"]["overrides"] == {"easgd_tau": 5}
+    assert j["detail"]["tuning"]["inert"] == []

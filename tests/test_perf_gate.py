@@ -57,6 +57,7 @@ def _run_gate(env_extra):
     env.setdefault("PERF_GATE_CHAOS", "0")
     env.setdefault("PERF_GATE_FLEET", "0")
     env.setdefault("PERF_GATE_BSP", "0")
+    env.setdefault("PERF_GATE_PUBLISH", "0")
     env.setdefault("PERF_GATE_TUNE", "0")
     # the LINT leg stays default-ON; feeding the committed artifact
     # back as the "current" document keeps the smoke tests off the
@@ -810,6 +811,126 @@ def test_gate_bsp_leg_skippable(fixtures):
     assert r.returncode == 0, r.stderr
     assert "bsp drill" not in r.stderr
     assert "bsp:" not in r.stderr
+    assert "green" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# publish leg (ISSUE 18): the online-learning live-swap drill verdict
+# gates the round — smoke-tested on fixture verdicts like the other legs
+# ---------------------------------------------------------------------------
+
+def _publish_json(path, ok=True, publishes=1, installs=None,
+                  gen0_identical=True, ab_identical=True,
+                  planted="regression", rollbacks=1, alerts=None,
+                  post_rollback=True, refused=True, extra_recompiles=0,
+                  violations=None):
+    doc = {"rules": {"PUBLISH": {
+        "rule": "PUBLISH",
+        "ok": ok,
+        "violations": list(violations or ()),
+        "n_requests": 6,
+        "publish_every": 3,
+        "n_publishes": publishes,
+        "install_deferred_while_busy": True,
+        "token_identical_gen0": gen0_identical,
+        "n_installs": publishes if installs is None else installs,
+        "ab_cohort_identical": ab_identical,
+        "ab_verdict_unplanted": "pass",
+        "ab_verdict_planted": planted,
+        "rollbacks": rollbacks,
+        "post_rollback_identical": post_rollback,
+        "refused_bad_dtype": refused,
+        "extra_recompiles": extra_recompiles,
+        "weights_rolled_back_alerts": (
+            rollbacks if alerts is None else alerts
+        ),
+    }}, "ok": ok}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def _publish_env(fixtures, publish_json):
+    base, good, _ = fixtures
+    return {
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_PUBLISH": "1",
+        "PERF_GATE_PUBLISH_JSON": publish_json,
+    }
+
+
+def test_gate_publish_leg_green(fixtures, tmp_path):
+    r = _run_gate(
+        _publish_env(fixtures, _publish_json(tmp_path / "pub.json"))
+    )
+    assert r.returncode == 0, r.stderr
+    assert "publish: 1 publish -> 1 install" in r.stderr
+    assert "cohorts token-identical" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_publish_leg_fails_on_install_mismatch(fixtures, tmp_path):
+    """Two installs for one publish = the subscriber double-applied;
+    refused independent of the drill's self-assessment."""
+    pub = _publish_json(tmp_path / "pub.json", installs=2)
+    r = _run_gate(_publish_env(fixtures, pub))
+    assert r.returncode != 0
+    assert "install per publish" in (r.stdout + r.stderr)
+
+
+def test_gate_publish_leg_fails_on_torn_stream(fixtures, tmp_path):
+    pub = _publish_json(
+        tmp_path / "pub.json", ok=False, gen0_identical=False,
+        violations=["cohort A is NOT token-identical to the gen-0 "
+                    "reference"],
+    )
+    r = _run_gate(_publish_env(fixtures, pub))
+    assert r.returncode != 0
+    assert "PUBLISH VIOLATION" in r.stderr
+
+
+def test_gate_publish_leg_fails_on_missed_rollback(fixtures, tmp_path):
+    """A planted SLO regression that never rolls back (or double-rolls)
+    is a broken canary loop — both shapes refused."""
+    none = _publish_json(tmp_path / "none.json", rollbacks=0, alerts=0)
+    r = _run_gate(_publish_env(fixtures, none))
+    assert r.returncode != 0
+    assert "rollback(s)" in (r.stdout + r.stderr)
+    silent = _publish_json(tmp_path / "silent.json", alerts=0)
+    r2 = _run_gate(_publish_env(fixtures, silent))
+    assert r2.returncode != 0
+    assert "weights_rolled_back" in (r2.stdout + r2.stderr)
+
+
+def test_gate_publish_leg_fails_on_recompiles(fixtures, tmp_path):
+    pub = _publish_json(tmp_path / "pub.json", extra_recompiles=2)
+    r = _run_gate(_publish_env(fixtures, pub))
+    assert r.returncode != 0
+    assert "params-as-data" in (r.stdout + r.stderr)
+
+
+def test_gate_publish_leg_fails_on_unrefused_shape(fixtures, tmp_path):
+    pub = _publish_json(tmp_path / "pub.json", refused=False)
+    r = _run_gate(_publish_env(fixtures, pub))
+    assert r.returncode != 0
+    assert "not refused before install" in (r.stdout + r.stderr)
+
+
+def test_gate_publish_leg_skippable(fixtures):
+    base, good, _ = fixtures
+    r = _run_gate({
+        "PERF_GATE_BENCH_JSON": good,
+        "PERF_GATE_BASELINE": base,
+        "PERF_GATE_WATCHDOG": "0",
+        "PERF_GATE_FAILOVER": "0",
+        "PERF_GATE_PUBLISH": "0",
+    })
+    assert r.returncode == 0, r.stderr
+    assert "publish drill" not in r.stderr
+    assert "publish:" not in r.stderr
     assert "green" in r.stderr
 
 

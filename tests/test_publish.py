@@ -1,0 +1,513 @@
+"""The online learning loop (ISSUE 18): center → serving replicas.
+
+Acceptance contracts under test:
+
+- **Publisher cadence + marker-last**: the center snapshot publishes
+  every N exchanges under a monotone generation; the announcement is
+  ``(generation, digest)``; snapshots are isolated from later center
+  mutation; only the latest generation is served.
+- **Relayout round-trip**: a host-numpy center tree re-lays into
+  serving placement value-identical, idempotently, and a
+  different-architecture tree is refused loudly.
+- **GL-W refusal**: dtype/shape/structure mismatches raise
+  :class:`SwapRefused` BEFORE the served tree is touched — the
+  recompile hazard never reaches ``install_params``.
+- **Torn installs impossible by position**: an install queued while
+  streams are in flight defers to the between-ticks idle gap; the
+  in-flight cohort finishes token-identical to a gen-0 reference and
+  the generation marker moves only after the drain.
+- **Exactly one rollback per flagged generation** plus exactly one
+  ``weights_rolled_back`` event; re-flagging and stale flags are
+  no-ops.
+- **The committed PUBLISH chaos drill stays green** (the same verdict
+  perf_gate's publish leg gates on).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.models.transformer import TransformerLM
+from theanompi_tpu.parallel.distributed_async import EasgdServerCore
+from theanompi_tpu.publish import (
+    CenterPublisher,
+    SwapRefused,
+    WeightSubscriber,
+    compare_cohorts,
+    snapshot_digest,
+    validate_swap,
+)
+from theanompi_tpu.runtime.mesh import make_mesh
+from theanompi_tpu.serving import PagedServingEngine, Request
+from theanompi_tpu.serving.fleet import ServeReplica
+from theanompi_tpu.serving.loader import relayout_for_serving
+from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+CFG = dict(
+    seq_len=64,
+    vocab_size=32,
+    d_model=32,
+    n_heads=4,
+    n_layers=2,
+    batch_size=2,
+    n_synth_train=2,
+    n_synth_val=1,
+    comm_probe=False,
+    print_freq=10_000,
+)
+GEOM = dict(n_slots=2, max_len=64, buckets=(8, 16, 64), block_size=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    mesh = make_mesh(devices=jax.devices()[:1])
+    return TransformerLM(config=dict(CFG), mesh=mesh)
+
+
+@pytest.fixture
+def event_tap():
+    """Capture the observability event bus for one test."""
+    tap = []
+
+    def fn(kind, fields):
+        tap.append((kind, dict(fields)))
+
+    obs.subscribe(fn)
+    yield tap
+    obs._subscribers.remove(fn)
+
+
+def _tree(seed=0, shapes=((4, 3), (5,))):
+    rng = np.random.RandomState(seed)
+    return {
+        f"w{i}": rng.randn(*s).astype(np.float32)
+        for i, s in enumerate(shapes)
+    }
+
+
+def _perturb(tree, seed=7, scale=0.02):
+    rng = np.random.RandomState(seed)
+    return jax.tree.map(
+        lambda a: (
+            a + rng.normal(0, scale, a.shape).astype(a.dtype)
+            if np.asarray(a).dtype == np.float32 else a
+        ),
+        tree,
+    )
+
+
+# ---------------------------------------------------------------------------
+# publisher: cadence, marker-last generation, snapshot isolation
+# ---------------------------------------------------------------------------
+
+def test_publisher_cadence_and_announcement():
+    center = _tree()
+    pub = CenterPublisher(lambda: center, publish_every=2)
+    assert pub.announcement() is None
+    assert pub.maybe_publish(1) is None  # off-cadence: no publish
+    ann = pub.maybe_publish(2)
+    assert ann is not None and ann["generation"] == 1
+    assert pub.announcement() == ann
+    assert ann["digest"] == snapshot_digest(center)
+    assert pub.maybe_publish(3) is None
+    assert pub.maybe_publish(4)["generation"] == 2
+    assert pub.n_published == 2
+
+
+def test_publisher_disabled_cadence_never_fires():
+    pub = CenterPublisher(lambda: _tree(), publish_every=0)
+    for n in range(1, 6):
+        assert pub.maybe_publish(n) is None
+    assert pub.announcement() is None
+    assert pub.snapshot() is None
+
+
+def test_published_snapshot_isolated_from_live_center():
+    center = _tree()
+    pub = CenterPublisher(lambda: center, publish_every=1)
+    ann = pub.maybe_publish(1)
+    center["w0"] += 1.0  # the next exchange mutates the live center
+    snap = pub.snapshot()
+    assert snap["generation"] == 1
+    # the snapshot still verifies against the ANNOUNCED digest — a
+    # publisher that handed out a view would fail this byte-for-byte
+    assert snapshot_digest(snap["params"]) == ann["digest"]
+
+
+def test_only_latest_generation_is_served():
+    center = _tree()
+    pub = CenterPublisher(lambda: center, publish_every=1)
+    pub.maybe_publish(1)
+    pub.maybe_publish(2)
+    assert pub.snapshot(generation=1) is None  # superseded: gone
+    assert pub.snapshot(generation=2)["generation"] == 2
+    assert pub.snapshot()["generation"] == 2
+
+
+def test_digest_sensitive_to_dtype_shape_and_value():
+    a = _tree()
+    assert snapshot_digest(a) == snapshot_digest(_tree())
+    b = _tree()
+    b["w0"] = b["w0"].astype(np.float16)
+    c = _tree()
+    c["w1"] = c["w1"].reshape(1, 5)
+    d = _tree()
+    d["w1"] = d["w1"] + 1e-3
+    digests = {snapshot_digest(t) for t in (a, b, c, d)}
+    assert len(digests) == 4
+
+
+# ---------------------------------------------------------------------------
+# validate_swap: the GL-W hazard list, applied at subscribe time
+# ---------------------------------------------------------------------------
+
+def test_validate_swap_refuses_every_hazard_shape():
+    cur = _tree()
+    validate_swap(cur, _tree(seed=9))  # same avals, different values: ok
+    bad_dtype = _tree()
+    bad_dtype["w0"] = bad_dtype["w0"].astype(np.float64)
+    with pytest.raises(SwapRefused, match="recompile hazard"):
+        validate_swap(cur, bad_dtype)
+    bad_shape = _tree()
+    bad_shape["w1"] = np.zeros((6,), np.float32)
+    with pytest.raises(SwapRefused, match="recompile hazard"):
+        validate_swap(cur, bad_shape)
+    with pytest.raises(SwapRefused, match="structure"):
+        validate_swap(cur, {"w0": cur["w0"]})
+
+
+# ---------------------------------------------------------------------------
+# subscriber unit behavior (stub replica: no model, no threads)
+# ---------------------------------------------------------------------------
+
+class _StubScheduler:
+    def __init__(self, params):
+        self.params = params
+
+
+class _StubReplica:
+    def __init__(self, params):
+        self.name = "stub0"
+        self.scheduler = _StubScheduler(params)
+        self.serving_generation = 0
+        self.pending_generation = None
+        self.install_calls = []
+
+    def install_params(self, params, generation, rollback=False):
+        self.scheduler.params = params
+        self.serving_generation = int(generation)
+        self.install_calls.append((int(generation), bool(rollback)))
+        return generation
+
+
+def _served_sub(center=None):
+    center = _tree() if center is None else center
+    pub = CenterPublisher(lambda: center, publish_every=1)
+    rep = _StubReplica(jax.tree.map(np.copy, center))
+    sub = WeightSubscriber(rep, lambda g: pub.snapshot(g))
+    return pub, rep, sub
+
+
+def test_subscriber_pulls_only_unseen_generations():
+    pub, rep, sub = _served_sub()
+    assert sub.poll(None) is False
+    ann = pub.maybe_publish(1)
+    assert sub.poll(ann) is True
+    assert rep.serving_generation == 1 and sub.installs == 1
+    # the same announcement re-arrives on every reply: no re-pull
+    assert sub.poll(ann) is False
+    assert sub.installs == 1
+    ann2 = pub.maybe_publish(2)
+    assert sub.poll(ann2) is True
+    assert rep.serving_generation == 2
+
+
+def test_subscriber_refuses_torn_wire_payload():
+    pub, rep, sub = _served_sub()
+    ann = pub.maybe_publish(1)
+    # corrupt the payload in flight: digest no longer matches the
+    # announcement — the pull must refuse BEFORE touching the replica
+    def torn_fetch(g):
+        snap = pub.snapshot(g)
+        snap["params"]["w0"] = snap["params"]["w0"] + 1.0
+        return snap
+
+    sub.fetch = torn_fetch
+    with pytest.raises(SwapRefused, match="torn or corrupted"):
+        sub.poll(ann)
+    assert sub.refusals == 1 and sub.installs == 0
+    assert rep.serving_generation == 0 and rep.install_calls == []
+    # the refused generation is marked seen: the same announcement is
+    # not retried forever, but the NEXT publish is picked up
+    assert sub.poll(ann) is False
+    sub.fetch = lambda g: pub.snapshot(g)
+    assert sub.poll(pub.maybe_publish(2)) is True
+    assert rep.serving_generation == 2
+
+
+def test_subscriber_refuses_dtype_mismatch_before_install():
+    pub, rep, sub = _served_sub()
+    ann = pub.maybe_publish(1)
+    served = jax.tree.map(np.copy, rep.scheduler.params)
+    sub.relayout = lambda p: jax.tree.map(
+        lambda a: a.astype(np.float16), p
+    )
+    with pytest.raises(SwapRefused, match="recompile hazard"):
+        sub.poll(ann)
+    assert sub.refusals == 1 and rep.install_calls == []
+    for k in served:
+        np.testing.assert_array_equal(served[k], rep.scheduler.params[k])
+
+
+def test_exactly_one_rollback_per_flagged_generation(event_tap):
+    pub, rep, sub = _served_sub()
+    gen0_params = jax.tree.map(np.copy, rep.scheduler.params)
+    assert sub.flag_regression(3) is False  # nothing installed yet
+    sub.poll(pub.maybe_publish(1))
+    assert sub.flag_regression(1) is True
+    assert rep.serving_generation == 0
+    for k in gen0_params:
+        np.testing.assert_array_equal(
+            gen0_params[k], rep.scheduler.params[k]
+        )
+    assert rep.install_calls[-1] == (0, True)
+    # re-flagging is idempotent; a stale flag for a generation the
+    # replica no longer serves is a no-op
+    assert sub.flag_regression(1) is False
+    assert sub.flag_regression(99) is False
+    assert sub.rollbacks == 1
+    rolled = [e for e in event_tap if e[0] == "weights_rolled_back"]
+    assert len(rolled) == 1
+    assert rolled[0][1]["generation"] == 1
+    assert rolled[0][1]["restored"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the EASGD server core end: announcements ride existing replies
+# ---------------------------------------------------------------------------
+
+def test_server_core_announces_and_serves_weights():
+    center = _tree()
+    core = EasgdServerCore(
+        jax.tree.map(np.copy, center), alpha=0.5, publish_every=2
+    )
+    worker = _perturb(center)
+    join = core.handler({"kind": "join", "rank": 0})
+    assert "publish" not in join  # nothing published yet
+    r1 = core.handler(
+        {"kind": "exchange", "rank": 0,
+         "params": jax.tree.map(np.copy, worker)}
+    )
+    assert "publish" not in r1  # exchange 1: off-cadence
+    r2 = core.handler(
+        {"kind": "exchange", "rank": 0,
+         "params": jax.tree.map(np.copy, worker)}
+    )
+    ann = r2["publish"]
+    assert ann["generation"] == 1
+    reply = core.handler({"kind": "weights", "generation": 1})
+    assert reply["ok"]
+    assert snapshot_digest(reply["params"]) == ann["digest"]
+    # the published tree is the POST-exchange center, not the seed
+    assert not np.allclose(reply["params"]["w0"], center["w0"])
+    stale = core.handler({"kind": "weights", "generation": 99})
+    assert not stale["ok"]
+
+
+def test_server_core_without_publisher_has_no_publish_surface():
+    core = EasgdServerCore(_tree(), alpha=0.5)  # publish_every=0
+    core.handler({"kind": "join", "rank": 0})
+    r = core.handler(
+        {"kind": "exchange", "rank": 0, "params": _tree(seed=3)}
+    )
+    assert "publish" not in r
+    assert not core.handler({"kind": "weights"})["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the A/B verdict
+# ---------------------------------------------------------------------------
+
+def _rows(n, ttft, tpot, gen):
+    return [
+        {"id": f"r{i}", "ttft_s": ttft, "tpot_s": tpot, "n_out": 8,
+         "generation": gen}
+        for i in range(n)
+    ]
+
+
+def test_compare_cohorts_verdicts():
+    base = _rows(4, ttft=0.10, tpot=0.01, gen=0)
+    assert compare_cohorts(
+        base, _rows(4, ttft=0.11, tpot=0.01, gen=1)
+    )["verdict"] == "pass"
+    bad = compare_cohorts(base, _rows(4, ttft=0.40, tpot=0.05, gen=1))
+    assert bad["verdict"] == "regression"
+    assert any("ttft" in f for f in bad["flags"])
+    assert compare_cohorts(base, [])["verdict"] == "inconclusive"
+    # sub-floor absolute deltas are clock noise, never a verdict
+    tiny = compare_cohorts(
+        _rows(4, ttft=1e-5, tpot=1e-5, gen=0),
+        _rows(4, ttft=9e-5, tpot=9e-5, gen=1),
+    )
+    assert tiny["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# relayout round-trip (real model)
+# ---------------------------------------------------------------------------
+
+def test_relayout_round_trip_value_identical(model):
+    host = jax.tree.map(np.array, jax.device_get(model.params))
+    placed = relayout_for_serving(model, host)
+    for h, p in zip(jax.tree.leaves(host), jax.tree.leaves(placed)):
+        np.testing.assert_array_equal(h, np.asarray(p))
+        assert np.asarray(p).dtype == h.dtype
+    # idempotent: re-laying an already-placed tree changes nothing
+    placed2 = relayout_for_serving(model, placed)
+    for p, q in zip(jax.tree.leaves(placed), jax.tree.leaves(placed2)):
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(q))
+    # and the model itself was never mutated
+    for m, h in zip(jax.tree.leaves(model.params), jax.tree.leaves(host)):
+        np.testing.assert_array_equal(np.asarray(m), h)
+
+
+def test_relayout_refuses_foreign_architecture(model):
+    with pytest.raises(ValueError, match="different params structure"):
+        relayout_for_serving(model, {"not": np.zeros(3, np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# torn installs impossible by position (real replica, manual ticks)
+# ---------------------------------------------------------------------------
+
+def test_install_defers_until_between_ticks_and_never_tears(model):
+    import time
+
+    host0 = jax.tree.map(np.array, jax.device_get(model.params))
+    placed0 = relayout_for_serving(model, host0)
+    placed1 = relayout_for_serving(model, _perturb(host0))
+
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(0, CFG["vocab_size"], size=6).tolist()
+        for _ in range(2)
+    ]
+
+    ref_sched = ContinuousBatchingScheduler(
+        PagedServingEngine(model, **GEOM), params=placed0
+    )
+    for j, p in enumerate(prompts):
+        ref_sched.submit(
+            Request(id=f"q{j}", prompt=list(p), max_new_tokens=12)
+        )
+    ref = ref_sched.run()
+
+    # the replica is NOT started: no tick thread, so the deferral is
+    # deterministic — we drive every tick by hand
+    rep = ServeReplica("t0", PagedServingEngine(model, **GEOM),
+                       params=placed0)
+    try:
+        for j, p in enumerate(prompts):
+            ok = rep.handle(("submit", {"id": f"q{j}", "prompt": list(p),
+                                        "max_new_tokens": 12}))
+            assert ok["ok"]
+        # install arrives mid-cohort: the scheduler has queued work, so
+        # the swap MUST defer to the between-ticks gap
+        rep.install_params(placed1, 1)
+        assert rep.pending_generation == 1
+        assert rep.serving_generation == 0
+        while not rep.scheduler.idle:
+            with rep._lock:
+                rep.scheduler.step()
+        # every tick of the in-flight cohort ran against generation 0:
+        # token-identical to the uninterrupted gen-0 reference
+        poll = rep.handle(("poll", {f"q{j}": 0 for j in range(2)}))
+        for j in range(2):
+            assert poll["streams"][f"q{j}"]["done"]
+            assert poll["streams"][f"q{j}"]["toks"] == list(ref[f"q{j}"])
+        assert rep.serving_generation == 0  # marker untouched mid-cohort
+        # a stale/duplicate generation is refused loudly, rollback excepted
+        with pytest.raises(ValueError, match="refused"):
+            rep.install_params(placed1, 0)
+        # the tick loop's idle gap applies the deferred install
+        rep.start()
+        deadline = time.monotonic() + 60
+        while rep.serving_generation != 1:
+            assert time.monotonic() < deadline, "install never applied"
+            time.sleep(0.005)
+        assert rep.installs == 1
+        for a, b in zip(
+            jax.tree.leaves(rep.scheduler.params),
+            jax.tree.leaves(placed1),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        rep.stop()
+
+
+def test_subscriber_installs_published_center_into_idle_replica(
+    model, event_tap
+):
+    host0 = jax.tree.map(np.array, jax.device_get(model.params))
+    core = EasgdServerCore(
+        jax.tree.map(np.copy, host0), alpha=0.5, publish_every=1
+    )
+    core.handler({"kind": "join", "rank": 0})
+    reply = core.handler(
+        {"kind": "exchange", "rank": 0, "params": _perturb(host0)}
+    )
+    ann = reply["publish"]
+
+    rep = ServeReplica("s0", PagedServingEngine(model, **GEOM),
+                       params=relayout_for_serving(model, host0)).start()
+    sub = WeightSubscriber(
+        rep,
+        lambda g: core.handler({"kind": "weights", "generation": g}),
+        relayout=lambda p: relayout_for_serving(model, p),
+    )
+    try:
+        assert sub.poll(ann) is True
+        # idle replica: the install applies inside install_params
+        assert rep.serving_generation == 1
+        assert rep.installs == 1 and sub.installs == 1
+        for a, b in zip(
+            jax.tree.leaves(rep.scheduler.params),
+            jax.tree.leaves(core.center),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        rep.stop()
+    kinds = [k for k, _ in event_tap]
+    assert kinds.count("weights_published") == 1
+    assert kinds.count("weights_installed") == 1
+
+
+# ---------------------------------------------------------------------------
+# the committed acceptance drill
+# ---------------------------------------------------------------------------
+
+def test_committed_publish_chaos_drill():
+    """The acceptance drill (ISSUE 18), tier-1: publish mid-decode →
+    in-flight cohort token-identical to gen 0 → A/B cohorts pinned per
+    generation each match their reference → planted SLO regression →
+    exactly one rollback and one weights_rolled_back alert →
+    post-rollback cohort matches gen 0 → bad-shape snapshot refused →
+    zero recompiles across the whole episode.  The same verdict gates
+    perf_gate's PUBLISH leg."""
+    from theanompi_tpu.runtime import chaos
+
+    verdict = chaos.run_publish_drill()
+    assert verdict["ok"], verdict["violations"]
+    assert verdict["n_publishes"] >= 1
+    assert verdict["n_installs"] == verdict["n_publishes"]
+    assert verdict["token_identical_gen0"] is True
+    assert verdict["ab_cohort_identical"] is True
+    assert verdict["ab_verdict_planted"] == "regression"
+    assert verdict["rollbacks"] == 1
+    assert verdict["post_rollback_identical"] is True
+    assert verdict["refused_bad_dtype"] is True
+    assert verdict["weights_rolled_back_alerts"] == 1
+    assert verdict["extra_recompiles"] == 0

@@ -328,14 +328,55 @@ def test_search_fleet_plan_judges_scaling_signals(tmp_path):
     assert any("requests_lost" in f for f in flags)
 
 
-def test_search_skips_inert_knobs_honestly(tmp_path):
-    """EASGD τ does not touch the committed BSP bench's measured
-    workload — 'tuning' it would measure noise, so the driver must
-    refuse and say so."""
-    report, _ = _sweep(tmp_path, "tr", plan="train")
+def test_search_skips_inert_knobs_honestly(tmp_path, monkeypatch):
+    """A knob declared inert_on_bench must be refused from the sweep
+    with a paper trail.  The committed registry no longer ships one
+    (easgd_tau graduated to its own plan + bench arm), so the honesty
+    machinery is pinned with a synthetic inert declaration — reusing
+    the easgd_tau name keeps the fixture bench's landscape valid."""
+    inert = Knob(
+        name="easgd_tau", kind="int", ladder=(2, 5, 10, 20, 40),
+        default=10, plan="train", bench="train",
+        description="synthetic inert knob for the skip contract",
+        inert_on_bench=True,
+    )
+    registry = tuple(
+        k for k in knobs_mod.REGISTRY if k.name != "easgd_tau"
+    ) + (inert,)
+    monkeypatch.setattr(knobs_mod, "REGISTRY", registry)
+    monkeypatch.setitem(knobs_mod._BY_NAME, "easgd_tau", inert)
+    report, _ = _sweep(tmp_path, "tr", plan="train", commit=False)
     assert report["skipped_inert"] == ["easgd_tau"]
     assert "easgd_tau" not in report["changed"]
     assert all(d["knob"] != "easgd_tau" for d in report["decisions"])
+
+
+def test_search_easgd_plan_adopts_planted_tau(tmp_path):
+    """The easgd plan sweeps τ for real now (no inert skip): better
+    mode converges to the planted τ=20 and commits it to the plan's
+    own TUNED entry."""
+    report, presets = _sweep(tmp_path, "eb", plan="easgd")
+    assert report["ok"] and report["committed"]
+    assert report["skipped_inert"] == []
+    assert report["changed"] == {"easgd_tau": 20}
+    assert presets_io.read_tuned(presets)["easgd"] == {"easgd_tau": 20}
+
+
+def test_search_easgd_plan_refuses_planted_regression(tmp_path):
+    """Regression mode: every τ move wins the headline but plants a
+    timeline alert — the history diff must refuse adoption."""
+    report, presets = _sweep(tmp_path, "er", plan="easgd",
+                             mode="regression")
+    assert report["ok"]
+    assert report["changed"] == {} and report["committed"] is False
+    assert presets_io.read_tuned(presets)["easgd"] == {"easgd_tau": 10}
+    flags = [
+        f
+        for d in report["decisions"]
+        for s in d["shorts"]
+        for f in s["verdict"]["flags"]
+    ]
+    assert any("history diff" in f for f in flags)
 
 
 def test_history_diff_gates_planted_timeline_alert(tmp_path):

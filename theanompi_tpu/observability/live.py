@@ -787,6 +787,9 @@ class Aggregator:
         # replica_evicted; same bookkeeping for fleet re-admissions
         self._evictions_alerted: Dict[str, float] = {}
         self._readmissions_alerted: Dict[str, float] = {}
+        # online learning loop: weight rollbacks already alerted on
+        # (publish_rollbacks_total deltas -> weights_rolled_back)
+        self._rollbacks_alerted: Dict[str, float] = {}
         self.verdict_log = (
             VerdictLog(persist_path, max_bytes=persist_max_bytes)
             if persist_path else None
@@ -1093,6 +1096,26 @@ class Aggregator:
                     "window": verdict.get("window"),
                     "t_wall": verdict.get("t_wall"),
                 }))
+        # live-publication rollbacks page (weights_rolled_back): a new
+        # model generation regressed its A/B cohort and a replica
+        # re-installed the prior snapshot — exactly one alert per
+        # rollback, same unseen-increment discipline as evictions
+        for replica, n_new in self._new_rollbacks():
+            for _ in range(n_new):
+                verdict["alerts"].append(self.watchdog.raise_alert({
+                    "rule": "weights_rolled_back",
+                    "rank": replica,
+                    "value": None,
+                    "threshold": None,
+                    "message": (
+                        f"replica {replica} rolled back a regressed "
+                        "published weight generation to its prior "
+                        "snapshot — the new center is flagged, "
+                        "investigate before re-publishing"
+                    ),
+                    "window": verdict.get("window"),
+                    "t_wall": verdict.get("t_wall"),
+                }))
         # standby promotion clock: a window close with no primary
         # heartbeat since the last close is one miss; promote_after
         # consecutive misses means the primary is gone — announce ONE
@@ -1205,6 +1228,30 @@ class Aggregator:
                 if n_new <= 0:
                     continue
                 self._readmissions_alerted[k] = val
+                replica = re.search(r'replica="([^"]*)"', k)
+                out.append((replica.group(1) if replica else "?", n_new))
+        return out
+
+    def _new_rollbacks(self):
+        """Weight rollbacks not yet alerted on: ``(replica, n_new)``
+        rows from the ``publish_rollbacks_total`` counter deltas (same
+        unseen-increment discipline as ``_new_evictions``)."""
+        import re
+
+        totals: Dict[str, float] = {}
+        with self._lock:
+            for rv in self.view.values():
+                for k, val in rv.counters.items():
+                    if k.startswith("publish_rollbacks_total"):
+                        totals[k] = totals.get(k, 0.0) + float(val)
+            out = []
+            for k, val in sorted(totals.items()):
+                n_new = int(round(
+                    val - self._rollbacks_alerted.get(k, 0.0)
+                ))
+                if n_new <= 0:
+                    continue
+                self._rollbacks_alerted[k] = val
                 replica = re.search(r'replica="([^"]*)"', k)
                 out.append((replica.group(1) if replica else "?", n_new))
         return out

@@ -285,6 +285,15 @@ class EasgdServerCore:
     - ``sweep`` evicts members silent past ``evict_after_s`` — called
       from the duties loop's wait so a dead worker can never wedge an
       epoch boundary.
+    - ``weights`` (with ``publish_every > 0``) serves the latest
+      published center snapshot to serving-tier subscribers — the
+      online learning loop's pull RPC (``theanompi_tpu.publish``).
+      Publication fires every ``publish_every`` exchanges; the
+      ``(generation, digest)`` announcement piggybacks on join and
+      exchange replies under the ``"publish"`` key.  Snapshot payloads
+      always ride the wire fp32, never ``wire_dtype``-compressed: the
+      subscriber verifies the digest byte-for-byte before install, and
+      a lossy wire would turn every pull into a refusal.
 
     With ``wire_dtype='q8'`` the reply leg is EF-compensated PER WORKER
     (residual in the member's roster state — the server-side state PR 6
@@ -302,6 +311,7 @@ class EasgdServerCore:
         adaptive_tau: bool = False,
         on_event=None,
         clock=time.monotonic,
+        publish_every: int = 0,
     ):
         self.alpha = float(alpha)
         self.wire_dtype = wire_dtype
@@ -325,6 +335,16 @@ class EasgdServerCore:
             ms.TauController(base_tau, self.roster)
             if (adaptive_tau and base_tau) else None
         )
+        if int(publish_every) > 0:
+            from theanompi_tpu.publish.publisher import CenterPublisher
+
+            # the center attr is re-BOUND every exchange, so the
+            # publisher must read through the getter, not capture a tree
+            self.publisher = CenterPublisher(
+                lambda: self.center, publish_every
+            )
+        else:
+            self.publisher = None
 
     def _membership_event(self, kind, member, generation) -> None:
         print(
@@ -357,6 +377,15 @@ class EasgdServerCore:
     def _tau_hint(self, reply: dict, rank) -> dict:
         if self.tau_ctrl is not None and rank is not None:
             reply["tau"] = self.tau_ctrl.tau_for(rank)
+        return self._announce(reply)
+
+    def _announce(self, reply: dict) -> dict:
+        """Piggyback the latest publish announcement — generation +
+        digest, a few dozen bytes — on a reply already going out."""
+        if self.publisher is not None:
+            ann = self.publisher.announcement()
+            if ann is not None:
+                reply["publish"] = ann
         return reply
 
     # ---- the served protocol -----------------------------------------
@@ -415,6 +444,10 @@ class EasgdServerCore:
                     lambda b, d: b + self.alpha * d, c, diff
                 )
                 self.n_exchanges += 1
+                if self.publisher is not None:
+                    # cadence hook: every publish_every-th exchange
+                    # snapshots the center just updated above
+                    self.publisher.maybe_publish(self.n_exchanges)
                 out = jax.tree.map(lambda a, d: a - self.alpha * d, w, diff)
                 if self.wire_dtype:
                     st = (
@@ -452,6 +485,23 @@ class EasgdServerCore:
                     self.roster.leave(rank)
                 self.cv.notify_all()
                 return {"ok": True}
+            if kind == "weights":
+                # online learning loop: a serving-tier subscriber pulls
+                # the published center snapshot (fp32, never
+                # wire-compressed — the digest must verify byte-exact)
+                snap = (
+                    self.publisher.snapshot(msg.get("generation"))
+                    if self.publisher is not None
+                    else None
+                )
+                if snap is None:
+                    return {
+                        "ok": False,
+                        "error": "no published snapshot for the "
+                                 "requested generation",
+                    }
+                snap["ok"] = True
+                return snap
         raise ValueError(f"unknown request kind {kind!r}")
 
 
@@ -480,6 +530,9 @@ def run_easgd_server(
     # hints in every exchange/join reply (membership.TauController)
     tau: Optional[int] = None,  # the workers' base tau (adaptive mode
     # needs it to scale from; ignored otherwise)
+    publish_every: int = 0,  # online learning loop: snapshot + announce
+    # the center every N exchanges for serving-tier subscribers
+    # (theanompi_tpu.publish); 0 disables publication entirely
 ):
     """Rank 0: the reference ``EASGD_Server.run()`` loop, TCP-served.
 
@@ -536,6 +589,7 @@ def run_easgd_server(
         evict_after_s=evict_after_s,
         base_tau=tau,
         adaptive_tau=adaptive_tau,
+        publish_every=publish_every,
         on_event=lambda kind, member, gen: rec.log_event(
             "membership", plane="easgd", event=kind, rank=member,
             generation=gen,

@@ -88,6 +88,9 @@ PRESETS: Dict[str, Dict[str, Any]] = {
 # verdict gate (bench_compare + doctor flags + history diff) passes.
 # --- BEGIN TUNED PRESETS (maintained by `python -m theanompi_tpu.tuning`) ---
 TUNED: Dict[str, Dict[str, Any]] = {
+    'easgd': {
+        'easgd_tau': 10,
+    },
     'fleet': {
         'fleet_replicas': 3,
     },
@@ -97,7 +100,6 @@ TUNED: Dict[str, Dict[str, Any]] = {
         'spec_k': 8,
     },
     'train': {
-        'easgd_tau': 10,
         'exchange_bucket_mb': 4.0,
         'trace_sample': 1,
     },

@@ -1,0 +1,50 @@
+"""theanompi_tpu.publish — live weight publication, center → replicas.
+
+The online learning loop (ROADMAP tentpole): the EASGD server is
+already a continuously-updated parameter store behind a request/reply
+protocol, and the serving tier already re-lays training checkpoints
+into inference sharding — this package connects them WITHOUT the disk
+hop.  Three pieces:
+
+- :class:`publisher.CenterPublisher` — server side.  Snapshots the
+  center every N exchanges, announces ``(generation, digest)`` on the
+  existing exchange/join replies, and serves the snapshot itself via a
+  new ``{"kind": "weights"}`` RPC on the same transport.
+- :class:`subscriber.WeightSubscriber` — replica side.  Pulls the
+  snapshot OFF the scheduler thread, re-lays it train→serve
+  (``serving/loader.relayout_for_serving``), dtype/shape-validates it
+  against the currently-served tree (the GL-W recompile hazard list,
+  refused loudly via :class:`subscriber.SwapRefused`), and hands it to
+  ``ServeReplica.install_params`` which installs it BETWEEN ticks
+  under a generation number — no torn updates, in-flight streams
+  finish on the generation they started on, and the swap is
+  zero-recompile because params are data to the jitted step.
+- :mod:`ab` — the A/B verdict.  Per-replica version pinning
+  (``FleetRouter.submit(..., generation=...)``) plus the
+  ``model_generation`` label on serving metrics make cohort timelines
+  separable; ``ab.compare_cohorts`` is the same diff ``observability
+  history diff`` runs, applied to two generations' request rows.  A
+  regression flags the new generation and the subscriber rolls back to
+  the prior snapshot (kept by reference until superseded).
+
+See docs/online_learning.md for topology and honest limits.
+"""
+
+from theanompi_tpu.publish.publisher import CenterPublisher, snapshot_digest
+from theanompi_tpu.publish.subscriber import (
+    SwapRefused,
+    WeightSubscriber,
+    remote_fetch,
+    validate_swap,
+)
+from theanompi_tpu.publish.ab import compare_cohorts
+
+__all__ = [
+    "CenterPublisher",
+    "SwapRefused",
+    "WeightSubscriber",
+    "compare_cohorts",
+    "remote_fetch",
+    "snapshot_digest",
+    "validate_swap",
+]

@@ -1,0 +1,98 @@
+"""A/B verdict between two serving generations' request cohorts.
+
+Per-replica version pinning makes A/B serving free: admission pins a
+request cohort to a generation (``FleetRouter.submit(...,
+generation=...)``), the ``model_generation`` label keeps the cohorts
+separable in ``/metrics``, and the per-request rows
+(``ServingMetrics.cohort_rows``) carry exact TTFT/TPOT per cohort.
+``compare_cohorts`` applies the same shape of judgment ``observability
+history diff`` renders between two runs' timelines — latency deltas
+against a relative tolerance — to two generations inside ONE run.
+
+Honest limits (also in docs/online_learning.md): the verdict is a
+latency/throughput diff, not a quality eval — a new generation that
+serves faster garbage passes it.  Token-level quality gating needs a
+reference-output check upstream of the flag, which is exactly what the
+PUBLISH chaos drill does with its pinned token-identity legs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from theanompi_tpu.observability.metrics import percentile
+
+
+def _cohort_stats(rows: Sequence[dict]) -> dict:
+    ttfts = [r["ttft_s"] for r in rows]
+    tpots = [r["tpot_s"] for r in rows if r.get("n_out", 0) > 1]
+    return {
+        "n_requests": len(rows),
+        "ttft_p50_s": percentile(ttfts, 50) if ttfts else 0.0,
+        "tpot_p50_s": percentile(tpots, 50) if tpots else 0.0,
+    }
+
+
+def compare_cohorts(
+    baseline_rows: Sequence[dict],
+    candidate_rows: Sequence[dict],
+    max_regression: float = 0.25,
+    min_requests: int = 1,
+    absolute_floor_s: float = 1e-4,
+) -> dict:
+    """Judge the candidate cohort against the baseline cohort.
+
+    Regression = candidate p50 worse than baseline p50 by more than
+    ``max_regression`` (relative) AND by more than ``absolute_floor_s``
+    (sub-100µs deltas are clock noise on any rig, never a verdict).
+    With fewer than ``min_requests`` rows on either side the verdict is
+    ``inconclusive`` — an empty cohort must not pass OR fail.
+
+    Returns ``{"verdict": "pass"|"regression"|"inconclusive",
+    "flags": [...], "baseline": {...}, "candidate": {...}}`` — the
+    flags list uses the same spelling discipline as the tuning judge
+    (a named metric per flag) so drill output reads like a verdict.
+    """
+    base = _cohort_stats(baseline_rows)
+    cand = _cohort_stats(candidate_rows)
+    out = {"baseline": base, "candidate": cand, "flags": []}
+    if (
+        base["n_requests"] < min_requests
+        or cand["n_requests"] < min_requests
+    ):
+        out["verdict"] = "inconclusive"
+        out["flags"].append(
+            f"cohort_too_small: baseline={base['n_requests']} "
+            f"candidate={cand['n_requests']} (need {min_requests})"
+        )
+        return out
+    for metric in ("ttft_p50_s", "tpot_p50_s"):
+        b, c = base[metric], cand[metric]
+        delta = c - b
+        if delta > absolute_floor_s and delta > max_regression * max(
+            b, absolute_floor_s
+        ):
+            out["flags"].append(
+                f"{metric}_regressed: {b:.6f} -> {c:.6f} "
+                f"(+{delta / max(b, absolute_floor_s):.0%} > "
+                f"{max_regression:.0%})"
+            )
+    out["verdict"] = "regression" if out["flags"] else "pass"
+    return out
+
+
+def judge_generations(
+    metrics,
+    baseline_generation: int,
+    candidate_generation: int,
+    max_regression: float = 0.25,
+    min_requests: int = 1,
+) -> dict:
+    """Convenience wrapper over one ``ServingMetrics`` instance: pull
+    both cohorts' rows by the ``generation`` field and compare."""
+    return compare_cohorts(
+        metrics.cohort_rows(baseline_generation),
+        metrics.cohort_rows(candidate_generation),
+        max_regression=max_regression,
+        min_requests=min_requests,
+    )

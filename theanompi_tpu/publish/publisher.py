@@ -1,0 +1,138 @@
+"""Server-side half of the online learning loop.
+
+``CenterPublisher`` rides inside ``EasgdServerCore.handler`` — the
+caller already serializes every mutation under the server's condition
+variable, so the publisher itself is deliberately LOCK-FREE (it owns no
+lock, keeping it out of the GL-T threadstate pass's scope by
+construction rather than by annotation).  Cadence is ``publish_every``
+exchanges: the same knob family as τ, and it rides the EASGD bench arm
+so tuning it measures a real workload.
+
+The announcement is tiny — ``(generation, digest)`` — and piggybacks on
+replies the transport already sends; the params themselves move only
+when a subscriber asks (``{"kind": "weights"}`` RPC), so a fleet of N
+replicas costs N pulls per publish, not N pushes per exchange.
+
+Digest discipline: the digest is computed over the SNAPSHOT COPY (not
+the live center a concurrent exchange may be re-binding), and the
+generation counter is assigned LAST — a reader that sees generation G
+is guaranteed the snapshot/digest for G are already in place (the same
+marker-last ordering GL-W003 enforces on the install side).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from theanompi_tpu import observability as obs
+
+_REG = obs.get_registry()
+_PUBLISHED = _REG.counter(
+    "publish_published_total",
+    "center snapshots published by the EASGD server",
+)
+_CENTER_GEN = _REG.gauge(
+    "publish_center_generation",
+    "latest published center generation",
+)
+
+
+def snapshot_digest(tree: Any) -> str:
+    """Content digest of a params pytree: structure + per-leaf
+    dtype/shape/bytes, SHA-256.  Pure read — no leaf is cast, reshaped,
+    or re-laid (``ascontiguousarray`` copies only when a leaf is a
+    non-contiguous view, and the copy is local to the hash)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    h = hashlib.sha256()
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+class CenterPublisher:
+    """Snapshot the center every ``publish_every`` exchanges.
+
+    ``get_center`` is a zero-arg callable returning the live center
+    tree (host numpy on the EASGD server); the publisher deep-copies it
+    at publish time so later exchanges never mutate a published
+    snapshot.  ``publish_every <= 0`` disables publication entirely —
+    the server-side hook is a no-op and ``announcement()`` stays None.
+    """
+
+    def __init__(
+        self,
+        get_center: Callable[[], Any],
+        publish_every: int,
+    ):
+        self.get_center = get_center
+        self.publish_every = int(publish_every)
+        self.generation = 0
+        self.digest: Optional[str] = None
+        self.n_published = 0
+        self._snapshot: Any = None
+
+    # ---- server hook (called with the server's cv held) --------------
+    def maybe_publish(self, n_exchanges: int) -> Optional[dict]:
+        """Publish iff ``n_exchanges`` lands on the cadence boundary.
+        Returns the announcement when a publish fired, else None."""
+        if self.publish_every <= 0 or n_exchanges <= 0:
+            return None
+        if n_exchanges % self.publish_every:
+            return None
+        return self.publish()
+
+    def publish(self) -> dict:
+        """Snapshot the center now, unconditionally."""
+        import jax
+
+        params = jax.tree.map(np.copy, self.get_center())
+        digest = snapshot_digest(params)
+        gen = self.generation + 1
+        self._snapshot = params
+        self.digest = digest
+        self.n_published += 1
+        _PUBLISHED.inc()
+        _CENTER_GEN.set(float(gen))
+        obs.publish_event(
+            "weights_published",
+            {"generation": gen, "digest": digest[:12]},
+        )
+        # marker LAST: a concurrent announcement() reader that sees the
+        # new generation is guaranteed snapshot + digest are in place
+        self.generation = gen
+        return {"generation": gen, "digest": digest}
+
+    # ---- what rides the wire -----------------------------------------
+    def announcement(self) -> Optional[dict]:
+        """``{"generation", "digest"}`` of the latest publish, or None
+        before the first.  Cheap enough to attach to every reply."""
+        if self.generation <= 0:
+            return None
+        return {"generation": self.generation, "digest": self.digest}
+
+    def snapshot(self, generation: Optional[int] = None) -> Optional[dict]:
+        """The published snapshot for ``generation`` (default: latest),
+        params deep-copied so the caller owns its tree.  None when
+        nothing is published yet or the asked-for generation is no
+        longer the one held (only the latest is kept server-side — the
+        ROLLBACK copy lives with the subscriber, not here)."""
+        import jax
+
+        if self._snapshot is None:
+            return None
+        if generation is not None and int(generation) != self.generation:
+            return None
+        return {
+            "generation": self.generation,
+            "digest": self.digest,
+            "params": jax.tree.map(np.copy, self._snapshot),
+        }

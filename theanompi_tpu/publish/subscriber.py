@@ -1,0 +1,223 @@
+"""Replica-side half of the online learning loop.
+
+``WeightSubscriber`` runs on a replica's CONTROL thread (whatever
+drives ``poll``/``pull`` — a drill loop, a supervisor, a fleet pump),
+never on the scheduler thread: the fetch is a blocking RPC and must not
+stall decode ticks.  It is single-threaded by contract and therefore
+lock-free — the handoff into the serving path goes through
+``ServeReplica.install_params``, which owns the replica lock and
+applies the swap BETWEEN ticks.  Keeping the subscriber lock-free also
+keeps it out of the GL-T threadstate pass's scope; keeping the fetch
+outside any lock keeps it out of GL-P002's.
+
+Validation BEFORE install (the GL-W hazard list, applied at subscribe
+time): the incoming tree must match the served tree's structure and
+every leaf's dtype AND shape exactly.  A mismatch is the recompile
+hazard — ``jax.jit`` would silently retrace on the new avals, blowing
+the zero-recompile guarantee — so it is refused loudly
+(:class:`SwapRefused`) and the served params are untouched.  The
+subscriber never casts or reshapes to "make it fit"; that coercion is
+exactly what GL-W001 exists to flag.
+
+Rollback: the previously-served tree is kept BY REFERENCE (the install
+is a whole-tree rebind, so the old tree stays alive exactly as long as
+this subscriber holds it — plain refcounting, no copy).
+``flag_regression`` re-installs it at most once per flagged generation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.publish.publisher import snapshot_digest
+
+_REG = obs.get_registry()
+_INSTALLS = _REG.counter(
+    "publish_installs_total",
+    "weight snapshots installed into serving replicas",
+)
+_REFUSALS = _REG.counter(
+    "publish_refusals_total",
+    "weight snapshots refused before install (digest/dtype/shape)",
+)
+_ROLLBACKS = _REG.counter(
+    "publish_rollbacks_total",
+    "regression-flagged generations rolled back to the prior snapshot",
+)
+
+
+class SwapRefused(RuntimeError):
+    """An incoming snapshot failed pre-install validation.
+
+    Raised BEFORE the served tree is touched: digest mismatch (torn or
+    corrupted wire payload) or a structure/dtype/shape mismatch (the
+    GL-W recompile hazard — installing it would retrace the jitted
+    step).  The replica keeps serving its current generation."""
+
+
+def validate_swap(current: Any, incoming: Any) -> None:
+    """Refuse any incoming tree whose structure or leaf avals differ
+    from the currently-served tree.  Never casts, never reshapes —
+    equality or refusal, nothing in between."""
+    import jax
+    import numpy as np
+
+    cur_def = jax.tree.structure(current)
+    inc_def = jax.tree.structure(incoming)
+    if cur_def != inc_def:
+        raise SwapRefused(
+            "params structure mismatch: incoming snapshot was trained "
+            "with a different architecture config than this replica "
+            f"serves (served {cur_def}, incoming {inc_def})"
+        )
+    for i, (c, w) in enumerate(
+        zip(jax.tree.leaves(current), jax.tree.leaves(incoming))
+    ):
+        cd, wd = np.asarray(c).dtype, np.asarray(w).dtype
+        cs, ws = tuple(np.shape(c)), tuple(np.shape(w))
+        if cd != wd or cs != ws:
+            raise SwapRefused(
+                f"leaf {i}: served {cd}{cs} vs incoming {wd}{ws} — "
+                "installing this would retrace the jitted step (the "
+                "GL-W recompile hazard); refused, replica keeps its "
+                "current generation"
+            )
+
+
+def remote_fetch(address, timeout_s: float = 30.0) -> Callable[[int], Optional[dict]]:
+    """Fetch closure over the EASGD server's ``{"kind": "weights"}``
+    RPC, for subscribers whose publisher is across the transport.  The
+    request carries an explicit timeout (GL-P001: no unbounded RPC in a
+    subscriber's poll loop)."""
+    def fetch(generation: int) -> Optional[dict]:
+        from theanompi_tpu.parallel.transport import request
+
+        reply = request(
+            address,
+            {"kind": "weights", "generation": int(generation)},
+            timeout=float(timeout_s),
+        )
+        if not reply.get("ok"):
+            return None
+        return reply
+    return fetch
+
+
+class WeightSubscriber:
+    """Pull published snapshots into one ``ServeReplica``.
+
+    ``fetch(generation)`` returns ``{"generation", "digest", "params"}``
+    or None (publisher has nothing / no longer holds that generation).
+    ``relayout`` (optional) is the train→serve re-lay step, e.g.
+    ``loader.relayout_for_serving`` partially applied over the
+    replica's model — it runs on THIS thread, off the scheduler.
+    """
+
+    def __init__(
+        self,
+        replica,
+        fetch: Callable[[int], Optional[dict]],
+        relayout: Optional[Callable[[Any], Any]] = None,
+    ):
+        self.replica = replica
+        self.fetch = fetch
+        self.relayout = relayout
+        self.seen_generation = 0
+        self.installs = 0
+        self.refusals = 0
+        self.rollbacks = 0
+        # rollback state is deliberately SCALAR attrs, not per-member
+        # dicts: there is exactly one prior snapshot per subscriber
+        # (GL-P003's hazard shape — gen-gated dicts mutated ungated —
+        # cannot occur on a scalar)
+        self._prior_params: Any = None
+        self._prior_generation = 0
+        self._flagged: set = set()
+
+    # ---- the pull path -----------------------------------------------
+    def poll(self, announcement: Optional[dict]) -> bool:
+        """React to a piggybacked announcement: pull iff it names a
+        generation newer than everything seen (installed OR refused —
+        a refused generation is not retried; the next publish is)."""
+        if not announcement:
+            return False
+        gen = int(announcement.get("generation") or 0)
+        if gen <= self.seen_generation:
+            return False
+        return self.pull(gen, expect_digest=announcement.get("digest"))
+
+    def pull(self, generation: int, expect_digest: Optional[str] = None) -> bool:
+        """Fetch + validate + hand to the replica for a between-ticks
+        install.  Returns True iff the snapshot was accepted (the
+        install itself may still be deferred until the replica is
+        between ticks).  Raises :class:`SwapRefused` on validation
+        failure — loudly, per the issue's contract."""
+        generation = int(generation)
+        snap = self.fetch(generation)  # blocking RPC: NEVER under a lock
+        if snap is None:
+            return False
+        params = snap["params"]
+        try:
+            digest = snapshot_digest(params)
+            want = expect_digest or snap.get("digest")
+            if want and digest != want:
+                raise SwapRefused(
+                    f"generation {generation}: wire digest {digest[:12]} "
+                    f"!= announced {str(want)[:12]} — torn or corrupted "
+                    "payload, refused"
+                )
+            if self.relayout is not None:
+                params = self.relayout(params)
+            validate_swap(self.replica.scheduler.params, params)
+        except SwapRefused:
+            self.refusals += 1
+            _REFUSALS.inc(replica=self.replica.name)
+            # a refused generation must not be re-pulled forever off
+            # the same announcement; mark it seen, wait for the next
+            self.seen_generation = generation
+            raise
+        prior = self.replica.scheduler.params
+        prior_gen = self.replica.serving_generation
+        self.replica.install_params(params, generation)
+        self.installs += 1
+        _INSTALLS.inc(replica=self.replica.name)
+        self._prior_params = prior
+        self._prior_generation = prior_gen
+        self.seen_generation = generation
+        return True
+
+    # ---- the rollback path -------------------------------------------
+    def flag_regression(self, generation: int) -> bool:
+        """A/B verdict said ``generation`` regressed: roll this replica
+        back to the prior snapshot.  At most ONE rollback per flagged
+        generation (re-flagging is idempotent), and only when that
+        generation is actually what the replica is serving/pending —
+        a stale flag for an already-superseded generation is a no-op.
+        Returns True iff a rollback happened."""
+        generation = int(generation)
+        if generation in self._flagged:
+            return False
+        self._flagged.add(generation)
+        if self._prior_params is None:
+            return False
+        current = self.replica.serving_generation
+        pending = getattr(self.replica, "pending_generation", None)
+        if generation != current and generation != pending:
+            return False
+        self.replica.install_params(
+            self._prior_params, self._prior_generation, rollback=True
+        )
+        self.rollbacks += 1
+        _ROLLBACKS.inc(
+            replica=self.replica.name, generation=str(generation)
+        )
+        obs.publish_event(
+            "weights_rolled_back",
+            {
+                "replica": self.replica.name,
+                "generation": generation,
+                "restored": self._prior_generation,
+            },
+        )
+        return True

@@ -499,6 +499,361 @@ def run_serve_drill(
     return verdict
 
 
+def run_publish_drill(
+    n_requests: int = 6,
+    max_new_tokens: int = 16,
+    publish_every: int = 3,
+    alpha: float = 0.5,
+    timeout: float = 300.0,
+    seed: int = 0,
+    config_overrides: Optional[dict] = None,
+) -> dict:
+    """The online-learning-loop drill (``--rule PUBLISH``); returns the
+    verdict dict.
+
+    Protocol: a 2-replica fleet serves generation 0 while an in-process
+    ``EasgdServerCore`` absorbs exchanges until its ``CenterPublisher``
+    fires generation 1 MID-DECODE.  The subscriber on the canary
+    replica pulls/validates immediately, but the install must defer to
+    the between-ticks gap — cohort A (pinned gen 0, in flight at the
+    publish) must finish token-identical to a single-scheduler gen-0
+    reference.  Then cohort B pins gen 1 on the canary and a control
+    cohort pins gen 0 on the baseline replica (A/B serving): each must
+    be token-identical to its generation's reference.  A PLANTED SLO
+    regression on the gen-1 cohort must flip the A/B verdict, trigger
+    exactly ONE rollback (re-flagging is a no-op) and exactly one
+    ``weights_rolled_back`` live-plane alert, and a post-rollback
+    cohort must again match the gen-0 reference.  A bad-shape snapshot
+    must be REFUSED before install (the GL-W recompile hazard), and
+    the whole episode — warm → install → rollback, >= 2 generations —
+    must be zero-recompile (prefill/decode trace counters pinned).
+    """
+    import time
+
+    import numpy as np
+
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.observability import live as obs_live
+    from theanompi_tpu.observability.metrics import (
+        counter_deltas,
+        flatten_counters,
+        get_registry,
+    )
+    from theanompi_tpu.parallel.distributed_async import EasgdServerCore
+    from theanompi_tpu.publish import WeightSubscriber, SwapRefused, ab
+    from theanompi_tpu.runtime.mesh import make_mesh
+    from theanompi_tpu.serving import PagedServingEngine, Request
+    from theanompi_tpu.serving.fleet import FleetRouter, ServeReplica
+    from theanompi_tpu.serving.loader import relayout_for_serving
+    from theanompi_tpu.serving.metrics import ServingMetrics
+    from theanompi_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+    import jax
+
+    cfg = dict(SERVE_CONFIG)
+    cfg.update(config_overrides or {})
+    mesh = make_mesh(devices=jax.devices()[:1])
+    model = TransformerLM(config=cfg, mesh=mesh)
+    geom = dict(n_slots=2, max_len=cfg["seq_len"], buckets=(8, 16, 64),
+                block_size=8)
+
+    verdict: dict = {
+        "rule": "PUBLISH",
+        "n_requests": n_requests,
+        "publish_every": publish_every,
+        "violations": [],
+    }
+    v = verdict["violations"]
+    base_counters = flatten_counters(get_registry().snapshot())
+
+    # ---- the publisher side: a live EASGD core over the same model ---
+    params_gen0 = jax.tree.map(np.array, jax.device_get(model.params))
+    core = EasgdServerCore(
+        jax.tree.map(np.copy, params_gen0), alpha=alpha,
+        publish_every=publish_every,
+    )
+    rng = np.random.RandomState(seed)
+    # a deterministic "worker trajectory": center + small perturbation,
+    # so the published generation 1 is genuinely different weights
+    worker = jax.tree.map(
+        lambda a: a + rng.normal(0, 0.02, a.shape).astype(a.dtype)
+        if a.dtype == np.float32 else a,
+        params_gen0,
+    )
+    core.handler({"kind": "join", "rank": 0})
+
+    def exchange_once():
+        return core.handler(
+            {"kind": "exchange", "rank": 0,
+             "params": jax.tree.map(np.copy, worker)}
+        )
+
+    # ---- references: one scheduler per generation, same prompts ------
+    prompts = [
+        rng.randint(0, cfg["vocab_size"],
+                    size=int(rng.randint(4, 12))).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def requests(tag):
+        return [
+            Request(id=f"{tag}{j}", prompt=list(p),
+                    max_new_tokens=max_new_tokens)
+            for j, p in enumerate(prompts)
+        ]
+
+    # one warmed engine serves both generations' references — exactly
+    # the params-as-data property the drill is certifying
+    ref_eng = PagedServingEngine(model, **geom)
+
+    def reference(params):
+        sched = ContinuousBatchingScheduler(ref_eng, params=params)
+        for r in requests("ref"):
+            sched.submit(r)
+        done = sched.run()
+        return [list(done[f"ref{j}"]) for j in range(n_requests)]
+
+    ref0 = reference(relayout_for_serving(model, params_gen0))
+
+    # ---- the fleet: baseline replica + canary with a subscriber ------
+    engines = [PagedServingEngine(model, **geom) for _ in range(2)]
+    reps = [ServeReplica(f"r{i}", engines[i]).start() for i in range(2)]
+    router = FleetRouter(evict_after_s=3600.0, metrics=ServingMetrics())
+    for i, rep in enumerate(reps):
+        router.add_replica(f"r{i}", rep)
+    canary = reps[1]
+
+    def fetch(generation):
+        reply = core.handler(
+            {"kind": "weights", "generation": int(generation)}
+        )
+        return reply if reply.get("ok") else None
+
+    sub = WeightSubscriber(
+        canary, fetch,
+        relayout=lambda p: relayout_for_serving(model, p),
+    )
+
+    def run_cohort(tag, pin):
+        ids = []
+        for r in requests(tag):
+            router.submit(r, generation=pin)
+            ids.append(r.id)
+        out = router.run(timeout_s=timeout)
+        return [list(out[i]) for i in ids]
+
+    def wait_idle(deadline):
+        while not all(r.scheduler.idle for r in reps):
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet never drained")
+            time.sleep(0.005)
+
+    try:
+        # warm every chunk bucket on both replicas so compile time never
+        # masquerades as decode work, then PIN the trace counters — the
+        # whole multi-generation episode must add zero
+        for wi, n in enumerate((3, 12, 20)):
+            for rep in reps:
+                rep.handle(("submit", {
+                    "id": f"_warm{wi}", "prompt": list(range(1, n + 1)),
+                    "max_new_tokens": 2,
+                }))
+            # drain between lengths: batching a short prompt with a
+            # long one would bucket it up and leave the short chunk
+            # shape untraced — the cohorts would then pay a "recompile"
+            # the episode check wrongly blames on the swap
+            wait_idle(time.monotonic() + timeout)
+        traces0 = [
+            (e._n_prefill_traces, e._n_decode_traces) for e in engines
+        ]
+
+        # ---- cohort A on gen 0, publish fired MID-DECODE -------------
+        for r in requests("a"):
+            router.submit(r, generation=0)
+        deadline = time.monotonic() + timeout
+        # let decode genuinely start before the publish lands
+        while not any(
+            s.tokens and not s.done for s in router._streams.values()
+        ):
+            if time.monotonic() > deadline:
+                raise RuntimeError("cohort A never started decoding")
+            router.pump()
+            time.sleep(0.002)
+        ann = None
+        for _ in range(publish_every):
+            reply = exchange_once()
+            ann = reply.get("publish", ann)
+        verdict["n_publishes"] = core.publisher.n_published
+        if ann is None or ann.get("generation") != 1:
+            v.append(f"publisher never announced generation 1 after "
+                     f"{publish_every} exchanges (got {ann})")
+        canary_busy = not canary.scheduler.idle
+        sub.poll(ann)  # pull + validate NOW; install defers if busy
+        verdict["install_deferred_while_busy"] = bool(
+            canary_busy and canary.serving_generation == 0
+        )
+        a_out = router.run(timeout_s=timeout)
+        cohort_a = [list(a_out[f"a{j}"]) for j in range(n_requests)]
+        verdict["token_identical_gen0"] = cohort_a == ref0
+        if cohort_a != ref0:
+            v.append(
+                "cohort A (admitted on generation 0, publish mid-decode)"
+                " is NOT token-identical to the gen-0 reference — the "
+                "install tore into in-flight streams"
+            )
+
+        # the between-ticks install applies once the canary drains
+        wait_idle(time.monotonic() + timeout)
+        deadline = time.monotonic() + timeout
+        while canary.serving_generation != 1:
+            if time.monotonic() > deadline:
+                raise RuntimeError("canary never installed generation 1")
+            time.sleep(0.005)
+        verdict["n_installs"] = reps[0].installs + reps[1].installs
+        if verdict["n_installs"] != verdict.get("n_publishes", 0):
+            v.append(
+                f"expected exactly one install per publish fleet-wide "
+                f"(1 subscriber), saw {verdict['n_installs']} install(s)"
+                f" for {verdict.get('n_publishes', 0)} publish(es)"
+            )
+        router.pump()  # poll replies refresh per-replica generations
+
+        # gen-1 reference AFTER the install (same published tree)
+        snap = fetch(1)
+        ref1 = reference(relayout_for_serving(model, snap["params"]))
+
+        # ---- A/B: cohort B pins gen 1, control pins gen 0 ------------
+        cohort_b = run_cohort("b", pin=1)
+        control = run_cohort("c", pin=0)
+        verdict["ab_cohort_identical"] = (
+            cohort_b == ref1 and control == ref0
+        )
+        if cohort_b != ref1:
+            v.append("gen-1 cohort is NOT token-identical to the gen-1 "
+                     "reference — version pinning leaked generations")
+        if control == ref1 and ref1 != ref0:
+            v.append("gen-0 control cohort matches the gen-1 reference "
+                     "— pinning routed it to the canary")
+        if control != ref0:
+            v.append("gen-0 control cohort is NOT token-identical to "
+                     "the gen-0 reference")
+
+        # ---- planted SLO regression → exactly one rollback -----------
+        base_rows = router.metrics.cohort_rows(0)
+        cand_rows = [
+            dict(r, ttft_s=r["ttft_s"] + 5.0, tpot_s=r["tpot_s"] + 5.0)
+            for r in router.metrics.cohort_rows(1)
+        ]
+        verdict["ab_verdict_unplanted"] = ab.compare_cohorts(
+            base_rows, router.metrics.cohort_rows(1)
+        )["verdict"]
+        planted = ab.compare_cohorts(base_rows, cand_rows)
+        verdict["ab_verdict_planted"] = planted["verdict"]
+        if planted["verdict"] != "regression":
+            v.append(
+                f"planted +5s SLO regression judged "
+                f"{planted['verdict']!r}, not 'regression'"
+            )
+        rolled = sub.flag_regression(1)
+        rolled_again = sub.flag_regression(1)
+        verdict["rollbacks"] = sub.rollbacks
+        if not rolled or rolled_again or sub.rollbacks != 1:
+            v.append(
+                f"expected exactly one rollback for one flagged "
+                f"generation, saw rollbacks={sub.rollbacks} "
+                f"(first={rolled}, reflag={rolled_again})"
+            )
+        deadline = time.monotonic() + timeout
+        while canary.serving_generation != 0:
+            if time.monotonic() > deadline:
+                raise RuntimeError("canary never rolled back to gen 0")
+            time.sleep(0.005)
+        router.pump()
+
+        # ---- post-rollback cohort must match gen 0 again -------------
+        post = run_cohort("p", pin=0)
+        verdict["post_rollback_identical"] = post == ref0
+        if post != ref0:
+            v.append("post-rollback cohort is NOT token-identical to "
+                     "the gen-0 reference — rollback restored the "
+                     "wrong weights")
+
+        # ---- bad-shape snapshot refused loudly before install --------
+        bad = jax.tree.map(
+            lambda a: np.zeros(np.shape(a) + (1,), np.asarray(a).dtype),
+            params_gen0,
+        )
+        bad_sub = WeightSubscriber(
+            canary,
+            lambda g: {"generation": g, "params": bad},
+        )
+        gen_before = canary.serving_generation
+        try:
+            bad_sub.pull(7)
+            verdict["refused_bad_dtype"] = False
+            v.append("a wrong-shape snapshot was NOT refused — the "
+                     "GL-W recompile hazard reached install")
+        except SwapRefused:
+            verdict["refused_bad_dtype"] = (
+                canary.serving_generation == gen_before
+                and bad_sub.refusals == 1
+            )
+            if not verdict["refused_bad_dtype"]:
+                v.append("refusal raised but the replica still moved "
+                         "generations")
+
+        # ---- zero-recompile across >= 2 generations ------------------
+        traces1 = [
+            (e._n_prefill_traces, e._n_decode_traces) for e in engines
+        ]
+        extra = sum(
+            (p1 - p0) + (d1 - d0)
+            for (p0, d0), (p1, d1) in zip(traces0, traces1)
+        )
+        verdict["extra_recompiles"] = extra
+        if extra != 0:
+            v.append(
+                f"{extra} recompile(s) across the install/rollback "
+                "episode — the swap is supposed to be params-as-data "
+                "(trace counters pinned)"
+            )
+    finally:
+        for rep in reps:
+            rep.stop()
+
+    # ---- exactly one weights_rolled_back alert through the live plane
+    deltas = counter_deltas(
+        flatten_counters(get_registry().snapshot()), base_counters
+    )
+    rb_deltas = {
+        k: val for k, val in deltas.items()
+        if k.startswith("publish_rollbacks_total")
+    }
+    agg = obs_live.Aggregator(log=lambda line: None)
+    agg.ingest({
+        "kind": obs_live.FRAME_KIND, "v": obs_live.FRAME_VERSION,
+        "rank": "serve_canary", "seq": 1, "t_wall": 0.0,
+        "sample_rate": 1, "dropped": 0,
+        "spans": {"names": [], "idx": [], "ts": [], "dur": []},
+        "ctrs": {"ts": [], "key": [], "val": []},
+        "flows": {"b_id": [], "b_ts": [], "f_id": [], "f_ts": []},
+        "counters": rb_deltas, "hist": {},
+    })
+    win = agg.close_window()
+    alerts = [
+        a for a in win["alerts"] if a["rule"] == "weights_rolled_back"
+    ]
+    verdict["weights_rolled_back_alerts"] = len(alerts)
+    if len(alerts) != 1:
+        v.append(
+            f"expected exactly one weights_rolled_back alert, saw "
+            f"{len(alerts)}"
+        )
+
+    verdict["ok"] = not v
+    return verdict
+
+
 def run_bsp_drill(
     n_ranks: int = 3,
     kill_rank: int = 1,
@@ -796,13 +1151,17 @@ def main(argv=None) -> int:
         prog="theanompi_tpu.runtime.chaos", description=__doc__
     )
     p.add_argument("--rule", action="append",
-                   choices=["EASGD", "GOSGD", "SERVE", "BSP"],
+                   choices=["EASGD", "GOSGD", "SERVE", "BSP", "PUBLISH"],
                    help="drill this rule (repeatable; default: EASGD). "
                    "SERVE runs the in-process serving-fleet kill drill "
                    "(evict → re-admit → token-identical, p99 gate); "
                    "BSP runs the elastic-BSP shrink/rejoin drill "
                    "(evict → resize bit-identical to the fresh smaller "
-                   "world → re-expand, one-recompile gate)")
+                   "world → re-expand, one-recompile gate); PUBLISH "
+                   "runs the online-learning-loop drill (publish "
+                   "mid-decode → between-ticks install → A/B pinned "
+                   "cohorts → planted-regression rollback, "
+                   "zero-recompile gate)")
     p.add_argument("--n-procs", type=int, default=3)
     p.add_argument("--kill-rank", type=int, default=1)
     p.add_argument("--kill-iter", type=int, default=10)
@@ -839,6 +1198,10 @@ def main(argv=None) -> int:
                    "keep it above --bsp-evict-after so the eviction "
                    "provably precedes the re-admission")
     p.add_argument("--bsp-evict-after", type=float, default=1.25)
+    p.add_argument("--publish-requests", type=int, default=6)
+    p.add_argument("--publish-every", type=int, default=3,
+                   help="exchanges per center publication in the "
+                   "PUBLISH drill (the publisher cadence knob)")
     args = p.parse_args(argv)
 
     out = {"rules": {}, "ok": True}
@@ -854,6 +1217,18 @@ def main(argv=None) -> int:
                 timeout=args.timeout,
                 run_baseline=not args.no_baseline,
             )
+        elif rule == "PUBLISH":
+            # the PUBLISH drill runs the EASGD core in-process, whose
+            # membership lines print to stdout; stdout of this CLI must
+            # carry ONLY the verdict JSON (perf_gate json.load's it)
+            import contextlib
+
+            with contextlib.redirect_stdout(sys.stderr):
+                verdict = run_publish_drill(
+                    n_requests=args.publish_requests,
+                    publish_every=args.publish_every,
+                    timeout=args.timeout,
+                )
         elif rule == "SERVE":
             verdict = run_serve_drill(
                 n_replicas=args.serve_replicas,

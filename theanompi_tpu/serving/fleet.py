@@ -103,6 +103,19 @@ class ServeReplica:
     zero-arg callable returning True (green) or False (503).  Wire the
     live watchdog's ``ok()`` here in production; tests and the chaos
     drill inject trips directly.
+
+    **Live weight installs** (``theanompi_tpu.publish``): a
+    ``WeightSubscriber`` hands validated snapshots to
+    :meth:`install_params`, which queues them and applies BETWEEN
+    ticks — only when the scheduler is fully idle (no queued, no
+    active streams), so a request admitted against generation G
+    decodes every token against G.  The apply is a whole-tree rebind
+    of ``scheduler.params`` (params are data to the jitted step — no
+    retrace), the serving-generation marker is assigned LAST, and each
+    install bumps an install epoch through the same
+    ``parallel.membership`` generation machinery the training planes
+    use.  Honest limit: a replica that is never idle never installs —
+    drain it (or let admission gaps occur) to take a publish.
     """
 
     def __init__(
@@ -128,6 +141,15 @@ class ServeReplica:
         self._health_fn = health_fn
         self._streams: Dict[str, Request] = {}
         self.ticks = 0
+        # live weight publication (publish/): the generation this
+        # replica currently serves, a deferred install slot, and an
+        # install epoch riding the membership-roster generation
+        # machinery (every applied install re-joins, which bumps)
+        self.serving_generation = 0
+        self.installs = 0
+        self._pending_install: Optional[Tuple[Any, int]] = None
+        self._install_roster = Roster("publish", evict_after_s=3600.0)
+        self.install_epoch = self._install_roster.join(self.name)
         self._killed = False
         self._stop = threading.Event()
         self.port = port
@@ -181,8 +203,85 @@ class ServeReplica:
                     with obs.span("replica_tick", replica=self.name):
                         self.scheduler.step()
                     self.ticks += 1
+                elif self._pending_install is not None:
+                    # between-ticks install point: no queued and no
+                    # active streams, so nothing can observe the swap
+                    # mid-flight (torn installs impossible by position)
+                    self._apply_install_locked()
             if not work:
                 time.sleep(self.tick_idle_s)
+
+    # ---- live weight installs (publish/) -----------------------------
+    @property
+    def pending_generation(self) -> Optional[int]:
+        p = self._pending_install
+        return p[1] if p is not None else None
+
+    def install_params(
+        self, params, generation: int, rollback: bool = False
+    ) -> int:
+        """Queue ``params`` for a between-ticks install under
+        ``generation``.  Applied immediately when the scheduler is
+        idle, otherwise deferred to the tick loop's next idle gap.
+        Non-rollback installs must advance the generation — a stale or
+        duplicate generation is refused LOUDLY (the subscriber's
+        monotone-pull contract makes this a bug, not a race); only an
+        explicit ``rollback=True`` may move the marker backward."""
+        generation = int(generation)
+        with self._lock:
+            pend = self._pending_install
+            held = max(
+                self.serving_generation,
+                pend[1] if pend is not None else 0,
+            )
+            if not rollback and generation <= held:
+                raise ValueError(
+                    f"replica {self.name!r}: install of generation "
+                    f"{generation} refused — already serving/holding "
+                    f"generation {held} (rollbacks must say "
+                    "rollback=True)"
+                )
+            self._pending_install = (params, generation)
+            if self.scheduler.idle:
+                self._apply_install_locked()
+        return generation
+
+    def _apply_install_locked(self) -> None:
+        """Apply the queued install.  Caller holds ``self._lock`` and
+        has proven the scheduler idle.  The swap is a WHOLE-TREE rebind
+        — never per-leaf stores into the live tree (the GL-W003 torn-
+        install shape) — and the generation markers are assigned only
+        after the new tree is fully in place."""
+        params, generation = self._pending_install
+        self._pending_install = None
+        with obs.span(
+            "weights_install", replica=self.name, generation=generation
+        ):
+            # cached prefix KV was computed under the OUTGOING weights;
+            # serving it against the new tree would silently leak the
+            # old generation into pinned streams.  The scheduler is
+            # idle, so every cached block holds exactly the cache's own
+            # reference and a full sweep empties the cache.
+            prefix = getattr(self.scheduler, "prefix", None)
+            if prefix is not None:
+                prefix.evict_unused(None)
+            self.scheduler.params = params
+            self.installs += 1
+            # install epoch: the membership roster's rejoin bump IS the
+            # monotone epoch counter (generation machinery reused, not
+            # reinvented) — distinct from serving_generation, which the
+            # publisher owns and a rollback may rewind
+            self.install_epoch = self._install_roster.join(self.name)
+            self.scheduler.model_generation = generation
+            self.serving_generation = generation  # marker LAST
+        obs.publish_event(
+            "weights_installed",
+            {
+                "replica": self.name,
+                "generation": generation,
+                "install_epoch": self.install_epoch,
+            },
+        )
 
     # ---- protocol ----------------------------------------------------
     def handle(self, msg: Any) -> Any:
@@ -200,6 +299,7 @@ class ServeReplica:
                 "block_size": int(self.engine.block_size),
                 "n_slots": int(self.engine.n_slots),
                 "max_len": int(self.engine.max_len),
+                "generation": int(self.serving_generation),
             }
         if kind == "submit":
             return self._handle_submit(msg[1])
@@ -260,6 +360,10 @@ class ServeReplica:
                 "healthy": self.healthy,
                 "draining": self.scheduler.draining,
                 "idle": self.scheduler.idle,
+                # the serving generation rides every poll reply: the
+                # router's per-replica view powers version-pinned
+                # admission (A/B cohorts) with no extra frames
+                "generation": int(self.serving_generation),
                 "summary": summary,
                 # pool headroom rides the poll reply as a placement
                 # tiebreak: equal-affinity candidates go to the replica
@@ -286,10 +390,13 @@ class _Stream:
     __slots__ = (
         "id", "prompt", "max_new_tokens", "eos_id", "temperature",
         "top_k", "seed", "replica", "tokens", "done", "readmissions",
-        "base",
+        "base", "pin",
     )
 
-    def __init__(self, spec: Dict[str, Any], replica: str):
+    def __init__(
+        self, spec: Dict[str, Any], replica: str,
+        pin: Optional[int] = None,
+    ):
         self.id = spec["id"]
         self.prompt = list(spec["prompt"])
         self.max_new_tokens = int(spec["max_new_tokens"])
@@ -298,6 +405,9 @@ class _Stream:
         self.top_k = int(spec.get("top_k", 0))
         self.seed = spec.get("seed")
         self.replica = replica
+        # version pin (A/B serving): admission and every re-admission
+        # stay on replicas serving exactly this model generation
+        self.pin = None if pin is None else int(pin)
         self.tokens: List[int] = []  # the accepted-token journal
         self.done = False
         self.readmissions = 0
@@ -336,7 +446,7 @@ class _ReplicaState:
         "name", "target", "block_size", "summary", "shed", "draining",
         "left", "dead", "active", "shed_events", "shed_since",
         "shed_seconds", "tokens_out", "headroom", "backpressure",
-        "drain_refusals",
+        "drain_refusals", "generation",
     )
 
     def __init__(self, name: str, target):
@@ -356,6 +466,7 @@ class _ReplicaState:
         self.tokens_out = 0
         self.backpressure = 0  # replica-side backpressure_events
         self.drain_refusals = 0  # replica-side drain_refusals
+        self.generation = 0  # serving generation from the last poll
 
     @property
     def admitting(self) -> bool:
@@ -463,14 +574,25 @@ class FleetRouter:
             prompt, state.block_size, state.summary
         )
 
-    def route(self, prompt: Sequence[int]) -> Tuple[str, int]:
+    def route(
+        self, prompt: Sequence[int], generation: Optional[int] = None
+    ) -> Tuple[str, int]:
         """(replica name, affinity match depth in blocks) for one
         prompt: highest depth × recency weight wins (a replica whose
         matching chain is warm outranks one holding the same depth in
         entries about to be LRU-evicted); weight ties break on
         advertised pool headroom, then round-robin.  No match falls
-        back to least-loaded, headroom-then-round-robin tiebroken."""
+        back to least-loaded, headroom-then-round-robin tiebroken.
+        ``generation`` (A/B pinning) restricts candidates to replicas
+        last seen serving exactly that model generation."""
         elig = self._eligible()
+        if generation is not None:
+            elig = [s for s in elig if s.generation == int(generation)]
+            if not elig:
+                raise FleetError(
+                    f"no admitting replica serves generation "
+                    f"{int(generation)} (pinned cohort)"
+                )
         if not elig:
             raise FleetError("no replica is admitting (fleet down, "
                              "draining, or fully shed)")
@@ -493,11 +615,18 @@ class FleetRouter:
         self._rr += 1
         return pick.name, depth
 
-    def submit(self, request: Union[Request, Dict[str, Any]]) -> str:
+    def submit(
+        self,
+        request: Union[Request, Dict[str, Any]],
+        generation: Optional[int] = None,
+    ) -> str:
         """Admit one request to the fleet; returns the replica name it
         landed on.  A refusing replica (drain race, just-died) is
         skipped and the request re-routes — ``FleetError`` only when
-        every replica refused."""
+        every replica refused.  ``generation`` pins this request's
+        cohort to replicas serving that model generation — admission
+        AND any re-admission stay on the pinned version, so cohort
+        timelines compare cleanly (``publish.ab``)."""
         spec = (
             {
                 "id": request.id,
@@ -512,11 +641,17 @@ class FleetRouter:
         )
         if spec["id"] in self._streams:
             raise ValueError(f"stream id {spec['id']!r} already submitted")
-        name, score = self.route(spec["prompt"])
-        stream = _Stream(spec, name)
+        name, score = self.route(spec["prompt"], generation=generation)
+        stream = _Stream(spec, name, pin=generation)
         placed = self._place(stream, spec, first_choice=name)
         if self.metrics is not None:
-            self.metrics.admitted(stream.id, len(stream.prompt))
+            gen = (
+                stream.pin if stream.pin is not None
+                else self._replicas[placed].generation
+            )
+            self.metrics.admitted(
+                stream.id, len(stream.prompt), generation=gen
+            )
         self._streams[stream.id] = stream
         self.stats["submitted"] += 1
         if score > 0 and placed == name:
@@ -532,9 +667,12 @@ class FleetRouter:
 
     def _place(self, stream: _Stream, spec: Dict[str, Any],
                first_choice: str) -> str:
-        """Try the routed replica, then every other admitting one."""
+        """Try the routed replica, then every other admitting one (a
+        pinned stream only ever tries replicas on its generation)."""
         order = [first_choice] + [
-            s.name for s in self._eligible() if s.name != first_choice
+            s.name for s in self._eligible()
+            if s.name != first_choice
+            and (stream.pin is None or s.generation == stream.pin)
         ]
         for name in order:
             state = self._replicas[name]
@@ -589,6 +727,7 @@ class FleetRouter:
         state.headroom = int(reply.get("headroom") or 0)
         state.backpressure = int(reply.get("backpressure") or 0)
         state.drain_refusals = int(reply.get("drain_refusals") or 0)
+        state.generation = int(reply.get("generation") or 0)
         state.draining = bool(reply.get("draining"))
         now = self.clock()
         healthy = bool(reply.get("healthy", True))
@@ -657,8 +796,10 @@ class FleetRouter:
                 "journaled",
             )
             try:
+                # a pinned stream re-admits only onto its generation —
+                # losing it when that generation vanished is honest
                 placed = self._place(st, spec, first_choice=self.route(
-                    spec["prompt"]
+                    spec["prompt"], generation=st.pin
                 )[0])
             except FleetError:
                 st.done = True  # surfaced as a violation by the drill
@@ -784,6 +925,7 @@ class FleetRouter:
                 "left": s.left,
                 "shed_events": s.shed_events,
                 "shed_seconds": round(s.shed_seconds, 4),
+                "generation": s.generation,
             }
         return {
             **self.stats,

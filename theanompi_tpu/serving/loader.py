@@ -26,6 +26,38 @@ from theanompi_tpu.runtime.mesh import replicate
 from theanompi_tpu.utils import checkpoint
 
 
+def relayout_for_serving(model, params):
+    """Train→serve re-lay of an IN-MEMORY params tree — the live-
+    publication path (``theanompi_tpu.publish``): same structure check
+    and same placement machinery as :func:`restore_params_for_serving`,
+    but the source is a published center snapshot, not a checkpoint
+    file, and the MODEL IS NEVER MUTATED — the placed tree is returned
+    for the subscriber to validate and hand to
+    ``ServeReplica.install_params``.  Replication covers plain-dp
+    serving; tp leaves move replicated → Megatron-sharded per the same
+    ``_build_param_specs`` tree training shards by (a no-op when the
+    mesh has no ``tp`` axis or the model declares no specs)."""
+    if jax.tree.structure(params) != jax.tree.structure(model.params):
+        raise ValueError(
+            "published snapshot has a different params structure than "
+            "the serving model — the center and this replica were built "
+            "from different architecture configs"
+        )
+    placed = replicate(model.mesh, params)
+    specs = getattr(model, "param_specs", None)
+    if specs is not None:
+        from jax.sharding import NamedSharding
+
+        placed = jax.tree.map(
+            lambda a, s: jax.device_put(
+                a, NamedSharding(model.mesh, s)
+            ),
+            placed,
+            specs,
+        )
+    return placed
+
+
 def restore_params_for_serving(model, path: str):
     """Load ``path`` and install its params on ``model``'s mesh in
     inference sharding.  Returns the placed params (also set on the

@@ -212,12 +212,23 @@ class ServingMetrics:
         counts[-1] += 1  # +Inf
 
     # ---- request lifecycle (scheduler hooks) -------------------------
-    def admitted(self, rid: str, n_prompt: int, t: Optional[float] = None):
+    def admitted(
+        self,
+        rid: str,
+        n_prompt: int,
+        t: Optional[float] = None,
+        generation: int = 0,
+    ):
+        # ``generation`` is the model generation the request was
+        # ADMITTED against (publish/ online-learning loop); it labels
+        # the request's latency observations so A/B cohorts stay
+        # separable in /metrics and history diffs
         self._open[rid] = {
             "id": rid,
             "n_prompt": int(n_prompt),
             "t_admit": self.clock() if t is None else t,
             "t_first": None,
+            "generation": int(generation),
         }
 
     def first_token(self, rid: str, t: Optional[float] = None):
@@ -243,6 +254,7 @@ class ServingMetrics:
             "tpot_s": float(tpot),
             "t_admit": row["t_admit"],
             "t_done": t,
+            "generation": int(row.get("generation", 0)),
         }
         self.rows.append(done)
         self.n_finished += 1
@@ -261,10 +273,11 @@ class ServingMetrics:
         # rows keep powering the exact nearest-rank summary(); the
         # histograms power /metrics scrapes and cross-subsystem
         # snapshots without retaining unbounded row lists
-        _TTFT.observe(done["ttft_s"])
+        gen = str(done["generation"])
+        _TTFT.observe(done["ttft_s"], model_generation=gen)
         self._bucket_observe(self._ttft_counts, done["ttft_s"])
         if done["n_out"] > 1:
-            _TPOT.observe(done["tpot_s"])
+            _TPOT.observe(done["tpot_s"], model_generation=gen)
             self._bucket_observe(self._tpot_counts, done["tpot_s"])
         if self.recorder is not None:
             self.recorder.log_event(
@@ -274,7 +287,15 @@ class ServingMetrics:
                 n_out=done["n_out"],
                 ttft_s=round(done["ttft_s"], 6),
                 tpot_s=round(done["tpot_s"], 6),
+                generation=done["generation"],
             )
+
+    def cohort_rows(self, generation: int) -> list:
+        """Completed-request rows admitted against ``generation`` —
+        the per-cohort view ``publish.ab.compare_cohorts`` judges A/B
+        serving by (bounded by the same ``max_rows`` window)."""
+        g = int(generation)
+        return [r for r in self.rows if r.get("generation", 0) == g]
 
     # ---- aggregate ---------------------------------------------------
     def summary(self) -> dict:

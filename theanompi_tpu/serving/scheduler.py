@@ -148,6 +148,11 @@ class ContinuousBatchingScheduler:
         self.engine = engine
         self.metrics = metrics
         self.params = params if params is not None else engine.model.params
+        # the model generation these params came from (publish/ live
+        # installs set it alongside the whole-tree params rebind); it
+        # labels admissions and the token counter so A/B cohorts stay
+        # separable in /metrics
+        self.model_generation = 0
         self.clock = clock
         self.paged = bool(getattr(engine, "is_paged", False))
         self.slots = [_Slot() for _ in range(engine.n_slots)]
@@ -269,7 +274,8 @@ class ContinuousBatchingScheduler:
                 )
         if self.metrics is not None:
             self.metrics.admitted(request.id, len(request.prompt),
-                                  t=self.clock())
+                                  t=self.clock(),
+                                  generation=self.model_generation)
         self.queue.append(request)
         _ADMITTED.inc()
         _QUEUE.set(len(self.queue))
@@ -693,7 +699,7 @@ class ContinuousBatchingScheduler:
         produced = (
             self._step_paged() if self.paged else self._step_contiguous()
         )
-        _TOKENS.inc(produced)
+        _TOKENS.inc(produced, model_generation=str(self.model_generation))
         return produced
 
     def run(self, max_ticks: int = 100_000) -> Dict[str, List[int]]:

@@ -44,7 +44,7 @@ from theanompi_tpu.tuning.knobs import Knob, KnobError
 def default_bench_cmd(plan: str) -> List[str]:
     """The real bench for a plan (CPU rehearsal is forced by trials)."""
     root = trials._repo_root()
-    script = "bench.py" if plan == "train" else "bench_serve.py"
+    script = "bench.py" if plan in ("train", "easgd") else "bench_serve.py"
     return [sys.executable, os.path.join(root, script)]
 
 
@@ -80,6 +80,11 @@ class DriverConfig:
             self.presets_path = presets_io.default_presets_path()
         if self.bench_cmd is None:
             self.bench_cmd = default_bench_cmd(self.plan)
+        if self.plan == "easgd":
+            # the easgd plan rides bench.py's EASGD arm, selected by
+            # env so the driver's bench_cmd surface stays one script
+            # per bench; an explicit caller-set rule wins
+            self.env_extra.setdefault("THEANOMPI_BENCH_RULE", "EASGD")
         if self.rounds < 1:
             raise KnobError("--rounds must be >= 1")
         if self.top_k < 1:

@@ -2,8 +2,8 @@
 
 A :class:`Knob` is a declaration, not a mechanism: it names the ladder
 of values the search may try, the plan that owns it (``train`` /
-``serve`` / ``fleet`` — the ``--plan`` selector), the bench that
-measures it, and the verdict instruments that judge a candidate:
+``serve`` / ``fleet`` / ``easgd`` — the ``--plan`` selector), the bench
+that measures it, and the verdict instruments that judge a candidate:
 
 - ``checks`` — declarative bounds evaluated directly on the BENCH
   JSON's ``detail`` tree (the same fields the perf_gate legs assert);
@@ -23,7 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Tuple
 
-PLANS = ("train", "serve", "fleet")
+PLANS = ("train", "serve", "fleet", "easgd")
 BENCHES = ("train", "serve")
 _KINDS = {"int": int, "float": (int, float), "choice": str}
 _CHECK_OPS = ("<=", ">=", "==", "truthy")
@@ -203,26 +203,6 @@ REGISTRY: Tuple[Knob, ...] = (
         history_flags=dict(_NO_NEW_ALERTS, max_overlap_drop=0.5),
     ),
     Knob(
-        name="easgd_tau",
-        kind="int",
-        ladder=(2, 5, 10, 20, 40),
-        default=10,
-        plan="train",
-        bench="train",
-        description=(
-            "EASGD communication period τ (worker steps between center "
-            "exchanges) — the elastic-averaging staleness/traffic "
-            "trade-off (arXiv:1605.08325 §4)"
-        ),
-        history_flags=dict(_NO_NEW_ALERTS),
-        # the committed train bench is the BSP AlexNet config: it
-        # accepts and echoes the override but its workload never runs
-        # the EASGD rule, so a sweep here would measure noise.  The
-        # driver skips inert knobs and says so; a multi-host EASGD
-        # bench window flips this off.
-        inert_on_bench=True,
-    ),
-    Knob(
         name="trace_sample",
         kind="int",
         ladder=(1, 2, 8, 32),
@@ -309,6 +289,36 @@ REGISTRY: Tuple[Knob, ...] = (
                   op=">=", value=1, required=True),
             Check(path=("fleet", "scaling", "shed_events"),
                   op="<=", value=0),
+        ),
+        history_flags=dict(_NO_NEW_ALERTS),
+    ),
+    # ---- easgd plan (bench.py with THEANOMPI_BENCH_RULE=EASGD) -----------
+    Knob(
+        name="easgd_tau",
+        kind="int",
+        ladder=(2, 5, 10, 20, 40),
+        default=10,
+        plan="easgd",
+        bench="train",
+        description=(
+            "EASGD communication period τ (worker steps between center "
+            "exchanges) — the elastic-averaging staleness/traffic "
+            "trade-off (arXiv:1605.08325 §4).  Measured by bench.py's "
+            "EASGD arm (workers round-robin against an in-process "
+            "EasgdServerCore with the online-learning publisher live), "
+            "so the sweep pays the real exchange + publish cadence "
+            "cost, not BSP noise."
+        ),
+        checks=(
+            # the arm must actually run the elastic rule — a candidate
+            # whose τ exceeded the step budget exchanged zero times and
+            # measured plain local SGD
+            Check(path=("easgd", "exchanges"), op=">=", value=1,
+                  required=True),
+            # the online-learning loop rides the same cadence: at least
+            # one center snapshot must have published during the window
+            Check(path=("easgd", "publish", "published"), op=">=",
+                  value=1, required=True),
         ),
         history_flags=dict(_NO_NEW_ALERTS),
     ),
