@@ -54,6 +54,14 @@ could not even pose:
   and the extra-compile count (must be 0 — params are data).  Token
   identity / rollback / refusal correctness lives in the PUBLISH chaos
   drill (perf_gate publish leg), not here.
+- **request forensics** (``detail.request_forensics``) — per-request
+  tail tracing is enabled around the measured open-loop window
+  (``forensics_threshold_s`` knob; requests slower than it are
+  retained whole) and the request doctor's phase breakdown of the
+  single slowest request rides the JSON line: queue / prefill /
+  decode / backpressure attribution with a coverage fraction the
+  perf-gate FORENSICS leg requires >= 0.9, plus retained/recycled
+  counts (a green run must recycle ~everything).
 
 Protocol:
 - ``TransformerLM`` at the flagship serve config (rehearsal shrinks it,
@@ -150,6 +158,10 @@ _KNOBS_REAL = dict(
     fleet_prefix_len=64, fleet_tail=8, fleet_new_tokens=8,
     fleet_slots=4, fleet_evict_after_s=2.0,
     fleet_failover_requests=4, fleet_failover_new_tokens=24,
+    # request forensics: retain whole traces only past this latency
+    # (30s = nothing on a green run; the worst-latency ring still
+    # feeds the doctor's slowest-request breakdown)
+    forensics_threshold_s=30.0,
 )
 _KNOBS_REHEARSAL = dict(
     d_model=32, n_heads=4, n_layers=2, vocab_size=64, seq_len=64,
@@ -173,6 +185,7 @@ _KNOBS_REHEARSAL = dict(
     fleet_prefix_len=24, fleet_tail=4, fleet_new_tokens=4,
     fleet_slots=2, fleet_evict_after_s=2.0,
     fleet_failover_requests=4, fleet_failover_new_tokens=16,
+    forensics_threshold_s=30.0,
 )
 
 # ---- closed-loop tuning contract (theanompi_tpu/tuning/trials.py) ---------
@@ -768,6 +781,33 @@ def _publish_probe(model, knobs):
         rep.stop()
 
 
+def _request_forensics(knobs):
+    """detail.request_forensics: the request doctor's verdict on the
+    measured open-loop window — phase breakdown of the slowest request
+    (worst-latency ring: present even when nothing breached the
+    retention threshold) plus the retain/recycle accounting the gate
+    reads.  Pure host-side bookkeeping; never touches the engine."""
+    from theanompi_tpu import observability
+    from theanompi_tpu.observability import analysis as obs_analysis
+
+    stats = observability.request_stats()
+    out = {
+        "threshold_s": knobs["forensics_threshold_s"],
+        "tracked": stats["tracked"],
+        "retained": stats["retained"],
+        "recycled": stats["recycled"],
+        "retained_rids": sorted(
+            r["rid"] for r in observability.retained_requests()
+        ),
+    }
+    worst = observability.worst_requests()
+    if worst:
+        slowest = obs_analysis.request_breakdown(worst[0])
+        out["slowest"] = slowest
+        out["coverage"] = slowest["coverage"]
+    return out
+
+
 def _long_tail_prompts(rng, knobs):
     """Mixed-length burst: mostly short prompts, a long tail near
     max_len — the workload shape that wastes contiguous slot memory."""
@@ -899,8 +939,18 @@ def main(argv=None):
                         max_new_tokens=min(2, knobs["max_new_tokens"])))
     warm.run()
 
+    # request forensics cover EXACTLY the measured window: enabled
+    # after warmup (a tracked warm request's compile time would
+    # masquerade as the slowest request) and disabled before the
+    # capacity probes (the failover probe kills a replica on purpose —
+    # its flagged retentions must not read as a red headline run)
+    observability.enable_request_tracking(
+        threshold_s=knobs["forensics_threshold_s"]
+    )
     dt = _drive_open_loop(sched, Request, prompts, arrivals,
                           knobs["max_new_tokens"])
+    forensics_detail = _request_forensics(knobs)
+    observability.disable_request_tracking()
 
     # ---- paged capacity probes (CPU bench acceptance evidence) -------
     paged_detail = None
@@ -1043,6 +1093,7 @@ def main(argv=None):
     }
     if "engine_stats" in summary:
         detail["engine_stats"] = summary["engine_stats"]
+    detail["request_forensics"] = forensics_detail
     if paged_detail is not None:
         detail["paged"] = paged_detail
     if spec_detail is not None:

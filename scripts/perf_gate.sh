@@ -83,6 +83,20 @@
 #                           ratio at equal cache bytes (default 2.0)
 #   PERF_GATE_SERVE_MAX_KV_DRIFT   maximum fraction of greedy tokens
 #                           the int8 cache may change (default 0.3)
+#   PERF_GATE_FORENSICS     1 (default) = request-forensics acceptance on
+#                           the serve JSON (ISSUE 20): the bench must have
+#                           run under request tracking, the slowest
+#                           request's phase attribution must cover >= the
+#                           coverage floor of its measured latency, the
+#                           green run must retain ~nothing (tail retention
+#                           that fires on a healthy run is noise, not
+#                           signal), and the planted-slow selftest
+#                           (`observability requests --selftest`) must
+#                           pass — a doctor that cannot blame a planted
+#                           2s queue wait is a broken gate.  0 = skip
+#                           (escape hatch).
+#   PERF_GATE_FORENSICS_MIN_COVERAGE  minimum phase-attribution coverage
+#                           of the slowest request (default 0.9)
 #
 # Chaos leg (the elastic-membership drill; docs/elasticity.md):
 #   PERF_GATE_CHAOS         1 (default) = run the kill-evict-respawn-readmit
@@ -543,6 +557,52 @@ print(f"[perf_gate] spec: identical, accept {rate} (speedup "
       f"{spec.get('speedup')}); kv ratio {ratio}, drift {drift}",
       file=sys.stderr)
 PY
+    fi
+    # 5e. request-forensics acceptance (ISSUE 20): the tail doctor must
+    # explain the slowest request, retain ~nothing on a green run, and
+    # prove on a planted-slow fixture that it CAN blame a phase
+    if [ "${PERF_GATE_FORENSICS:-1}" = "1" ]; then
+        MIN_COVERAGE="${PERF_GATE_FORENSICS_MIN_COVERAGE:-0.9}"
+        echo "[perf_gate] forensics acceptance: coverage >= $MIN_COVERAGE, green run retains ~nothing" >&2
+        python - "$SERVE_JSON" "$MIN_COVERAGE" <<'PY'
+import json, sys
+sys.path.insert(0, "scripts")
+from bench_compare import extract_bench
+doc = extract_bench(open(sys.argv[1]).read()) or {}
+min_cov = float(sys.argv[2])
+fx = (doc.get("detail") or {}).get("request_forensics")
+if not isinstance(fx, dict):
+    sys.exit("[perf_gate] FORENSICS VIOLATION: serve bench JSON has no "
+             "detail.request_forensics section (bench ran without "
+             "request tracking?)")
+if fx.get("tracked", 0) < 1:
+    sys.exit("[perf_gate] FORENSICS VIOLATION: zero requests tracked — "
+             "the measured window ran outside request tracking")
+cov = fx.get("coverage")
+if cov is None or cov < min_cov:
+    sys.exit(f"[perf_gate] FORENSICS VIOLATION: slowest request's phase "
+             f"attribution covers {cov} of its latency < {min_cov} — "
+             "the doctor cannot explain where the tail went")
+retained = fx.get("retained", 0)
+if retained > 1:
+    sys.exit(f"[perf_gate] FORENSICS VIOLATION: {retained} request(s) "
+             f"retained on a green run (rids {fx.get('retained_rids')}) "
+             "— tail retention firing on a healthy bench is noise, "
+             "not signal")
+slow = fx.get("slowest") or {}
+print(f"[perf_gate] forensics: {fx.get('tracked')} tracked, "
+      f"{retained} retained, slowest {slow.get('rid')!r} coverage "
+      f"{cov}", file=sys.stderr)
+PY
+        # self-test: the planted 2s queue-dominated request MUST be
+        # retained, sampling-proof, and blamed on the queue — a request
+        # doctor that cannot explain the plant is a broken gate
+        echo "[perf_gate] forensics selftest: observability requests --selftest" >&2
+        if ! python -m theanompi_tpu.observability requests --selftest \
+                > /dev/null; then
+            echo "[perf_gate] FORENSICS VIOLATION: the planted-slow selftest failed" >&2
+            exit 1
+        fi
     fi
 fi
 

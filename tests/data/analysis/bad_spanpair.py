@@ -1,0 +1,79 @@
+"""Seeded GL-O001 corpus: unpaired observability lifecycle calls.
+
+Parsed by the analyzer, never imported.  Each ``fires_*`` function must
+produce exactly one GL-O001; every other function is a sanctioned
+shape that must stay silent.
+"""
+
+
+def fires_inverted_drain(sched, subscriber):
+    # end issued BEFORE its begin with no loop back: the drain opened
+    # on the last line can never close.
+    sched.end_drain()
+    subscriber.install()
+    sched.begin_drain()  # GL-O001
+
+
+def fires_disjoint_flow(tracer, cond, rid):
+    # begin and end on disjoint branches — from the begin, the end's
+    # block is not reachable.
+    if cond:
+        tracer.flow_begin(f"req:{rid}", 1)  # GL-O001
+    else:
+        tracer.flow_end(f"req:{rid}", 1)
+
+
+def fires_inverted_tracking(obs):
+    obs.disable_request_tracking()
+    obs.enable_request_tracking(threshold_s=0.5)  # GL-O001
+    return obs.request_stats()
+
+
+def silent_handoff(obs, rid, ok):
+    # the FleetRouter.submit shape: close on the rejection path only,
+    # leave the span open on success (the replica owns it now).  The
+    # end IS reachable from the begin, so this must not fire.
+    obs.request_begin(rid)
+    if not ok:
+        obs.request_end(rid, status="rejected")
+        raise RuntimeError("admission refused")
+    return rid
+
+
+def silent_try_finally(sched, work):
+    sched.begin_drain()
+    try:
+        work()
+    finally:
+        sched.end_drain()
+
+
+def silent_loop_carry(tracer, rids):
+    # begin inside the loop, end after it: reachable via the loop
+    # exit edge.
+    for rid in rids:
+        tracer.flow_begin(f"req:{rid}", 1)
+    tracer.flow_end("req:last", 1)
+
+
+def silent_uncalibrated(router, rid):
+    # no matching end anywhere in this function: the pair closes in
+    # another function (the normal cross-function discipline) — the
+    # self-calibration must keep this silent.
+    router.flow_begin(f"req:{rid}", 1)
+    return router.poll(rid)
+
+
+def silent_mismatched_receiver(a, b):
+    # a's end does not calibrate b's begin: different receivers, and
+    # b has no end of its own here -> silent (closes elsewhere).
+    a.begin_drain()
+    a.end_drain()
+    b.begin_drain()
+
+
+def silent_closure_veto(obs, atexit):
+    # the end only exists inside a closure that runs at an unknowable
+    # time — the pass has nothing sound to say, so it must not fire.
+    obs.enable_request_tracking(threshold_s=2.0)
+    atexit.register(lambda: obs.disable_request_tracking())

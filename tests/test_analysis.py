@@ -176,6 +176,7 @@ def test_every_pass_fires_on_corpus():
         "threadstate",
         "protocol",
         "weightswap",
+        "spanpair",
     }
 
 
@@ -1121,6 +1122,46 @@ def test_weightswap_golden():
     clean = {"swap_plain_ok", "swap_gated_ok", "promote_ok", "infer",
              "__init__"}
     assert not clean & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# GL-O001 spanpair pass (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_spanpair_golden():
+    findings = _findings("bad_spanpair.py")
+    got = _rule_symbol_pairs(findings)
+    assert got == sorted(
+        [
+            ("GL-O001", "fires_inverted_drain"),
+            ("GL-O001", "fires_disjoint_flow"),
+            ("GL-O001", "fires_inverted_tracking"),
+        ]
+    )
+    for f in findings:
+        assert f.severity == "warning"
+        assert "no reachable" in f.message
+    # every sanctioned shape in the fixture stays silent: the
+    # submit-style handoff, try/finally, the loop carry, the
+    # uncalibrated cross-function pair, the mismatched receiver, and
+    # the closure veto
+    silent = {
+        "silent_handoff", "silent_try_finally", "silent_loop_carry",
+        "silent_uncalibrated", "silent_mismatched_receiver",
+        "silent_closure_veto",
+    }
+    assert not silent & {f.symbol.rsplit(".", 1)[-1] for f in findings}
+
+
+def test_spanpair_repo_clean():
+    """The shipped serving/observability code uses the pair
+    discipline correctly — the new pass must add nothing to the
+    repo's own lint verdict (the empty-baseline acceptance)."""
+    from theanompi_tpu.analysis import engine
+
+    findings, _skipped = analyze()
+    assert [f for f in findings if f.rule.startswith("GL-O")] == []
+    assert engine.spanpair in engine._PER_MODULE_PASSES
 
 
 # ---------------------------------------------------------------------------

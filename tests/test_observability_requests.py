@@ -1,0 +1,469 @@
+"""Request-level tail forensics (ISSUE 20).
+
+The tentpole contract, tested end to end on a fake-clock tracer:
+tail-based retention (threshold OR flags, sampling-proof), the
+worst-latency ring that keeps a green run's p99 explainable, the
+request doctor's priority interval-subtraction breakdown, the
+``requests``/``doctor --request`` CLI with its planted-slow selftest,
+the ``*requests.json`` export artifact, the live-plane digests that
+become ``history slowest`` rows, and the chaos drill's causal-tree
+check (``chaos.check_readmit_trace``) golden-tested on synthetic
+traces of both legitimate shapes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from theanompi_tpu import observability as obs
+from theanompi_tpu.observability import analysis
+from theanompi_tpu.observability.trace import Tracer
+
+
+def _tracker(threshold_s=0.5, **kw):
+    """A deterministic tracer with request tracking on: fake clock
+    advanced by hand, so latencies are exact."""
+    now = [0.0]
+    tr = Tracer(clock=lambda: now[0], pid=0, process_name="reqtest")
+    tr.enable()
+    tr.enable_request_tracking(threshold_s=threshold_s, **kw)
+    return tr, now
+
+
+def _drive(tr, now, rid, queue=0.0, prefill=0.0, decode=0.0,
+           flags=(), n_tokens=8, status="ok"):
+    """One synthetic request: queue -> prefill -> first_token ->
+    decode, each phase an exact span on the fake clock."""
+    t0 = now[0]
+    tr.request_begin(rid, prompt_len=4)
+    if queue:
+        now[0] += queue
+        tr.add_span("req_queue", t0, now[0], {"rid": rid})
+    tq = now[0]
+    if prefill:
+        now[0] += prefill
+        tr.add_span("req_prefill", tq, now[0], {"rid": rid})
+    tr.request_mark(rid, "first_token")
+    tp = now[0]
+    if decode:
+        now[0] += decode
+        tr.add_span("req_decode", tp, now[0], {"rid": rid})
+    for f in flags:
+        tr.request_flag(rid, f)
+    return tr.request_end(rid, n_tokens=n_tokens, status=status)
+
+
+# ---------------------------------------------------------------------------
+# retention: threshold x flags x status, sampling-proof buffering
+# ---------------------------------------------------------------------------
+
+def test_threshold_retention_and_counters():
+    tr, now = _tracker(threshold_s=0.5)
+    fast = _drive(tr, now, "fast", decode=0.01)
+    slow = _drive(tr, now, "slow", queue=0.4, decode=0.2)
+    assert fast["retained"] is False
+    assert slow["retained"] is True
+    stats = tr.request_stats()
+    assert stats["tracked"] == 2
+    assert stats["retained"] == 1
+    assert stats["recycled"] == 1
+    assert [r["rid"] for r in tr.retained_requests()] == ["slow"]
+
+
+def test_flag_retains_below_threshold():
+    """A readmitted/lost/killed flag retains UNCONDITIONALLY — fast
+    failovers are exactly the tails worth explaining."""
+    tr, now = _tracker(threshold_s=100.0)
+    rec = _drive(tr, now, "r0", decode=0.01, flags=("readmitted",))
+    assert rec["retained"] is True
+    assert rec["flags"] == ["readmitted"]
+
+
+def test_non_ok_status_retains():
+    tr, now = _tracker(threshold_s=100.0)
+    rec = _drive(tr, now, "r0", decode=0.01, status="lost")
+    assert rec["retained"] is True
+    assert rec["status"] == "lost"
+
+
+def test_retention_is_sampling_proof():
+    """Events route to the request buffer BEFORE the 1-in-N sampling
+    drop: a retained trace is complete even when the global trace
+    keeps almost nothing."""
+    tr, now = _tracker(threshold_s=0.5)
+    tr.sample_rate = 1000
+    rec = _drive(tr, now, "slow", queue=0.4, prefill=0.1, decode=0.2)
+    names = [e["name"] for e in rec["events"] if e.get("ph") == "X"]
+    assert "req_queue" in names
+    assert "req_prefill" in names
+    assert "req_decode" in names
+
+
+def test_request_begin_idempotent():
+    """The router and the replica scheduler both open the same rid;
+    the second begin must neither reset t0 nor double-count."""
+    tr, now = _tracker(threshold_s=0.1)
+    tr.request_begin("r0")
+    now[0] += 0.2
+    tr.request_begin("r0")  # replica-side re-open: no-op
+    now[0] += 0.05
+    rec = tr.request_end("r0")
+    assert tr.request_stats()["tracked"] == 1
+    assert rec["latency_s"] == pytest.approx(0.25)
+
+
+def test_retained_ring_bounded_and_worst_ring_sorted():
+    tr, now = _tracker(threshold_s=0.05, capacity=2, worst=2)
+    for i, lat in enumerate((0.1, 0.3, 0.2)):
+        _drive(tr, now, f"r{i}", decode=lat)
+    # capacity=2: the oldest retained record was evicted
+    assert [r["rid"] for r in tr.retained_requests()] == ["r1", "r2"]
+    # worst ring: slowest first, bounded at 2, independent of retention
+    assert [r["rid"] for r in tr.worst_requests()] == ["r1", "r2"]
+
+
+def test_event_buffer_truncation_counted():
+    tr, now = _tracker(threshold_s=0.0, max_events=4)
+    tr.request_begin("r0")
+    for i in range(10):
+        t0 = now[0]
+        now[0] += 0.001
+        tr.add_span("req_decode", t0, now[0], {"rid": "r0"})
+    rec = tr.request_end("r0")
+    assert len(rec["events"]) == 4
+    assert rec["truncated"] == 6
+
+
+def test_disable_drops_all_state():
+    tr, now = _tracker(threshold_s=0.0)
+    _drive(tr, now, "r0", decode=0.1)
+    tr.disable_request_tracking()
+    assert tr.retained_requests() == []
+    assert tr.request_stats()["tracked"] == 0
+    # and the request_* calls become no-ops
+    tr.request_begin("r1")
+    assert tr.request_end("r1") is None
+
+
+def test_disabled_request_path_overhead():
+    """Tier-1 overhead guard (ISSUE 20 satellite): with tracing off,
+    the request lifecycle calls must stay cheap enough for per-request
+    hot paths.  Loose 20µs budget on a loaded CI box — this catches an
+    accidental always-on slow path, not a benchmark."""
+    tracer = obs.get_tracer()
+    was_enabled = tracer.enabled
+    tracer.disable()
+    try:
+        n = 20_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            obs.request_begin(f"r{i}")
+            obs.request_flag(f"r{i}", "x")
+            obs.request_end(f"r{i}")
+        per_req = (time.perf_counter() - t0) / n
+    finally:
+        if was_enabled:
+            tracer.enabled = True
+    assert per_req < 20e-6, f"disabled request path {per_req * 1e6:.2f}µs"
+
+
+# ---------------------------------------------------------------------------
+# the request doctor: priority interval-subtraction breakdown
+# ---------------------------------------------------------------------------
+
+def test_breakdown_sums_to_measured_latency():
+    tr, now = _tracker(threshold_s=0.5)
+    rec = _drive(tr, now, "slow", queue=1.6, prefill=0.1, decode=0.3)
+    row = analysis.request_breakdown(rec)
+    assert row["latency_s"] == pytest.approx(2.0)
+    assert row["coverage"] >= 0.99
+    assert row["phases"]["queue"] == pytest.approx(1.6)
+    assert row["phases"]["prefill"] == pytest.approx(0.1)
+    assert row["phases"]["decode"] == pytest.approx(0.3)
+    assert sum(row["phases"].values()) <= row["latency_s"] * 1.001
+
+
+def test_breakdown_overlap_clipped_by_priority():
+    """A whole-tick decode span overlapping the prefill dispatch must
+    not double-count: prefill outranks decode in _PHASE_PRIORITY, so
+    the overlap lands in prefill exactly once."""
+    tr, now = _tracker(threshold_s=0.0)
+    tr.request_begin("r0")
+    t0 = now[0]
+    now[0] = t0 + 1.0
+    # decode span covering the whole second, prefill the first half
+    tr.add_span("req_decode", t0, t0 + 1.0, {"rid": "r0"})
+    tr.add_span("req_prefill", t0, t0 + 0.5, {"rid": "r0"})
+    row = analysis.request_breakdown(tr.request_end("r0"))
+    assert row["phases"]["prefill"] == pytest.approx(0.5)
+    assert row["phases"]["decode"] == pytest.approx(0.5)
+    assert row["coverage"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_report_and_thresholds():
+    tr, now = _tracker(threshold_s=0.0)
+    for i in range(9):
+        _drive(tr, now, f"ok{i}", prefill=0.01, decode=0.04)
+    _drive(tr, now, "tail", queue=1.9, decode=0.1)
+    report = analysis.request_report(tr.retained_requests())
+    assert report["n_requests"] == 10
+    assert report["p99"]["rid"] == "tail"
+    assert report["p99"]["phases"]["queue"] == pytest.approx(1.9)
+    # aggregate queue fraction is dominated by the tail request
+    v = analysis.check_request_thresholds(report, max_queue_frac=0.5)
+    assert v and v[0]["rule"] == "max_queue_frac"
+    # the honesty check: p99 is fully attributed here, so no violation
+    assert analysis.check_request_thresholds(
+        report, max_p99_unattributed_frac=0.1) == []
+
+
+def test_threshold_honesty_check_fires_on_gap():
+    """A tail request with un-spanned wall time must trip
+    max_p99_unattributed_frac — the doctor calls out its own gap."""
+    tr, now = _tracker(threshold_s=0.0)
+    tr.request_begin("gap")
+    now[0] += 2.0  # 2s of nothing: no spans land
+    tr.request_end("gap")
+    report = analysis.request_report(tr.retained_requests())
+    v = analysis.check_request_thresholds(
+        report, max_p99_unattributed_frac=0.1)
+    assert v and v[0]["rule"] == "max_p99_unattributed_frac"
+
+
+# ---------------------------------------------------------------------------
+# export artifact + CLI
+# ---------------------------------------------------------------------------
+
+def test_requests_json_artifact_roundtrip(tmp_path):
+    from theanompi_tpu.observability import export
+
+    tracer = obs.get_tracer()
+    was_enabled = tracer.enabled
+    obs.enable_tracing()
+    obs.enable_request_tracking(threshold_s=0.0)
+    try:
+        obs.request_begin("r0")
+        obs.request_end("r0", n_tokens=3)
+        out = export.dump_all(directory=str(tmp_path), prefix="t_")
+        assert "requests" in out
+        doc = analysis.load_requests(out["requests"])
+        assert doc["kind"] == "tmpi_requests"
+        assert [r["rid"] for r in doc["retained"]] == ["r0"]
+        assert doc["stats"]["tracked"] == 1
+    finally:
+        obs.disable_request_tracking()
+        if not was_enabled:
+            obs.disable_tracing()
+        tracer.clear()
+    # the loader refuses non-forensics documents by kind
+    bad = tmp_path / "not_requests.json"
+    bad.write_text('{"kind": "something_else"}')
+    with pytest.raises(ValueError):
+        analysis.load_requests(str(bad))
+
+
+def _cli(*args, **kw):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "theanompi_tpu.observability", *args],
+        capture_output=True, text=True, env=env, timeout=120, **kw
+    )
+
+
+def test_cli_requests_selftest():
+    """The perf_gate FORENSICS leg's planted-slow fixture: a synthetic
+    2s queue-dominated request must be retained, sampling-proof, and
+    blamed on the queue — exit 0 with the breakdown rendered."""
+    r = _cli("requests", "--selftest")
+    assert r.returncode == 0, r.stderr
+    assert "queue" in r.stdout
+    assert "blamed on queue" in r.stderr
+
+
+def test_cli_requests_and_doctor_request_view(tmp_path):
+    from theanompi_tpu.observability import export
+
+    tracer = obs.get_tracer()
+    was_enabled = tracer.enabled
+    obs.enable_tracing()
+    obs.enable_request_tracking(threshold_s=0.0)
+    try:
+        obs.request_begin("req-7")
+        obs.request_end("req-7", n_tokens=2)
+        out = export.dump_all(directory=str(tmp_path), prefix="t_")
+    finally:
+        obs.disable_request_tracking()
+        if not was_enabled:
+            obs.disable_tracing()
+        tracer.clear()
+    r = _cli("requests", out["requests"], "--json")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["n_requests"] == 1
+    r2 = _cli("doctor", "--requests", out["requests"],
+              "--request", "req-7")
+    assert r2.returncode == 0, r2.stderr
+    assert "req-7" in r2.stdout
+    # unknown rid: loud usage error naming the retained rids
+    r3 = _cli("requests", out["requests"], "--request", "nope")
+    assert r3.returncode == 2
+    assert "req-7" in r3.stderr
+
+
+# ---------------------------------------------------------------------------
+# live plane: digests -> aggregator ring -> history slowest
+# ---------------------------------------------------------------------------
+
+def test_digest_shape_and_drain():
+    tr, now = _tracker(threshold_s=0.0)
+    _drive(tr, now, "r0", queue=0.2, prefill=0.1, decode=0.7,
+           n_tokens=8)
+    digests = tr.drain_request_digests()
+    assert len(digests) == 1
+    d = digests[0]
+    assert d["rid"] == "r0"
+    assert d["latency_s"] == pytest.approx(1.0)
+    assert d["ttft_s"] == pytest.approx(0.3)
+    assert d["tpot_s"] == pytest.approx(0.7 / 7)
+    assert d["phases"]["queue"] == pytest.approx(0.2)
+    # drained means drained
+    assert tr.drain_request_digests() == []
+
+
+def test_history_slowest_dedupes_and_ranks():
+    from theanompi_tpu.observability import history
+
+    verdicts = [
+        {"window": 0, "slow_requests": [
+            {"rid": "a", "latency_s": 0.5, "status": "ok",
+             "phases": {"decode": 0.5}, "flags": []},
+            {"rid": "b", "latency_s": 2.0, "status": "ok",
+             "phases": {"queue": 1.9}, "flags": []},
+        ]},
+        # window-boundary re-ship: same rid, worse observation wins
+        {"window": 1, "slow_requests": [
+            {"rid": "a", "latency_s": 0.9, "status": "ok",
+             "phases": {"decode": 0.9}, "flags": ["readmitted"]},
+        ]},
+    ]
+    rows = history.slowest_requests(verdicts, by="latency", n=10)
+    assert [r["rid"] for r in rows] == ["b", "a"]
+    assert rows[1]["latency_s"] == 0.9
+    assert rows[1]["window"] == 1
+    rendered = history.render_slowest(rows)
+    assert "queue" in rendered and "readmitted" in rendered
+    with pytest.raises(ValueError):
+        history.slowest_requests(verdicts, by="nope")
+
+
+def test_aggregator_ingests_req_digests():
+    from theanompi_tpu.observability.live import Aggregator
+
+    agg = Aggregator()
+    agg.ingest({
+        "kind": "tmpi_telemetry",
+        "rank": "replica0", "seq": 1, "t_wall": 0.0,
+        "req_digests": [
+            {"rid": "q1", "latency_s": 1.5, "status": "ok",
+             "phases": {"queue": 1.4}, "flags": []},
+            {"rid": "q2", "latency_s": 0.2, "status": "ok",
+             "phases": {"decode": 0.2}, "flags": []},
+        ],
+    })
+    worst = agg.slowest_requests()
+    assert [r["rid"] for r in worst] == ["q1", "q2"]
+    assert worst[0]["rank"] == "replica0"
+    # the window verdict carries the offenders for history persistence
+    verdict = agg.close_window()
+    assert [r["rid"] for r in verdict["slow_requests"]][0] == "q1"
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill's causal-tree contract, golden-tested synthetically
+# ---------------------------------------------------------------------------
+
+def _span(name, ts_us, dur_us, rid, **args):
+    return {"ph": "X", "name": name, "ts": ts_us, "dur": dur_us,
+            "args": {"rid": rid, **args}}
+
+
+def _readmit_record(rid="q0", journaled=5, victim_side=True,
+                    flow=True, survivor_order="qpd"):
+    """A synthetic retained record shaped like the drill's killed
+    stream: victim-side queue/prefill/decode, the req_readmit hop at
+    t=1000µs with its flow arrow, then the survivor-side chain."""
+    events = []
+    if victim_side:
+        events += [
+            _span("req_queue", 0, 50, rid),
+            _span("req_prefill", 50, 150, rid),
+            _span("req_decode", 200, 700, rid),
+        ]
+    events.append(_span("req_readmit", 1000, 80, rid,
+                        journaled=journaled))
+    if flow:
+        events.append({"ph": "s", "cat": "flow",
+                       "id": f"req:{rid}:r{journaled}", "ts": 1010})
+    pos = {"q": ("req_queue", 1100, 40), "p": ("req_prefill", 1150, 60),
+           "d": ("req_decode", 1250, 500)}
+    ts_shift = 0
+    for ch in survivor_order:
+        name, ts, dur = pos[ch]
+        events.append(_span(name, ts + ts_shift, dur, rid))
+        ts_shift += 1  # preserve the given order under the ts sort
+    return {"rid": rid, "status": "ok", "latency_s": 0.002,
+            "flags": ["readmitted"], "events": events}
+
+
+def test_check_readmit_trace_full_tree():
+    from theanompi_tpu.runtime.chaos import check_readmit_trace
+
+    chk = check_readmit_trace(_readmit_record())
+    assert chk["ok"], chk["missing"]
+    assert chk["full_tree"] is True
+    assert "req_readmit" in chk["order"]
+
+
+def test_check_readmit_trace_pre_token_kill():
+    """A stream killed before producing a token (journaled=0) has no
+    victim-side phases — the survivor-side chain alone is a legitimate
+    causal tree, but NOT a full one."""
+    from theanompi_tpu.runtime.chaos import check_readmit_trace
+
+    rec = _readmit_record(journaled=0, victim_side=False)
+    chk = check_readmit_trace(rec)
+    assert chk["ok"], chk["missing"]
+    assert chk["full_tree"] is False
+
+
+def test_check_readmit_trace_catches_lost_story():
+    """journaled>0 with no victim-side decode span = the trace LOST the
+    killed stream's pre-kill story — exactly the regression the drill
+    exists to catch."""
+    from theanompi_tpu.runtime.chaos import check_readmit_trace
+
+    rec = _readmit_record(journaled=5, victim_side=False)
+    chk = check_readmit_trace(rec)
+    assert not chk["ok"]
+    assert any("before the readmission hop" in m for m in chk["missing"])
+
+
+def test_check_readmit_trace_requires_flow_arrow():
+    from theanompi_tpu.runtime.chaos import check_readmit_trace
+
+    chk = check_readmit_trace(_readmit_record(flow=False))
+    assert not chk["ok"]
+    assert any("flow arrow" in m for m in chk["missing"])
+
+
+def test_check_readmit_trace_requires_survivor_chain():
+    from theanompi_tpu.runtime.chaos import check_readmit_trace
+
+    rec = _readmit_record(survivor_order="qp")  # no post-hop decode
+    chk = check_readmit_trace(rec)
+    assert not chk["ok"]
+    assert any("decode span after" in m for m in chk["missing"])

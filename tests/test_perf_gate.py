@@ -74,9 +74,12 @@ def _run_gate(env_extra):
 def _serve_json(path, value=150.0, trace=TRACE, metrics=None,
                 ratio=3.5, hit_rate=0.57, fed=72, no_reuse=168,
                 token_identical=True, accept_rate=0.78,
-                kv_ratio=2.65, kv_drift=0.0, spec=True, kv_quant=True):
+                kv_ratio=2.65, kv_drift=0.0, spec=True, kv_quant=True,
+                forensics=True, coverage=0.97, retained=0, tracked=6):
     """A BENCH_serve-shaped fixture with the paged + decode-speed
-    acceptance fields (detail.spec / detail.kv_quant, ISSUE 11)."""
+    acceptance fields (detail.spec / detail.kv_quant, ISSUE 11) and
+    the request-forensics section (detail.request_forensics, ISSUE
+    20)."""
     obs = {"trace_raw": trace}
     if metrics:
         obs["metrics_json"] = metrics
@@ -110,6 +113,24 @@ def _serve_json(path, value=150.0, trace=TRACE, metrics=None,
             "greedy_drift": kv_drift,
             "pool_blocks_fp32": 17,
             "pool_blocks_int8": 45,
+        }
+    if forensics:
+        detail["request_forensics"] = {
+            "threshold_s": 30.0,
+            "tracked": tracked,
+            "retained": retained,
+            "recycled": tracked - retained,
+            "retained_rids": [f"req{i}" for i in range(retained)],
+            "coverage": coverage,
+            "slowest": {
+                "rid": "req0",
+                "latency_s": 0.24,
+                "coverage": coverage,
+                "phases": {"queue": 0.0001, "prefill": 0.056,
+                           "decode": 0.184, "spec_rollback": 0.0,
+                           "install_wait": 0.0, "backpressure": 0.0,
+                           "readmission": 0.0},
+            },
         }
     doc = {
         "metric": "transformer_serve_tokens_per_sec",
@@ -367,6 +388,55 @@ def test_gate_spec_leg_escape_hatch(fixtures, tmp_path):
     serve = _serve_json(tmp_path / "serve.json", token_identical=False,
                         kv_ratio=1.0)
     r = _run_gate(_serve_env(fixtures, serve, PERF_GATE_SPEC="0"))
+    assert r.returncode == 0, r.stderr
+    assert "paged: ratio 3.5" in r.stderr
+
+
+def test_gate_forensics_leg_green(fixtures, tmp_path):
+    """Green forensics fields sail through; the planted-slow selftest
+    runs and passes as part of the leg."""
+    serve = _serve_json(tmp_path / "serve.json")
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode == 0, r.stderr
+    assert "forensics: 6 tracked, 0 retained" in r.stderr
+    assert "forensics selftest" in r.stderr
+    assert "green" in r.stderr
+
+
+def test_gate_forensics_fails_on_low_coverage(fixtures, tmp_path):
+    """A slowest request the doctor cannot explain (phase attribution
+    below the floor) fails the gate."""
+    serve = _serve_json(tmp_path / "serve.json", coverage=0.5)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "cannot explain where the tail went" in (r.stdout + r.stderr)
+    # the floor is a knob
+    r2 = _run_gate(_serve_env(
+        fixtures, serve, PERF_GATE_FORENSICS_MIN_COVERAGE="0.4"))
+    assert r2.returncode == 0, r2.stderr
+
+
+def test_gate_forensics_fails_on_green_retention(fixtures, tmp_path):
+    """Tail retention firing on a healthy bench run means the flags or
+    threshold are mis-tuned — noise, not signal — and fails the gate."""
+    serve = _serve_json(tmp_path / "serve.json", retained=3)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "retained on a green run" in (r.stdout + r.stderr)
+
+
+def test_gate_forensics_fails_on_missing_section(fixtures, tmp_path):
+    serve = _serve_json(tmp_path / "serve.json", forensics=False)
+    r = _run_gate(_serve_env(fixtures, serve))
+    assert r.returncode != 0
+    assert "no detail.request_forensics" in (r.stdout + r.stderr)
+
+
+def test_gate_forensics_escape_hatch(fixtures, tmp_path):
+    """PERF_GATE_FORENSICS=0 skips the forensics acceptance only — the
+    paged and spec checks still run."""
+    serve = _serve_json(tmp_path / "serve.json", forensics=False)
+    r = _run_gate(_serve_env(fixtures, serve, PERF_GATE_FORENSICS="0"))
     assert r.returncode == 0, r.stderr
     assert "paged: ratio 3.5" in r.stderr
 

@@ -29,6 +29,7 @@ from theanompi_tpu.analysis import (
     locks,
     protocol,
     recompile,
+    spanpair,
     step_trace,
     threadstate,
     weightswap,
@@ -38,7 +39,7 @@ from theanompi_tpu.analysis.source import ParsedModule, parse_module
 
 BASELINE_NAME = ".graftlint_baseline.json"
 
-_PER_MODULE_PASSES = (recompile, donation, collectives, weightswap)
+_PER_MODULE_PASSES = (recompile, donation, collectives, weightswap, spanpair)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\-\s]+))?"

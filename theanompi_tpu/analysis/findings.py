@@ -11,7 +11,10 @@ Pass ids: ``recompile`` | ``donation`` | ``collectives`` |
 ``lockorder`` | ``steptrace`` (the interprocedural whole-step pass) |
 ``threadstate`` (GL-T*, unlocked shared-dict mutation) |
 ``protocol`` (GL-P*, distributed-protocol misuse) |
-``weightswap`` (GL-W*, jit-fed param-tree swap discipline).
+``weightswap`` (GL-W*, jit-fed param-tree swap discipline) |
+``spanpair`` (GL-O*, observability lifecycle pairs — a
+``flow_begin``/``request_begin``/``begin_drain`` whose matching end is
+locally used but unreachable from the begin).
 ``FIXABLE_RULES`` names the rules the ``--fix`` rewriter
 (``analysis/fixer.py``) can repair mechanically; ``Finding.fixable``
 surfaces that in both expositions so a human (or CI annotate step)

@@ -1,0 +1,238 @@
+"""Pass — observability lifecycle pairing (GL-O001 ``unpaired-span``).
+
+The request-forensics plane (observability/trace.py) and the serving
+scheduler expose *paired* lifecycle calls: ``flow_begin``/``flow_end``
+arrows, ``request_begin``/``request_end`` tail buffers,
+``begin_drain``/``end_drain`` admission gates, and the
+``enable_request_tracking``/``disable_request_tracking`` master switch.
+A begin with no matching end is not an exception — it is a silent
+leak: the flow arrow never binds, the request buffer pins its events
+until eviction, the scheduler refuses admissions forever.  Exactly the
+failure class a lint catches better than a test, because nothing
+crashes.
+
+The rule is deliberately narrow to stay silent on the two *sanctioned*
+asymmetric shapes this repo relies on:
+
+- **Cross-function pairing** (the normal case): ``FleetRouter.submit``
+  opens the request and the replica's completion path closes it, in a
+  different function.  The pass therefore SELF-CALIBRATES per
+  function: a begin is analyzed only when the SAME function also
+  calls the matching end *on the same receiver* — a function that
+  demonstrably uses the pair discipline locally.
+- **Ownership handoff**: ``submit`` calls ``request_end`` only on the
+  rejection path and intentionally leaves the span open on success
+  (the replica owns it now).  So the pass does NOT flag "some path
+  escapes without the end" — it flags only begins from which NO
+  matching end is reachable on ANY path of the per-function CFG
+  (``analysis/dataflow.py``'s ``build_cfg``, the same lowering the
+  flow-sensitive donation rule uses).  What survives that filter is
+  the copy-paste class: the end issued *before* its begin with no
+  loop back, or begin and end on disjoint branches — a pair that can
+  never close, in a function that visibly meant to close it.
+
+Generic ``start``/``stop`` is deliberately NOT in the pair table: the
+restart idiom (``x.stop(); x.start()``) is legitimate and would be
+indistinguishable from the inverted-order bug.  Ends that only occur
+inside a nested def/lambda (an atexit hook, a finalizer closure) veto
+the receiver entirely — the closure runs at an unknowable time, so the
+pass has nothing sound to say.  Pure stdlib, no jax import, like the
+whole package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from theanompi_tpu.analysis import dataflow
+from theanompi_tpu.analysis.findings import Finding
+from theanompi_tpu.analysis.source import ParsedModule, attr_path
+
+PASS_ID = "spanpair"
+
+# begin call name -> matching end call name.  Matching is per-receiver:
+# `self.sched.begin_drain()` pairs only with `self.sched.end_drain()`.
+PAIRS = {
+    "flow_begin": "flow_end",
+    "request_begin": "request_end",
+    "begin_drain": "end_drain",
+    "enable_request_tracking": "disable_request_tracking",
+}
+_END_NAMES = set(PAIRS.values())
+
+_OPAQUE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _split_call(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(receiver, method) for a Name/Attribute call we can resolve;
+    receiver is the dotted prefix ("" for a bare-name call)."""
+    path = attr_path(call.func)
+    if path is None:
+        return None
+    if "." in path:
+        recv, name = path.rsplit(".", 1)
+    else:
+        recv, name = "", path
+    return recv, name
+
+
+def _walk_calls(root: ast.AST) -> List[ast.Call]:
+    """Every Call under ``root`` WITHOUT descending into nested
+    defs/lambdas/classes (they run when called, not where defined)."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = [root]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _OPAQUE):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _stmt_calls(stmt) -> List[ast.Call]:
+    """Calls a CFG statement evaluates itself.  For a lowered
+    If/For/While/With header that is the guard expression only — the
+    body's statements live in their own blocks already."""
+    if dataflow.is_header(stmt):
+        node = dataflow.header_node(stmt)
+        if isinstance(node, (ast.If, ast.While)):
+            roots: List[ast.AST] = [node.test]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            roots = [node.iter]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            roots = [it.context_expr for it in node.items]
+        else:  # pragma: no cover - future header shapes
+            roots = []
+    else:
+        roots = [stmt]
+    out: List[ast.Call] = []
+    for r in roots:
+        out.extend(_walk_calls(r))
+    return out
+
+
+def _nested_end_receivers(fn_node: ast.AST) -> Set[Tuple[str, str]]:
+    """(receiver, end-name) pairs whose end occurs only inside a
+    nested def/lambda under ``fn_node`` — vetoed receivers."""
+    out: Set[Tuple[str, str]] = set()
+    for stmt in getattr(fn_node, "body", []):
+        for n in ast.walk(stmt):
+            if isinstance(n, _OPAQUE):
+                for inner in ast.walk(n):
+                    if isinstance(inner, ast.Call):
+                        split = _split_call(inner)
+                        if split and split[1] in _END_NAMES:
+                            out.add(split)
+    return out
+
+
+def _end_reachable(
+    cfg: dataflow.CFG,
+    calls_by_stmt: Dict[int, List[List[ast.Call]]],
+    block: int,
+    stmt_idx: int,
+    begin: ast.Call,
+    recv: str,
+    end_name: str,
+) -> bool:
+    """True when a matching end call occurs at-or-after ``begin`` in
+    its own statement, later in its block, or in any CFG-reachable
+    block (back edges included — a loop can carry control back over
+    an earlier end)."""
+
+    def match(call: ast.Call) -> bool:
+        if call is begin:
+            return False
+        split = _split_call(call)
+        return split is not None and split == (recv, end_name)
+
+    stmts = calls_by_stmt[block]
+    if any(match(c) for c in stmts[stmt_idx]):
+        return True
+    for later in stmts[stmt_idx + 1:]:
+        if any(match(c) for c in later):
+            return True
+    seen: Set[int] = set()
+    work = list(cfg.blocks[block].succs)
+    while work:
+        b = work.pop()
+        if b in seen:
+            continue
+        seen.add(b)
+        for stmt in calls_by_stmt.get(b, []):
+            if any(match(c) for c in stmt):
+                return True
+        work.extend(cfg.blocks[b].succs)
+    return False
+
+
+def run(m: ParsedModule) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in m.functions:
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            continue
+        body = getattr(node, "body", None)
+        if not body:
+            continue
+        # flat scan (nested defs excluded): which (recv, end) pairs
+        # does this function itself issue?  Begins only calibrate
+        # against ends on the SAME receiver.
+        ends_present: Set[Tuple[str, str]] = set()
+        has_begin = False
+        for stmt in body:
+            for call in _walk_calls(stmt):
+                split = _split_call(call)
+                if split is None:
+                    continue
+                if split[1] in _END_NAMES:
+                    ends_present.add(split)
+                elif split[1] in PAIRS:
+                    has_begin = True
+        if not has_begin or not ends_present:
+            continue
+        vetoed = _nested_end_receivers(node)
+        cfg = dataflow.build_cfg(body)
+        calls_by_stmt: Dict[int, List[List[ast.Call]]] = {
+            b.id: [_stmt_calls(s) for s in b.stmts] for b in cfg.blocks
+        }
+        for b in cfg.blocks:
+            for idx, calls in enumerate(calls_by_stmt[b.id]):
+                for call in calls:
+                    split = _split_call(call)
+                    if split is None or split[1] not in PAIRS:
+                        continue
+                    recv, name = split
+                    end_name = PAIRS[name]
+                    if (recv, end_name) not in ends_present:
+                        continue  # not calibrated: pair closes elsewhere
+                    if (recv, end_name) in vetoed:
+                        continue  # end escapes into a closure
+                    if _end_reachable(
+                        cfg, calls_by_stmt, b.id, idx, call, recv, end_name
+                    ):
+                        continue
+                    where = f"on {recv!r}" if recv else "at module scope"
+                    out.append(
+                        Finding(
+                            rule="GL-O001",
+                            pass_id=PASS_ID,
+                            severity="warning",
+                            file=m.rel,
+                            line=call.lineno,
+                            symbol=fi.qualname,
+                            message=(
+                                f"{name}() {where} has no reachable "
+                                f"{end_name}() on any path — this function "
+                                f"calls {end_name}() on the same receiver, "
+                                "but never after this begin, so the "
+                                "span/drain it opens can never close "
+                                "(inverted order or disjoint branches)"
+                            ),
+                            snippet=m.snippet(call.lineno),
+                        )
+                    )
+    return out

@@ -62,14 +62,25 @@ from theanompi_tpu.observability.trace import (
     Tracer,
     add_span,
     counter_event,
+    disable_request_tracking,
+    drain_request_digests,
+    enable_request_tracking,
     flow_begin,
     flow_end,
     get_tracer,
     instant,
     merge_raw_traces,
     raw_to_chrome,
+    request_begin,
+    request_end,
+    request_flag,
+    request_mark,
+    request_stats,
+    request_tracking_active,
+    retained_requests,
     span,
     traced,
+    worst_requests,
 )
 
 __all__ = [
@@ -84,8 +95,11 @@ __all__ = [
     "counter_deltas",
     "counter_event",
     "counter_values",
+    "disable_request_tracking",
     "disable_tracing",
+    "drain_request_digests",
     "dump_all",
+    "enable_request_tracking",
     "enable_tracing",
     "flatten_counters",
     "flow_begin",
@@ -98,10 +112,18 @@ __all__ = [
     "percentile",
     "publish_event",
     "raw_to_chrome",
+    "request_begin",
+    "request_end",
+    "request_flag",
+    "request_mark",
+    "request_stats",
+    "request_tracking_active",
+    "retained_requests",
     "set_process",
     "span",
     "subscribe",
     "traced",
+    "worst_requests",
 ]
 
 _EVENTS = get_registry().counter(

@@ -48,7 +48,18 @@ Commands:
   ``--replay`` inputs (kill the primary after ``--kill-primary-after``
   windows; exit 3 = standby never promoted).  Exits 1 when any alert
   fired.
-- ``history list|show|alerts|diff``  query persisted verdict timelines
+- ``requests``                  the REQUEST doctor: phase-attribute the
+  retained (tail) requests in a ``*requests.json`` artifact — worst-N
+  table, p50/p99 attribution rows that sum to the measured latency,
+  aggregate phase fractions.  ``--request RID`` shows one request's
+  full breakdown (also reachable as ``doctor --request RID``).
+  ``--max-queue-frac`` / ``--max-p99-unattributed-frac`` exit 1 on
+  violation — the FORENSICS CI gate.  ``--selftest`` plants a
+  synthetic slow request through a real tracer and verifies the whole
+  pipeline (retention, sampling-proof buffering, queue blame) with no
+  artifacts needed.
+- ``history list|show|alerts|slowest|diff``  query persisted verdict
+  timelines
   (the ``--persist`` / ``THEANOMPI_LIVE_PERSIST`` JSONL files,
   rotation segments read transparently): list runs, one run's
   window-over-window trend table, flattened alerts, and a cross-run
@@ -186,6 +197,14 @@ def _cmd_merge(args) -> int:
 def _cmd_doctor(args) -> int:
     from theanompi_tpu.observability import analysis
 
+    if args.request:
+        # `doctor --request RID` is the request doctor's single-request
+        # view — same loader and renderer as the `requests` subcommand
+        return _cmd_requests(argparse.Namespace(
+            dir=args.dir, input=args.requests, request=args.request,
+            json=args.json, out=args.out, selftest=False, worst=5,
+            max_queue_frac=None, max_p99_unattributed_frac=None,
+        ))
     named, rc = _load_named(args, "diagnose")
     if rc:
         return rc
@@ -214,6 +233,160 @@ def _cmd_doctor(args) -> int:
     )
     for violation in violations:
         print(f"THRESHOLD VIOLATION: {violation}", file=sys.stderr)
+    return 1 if violations else 0
+
+
+def _merge_request_records(doc: dict) -> List[dict]:
+    """One record per rid from a requests.json artifact: the retained
+    (tail) ring plus the worst-latency ring, first occurrence wins
+    (both rings hold the SAME record object at dump time, so the dedupe
+    is exact, not approximate)."""
+    seen: dict = {}
+    for rec in list(doc.get("retained") or []) + \
+            list(doc.get("worst") or []):
+        rid = rec.get("rid")
+        if rid is not None and rid not in seen:
+            seen[rid] = rec
+    return list(seen.values())
+
+
+def _requests_selftest() -> int:
+    """Plant a synthetic slow request through a REAL tracer (fake
+    clock) and verify the whole forensics pipeline end to end: the
+    fast request recycles, the planted-slow one is retained, its
+    breakdown blames the queue, and coverage clears the FORENSICS
+    gate's 0.9 floor.  Zero artifacts needed — this is what the perf
+    gate runs to prove the machinery itself."""
+    from theanompi_tpu.observability import analysis
+    from theanompi_tpu.observability.trace import Tracer
+
+    now = [0.0]
+    tr = Tracer(clock=lambda: now[0], pid=0, process_name="selftest")
+    tr.enable()
+    # sampling ON: retention must be sampling-proof (events route to
+    # the request buffer BEFORE the 1-in-N drop)
+    tr.sample_rate = 1000
+    tr.enable_request_tracking(threshold_s=0.5)
+    # a fast request: recycled, never retained
+    t0 = now[0]
+    tr.request_begin("fast-0")
+    now[0] += 0.010
+    tr.add_span("req_decode", t0, now[0], {"rid": "fast-0"})
+    fast = tr.request_end("fast-0", n_tokens=4)
+    # the planted slow request: ~2 s dominated by queue wait
+    t0 = now[0]
+    tr.request_begin("slow-0", prompt_len=8)
+    now[0] = t0 + 1.6
+    tr.add_span("req_queue", t0, now[0], {"rid": "slow-0"})
+    tq = now[0]
+    now[0] = tq + 0.1
+    tr.add_span("req_prefill", tq, now[0], {"rid": "slow-0"})
+    tr.request_mark("slow-0", "first_token")
+    tp = now[0]
+    now[0] = tp + 0.3
+    tr.add_span("req_decode", tp, now[0], {"rid": "slow-0"})
+    slow = tr.request_end("slow-0", n_tokens=16)
+    failures: List[str] = []
+    if fast is None or fast["retained"]:
+        failures.append("fast request was retained (should recycle)")
+    if slow is None or not slow["retained"]:
+        failures.append("planted slow request was NOT retained")
+    stats = tr.request_stats()
+    if stats["recycled"] != 1 or stats["retained"] != 1:
+        failures.append(f"retention counters wrong: {stats}")
+    row = None
+    for rec in tr.retained_requests():
+        if rec["rid"] == "slow-0":
+            row = analysis.request_breakdown(rec)
+    if row is None:
+        failures.append("slow request missing from the retained ring")
+    else:
+        if row["coverage"] < 0.9:
+            failures.append(
+                f"attribution coverage {row['coverage']:.3f} < 0.9"
+            )
+        dom = max(
+            analysis.REQUEST_PHASES, key=lambda p: row["phases"][p]
+        )
+        if dom != "queue":
+            failures.append(
+                f"dominant phase {dom!r} — expected 'queue' "
+                "(planted 1.6s of queue wait)"
+            )
+        if len(slow["events"]) < 3:
+            failures.append(
+                f"only {len(slow['events'])} events buffered under "
+                "1-in-1000 sampling — retention is not sampling-proof"
+            )
+        sys.stdout.write(analysis.render_request_breakdown(row))
+    for f in failures:
+        print(f"SELFTEST FAILURE: {f}", file=sys.stderr)
+    if not failures:
+        print(
+            "requests selftest: planted slow request retained, "
+            "sampling-proof, blamed on queue",
+            file=sys.stderr,
+        )
+    return 1 if failures else 0
+
+
+def _cmd_requests(args) -> int:
+    from theanompi_tpu.observability import analysis
+
+    if args.selftest:
+        return _requests_selftest()
+    d = _resolve_dir(args)
+    path = args.input or _newest("*requests.json", d)
+    if not path or not os.path.exists(path):
+        print(
+            f"no *requests.json artifact found in {d} (enable request "
+            "tracking — obs.enable_request_tracking() — before "
+            "dump_all, or pass a file)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        doc = analysis.load_requests(path)
+    except (ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    records = _merge_request_records(doc)
+    if args.request:
+        rec = next(
+            (r for r in records if str(r.get("rid")) == args.request),
+            None,
+        )
+        if rec is None:
+            known = ", ".join(
+                str(r.get("rid")) for r in records
+            ) or "none"
+            print(
+                f"request {args.request} not in {path} "
+                f"(retained: {known})",
+                file=sys.stderr,
+            )
+            return 2
+        row = analysis.request_breakdown(rec)
+        if args.json:
+            _write_out(json.dumps(row, indent=2) + "\n", args.out)
+        else:
+            _write_out(analysis.render_request_breakdown(row), args.out)
+        return 0
+    report = analysis.request_report(records)
+    if args.json:
+        _write_out(json.dumps(report, indent=2) + "\n", args.out)
+    else:
+        _write_out(
+            analysis.render_request_report(report, worst=args.worst),
+            args.out,
+        )
+    violations = analysis.check_request_thresholds(
+        report,
+        max_queue_frac=args.max_queue_frac,
+        max_p99_unattributed_frac=args.max_p99_unattributed_frac,
+    )
+    for v in violations:
+        print(f"THRESHOLD VIOLATION: {v['message']}", file=sys.stderr)
     return 1 if violations else 0
 
 
@@ -588,6 +761,25 @@ def _cmd_history_alerts(args) -> int:
     return 0
 
 
+def _cmd_history_slowest(args) -> int:
+    from theanompi_tpu.observability import history
+
+    path = _resolve_timeline(args, args.run)
+    if path is None:
+        return 2
+    verdicts = history.read_timeline(path)
+    try:
+        rows = history.slowest_requests(verdicts, by=args.by, n=args.n)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        sys.stdout.write(json.dumps(rows, indent=2) + "\n")
+    else:
+        sys.stdout.write(history.render_slowest(rows, by=args.by))
+    return 0
+
+
 def _cmd_history_diff(args) -> int:
     from theanompi_tpu.observability import history
 
@@ -721,7 +913,58 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="fail when serving TPOT p99 exceeds this (needs --metrics)",
     )
+    doc.add_argument(
+        "--request", default=None, metavar="RID",
+        help="explain ONE request: phase-attribute its retained trace "
+        "from the *requests.json artifact (the request doctor)",
+    )
+    doc.add_argument(
+        "--requests", default=None, metavar="FILE",
+        help="requests.json artifact for --request (default: newest "
+        "*requests.json in the observability directory)",
+    )
     doc.set_defaults(fn=_cmd_doctor)
+    req = sub.add_parser(
+        "requests",
+        help="request doctor: phase-attribute retained tail requests; "
+        "threshold flags gate CI; --selftest needs no artifacts",
+    )
+    req.add_argument(
+        "input", nargs="?",
+        help="requests.json artifact (default: newest *requests.json "
+        "in the observability directory)",
+    )
+    req.add_argument("--dir", default=None, help="observability directory")
+    req.add_argument(
+        "--out", default=None, help="write here instead of stdout"
+    )
+    req.add_argument(
+        "--request", default=None, metavar="RID",
+        help="show one request's full phase breakdown",
+    )
+    req.add_argument(
+        "--worst", type=int, default=5,
+        help="rows in the worst-requests table (default 5)",
+    )
+    req.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    req.add_argument(
+        "--max-queue-frac", type=float, default=None,
+        help="fail (exit 1) when queueing exceeds this fraction of "
+        "total request latency",
+    )
+    req.add_argument(
+        "--max-p99-unattributed-frac", type=float, default=None,
+        help="fail when the p99 request's unattributed remainder "
+        "exceeds this fraction of its latency",
+    )
+    req.add_argument(
+        "--selftest", action="store_true",
+        help="plant a synthetic slow request through a real tracer "
+        "and verify retention + attribution end to end",
+    )
+    req.set_defaults(fn=_cmd_requests)
     w = sub.add_parser(
         "watch",
         help="live doctor: telemetry aggregator + per-window verdicts "
@@ -872,6 +1115,23 @@ def _build_parser() -> argparse.ArgumentParser:
     ha.add_argument("--dir", default=None, help="observability directory")
     ha.add_argument("--json", action="store_true")
     ha.set_defaults(fn=_cmd_history_alerts)
+    hw = hsub.add_parser(
+        "slowest",
+        help="worst-N requests across a run's verdicts (the retained-"
+        "trace digests the replicas shipped live)",
+    )
+    hw.add_argument("run", help="timeline path or basename in --dir")
+    hw.add_argument("--dir", default=None, help="observability directory")
+    hw.add_argument(
+        "--by", choices=("latency", "ttft", "tpot"), default="latency",
+        help="ranking key (default latency)",
+    )
+    hw.add_argument(
+        "-n", type=int, default=10, dest="n",
+        help="rows to show (default 10)",
+    )
+    hw.add_argument("--json", action="store_true")
+    hw.set_defaults(fn=_cmd_history_slowest)
     hd = hsub.add_parser(
         "diff",
         help="compare two runs; threshold flags exit 1 on regression",

@@ -58,6 +58,18 @@ so a sampled trace cannot flake a CI gate.  ``estimate_clock_offsets``
 recovers per-rank clock skew from the min one-way delay of matched
 flow send/recv pairs — ``merge_raw_traces`` applies it so merged
 timelines line up across hosts.
+
+The **request doctor** (``request_breakdown`` / ``request_report`` /
+``check_request_thresholds``) runs the same interval algebra over ONE
+request's retained span buffer (``Tracer.retained_requests``): every
+microsecond of a slow request's latency is attributed to exactly one
+phase — queue, backpressure, prefill, decode, spec-rollback,
+install-wait, readmission — by priority-ordered interval subtraction,
+so the phase column sums to (at most) the measured latency and the
+remainder is reported honestly as ``unattributed``.  The CLI wrapper
+is ``python -m theanompi_tpu.observability requests`` (and
+``doctor --request RID``); ``--max-queue-frac`` /
+``--max-p99-unattributed-frac`` turn the attribution into CI gates.
 """
 
 from __future__ import annotations
@@ -1466,4 +1478,351 @@ def render_report(report: dict) -> str:
             )
     for w in report.get("warnings", []):
         lines.append(f"WARNING: {w}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the request doctor: one retained request → a phase attribution
+# ---------------------------------------------------------------------------
+
+# the phase taxonomy, in REPORT order.  One definition: the
+# instrumentation sites (scheduler/fleet) emit the ``req_*`` spans,
+# the tracer's tail retention buffers them, and this table is where
+# the agreement on what they MEAN lives.
+REQUEST_PHASES = (
+    "queue",
+    "backpressure",
+    "prefill",
+    "decode",
+    "spec_rollback",
+    "install_wait",
+    "readmission",
+)
+
+# span names contributing to each phase.  ``prefill`` covers both the
+# paged per-lane phase span (``req_prefill``) and the contiguous
+# scheduler's rid-labeled ``prefill`` span (plus the engine dispatch
+# span, which nests inside either — the interval union makes the
+# overlap free).  ``req_spec`` counts as decode wall time; its
+# rolled-back share is carved out scalar-wise below.
+_PHASE_SPANS = {
+    "queue": ("req_queue",),
+    "backpressure": ("req_backpressure",),
+    "prefill": ("req_prefill", "prefill", "prefill_dispatch"),
+    "decode": ("req_decode", "req_spec"),
+    "install_wait": ("req_install_wait",),
+    "readmission": ("req_readmit",),
+}
+
+# attribution priority, highest first: when two phases overlap in wall
+# time (a backpressure stall measured while the lane also sat queued,
+# an install wait spanning a decode tick) the HIGHER-priority phase
+# keeps the overlap and the lower one is clipped around it — every
+# microsecond lands in exactly one phase, so the columns sum to at
+# most the measured latency instead of double-counting.  Rarer,
+# more-actionable causes outrank the steady-state ones.
+_PHASE_PRIORITY = (
+    "readmission",
+    "install_wait",
+    "backpressure",
+    "prefill",
+    "decode",
+    "queue",
+)
+
+
+def request_breakdown(record: dict) -> dict:
+    """One retained request record (``Tracer.retained_requests`` /
+    ``worst_requests`` element) → its phase attribution.
+
+    Pure interval math over the buffered spans, clipped to the
+    request's own ``[t_start_us, t_end_us]`` window and assigned by
+    ``_PHASE_PRIORITY`` subtraction (``merge_intervals`` /
+    ``intersect_total`` — the same primitives the rank doctor runs).
+    ``spec_rollback`` is then carved scalar-wise out of decode: each
+    ``req_spec`` span donates ``dur × rolled_back / max(1, proposed)``
+    — the share of the round's wall time spent verifying proposals the
+    target rejected.  Returns phase seconds, the unattributed
+    remainder, and ``coverage`` (attributed / latency) — the number
+    the FORENSICS perf-gate leg pins ≥ 0.9."""
+    t0 = float(record.get("t_start_us", 0.0))
+    t1 = float(record.get("t_end_us", t0))
+    events = record.get("events") or []
+    spans = [ev for ev in events if ev.get("ph") == "X"]
+
+    def _clipped(names: Tuple[str, ...]) -> List[Tuple[float, float]]:
+        wanted = set(names)
+        ivs: List[Tuple[float, float]] = []
+        for s in spans:
+            if s.get("name") not in wanted:
+                continue
+            a = float(s.get("ts", 0.0))
+            b = a + float(s.get("dur", 0.0))
+            a, b = max(a, t0), min(b, t1)
+            if b > a:
+                ivs.append((a, b))
+        return merge_intervals(ivs)
+
+    phases = {p: 0.0 for p in REQUEST_PHASES}
+    assigned: List[Tuple[float, float]] = []
+    for phase in _PHASE_PRIORITY:
+        iv = _clipped(_PHASE_SPANS[phase])
+        phases[phase] = (total(iv) - intersect_total(iv, assigned)) / 1e6
+        assigned = merge_intervals(assigned + iv)
+
+    rollback_us = 0.0
+    for s in spans:
+        if s.get("name") != "req_spec":
+            continue
+        args = s.get("args") or {}
+        proposed = float(args.get("proposed", 0) or 0)
+        rolled = float(args.get("rolled_back", 0) or 0)
+        if rolled > 0:
+            rollback_us += (
+                float(s.get("dur", 0.0)) * rolled / max(1.0, proposed)
+            )
+    # the carve can never exceed what decode actually owns after the
+    # priority subtraction (a rollback share of time clipped away by a
+    # higher-priority phase is already attributed there)
+    rollback_s = min(rollback_us / 1e6, phases["decode"])
+    phases["spec_rollback"] = rollback_s
+    phases["decode"] -= rollback_s
+
+    latency = float(record.get("latency_s", max(0.0, (t1 - t0) / 1e6)))
+    attributed = sum(phases.values())
+    unattributed = max(0.0, latency - attributed)
+    out = {
+        "rid": record.get("rid"),
+        "status": record.get("status", "ok"),
+        "flags": list(record.get("flags") or []),
+        "latency_s": latency,
+        "phases": dict(phases),
+        "attributed_s": attributed,
+        "unattributed_s": unattributed,
+        "coverage": (
+            min(1.0, attributed / latency) if latency > 0 else 1.0
+        ),
+        "n_events": len(events),
+        "truncated": int(record.get("truncated", 0)),
+    }
+    if "n_tokens" in record:
+        out["n_tokens"] = record["n_tokens"]
+    for mark in record.get("marks") or []:
+        if mark.get("name") == "first_token":
+            out["ttft_s"] = max(
+                0.0, (float(mark.get("ts", t0)) - t0) / 1e6
+            )
+            break
+    return _round_floats(out)
+
+
+def request_report(records: Iterable[dict]) -> dict:
+    """Fleet-level view over many retained requests: per-request rows
+    (worst-first), aggregate phase fractions, and the p50/p99 request
+    breakdowns — the phase-attribution table the ISSUE's doctor
+    prints.  ``p50``/``p99`` are the breakdowns of the requests AT
+    those latency ranks (nearest-rank, same estimator as the rank
+    doctor), not an average: an attribution table that sums to one
+    real request's measured latency, not to a synthetic blend."""
+    rows = [request_breakdown(r) for r in records]
+    by_lat = sorted(rows, key=lambda r: r["latency_s"])
+    out: dict = {
+        "n_requests": len(rows),
+        "requests": sorted(rows, key=lambda r: -r["latency_s"]),
+    }
+    total_lat = sum(r["latency_s"] for r in rows)
+    totals = {
+        p: sum(r["phases"][p] for r in rows) for p in REQUEST_PHASES
+    }
+    out["phase_totals_s"] = totals
+    out["phase_fractions"] = {
+        p: (totals[p] / total_lat if total_lat > 0 else 0.0)
+        for p in REQUEST_PHASES
+    }
+    out["unattributed_s"] = sum(r["unattributed_s"] for r in rows)
+    out["unattributed_frac"] = (
+        out["unattributed_s"] / total_lat if total_lat > 0 else 0.0
+    )
+    if by_lat:
+        for pct, key in ((50, "p50"), (99, "p99")):
+            k = max(
+                0,
+                min(
+                    len(by_lat) - 1,
+                    int(round(pct / 100.0 * (len(by_lat) - 1))),
+                ),
+            )
+            row = by_lat[k]
+            out[key] = {
+                "rid": row["rid"],
+                "latency_s": row["latency_s"],
+                "phases": dict(row["phases"]),
+                "unattributed_s": row["unattributed_s"],
+                "coverage": row["coverage"],
+            }
+    return _round_floats(out)
+
+
+def check_request_thresholds(
+    report: dict,
+    max_queue_frac: Optional[float] = None,
+    max_p99_unattributed_frac: Optional[float] = None,
+) -> List[dict]:
+    """Request-attribution violations as structured rows (same shape
+    as ``check_thresholds_structured``; empty = healthy).
+
+    ``max_queue_frac`` gates the AGGREGATE queue share of total
+    request latency — the capacity signal (requests spending their
+    lives queued means the fleet is undersized, not slow).
+    ``max_p99_unattributed_frac`` gates the p99 request's unexplained
+    remainder — the doctor's own honesty check: a tail request whose
+    latency the phases cannot explain means an instrumentation gap,
+    and the gate fails instead of shrugging."""
+    v: List[dict] = []
+    if max_queue_frac is not None:
+        qf = float(
+            (report.get("phase_fractions") or {}).get("queue", 0.0)
+        )
+        if qf > max_queue_frac:
+            v.append({
+                "rule": "max_queue_frac", "rank": None, "value": qf,
+                "threshold": max_queue_frac,
+                "message": (
+                    f"queue fraction {qf:.4f} > {max_queue_frac} of "
+                    "total request latency — admission-bound fleet"
+                ),
+            })
+    if max_p99_unattributed_frac is not None:
+        p99 = report.get("p99")
+        if p99 and p99.get("latency_s", 0.0) > 0:
+            uf = float(p99["unattributed_s"]) / float(p99["latency_s"])
+            if uf > max_p99_unattributed_frac:
+                v.append({
+                    "rule": "max_p99_unattributed_frac",
+                    "rank": p99.get("rid"), "value": uf,
+                    "threshold": max_p99_unattributed_frac,
+                    "message": (
+                        f"p99 request {p99.get('rid')}: "
+                        f"{100 * uf:.1f}% of its "
+                        f"{p99['latency_s']:.4f}s latency is "
+                        "unattributed > "
+                        f"{100 * max_p99_unattributed_frac:.1f}% — "
+                        "instrumentation gap in the phase taxonomy"
+                    ),
+                })
+    return v
+
+
+def load_requests(path) -> dict:
+    """Parse a ``*requests.json`` artifact (``export.dump_all``'s
+    request-forensics document).  Refuses anything that is not one —
+    pointing the request doctor at a metrics snapshot should say so,
+    not render an empty table."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or doc.get("kind") != "tmpi_requests":
+        raise ValueError(
+            f"{path}: not a request-forensics artifact (kind="
+            f"{doc.get('kind') if isinstance(doc, dict) else type(doc).__name__!r})"
+        )
+    return doc
+
+
+def _ms(x: float) -> str:
+    return f"{x * 1e3:9.2f}"
+
+
+def render_request_breakdown(row: dict) -> str:
+    """One request's attribution as a human table — the
+    ``doctor --request RID`` view."""
+    lines: List[str] = []
+    flags = (
+        " [" + ",".join(row["flags"]) + "]" if row.get("flags") else ""
+    )
+    lines.append(
+        f"request {row.get('rid')}  status={row.get('status')}{flags}"
+    )
+    lines.append(
+        f"  latency {row['latency_s'] * 1e3:.2f} ms"
+        + (
+            f"  ttft {row['ttft_s'] * 1e3:.2f} ms"
+            if "ttft_s" in row else ""
+        )
+        + (
+            f"  tokens {row['n_tokens']}" if "n_tokens" in row else ""
+        )
+    )
+    lines.append(f"  {'phase':<14} {'ms':>9} {'share':>7}")
+    lat = row["latency_s"] or 1e-12
+    for p in REQUEST_PHASES:
+        s = row["phases"][p]
+        if s <= 0:
+            continue
+        lines.append(f"  {p:<14} {_ms(s)} {100 * s / lat:6.1f}%")
+    lines.append(
+        f"  {'unattributed':<14} {_ms(row['unattributed_s'])} "
+        f"{100 * row['unattributed_s'] / lat:6.1f}%"
+    )
+    lines.append(
+        f"  coverage {100 * row['coverage']:.1f}% over "
+        f"{row['n_events']} events"
+        + (
+            f" (TRUNCATED: {row['truncated']} dropped)"
+            if row.get("truncated") else ""
+        )
+    )
+    return "\n".join(lines) + "\n"
+
+
+def render_request_report(report: dict, worst: int = 5) -> str:
+    """The fleet table: worst-``worst`` requests with their dominant
+    phase, then the p50/p99 attribution rows, then aggregate phase
+    fractions."""
+    lines: List[str] = []
+    n = report.get("n_requests", 0)
+    lines.append(f"retained requests: {n}")
+    if not n:
+        return lines[0] + "\n"
+    hdr = (
+        f"  {'rid':<14} {'status':<9} {'latency ms':>10} "
+        f"{'dominant phase':<16} {'coverage':>8}"
+    )
+    lines.append(hdr)
+    lines.append("  " + "-" * (len(hdr) - 2))
+    for row in report["requests"][: max(0, int(worst))]:
+        dom = max(REQUEST_PHASES, key=lambda p: row["phases"][p])
+        if row["unattributed_s"] > row["phases"][dom]:
+            dom = "unattributed"
+        flags = "!" if row.get("flags") else " "
+        lines.append(
+            f"  {str(row.get('rid')):<14} {row['status']:<9}"
+            f"{flags}{row['latency_s'] * 1e3:>9.2f} {dom:<16} "
+            f"{100 * row['coverage']:>7.1f}%"
+        )
+    for key in ("p50", "p99"):
+        pr = report.get(key)
+        if not pr:
+            continue
+        parts = [
+            f"{p} {pr['phases'][p] * 1e3:.1f}ms"
+            for p in REQUEST_PHASES
+            if pr["phases"][p] > 0
+        ]
+        if pr["unattributed_s"] > 0:
+            parts.append(f"unattributed {pr['unattributed_s'] * 1e3:.1f}ms")
+        lines.append(
+            f"{key} ({pr['rid']}, {pr['latency_s'] * 1e3:.2f} ms): "
+            + (", ".join(parts) if parts else "no attributed time")
+        )
+    fr = report.get("phase_fractions") or {}
+    shares = [
+        f"{p} {100 * fr[p]:.1f}%" for p in REQUEST_PHASES
+        if fr.get(p, 0.0) > 0.0005
+    ]
+    if report.get("unattributed_frac", 0.0) > 0.0005:
+        shares.append(
+            f"unattributed {100 * report['unattributed_frac']:.1f}%"
+        )
+    if shares:
+        lines.append("fleet latency shares: " + ", ".join(shares))
     return "\n".join(lines) + "\n"

@@ -93,6 +93,27 @@ def dump_all(
     with open(out["flight"], "w", encoding="utf-8") as f:
         json.dump(get_flight_recorder().snapshot(), f, default=str)
         f.write("\n")
+    # request forensics ride along when the tracer tracked any: the
+    # retained (tail) buffers + the worst-latency ring, the document
+    # `observability requests` / `doctor --request` reads offline.
+    # Written only when there is something to say — a run without
+    # request tracking keeps its artifact set unchanged.
+    stats = tracer.request_stats()
+    if stats.get("tracked"):
+        req_path = os.path.join(d, f"{prefix}requests.json")
+        with open(req_path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "kind": "tmpi_requests",
+                    "stats": stats,
+                    "retained": tracer.retained_requests(),
+                    "worst": tracer.worst_requests(),
+                },
+                f,
+                default=str,
+            )
+            f.write("\n")
+        out["requests"] = req_path
     # self-diagnosis rides every export: the doctor's report over this
     # process's own raw trace + metrics snapshot, so a bench/crash
     # artifact dir answers "was the run healthy" without another tool

@@ -172,6 +172,72 @@ def summarize(verdicts: List[dict]) -> dict:
     return out
 
 
+# how `history slowest` ranks request digests: CLI key → digest field
+_SLOWEST_KEYS = {
+    "latency": "latency_s",
+    "ttft": "ttft_s",
+    "tpot": "tpot_s",
+}
+
+
+def slowest_requests(
+    verdicts: List[dict], by: str = "latency", n: int = 10
+) -> List[dict]:
+    """Worst-``n`` requests across a run's persisted verdicts.
+
+    Each verdict may carry ``slow_requests`` — the retained-trace
+    digests the replicas shipped over the live plane that window
+    (``Tracer.drain_request_digests`` → aggregator → verdict).  A
+    request finishing near a window boundary (or re-shipped after a
+    failover replay) can appear in several windows; entries dedupe by
+    rid keeping the WORST observation under the ranking key, so a
+    request is one row no matter how many windows saw it.  ``by`` is
+    one of ``latency``/``ttft``/``tpot``; digests missing the key rank
+    last, not crash."""
+    key = _SLOWEST_KEYS.get(by)
+    if key is None:
+        raise ValueError(
+            f"unknown ranking {by!r} (one of: "
+            f"{', '.join(sorted(_SLOWEST_KEYS))})"
+        )
+    best: dict = {}
+    for v in verdicts:
+        for d in v.get("slow_requests") or []:
+            if not isinstance(d, dict) or d.get("rid") is None:
+                continue
+            row = {**d, "window": v.get("window")}
+            rid = row["rid"]
+            prev = best.get(rid)
+            if prev is None or float(row.get(key) or 0.0) > \
+                    float(prev.get(key) or 0.0):
+                best[rid] = row
+    rows = sorted(
+        best.values(), key=lambda r: -float(r.get(key) or 0.0)
+    )
+    return rows[: max(0, int(n))]
+
+
+def render_slowest(rows: List[dict], by: str = "latency") -> str:
+    hdr = (
+        f"{'rid':<16} {'window':>6} {'status':<9} {'latency ms':>10} "
+        f"{'ttft ms':>8} {'dominant phase':<16} {'flags'}"
+    )
+    lines = [f"slowest requests (by {by}):", hdr, "-" * len(hdr)]
+    for r in rows:
+        phases = r.get("phases") or {}
+        dom = max(phases, key=phases.get) if phases else "-"
+        ttft = r.get("ttft_s")
+        lines.append(
+            f"{str(r.get('rid')):<16} {str(r.get('window')):>6} "
+            f"{str(r.get('status')):<9} "
+            f"{float(r.get('latency_s') or 0.0) * 1e3:>10.2f} "
+            f"{(float(ttft) * 1e3 if ttft is not None else float('nan')):>8.2f} "
+            f"{dom:<16} {','.join(r.get('flags') or []) or '-'}"
+        )
+    lines.append(f"{len(rows)} request(s)")
+    return "\n".join(lines) + "\n"
+
+
 # the rows `history diff` compares: (key path in the summary, label,
 # direction) — direction "low" means lower is better (an increase can
 # regress), "high" means higher is better (a drop can regress)

@@ -565,7 +565,14 @@ class TelemetryShipper:
                     "counts": _seq_f64(delta),
                 }
         self.seq += 1
-        return {
+        # finished-request digests (tail forensics): the tracer's
+        # pending ring drains into the frame, so a retained slow
+        # request's compact summary reaches the aggregator within one
+        # shipping period of finishing.  Additive key — old
+        # aggregators ignore it.
+        drain = getattr(self.tracer, "drain_request_digests", None)
+        digests = drain() if drain is not None else []
+        frame_doc = {
             "kind": FRAME_KIND,
             "v": FRAME_VERSION,
             "rank": self.rank_label,
@@ -593,6 +600,9 @@ class TelemetryShipper:
             "counters": deltas,
             "hist": hist,
         }
+        if digests:
+            frame_doc["req_digests"] = digests
+        return frame_doc
 
 
 # ---------------------------------------------------------------------------
@@ -803,6 +813,12 @@ class Aggregator:
             self.view[str(label)] = _RankView()
         # per-window SLO histogram sums (metric -> (bounds, counts))
         self._win_hist: Dict[str, Tuple[List[float], List[int]]] = {}
+        # request tail forensics: digests shipped this window (drained
+        # into the verdict's ``slow_requests``) + the run's bounded
+        # worst-offenders ring (any window, slowest first)
+        self._win_slow: List[dict] = []
+        self._slow_worst: List[dict] = []
+        self.slow_worst_cap = 32
         # clock skew: min one-way delay per (src_label, dst_label) from
         # flow halves; either half can arrive first (frames interleave
         # across ranks), so both await their counterpart symmetrically
@@ -857,6 +873,17 @@ class Aggregator:
                 rv.counters[k] = rv.counters.get(k, 0.0) + float(v)
             self._ingest_events(label, frame)
             self._ingest_hist(frame)
+            for d in frame.get("req_digests") or []:
+                if not isinstance(d, dict) or d.get("rid") is None:
+                    continue
+                row = {**d, "rank": label}
+                self._win_slow.append(row)
+                del self._win_slow[:-256]
+                self._slow_worst.append(row)
+                self._slow_worst.sort(
+                    key=lambda r: -float(r.get("latency_s") or 0.0)
+                )
+                del self._slow_worst[self.slow_worst_cap:]
         _FRAMES.inc(direction="ingested")
         _AGG_FRAMES.inc(name=self.name, rank=label)
         # shadow feed: the standby sees exactly what the primary saw.
@@ -993,6 +1020,13 @@ class Aggregator:
                     bounds, [a + b for a, b in zip(cur[1], counts)]
                 )
 
+    def slowest_requests(self) -> List[dict]:
+        """The run's worst-offender request digests (slowest first,
+        bounded at ``slow_worst_cap``) — every digest any replica
+        shipped, regardless of which window it landed in."""
+        with self._lock:
+            return list(self._slow_worst)
+
     # ---- windowing ---------------------------------------------------
     def dead_ranks(self, now: Optional[float] = None) -> List[str]:
         now = self.clock() if now is None else now
@@ -1033,6 +1067,16 @@ class Aggregator:
             self._win_hist = {}
             if serving:
                 verdict["serving"] = serving
+            if self._win_slow:
+                # worst-first; the verdict carries the window's top
+                # offenders, the full run's worst ring stays queryable
+                # via slowest_requests()
+                slow = sorted(
+                    self._win_slow,
+                    key=lambda r: -float(r.get("latency_s") or 0.0),
+                )
+                verdict["slow_requests"] = slow[:16]
+                self._win_slow = []
             if self._edges:
                 offsets, unaligned = analysis.offsets_from_edges(
                     self._edges, list(self.view)

@@ -41,6 +41,18 @@ Contracts:
   and counter events are never sampled — pairing and gauge crossings
   must survive sampling.  Sampled-out spans are counted
   (``sampled_out``), never silent.
+- **Tail-based request retention** — ``enable_request_tracking``
+  opens a per-request buffer per ``request_begin(rid)``; every event
+  whose args carry that ``rid`` (or whose flow id starts ``req:{rid}``)
+  is routed into it BEFORE the 1-in-N sampling drop, so a retained
+  request's story is never holey.  ``request_end`` keeps the buffer
+  only when the request breached the latency threshold or was flagged
+  (killed / readmitted / lost) and cheaply recycles it otherwise; a
+  small worst-latency ring survives regardless of threshold so a
+  green run still has its slowest request to explain.  Finished
+  request digests queue for the live telemetry plane
+  (``drain_request_digests``) — the aggregator's fleet-wide
+  worst-offenders feed.
 """
 
 from __future__ import annotations
@@ -138,6 +150,19 @@ class Tracer:
         # — the live telemetry shipper's feed; same outside-the-lock
         # contract as span_sinks
         self.point_sinks: List[Callable[[dict], None]] = []
+        # ---- tail-based per-request retention (off until
+        # enable_request_tracking) -----------------------------------
+        self._req_tracking = False
+        self._req_threshold_s = float("inf")
+        self._req_max_events = 512
+        self._req_worst_cap = 8
+        self._req_open: Dict[str, dict] = {}  # rid -> open record
+        self._req_retained: deque = deque(maxlen=64)
+        self._req_worst: List[dict] = []  # worst-latency ring (any status)
+        self._req_digests: List[dict] = []  # pending live-plane digests
+        self.req_tracked = 0
+        self.req_retained_total = 0
+        self.req_recycled = 0
 
     # ---- lifecycle -----------------------------------------------------
     def enable(
@@ -168,6 +193,243 @@ class Tracer:
         self.pid = int(pid)
         if name is not None:
             self.process_name = name
+
+    # ---- per-request tail retention ------------------------------------
+    def enable_request_tracking(
+        self,
+        threshold_s: float = 1.0,
+        capacity: int = 64,
+        max_events: int = 512,
+        worst: int = 8,
+    ) -> None:
+        """Start tail-based per-request span retention.  A finished
+        request is KEPT when its latency breaches ``threshold_s`` or it
+        carries flags (readmitted / lost / killed), recycled otherwise;
+        the ``worst`` lowest-latency-breakers ring keeps the slowest
+        requests regardless, so a green run can still explain its p99.
+        ``capacity`` bounds the retained ring, ``max_events`` the
+        per-request buffer (overflow counted, never silent)."""
+        with self._lock:
+            self._req_tracking = True
+            self._req_threshold_s = float(threshold_s)
+            self._req_max_events = int(max_events)
+            self._req_worst_cap = max(1, int(worst))
+            self._req_retained = deque(
+                self._req_retained, maxlen=max(1, int(capacity))
+            )
+
+    def disable_request_tracking(self) -> None:
+        """Stop tracking and drop all per-request state (open buffers,
+        retained ring, worst ring, pending digests, counters)."""
+        with self._lock:
+            self._req_tracking = False
+            self._req_open.clear()
+            self._req_retained.clear()
+            self._req_worst = []
+            self._req_digests = []
+            self.req_tracked = 0
+            self.req_retained_total = 0
+            self.req_recycled = 0
+
+    @property
+    def request_tracking(self) -> bool:
+        return self.enabled and self._req_tracking
+
+    def request_begin(self, rid: str, **meta) -> None:
+        """Open a per-request buffer.  IDEMPOTENT: a second begin for an
+        open rid is a no-op, so the fleet router (which mints the id)
+        and the replica scheduler (which sees the same id later, and is
+        the only opener in router-less runs) can both call it."""
+        if not (self.enabled and self._req_tracking):
+            return
+        rid = str(rid)
+        with self._lock:
+            if rid in self._req_open:
+                return
+            self._req_open[rid] = {
+                "rid": rid,
+                "t0": self.clock(),
+                "meta": meta,
+                "events": [],
+                "flags": [],
+                "marks": [],
+                "truncated": 0,
+            }
+            self.req_tracked += 1
+
+    def request_flag(self, rid: str, flag: str) -> None:
+        """Mark an open request for unconditional retention (e.g.
+        ``readmitted``, ``lost``) — flags beat the latency threshold."""
+        if not (self.enabled and self._req_tracking):
+            return
+        with self._lock:
+            rec = self._req_open.get(str(rid))
+            if rec is not None and flag not in rec["flags"]:
+                rec["flags"].append(str(flag))
+
+    def request_mark(self, rid: str, name: str) -> None:
+        """Stamp one named point on an open request's own clock (e.g.
+        ``first_token`` — the TTFT anchor in its digest)."""
+        if not (self.enabled and self._req_tracking):
+            return
+        with self._lock:
+            rec = self._req_open.get(str(rid))
+            if rec is not None:
+                rec["marks"].append(
+                    {"name": str(name), "ts": self._us(self.clock())}
+                )
+
+    def request_end(
+        self, rid: str, status: str = "ok", **extra
+    ) -> Optional[dict]:
+        """Close an open request and decide retention.  No-op (None)
+        for unknown/already-closed rids.  Returns the finished record;
+        whether it was retained is ``record["retained"]``."""
+        if not self._req_tracking:
+            return None
+        rid = str(rid)
+        with self._lock:
+            rec = self._req_open.pop(rid, None)
+            if rec is None:
+                return None
+            t1 = self.clock()
+            latency = t1 - rec["t0"]
+            keep = (
+                bool(rec["flags"])
+                or status != "ok"
+                or latency >= self._req_threshold_s
+            )
+            out = {
+                "rid": rid,
+                "status": str(status),
+                "latency_s": round(latency, 9),
+                "t_start_us": self._us(rec["t0"]),
+                "t_end_us": self._us(t1),
+                "flags": list(rec["flags"]),
+                "meta": rec["meta"],
+                "marks": rec["marks"],
+                "events": rec["events"],
+                "truncated": rec["truncated"],
+                "retained": keep,
+            }
+            if extra:
+                out.update(extra)
+            if keep:
+                self._req_retained.append(out)
+                self.req_retained_total += 1
+            else:
+                self.req_recycled += 1
+            # worst-latency ring: kept regardless of threshold so the
+            # slowest request of a green run is still explainable
+            self._req_worst.append(out)
+            self._req_worst.sort(
+                key=lambda r: r["latency_s"], reverse=True
+            )
+            del self._req_worst[self._req_worst_cap:]
+            self._req_digests.append(self._digest_locked(out))
+            del self._req_digests[:-256]
+        # one top-level span per finished request: the merged-trace row
+        # the per-phase children nest under (rid popped above, so this
+        # span is not routed back into the buffer)
+        self.add_span(
+            "request", rec["t0"], t1,
+            {"rid": rid, "status": status,
+             "retained": keep, **({"flags": out["flags"]}
+                                  if out["flags"] else {})},
+        )
+        return out
+
+    def _digest_locked(self, out: dict) -> dict:
+        """Compact live-plane summary of one finished request: latency,
+        TTFT (from the ``first_token`` mark), coarse per-phase sums by
+        ``req_*`` span name.  The real interval math lives in
+        ``analysis.request_breakdown`` — this is the cheap wire form."""
+        phases: Dict[str, float] = {}
+        for ev in out["events"]:
+            name = ev.get("name", "")
+            if ev.get("ph") == "X" and name.startswith("req_"):
+                phases[name[4:]] = round(
+                    phases.get(name[4:], 0.0)
+                    + float(ev.get("dur", 0.0)) / 1e6, 9,
+                )
+        d = {
+            "rid": out["rid"],
+            "status": out["status"],
+            "latency_s": out["latency_s"],
+            "flags": out["flags"],
+            "retained": out["retained"],
+            "n_events": len(out["events"]),
+            "phases": phases,
+        }
+        for m in out["marks"]:
+            if m["name"] == "first_token":
+                d["ttft_s"] = round(
+                    (m["ts"] - out["t_start_us"]) / 1e6, 9
+                )
+                break
+        n_tokens = out.get("n_tokens")
+        if n_tokens is not None:
+            d["n_tokens"] = int(n_tokens)
+            if "ttft_s" in d and n_tokens > 1:
+                d["tpot_s"] = round(
+                    (out["latency_s"] - d["ttft_s"]) / (n_tokens - 1), 9
+                )
+        return d
+
+    def retained_requests(self) -> List[dict]:
+        with self._lock:
+            return list(self._req_retained)
+
+    def worst_requests(self) -> List[dict]:
+        """The worst-latency ring, slowest first (retained or not)."""
+        with self._lock:
+            return list(self._req_worst)
+
+    def request_stats(self) -> dict:
+        with self._lock:
+            return {
+                "tracking": self._req_tracking,
+                "threshold_s": self._req_threshold_s,
+                "tracked": self.req_tracked,
+                "retained": self.req_retained_total,
+                "recycled": self.req_recycled,
+                "open": len(self._req_open),
+                "retained_held": len(self._req_retained),
+            }
+
+    def drain_request_digests(self) -> List[dict]:
+        """Hand off (and clear) the pending finished-request digests —
+        the live telemetry shipper's per-frame feed."""
+        with self._lock:
+            out, self._req_digests = self._req_digests, []
+            return out
+
+    def _route_request_locked(self, ev: dict) -> None:
+        """File ``ev`` into the per-request buffer(s) its args' ``rid``
+        (or its ``req:{rid}`` flow id) names.  Runs BEFORE the sampling
+        drop in ``add_span`` — a retained request's trace is complete
+        even under 1-in-N sampling.  ``rid="*"`` broadcasts to every
+        open request (install waits stall whoever is in flight)."""
+        args = ev.get("args")
+        rid = args.get("rid") if args else None
+        if rid is None and ev.get("cat") == "flow":
+            fid = str(ev.get("id", ""))
+            if fid.startswith("req:"):
+                rid = fid.split(":", 2)[1]
+        if rid is None:
+            return
+        if rid == "*":
+            recs = self._req_open.values()
+        else:
+            rec = self._req_open.get(str(rid))
+            if rec is None:
+                return
+            recs = (rec,)
+        for rec in recs:
+            if len(rec["events"]) >= self._req_max_events:
+                rec["truncated"] += 1
+            else:
+                rec["events"].append(ev)
 
     # ---- recording -----------------------------------------------------
     def _track_locked(self) -> int:
@@ -208,6 +470,10 @@ class Tracer:
             ev["args"] = args
         with self._lock:
             tid = ev["tid"] = self._track_locked()
+            if self._req_tracking:
+                # request buffers fill BEFORE the sampling drop: a
+                # tail-retained request's story must never be holey
+                self._route_request_locked(ev)
             if self.sample_rate > 1:
                 seq = self._span_seq.get(tid, 0)
                 self._span_seq[tid] = seq + 1
@@ -236,6 +502,8 @@ class Tracer:
             ev["args"] = args
         with self._lock:
             ev["tid"] = self._track_locked()
+            if self._req_tracking:
+                self._route_request_locked(ev)
             self._push_locked(ev)
 
     def _point_event(self, ev: dict, args: Optional[dict]) -> None:
@@ -243,6 +511,8 @@ class Tracer:
             ev["args"] = args
         with self._lock:
             ev["tid"] = self._track_locked()
+            if self._req_tracking:
+                self._route_request_locked(ev)
             self._push_locked(ev)
         for sink in self.point_sinks:
             sink(ev)
@@ -659,6 +929,59 @@ def counter_event(name: str, value: float, **series) -> None:
 
 def add_span(name: str, start: float, end: float, args=None) -> None:
     _TRACER.add_span(name, start, end, args)
+
+
+def enable_request_tracking(
+    threshold_s: float = 1.0,
+    capacity: int = 64,
+    max_events: int = 512,
+    worst: int = 8,
+) -> None:
+    _TRACER.enable_request_tracking(
+        threshold_s, capacity=capacity, max_events=max_events, worst=worst
+    )
+
+
+def disable_request_tracking() -> None:
+    _TRACER.disable_request_tracking()
+
+
+def request_tracking_active() -> bool:
+    """Cheap gate for request-phase instrumentation call sites."""
+    t = _TRACER
+    return t.enabled and t._req_tracking
+
+
+def request_begin(rid: str, **meta) -> None:
+    _TRACER.request_begin(rid, **meta)
+
+
+def request_flag(rid: str, flag: str) -> None:
+    _TRACER.request_flag(rid, flag)
+
+
+def request_mark(rid: str, name: str) -> None:
+    _TRACER.request_mark(rid, name)
+
+
+def request_end(rid: str, status: str = "ok", **extra) -> Optional[dict]:
+    return _TRACER.request_end(rid, status=status, **extra)
+
+
+def retained_requests() -> List[dict]:
+    return _TRACER.retained_requests()
+
+
+def worst_requests() -> List[dict]:
+    return _TRACER.worst_requests()
+
+
+def request_stats() -> dict:
+    return _TRACER.request_stats()
+
+
+def drain_request_digests() -> List[dict]:
+    return _TRACER.drain_request_digests()
 
 
 def traced(name: Optional[str] = None):

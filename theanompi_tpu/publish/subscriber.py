@@ -179,7 +179,20 @@ class WeightSubscriber:
             raise
         prior = self.replica.scheduler.params
         prior_gen = self.replica.serving_generation
-        self.replica.install_params(params, generation)
+        with obs.span(
+            "publish_install", replica=self.replica.name,
+            generation=generation,
+        ):
+            self.replica.install_params(params, generation)
+        if self.replica.pending_generation == generation:
+            # the replica was busy: the swap is queued for its next
+            # idle gap (or a forced drain) — the deferral is a visible
+            # trace instant, not silence, so a slow rollout is
+            # attributable from the trace alone
+            obs.instant(
+                "publish_install_deferred",
+                {"replica": self.replica.name, "generation": generation},
+            )
         self.installs += 1
         _INSTALLS.inc(replica=self.replica.name)
         self._prior_params = prior

@@ -16,6 +16,12 @@ through the ordinary prefill path), and p99 TTFT/TPOT within
 tolerance of the uninterrupted run.  The fleet is in-process
 (``serving/fleet.py`` replicas are threads behind the same protocol a
 TCP replica serves), so the drill is deterministic and CI-sized.
+As of ISSUE 20 the chaos phase also runs under request-forensics
+tracking: the killed stream's retained trace must tell the failover as
+ONE causal tree — queue -> prefill -> decode -> ``req_readmit`` (with
+its fresh flow arrow) -> decode on the survivor
+(:func:`check_readmit_trace`); a re-admission whose trace lost the
+story is a violation.
 
 **Elastic BSP drill** (``--rule BSP``, ISSUE 13 — the perf_gate BSP
 leg): kill one rank of a synchronous data-parallel fleet mid-run.
@@ -287,6 +293,109 @@ SERVE_CONFIG = {
 }
 
 
+def check_readmit_trace(record: dict) -> dict:
+    """Verify a killed stream's retained trace tells the whole story
+    as ONE causal tree: queue -> prefill -> decode (on the victim) ->
+    the ``req_readmit`` hop (with its fresh flow arrow) -> decode again
+    (on the survivor).  A stream killed before it produced any token
+    (the hop's ``journaled`` arg is 0) legitimately has no victim-side
+    phases; for those only the survivor-side chain is required.
+    Returns ``{"ok": bool, "full_tree": bool, "missing": [...],
+    "order": [...]}`` — importable so the drill and the golden test
+    assert the identical contract."""
+    spans = sorted(
+        (ev for ev in record.get("events", ()) if ev.get("ph") == "X"),
+        key=lambda ev: ev.get("ts", 0),
+    )
+    rid = record.get("rid", "")
+    missing = []
+    readmit = [ev for ev in spans if ev.get("name") == "req_readmit"]
+    if not readmit:
+        missing.append("req_readmit span")
+        hop_ts = None
+        journaled = 0
+    else:
+        hop_ts = readmit[0].get("ts", 0)
+        journaled = int(readmit[0].get("args", {}).get("journaled", 0) or 0)
+
+    decode_names = ("req_decode", "req_spec")
+    prefill_names = ("req_prefill", "prefill", "prefill_dispatch")
+
+    def first_ts(names, after=None):
+        for ev in spans:
+            if ev.get("name") in names and (
+                after is None or ev.get("ts", 0) >= after
+            ):
+                return ev.get("ts", 0)
+        return None
+
+    full_tree = False
+    if hop_ts is not None:
+        # Survivor side — required for every readmitted stream: the
+        # hop re-enters the queue, prefills from the journal, decodes.
+        q_after = first_ts(("req_queue",), after=hop_ts)
+        p_after = first_ts(prefill_names, after=hop_ts)
+        d_after = first_ts(decode_names, after=hop_ts)
+        if q_after is None:
+            missing.append("req_queue span after the readmission hop")
+        if p_after is None:
+            missing.append("prefill span after the readmission hop")
+        if d_after is None:
+            missing.append("decode span after the readmission hop")
+        # whole-tick and per-dispatch spans overlap (the admission
+        # tick's decode span starts at the admission timestamp), so
+        # order on the decode phase's END, not its first start
+        d_end = max(
+            (ev.get("ts", 0) + ev.get("dur", 0) for ev in spans
+             if ev.get("name") in decode_names
+             and ev.get("ts", 0) >= hop_ts),
+            default=None,
+        )
+        if (q_after is not None and p_after is not None
+                and d_end is not None
+                and not (q_after <= p_after <= d_end)):
+            missing.append(
+                "post-hop order is not queue<=prefill<=decode")
+        # Victim side — required only when the stream had produced
+        # tokens before the kill (journaled > 0).
+        q_before = first_ts(("req_queue",))
+        p_before = first_ts(prefill_names)
+        d_before = [ev for ev in spans if ev.get("name") in decode_names
+                    and ev.get("ts", 0) <= hop_ts]
+        if journaled > 0:
+            if q_before is None or q_before > hop_ts:
+                missing.append("req_queue span before the readmission hop")
+            if p_before is None or p_before > hop_ts:
+                missing.append("prefill span before the readmission hop")
+            if not d_before:
+                missing.append("decode span before the readmission hop")
+            if (not missing and not (q_before <= p_before <= hop_ts)):
+                missing.append("phase order is not queue<=prefill<=readmit")
+        full_tree = bool(
+            q_before is not None and q_before <= hop_ts
+            and p_before is not None and p_before <= hop_ts
+            and d_before and d_after is not None and not missing
+        )
+        # the hop's flow arrow: begin (ph s) from the router with the
+        # journal-length suffix, bound (ph f) by the accepting replica
+        flow_ids = {
+            ev.get("id") for ev in record.get("events", ())
+            if ev.get("ph") in ("s", "f")
+        }
+        if not any(
+            isinstance(i, str) and i.startswith(f"req:{rid}:r")
+            for i in flow_ids
+        ):
+            missing.append("readmission flow arrow (req:<rid>:r<n>)")
+    return {
+        "ok": not missing,
+        "full_tree": full_tree,
+        "missing": missing,
+        "order": [ev.get("name") for ev in spans],
+        "flags": list(record.get("flags", ())),
+    }
+
+
 def run_serve_drill(
     n_replicas: int = 3,
     n_requests: int = 8,
@@ -420,7 +529,19 @@ def run_serve_drill(
     }
 
     # ---- chaos: kill the busiest replica mid-stream ------------------
-    alerts: list = []
+    # request forensics arm over the chaos phase only: the threshold is
+    # far above any drill latency, so retention is driven purely by the
+    # ``readmitted``/``lost`` flags — the killed stream's whole trace
+    # survives, everything else recycles
+    from theanompi_tpu import observability as obs
+
+    tracer_was_enabled = obs.get_tracer().enabled
+    if not tracer_was_enabled:
+        # request tracking rides the tracer; the drill CLI runs with
+        # tracing off, so switch it on for the chaos phase only
+        obs.enable_tracing()
+    obs.enable_request_tracking(threshold_s=max(timeout, 600.0))
+    alerts = []
     reps, router = build_fleet(alerts)
     try:
         for r in requests():
@@ -457,9 +578,23 @@ def run_serve_drill(
         for rep in reps:
             rep.stop()
 
+    retained = obs.retained_requests()
+    obs.disable_request_tracking()
+    if not tracer_was_enabled:
+        obs.disable_tracing()
+    readmitted = [
+        r for r in retained if "readmitted" in r.get("flags", ())
+    ]
     stats = router.fleet_stats()
     verdict["evictions"] = stats["evictions"]
     verdict["readmissions"] = stats["readmissions"]
+    verdict["forensics"] = {
+        "retained": len(retained),
+        "retained_rids": sorted(r["rid"] for r in retained),
+        "readmitted_traces": {
+            r["rid"]: check_readmit_trace(r) for r in readmitted
+        },
+    }
     verdict["eviction_alerts"] = alerts.count("replica_evicted")
     verdict["readmission_alerts"] = alerts.count("request_readmitted")
     verdict["token_identical"] = chaos_out == base_out
@@ -479,6 +614,24 @@ def run_serve_drill(
     if verdict["readmissions"] < 1:
         v.append("no in-flight stream re-admitted — the kill was a "
                  "monitoring blackout, not a survived failure")
+    else:
+        if not readmitted:
+            v.append("re-admission happened but no retained trace "
+                     "carries the 'readmitted' flag — tail forensics "
+                     "lost the killed stream's story")
+        traces = verdict["forensics"]["readmitted_traces"]
+        for rid, chk in sorted(traces.items()):
+            if not chk["ok"]:
+                v.append(
+                    f"retained trace for re-admitted stream {rid!r} is "
+                    f"missing: {', '.join(chk['missing'])} — not one "
+                    "causal queue->prefill->decode->readmit->decode tree"
+                )
+        if traces and not any(chk["full_tree"] for chk in traces.values()):
+            v.append(
+                "no re-admitted stream's trace shows the full "
+                "queue->prefill->decode->readmit->decode tree — every "
+                "victim was killed before producing a token")
     if not verdict["token_identical"]:
         diff = [k for k in base_out if chaos_out.get(k) != base_out[k]]
         v.append(f"outputs diverged from the uninterrupted run for "

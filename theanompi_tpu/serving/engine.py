@@ -331,9 +331,11 @@ class ServingEngine:
         logits = self._head(lnf, head, x[true_len - 1])
         return cache, logits
 
-    def prefill(self, params, cache, slot: int, tokens):
+    def prefill(self, params, cache, slot: int, tokens, rid=None):
         """Host entry: pad ``tokens`` (list/array of ints) to its bucket
-        and run the compiled prefill.  Returns (cache, logits (V,))."""
+        and run the compiled prefill.  Returns (cache, logits (V,)).
+        ``rid`` (request id) rides the span args only — request-trace
+        routing, zero effect on the compiled dispatch."""
         import numpy as np
 
         toks = np.asarray(tokens, dtype=np.int32).reshape(-1)
@@ -344,7 +346,8 @@ class ServingEngine:
         padded = np.zeros((b,), np.int32)
         padded[:n] = toks
         _PREFILLS.inc(bucket=str(b))
-        with obs.span("prefill_dispatch", bucket=b, true_len=n):
+        extra = {"rid": rid} if rid is not None else {}
+        with obs.span("prefill_dispatch", bucket=b, true_len=n, **extra):
             return self._prefill_jit(
                 params, cache, jnp.asarray(padded),
                 jnp.int32(slot), jnp.int32(n),

@@ -77,6 +77,13 @@ from theanompi_tpu.serving.scheduler import (
 
 PROTOCOL_VERSION = 1
 
+_REG = obs.get_registry()
+_FORCED_DRAIN_INSTALLS = _REG.counter(
+    "publish_forced_drain_installs_total",
+    "publish installs that composed a forced drain on a saturated "
+    "replica (expected rollout path under sustained load — not paged)",
+)
+
 
 class FleetError(RuntimeError):
     """No replica could take a request (fleet down / all draining)."""
@@ -128,6 +135,7 @@ class ServeReplica:
         prefix_impl: str = "radix",
         summary_cap: int = 256,
         tick_idle_s: float = 0.002,
+        install_max_wait_s: float = 30.0,
         **sched_kwargs,
     ):
         self.name = str(name)
@@ -136,6 +144,12 @@ class ServeReplica:
         self.scheduler = ContinuousBatchingScheduler(
             engine, params=params, prefix_impl=prefix_impl, **sched_kwargs
         )
+        # the ROUTER owns each stream's retention buffer: a replica-side
+        # finish is not the end of the request's story (the stream may
+        # yet be re-admitted elsewhere), so this scheduler must not
+        # close buffers — the router's _absorb_poll closes them when it
+        # sees the stream complete
+        self.scheduler.owns_request_buffers = False
         self.summary_cap = int(summary_cap)
         self.tick_idle_s = float(tick_idle_s)
         self._health_fn = health_fn
@@ -148,6 +162,14 @@ class ServeReplica:
         self.serving_generation = 0
         self.installs = 0
         self._pending_install: Optional[Tuple[Any, int]] = None
+        # forced-drain install (the saturated-replica gap): a pending
+        # install older than install_max_wait_s composes begin_drain →
+        # idle → apply → end_drain so a never-idle replica still makes
+        # rollout progress (<= 0 disables the forcing)
+        self.install_max_wait_s = float(install_max_wait_s)
+        self._pending_install_since: Optional[float] = None
+        self._forced_drain = False
+        self.forced_drain_installs = 0
         self._install_roster = Roster("publish", evict_after_s=3600.0)
         self.install_epoch = self._install_roster.join(self.name)
         self._killed = False
@@ -203,6 +225,7 @@ class ServeReplica:
                     with obs.span("replica_tick", replica=self.name):
                         self.scheduler.step()
                     self.ticks += 1
+                    self._maybe_force_drain_locked()
                 elif self._pending_install is not None:
                     # between-ticks install point: no queued and no
                     # active streams, so nothing can observe the swap
@@ -244,7 +267,35 @@ class ServeReplica:
             self._pending_install = (params, generation)
             if self.scheduler.idle:
                 self._apply_install_locked()
+            elif self._pending_install_since is None:
+                # rollout-progress clock starts at the FIRST deferral;
+                # a newer snapshot replacing a still-pending one keeps
+                # the original stamp (the gap is what matters)
+                self._pending_install_since = time.monotonic()
         return generation
+
+    def _maybe_force_drain_locked(self) -> None:
+        """The saturated-replica install gap: a replica that is never
+        idle would hold a pending install forever.  Once the deferral
+        outlives ``install_max_wait_s``, begin a drain — the router
+        observes ``draining`` in the next poll reply and routes new
+        work elsewhere; in-flight streams finish, the idle gap applies
+        the install, and ``_apply_install_locked`` reopens admissions.
+        Expected rollout path under sustained load: counted
+        (``publish_forced_drain_installs_total``), never paged."""
+        if (
+            self._pending_install is None
+            or self._forced_drain
+            or self.scheduler.draining
+            or self.install_max_wait_s <= 0
+            or self._pending_install_since is None
+        ):
+            return
+        waited = time.monotonic() - self._pending_install_since
+        if waited < self.install_max_wait_s:
+            return
+        self.scheduler.begin_drain()
+        self._forced_drain = True
 
     def _apply_install_locked(self) -> None:
         """Apply the queued install.  Caller holds ``self._lock`` and
@@ -254,6 +305,11 @@ class ServeReplica:
         after the new tree is fully in place."""
         params, generation = self._pending_install
         self._pending_install = None
+        self._pending_install_since = None
+        forced = self._forced_drain
+        track = obs.request_tracking_active()
+        if track:
+            t0 = obs.get_tracer().clock()
         with obs.span(
             "weights_install", replica=self.name, generation=generation
         ):
@@ -274,12 +330,33 @@ class ServeReplica:
             self.install_epoch = self._install_roster.join(self.name)
             self.scheduler.model_generation = generation
             self.serving_generation = generation  # marker LAST
+        if forced:
+            # the drain existed only to make this install possible —
+            # rejoin the admission rotation (the router un-drains this
+            # replica from its next poll reply)
+            self._forced_drain = False
+            self.scheduler.end_drain()
+            self.forced_drain_installs += 1
+            _FORCED_DRAIN_INSTALLS.inc(replica=self.name)
+        if track:
+            # install-wait phase spans for any stream still open on
+            # THIS replica (none in the ordinary idle-gap install; the
+            # span is the honest record if an install ever applies with
+            # streams in flight)
+            t1 = obs.get_tracer().clock()
+            for rid in self._streams:
+                if rid not in self.scheduler.finished:
+                    obs.add_span(
+                        "req_install_wait", t0, t1,
+                        {"rid": rid, "generation": generation},
+                    )
         obs.publish_event(
             "weights_installed",
             {
                 "replica": self.name,
                 "generation": generation,
                 "install_epoch": self.install_epoch,
+                "forced_drain": forced,
             },
         )
 
@@ -333,6 +410,16 @@ class ServeReplica:
             except ValueError as e:  # impossible geometry — loud, not lost
                 return {"ok": False, "reason": f"refused: {e}"}
             self._streams[req.id] = req
+        # arrow head of the router→replica hand-off: the flow id is
+        # reconstructed from the spec alone (``req:{rid}`` for the
+        # initial hop, ``req:{rid}:r{token_index0}`` for a re-admission
+        # — token_index0 IS the journal length at resubmit), so the
+        # replica needs no side channel to pair the router's begin
+        fid = (
+            f"req:{req.id}" if req.token_index0 == 0
+            else f"req:{req.id}:r{req.token_index0}"
+        )
+        obs.flow_end("req", fid, {"rid": req.id, "replica": self.name})
         return {"ok": True, "ticks": self.ticks}
 
     def _handle_poll(self, cursors: Dict[str, int]) -> Dict[str, Any]:
@@ -641,9 +728,22 @@ class FleetRouter:
         )
         if spec["id"] in self._streams:
             raise ValueError(f"stream id {spec['id']!r} already submitted")
-        name, score = self.route(spec["prompt"], generation=generation)
-        stream = _Stream(spec, name, pin=generation)
-        placed = self._place(stream, spec, first_choice=name)
+        rid = str(spec["id"])
+        # the request's story starts HERE: open its retention buffer
+        # (no-op unless request tracking is on) and emit the arrow tail
+        # the accepting replica's _handle_submit pairs with
+        obs.request_begin(rid, prompt_len=len(spec["prompt"]))
+        try:
+            with obs.span("fleet_submit", rid=rid):
+                obs.flow_begin("req", f"req:{rid}", {"rid": rid})
+                name, score = self.route(
+                    spec["prompt"], generation=generation
+                )
+                stream = _Stream(spec, name, pin=generation)
+                placed = self._place(stream, spec, first_choice=name)
+        except FleetError:
+            obs.request_end(rid, status="rejected")
+            raise
         if self.metrics is not None:
             gen = (
                 stream.pin if stream.pin is not None
@@ -763,6 +863,12 @@ class FleetRouter:
                 self.stats["finished"] += 1
                 if self.metrics is not None:
                     self.metrics.finished(st.id, len(st.tokens))
+                # the router owns the stream's retention buffer
+                # (replica schedulers run with owns_request_buffers
+                # off) — the story ends when the ROUTER sees the
+                # stream complete, so a mid-flight kill can still
+                # flag-and-retain the whole trace
+                obs.request_end(st.id, n_tokens=len(st.tokens))
 
     def _handle_eviction(self, name: str) -> None:
         state = self._replicas.get(name)
@@ -784,11 +890,18 @@ class FleetRouter:
                 self.stats["finished"] += 1
                 if self.metrics is not None:
                     self.metrics.finished(st.id, len(st.tokens))
+                # the dead replica's scheduler never closed this
+                # request's retention buffer — close it here (no-op
+                # when the replica-side finish already did)
+                obs.request_end(st.id, n_tokens=len(st.tokens))
                 continue
             spec = st.resubmit_spec()
             st.readmissions += 1
             self.stats["readmissions"] += 1
             smetrics.FLEET_READMISSIONS.inc(replica=name)
+            # a killed/readmitted stream is retained UNCONDITIONALLY —
+            # failovers are exactly the tails worth explaining
+            obs.request_flag(st.id, "readmitted")
             self._alert(
                 "request_readmitted",
                 f"stream {st.id!r} re-admitted off dead replica "
@@ -797,13 +910,28 @@ class FleetRouter:
             )
             try:
                 # a pinned stream re-admits only onto its generation —
-                # losing it when that generation vanished is honest
-                placed = self._place(st, spec, first_choice=self.route(
-                    spec["prompt"], generation=st.pin
-                )[0])
+                # losing it when that generation vanished is honest.
+                # The hop gets its own phase span + a fresh flow arrow
+                # (id suffixed with the journal length = the spec's
+                # token_index0, which the accepting replica's flow_end
+                # reconstructs without a side channel)
+                with obs.span("req_readmit", rid=st.id, off_replica=name,
+                              journaled=len(st.tokens)):
+                    obs.flow_begin(
+                        "req", f"req:{st.id}:r{len(st.tokens)}",
+                        {"rid": st.id},
+                    )
+                    placed = self._place(
+                        st, spec, first_choice=self.route(
+                            spec["prompt"], generation=st.pin
+                        )[0],
+                    )
             except FleetError:
                 st.done = True  # surfaced as a violation by the drill
                 self.stats["requests_lost"] += 1
+                obs.request_flag(st.id, "lost")
+                obs.request_end(st.id, status="lost",
+                                n_tokens=len(st.tokens))
                 self._alert(
                     "request_lost",
                     f"stream {st.id!r} could not re-admit anywhere",

@@ -135,14 +135,16 @@ class BlockPool:
         self.peak_used = max(self.peak_used, self.n_used)
 
     # ---- alloc / retain / release ------------------------------------
-    def alloc(self, n: int) -> Optional[List[int]]:
+    def alloc(self, n: int, rid=None) -> Optional[List[int]]:
         """``n`` fresh blocks (ref 1 each), or None — never a partial
-        grant, so a failed admission has nothing to roll back."""
+        grant, so a failed admission has nothing to roll back.  ``rid``
+        labels the span with the requesting stream (trace-only)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
-        with obs.span("block_alloc", n=n, free=len(self._free)):
+        extra = {"rid": rid} if rid is not None else {}
+        with obs.span("block_alloc", n=n, free=len(self._free), **extra):
             out = [self._free.pop() for _ in range(n)]
             for b in out:
                 self._ref[b] = 1
@@ -199,16 +201,18 @@ class PrefixCache:
         h.update(np.asarray(tokens, dtype=np.int64).tobytes())
         return h.digest()
 
-    def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+    def match(self, prompt: Sequence[int], rid=None) -> Tuple[List[int], int]:
         """Longest cached chain of full blocks covering a PREFIX of
         ``prompt``; each matched block is retained for the caller.
         Capped so at least the final prompt token is always prefilled
-        (its logits are the request's first decode input)."""
+        (its logits are the request's first decode input).  ``rid``
+        labels the span with the matching stream (trace-only)."""
         bs = self.block_size
         limit = (len(prompt) - 1) // bs
         out: List[int] = []
         parent = b""
-        with obs.span("prefix_match", n_prompt=len(prompt)):
+        extra = {"rid": rid} if rid is not None else {}
+        with obs.span("prefix_match", n_prompt=len(prompt), **extra):
             for j in range(limit):
                 parent = self._digest(parent, prompt[j * bs:(j + 1) * bs])
                 block = self._entries.get(parent)

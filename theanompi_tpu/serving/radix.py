@@ -109,16 +109,20 @@ class RadixPrefixCache:
         node.lru = self._clock
 
     # ---- the chain-cache contract ------------------------------------
-    def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+    def match(self, prompt: Sequence[int], rid=None) -> Tuple[List[int], int]:
         """Longest cached chain of full blocks covering a PREFIX of
         ``prompt``; each matched block retained for the caller, every
         touched node (trunk included) bumped in LRU — a partial
         overlap refreshes the shared trunk even when the tails have
-        long gone cold."""
+        long gone cold.  ``rid`` labels the span with the matching
+        stream (trace-only)."""
         bs = self.block_size
         digests = chain_digests(prompt, bs)[: (len(prompt) - 1) // bs]
         out: List[int] = []
-        with obs.span("prefix_match", n_prompt=len(prompt), impl="radix"):
+        extra = {"rid": rid} if rid is not None else {}
+        with obs.span(
+            "prefix_match", n_prompt=len(prompt), impl="radix", **extra
+        ):
             node: Optional[_Node] = None
             for d in digests:
                 nxt = (

@@ -162,6 +162,13 @@ class ContinuousBatchingScheduler:
         # slots and queued requests but REFUSES new submissions with
         # counted backpressure (the fleet router routes them elsewhere)
         self.draining = False
+        # request-buffer ownership: a standalone scheduler closes each
+        # rid's retention buffer when the request finishes; a fleet
+        # replica's scheduler must NOT — the router owns the stream's
+        # end-to-end story (a replica-side finish is not the end of it:
+        # the stream may yet be re-admitted elsewhere), so fleet.py
+        # clears this and closes buffers router-side
+        self.owns_request_buffers = True
         self._tokens = np.zeros((engine.n_slots,), np.int32)
         self._active = np.zeros((engine.n_slots,), bool)
         self._sampler = None  # built lazily on the first sampling request
@@ -177,6 +184,20 @@ class ContinuousBatchingScheduler:
             "backpressure_events": 0,
             "drain_refusals": 0,
         }
+        # request forensics (observability request tracking, all gated
+        # on obs.request_tracking_active()): enqueue timestamps for
+        # queue-wait spans, when the head of the queue started stalling
+        # on pool backpressure, and rids finished this tick — their
+        # buffers close at the END of step() so the tick's phase spans
+        # land inside them first
+        self._req_enq: Dict[str, float] = {}
+        self._bp_since: Optional[float] = None
+        self._req_done: List[tuple] = []
+        # mid-tick admission timestamps (cleared each step): the
+        # whole-tick phase span for a request admitted partway through
+        # a tick starts at its admission, not the tick edge, so its
+        # queue wait is never double-billed as prefill
+        self._req_tick_adm: Dict[str, float] = {}
         if self.paged:
             if pool is not None and pool.block_size != engine.block_size:
                 raise ValueError("pool/engine block_size mismatch")
@@ -245,6 +266,13 @@ class ContinuousBatchingScheduler:
         reports idle, then ``leave()``s its roster cleanly."""
         self.draining = True
 
+    def end_drain(self) -> None:
+        """Reopen admissions after a drain ran its course — the forced
+        publish-install path composes ``begin_drain`` → idle →
+        ``install_params`` apply → ``end_drain`` so a saturated replica
+        still takes rollouts (fleet.ServeReplica)."""
+        self.draining = False
+
     @property
     def idle(self) -> bool:
         """Nothing queued, nothing in flight — a draining scheduler
@@ -277,6 +305,12 @@ class ContinuousBatchingScheduler:
                                   t=self.clock(),
                                   generation=self.model_generation)
         self.queue.append(request)
+        if obs.request_tracking_active():
+            # idempotent: under a fleet the router already opened this
+            # rid at its own submit (the true request start); in
+            # router-less runs this IS the open
+            obs.request_begin(request.id, prompt_len=len(request.prompt))
+            self._req_enq[request.id] = self.clock()
         _ADMITTED.inc()
         _QUEUE.set(len(self.queue))
 
@@ -318,6 +352,10 @@ class ContinuousBatchingScheduler:
         slot.request = None
         slot.produced = 0
         self._active[i] = False
+        if obs.request_tracking_active():
+            # close the request buffer at the END of step(), after the
+            # tick's phase spans have landed in it
+            self._req_done.append((req.id, len(req.output)))
         _FINISHED.inc()
         _SLOTS.set(self.n_active)
 
@@ -397,12 +435,46 @@ class ContinuousBatchingScheduler:
         req = slot.request
         req.output.append(token)
         slot.produced += 1
-        if self.metrics is not None and slot.produced == 1:
-            self.metrics.first_token(req.id, t=self.clock())
+        if slot.produced == 1:
+            if self.metrics is not None:
+                self.metrics.first_token(req.id, t=self.clock())
+            obs.request_mark(req.id, "first_token")
         return (
             slot.produced >= req.max_new_tokens
             or (req.eos_id is not None and token == req.eos_id)
         )
+
+    # ------------------------------------------------------------------
+    # request-forensics phase spans (no-ops unless request tracking is
+    # on — obs.request_tracking_active(); spans carry rid args, so the
+    # tracer routes each into its request's retention buffer)
+    # ------------------------------------------------------------------
+    def _note_admitted(self, rid: str) -> None:
+        """Retroactive queue-wait (and backpressure-stall) spans for a
+        just-admitted request."""
+        if not obs.request_tracking_active():
+            self._req_enq.pop(rid, None)
+            return
+        now = self.clock()
+        self._req_tick_adm[rid] = now
+        t_enq = self._req_enq.pop(rid, None)
+        if t_enq is not None:
+            obs.add_span("req_queue", t_enq, now, {"rid": rid})
+        if self._bp_since is not None:
+            # the head of the queue sat on an exhausted pool from
+            # _bp_since until this admission unstuck it
+            obs.add_span(
+                "req_backpressure", self._bp_since, now, {"rid": rid}
+            )
+            self._bp_since = None
+
+    def _close_finished_requests(self) -> None:
+        """End the request buffers of every rid finished this tick —
+        runs LAST in step() so every phase span has already landed."""
+        if self.owns_request_buffers:
+            for rid, n_tokens in self._req_done:
+                obs.request_end(rid, n_tokens=n_tokens)
+        self._req_done.clear()
 
     # ------------------------------------------------------------------
     # contiguous tick
@@ -415,11 +487,12 @@ class ContinuousBatchingScheduler:
             if slot.request is not None or not self.queue:
                 continue
             req = self.queue.pop(0)
+            self._note_admitted(req.id)
             slot.request = req
             with obs.span("prefill", slot=i, rid=req.id,
                           n_prompt=len(req.prompt)):
                 self.cache, logits = self.engine.prefill(
-                    self.params, self.cache, i, req.prompt
+                    self.params, self.cache, i, req.prompt, rid=req.id
                 )
             self._active[i] = True
             self._note_concurrency()
@@ -430,6 +503,13 @@ class ContinuousBatchingScheduler:
                 self._finish(i)
         # 2) one fixed-shape decode tick over the active slots
         if self._active.any():
+            track = obs.request_tracking_active()
+            if track:
+                t0 = self.clock()
+                rids = [
+                    s.request.id if self._active[i] else None
+                    for i, s in enumerate(self.slots)
+                ]
             for i, slot in enumerate(self.slots):
                 # the token entering each active slot = its last output
                 self._tokens[i] = (
@@ -451,6 +531,13 @@ class ContinuousBatchingScheduler:
                 produced += 1
                 if self._emit(i, int(toks[i])):
                     self._finish(i)
+            if track:
+                t1 = self.clock()
+                for i in range(len(self.slots)):
+                    if rids[i] is not None:
+                        obs.add_span(
+                            "req_decode", t0, t1, {"rid": rids[i]}
+                        )
         return produced
 
     # ------------------------------------------------------------------
@@ -473,22 +560,26 @@ class ContinuousBatchingScheduler:
             hits: List[int] = []
             hit_tokens = 0
             if self.prefix is not None:
-                hits, hit_tokens = self.prefix.match(req.prompt)
-            fresh = self.pool.alloc(need - len(hits))
+                hits, hit_tokens = self.prefix.match(req.prompt, rid=req.id)
+            fresh = self.pool.alloc(need - len(hits), rid=req.id)
             if fresh is None and self.prefix is not None:
                 # the shortfall rides along so a need-aware cache (the
                 # radix tree) can evict ONLY the coldest tails; the
                 # chain cache ignores it and sweeps everything idle
                 shortfall = (need - len(hits)) - self.pool.n_free
                 self.prefix.evict_unused(max(1, shortfall))
-                fresh = self.pool.alloc(need - len(hits))
+                fresh = self.pool.alloc(need - len(hits), rid=req.id)
             if fresh is None:
                 # roll back the prefix refs; the request stays queued
                 self.pool.release_all(hits)
                 self.stats["backpressure_events"] += 1
                 smetrics.ADMISSION_BACKPRESSURE.inc()
+                if (self._bp_since is None
+                        and obs.request_tracking_active()):
+                    self._bp_since = self.clock()
                 break
             self.queue.pop(0)
+            self._note_admitted(req.id)
             slot.request = req
             slot.blocks = hits + fresh
             slot.n_fed = hit_tokens
@@ -516,6 +607,12 @@ class ContinuousBatchingScheduler:
         ][: self.engine.prefill_rows]
         if not pending:
             return 0
+        track = obs.request_tracking_active()
+        if track:
+            # rids up front: a lane that completes AND finishes this
+            # tick has slot.request=None by the span-emit point below
+            t0 = self.clock()
+            rids = [self.slots[i].request.id for i in pending]
         cap = (
             self.engine.prefill_chunk
             if self.engine.prefill_chunk is not None
@@ -562,6 +659,17 @@ class ContinuousBatchingScheduler:
                 produced += 1
                 if self._emit(i, int(picks[r_idx])):
                     self._finish(i)
+        if track:
+            # one req_prefill phase span per lane covering the WHOLE
+            # tick (row prep, the dispatch, and the blocking pick) —
+            # host time a dispatch-only span would leave unattributed
+            t1 = self.clock()
+            for r_idx in range(len(pending)):
+                obs.add_span(
+                    "req_prefill", t0, t1,
+                    {"rid": rids[r_idx],
+                     "n_tokens": len(rows[r_idx]["tokens"])},
+                )
         return produced
 
     def _decode_tick_paged(self) -> int:
@@ -570,6 +678,13 @@ class ContinuousBatchingScheduler:
         )
         if not decoding.any():
             return 0
+        track = obs.request_tracking_active()
+        if track:
+            t0 = self.clock()
+            rids = [
+                s.request.id if decoding[i] else None
+                for i, s in enumerate(self.slots)
+            ]
         for i, slot in enumerate(self.slots):
             self._tokens[i] = (
                 slot.request.output[-1] if decoding[i] else 0
@@ -594,6 +709,11 @@ class ContinuousBatchingScheduler:
             produced += 1
             if self._emit(i, int(toks[i])):
                 self._finish(i)
+        if track:
+            t1 = self.clock()
+            for i in range(len(self.slots)):
+                if rids[i] is not None:
+                    obs.add_span("req_decode", t0, t1, {"rid": rids[i]})
         return produced
 
     # ------------------------------------------------------------------
@@ -611,10 +731,19 @@ class ContinuousBatchingScheduler:
         decoding = np.array([s.decoding for s in self.slots], dtype=bool)
         if not decoding.any():
             return 0
+        track = obs.request_tracking_active()
+        if track:
+            t0 = self.clock()
+            rids = [
+                s.request.id if decoding[i] else None
+                for i, s in enumerate(self.slots)
+            ]
+            accepted = [0] * len(self.slots)
         for i, slot in enumerate(self.slots):
             if decoding[i] and not spec._blocks[i]:
                 spec.ensure_slot(i, slot.request.prompt,
-                                 slot.request.max_new_tokens)
+                                 slot.request.max_new_tokens,
+                                 rid=slot.request.id)
         n = len(self.slots)
         k = spec.k
         last = np.zeros((n,), np.int32)
@@ -673,6 +802,8 @@ class ContinuousBatchingScheduler:
                     finished = True
                     break
             spec.note_lane(int(k_eff[i]), a, m)
+            if track:
+                accepted[i] = a
             # target K/V bookkeeping: rows p0..p0+m-1 hold the emitted
             # prefix's tokens; everything past them is masked garbage
             self._lengths[i] = int(p0[i]) + m
@@ -681,6 +812,21 @@ class ContinuousBatchingScheduler:
             else:
                 spec.commit(i, a, int(k_eff[i]), props[i], int(last[i]),
                             int(p0[i]))
+        if track:
+            # req_spec = this request's share of the speculative round;
+            # proposed/accepted let the doctor carve the rolled-back
+            # fraction out as the spec_rollback phase
+            t1 = self.clock()
+            for i in range(len(self.slots)):
+                if rids[i] is not None:
+                    obs.add_span(
+                        "req_spec", t0, t1,
+                        {"rid": rids[i], "proposed": int(k_eff[i]),
+                         "accepted": int(accepted[i]),
+                         "rolled_back": max(
+                             0, int(k_eff[i]) - int(accepted[i])
+                         )},
+                    )
         return produced
 
     def _step_paged(self) -> int:
@@ -696,9 +842,54 @@ class ContinuousBatchingScheduler:
     def step(self) -> int:
         """One tick: admissions, (paged) chunked prefill, then one
         decode step.  Returns the number of tokens generated."""
+        track = obs.request_tracking_active()
+        if track:
+            # whole-tick phase accounting: a decoding lane spends real
+            # wall time sitting through OTHER lanes' prefill chunks and
+            # the tick's host bookkeeping — time the per-dispatch spans
+            # alone leave unattributed.  One span per in-flight rid per
+            # tick, named for the phase the request is IN (decode wall
+            # time is what TPOT measures; prefill wall time is what
+            # TTFT measures), clipped to mid-tick admission.
+            t0 = self.clock()
+            self._req_tick_adm.clear()
+            phase_of: Dict[str, str] = {}
+            for s in self.slots:
+                if s.request is not None:
+                    feeding = (
+                        self.paged
+                        and s.n_fed < len(s.request.prompt)
+                    )
+                    phase_of[s.request.id] = (
+                        "req_prefill" if feeding else "req_decode"
+                    )
         produced = (
             self._step_paged() if self.paged else self._step_contiguous()
         )
+        if track:
+            t1 = self.clock()
+            for s in self.slots:
+                if s.request is not None:
+                    rid = s.request.id
+                    if rid not in phase_of:
+                        feeding = (
+                            self.paged
+                            and s.n_fed < len(s.request.prompt)
+                        )
+                        phase_of[rid] = (
+                            "req_prefill" if feeding else "req_decode"
+                        )
+            for rid, _n in self._req_done:
+                # finished mid-tick: it was producing tokens, so its
+                # share of this tick reads as decode unless it entered
+                # the tick still feeding prompt
+                phase_of.setdefault(rid, "req_decode")
+            for rid, name in phase_of.items():
+                start = max(t0, self._req_tick_adm.get(rid, t0))
+                if t1 > start:
+                    obs.add_span(name, start, t1, {"rid": rid})
+        if self._req_done:
+            self._close_finished_requests()
         _TOKENS.inc(produced, model_generation=str(self.model_generation))
         return produced
 

@@ -120,10 +120,11 @@ class SpecDecoder:
     # ------------------------------------------------------------------
     # slot lifecycle (mirrors the target scheduler's)
     # ------------------------------------------------------------------
-    def ensure_slot(self, i: int, prompt, max_new: int) -> None:
+    def ensure_slot(self, i: int, prompt, max_new: int, rid=None) -> None:
         """Mirror-admit target slot ``i``: allocate draft blocks and
         prefill the whole prompt into the draft cache (chunked through
-        the draft's own bucket ladder).  Idempotent."""
+        the draft's own bucket ladder).  Idempotent.  ``rid`` labels the
+        draft-prefill span with the owning stream (trace-only)."""
         if self._blocks[i]:
             return
         need = self.draft.max_seq_blocks(len(prompt) + max_new)
@@ -140,7 +141,10 @@ class SpecDecoder:
         self._tables[i, :len(blocks)] = blocks
         cap = self.draft.chunk_buckets[-1]
         p0 = 0
-        with obs.span("spec_draft_prefill", slot=i, n_prompt=len(prompt)):
+        extra = {"rid": rid} if rid is not None else {}
+        with obs.span(
+            "spec_draft_prefill", slot=i, n_prompt=len(prompt), **extra
+        ):
             while p0 < len(prompt):
                 chunk = list(prompt[p0:p0 + cap])
                 self.state, _ = self.draft.prefill_chunks(
